@@ -1,0 +1,30 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up rebuild of the capabilities of PredictionIO (reference:
+``/root/reference``, v0.9.2-SNAPSHOT) designed TPU-first:
+
+- **Storage plane** (:mod:`predictionio_tpu.storage`): append-only event store
+  with ``$set/$unset/$delete`` property semantics, metadata DAOs (apps, access
+  keys, engine manifests, engine/evaluation instances) and model blob stores.
+  (Reference: ``data/src/main/scala/io/prediction/data/storage/``.)
+- **Event server** (:mod:`predictionio_tpu.api`): REST ingestion API compatible
+  with the reference's ``events.json`` / ``stats.json`` routes.
+  (Reference: ``data/src/main/scala/io/prediction/data/api/EventAPI.scala``.)
+- **DASE controller** (:mod:`predictionio_tpu.controller`): DataSource →
+  Preparator → Algorithm(s) → Serving engine contract, engine-variant JSON
+  params, evaluation metrics and memoized hyperparameter sweeps.
+  (Reference: ``core/src/main/scala/io/prediction/controller/``.)
+- **Workflow runtime** (:mod:`predictionio_tpu.workflow`): train/eval/deploy
+  lifecycle with persisted engine instances, a TPU mesh context instead of a
+  SparkContext, and a query REST server with hot reload.
+  (Reference: ``core/src/main/scala/io/prediction/workflow/``.)
+- **Compute plane** (:mod:`predictionio_tpu.ops`, :mod:`predictionio_tpu.models`):
+  jit'd / Pallas kernels — blocked ALS with mesh-sharded factor tables, Naive
+  Bayes sufficient-statistic reductions, batched gather-dot top-k serving —
+  replacing the reference's delegation to Spark MLlib.
+- **Parallelism** (:mod:`predictionio_tpu.parallel`): ``jax.sharding.Mesh``
+  construction, sharding specs, and collective helpers (ICI within a slice,
+  DCN across slices) replacing Spark executor scheduling and shuffles.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,80 @@
+"""Storage plane: events, metadata, models, ID maps.
+
+Rebuild of the reference's L1 storage abstraction
+(``data/src/main/scala/io/prediction/data/storage/``; SURVEY §1 L1, §2.2).
+"""
+
+from .aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    EventOp,
+    aggregate_properties,
+    aggregate_single,
+)
+from .bimap import BiMap, EntityMap
+from .data_map import DataMap, DataMapException, PropertyMap
+from .event import (
+    Event,
+    EventValidationError,
+    format_event_time,
+    parse_event_time,
+    utcnow,
+    validate_event,
+)
+from .events import EventFilter, EventStore
+from .metadata import (
+    STATUS_COMPLETED,
+    STATUS_EVALCOMPLETED,
+    STATUS_EVALUATING,
+    STATUS_INIT,
+    STATUS_TRAINING,
+    AccessKey,
+    App,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    MetadataStore,
+    new_engine_instance,
+)
+from .model_store import LocalFSModelStore, Model, ModelStore, SqliteModelStore
+from .registry import StorageError, StorageRegistry, get_registry
+from .sqlite_events import SqliteEventStore
+
+__all__ = [
+    "AGGREGATOR_EVENT_NAMES",
+    "AccessKey",
+    "App",
+    "BiMap",
+    "DataMap",
+    "DataMapException",
+    "EngineInstance",
+    "EngineManifest",
+    "EntityMap",
+    "EvaluationInstance",
+    "Event",
+    "EventFilter",
+    "EventOp",
+    "EventStore",
+    "EventValidationError",
+    "LocalFSModelStore",
+    "MetadataStore",
+    "Model",
+    "ModelStore",
+    "PropertyMap",
+    "STATUS_COMPLETED",
+    "STATUS_EVALCOMPLETED",
+    "STATUS_EVALUATING",
+    "STATUS_INIT",
+    "STATUS_TRAINING",
+    "SqliteEventStore",
+    "SqliteModelStore",
+    "StorageError",
+    "StorageRegistry",
+    "aggregate_properties",
+    "aggregate_single",
+    "format_event_time",
+    "get_registry",
+    "new_engine_instance",
+    "parse_event_time",
+    "utcnow",
+    "validate_event",
+]

@@ -1,0 +1,133 @@
+"""Bidirectional maps for ID ↔ index translation.
+
+Rebuild of the reference's ``BiMap`` / ``EntityMap``
+(``data/src/main/scala/io/prediction/data/storage/BiMap.scala:25-164``,
+``EntityMap.scala``): the device every recommender template uses to turn
+string entity IDs into dense matrix indices and back. On TPU this is the
+boundary between host-side string IDs and device-side integer indices: the
+forward map feeds index arrays to infeed, the inverse map decodes top-k
+results coming back from the scoring kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Generic, Iterable, Iterator, List, Mapping, Optional, Tuple, TypeVar
+
+import numpy as np
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class BiMap(Generic[K, V]):
+    """Immutable bidirectional map (``BiMap.scala:25-105``).
+
+    Construction fails if values are not unique, matching the reference's
+    requirement that the map be invertible.
+    """
+
+    def __init__(self, forward: Mapping[K, V], _inverse: Optional[Mapping[V, K]] = None):
+        self._forward: Dict[K, V] = dict(forward)
+        if _inverse is None:
+            inverse: Dict[V, K] = {}
+            for k, v in self._forward.items():
+                if v in inverse:
+                    raise ValueError(
+                        f"BiMap values must be unique; duplicate value {v!r}"
+                    )
+                inverse[v] = k
+            self._inverse = inverse
+        else:
+            self._inverse = dict(_inverse)
+
+    # -- accessors --------------------------------------------------------
+    def __getitem__(self, key: K) -> V:
+        return self._forward[key]
+
+    def get(self, key: K) -> Optional[V]:
+        return self._forward.get(key)
+
+    def get_or_else(self, key: K, default: V) -> V:
+        return self._forward.get(key, default)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._forward
+
+    def __len__(self) -> int:
+        return len(self._forward)
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._forward)
+
+    def contains(self, key: K) -> bool:
+        return key in self._forward
+
+    @property
+    def inverse(self) -> "BiMap[V, K]":
+        """O(1) inverted view (``BiMap.scala:45-50``)."""
+        return BiMap(self._inverse, _inverse=self._forward)
+
+    def to_dict(self) -> Dict[K, V]:
+        return dict(self._forward)
+
+    def take(self, n: int) -> "BiMap[K, V]":
+        sub = dict(list(self._forward.items())[:n])
+        return BiMap(sub)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, BiMap):
+            return self._forward == other._forward
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(frozenset(self._forward.items()))
+
+    def __repr__(self) -> str:
+        return f"BiMap({self._forward!r})"
+
+    # -- builders (BiMap.scala:110-164) -----------------------------------
+    @staticmethod
+    def string_int(keys: Iterable[str]) -> "BiMap[str, int]":
+        """Distinct keys → dense [0, n) indices (``BiMap.stringInt``)."""
+        seen: Dict[str, int] = {}
+        for k in keys:
+            if k not in seen:
+                seen[k] = len(seen)
+        return BiMap(seen)
+
+    string_long = string_int  # Python ints are unbounded
+
+    # -- vectorized translation (TPU infeed path) --------------------------
+    def map_array(
+        self, keys: Iterable[K], missing: int = -1
+    ) -> np.ndarray:
+        """Vectorized forward lookup → int32 numpy array.
+
+        Unknown keys map to ``missing`` so callers can mask them out before
+        device transfer (the sparse-infeed analogue of the reference's
+        ``.filter`` on map hits).
+        """
+        fwd = self._forward
+        return np.fromiter(
+            (fwd.get(k, missing) for k in keys), dtype=np.int32
+        )
+
+    def inverse_list(self, indices: Iterable[V]) -> List[K]:
+        inv = self._inverse
+        return [inv[i] for i in indices]
+
+
+class EntityMap(BiMap[str, int]):
+    """BiMap from entity id → dense index that also carries entity payloads
+    (``EntityMap.scala``)."""
+
+    def __init__(self, entities: Mapping[str, object]):
+        ids = BiMap.string_int(entities.keys())
+        super().__init__(ids.to_dict())
+        self._entities = dict(entities)
+
+    def entity(self, key: str):
+        return self._entities[key]
+
+    def entity_by_index(self, index: int):
+        return self._entities[self._inverse[index]]

@@ -1,0 +1,215 @@
+"""Event model and validation.
+
+Rebuild of the reference's event record and validation rules
+(``data/src/main/scala/io/prediction/data/storage/Event.scala:37-115``):
+an append-only, immutable event with entity / optional target-entity
+addressing, a schema-free property bag, and reserved-name rules for the
+``$set/$unset/$delete`` special events and the ``pio_`` prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Any, Mapping, Optional, Sequence, Union
+
+from .data_map import DataMap
+
+UTC = _dt.timezone.utc
+
+#: Single-entity reserved events (``Event.scala:66``).
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+
+#: Entity types exempt from the reserved-prefix rule (``Event.scala:102``).
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+
+#: Property names exempt from the reserved-prefix rule (``Event.scala:103``).
+BUILTIN_PROPERTIES: frozenset = frozenset()
+
+
+class EventValidationError(ValueError):
+    """An event violates the reference's validation rules."""
+
+
+def is_reserved_prefix(name: str) -> bool:
+    """``$``- or ``pio_``-prefixed names are reserved (``Event.scala:63-64``)."""
+    return name.startswith("$") or name.startswith("pio_")
+
+
+def is_special_event(name: str) -> bool:
+    return name in SPECIAL_EVENTS
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+def _as_datetime(value: Union[_dt.datetime, str, None]) -> Optional[_dt.datetime]:
+    if value is None or isinstance(value, _dt.datetime):
+        if isinstance(value, _dt.datetime) and value.tzinfo is None:
+            # Reference default time zone is UTC (Event.scala:59).
+            return value.replace(tzinfo=UTC)
+        return value
+    if isinstance(value, str):
+        return parse_event_time(value)
+    raise EventValidationError(f"Cannot interpret {value!r} as a datetime")
+
+
+def parse_event_time(text: str) -> _dt.datetime:
+    """Parse an ISO-8601 timestamp; naive times are taken as UTC."""
+    t = text.strip()
+    if t.endswith("Z") or t.endswith("z"):
+        t = t[:-1] + "+00:00"
+    try:
+        parsed = _dt.datetime.fromisoformat(t)
+    except ValueError as exc:
+        raise EventValidationError(f"Invalid event time {text!r}: {exc}") from exc
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=UTC)
+    return parsed
+
+
+def to_millis(when: _dt.datetime) -> int:
+    """Epoch milliseconds; naive datetimes are taken as UTC."""
+    if when.tzinfo is None:
+        when = when.replace(tzinfo=UTC)
+    return int(when.timestamp() * 1000)
+
+
+def format_event_time(when: _dt.datetime) -> str:
+    """ISO-8601 with millisecond precision and explicit offset."""
+    if when.tzinfo is None:
+        when = when.replace(tzinfo=UTC)
+    return when.isoformat(timespec="milliseconds")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One immutable event (``Event.scala:37-55``).
+
+    ``event_id`` is assigned by the event store on insert; ``creation_time``
+    records system arrival while ``event_time`` is when the event happened.
+    """
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: Optional[str] = None
+    target_entity_id: Optional[str] = None
+    properties: DataMap = dataclasses.field(default_factory=DataMap)
+    event_time: _dt.datetime = dataclasses.field(default_factory=utcnow)
+    tags: Sequence[str] = ()
+    pr_id: Optional[str] = None
+    creation_time: _dt.datetime = dataclasses.field(default_factory=utcnow)
+    event_id: Optional[str] = None
+
+    def __post_init__(self):
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+        object.__setattr__(self, "event_time", _as_datetime(self.event_time))
+        object.__setattr__(self, "creation_time", _as_datetime(self.creation_time))
+        object.__setattr__(self, "tags", tuple(self.tags))
+
+    # -- JSON codec (wire format of the Event Server, EventJson4sSupport) --
+    def to_json_dict(self) -> dict:
+        out: dict = {
+            "event": self.event,
+            "entityType": self.entity_type,
+            "entityId": self.entity_id,
+            "properties": self.properties.to_dict(),
+            "eventTime": format_event_time(self.event_time),
+        }
+        if self.event_id is not None:
+            out["eventId"] = self.event_id
+        if self.target_entity_type is not None:
+            out["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            out["targetEntityId"] = self.target_entity_id
+        if self.tags:
+            out["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            out["prId"] = self.pr_id
+        out["creationTime"] = format_event_time(self.creation_time)
+        return out
+
+    @classmethod
+    def from_json_dict(cls, obj: Mapping[str, Any]) -> "Event":
+        def req(key: str) -> Any:
+            if key not in obj:
+                raise EventValidationError(f"field {key} is required")
+            return obj[key]
+
+        now = utcnow()
+        return cls(
+            event=req("event"),
+            entity_type=req("entityType"),
+            entity_id=str(req("entityId")),
+            target_entity_type=obj.get("targetEntityType"),
+            target_entity_id=(
+                None
+                if obj.get("targetEntityId") is None
+                else str(obj["targetEntityId"])
+            ),
+            properties=DataMap(obj.get("properties") or {}),
+            event_time=_as_datetime(obj.get("eventTime")) or now,
+            tags=tuple(obj.get("tags") or ()),
+            pr_id=obj.get("prId"),
+            creation_time=_as_datetime(obj.get("creationTime")) or now,
+            event_id=obj.get("eventId"),
+        )
+
+
+def _require(cond: bool, message: str) -> None:
+    if not cond:
+        raise EventValidationError(message)
+
+
+def validate_event(e: Event) -> None:
+    """Apply the reference's validation rules (``Event.scala:70-99``)."""
+    _require(bool(e.event), "event must not be empty.")
+    _require(bool(e.entity_type), "entityType must not be empty string.")
+    _require(bool(e.entity_id), "entityId must not be empty string.")
+    _require(
+        e.target_entity_type is None or bool(e.target_entity_type),
+        "targetEntityType must not be empty string",
+    )
+    _require(
+        e.target_entity_id is None or bool(e.target_entity_id),
+        "targetEntityId must not be empty string.",
+    )
+    _require(
+        (e.target_entity_type is None) == (e.target_entity_id is None),
+        "targetEntityType and targetEntityId must be specified together.",
+    )
+    _require(
+        not (e.event == "$unset" and e.properties.is_empty()),
+        "properties cannot be empty for $unset event",
+    )
+    _require(
+        not is_reserved_prefix(e.event) or is_special_event(e.event),
+        f"{e.event} is not a supported reserved event name.",
+    )
+    _require(
+        not is_special_event(e.event)
+        or (e.target_entity_type is None and e.target_entity_id is None),
+        f"Reserved event {e.event} cannot have targetEntity",
+    )
+    _require(
+        not is_reserved_prefix(e.entity_type)
+        or e.entity_type in BUILTIN_ENTITY_TYPES,
+        f"The entityType {e.entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    if e.target_entity_type is not None:
+        _require(
+            not is_reserved_prefix(e.target_entity_type)
+            or e.target_entity_type in BUILTIN_ENTITY_TYPES,
+            f"The targetEntityType {e.target_entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )
+    for key in e.properties.keyset():
+        _require(
+            not is_reserved_prefix(key) or key in BUILTIN_PROPERTIES,
+            f"The property {key} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )

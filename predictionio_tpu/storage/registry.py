@@ -1,0 +1,212 @@
+"""Storage registry: environment-driven backend wiring.
+
+Rebuild of the reference's ``Storage`` object
+(``data/src/main/scala/io/prediction/data/storage/Storage.scala:33-302``):
+sources are declared by ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ ``_PATH`` here,
+instead of hosts/ports), and the three repositories are bound by
+``PIO_STORAGE_REPOSITORIES_{METADATA,MODELDATA,EVENTDATA}_{NAME,SOURCE}``.
+Clients are constructed lazily and cached per source
+(``Storage.scala:124-174``); ``verify_all_data_objects`` backs the ``status``
+CLI command (``Storage.scala:230-250``).
+
+Default wiring (no env vars): a single SQLite source rooted at
+``$PIO_FS_BASEDIR`` (default ``~/.predictionio_tpu``), so a fresh checkout
+works with zero configuration.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from .event import Event, utcnow
+from .events import EventStore
+from .metadata import MetadataStore
+from .model_store import LocalFSModelStore, Model, ModelStore, SqliteModelStore
+from .sqlite_events import SqliteEventStore
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_TYPE$")
+
+REPO_METADATA = "METADATA"
+REPO_MODELDATA = "MODELDATA"
+REPO_EVENTDATA = "EVENTDATA"
+
+
+class StorageError(Exception):
+    """Configuration or client-construction failure (``Storage.scala:61``)."""
+
+
+def base_dir(env: Optional[Dict[str, str]] = None) -> str:
+    e = env if env is not None else os.environ
+    return e.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".predictionio_tpu")
+    )
+
+
+class StorageRegistry:
+    """Lazily-constructed, cached storage clients keyed by source name."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self._lock = threading.RLock()
+        self._event_stores: Dict[str, EventStore] = {}
+        self._metadata_stores: Dict[str, MetadataStore] = {}
+        self._model_stores: Dict[str, ModelStore] = {}
+        self._sources = self._parse_sources()
+
+    # -- config parsing (Storage.scala:38-51,96-121) ----------------------
+    def _parse_sources(self) -> Dict[str, Dict[str, str]]:
+        sources: Dict[str, Dict[str, str]] = {}
+        for key, value in self._env.items():
+            m = _SOURCE_RE.match(key)
+            if not m:
+                continue
+            name = m.group(1)
+            prefix = f"PIO_STORAGE_SOURCES_{name}_"
+            conf = {
+                k[len(prefix):].lower(): v
+                for k, v in self._env.items()
+                if k.startswith(prefix)
+            }
+            sources[name] = conf
+        if not sources:
+            root = base_dir(self._env)
+            sources["LOCAL"] = {"type": "sqlite", "path": root}
+        return sources
+
+    def _repo_source_name(self, repo: str) -> str:
+        name = self._env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
+        if name is None:
+            if len(self._sources) == 1:
+                return next(iter(self._sources))
+            raise StorageError(
+                f"Repository {repo} has no PIO_STORAGE_REPOSITORIES_{repo}_SOURCE "
+                f"and multiple sources are configured: {sorted(self._sources)}"
+            )
+        if name not in self._sources:
+            raise StorageError(
+                f"Repository {repo} references undefined source {name!r} "
+                f"(defined: {sorted(self._sources)})"
+            )
+        return name
+
+    def _source_conf(self, name: str) -> Dict[str, str]:
+        return self._sources[name]
+
+    def _source_path(self, name: str, filename: str) -> str:
+        conf = self._source_conf(name)
+        root = conf.get("path", base_dir(self._env))
+        return os.path.join(root, filename)
+
+    # -- repository accessors (Storage.scala:252-276) ---------------------
+    def get_events(self) -> EventStore:
+        name = self._repo_source_name(REPO_EVENTDATA)
+        with self._lock:
+            if name not in self._event_stores:
+                conf = self._source_conf(name)
+                stype = conf.get("type", "sqlite")
+                if stype in ("sqlite", "localfs"):
+                    self._event_stores[name] = SqliteEventStore(
+                        self._source_path(name, "events.db")
+                    )
+                elif stype == "memory":
+                    self._event_stores[name] = SqliteEventStore(":memory:")
+                elif stype == "native":
+                    try:
+                        from .native_events import NativeEventStore
+                    except ImportError as exc:
+                        raise StorageError(
+                            "native event store backend is not built "
+                            f"(predictionio_tpu.storage.native_events): {exc}"
+                        ) from exc
+                    self._event_stores[name] = NativeEventStore(
+                        self._source_path(name, "events_native")
+                    )
+                else:
+                    raise StorageError(f"Unknown event store type {stype!r}")
+            return self._event_stores[name]
+
+    def get_metadata(self) -> MetadataStore:
+        name = self._repo_source_name(REPO_METADATA)
+        with self._lock:
+            if name not in self._metadata_stores:
+                conf = self._source_conf(name)
+                stype = conf.get("type", "sqlite")
+                if stype == "memory":
+                    self._metadata_stores[name] = MetadataStore(":memory:")
+                else:
+                    self._metadata_stores[name] = MetadataStore(
+                        self._source_path(name, "metadata.db")
+                    )
+            return self._metadata_stores[name]
+
+    def get_models(self) -> ModelStore:
+        name = self._repo_source_name(REPO_MODELDATA)
+        with self._lock:
+            if name not in self._model_stores:
+                conf = self._source_conf(name)
+                stype = conf.get("type", "sqlite")
+                if stype == "localfs":
+                    self._model_stores[name] = LocalFSModelStore(
+                        self._source_path(name, "models")
+                    )
+                elif stype == "memory":
+                    self._model_stores[name] = SqliteModelStore(":memory:")
+                else:
+                    self._model_stores[name] = SqliteModelStore(
+                        self._source_path(name, "models.db")
+                    )
+            return self._model_stores[name]
+
+    # -- verification (pio status; Storage.scala:230-250) ------------------
+    def verify_all_data_objects(self) -> Dict[str, bool]:
+        """Touch every repository with a live operation, incl. a test write."""
+        results: Dict[str, bool] = {}
+        try:
+            md = self.get_metadata()
+            md.app_get_all()
+            results["metadata"] = True
+        except Exception:
+            results["metadata"] = False
+        try:
+            ms = self.get_models()
+            probe = Model(id="pio-status-probe", models=b"probe")
+            ms.insert(probe)
+            ok = ms.get(probe.id)
+            ms.delete(probe.id)
+            results["modeldata"] = ok is not None and ok.models == b"probe"
+        except Exception:
+            results["modeldata"] = False
+        try:
+            ev = self.get_events()
+            ev.init(0)
+            eid = ev.insert(
+                Event(
+                    event="$set",
+                    entity_type="pio_pr",
+                    entity_id="status-probe",
+                    event_time=utcnow(),
+                ),
+                0,
+            )
+            ok2 = ev.get(eid, 0) is not None
+            ev.delete(eid, 0)
+            results["eventdata"] = ok2
+        except Exception:
+            results["eventdata"] = False
+        return results
+
+
+_default_registry: Optional[StorageRegistry] = None
+_default_lock = threading.Lock()
+
+
+def get_registry(refresh: bool = False) -> StorageRegistry:
+    """Process-wide registry built from ``os.environ`` (``Storage`` object)."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None or refresh:
+            _default_registry = StorageRegistry()
+        return _default_registry

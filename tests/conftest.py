@@ -1,0 +1,41 @@
+"""Test fixtures.
+
+Tests run on the CPU backend with 8 virtual devices — the analogue of the
+reference's ``local[4]`` Spark test contexts
+(``core/src/test/scala/io/prediction/workflow/BaseTest.scala``): multi-device
+sharding semantics are exercised without TPU hardware. Env vars must be set
+before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def event_store():
+    from predictionio_tpu.storage import SqliteEventStore
+
+    store = SqliteEventStore(":memory:")
+    store.init(1)
+    yield store
+    store.close()
+
+
+@pytest.fixture()
+def metadata_store():
+    from predictionio_tpu.storage import MetadataStore
+
+    store = MetadataStore(":memory:")
+    yield store
+    store.close()

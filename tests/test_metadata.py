@@ -1,0 +1,174 @@
+"""Metadata DAO + registry tests (reference: ES metadata backends, Storage)."""
+
+import os
+
+from predictionio_tpu.storage import (
+    STATUS_COMPLETED,
+    AccessKey,
+    App,
+    EngineManifest,
+    Model,
+    SqliteModelStore,
+    StorageRegistry,
+    new_engine_instance,
+)
+from predictionio_tpu.storage.metadata import STATUS_EVALCOMPLETED, EvaluationInstance
+from predictionio_tpu.storage.event import utcnow
+
+
+class TestApps:
+    def test_crud(self, metadata_store):
+        md = metadata_store
+        app_id = md.app_insert(App(id=0, name="myapp", description="d"))
+        assert app_id is not None
+        got = md.app_get(app_id)
+        assert got.name == "myapp"
+        assert md.app_get_by_name("myapp").id == app_id
+        # duplicate name rejected
+        assert md.app_insert(App(id=0, name="myapp")) is None
+        assert len(md.app_get_all()) == 1
+        assert md.app_update(App(id=app_id, name="renamed"))
+        assert md.app_get(app_id).name == "renamed"
+        assert md.app_delete(app_id)
+        assert md.app_get(app_id) is None
+
+
+class TestAccessKeys:
+    def test_generate_and_auth(self, metadata_store):
+        md = metadata_store
+        key = md.access_key_insert(AccessKey(key="", appid=7, events=("rate",)))
+        assert key and len(key) > 20
+        ak = md.access_key_get(key)
+        assert ak.appid == 7
+        assert ak.events == ("rate",)
+        assert md.access_key_get_by_app(7)[0].key == key
+        assert md.access_key_delete(key)
+        assert md.access_key_get(key) is None
+
+
+class TestEngineInstances:
+    def test_lifecycle(self, metadata_store):
+        md = metadata_store
+        inst = new_engine_instance(
+            engine_id="eid", engine_version="1", engine_variant="engine.json",
+            engine_factory="pkg.Factory",
+        )
+        iid = md.engine_instance_insert(inst)
+        got = md.engine_instance_get(iid)
+        assert got.status == "INIT"
+        # no completed instance yet
+        assert (
+            md.engine_instance_get_latest_completed("eid", "1", "engine.json")
+            is None
+        )
+        import dataclasses
+
+        md.engine_instance_update(
+            dataclasses.replace(got, status=STATUS_COMPLETED)
+        )
+        latest = md.engine_instance_get_latest_completed(
+            "eid", "1", "engine.json"
+        )
+        assert latest.id == iid
+
+    def test_latest_completed_picks_newest(self, metadata_store):
+        import dataclasses
+        import datetime as dt
+
+        md = metadata_store
+        for offset in (0, 100):
+            inst = new_engine_instance("e", "1", "v.json", "F")
+            inst = dataclasses.replace(
+                inst,
+                status=STATUS_COMPLETED,
+                start_time=inst.start_time + dt.timedelta(seconds=offset),
+            )
+            iid = md.engine_instance_insert(inst)
+        assert md.engine_instance_get_latest_completed("e", "1", "v.json").id == iid
+
+
+class TestEvaluationInstances:
+    def test_insert_and_completed_list(self, metadata_store):
+        md = metadata_store
+        now = utcnow()
+        iid = md.evaluation_instance_insert(
+            EvaluationInstance(
+                id="", status=STATUS_EVALCOMPLETED, start_time=now,
+                end_time=now, evaluation_class="Eval1",
+                evaluator_results="metric=0.5",
+            )
+        )
+        assert md.evaluation_instance_get(iid).evaluation_class == "Eval1"
+        assert [i.id for i in md.evaluation_instance_get_completed()] == [iid]
+
+
+class TestManifests:
+    def test_upsert_get(self, metadata_store):
+        md = metadata_store
+        m = EngineManifest(
+            id="abc", version="1", name="my-engine",
+            files=("a.py",), engine_factory="pkg.f",
+        )
+        md.manifest_update(m)
+        got = md.manifest_get("abc", "1")
+        assert got.name == "my-engine"
+        assert got.files == ("a.py",)
+        assert md.manifest_get("abc", "2") is None
+
+
+class TestModelStore:
+    def test_roundtrip(self):
+        ms = SqliteModelStore(":memory:")
+        ms.insert(Model(id="m1", models=b"\x00" * 1000))
+        assert ms.get("m1").models == b"\x00" * 1000
+        ms.delete("m1")
+        assert ms.get("m1") is None
+
+    def test_localfs(self, tmp_path):
+        from predictionio_tpu.storage import LocalFSModelStore
+
+        ms = LocalFSModelStore(str(tmp_path))
+        ms.insert(Model(id="a/b", models=b"xyz"))
+        assert ms.get("a/b").models == b"xyz"
+        ms.delete("a/b")
+        assert ms.get("a/b") is None
+
+
+class TestRegistry:
+    def test_default_wiring(self, tmp_path):
+        reg = StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+        assert reg.get_metadata() is reg.get_metadata()
+        status = reg.verify_all_data_objects()
+        assert status == {"metadata": True, "modeldata": True, "eventdata": True}
+        assert os.path.exists(os.path.join(str(tmp_path), "events.db"))
+
+    def test_env_source_wiring(self, tmp_path):
+        env = {
+            "PIO_STORAGE_SOURCES_MAIN_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_MAIN_PATH": str(tmp_path / "main"),
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "fs"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MAIN",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MAIN",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        }
+        reg = StorageRegistry(env=env)
+        from predictionio_tpu.storage import LocalFSModelStore
+
+        assert isinstance(reg.get_models(), LocalFSModelStore)
+        assert reg.verify_all_data_objects()["eventdata"] is True
+
+    def test_bad_source_reference(self, tmp_path):
+        from predictionio_tpu.storage import StorageError
+
+        env = {
+            "PIO_STORAGE_SOURCES_A_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_A_PATH": str(tmp_path),
+            "PIO_STORAGE_SOURCES_B_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path),
+        }
+        reg = StorageRegistry(env=env)
+        import pytest
+
+        with pytest.raises(StorageError):
+            reg.get_metadata()  # ambiguous without REPOSITORIES binding

@@ -1,0 +1,391 @@
+"""Storage-plane tests.
+
+Mirrors the reference's data-module specs: ``EventsSpec.scala`` (insert/get/
+delete roundtrip), ``LEventAggregatorSpec``/``PEventAggregatorSpec``
+($set/$unset/$delete folding), ``BiMapSpec``, and DataMap/Event validation
+behavior from ``DataMap.scala`` / ``Event.scala``.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage import (
+    BiMap,
+    DataMap,
+    DataMapException,
+    Event,
+    EventFilter,
+    EventValidationError,
+    aggregate_properties,
+    aggregate_single,
+    validate_event,
+)
+
+UTC = dt.timezone.utc
+
+
+def ts(seconds: int) -> dt.datetime:
+    return dt.datetime(2024, 1, 1, 0, 0, 0, tzinfo=UTC) + dt.timedelta(
+        seconds=seconds
+    )
+
+
+# ---------------------------------------------------------------------------
+# DataMap
+# ---------------------------------------------------------------------------
+class TestDataMap:
+    def test_typed_get(self):
+        d = DataMap({"a": 1, "b": "x", "c": [1, 2], "d": 2.5})
+        assert d.get("a", int) == 1
+        assert d.get("b", str) == "x"
+        assert d.get("c", list) == [1, 2]
+        assert d.get("d", float) == 2.5
+        # int widens to float (json4s extracts Int as Double on demand)
+        assert d.get("a", float) == 1.0
+
+    def test_get_missing_raises(self):
+        with pytest.raises(DataMapException):
+            DataMap({}).get("nope", int)
+
+    def test_get_wrong_type_raises(self):
+        with pytest.raises(DataMapException):
+            DataMap({"a": "str"}).get("a", int)
+
+    def test_get_opt_and_or_else(self):
+        d = DataMap({"a": 7})
+        assert d.get_opt("a", int) == 7
+        assert d.get_opt("zz", int) is None
+        assert d.get_or_else("zz", 3) == 3
+
+    def test_merge_right_biased(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 9, "z": 3})
+        assert (a | b).to_dict() == {"x": 1, "y": 9, "z": 3}
+
+    def test_without(self):
+        d = DataMap({"x": 1, "y": 2})
+        assert d.without(["y"]).to_dict() == {"x": 1}
+
+
+# ---------------------------------------------------------------------------
+# Event validation (Event.scala:70-99)
+# ---------------------------------------------------------------------------
+class TestEventValidation:
+    def ok(self, **kw):
+        defaults = dict(event="rate", entity_type="user", entity_id="u1")
+        defaults.update(kw)
+        return Event(**defaults)
+
+    def test_valid_plain_event(self):
+        validate_event(
+            self.ok(
+                target_entity_type="item",
+                target_entity_id="i1",
+                properties=DataMap({"rating": 4.0}),
+            )
+        )
+
+    def test_special_events_allowed(self):
+        validate_event(self.ok(event="$set", properties=DataMap({"a": 1})))
+        validate_event(self.ok(event="$unset", properties=DataMap({"a": None})))
+        validate_event(self.ok(event="$delete"))
+
+    def test_unknown_dollar_event_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(event="$frob"))
+
+    def test_empty_fields_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(event=""))
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(entity_type=""))
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(entity_id=""))
+
+    def test_target_entity_must_be_paired(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(target_entity_id="i1"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(event="$unset"))
+
+    def test_special_event_cannot_have_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(
+                self.ok(
+                    event="$set",
+                    target_entity_type="item",
+                    target_entity_id="i1",
+                )
+            )
+
+    def test_reserved_prefixes(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(entity_type="pio_thing"))
+        # builtin pio_pr is allowed
+        validate_event(self.ok(entity_type="pio_pr"))
+        with pytest.raises(EventValidationError):
+            validate_event(self.ok(properties=DataMap({"pio_x": 1})))
+
+    def test_json_roundtrip(self):
+        e = self.ok(
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"rating": 4.0}),
+            event_time=ts(5),
+            tags=("a", "b"),
+            pr_id="pr-1",
+        )
+        e2 = Event.from_json_dict(e.to_json_dict())
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == "i1"
+        assert e2.properties == e.properties
+        assert e2.event_time == e.event_time
+        assert e2.tags == ("a", "b")
+        assert e2.pr_id == "pr-1"
+
+
+# ---------------------------------------------------------------------------
+# Aggregation (LEventAggregatorSpec / PEventAggregator.scala)
+# ---------------------------------------------------------------------------
+def set_ev(eid, t, props):
+    return Event(
+        event="$set", entity_type="user", entity_id=eid,
+        properties=DataMap(props), event_time=ts(t),
+    )
+
+
+def unset_ev(eid, t, keys):
+    return Event(
+        event="$unset", entity_type="user", entity_id=eid,
+        properties=DataMap({k: None for k in keys}), event_time=ts(t),
+    )
+
+
+def delete_ev(eid, t):
+    return Event(
+        event="$delete", entity_type="user", entity_id=eid, event_time=ts(t),
+    )
+
+
+class TestAggregation:
+    def test_set_merge_latest_wins(self):
+        events = [
+            set_ev("u1", 10, {"a": 1, "b": 2}),
+            set_ev("u1", 20, {"b": 3, "c": 4}),
+            set_ev("u1", 15, {"b": 99}),  # older than t=20 for b
+        ]
+        out = aggregate_properties(events)
+        pm = out["u1"]
+        assert pm.to_dict() == {"a": 1, "b": 3, "c": 4}
+        assert pm.first_updated == ts(10)
+        assert pm.last_updated == ts(20)
+
+    def test_order_independence(self):
+        events = [
+            set_ev("u1", 10, {"a": 1}),
+            unset_ev("u1", 15, ["a"]),
+            set_ev("u1", 20, {"a": 5}),
+        ]
+        import itertools
+
+        results = set()
+        for perm in itertools.permutations(events):
+            pm = aggregate_single(list(perm))
+            results.add(tuple(sorted(pm.to_dict().items())))
+        assert results == {(("a", 5),)}
+
+    def test_unset_drops_field_when_later(self):
+        events = [set_ev("u1", 10, {"a": 1, "b": 2}), unset_ev("u1", 15, ["a"])]
+        assert aggregate_single(events).to_dict() == {"b": 2}
+
+    def test_unset_before_set_is_noop(self):
+        events = [set_ev("u1", 10, {"a": 1}), unset_ev("u1", 5, ["a"])]
+        assert aggregate_single(events).to_dict() == {"a": 1}
+
+    def test_unset_ties_win(self):
+        # reference: unset time >= set time drops the field
+        events = [set_ev("u1", 10, {"a": 1}), unset_ev("u1", 10, ["a"])]
+        assert aggregate_single(events).to_dict() == {}
+
+    def test_unset_of_never_set_key(self):
+        events = [set_ev("u1", 10, {"a": 1}), unset_ev("u1", 15, ["zz"])]
+        assert aggregate_single(events).to_dict() == {"a": 1}
+
+    def test_delete_after_last_set_deletes_entity(self):
+        events = [set_ev("u1", 10, {"a": 1}), delete_ev("u1", 20)]
+        assert aggregate_single(events) is None
+        assert aggregate_properties(events) == {}
+
+    def test_delete_then_set_keeps_newer_fields(self):
+        events = [
+            set_ev("u1", 10, {"a": 1}),
+            delete_ev("u1", 15),
+            set_ev("u1", 20, {"b": 2}),
+        ]
+        assert aggregate_single(events).to_dict() == {"b": 2}
+
+    def test_no_set_means_no_entity(self):
+        assert aggregate_single([unset_ev("u1", 5, ["a"])]) is None
+        assert aggregate_single([delete_ev("u1", 5)]) is None
+
+    def test_non_special_events_ignored(self):
+        rate = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            event_time=ts(50),
+        )
+        events = [set_ev("u1", 10, {"a": 1}), rate]
+        pm = aggregate_single(events)
+        assert pm.to_dict() == {"a": 1}
+        assert pm.last_updated == ts(10)  # rate doesn't move lastUpdated
+
+    def test_multiple_entities(self):
+        events = [
+            set_ev("u1", 10, {"a": 1}),
+            set_ev("u2", 11, {"a": 2}),
+            delete_ev("u2", 12),
+        ]
+        out = aggregate_properties(events)
+        assert set(out) == {"u1"}
+
+
+# ---------------------------------------------------------------------------
+# SqliteEventStore (EventsSpec analogue)
+# ---------------------------------------------------------------------------
+class TestEventStore:
+    def test_insert_get_roundtrip(self, event_store):
+        e = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({"rating": 4.5}), event_time=ts(1),
+            tags=("t1",), pr_id="p1",
+        )
+        eid = event_store.insert(e, app_id=1)
+        got = event_store.get(eid, app_id=1)
+        assert got is not None
+        assert got.event == "rate"
+        assert got.entity_id == "u1"
+        assert got.target_entity_id == "i1"
+        assert got.properties.get("rating", float) == 4.5
+        assert got.event_time == ts(1)
+        assert got.tags == ("t1",)
+        assert got.pr_id == "p1"
+
+    def test_delete(self, event_store):
+        eid = event_store.insert(
+            Event(event="e", entity_type="t", entity_id="i"), 1
+        )
+        assert event_store.delete(eid, 1) is True
+        assert event_store.get(eid, 1) is None
+        assert event_store.delete(eid, 1) is False
+
+    def test_app_isolation(self, event_store):
+        event_store.init(2)
+        event_store.insert(Event(event="a", entity_type="t", entity_id="1"), 1)
+        event_store.insert(Event(event="b", entity_type="t", entity_id="1"), 2)
+        assert [e.event for e in event_store.find(1)] == ["a"]
+        assert [e.event for e in event_store.find(2)] == ["b"]
+
+    def test_find_filters(self, event_store):
+        for i, (name, etype, eid_) in enumerate(
+            [
+                ("rate", "user", "u1"),
+                ("buy", "user", "u1"),
+                ("rate", "user", "u2"),
+                ("view", "item", "i1"),
+            ]
+        ):
+            event_store.insert(
+                Event(
+                    event=name, entity_type=etype, entity_id=eid_,
+                    target_entity_type="item", target_entity_id="x",
+                    event_time=ts(i),
+                ),
+                1,
+            )
+        f = EventFilter(event_names=["rate"])
+        assert len(list(event_store.find(1, f))) == 2
+        f = EventFilter(entity_type="user", entity_id="u1")
+        assert len(list(event_store.find(1, f))) == 2
+        f = EventFilter(start_time=ts(1), until_time=ts(3))
+        assert [e.event for e in event_store.find(1, f)] == ["buy", "rate"]
+        f = EventFilter(limit=2, reversed=True)
+        got = [e.event for e in event_store.find(1, f)]
+        assert got == ["view", "rate"]
+
+    def test_aggregate_through_store(self, event_store):
+        event_store.insert(set_ev("u1", 10, {"a": 1}), 1)
+        event_store.insert(unset_ev("u1", 15, ["a"]), 1)
+        event_store.insert(set_ev("u1", 20, {"b": 2}), 1)
+        event_store.insert(set_ev("u2", 20, {"a": 9}), 1)
+        out = event_store.aggregate_properties(1, "user")
+        assert out["u1"].to_dict() == {"b": 2}
+        assert out["u2"].to_dict() == {"a": 9}
+        single = event_store.aggregate_properties_single(1, "user", "u1")
+        assert single.to_dict() == {"b": 2}
+
+    def test_aggregate_required_filter(self, event_store):
+        event_store.insert(set_ev("u1", 1, {"a": 1, "b": 2}), 1)
+        event_store.insert(set_ev("u2", 1, {"a": 1}), 1)
+        out = event_store.aggregate_properties(1, "user", required=["b"])
+        assert set(out) == {"u1"}
+
+    def test_scan_columnar(self, event_store):
+        for i in range(5):
+            event_store.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{i % 2}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(i)}), event_time=ts(i),
+                ),
+                1,
+            )
+        cols = event_store.scan_columnar(1, EventFilter(event_names=["rate"]))
+        assert cols["entity_id"] == ["u0", "u1", "u0", "u1", "u0"]
+        assert [p["rating"] for p in cols["properties"]] == [0, 1, 2, 3, 4]
+
+    def test_remove_app(self, event_store):
+        event_store.insert(Event(event="a", entity_type="t", entity_id="1"), 1)
+        assert event_store.remove(1)
+        event_store.init(1)
+        assert list(event_store.find(1)) == []
+
+
+# ---------------------------------------------------------------------------
+# BiMap (BiMapSpec)
+# ---------------------------------------------------------------------------
+class TestBiMap:
+    def test_forward_inverse(self):
+        m = BiMap({"a": 1, "b": 2})
+        assert m["a"] == 1
+        assert m.inverse[2] == "b"
+        assert m.get("zz") is None
+        assert m.get_or_else("zz", -1) == -1
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_string_int_dense(self):
+        m = BiMap.string_int(["x", "y", "x", "z", "y"])
+        assert len(m) == 3
+        assert sorted(m.to_dict().values()) == [0, 1, 2]
+        assert m["x"] == 0  # first-seen order
+
+    def test_map_array(self):
+        m = BiMap.string_int(["x", "y"])
+        import numpy as np
+
+        arr = m.map_array(["y", "x", "nope"])
+        assert arr.tolist() == [1, 0, -1]
+        assert arr.dtype == np.int32
+
+    def test_inverse_list(self):
+        m = BiMap.string_int(["x", "y", "z"])
+        assert m.inverse_list([2, 0]) == ["z", "x"]
