@@ -1,0 +1,272 @@
+"""DASE component contracts: DataSource, Preparator, Algorithm, Serving.
+
+Rebuild of the reference's controller base classes
+(``core/src/main/scala/io/prediction/controller/{DataSource,Preparator,
+Algorithm,Serving}.scala`` over the typeless ``core/Base*.scala`` layer).
+
+The reference's P/L/P2L trichotomy (``Algorithm.scala:41-256``) — distributed
+vs. local vs. distributed-train/local-model — was an artifact of RDD-based
+execution. Here data and models are pytrees; *where* they live is a sharding
+annotation, not a class hierarchy (SURVEY §7):
+
+- a "P" model is a pytree of ``jax.Array`` s sharded over the workflow mesh;
+- an "L" model is a replicated pytree (every device holds it);
+- "P2L" is ``jax.device_get`` of sharded train output into host memory.
+
+Algorithms declare how their trained model persists via the three-way
+protocol the reference encodes in ``makeSerializableModels``
+(``Engine.scala:254-272``): a :class:`PersistentModel` saves itself (analogue
+of ``IPersistentModel``, ``IPersistentModel.scala:60-137``); a plain picklable
+model is blobbed by the workflow (Kryo analogue); :data:`RETRAIN` opts out and
+forces retraining at deploy (the ``Unit`` model of ``Algorithm.scala:80-101``).
+"""
+
+from __future__ import annotations
+
+import abc
+import inspect
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Generic,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Type,
+    TypeVar,
+)
+
+from .params import EmptyParams, Params
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+M = TypeVar("M")  # model
+Q = TypeVar("Q")  # query
+P = TypeVar("P")  # predicted result
+A = TypeVar("A")  # actual result
+
+
+class _RetrainSentinel:
+    """Marker: model not persisted; retrain at deploy (``Engine.scala:180``)."""
+
+    def __repr__(self) -> str:
+        return "RETRAIN"
+
+    def __reduce__(self):
+        # Pickle back to the module-level singleton so identity checks
+        # survive blob-store roundtrips across processes.
+        return (_retrain_instance, ())
+
+
+def _retrain_instance() -> "_RetrainSentinel":
+    return RETRAIN
+
+
+#: Return this from ``make_persistent`` to request deploy-time retraining.
+RETRAIN = _RetrainSentinel()
+
+
+class SanityCheck(abc.ABC):
+    """Optional hook run on data/models after each stage unless skipped
+    (``controller/SanityCheck.scala``; invocation ``Engine.scala:526-582``)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise on inconsistent data."""
+
+
+def run_sanity_check(obj: Any, label: str) -> None:
+    """Invoke ``sanity_check`` if the object opts in (duck-typed, like the
+    reference's ``isInstanceOf[SanityCheck]`` test)."""
+    check = getattr(obj, "sanity_check", None)
+    if callable(check):
+        check()
+
+
+class Controller:
+    """Common base: every DASE component holds its ``Params``
+    (``controller/Params.scala:23``; instantiation via :func:`doer`)."""
+
+    params: Params = EmptyParams()
+
+
+def doer(cls: Type, params: Params) -> Any:
+    """Instantiate a controller class with or without params.
+
+    The ``Doer`` reflection constructor (``core/AbstractDoer.scala:30-53``):
+    prefer a 1-arg ``(params)`` constructor, fall back to zero-arg.
+    """
+    try:
+        sig = inspect.signature(cls.__init__)
+        accepts_params = len(
+            [
+                p
+                for name, p in sig.parameters.items()
+                if name != "self"
+                and p.kind
+                in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)
+                and p.default is p.empty
+            ]
+        ) >= 1 or "params" in sig.parameters
+    except (TypeError, ValueError):
+        accepts_params = False
+    if accepts_params:
+        instance = cls(params)
+    else:
+        instance = cls()
+        instance.params = params
+    if getattr(instance, "params", None) is None:
+        instance.params = params
+    return instance
+
+
+class DataSource(Controller, Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data
+    (``controller/DataSource.scala:38-107``)."""
+
+    def read_training(self, ctx) -> TD:
+        """Training path (``PDataSource.readTraining``)."""
+        raise NotImplementedError
+
+    def read_eval(self, ctx) -> List[Tuple[TD, EI, List[Tuple[Q, A]]]]:
+        """Evaluation path: (train split, eval info, (query, actual) set) per
+        fold (``PDataSource.readEval``, ``DataSource.scala:48-56``)."""
+        return []
+
+
+class Preparator(Controller, Generic[TD, PD]):
+    """Transforms training data for algorithms
+    (``controller/Preparator.scala:38-74``)."""
+
+    def prepare(self, ctx, training_data: TD) -> PD:
+        raise NotImplementedError
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """Pass-through (``controller/IdentityPreparator`` in
+    ``Preparator.scala:76-96``)."""
+
+    def prepare(self, ctx, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(Controller, Generic[PD, M, Q, P]):
+    """Train + predict (``controller/Algorithm.scala``).
+
+    ``batch_predict`` is the evaluation path (``batchPredict``,
+    ``Algorithm.scala:60-78``); the default maps ``predict`` but TPU
+    algorithms override it with a single vectorized device call.
+    """
+
+    def train(self, ctx, prepared_data: PD) -> M:
+        raise NotImplementedError
+
+    def predict(self, model: M, query: Q) -> P:
+        raise NotImplementedError
+
+    def batch_predict(
+        self, model: M, indexed_queries: Sequence[Tuple[int, Q]]
+    ) -> List[Tuple[int, P]]:
+        return [(i, self.predict(model, q)) for i, q in indexed_queries]
+
+    # -- persistence protocol (Engine.scala:254-272) ----------------------
+    def make_persistent(self, instance_id: str, model: M, ctx) -> Any:
+        """Decide how the trained model persists.
+
+        Return value semantics:
+
+        - a :class:`PersistentModel` instance → it saved itself; a manifest
+          with its class path is stored instead of the model bytes;
+        - :data:`RETRAIN` → nothing persisted, deploy retrains;
+        - anything else → pickled into the model blob store by the workflow.
+        """
+        if isinstance(model, PersistentModel):
+            if model.save(instance_id, self.params, ctx):
+                return PersistentModelManifest.of(model)
+            return RETRAIN
+        return model
+
+    def query_class(self) -> Optional[Type[Q]]:
+        """Query dataclass for JSON decoding at the query server (the
+        analogue of the per-algo ``querySerializer``,
+        ``CreateServer.scala:475-478``)."""
+        return None
+
+
+class PersistentModel(abc.ABC):
+    """Self-persisting model (``IPersistentModel.scala:60-96``).
+
+    Implementations also provide a ``load`` classmethod (the
+    ``IPersistentModelLoader`` companion, ``IPersistentModel.scala:98-117``).
+    """
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Params, ctx) -> bool:
+        """Persist; return False to fall back to deploy-time retraining."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params, ctx) -> "PersistentModel":
+        ...
+
+
+class PersistentModelManifest:
+    """Records the class path of a self-persisted model
+    (``workflow/PersistentModelManifest.scala``)."""
+
+    def __init__(self, class_path: str):
+        self.class_path = class_path
+
+    @staticmethod
+    def of(model: PersistentModel) -> "PersistentModelManifest":
+        cls = type(model)
+        return PersistentModelManifest(f"{cls.__module__}:{cls.__qualname__}")
+
+    def resolve(self) -> Type[PersistentModel]:
+        import importlib
+
+        module_name, _, qualname = self.class_path.partition(":")
+        obj: Any = importlib.import_module(module_name)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    def __repr__(self) -> str:
+        return f"PersistentModelManifest({self.class_path!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, PersistentModelManifest)
+            and self.class_path == other.class_path
+        )
+
+
+class Serving(Controller, Generic[Q, P]):
+    """Combines per-algorithm predictions into one response
+    (``controller/Serving.scala:34-60``)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        raise NotImplementedError
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-predict query enrichment hook (``Serving.scala`` supplement)."""
+        return query
+
+
+class FirstServing(Serving[Q, P]):
+    """Returns the first algorithm's prediction (``LFirstServing``,
+    ``Serving.scala:62-81``)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Averages numeric predictions (``LAverageServing``,
+    ``Serving.scala:83-102``)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)

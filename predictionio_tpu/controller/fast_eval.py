@@ -1,0 +1,192 @@
+"""FastEvalEngine: prefix-memoized hyperparameter sweeps.
+
+Rebuild of ``core/src/main/scala/io/prediction/controller/FastEvalEngine.scala:52-344``:
+when sweeping a grid where only the later DASE stages vary, earlier stage
+results are cached keyed by the *params prefix* — a sweep over algorithm
+params reads and prepares data exactly once.
+
+Caches use value equality on params (``FastEvalEngine.scala:299-302``). A
+params class without value ``__eq__`` (i.e. not a dataclass) falls back to
+identity and never hits the cache across distinct instances — the reference's
+"not cached when isEqual not implemented" behavior
+(``FastEvalEngineTest.scala:146``).
+
+Trade-off carried over from the reference: FastEvalEngine caches *predictions
+per algorithm-params prefix*, so serving-params-only sweeps reuse everything
+upstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Any, Dict, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+from .dase import doer
+from .engine import Engine, EngineParams, WorkflowParams
+from .params import Params
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class AssocCache(Generic[K, V]):
+    """Equality-keyed cache (no hashability requirement on params)."""
+
+    def __init__(self):
+        self._items: List[Tuple[K, V]] = []
+
+    def get(self, key: K) -> Optional[V]:
+        for k, v in self._items:
+            if k == key:
+                return v
+        return None
+
+    def put(self, key: K, value: V) -> None:
+        self._items.append((key, value))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+# Prefix keys (FastEvalEngine.scala:52-87)
+@dataclasses.dataclass(frozen=True)
+class DataSourcePrefix:
+    data_source_params: Tuple[str, Params]
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparatorPrefix:
+    data_source_params: Tuple[str, Params]
+    preparator_params: Tuple[str, Params]
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmsPrefix:
+    data_source_params: Tuple[str, Params]
+    preparator_params: Tuple[str, Params]
+    algorithm_params_list: Tuple[Tuple[str, Params], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPrefix:
+    data_source_params: Tuple[str, Params]
+    preparator_params: Tuple[str, Params]
+    algorithm_params_list: Tuple[Tuple[str, Params], ...]
+    serving_params: Tuple[str, Params]
+
+
+class FastEvalEngineWorkflow:
+    """Holds the per-sweep caches (``FastEvalEngineWorkflow``,
+    ``FastEvalEngine.scala:89-344``)."""
+
+    def __init__(self, engine: "FastEvalEngine", ctx, workflow_params: WorkflowParams):
+        self.engine = engine
+        self.ctx = ctx
+        self.workflow_params = workflow_params
+        # caches (FastEvalEngine.scala:299-302)
+        self.data_source_cache: AssocCache = AssocCache()
+        self.preparator_cache: AssocCache = AssocCache()
+        self.algorithms_cache: AssocCache = AssocCache()
+        self.serving_cache: AssocCache = AssocCache()
+
+    # each stage: compute through the previous stage's cached result
+    def get_data_source_result(self, prefix: DataSourcePrefix):
+        cached = self.data_source_cache.get(prefix)
+        if cached is None:
+            name, params = prefix.data_source_params
+            data_source = doer(self.engine.data_source_class_map[name], params)
+            cached = data_source.read_eval(self.ctx)
+            self.data_source_cache.put(prefix, cached)
+        return cached
+
+    def get_preparator_result(self, prefix: PreparatorPrefix):
+        cached = self.preparator_cache.get(prefix)
+        if cached is None:
+            eval_sets = self.get_data_source_result(
+                DataSourcePrefix(prefix.data_source_params)
+            )
+            name, params = prefix.preparator_params
+            preparator = doer(self.engine.preparator_class_map[name], params)
+            cached = [
+                (preparator.prepare(self.ctx, td), ei, qa)
+                for td, ei, qa in eval_sets
+            ]
+            self.preparator_cache.put(prefix, cached)
+        return cached
+
+    def get_algorithms_result(self, prefix: AlgorithmsPrefix):
+        """Per fold: list over algos of indexed predictions
+        (``computeAlgorithmsResult``, ``FastEvalEngine.scala:170-242``)."""
+        cached = self.algorithms_cache.get(prefix)
+        if cached is None:
+            prepared_sets = self.get_preparator_result(
+                PreparatorPrefix(
+                    prefix.data_source_params, prefix.preparator_params
+                )
+            )
+            algos = [
+                doer(self.engine.algorithm_class_map[name], params)
+                for name, params in prefix.algorithm_params_list
+            ]
+            cached = []
+            for pd, ei, qa in prepared_sets:
+                models = [a.train(self.ctx, pd) for a in algos]
+                indexed = list(enumerate(q for q, _ in qa))
+                per_algo = [
+                    a.batch_predict(m, indexed)
+                    for a, m in zip(algos, models)
+                ]
+                cached.append((per_algo, ei, qa))
+            self.algorithms_cache.put(prefix, cached)
+        return cached
+
+    def get_serving_result(self, prefix: ServingPrefix):
+        cached = self.serving_cache.get(prefix)
+        if cached is None:
+            algo_sets = self.get_algorithms_result(
+                AlgorithmsPrefix(
+                    prefix.data_source_params,
+                    prefix.preparator_params,
+                    prefix.algorithm_params_list,
+                )
+            )
+            name, params = prefix.serving_params
+            serving = doer(self.engine.serving_class_map[name], params)
+            cached = []
+            for per_algo, ei, qa in algo_sets:
+                by_query: Dict[int, Dict[int, Any]] = defaultdict(dict)
+                for ai, indexed_preds in enumerate(per_algo):
+                    for qi, p in indexed_preds:
+                        by_query[qi][ai] = p
+                qpa = []
+                for qi, (q, a) in enumerate(qa):
+                    preds = by_query.get(qi, {})
+                    ordered = [preds[ai] for ai in sorted(preds)]
+                    qpa.append((q, serving.serve(q, ordered), a))
+                cached.append((ei, qpa))
+            self.serving_cache.put(prefix, cached)
+        return cached
+
+
+class FastEvalEngine(Engine):
+    """Engine whose ``batch_eval`` memoizes by params prefix
+    (``FastEvalEngine.scala:310-344``)."""
+
+    def batch_eval(
+        self,
+        ctx,
+        engine_params_list: Sequence[EngineParams],
+        workflow_params: WorkflowParams = WorkflowParams(),
+    ):
+        workflow = FastEvalEngineWorkflow(self, ctx, workflow_params)
+        results = []
+        for ep in engine_params_list:
+            prefix = ServingPrefix(
+                ep.data_source_params,
+                ep.preparator_params,
+                tuple(ep.algorithm_params_list),
+                ep.serving_params,
+            )
+            results.append((ep, workflow.get_serving_result(prefix)))
+        return results

@@ -1,0 +1,23 @@
+"""Parallelism: mesh construction, shardings, collective helpers (SURVEY §2.8)."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshConfig,
+    create_mesh,
+    data_sharding,
+    model_sharding,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "MeshConfig",
+    "create_mesh",
+    "data_sharding",
+    "model_sharding",
+    "replicated",
+    "shard_batch",
+]

@@ -1,0 +1,103 @@
+"""Device mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's Spark executor topology
+(``workflow/WorkflowContext.scala:78-97`` created a SparkContext; here a
+train/eval/serving run gets a ``jax.sharding.Mesh``). Axes follow the
+scaling-book convention:
+
+- ``data``  — batch/data parallelism (the analogue of RDD partitions);
+- ``model`` — tensor/factor sharding (the analogue of MLlib ALS blocks).
+
+Collectives ride ICI within a slice; multi-slice meshes put ``data``
+outermost so cross-slice traffic (DCN) carries only gradient/Gramian
+reductions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh shape request. ``axes`` maps axis name → size; a size of -1 means
+    "all remaining devices" (at most one axis may be -1)."""
+
+    axes: Tuple[Tuple[str, int], ...] = ((DATA_AXIS, -1),)
+
+    @staticmethod
+    def from_dict(d: Dict[str, int]) -> "MeshConfig":
+        return MeshConfig(tuple(d.items()))
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Single-device environments yield a 1-device mesh with the same axis
+    names, so all sharding annotations stay valid from laptop CPU to a pod
+    slice (compile-once, shard-anywhere).
+    """
+    config = config or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+
+    names = [name for name, _ in config.axes]
+    sizes = [size for _, size in config.axes]
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if wild:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n // fixed
+    elif math.prod(sizes) != n:
+        raise ValueError(
+            f"Mesh axes {dict(config.axes)} need {math.prod(sizes)} devices, "
+            f"have {n}"
+        )
+    grid = np.array(devs).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def data_sharding(mesh: Mesh, *, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading dim sharded over the data axis (batch parallelism)."""
+    return NamedSharding(mesh, P(axis))
+
+def model_sharding(mesh: Mesh, *, axis: str = MODEL_AXIS) -> NamedSharding:
+    """Leading dim sharded over the model axis (factor-table sharding)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — the analogue of the reference's broadcast "L"
+    models (``Algorithm.scala:118-145``)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, array, *, axis: str = DATA_AXIS):
+    """Pad the leading dim to a multiple of the axis size and device_put with
+    batch sharding. Returns (sharded_array, original_length)."""
+    import jax.numpy as jnp
+
+    n = array.shape[0]
+    per = mesh.shape[axis]
+    padded = ((n + per - 1) // per) * per
+    if padded != n:
+        pad_width = [(0, padded - n)] + [(0, 0)] * (array.ndim - 1)
+        array = np.pad(np.asarray(array), pad_width)
+    return jax.device_put(array, data_sharding(mesh, axis=axis)), n

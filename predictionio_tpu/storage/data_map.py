@@ -41,9 +41,10 @@ class DataMap(Mapping[str, Any]):
 
     Reference semantics (``DataMap.scala``):
 
-    - ``get(name, as_type)`` raises :class:`DataMapException` when the field is
-      missing (``require`` behavior, ``DataMap.scala:49-55``).
+    - ``get_as(name, as_type)`` raises :class:`DataMapException` when the
+      field is missing (``require`` behavior, ``DataMap.scala:49-55``).
     - ``get_opt`` returns ``None`` when missing.
+    - ``get(name, default)`` keeps the standard ``Mapping.get`` contract.
     - ``++`` merge (here ``|`` / :meth:`merge`) is right-biased.
     - ``--`` removal (:meth:`without`).
     """
@@ -76,8 +77,13 @@ class DataMap(Mapping[str, Any]):
         if name not in self._fields:
             raise DataMapException(f"The field {name} is required.")
 
-    def get(self, name: str, as_type: Type[T] = object) -> T:  # type: ignore[override]
-        """Return field ``name`` coerced to ``as_type``; raise if missing."""
+    def get(self, name: str, default: Any = None) -> Any:
+        """Standard ``Mapping.get``: value or ``default`` when missing."""
+        return self._fields.get(name, default)
+
+    def get_as(self, name: str, as_type: Type[T] = object) -> T:
+        """Return field ``name`` coerced to ``as_type``; raise if missing
+        (the reference's typed ``get[T]``)."""
         self.require(name)
         return self._coerce(name, self._fields[name], as_type)
 
@@ -87,6 +93,7 @@ class DataMap(Mapping[str, Any]):
         return self._coerce(name, self._fields[name], as_type)
 
     def get_or_else(self, name: str, default: T) -> T:
+        """Typed get with fallback (``DataMap.scala`` ``getOrElse``)."""
         value = self.get_opt(name, type(default))
         return default if value is None else value
 

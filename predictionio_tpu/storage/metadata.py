@@ -149,8 +149,15 @@ class MetadataStore:
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.RLock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, timeout=30.0
+        )
         with self._lock:
+            if path != ":memory:":
+                # WAL so a CLI, event server and training run can genuinely
+                # coexist on one metadata file (readers don't block writers).
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA busy_timeout=30000")
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
 

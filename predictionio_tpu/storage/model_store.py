@@ -15,6 +15,7 @@ import dataclasses
 import os
 import sqlite3
 import threading
+import urllib.parse
 import zlib
 from typing import Optional
 
@@ -46,7 +47,8 @@ class LocalFSModelStore(ModelStore):
         os.makedirs(base_dir, exist_ok=True)
 
     def _path(self, id: str) -> str:
-        safe = id.replace("/", "_").replace("\\", "_")
+        # Percent-encode so distinct ids never collide on one file name.
+        safe = urllib.parse.quote(id, safe="")
         return os.path.join(self._base, f"pio_model_{safe}.bin")
 
     def insert(self, model: Model) -> None:

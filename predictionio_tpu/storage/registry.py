@@ -136,10 +136,12 @@ class StorageRegistry:
                 stype = conf.get("type", "sqlite")
                 if stype == "memory":
                     self._metadata_stores[name] = MetadataStore(":memory:")
-                else:
+                elif stype in ("sqlite", "localfs"):
                     self._metadata_stores[name] = MetadataStore(
                         self._source_path(name, "metadata.db")
                     )
+                else:
+                    raise StorageError(f"Unknown metadata store type {stype!r}")
             return self._metadata_stores[name]
 
     def get_models(self) -> ModelStore:
@@ -154,10 +156,12 @@ class StorageRegistry:
                     )
                 elif stype == "memory":
                     self._model_stores[name] = SqliteModelStore(":memory:")
-                else:
+                elif stype == "sqlite":
                     self._model_stores[name] = SqliteModelStore(
                         self._source_path(name, "models.db")
                     )
+                else:
+                    raise StorageError(f"Unknown model store type {stype!r}")
             return self._model_stores[name]
 
     # -- verification (pio status; Storage.scala:230-250) ------------------

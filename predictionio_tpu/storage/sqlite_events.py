@@ -241,9 +241,21 @@ class SqliteEventStore(EventStore):
         table = self._ensure_table(app_id)
         f = filter or EventFilter()
         sql, params = self._build_query(table, f)
-        with self._lock:
-            rows = self._conn.execute(sql, params).fetchall()
-        return iter([self._row_to_event(r) for r in rows])
+
+        def stream() -> Iterator[Event]:
+            # Stream in batches so million-event scans never materialize the
+            # whole table; the lock is held only per batch.
+            with self._lock:
+                cursor = self._conn.execute(sql, params)
+            while True:
+                with self._lock:
+                    rows = cursor.fetchmany(1000)
+                if not rows:
+                    return
+                for r in rows:
+                    yield self._row_to_event(r)
+
+        return stream()
 
     def scan_columnar(self, app_id: int, filter: Optional[EventFilter] = None):
         """Bulk scan returning column dict of python lists / numpy arrays.

@@ -1,0 +1,12 @@
+"""Workflow runtime (≙ reference L4, ``workflow/``; SURVEY §2.1)."""
+
+from .context import WorkflowContext, pio_env_vars
+from .core_workflow import load_models, run_evaluation, run_train
+
+__all__ = [
+    "WorkflowContext",
+    "load_models",
+    "pio_env_vars",
+    "run_evaluation",
+    "run_train",
+]

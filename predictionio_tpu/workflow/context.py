@@ -1,0 +1,72 @@
+"""WorkflowContext: per-run compute context.
+
+Rebuild of ``core/src/main/scala/io/prediction/workflow/WorkflowContext.scala:78-97``
+— where the reference constructs a SparkContext ("PredictionIO <mode>:
+<batch>" app name, executor env injection), a run here gets a device mesh,
+mode/batch labels, and the PIO_* env passthrough. The context is handed to
+every DASE component (the ``sc`` argument of the reference's ``*Base``
+methods).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+from ..parallel.mesh import (
+    DATA_AXIS,
+    MeshConfig,
+    create_mesh,
+    data_sharding,
+    replicated,
+)
+
+
+def pio_env_vars(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env vars starting with PIO_ (``WorkflowUtils.pioEnvVars``,
+    ``WorkflowUtils.scala:212-217``)."""
+    source = env if env is not None else dict(os.environ)
+    return {k: v for k, v in source.items() if k.startswith("PIO_")}
+
+
+class WorkflowContext:
+    """Compute context: mode + batch labels, env, and a lazily-built mesh."""
+
+    def __init__(
+        self,
+        mode: str = "Training",
+        batch: str = "",
+        executor_env: Optional[Dict[str, str]] = None,
+        mesh_config: Optional[MeshConfig] = None,
+        devices: Optional[Sequence] = None,
+    ):
+        self.mode = mode
+        self.batch = batch
+        self.env = dict(
+            executor_env if executor_env is not None else pio_env_vars()
+        )
+        self._mesh_config = mesh_config
+        self._devices = devices
+        self._mesh = None
+
+    @property
+    def app_name(self) -> str:
+        # "PredictionIO <mode>: <batch>" (WorkflowContext.scala:82-84)
+        return f"PredictionIO {self.mode}: {self.batch}"
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = create_mesh(self._mesh_config, self._devices)
+        return self._mesh
+
+    # -- sharding shortcuts used by DASE components ------------------------
+    def data_sharding(self, axis: str = DATA_AXIS):
+        return data_sharding(self.mesh, axis=axis)
+
+    def replicated(self):
+        return replicated(self.mesh)
+
+    def stop(self) -> None:
+        """SparkContext.stop analogue — release the mesh."""
+        self._mesh = None

@@ -1,0 +1,146 @@
+"""Core train/eval workflows.
+
+Rebuild of ``core/src/main/scala/io/prediction/workflow/CoreWorkflow.scala:43-144``
+and ``EvaluationWorkflow.scala:68-81``: bootstrap a context, run the engine,
+persist models / evaluation results, and flip instance status
+INIT → COMPLETED (or EVALUATING → EVALCOMPLETED). The reference Kryo-blobs
+models into the ``Models`` store; here the persisted model list is pickled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+from typing import Any, List, Optional, Sequence
+
+from ..controller.engine import (
+    Engine,
+    EngineParams,
+    WorkflowParams,
+    serialize_engine_params,
+)
+from ..controller.evaluation import EngineParamsGenerator, Evaluation
+from ..storage import (
+    STATUS_COMPLETED,
+    STATUS_EVALCOMPLETED,
+    STATUS_EVALUATING,
+    Model,
+    StorageRegistry,
+    new_engine_instance,
+    utcnow,
+)
+from ..storage.metadata import EvaluationInstance
+from .context import WorkflowContext, pio_env_vars
+
+logger = logging.getLogger(__name__)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    registry: StorageRegistry,
+    engine_id: str = "default",
+    engine_version: str = "1",
+    engine_variant: str = "engine.json",
+    engine_factory: str = "",
+    workflow_params: WorkflowParams = WorkflowParams(),
+    ctx: Optional[WorkflowContext] = None,
+) -> str:
+    """Train and persist; returns the engine instance id
+    (``CoreWorkflow.runTrain``, ``CoreWorkflow.scala:43-93``)."""
+    md = registry.get_metadata()
+    params_cols = serialize_engine_params(engine_params)
+    instance = new_engine_instance(
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=workflow_params.batch,
+        env=pio_env_vars(),
+        **params_cols,
+    )
+    instance_id = md.engine_instance_insert(instance)
+
+    ctx = ctx or WorkflowContext(mode="Training", batch=workflow_params.batch)
+    try:
+        models = engine.train(ctx, engine_params, workflow_params)
+        persisted = engine.make_serializable_models(
+            ctx, engine_params, instance_id, models
+        )
+        registry.get_models().insert(
+            Model(id=instance_id, models=pickle.dumps(persisted))
+        )
+        stored = md.engine_instance_get(instance_id)
+        assert stored is not None
+        md.engine_instance_update(
+            dataclasses.replace(
+                stored, status=STATUS_COMPLETED, end_time=utcnow()
+            )
+        )
+        logger.info("Training completed; engine instance %s", instance_id)
+        return instance_id
+    except KeyboardInterrupt:
+        # CoreWorkflow.scala:83-88: interruptions leave the INIT row behind.
+        logger.warning("Training interrupted; instance %s stays INIT", instance_id)
+        raise
+    finally:
+        ctx.stop()
+
+
+def load_models(registry: StorageRegistry, instance_id: str) -> List[Any]:
+    """Persisted model list for an instance (``CreateServer.scala:196-198``)."""
+    blob = registry.get_models().get(instance_id)
+    if blob is None:
+        raise KeyError(f"No model data for engine instance {instance_id}")
+    return pickle.loads(blob.models)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    engine_params_generator: EngineParamsGenerator,
+    registry: StorageRegistry,
+    workflow_params: WorkflowParams = WorkflowParams(),
+    ctx: Optional[WorkflowContext] = None,
+) -> str:
+    """Full evaluation run (``CoreWorkflow.runEvaluation``,
+    ``CoreWorkflow.scala:95-144`` + ``EvaluationWorkflow.scala:68-81``)."""
+    md = registry.get_metadata()
+    now = utcnow()
+    instance = EvaluationInstance(
+        id="",
+        status=STATUS_EVALUATING,
+        start_time=now,
+        end_time=now,
+        evaluation_class=type(evaluation).__name__,
+        engine_params_generator_class=type(engine_params_generator).__name__,
+        batch=workflow_params.batch,
+        env=pio_env_vars(),
+    )
+    instance_id = md.evaluation_instance_insert(instance)
+
+    ctx = ctx or WorkflowContext(mode="Evaluation", batch=workflow_params.batch)
+    try:
+        engine, evaluator = evaluation.engine_evaluator
+        engine_eval_data = engine.batch_eval(
+            ctx, engine_params_generator.engine_params_list, workflow_params
+        )
+        result = evaluator.evaluate_base(
+            ctx, evaluation, engine_eval_data, workflow_params
+        )
+        stored = md.evaluation_instance_get(instance_id)
+        assert stored is not None
+        md.evaluation_instance_update(
+            dataclasses.replace(
+                stored,
+                status=STATUS_EVALCOMPLETED,
+                end_time=utcnow(),
+                evaluator_results=result.one_liner(),
+                evaluator_results_html=result.to_html(),
+                evaluator_results_json=result.to_json(),
+            )
+        )
+        logger.info("Evaluation completed; instance %s", instance_id)
+        return instance_id
+    finally:
+        ctx.stop()

@@ -1,0 +1,213 @@
+"""Deterministic toy DASE components for engine/workflow tests.
+
+The analogue of the reference's fake-engine fixture
+(``core/src/test/scala/io/prediction/controller/SampleEngine.scala``):
+components carry integer ids so tests assert exact dataflow composition, and
+class-level invocation counters back the FastEvalEngine memoization tests
+(``FastEvalEngineTest.scala:30-146``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from predictionio_tpu.controller import (
+    RETRAIN,
+    Algorithm,
+    DataSource,
+    Params,
+    PersistentModel,
+    Preparator,
+    Serving,
+)
+
+
+# -- data carriers ----------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class TrainingData:
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"TrainingData {self.id} failed sanity check")
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedData:
+    id: int
+    td_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleModel:
+    algo_id: int
+    pd_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    algo_id: int
+    model: SampleModel
+    query: Query
+    combined: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Actual:
+    id: int
+
+
+# -- params -----------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class IdParams(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    id: int = 0
+    n_eval_sets: int = 0
+    error: bool = False
+
+
+# -- components -------------------------------------------------------------
+class CountingMixin:
+    """Class-level invocation counters (FastEvalEngineTest's count asserts)."""
+
+    @classmethod
+    def reset_count(cls):
+        cls.count = 0
+
+    @classmethod
+    def bump(cls):
+        cls.count = getattr(cls, "count", 0) + 1
+
+
+class DataSource0(DataSource, CountingMixin):
+    params_class = DSParams
+    count = 0
+
+    def __init__(self, params: DSParams = DSParams()):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        type(self).bump()
+        return TrainingData(id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        type(self).bump()
+        sets = []
+        for i in range(self.params.n_eval_sets):
+            td = TrainingData(id=self.params.id + i)
+            ei = EvalInfo(id=self.params.id + i)
+            qa = [(Query(id=q), Actual(id=q)) for q in range(2)]
+            sets.append((td, ei, qa))
+        return sets
+
+
+class Preparator0(Preparator, CountingMixin):
+    params_class = IdParams
+    count = 0
+
+    def __init__(self, params: IdParams = IdParams()):
+        self.params = params
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        type(self).bump()
+        return PreparedData(id=self.params.id, td_id=td.id)
+
+
+class Algo0(Algorithm, CountingMixin):
+    params_class = IdParams
+    count = 0
+
+    def __init__(self, params: IdParams = IdParams()):
+        self.params = params
+
+    def train(self, ctx, pd: PreparedData) -> SampleModel:
+        type(self).bump()
+        return SampleModel(algo_id=self.params.id, pd_id=pd.id)
+
+    def predict(self, model: SampleModel, query: Query) -> Prediction:
+        return Prediction(algo_id=self.params.id, model=model, query=query)
+
+
+class Algo1(Algo0):
+    """Second algorithm family for multi-algo engines."""
+
+    count = 0
+
+
+class Serving0(Serving, CountingMixin):
+    params_class = IdParams
+    count = 0
+
+    def __init__(self, params: IdParams = IdParams()):
+        self.params = params
+
+    def serve(self, query: Query, predictions) -> Prediction:
+        type(self).bump()
+        first = predictions[0]
+        return dataclasses.replace(
+            first, combined=tuple(p.algo_id for p in predictions)
+        )
+
+
+# -- persistence variants ---------------------------------------------------
+_saved_store = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistableModel(PersistentModel):
+    algo_id: int
+    pd_id: int
+
+    def save(self, instance_id, params, ctx) -> bool:
+        _saved_store[(instance_id, self.algo_id)] = self
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params, ctx):
+        return _saved_store[(instance_id, params.id)]
+
+
+class PersistentAlgo(Algo0):
+    """Algorithm with a self-persisting model (IPersistentModel analogue)."""
+
+    count = 0
+
+    def train(self, ctx, pd: PreparedData):
+        type(self).bump()
+        return PersistableModel(algo_id=self.params.id, pd_id=pd.id)
+
+    def predict(self, model, query):
+        return Prediction(algo_id=self.params.id, model=model, query=query)
+
+
+class NonPersistentAlgo(Algo0):
+    """Model opts out of persistence → deploy retrains (PAlgorithm w/o
+    IPersistentModel)."""
+
+    count = 0
+
+    def make_persistent(self, instance_id, model, ctx):
+        return RETRAIN
+
+
+def reset_all_counts():
+    for cls in (DataSource0, Preparator0, Algo0, Algo1, Serving0,
+                PersistentAlgo, NonPersistentAlgo):
+        cls.reset_count()
+    _saved_store.clear()

@@ -37,20 +37,26 @@ def ts(seconds: int) -> dt.datetime:
 class TestDataMap:
     def test_typed_get(self):
         d = DataMap({"a": 1, "b": "x", "c": [1, 2], "d": 2.5})
-        assert d.get("a", int) == 1
-        assert d.get("b", str) == "x"
-        assert d.get("c", list) == [1, 2]
-        assert d.get("d", float) == 2.5
+        assert d.get_as("a", int) == 1
+        assert d.get_as("b", str) == "x"
+        assert d.get_as("c", list) == [1, 2]
+        assert d.get_as("d", float) == 2.5
         # int widens to float (json4s extracts Int as Double on demand)
-        assert d.get("a", float) == 1.0
+        assert d.get_as("a", float) == 1.0
 
     def test_get_missing_raises(self):
         with pytest.raises(DataMapException):
-            DataMap({}).get("nope", int)
+            DataMap({}).get_as("nope", int)
 
     def test_get_wrong_type_raises(self):
         with pytest.raises(DataMapException):
-            DataMap({"a": "str"}).get("a", int)
+            DataMap({"a": "str"}).get_as("a", int)
+
+    def test_mapping_get_contract(self):
+        d = DataMap({"a": 1})
+        assert d.get("missing") is None
+        assert d.get("missing", "fallback") == "fallback"
+        assert d.get("a") == 1
 
     def test_get_opt_and_or_else(self):
         d = DataMap({"a": 7})
@@ -272,7 +278,7 @@ class TestEventStore:
         assert got.event == "rate"
         assert got.entity_id == "u1"
         assert got.target_entity_id == "i1"
-        assert got.properties.get("rating", float) == 4.5
+        assert got.properties.get_as("rating", float) == 4.5
         assert got.event_time == ts(1)
         assert got.tags == ("t1",)
         assert got.pr_id == "p1"

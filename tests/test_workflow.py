@@ -1,0 +1,120 @@
+"""Workflow runtime tests: run_train / run_evaluation lifecycle against the
+storage registry (``CoreWorkflow.scala`` behavior)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    EngineParamsGenerator,
+    Evaluation,
+    Metric,
+    MetricEvaluator,
+    WorkflowParams,
+)
+from predictionio_tpu.storage import STATUS_COMPLETED, STATUS_EVALCOMPLETED, StorageRegistry
+from predictionio_tpu.workflow.core_workflow import load_models, run_evaluation, run_train
+from predictionio_tpu.workflow.context import WorkflowContext, pio_env_vars
+
+from sample_engine import (
+    IdParams,
+    SampleModel,
+    reset_all_counts,
+)
+from test_engine import IdSumMetric, make_engine, make_params
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_all_counts()
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+
+
+class TestRunTrain:
+    def test_full_lifecycle(self, registry):
+        engine = make_engine()
+        params = make_params(ds_id=2, prep_id=4, algo_ids=(8,))
+        iid = run_train(
+            engine,
+            params,
+            registry,
+            engine_id="sample",
+            engine_factory="tests.sample_engine",
+            workflow_params=WorkflowParams(batch="b1"),
+        )
+        md = registry.get_metadata()
+        inst = md.engine_instance_get(iid)
+        assert inst.status == STATUS_COMPLETED
+        assert inst.engine_id == "sample"
+        assert inst.batch == "b1"
+        assert inst.end_time >= inst.start_time
+        # params columns are JSON
+        assert json.loads(inst.algorithms_params)[0]["params"]["id"] == 8
+        # model blob loads back
+        models = load_models(registry, iid)
+        assert models == [SampleModel(algo_id=8, pd_id=4)]
+        # deploy path finds the latest completed instance
+        latest = md.engine_instance_get_latest_completed(
+            "sample", "1", "engine.json"
+        )
+        assert latest.id == iid
+
+    def test_instance_params_roundtrip_to_engine_params(self, registry):
+        engine = make_engine()
+        params = make_params(algo_ids=(3,))
+        iid = run_train(engine, params, registry)
+        inst = registry.get_metadata().engine_instance_get(iid)
+        assert engine.engine_instance_to_engine_params(inst) == params
+
+    def test_train_failure_leaves_init_row(self, registry):
+        engine = make_engine()
+        bad = make_params().copy(
+            data_source_params=("missing-name", IdParams())
+        )
+        with pytest.raises(KeyError):
+            run_train(engine, bad, registry)
+        # crash leaves non-COMPLETED row (reference leaves INIT)
+        instances = registry.get_metadata().engine_instance_get_all()
+        assert len(instances) == 1
+        assert instances[0].status == "INIT"
+
+
+class TestRunEvaluation:
+    def test_full_lifecycle(self, registry):
+        ev = Evaluation()
+        ev.engine_metric = (make_engine(), IdSumMetric())
+        gen = EngineParamsGenerator(
+            [make_params(algo_ids=(i,)) for i in (1, 9, 4)]
+        )
+        iid = run_evaluation(ev, gen, registry)
+        inst = registry.get_metadata().evaluation_instance_get(iid)
+        assert inst.status == STATUS_EVALCOMPLETED
+        assert "IdSumMetric" in inst.evaluator_results
+        parsed = json.loads(inst.evaluator_results_json)
+        assert parsed["bestIdx"] == 1
+        assert parsed["bestEngineParams"]["algorithms"][0]["params"]["id"] == 9
+        assert "<html>" in inst.evaluator_results_html
+        assert [i.id for i in
+                registry.get_metadata().evaluation_instance_get_completed()] == [iid]
+
+
+class TestContext:
+    def test_app_name_and_env(self):
+        ctx = WorkflowContext(mode="Serving", batch="bb",
+                              executor_env={"PIO_X": "1"})
+        assert ctx.app_name == "PredictionIO Serving: bb"
+        assert ctx.env == {"PIO_X": "1"}
+
+    def test_pio_env_vars_filter(self):
+        out = pio_env_vars({"PIO_A": "1", "OTHER": "2", "PIO_B": "3"})
+        assert out == {"PIO_A": "1", "PIO_B": "3"}
+
+    def test_mesh_lazy_build(self):
+        ctx = WorkflowContext()
+        mesh = ctx.mesh
+        assert mesh.shape["data"] == 8  # virtual CPU devices from conftest
+        ctx.stop()
