@@ -1,0 +1,27 @@
+"""Engine templates — the workloads of SURVEY §2.6, rebuilt TPU-native."""
+
+from .recommendation import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    ItemScore,
+    PredictedResult,
+    Query,
+    RecDataSource,
+    RecDataSourceParams,
+    RecPreparator,
+)
+from .recommendation import engine_factory as recommendation_engine_factory
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModel",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "RecDataSource",
+    "RecDataSourceParams",
+    "RecPreparator",
+    "recommendation_engine_factory",
+]

@@ -1,0 +1,34 @@
+"""TPU compute kernels: ALS, scoring, classification reductions (SURVEY §2.9:
+the MLlib-dependency surface rebuilt as XLA/Pallas programs)."""
+
+from .als import (
+    ALSConfig,
+    ALSFactors,
+    BucketedMatrix,
+    als_train,
+    als_train_coo,
+    bucketize,
+    predict_pairs,
+    rmse,
+)
+from .scoring import (
+    standardize,
+    top_k_for_users,
+    top_k_for_vectors,
+    top_k_similar_items,
+)
+
+__all__ = [
+    "ALSConfig",
+    "ALSFactors",
+    "BucketedMatrix",
+    "als_train",
+    "als_train_coo",
+    "bucketize",
+    "predict_pairs",
+    "rmse",
+    "standardize",
+    "top_k_for_users",
+    "top_k_for_vectors",
+    "top_k_similar_items",
+]

@@ -1,0 +1,94 @@
+"""Serving-side scoring kernels.
+
+The hot path of the deployed recommendation engine: the reference scores via
+``MatrixFactorizationModel.recommendProducts`` (factor dot products, invoked
+per query in ``examples/.../ALSAlgorithm.scala:76-80``); here queries are
+batched into one gather → matmul → top-k device call
+(SURVEY §3.2 "batched gather-dot kernel").
+
+All kernels are jit'd with static k so repeated serving calls hit the
+compilation cache; the query batch rides the mesh ``data`` axis when the
+server shards a batch across chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def _score_topk(query_vectors, item_factors, k, exclude_mask):
+    scores = jnp.einsum(
+        "br,ir->bi", query_vectors, item_factors, preferred_element_type=jnp.float32
+    )
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask, NEG_INF, scores)
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_for_users(
+    user_factors: jax.Array,  # [U, R]
+    item_factors: jax.Array,  # [I, R]
+    user_idx: jax.Array,  # [B] int32
+    k: int,
+    exclude_mask: Optional[jax.Array] = None,  # [B, I] bool — True = exclude
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k items for a batch of known users.
+
+    Returns (scores [B, k], item indices [B, k]). ``exclude_mask`` implements
+    the seen/unavailable-item filters the e-commerce template applies
+    (reference ``ALSAlgorithm.scala`` in ecommerce template).
+    """
+    return _score_topk(user_factors[user_idx], item_factors, k, exclude_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_for_vectors(
+    query_vectors: jax.Array,  # [B, R]
+    item_factors: jax.Array,  # [I, R]
+    k: int,
+    exclude_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k items for raw query vectors (cold-start / feature queries)."""
+    return _score_topk(query_vectors, item_factors, k, exclude_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_similar_items(
+    item_factors: jax.Array,  # [I, R]
+    item_idx: jax.Array,  # [B] int32
+    k: int,
+    exclude_self: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Cosine-similar items — the similarproduct template's kernel
+    (reference: ALS ``productFeatures`` cosine,
+    ``examples/scala-parallel-similarproduct``).
+
+    Returns (cosine scores [B, k], item indices [B, k]); when
+    ``exclude_self`` the query item's own score is masked to -inf before
+    the top-k selection.
+    """
+    norms = jnp.linalg.norm(item_factors, axis=1, keepdims=True)
+    unit = item_factors / jnp.maximum(norms, 1e-12)
+    q = unit[item_idx]  # [B, R]
+    scores = jnp.einsum("br,ir->bi", q, unit, preferred_element_type=jnp.float32)
+    if exclude_self:
+        n_items = item_factors.shape[0]
+        one_hot = jax.nn.one_hot(item_idx, n_items, dtype=jnp.bool_)
+        scores = jnp.where(one_hot, NEG_INF, scores)
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def standardize(scores: jax.Array) -> jax.Array:
+    """Z-score standardization — the multi-algorithm ensemble combine step
+    (reference similarproduct ``multi/`` Serving z-score + sum)."""
+    mean = jnp.mean(scores)
+    std = jnp.std(scores)
+    return (scores - mean) / jnp.maximum(std, 1e-12)
