@@ -1,0 +1,24 @@
+"""REST data-ingestion plane (the reference's Event Server, SURVEY §1 L2).
+
+Rebuild of ``data/src/main/scala/io/prediction/data/api/EventAPI.scala``:
+the ``events.json`` / ``events/<id>.json`` / ``stats.json`` routes with
+access-key authentication and hourly/lifetime stats bookkeeping. The spray/
+akka actor tree becomes a threaded stdlib HTTP server — the ingestion path is
+pure control plane and never touches the TPU.
+"""
+
+from .event_server import (
+    EventServer,
+    EventServerConfig,
+    Stats,
+    StatsTracker,
+    create_event_server,
+)
+
+__all__ = [
+    "EventServer",
+    "EventServerConfig",
+    "Stats",
+    "StatsTracker",
+    "create_event_server",
+]

@@ -13,7 +13,7 @@ predict is the batched gather-dot top-k kernel
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -25,7 +25,7 @@ from ..controller import (
     Params,
     Preparator,
 )
-from ..ops.als import ALSConfig, ALSFactors, als_train_coo
+from ..ops.als import ALSConfig, als_train_coo
 from ..ops.scoring import top_k_for_users
 from ..storage import BiMap, EventFilter, get_registry
 

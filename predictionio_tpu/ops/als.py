@@ -167,18 +167,21 @@ def _solve_block_explicit_body(y, idx, val, mask, lam, rank):
 def _solve_block_implicit_body(y, yty, idx, val, mask, lam, alpha, rank):
     """Implicit-feedback solve (Hu-Koren-Volinsky, MLlib semantics).
 
-    A_u = YᵀY + Σ_observed (c-1) y yᵀ + λ n_u I,  b_u = Σ_observed c·y
-    with confidence c = 1 + α·r.
+    A_u = YᵀY + Σ_observed (c-1) y yᵀ + λ n_u I,  b_u = Σ_observed c·p·y
+    with confidence c = 1 + α·|r| and preference p = 1[r > 0] (MLlib's
+    ``ALS.scala`` implicit convention: confidence from magnitude, preference
+    from sign — a negative rating is high-confidence "not preferred").
     """
     g = y[idx] * mask[..., None]  # [B, K, R]
-    c_minus_1 = (alpha * val) * mask  # [B, K]
+    c_minus_1 = (alpha * jnp.abs(val)) * mask  # [B, K]
+    pref = (val > 0).astype(jnp.float32) * mask  # [B, K]
     a = yty[None] + jnp.einsum(
         "bkr,bk,bks->brs", g, c_minus_1, g, preferred_element_type=jnp.float32
     )
     n_u = mask.sum(axis=1)
     a = a + (lam * n_u)[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
     b = jnp.einsum(
-        "bkr,bk->br", g, (1.0 + c_minus_1) * mask, preferred_element_type=jnp.float32
+        "bkr,bk->br", g, (1.0 + c_minus_1) * pref, preferred_element_type=jnp.float32
     )
     chol = jax.scipy.linalg.cho_factor(a, lower=True)
     return jax.scipy.linalg.cho_solve(chol, b)

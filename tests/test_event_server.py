@@ -1,0 +1,225 @@
+"""Event Server REST tests.
+
+Analogue of the reference's ``EventServiceSpec``
+(``data/src/test/scala/io/prediction/data/api/EventServiceSpec.scala:31-42``)
+but exercising the full HTTP surface over a live socket (the spray-testkit
+route tests become requests against a ThreadingHTTPServer on an ephemeral
+port), plus the stats bookkeeping of ``StatsActor``
+(``EventAPI.scala:354-395``).
+"""
+
+import datetime as dt
+
+import pytest
+import requests
+
+from predictionio_tpu.api import EventServer, EventServerConfig, StatsTracker
+from predictionio_tpu.storage import (
+    AccessKey,
+    App,
+    Event,
+    MetadataStore,
+    SqliteEventStore,
+)
+
+
+@pytest.fixture()
+def server():
+    events = SqliteEventStore(":memory:")
+    metadata = MetadataStore(":memory:")
+    app_id = metadata.app_insert(App(id=0, name="testapp"))
+    metadata.access_key_insert(AccessKey(key="SECRET", appid=app_id, events=[]))
+    events.init(app_id)
+    srv = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True), events, metadata
+    )
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.bound_port}"
+    yield base, app_id
+    srv.shutdown()
+    srv.server_close()
+
+
+def _event_payload(**overrides):
+    payload = {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": "u1",
+        "targetEntityType": "item",
+        "targetEntityId": "i1",
+        "properties": {"rating": 4.5},
+        "eventTime": "2026-01-02T03:04:05.000Z",
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_root_alive(server):
+    base, _ = server
+    r = requests.get(f"{base}/")
+    assert r.status_code == 200
+    assert r.json() == {"status": "alive"}
+
+
+def test_post_requires_access_key(server):
+    base, _ = server
+    r = requests.post(f"{base}/events.json", json=_event_payload())
+    assert r.status_code == 401
+    assert r.json() == {"message": "Invalid accessKey."}
+    r = requests.post(
+        f"{base}/events.json?accessKey=WRONG", json=_event_payload()
+    )
+    assert r.status_code == 401
+
+
+def test_post_get_delete_roundtrip(server):
+    base, _ = server
+    r = requests.post(
+        f"{base}/events.json?accessKey=SECRET", json=_event_payload()
+    )
+    assert r.status_code == 201
+    event_id = r.json()["eventId"]
+    assert event_id
+
+    r = requests.get(f"{base}/events/{event_id}.json?accessKey=SECRET")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["event"] == "rate"
+    assert body["entityId"] == "u1"
+    assert body["targetEntityId"] == "i1"
+    assert body["properties"]["rating"] == 4.5
+    assert body["eventTime"].startswith("2026-01-02T03:04:05")
+
+    r = requests.delete(f"{base}/events/{event_id}.json?accessKey=SECRET")
+    assert r.status_code == 200
+    assert r.json() == {"message": "Found"}
+
+    r = requests.get(f"{base}/events/{event_id}.json?accessKey=SECRET")
+    assert r.status_code == 404
+    r = requests.delete(f"{base}/events/{event_id}.json?accessKey=SECRET")
+    assert r.status_code == 404
+    assert r.json() == {"message": "Not Found"}
+
+
+def test_post_malformed_body_is_400(server):
+    base, _ = server
+    r = requests.post(
+        f"{base}/events.json?accessKey=SECRET",
+        data="{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 400
+
+    # validation failure: $set requires no targetEntity (Event.scala:70-99)
+    r = requests.post(
+        f"{base}/events.json?accessKey=SECRET",
+        json=_event_payload(event="$set"),
+    )
+    assert r.status_code == 400
+
+
+def test_find_with_filters(server):
+    base, _ = server
+    for i in range(5):
+        requests.post(
+            f"{base}/events.json?accessKey=SECRET",
+            json=_event_payload(
+                entityId=f"u{i % 2}",
+                eventTime=f"2026-01-0{i + 1}T00:00:00.000Z",
+            ),
+        )
+
+    r = requests.get(f"{base}/events.json?accessKey=SECRET")
+    assert r.status_code == 200
+    assert len(r.json()) == 5
+
+    r = requests.get(f"{base}/events.json?accessKey=SECRET&entityId=u0")
+    assert len(r.json()) == 3
+
+    r = requests.get(
+        f"{base}/events.json?accessKey=SECRET"
+        "&startTime=2026-01-02T00:00:00.000Z&untilTime=2026-01-04T00:00:00.000Z"
+    )
+    assert len(r.json()) == 2
+
+    r = requests.get(f"{base}/events.json?accessKey=SECRET&limit=2")
+    assert len(r.json()) == 2
+
+    # reversed=true returns latest first (LEvents.scala:139)
+    r = requests.get(f"{base}/events.json?accessKey=SECRET&reversed=true")
+    times = [e["eventTime"] for e in r.json()]
+    assert times == sorted(times, reverse=True)
+
+    r = requests.get(f"{base}/events.json?accessKey=SECRET&event=nonexistent")
+    assert r.status_code == 404
+
+
+def test_stats_counts_by_app(server):
+    base, _ = server
+    requests.post(f"{base}/events.json?accessKey=SECRET", json=_event_payload())
+    requests.post(
+        f"{base}/events.json?accessKey=SECRET",
+        json=_event_payload(event="buy"),
+    )
+    # no-target event: snapshot sort must handle targetEntityType=None
+    no_target = _event_payload(event="view")
+    del no_target["targetEntityType"], no_target["targetEntityId"]
+    requests.post(f"{base}/events.json?accessKey=SECRET", json=no_target)
+    r = requests.get(f"{base}/stats.json?accessKey=SECRET")
+    assert r.status_code == 200
+    snap = r.json()
+    assert set(snap) == {"time", "currentHour", "prevHour", "longLive"}
+    long_live = snap["longLive"]
+    events_counted = {
+        kv["key"]["event"]: kv["value"] for kv in long_live["basic"]
+    }
+    assert events_counted == {"rate": 1, "buy": 1, "view": 1}
+    assert long_live["statusCode"] == [{"key": 201, "value": 3}]
+
+
+def test_stats_disabled_is_404():
+    events = SqliteEventStore(":memory:")
+    metadata = MetadataStore(":memory:")
+    app_id = metadata.app_insert(App(id=0, name="nostats"))
+    metadata.access_key_insert(AccessKey(key="K", appid=app_id, events=[]))
+    events.init(app_id)
+    srv = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, stats=False), events, metadata
+    )
+    srv.start_background()
+    try:
+        r = requests.get(
+            f"http://127.0.0.1:{srv.bound_port}/stats.json?accessKey=K"
+        )
+        assert r.status_code == 404
+        assert "stats" in r.json()["message"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_keepalive_survives_rejected_post(server):
+    # A 401 sent before the body is read must not desync the next request
+    # on the same persistent connection.
+    base, _ = server
+    with requests.Session() as s:
+        r = s.post(f"{base}/events.json", json=_event_payload())
+        assert r.status_code == 401
+        r = s.post(f"{base}/events.json?accessKey=SECRET", json=_event_payload())
+        assert r.status_code == 201
+
+
+def test_stats_tracker_hour_rollover():
+    tracker = StatsTracker()
+    e = Event(event="rate", entity_type="user", entity_id="u1")
+    tracker.bookkeeping(7, 201, e)
+    snap = tracker.get(7)
+    assert snap["currentHour"]["statusCode"] == [{"key": 201, "value": 1}]
+    # force rollover by back-dating the hourly window
+    tracker.hourly.start_time = tracker.hourly.start_time - dt.timedelta(hours=2)
+    tracker.bookkeeping(7, 201, e)
+    snap = tracker.get(7)
+    assert snap["currentHour"]["statusCode"] == [{"key": 201, "value": 1}]
+    assert snap["longLive"]["statusCode"] == [{"key": 201, "value": 2}]
+    # other app sees nothing
+    assert tracker.get(8)["longLive"]["basic"] == []
