@@ -29,9 +29,10 @@ import datetime as _dt
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+from .http import BackgroundHTTPServer, JsonHTTPHandler
 
 from ..storage.event import (
     Event,
@@ -162,21 +163,14 @@ def _parse_bool(text: str) -> bool:
     return text.strip().lower() in ("true", "1", "yes")
 
 
-class _EventServiceHandler(BaseHTTPRequestHandler):
+class _EventServiceHandler(JsonHTTPHandler):
     """One request = one route dispatch (``EventServiceActor.route``,
     ``EventAPI.scala:166-349``)."""
 
     server: "EventServer"
-    protocol_version = "HTTP/1.1"
 
     # -- helpers ----------------------------------------------------------
-    def _respond(self, status: int, payload: Any) -> None:
-        body = json.dumps(payload).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    _respond = JsonHTTPHandler.respond
 
     def _auth(self, query: Dict[str, list]) -> int:
         """accessKey → appId (``withAccessKey``, ``EventAPI.scala:149-164``).
@@ -189,13 +183,6 @@ class _EventServiceHandler(BaseHTTPRequestHandler):
             raise _HTTPError(401, {"message": "Invalid accessKey."})
         return ak.appid
 
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
-        return self.rfile.read(length) if length else b""
-
-    def log_message(self, fmt: str, *args: Any) -> None:  # quiet by default
-        logger.debug("%s - %s", self.address_string(), fmt % args)
-
     # -- dispatch ---------------------------------------------------------
     def _route(self, method: str) -> None:
         parsed = urlparse(self.path)
@@ -203,7 +190,7 @@ class _EventServiceHandler(BaseHTTPRequestHandler):
         query = parse_qs(parsed.query)
         # Drain the request body up front: on keep-alive connections an error
         # response sent before the body is read would desync the next request.
-        self._body = self._read_body()
+        self._body = self.read_body()
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})
@@ -321,11 +308,9 @@ class _EventServiceHandler(BaseHTTPRequestHandler):
         self._respond(200, self.server.stats_tracker.get(app_id))
 
 
-class EventServer(ThreadingHTTPServer):
+class EventServer(BackgroundHTTPServer):
     """Threaded HTTP server bound to the storage plane
     (``EventServer.createEventServer``, ``EventAPI.scala:427-445``)."""
-
-    daemon_threads = True
 
     def __init__(
         self,
@@ -340,15 +325,6 @@ class EventServer(ThreadingHTTPServer):
             StatsTracker() if config.stats else None
         )
         super().__init__((config.ip, config.port), _EventServiceHandler)
-
-    @property
-    def bound_port(self) -> int:
-        return self.server_address[1]
-
-    def start_background(self) -> threading.Thread:
-        thread = threading.Thread(target=self.serve_forever, daemon=True)
-        thread.start()
-        return thread
 
 
 def create_event_server(

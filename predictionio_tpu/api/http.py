@@ -1,0 +1,76 @@
+"""Shared HTTP plumbing for the framework's REST surfaces.
+
+The Event Server (``api/event_server.py``), query server
+(``workflow/serving.py``) and dashboard all speak the same dialect: JSON
+bodies, keep-alive connections, daemon-threaded stdlib servers. This module
+is the single home for that plumbing (the analogue of the spray/akka layer
+both reference servers share).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+
+class JsonHTTPHandler(BaseHTTPRequestHandler):
+    """Request handler base: JSON responses, body draining, quiet logs."""
+
+    protocol_version = "HTTP/1.1"
+
+    def respond(
+        self, status: int, payload: Any, content_type: str = "application/json"
+    ) -> None:
+        """Send a response. JSON payloads are dumped; raw ``bytes`` (and
+        ``str`` only for non-JSON content types, e.g. HTML pages) pass
+        through verbatim."""
+        if isinstance(payload, bytes):
+            body = payload
+        elif isinstance(payload, str) and content_type != "application/json":
+            body = payload.encode("utf-8")
+        else:
+            body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_body(self) -> bytes:
+        """Drain the request body. Must happen before any error response on a
+        keep-alive connection, else leftover body bytes desync the next
+        request."""
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class BackgroundHTTPServer(ThreadingHTTPServer):
+    """Threaded server with ephemeral-port introspection and background run."""
+
+    daemon_threads = True
+
+    @property
+    def bound_port(self) -> int:
+        return self.server_address[1]
+
+    def start_background(self) -> threading.Thread:
+        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread.start()
+        return thread
+
+    def stop_async(self) -> None:
+        """Shut down from inside a handler thread (``GET /stop``)."""
+
+        def stop() -> None:
+            self.shutdown()
+            self.server_close()  # release the listening socket
+
+        threading.Thread(target=stop, daemon=True).start()

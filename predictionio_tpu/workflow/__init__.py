@@ -2,9 +2,21 @@
 
 from .context import WorkflowContext, pio_env_vars
 from .core_workflow import load_models, run_evaluation, run_train
+from .serving import (
+    Deployment,
+    QueryServer,
+    ServerConfig,
+    create_query_server,
+    prepare_deployment,
+)
 
 __all__ = [
+    "Deployment",
+    "QueryServer",
+    "ServerConfig",
     "WorkflowContext",
+    "create_query_server",
+    "prepare_deployment",
     "load_models",
     "pio_env_vars",
     "run_evaluation",

@@ -1,0 +1,432 @@
+"""Query server: REST deployment of trained engines.
+
+Rebuild of ``core/src/main/scala/io/prediction/workflow/CreateServer.scala``:
+
+- ``POST /queries.json`` — decode query, ``predict`` over every algorithm,
+  ``serve`` combine, optional feedback loop (``CreateServer.scala:458-577``);
+- ``GET /reload``       — hot-swap to the latest completed engine instance
+  (``MasterActor`` ReloadServer, ``CreateServer.scala:300-321``);
+- ``GET /stop``         — graceful shutdown (``CreateServer.scala:389-397``);
+- ``GET /``             — status page with engine info and serving stats
+  (``CreateServer.scala:421-456``; twirl ``index.scala.html``).
+
+The reference's akka ``MasterActor``/``ServerActor`` pair and its
+serve-time SparkContext collapse into one threaded HTTP server holding the
+live model pytrees (factor tables stay resident in HBM between requests; a
+reload swaps the table references under a lock — the TPU analogue of
+respawning the server actor).
+
+Feedback events mirror ``CreateServer.scala:505-565``: a ``predict`` event
+with ``entityType=pio_pr``, a generated 64-char ``prId``, and properties
+``{engineInstanceId, query, prediction}`` POSTed to the Event Server; when
+the prediction carries a ``prId`` field the response is stamped with the
+generated id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import html
+import json
+import logging
+import random
+import string
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..controller.engine import Engine, EngineParams, WorkflowParams
+from ..storage import StorageRegistry, utcnow
+from ..storage.metadata import STATUS_COMPLETED, EngineInstance
+from .context import WorkflowContext
+from .core_workflow import load_models
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """``ServerConfig`` (``CreateServer.scala:71-98``); query port default
+    8000 (``CreateServer.scala:76``)."""
+
+    ip: str = "localhost"
+    port: int = 8000
+    engine_instance_id: Optional[str] = None  # None = latest COMPLETED
+    engine_id: Optional[str] = None
+    engine_version: Optional[str] = None
+    engine_variant: str = "engine.json"
+    feedback: bool = False
+    event_server_ip: str = "localhost"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    batch: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Query / prediction JSON codecs (per-algo querySerializer analogue,
+# CreateServer.scala:475-478)
+# ---------------------------------------------------------------------------
+
+
+def decode_query(algorithms: Sequence[Any], payload: Any) -> Any:
+    """Decode a JSON query using the first algorithm's declared query class
+    (plain dicts pass through, like json4s ``DefaultFormats``)."""
+    for algo in algorithms:
+        cls = algo.query_class()
+        if cls is not None:
+            if dataclasses.is_dataclass(cls):
+                fields = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in payload.items() if k in fields})
+            return cls(**payload)
+    return payload
+
+
+def encode_result(obj: Any) -> Any:
+    """Prediction → JSON-compatible structure."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: encode_result(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {k: encode_result(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_result(v) for v in obj]
+    if not isinstance(obj, (str, bytes)):
+        if hasattr(obj, "tolist"):
+            return obj.tolist()  # numpy / jax arrays (any shape)
+        if hasattr(obj, "item"):
+            try:
+                return obj.item()  # other scalar wrappers
+            except (TypeError, ValueError):
+                pass
+    return obj
+
+
+def _gen_pr_id() -> str:
+    """64 alphanumeric chars (``CreateServer.scala:513``)."""
+    alphabet = string.ascii_letters + string.digits
+    return "".join(random.choice(alphabet) for _ in range(64))
+
+
+def _get_pr_id(obj: Any) -> Optional[str]:
+    """The ``WithPrId`` protocol: a ``pr_id`` attribute or ``prId`` key."""
+    if isinstance(obj, dict):
+        return obj.get("prId") if "prId" in obj else None
+    return getattr(obj, "pr_id", None)
+
+
+def _has_pr_id(obj: Any) -> bool:
+    return (isinstance(obj, dict) and "prId" in obj) or hasattr(obj, "pr_id")
+
+
+# ---------------------------------------------------------------------------
+# Deployment state (what MasterActor rebuilds on reload)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Deployment:
+    """One live engine instance: algorithms + in-memory (HBM) models +
+    serving combiner (``createServerActorWithEngine``,
+    ``CreateServer.scala:184-248``)."""
+
+    instance: EngineInstance
+    engine_params: EngineParams
+    algorithms: List[Any]
+    models: List[Any]
+    serving: Any
+
+
+def prepare_deployment(
+    engine: Engine,
+    registry: StorageRegistry,
+    config: ServerConfig,
+    ctx: Optional[WorkflowContext] = None,
+) -> Deployment:
+    """Load the target engine instance and make its models live
+    (``CreateServer.scala:184-248`` + ``Engine.prepareDeploy``)."""
+    md = registry.get_metadata()
+    if config.engine_instance_id:
+        instance = md.engine_instance_get(config.engine_instance_id)
+        if instance is None:
+            raise KeyError(
+                f"Engine instance {config.engine_instance_id} not found"
+            )
+    else:
+        instance = md.engine_instance_get_latest_completed(
+            engine_id=config.engine_id or "default",
+            engine_version=config.engine_version or "1",
+            engine_variant=config.engine_variant,
+        )
+        if instance is None:
+            raise RuntimeError(
+                "No completed engine instance found; run train first "
+                "(Console.scala:742-780)"
+            )
+    if instance.status != STATUS_COMPLETED:
+        raise RuntimeError(
+            f"Engine instance {instance.id} has status {instance.status}, "
+            "not COMPLETED"
+        )
+
+    ctx = ctx or WorkflowContext(mode="Serving", batch=config.batch)
+    engine_params = engine.engine_instance_to_engine_params(instance)
+    persisted = load_models(registry, instance.id)
+    live_models = engine.prepare_deploy(ctx, engine_params, instance.id, persisted)
+    algorithms = engine._algorithms(engine_params)
+    serving = engine._serving(engine_params)
+    return Deployment(
+        instance=instance,
+        engine_params=engine_params,
+        algorithms=algorithms,
+        models=live_models,
+        serving=serving,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+
+class QueryDecodeError(ValueError):
+    """Query JSON does not fit the engine's query shape → 400, matching the
+    reference's MappingException handling (``CreateServer.scala:578-585``)."""
+
+
+class _QueryHandler(JsonHTTPHandler):
+    server: "QueryServer"
+
+    _respond = JsonHTTPHandler.respond
+
+    def do_POST(self) -> None:  # noqa: N802
+        raw = self.read_body()
+        path = urlparse(self.path).path
+        if path != "/queries.json":
+            self._respond(404, {"message": "Not Found"})
+            return
+        try:
+            payload = json.loads(raw.decode("utf-8")) if raw else {}
+        except ValueError as exc:
+            self._respond(400, {"message": str(exc)})
+            return
+        try:
+            result, status = self.server.handle_query(payload)
+            self._respond(status, result)
+        except QueryDecodeError as exc:
+            self._respond(400, {"message": str(exc)})
+        except Exception as exc:
+            logger.exception("Query failed")
+            self._respond(500, {"message": str(exc)})
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        if path == "/":
+            self._respond(200, self.server.status_html(), content_type="text/html")
+        elif path == "/reload":
+            try:
+                self.server.reload()
+                self._respond(200, {"message": "Reloaded"})
+            except Exception as exc:
+                logger.exception("Reload failed")
+                self._respond(500, {"message": str(exc)})
+        elif path == "/stop":
+            self._respond(200, {"message": "Shutting down"})
+            self.server.stop_async()
+        else:
+            self._respond(404, {"message": "Not Found"})
+
+
+class QueryServer(BackgroundHTTPServer):
+    """The serving process (``ServerActor`` + ``MasterActor``,
+    ``CreateServer.scala:250-628``)."""
+
+    def __init__(
+        self,
+        config: ServerConfig,
+        engine: Engine,
+        registry: StorageRegistry,
+        deployment: Optional[Deployment] = None,
+        ctx: Optional[WorkflowContext] = None,
+    ):
+        self.config = config
+        self.engine = engine
+        self.registry = registry
+        self.ctx = ctx or WorkflowContext(mode="Serving", batch=config.batch)
+        self._deploy_lock = threading.RLock()
+        self.deployment = deployment or prepare_deployment(
+            engine, registry, config, self.ctx
+        )
+        # Serving stats (CreateServer.scala:392-394,567-574)
+        self._stats_lock = threading.Lock()
+        self.server_start_time = utcnow()
+        self.request_count = 0
+        self.last_serving_sec = 0.0
+        self.avg_serving_sec = 0.0
+        super().__init__((config.ip, config.port), _QueryHandler)
+
+    # -- query path (CreateServer.scala:458-577) --------------------------
+    def handle_query(self, payload: Any) -> Tuple[Any, int]:
+        started = time.monotonic()
+        query_time = utcnow()
+        with self._deploy_lock:
+            dep = self.deployment
+        try:
+            query = decode_query(dep.algorithms, payload)
+        except (TypeError, AttributeError, KeyError) as exc:
+            raise QueryDecodeError(f"Invalid query: {exc}") from exc
+        query = dep.serving.supplement(query)
+        predictions = [
+            algo.predict(model, query)
+            for algo, model in zip(dep.algorithms, dep.models)
+        ]
+        prediction = dep.serving.serve(query, predictions)
+        result = encode_result(prediction)
+
+        if self.config.feedback:
+            result = self._send_feedback(dep, query_time, query, prediction, result)
+
+        elapsed = time.monotonic() - started
+        with self._stats_lock:
+            self.last_serving_sec = elapsed
+            self.avg_serving_sec = (
+                self.avg_serving_sec * self.request_count + elapsed
+            ) / (self.request_count + 1)
+            self.request_count += 1
+        return result, 200
+
+    def _send_feedback(
+        self,
+        dep: Deployment,
+        query_time: _dt.datetime,
+        query: Any,
+        prediction: Any,
+        result: Any,
+    ) -> Any:
+        """Async ``predict`` event to the Event Server
+        (``CreateServer.scala:505-565``)."""
+        existing = _get_pr_id(prediction)
+        new_pr_id = existing if existing else _gen_pr_id()
+        data = {
+            "event": "predict",
+            "eventTime": query_time.isoformat(timespec="milliseconds"),
+            "entityType": "pio_pr",
+            "entityId": new_pr_id,
+            "properties": {
+                "engineInstanceId": dep.instance.id,
+                "query": encode_result(query),
+                "prediction": encode_result(prediction),
+            },
+        }
+        query_pr_id = _get_pr_id(query)
+        if query_pr_id is not None:
+            data["prId"] = query_pr_id
+
+        url = (
+            f"http://{self.config.event_server_ip}:"
+            f"{self.config.event_server_port}/events.json"
+            f"?accessKey={self.config.access_key or ''}"
+        )
+
+        def post() -> None:
+            try:
+                import requests
+
+                resp = requests.post(url, json=data, timeout=10)
+                if resp.status_code != 201:
+                    logger.error(
+                        "Feedback event failed. Status code: %s. Data: %s",
+                        resp.status_code,
+                        data,
+                    )
+            except Exception as exc:
+                logger.error("Feedback event failed: %s", exc)
+
+        threading.Thread(target=post, daemon=True).start()
+
+        # Stamp the generated prId into the response only for predictions
+        # that carry a prId slot (CreateServer.scala:558-565).
+        if _has_pr_id(prediction) and isinstance(result, dict):
+            result = dict(result)
+            result["prId"] = new_pr_id
+        return result
+
+    # -- lifecycle --------------------------------------------------------
+    def reload(self) -> None:
+        """Hot-swap to the latest completed instance
+        (``CreateServer.scala:300-321``): the new tables are staged first,
+        then the references swap under the lock."""
+        cfg = dataclasses.replace(
+            self.config,
+            engine_instance_id=None,
+            engine_id=self.deployment.instance.engine_id,
+            engine_version=self.deployment.instance.engine_version,
+            engine_variant=self.deployment.instance.engine_variant,
+        )
+        fresh = prepare_deployment(self.engine, self.registry, cfg, self.ctx)
+        with self._deploy_lock:
+            old = self.deployment.instance.id
+            self.deployment = fresh
+        logger.info(
+            "Reloaded: engine instance %s -> %s", old, fresh.instance.id
+        )
+
+    # -- status page (CreateServer.scala:421-456) -------------------------
+    def status_html(self) -> str:
+        dep = self.deployment
+        with self._stats_lock:
+            rows = [
+                ("Engine instance", dep.instance.id),
+                ("Engine", f"{dep.instance.engine_id} {dep.instance.engine_version}"),
+                ("Engine factory", dep.instance.engine_factory),
+                ("Start time", str(self.server_start_time)),
+                ("Algorithms", ", ".join(type(a).__name__ for a in dep.algorithms)),
+                ("Models", ", ".join(type(m).__name__ for m in dep.models)),
+                ("Serving", type(dep.serving).__name__),
+                ("Feedback enabled", str(self.config.feedback)),
+                ("Request count", str(self.request_count)),
+                ("Average serving time", f"{self.avg_serving_sec * 1000:.3f} ms"),
+                ("Last serving time", f"{self.last_serving_sec * 1000:.3f} ms"),
+            ]
+        cells = "".join(
+            f"<tr><th>{html.escape(k)}</th><td>{html.escape(v)}</td></tr>"
+            for k, v in rows
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>"
+            f"{html.escape(dep.instance.engine_id)} - predictionio_tpu engine "
+            "server</title></head><body>"
+            "<h1>PredictionIO-TPU Engine Server</h1>"
+            f"<table>{cells}</table>"
+            "<p>POST JSON queries to <code>/queries.json</code>; "
+            "<a href=\"/reload\">reload</a> latest model.</p>"
+            "</body></html>"
+        )
+
+
+def create_query_server(
+    engine: Engine,
+    config: ServerConfig = ServerConfig(),
+    registry: Optional[StorageRegistry] = None,
+    block: bool = True,
+) -> QueryServer:
+    """Deploy an engine (``CreateServer.main``, ``CreateServer.scala:100-182``)."""
+    from ..storage.registry import get_registry
+
+    registry = registry or get_registry()
+    server = QueryServer(config, engine, registry)
+    logger.info(
+        "Query server: engine instance %s on %s:%d",
+        server.deployment.instance.id,
+        config.ip,
+        server.bound_port,
+    )
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+    else:
+        server.start_background()
+    return server
