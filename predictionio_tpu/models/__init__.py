@@ -1,5 +1,8 @@
 """Engine templates — the workloads of SURVEY §2.6, rebuilt TPU-native."""
 
+from . import classification, ecommerce, recommendation, similarproduct
+from .classification import engine_factory as classification_engine_factory
+from .ecommerce import engine_factory as ecommerce_engine_factory
 from .recommendation import (
     ALSAlgorithm,
     ALSAlgorithmParams,
@@ -12,6 +15,7 @@ from .recommendation import (
     RecPreparator,
 )
 from .recommendation import engine_factory as recommendation_engine_factory
+from .similarproduct import engine_factory as similarproduct_engine_factory
 
 __all__ = [
     "ALSAlgorithm",
@@ -23,5 +27,12 @@ __all__ = [
     "RecDataSource",
     "RecDataSourceParams",
     "RecPreparator",
+    "classification",
+    "classification_engine_factory",
+    "ecommerce",
+    "ecommerce_engine_factory",
+    "recommendation",
     "recommendation_engine_factory",
+    "similarproduct",
+    "similarproduct_engine_factory",
 ]

@@ -1,0 +1,222 @@
+"""Classification engine template (NaiveBayes + RandomForest ensemble).
+
+Rebuild of ``examples/scala-parallel-classification/add-algorithm/src/main/
+scala/``: the DataSource derives labeled points from
+``aggregateProperties`` over "user" entities with required properties
+``plan, attr0, attr1, attr2`` (``DataSource.scala:27-56``); the engine maps
+two algorithms — ``"naive"`` (MLlib ``NaiveBayes.train`` with ``lambda``,
+``NaiveBayesAlgorithm.scala:19-27``) and ``"randomforest"``
+(``RandomForestAlgorithm.scala:28-49``) — combined by a first-prediction
+Serving (``Serving.scala:5-12``, ``Engine.scala:15-23``).
+
+TPU restatement: both algorithms train on device via the sufficient-statistic
+/ histogram kernels in :mod:`predictionio_tpu.ops.classifier` and
+:mod:`predictionio_tpu.ops.forest`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from ..ops import classifier, forest
+from ..storage import get_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """``Query(features)`` (``Engine.scala:6-8``)."""
+
+    features: Tuple[float, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "features", tuple(self.features))
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    """``PredictedResult(label)`` (``Engine.scala:10-12``)."""
+
+    label: float
+
+
+@dataclasses.dataclass
+class TrainingData:
+    """Labeled points (``DataSource.scala:59-61``)."""
+
+    features: np.ndarray  # [N, D]
+    labels: np.ndarray  # [N]
+
+    def sanity_check(self) -> None:
+        if self.features.shape[0] == 0:
+            raise ValueError("Classification TrainingData is empty")
+        if not np.isfinite(self.features).all():
+            raise ValueError("Classification features contain non-finite values")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassificationDataSourceParams(Params):
+    app_id: int = 1
+    entity_type: str = "user"
+    label_property: str = "plan"
+    feature_properties: Tuple[str, ...] = ("attr0", "attr1", "attr2")
+    eval_k: int = 0  # >0 enables k-fold readEval
+
+
+class ClassificationDataSource(DataSource):
+    """``aggregateProperties`` → labeled points (``DataSource.scala:27-56``);
+    entities missing a required property are skipped (the reference's
+    ``required=...`` filter)."""
+
+    params_class = ClassificationDataSourceParams
+
+    def __init__(
+        self,
+        params: ClassificationDataSourceParams = ClassificationDataSourceParams(),
+    ):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        p = self.params
+        store = get_registry().get_events()
+        required = (p.label_property,) + tuple(p.feature_properties)
+        props_by_entity = store.aggregate_properties(
+            p.app_id, p.entity_type, required=required
+        )
+        feats: List[List[float]] = []
+        labels: List[float] = []
+        for entity_id, props in sorted(props_by_entity.items()):
+            labels.append(float(props.get(p.label_property)))
+            feats.append([float(props.get(f)) for f in p.feature_properties])
+        return TrainingData(
+            features=np.asarray(feats, np.float32).reshape(
+                len(labels), len(p.feature_properties)
+            ),
+            labels=np.asarray(labels),
+        )
+
+    def read_eval(self, ctx):
+        td = self.read_training(ctx)
+        k = max(2, self.params.eval_k)
+        folds = []
+        idx = np.arange(td.labels.shape[0])
+        for f in range(k):
+            test = idx % k == f
+            train_td = TrainingData(
+                features=td.features[~test], labels=td.labels[~test]
+            )
+            qa = [
+                (
+                    Query(features=tuple(td.features[i])),
+                    PredictedResult(label=float(td.labels[i])),
+                )
+                for i in idx[test]
+            ]
+            folds.append((train_td, None, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    """``NaiveBayesAlgorithmParams(lambda)``."""
+
+    lam: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """Multinomial NB on device (``NaiveBayesAlgorithm.scala:19-27``)."""
+
+    params_class = NaiveBayesParams
+
+    def __init__(self, params: NaiveBayesParams = NaiveBayesParams()):
+        self.params = params
+
+    def train(self, ctx, pd: TrainingData) -> classifier.MultinomialNBModel:
+        return classifier.train(pd.features, pd.labels, lam=self.params.lam)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        return PredictedResult(label=model.predict(query.features))
+
+    def batch_predict(self, model, indexed_queries):
+        idx = [i for i, _ in indexed_queries]
+        feats = np.asarray([q.features for _, q in indexed_queries], np.float32)
+        labels = model.predict_batch(feats)
+        return [
+            (i, PredictedResult(label=float(l))) for i, l in zip(idx, labels)
+        ]
+
+    def query_class(self):
+        return Query
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForestParams(Params):
+    """``RandomForestAlgorithmParams`` (``RandomForestAlgorithm.scala:12-19``)."""
+
+    num_classes: int = 2
+    num_trees: int = 10
+    feature_subset_strategy: str = "auto"
+    impurity: str = "gini"
+    max_depth: int = 4
+    max_bins: int = 32
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    """Histogram random forest on device
+    (``RandomForestAlgorithm.scala:28-49``)."""
+
+    params_class = RandomForestParams
+
+    def __init__(self, params: RandomForestParams = RandomForestParams()):
+        self.params = params
+
+    def train(self, ctx, pd: TrainingData) -> forest.RandomForestModel:
+        p = self.params
+        return forest.train(
+            pd.features,
+            pd.labels,
+            forest.ForestConfig(
+                num_classes=p.num_classes,
+                num_trees=p.num_trees,
+                feature_subset_strategy=p.feature_subset_strategy,
+                impurity=p.impurity,
+                max_depth=p.max_depth,
+                max_bins=p.max_bins,
+                seed=p.seed,
+            ),
+        )
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        return PredictedResult(label=model.predict(query.features))
+
+    def batch_predict(self, model, indexed_queries):
+        idx = [i for i, _ in indexed_queries]
+        feats = np.asarray([q.features for _, q in indexed_queries], np.float32)
+        labels = model.predict_batch(feats)
+        return [
+            (i, PredictedResult(label=float(l))) for i, l in zip(idx, labels)
+        ]
+
+    def query_class(self):
+        return Query
+
+
+def engine_factory() -> Engine:
+    """``ClassificationEngine`` (``Engine.scala:14-23``)."""
+    return Engine(
+        {"": ClassificationDataSource},
+        {"": IdentityPreparator},
+        {"naive": NaiveBayesAlgorithm, "randomforest": RandomForestAlgorithm},
+        {"": FirstServing},
+    )

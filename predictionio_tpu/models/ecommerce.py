@@ -1,0 +1,352 @@
+"""E-commerce recommendation engine template.
+
+Rebuild of ``examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event/src/main/scala/``: a P2L-style ALS whose predict
+applies live business filters at query time —
+
+- explicit ALS over rate events, keeping the LATEST rating per (user, item)
+  (``ALSAlgorithm.scala:82-117``);
+- seen-items filter from the user's live event stream when ``unseenOnly``
+  (``ALSAlgorithm.scala:160-192``);
+- "unavailableItems" constraint read from the latest ``$set`` on the
+  ``constraint/unavailableItems`` entity (``ALSAlgorithm.scala:195-215``);
+- known user → factor dot-products; unknown user → cosine similarity against
+  the user's 10 most recent viewed items (``predictNewUser``,
+  ``ALSAlgorithm.scala:284-360``).
+
+The reference bounds each live read with a 200 ms timeout
+(``Duration(200, "millis")``); here the same budget guards the host-side
+event-store reads so the device scoring path never blocks on storage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from ..ops.als import ALSConfig, als_train_coo
+from ..storage import BiMap, EventFilter, get_registry
+from .similarproduct import Item, ItemScore, PredictedResult
+
+logger = logging.getLogger(__name__)
+
+#: Live event-read budget (seconds) — the template's 200 ms Duration.
+LIVE_READ_TIMEOUT_S = 0.2
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """``Query(user, num, categories, whiteList, blackList)``."""
+
+    user: str
+    num: int = 10
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass
+class RateEvent:
+    user: str
+    item: str
+    rating: float
+    t: int
+
+
+@dataclasses.dataclass
+class TrainingData:
+    users: Dict[str, None]
+    items: Dict[str, Item]
+    rate_events: List[RateEvent]
+
+    def sanity_check(self) -> None:
+        if not self.rate_events:
+            raise ValueError("ecommerce TrainingData has no rate events")
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommerceDataSourceParams(Params):
+    app_id: int = 1
+
+
+class ECommerceDataSource(DataSource):
+    """``$set`` user/item entities + rate events (template DataSource)."""
+
+    params_class = ECommerceDataSourceParams
+
+    def __init__(
+        self, params: ECommerceDataSourceParams = ECommerceDataSourceParams()
+    ):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        store = get_registry().get_events()
+        app_id = self.params.app_id
+        users = {
+            uid: None
+            for uid in store.aggregate_properties(app_id, "user").keys()
+        }
+        items = {
+            iid: Item(categories=tuple(props.get("categories") or ()))
+            for iid, props in store.aggregate_properties(app_id, "item").items()
+        }
+        rates: List[RateEvent] = []
+        for e in store.find(
+            app_id, EventFilter(entity_type="user", event_names=["rate"])
+        ):
+            if e.target_entity_id is None:
+                continue
+            rates.append(
+                RateEvent(
+                    user=e.entity_id,
+                    item=e.target_entity_id,
+                    rating=float(e.properties.get("rating")),
+                    t=int(e.event_time.timestamp() * 1000),
+                )
+            )
+        return TrainingData(users=users, items=items, rate_events=rates)
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommerceALSParams(Params):
+    """``ALSAlgorithmParams(appId, unseenOnly, seenEvents, rank,
+    numIterations, lambda, seed)``."""
+
+    app_id: int = 1
+    unseen_only: bool = True
+    seen_events: Tuple[str, ...] = ("buy", "view")
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class ECommerceModel:
+    """Collected factor tables + id maps (``ALSModel``,
+    ``ALSAlgorithm.scala:30-51``) — the P2L pattern: distributed train,
+    host/HBM-resident serving tables."""
+
+    rank: int
+    user_factors: np.ndarray  # [U, R]
+    item_factors: np.ndarray  # [I, R]
+    user_map: BiMap
+    item_map: BiMap
+    items: Dict[int, Item]
+
+    def sanity_check(self) -> None:
+        if not np.isfinite(self.user_factors).all():
+            raise ValueError("ECommerceModel user factors are non-finite")
+
+
+class ECommerceALSAlgorithm(Algorithm):
+    """Explicit ALS + live-filtered serving (``ALSAlgorithm.scala``)."""
+
+    params_class = ECommerceALSParams
+
+    def __init__(self, params: ECommerceALSParams = ECommerceALSParams()):
+        self.params = params
+
+    # -- train (ALSAlgorithm.scala:64-146) --------------------------------
+    def train(self, ctx, pd: TrainingData) -> ECommerceModel:
+        if not pd.rate_events:
+            raise ValueError("rateEvents cannot be empty")
+        if not pd.users or not pd.items:
+            raise ValueError("users/items cannot be empty")
+        user_map = BiMap.string_int(pd.users.keys())
+        item_map = BiMap.string_int(pd.items.keys())
+        # latest rating per (user, item) wins
+        latest: Dict[Tuple[int, int], RateEvent] = {}
+        for r in pd.rate_events:
+            u, i = user_map.get(r.user), item_map.get(r.item)
+            if u is None or i is None:
+                logger.info(
+                    "Skipping rate event with unknown ids %s->%s", r.user, r.item
+                )
+                continue
+            key = (u, i)
+            if key not in latest or r.t > latest[key].t:
+                latest[key] = r
+        if not latest:
+            raise ValueError("no valid rate events after id mapping")
+        users = np.array([k[0] for k in latest], np.int64)
+        items = np.array([k[1] for k in latest], np.int64)
+        vals = np.array([e.rating for e in latest.values()], np.float32)
+        factors = als_train_coo(
+            users,
+            items,
+            vals,
+            n_users=len(user_map),
+            n_items=len(item_map),
+            cfg=ALSConfig(
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                lambda_=self.params.lambda_,
+                implicit_prefs=False,
+                seed=self.params.seed,
+            ),
+        )
+        return ECommerceModel(
+            rank=self.params.rank,
+            user_factors=np.asarray(factors.user_factors),
+            item_factors=np.asarray(factors.item_factors),
+            user_map=user_map,
+            item_map=item_map,
+            items={item_map[i]: item for i, item in pd.items.items()},
+        )
+
+    # -- live filters (ALSAlgorithm.scala:160-215) ------------------------
+    def _seen_items(self, user: str) -> Set[str]:
+        if not self.params.unseen_only:
+            return set()
+        try:
+            store = get_registry().get_events()
+            deadline = time.monotonic() + LIVE_READ_TIMEOUT_S
+            seen: Set[str] = set()
+            for e in store.find_single_entity(
+                self.params.app_id,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+                target_entity_type="item",
+            ):
+                if e.target_entity_id is not None:
+                    seen.add(e.target_entity_id)
+                if time.monotonic() > deadline:
+                    logger.error("Timeout reading seen events for %s", user)
+                    break
+            return seen
+        except Exception as exc:
+            logger.error("Error when read seen events: %s", exc)
+            return set()
+
+    def _unavailable_items(self) -> Set[str]:
+        try:
+            store = get_registry().get_events()
+            events = list(
+                store.find_single_entity(
+                    self.params.app_id,
+                    entity_type="constraint",
+                    entity_id="unavailableItems",
+                    event_names=["$set"],
+                    limit=1,
+                    latest=True,
+                )
+            )
+            if events:
+                return set(events[0].properties.get("items") or ())
+            return set()
+        except Exception as exc:
+            logger.error("Error when read set unavailableItems event: %s", exc)
+            return set()
+
+    # -- predict (ALSAlgorithm.scala:148-281) -----------------------------
+    def predict(self, model: ECommerceModel, query: Query) -> PredictedResult:
+        black = set(query.black_list or ())
+        final_black = black | self._seen_items(query.user) | self._unavailable_items()
+        black_idx = {
+            model.item_map.get(x)
+            for x in final_black
+            if model.item_map.get(x) is not None
+        }
+        white_idx: Optional[Set[int]] = None
+        if query.white_list is not None:
+            white_idx = {
+                model.item_map.get(x)
+                for x in query.white_list
+                if model.item_map.get(x) is not None
+            }
+
+        uidx = model.user_map.get(query.user)
+        if uidx is not None:
+            scores = model.item_factors @ model.user_factors[uidx]
+        else:
+            # new user: cosine against recent views (predictNewUser)
+            logger.info("No userFeature found for user %s", query.user)
+            recent = self._recent_view_items(query.user)
+            recent_idx = [
+                model.item_map.get(x)
+                for x in recent
+                if model.item_map.get(x) is not None
+            ]
+            if not recent_idx:
+                return PredictedResult(item_scores=())
+            f = model.item_factors
+            unit = f / np.maximum(np.linalg.norm(f, axis=1, keepdims=True), 1e-12)
+            scores = unit @ unit[recent_idx].sum(axis=0)
+
+        excluded = np.zeros((model.item_factors.shape[0],), bool)
+        for i in black_idx:
+            excluded[i] = True
+        if white_idx is not None:
+            mask = np.ones_like(excluded)
+            for i in white_idx:
+                mask[i] = False
+            excluded |= mask
+        if query.categories is not None:
+            want = set(query.categories)
+            for i in range(excluded.shape[0]):
+                if not want.intersection(model.items.get(i, Item()).categories):
+                    excluded[i] = True
+
+        scores = np.where(excluded | (scores <= 0), -np.inf, scores)
+        k = min(query.num, int(np.isfinite(scores).sum()))
+        if k <= 0:
+            return PredictedResult(item_scores=())
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top])]
+        inv = model.item_map.inverse
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=inv[int(i)], score=float(scores[i]))
+                for i in top
+                if np.isfinite(scores[i])
+            )
+        )
+
+    def _recent_view_items(self, user: str) -> List[str]:
+        """Latest 10 viewed items (``predictNewUser``,
+        ``ALSAlgorithm.scala:294-323``)."""
+        try:
+            store = get_registry().get_events()
+            return [
+                e.target_entity_id
+                for e in store.find_single_entity(
+                    self.params.app_id,
+                    entity_type="user",
+                    entity_id=user,
+                    event_names=["view"],
+                    target_entity_type="item",
+                    limit=10,
+                    latest=True,
+                )
+                if e.target_entity_id is not None
+            ]
+        except Exception as exc:
+            logger.error("Error when read recent events: %s", exc)
+            return []
+
+    def query_class(self):
+        return Query
+
+
+def engine_factory() -> Engine:
+    """``ECommerceRecommendationEngine`` (template ``Engine.scala``)."""
+    return Engine(
+        {"": ECommerceDataSource},
+        {"": IdentityPreparator},
+        {"als": ECommerceALSAlgorithm},
+        {"": FirstServing},
+    )

@@ -1,0 +1,358 @@
+"""Similar-product engine template (multi-algorithm ensemble).
+
+Rebuild of ``examples/scala-parallel-similarproduct/multi/src/main/scala/``:
+
+- DataSource reads ``$set`` user/item entities (items carry ``categories``),
+  "view" events and "like"/"dislike" events (``DataSource.scala``);
+- ``ALSAlgorithm`` trains implicit ALS over deduplicated view counts and
+  scores similarity as summed cosine between query-item factors and all item
+  factors (``ALSAlgorithm.scala:76-205``);
+- ``LikeAlgorithm`` re-trains on like/dislike (latest event per (user, item)
+  wins; like→1, dislike→−1) (``LikeAlgorithm.scala:17-90``);
+- Serving z-score-standardizes each algorithm's scores (skipped when
+  ``num == 1``) and sums per item (``Serving.scala:14-53``).
+
+TPU restatement: both algorithms share the ALS kernel
+(:mod:`predictionio_tpu.ops.als`, implicit mode); predict is one device
+matvec over unit-normalized factor tables
+(:func:`predictionio_tpu.ops.scoring.top_k_for_vectors`); the ensemble
+standardization is :func:`predictionio_tpu.ops.scoring.standardize`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    IdentityPreparator,
+    Params,
+    Serving,
+)
+from ..ops.als import ALSConfig, als_train_coo
+from ..storage import BiMap, EventFilter, get_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    """``Item(categories)`` (template's DataSource)."""
+
+    categories: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """``Query(items, num, categories, whiteList, blackList)``."""
+
+    items: Tuple[str, ...]
+    num: int = 10
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "items", tuple(self.items))
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...]
+
+
+@dataclasses.dataclass
+class ViewEvent:
+    user: str
+    item: str
+    t: int  # millis
+
+
+@dataclasses.dataclass
+class LikeEvent:
+    user: str
+    item: str
+    t: int
+    like: bool
+
+
+@dataclasses.dataclass
+class TrainingData:
+    users: Dict[str, None]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+    like_events: List[LikeEvent]
+
+    def sanity_check(self) -> None:
+        if not self.items:
+            raise ValueError("similarproduct TrainingData has no items")
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarProductDataSourceParams(Params):
+    app_id: int = 1
+
+
+class SimilarProductDataSource(DataSource):
+    """``$set`` entities + view + like/dislike streams
+    (multi ``DataSource.scala``)."""
+
+    params_class = SimilarProductDataSourceParams
+
+    def __init__(
+        self,
+        params: SimilarProductDataSourceParams = SimilarProductDataSourceParams(),
+    ):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        store = get_registry().get_events()
+        app_id = self.params.app_id
+        users = {
+            uid: None
+            for uid in store.aggregate_properties(app_id, "user").keys()
+        }
+        items = {
+            iid: Item(categories=tuple(props.get("categories") or ()))
+            for iid, props in store.aggregate_properties(app_id, "item").items()
+        }
+        views: List[ViewEvent] = []
+        likes: List[LikeEvent] = []
+        for e in store.find(
+            app_id,
+            EventFilter(
+                entity_type="user",
+                event_names=["view", "like", "dislike"],
+            ),
+        ):
+            if e.target_entity_id is None:
+                continue
+            t = int(e.event_time.timestamp() * 1000)
+            if e.event == "view":
+                views.append(ViewEvent(e.entity_id, e.target_entity_id, t))
+            else:
+                likes.append(
+                    LikeEvent(
+                        e.entity_id, e.target_entity_id, t, e.event == "like"
+                    )
+                )
+        return TrainingData(
+            users=users, items=items, view_events=views, like_events=likes
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarALSParams(Params):
+    """``ALSAlgorithmParams(rank, numIterations, lambda, seed)``."""
+
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class SimilarALSModel:
+    """Item-factor table + id maps (``ALSModel``,
+    ``ALSAlgorithm.scala:25-53``); only ``productFeatures`` is needed for
+    similarity scoring."""
+
+    item_factors: np.ndarray  # [I, R]
+    item_map: BiMap
+    items: Dict[int, Item]
+
+    def sanity_check(self) -> None:
+        if not np.isfinite(self.item_factors).all():
+            raise ValueError("SimilarALSModel factors are non-finite")
+
+
+def _candidate_mask(
+    model: SimilarALSModel,
+    query: Query,
+    query_idx: Sequence[int],
+) -> np.ndarray:
+    """True = excluded. Mirrors ``isCandidateItem``: drop query items
+    themselves, category-mismatched, non-whitelisted, blacklisted."""
+    n = model.item_factors.shape[0]
+    excluded = np.zeros((n,), bool)
+    excluded[list(query_idx)] = True
+    if query.categories is not None:
+        want = set(query.categories)
+        for i in range(n):
+            cats = model.items.get(i, Item()).categories
+            if not want.intersection(cats):
+                excluded[i] = True
+    if query.white_list is not None:
+        allowed = {
+            model.item_map.get(it)
+            for it in query.white_list
+            if model.item_map.get(it) is not None
+        }
+        for i in range(n):
+            if i not in allowed:
+                excluded[i] = True
+    if query.black_list is not None:
+        for it in query.black_list:
+            idx = model.item_map.get(it)
+            if idx is not None:
+                excluded[idx] = True
+    return excluded
+
+
+class SimilarALSAlgorithm(Algorithm):
+    """Implicit ALS over view counts; cosine-sum similarity predict
+    (``ALSAlgorithm.scala:76-252``)."""
+
+    params_class = SimilarALSParams
+
+    def __init__(self, params: SimilarALSParams = SimilarALSParams()):
+        self.params = params
+
+    # -- train ------------------------------------------------------------
+    def _ratings(self, pd: TrainingData) -> List[Tuple[str, str, float]]:
+        """view count per (user, item) (``ALSAlgorithm.scala:98-119``)."""
+        counts: Dict[Tuple[str, str], float] = {}
+        for v in pd.view_events:
+            counts[(v.user, v.item)] = counts.get((v.user, v.item), 0.0) + 1.0
+        return [(u, i, c) for (u, i), c in counts.items()]
+
+    def train(self, ctx, pd: TrainingData) -> SimilarALSModel:
+        triplets = self._ratings(pd)
+        if not triplets:
+            raise ValueError(
+                "similarproduct training events are empty; check DataSource"
+            )
+        user_map = BiMap.string_int(pd.users.keys())
+        item_map = BiMap.string_int(pd.items.keys())
+        valid = [
+            (user_map.get(u), item_map.get(i), r)
+            for u, i, r in triplets
+            if user_map.get(u) is not None and item_map.get(i) is not None
+        ]
+        users = np.array([v[0] for v in valid], np.int64)
+        items = np.array([v[1] for v in valid], np.int64)
+        vals = np.array([v[2] for v in valid], np.float32)
+        factors = als_train_coo(
+            users,
+            items,
+            vals,
+            n_users=len(user_map),
+            n_items=len(item_map),
+            cfg=ALSConfig(
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                lambda_=self.params.lambda_,
+                implicit_prefs=True,
+                alpha=1.0,
+                seed=self.params.seed,
+            ),
+        )
+        items_by_idx = {
+            item_map[i]: item for i, item in pd.items.items()
+        }
+        return SimilarALSModel(
+            item_factors=np.asarray(factors.item_factors),
+            item_map=item_map,
+            items=items_by_idx,
+        )
+
+    # -- predict ----------------------------------------------------------
+    def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
+        query_idx = [
+            model.item_map.get(it)
+            for it in query.items
+            if model.item_map.get(it) is not None
+        ]
+        if not query_idx:
+            return PredictedResult(item_scores=())
+        f = model.item_factors
+        norms = np.linalg.norm(f, axis=1, keepdims=True)
+        unit = f / np.maximum(norms, 1e-12)
+        # Σ_q cos(q, i) = (Σ_q unit_q) · unit_i — one matvec
+        qvec = unit[query_idx].sum(axis=0)
+        scores = unit @ qvec
+        excluded = _candidate_mask(model, query, query_idx)
+        scores = np.where(excluded | (scores <= 0), -np.inf, scores)
+        k = min(query.num, (np.isfinite(scores)).sum())
+        if k <= 0:
+            return PredictedResult(item_scores=())
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top])]
+        inv = model.item_map.inverse
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=inv[int(i)], score=float(scores[i]))
+                for i in top
+                if np.isfinite(scores[i])
+            )
+        )
+
+    def query_class(self):
+        return Query
+
+
+class LikeAlgorithm(SimilarALSAlgorithm):
+    """Same model over like/dislike signals: latest event per (user, item)
+    wins; like→1, dislike→−1 (``LikeAlgorithm.scala:44-90``). Negative
+    ratings act as high-confidence zero-preference in the implicit solver."""
+
+    def _ratings(self, pd: TrainingData) -> List[Tuple[str, str, float]]:
+        latest: Dict[Tuple[str, str], LikeEvent] = {}
+        for e in pd.like_events:
+            key = (e.user, e.item)
+            if key not in latest or e.t > latest[key].t:
+                latest[key] = e
+        return [
+            (e.user, e.item, 1.0 if e.like else -1.0) for e in latest.values()
+        ]
+
+
+class SimilarProductServing(Serving):
+    """Z-score standardize per algorithm (unless ``num == 1``), sum by item,
+    top-``num`` (``Serving.scala:14-53``)."""
+
+    def serve(
+        self, query: Query, predictions: Sequence[PredictedResult]
+    ) -> PredictedResult:
+        standardized: List[Tuple[str, float]] = []
+        for pr in predictions:
+            scores = np.array([s.score for s in pr.item_scores], np.float64)
+            if query.num == 1 or scores.size == 0:
+                z = scores
+            else:
+                std = scores.std()
+                z = (
+                    np.zeros_like(scores)
+                    if std == 0
+                    else (scores - scores.mean()) / std
+                )
+            standardized.extend(
+                (s.item, float(zv)) for s, zv in zip(pr.item_scores, z)
+            )
+        combined: Dict[str, float] = {}
+        for item, score in standardized:
+            combined[item] = combined.get(item, 0.0) + score
+        ranked = sorted(combined.items(), key=lambda kv: -kv[1])[: query.num]
+        return PredictedResult(
+            item_scores=tuple(ItemScore(item=i, score=s) for i, s in ranked)
+        )
+
+
+def engine_factory() -> Engine:
+    """``SimilarProductEngine`` (multi ``Engine.scala``: ``Map("als" -> …,
+    "likealgo" -> …)``)."""
+    return Engine(
+        {"": SimilarProductDataSource},
+        {"": IdentityPreparator},
+        {"als": SimilarALSAlgorithm, "likealgo": LikeAlgorithm},
+        {"": SimilarProductServing},
+    )

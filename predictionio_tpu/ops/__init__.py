@@ -11,6 +11,7 @@ from .als import (
     predict_pairs,
     rmse,
 )
+from . import classifier, forest, markov, naive_bayes
 from .scoring import (
     standardize,
     top_k_for_users,
@@ -20,6 +21,10 @@ from .scoring import (
 
 __all__ = [
     "ALSConfig",
+    "classifier",
+    "forest",
+    "markov",
+    "naive_bayes",
     "ALSFactors",
     "BucketedMatrix",
     "als_train",

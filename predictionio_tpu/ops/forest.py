@@ -1,0 +1,265 @@
+"""Random forest classifier on TPU.
+
+The reference template's second algorithm wraps MLlib
+``RandomForest.trainClassifier`` (``examples/scala-parallel-classification/
+add-algorithm/src/main/scala/RandomForestAlgorithm.scala:28-41``) with params
+``numClasses, numTrees, featureSubsetStrategy, impurity, maxDepth, maxBins``.
+
+MLlib grows trees node-queue style with per-partition histogram aggregation.
+The TPU-native formulation keeps the same statistical recipe — quantile-bin
+histograms, gini/entropy split search, per-node feature subsets, bootstrap
+bagging — but grows ALL nodes of a level for ALL trees in one fixed-shape
+step:
+
+- samples carry a ``node_id`` per tree; a level step is one scatter-add into
+  a ``[T, nodes, D, B, C]`` histogram cube, one vectorized gain argmax, and
+  one gather to route samples down — no host control flow, shapes static
+  across the whole build, so XLA compiles a single fused program;
+- trees live in a dense complete-binary-tree layout (``feature``,
+  ``threshold`` per internal node, class histogram per node), so batched
+  prediction is ``max_depth`` gathers.
+
+Bins are global per-feature quantiles (MLlib also bins once up front).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ForestConfig:
+    """``RandomForestAlgorithmParams`` analogue (defaults from the
+    template's engine.json)."""
+
+    num_classes: int = 2
+    num_trees: int = 10
+    feature_subset_strategy: str = "auto"  # auto | all | sqrt | log2 | onethird
+    impurity: str = "gini"  # gini | entropy
+    max_depth: int = 4
+    max_bins: int = 32
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class RandomForestModel:
+    """Dense complete-binary-tree ensemble.
+
+    Internal nodes ``0 .. 2^depth-2``; node ``i``'s children are ``2i+1``,
+    ``2i+2``. ``leaf_probs[t, leaf]`` are class distributions at depth
+    ``max_depth``; prediction = argmax of the mean over trees (majority
+    vote, as MLlib classification does).
+    """
+
+    feature: np.ndarray  # [T, I] int32 split feature per internal node
+    threshold: np.ndarray  # [T, I] float32 split threshold
+    leaf_probs: np.ndarray  # [T, L, C] float32
+    class_values: np.ndarray  # [C] original label values
+    max_depth: int
+
+    def predict(self, features: Sequence[float]) -> float:
+        return float(self.predict_batch(np.asarray(features)[None])[0])
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        """[N, D] → [N] label values: ``max_depth`` gathers per tree,
+        vote across trees."""
+        probs = _predict_probs(
+            jnp.asarray(features, jnp.float32),
+            jnp.asarray(self.feature),
+            jnp.asarray(self.threshold),
+            jnp.asarray(self.leaf_probs),
+            self.max_depth,
+        )
+        return self.class_values[np.asarray(jnp.argmax(probs, axis=1))]
+
+    def sanity_check(self) -> None:
+        # +inf thresholds are the "unsplittable node" sentinel (route left);
+        # only NaN indicates a broken build.
+        if np.isnan(self.threshold).any():
+            raise ValueError("RandomForestModel has NaN thresholds")
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_probs(x, feature, threshold, leaf_probs, max_depth):
+    n = x.shape[0]
+    t = feature.shape[0]
+    node = jnp.zeros((t, n), jnp.int32)
+    for _ in range(max_depth):
+        f = jnp.take_along_axis(feature, node, axis=1)  # [T, N]
+        thr = jnp.take_along_axis(threshold, node, axis=1)
+        xv = x[jnp.arange(n)[None, :], f]  # [T, N]
+        node = 2 * node + 1 + (xv > thr).astype(jnp.int32)
+    leaf = node - (2**max_depth - 1)
+    probs = jnp.take_along_axis(
+        leaf_probs, leaf[:, :, None], axis=1
+    )  # [T, N, C]
+    return probs.mean(axis=0)  # [N, C]
+
+
+def _impurity_from_hist(h: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """h[..., C] class counts → impurity[...] (gini or entropy)."""
+    tot = h.sum(axis=-1, keepdims=True)
+    p = h / jnp.maximum(tot, 1.0)
+    if kind == "entropy":
+        return -(jnp.where(p > 0, p * jnp.log(p), 0.0)).sum(axis=-1)
+    return 1.0 - (p * p).sum(axis=-1)  # gini
+
+
+def _features_per_node(strategy: str, d: int) -> int:
+    s = strategy.lower()
+    if s in ("all",):
+        return d
+    if s in ("sqrt", "auto"):  # MLlib auto = sqrt for classification
+        return max(1, int(np.sqrt(d)))
+    if s == "log2":
+        return max(1, int(np.log2(d)))
+    if s == "onethird":
+        return max(1, d // 3)
+    raise ValueError(f"Unknown featureSubsetStrategy: {strategy}")
+
+
+def train(
+    features: np.ndarray,  # [N, D]
+    labels: np.ndarray,  # [N] label values
+    config: ForestConfig = ForestConfig(),
+    class_values: Optional[np.ndarray] = None,
+) -> RandomForestModel:
+    """Grow the ensemble level-by-level with fixed-shape device steps."""
+    x_np = np.asarray(features, np.float32)
+    labels = np.asarray(labels)
+    n, d = x_np.shape
+    if n == 0:
+        raise ValueError("Cannot train a random forest on an empty dataset")
+
+    if class_values is None:
+        class_values, label_idx = np.unique(labels, return_inverse=True)
+    else:
+        class_values = np.asarray(class_values)
+        label_idx = np.searchsorted(class_values, labels)
+    c = max(config.num_classes, class_values.shape[0])
+
+    # Global per-feature quantile bin edges [D, B-1] (MLlib findSplits).
+    b = min(config.max_bins, max(2, n))
+    qs = np.linspace(0, 1, b + 1)[1:-1]
+    edges = np.quantile(x_np, qs, axis=0).T.astype(np.float32)  # [D, B-1]
+    # binned[n, d] = number of edges < x  (so bin k means edges[k-1] < x <= edges[k])
+    binned = (x_np[:, :, None] > edges[None]).sum(axis=2).astype(np.int32)
+
+    t = config.num_trees
+    depth = config.max_depth
+    n_internal = 2**depth - 1
+    n_leaves = 2**depth
+    k_feats = _features_per_node(config.feature_subset_strategy, d)
+
+    key = jax.random.PRNGKey(config.seed)
+    boot_key, feat_key = jax.random.split(key)
+    # bootstrap sample indices per tree [T, N]
+    boot = jax.random.randint(boot_key, (t, n), 0, n, dtype=jnp.int32)
+
+    xb = jnp.asarray(binned)  # [N, D] bin ids
+    xe = jnp.asarray(edges)  # [D, B-1]
+    yl = jnp.asarray(label_idx, jnp.int32)
+
+    @functools.partial(jax.jit, static_argnames=("level",))
+    def level_step(level, node, sample_idx, sample_y, feat_arr, thr_arr, fkey):
+        """One level for all trees: histogram → best split → route down."""
+        n_nodes = 2**level
+        first = n_nodes - 1  # first node id at this level
+        local = node - first  # [T, N] in [0, n_nodes)
+
+        # class histograms per (tree, node, feature, bin)
+        tree_ix = jnp.broadcast_to(jnp.arange(t)[:, None, None], (t, n, d))
+        node_ix = jnp.broadcast_to(local[:, :, None], (t, n, d))
+        feat_ix = jnp.broadcast_to(jnp.arange(d)[None, None, :], (t, n, d))
+        bins = xb[sample_idx]  # [T, N, D]
+        ys = jnp.broadcast_to(sample_y[:, :, None], (t, n, d))
+        hist = jnp.zeros((t, n_nodes, d, b, c), jnp.float32).at[
+            tree_ix.reshape(-1),
+            node_ix.reshape(-1),
+            feat_ix.reshape(-1),
+            bins.reshape(-1),
+            ys.reshape(-1),
+        ].add(1.0)
+
+        # split gain for each candidate boundary (after bin k, k=0..B-2)
+        left = jnp.cumsum(hist, axis=3)[:, :, :, :-1, :]  # [T,Nn,D,B-1,C]
+        total = hist.sum(axis=3)[:, :, :, None, :]  # [T,Nn,D,1,C]
+        right = total - left
+        lt = left.sum(axis=-1)
+        rt = right.sum(axis=-1)
+        nt = jnp.maximum(lt + rt, 1.0)
+        child_imp = (
+            lt * _impurity_from_hist(left, config.impurity)
+            + rt * _impurity_from_hist(right, config.impurity)
+        ) / nt  # [T,Nn,D,B-1]
+        parent_imp = _impurity_from_hist(total[:, :, :, 0, :], config.impurity)
+        gain = parent_imp[..., None] - child_imp  # [T,Nn,D,B-1]
+        # invalid splits (empty side) get no gain
+        gain = jnp.where((lt > 0) & (rt > 0), gain, -jnp.inf)
+
+        # per-(tree,node) random feature subset (MLlib per-node subsetting)
+        if k_feats < d:
+            scores = jax.random.uniform(fkey, (t, n_nodes, d))
+            kth = jnp.sort(scores, axis=2)[:, :, k_feats - 1][:, :, None]
+            gain = jnp.where((scores <= kth)[..., None], gain, -jnp.inf)
+
+        flat = gain.reshape(t, n_nodes, d * (b - 1))
+        best = jnp.argmax(flat, axis=2)  # [T, Nn]
+        best_gain = jnp.take_along_axis(flat, best[:, :, None], axis=2)[..., 0]
+        bf = (best // (b - 1)).astype(jnp.int32)  # feature
+        bb = best % (b - 1)  # boundary index
+        bthr = xe[bf, bb]  # [T, Nn]
+        # nodes with no valid split: route everything left via +inf threshold
+        bthr = jnp.where(jnp.isfinite(best_gain), bthr, jnp.inf)
+
+        feat_arr = feat_arr.at[:, first : first + n_nodes].set(bf)
+        thr_arr = thr_arr.at[:, first : first + n_nodes].set(bthr)
+
+        # route samples: compare raw value to threshold
+        xv = jnp.take_along_axis(
+            jnp.asarray(x_np)[sample_idx],  # [T, N, D]
+            jnp.take_along_axis(bf, local, axis=1)[:, :, None],
+            axis=2,
+        )[..., 0]
+        thr_s = jnp.take_along_axis(bthr, local, axis=1)
+        node = 2 * node + 1 + (xv > thr_s).astype(jnp.int32)
+        return node, feat_arr, thr_arr
+
+    node = jnp.zeros((t, n), jnp.int32)
+    sample_y = yl[boot]  # [T, N]
+    feat_arr = jnp.zeros((t, n_internal), jnp.int32)
+    thr_arr = jnp.full((t, n_internal), jnp.inf, jnp.float32)
+    for level in range(depth):
+        fkey = jax.random.fold_in(feat_key, level)
+        node, feat_arr, thr_arr = level_step(
+            level, node, boot, sample_y, feat_arr, thr_arr, fkey
+        )
+
+    # leaf class distributions
+    leaf = node - (2**depth - 1)  # [T, N]
+    tree_ix = jnp.broadcast_to(jnp.arange(t)[:, None], (t, n))
+
+    @jax.jit
+    def leaf_hist(leaf, sample_y):
+        return jnp.zeros((t, n_leaves, c), jnp.float32).at[
+            tree_ix.reshape(-1), leaf.reshape(-1), sample_y.reshape(-1)
+        ].add(1.0)
+
+    lh = leaf_hist(leaf, sample_y)
+    probs = lh / jnp.maximum(lh.sum(axis=2, keepdims=True), 1.0)
+
+    cv = np.zeros((c,), dtype=np.asarray(class_values).dtype)
+    cv[: class_values.shape[0]] = class_values
+    return RandomForestModel(
+        feature=np.asarray(feat_arr),
+        threshold=np.asarray(thr_arr),
+        leaf_probs=np.asarray(probs),
+        class_values=cv,
+        max_depth=depth,
+    )

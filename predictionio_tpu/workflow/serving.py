@@ -34,11 +34,14 @@ import random
 import string
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Sequence, Tuple
 from urllib.parse import urlparse
 
+import requests
+
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
-from ..controller.engine import Engine, EngineParams, WorkflowParams
+from ..controller.engine import Engine, EngineParams
 from ..storage import StorageRegistry, utcnow
 from ..storage.metadata import STATUS_COMPLETED, EngineInstance
 from .context import WorkflowContext
@@ -198,44 +201,42 @@ class QueryDecodeError(ValueError):
 class _QueryHandler(JsonHTTPHandler):
     server: "QueryServer"
 
-    _respond = JsonHTTPHandler.respond
-
     def do_POST(self) -> None:  # noqa: N802
         raw = self.read_body()
         path = urlparse(self.path).path
         if path != "/queries.json":
-            self._respond(404, {"message": "Not Found"})
+            self.respond(404, {"message": "Not Found"})
             return
         try:
             payload = json.loads(raw.decode("utf-8")) if raw else {}
         except ValueError as exc:
-            self._respond(400, {"message": str(exc)})
+            self.respond(400, {"message": str(exc)})
             return
         try:
             result, status = self.server.handle_query(payload)
-            self._respond(status, result)
+            self.respond(status, result)
         except QueryDecodeError as exc:
-            self._respond(400, {"message": str(exc)})
+            self.respond(400, {"message": str(exc)})
         except Exception as exc:
             logger.exception("Query failed")
-            self._respond(500, {"message": str(exc)})
+            self.respond(500, {"message": str(exc)})
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
         if path == "/":
-            self._respond(200, self.server.status_html(), content_type="text/html")
+            self.respond(200, self.server.status_html(), content_type="text/html")
         elif path == "/reload":
             try:
                 self.server.reload()
-                self._respond(200, {"message": "Reloaded"})
+                self.respond(200, {"message": "Reloaded"})
             except Exception as exc:
                 logger.exception("Reload failed")
-                self._respond(500, {"message": str(exc)})
+                self.respond(500, {"message": str(exc)})
         elif path == "/stop":
-            self._respond(200, {"message": "Shutting down"})
+            self.respond(200, {"message": "Shutting down"})
             self.server.stop_async()
         else:
-            self._respond(404, {"message": "Not Found"})
+            self.respond(404, {"message": "Not Found"})
 
 
 class QueryServer(BackgroundHTTPServer):
@@ -257,6 +258,11 @@ class QueryServer(BackgroundHTTPServer):
         self._deploy_lock = threading.RLock()
         self.deployment = deployment or prepare_deployment(
             engine, registry, config, self.ctx
+        )
+        # Bounded async feedback delivery (CreateServer's fire-and-forget
+        # future, without unbounded thread growth under load).
+        self._feedback_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="feedback"
         )
         # Serving stats (CreateServer.scala:392-394,567-574)
         self._stats_lock = threading.Lock()
@@ -331,8 +337,6 @@ class QueryServer(BackgroundHTTPServer):
 
         def post() -> None:
             try:
-                import requests
-
                 resp = requests.post(url, json=data, timeout=10)
                 if resp.status_code != 201:
                     logger.error(
@@ -343,12 +347,13 @@ class QueryServer(BackgroundHTTPServer):
             except Exception as exc:
                 logger.error("Feedback event failed: %s", exc)
 
-        threading.Thread(target=post, daemon=True).start()
+        self._feedback_pool.submit(post)
 
         # Stamp the generated prId into the response only for predictions
         # that carry a prId slot (CreateServer.scala:558-565).
         if _has_pr_id(prediction) and isinstance(result, dict):
             result = dict(result)
+            result.pop("pr_id", None)  # replace the stale slot, don't duplicate
             result["prId"] = new_pr_id
         return result
 

@@ -1,0 +1,187 @@
+"""e2 engine library tests: CategoricalNaiveBayes + MarkovChain.
+
+Mirrors the reference suites
+(``e2/src/test/scala/io/prediction/e2/engine/CategoricalNaiveBayesTest.scala``
+and ``MarkovChainTest.scala``) with the same fruit / transition-matrix
+fixtures and expected values.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import markov, naive_bayes
+from predictionio_tpu.ops.naive_bayes import LabeledPoint
+
+TOL = 1e-4
+
+BANANA, ORANGE, OTHER = "Banana", "Orange", "Other Fruit"
+NOT_LONG, LONG = "Not Long", "Long"
+NOT_SWEET, SWEET = "Not Sweet", "Sweet"
+NOT_YELLOW, YELLOW = "Not Yellow", "Yellow"
+
+FRUIT_POINTS = [
+    LabeledPoint(BANANA, (LONG, SWEET, YELLOW)),
+    LabeledPoint(BANANA, (LONG, SWEET, YELLOW)),
+    LabeledPoint(BANANA, (LONG, SWEET, YELLOW)),
+    LabeledPoint(BANANA, (LONG, SWEET, YELLOW)),
+    LabeledPoint(BANANA, (NOT_LONG, NOT_SWEET, NOT_YELLOW)),
+    LabeledPoint(ORANGE, (NOT_LONG, SWEET, NOT_YELLOW)),
+    LabeledPoint(ORANGE, (NOT_LONG, NOT_SWEET, NOT_YELLOW)),
+    LabeledPoint(OTHER, (LONG, SWEET, NOT_YELLOW)),
+    LabeledPoint(OTHER, (NOT_LONG, SWEET, NOT_YELLOW)),
+    LabeledPoint(OTHER, (LONG, SWEET, YELLOW)),
+    LabeledPoint(OTHER, (NOT_LONG, NOT_SWEET, NOT_YELLOW)),
+]
+
+
+@pytest.fixture(scope="module")
+def fruit_model():
+    return naive_bayes.train(FRUIT_POINTS)
+
+
+class TestCategoricalNaiveBayes:
+    def _prior(self, m, label):
+        return m.log_priors[m.label_vocab[label]]
+
+    def _lik(self, m, label, slot, value):
+        return m.log_likelihoods[slot][m.label_vocab[label], m.feature_vocabs[slot][value]]
+
+    def test_priors_and_likelihoods(self, fruit_model):
+        m = fruit_model
+        assert self._prior(m, BANANA) == pytest.approx(-0.7885, abs=TOL)
+        assert self._prior(m, ORANGE) == pytest.approx(-1.7047, abs=TOL)
+        assert self._prior(m, OTHER) == pytest.approx(-1.0116, abs=TOL)
+
+        assert self._lik(m, BANANA, 0, LONG) == pytest.approx(-0.2231, abs=TOL)
+        assert self._lik(m, BANANA, 0, NOT_LONG) == pytest.approx(-1.6094, abs=TOL)
+        assert self._lik(m, BANANA, 1, SWEET) == pytest.approx(-0.2231, abs=TOL)
+        assert self._lik(m, BANANA, 1, NOT_SWEET) == pytest.approx(-1.6094, abs=TOL)
+        assert self._lik(m, BANANA, 2, YELLOW) == pytest.approx(-0.2231, abs=TOL)
+        assert self._lik(m, BANANA, 2, NOT_YELLOW) == pytest.approx(-1.6094, abs=TOL)
+
+        # Orange never saw Long/Yellow: those cells are -inf (the reference
+        # simply has no map entry)
+        assert self._lik(m, ORANGE, 0, LONG) == -math.inf
+        assert self._lik(m, ORANGE, 0, NOT_LONG) == pytest.approx(0.0, abs=TOL)
+        assert self._lik(m, ORANGE, 1, SWEET) == pytest.approx(-0.6931, abs=TOL)
+        assert self._lik(m, ORANGE, 1, NOT_SWEET) == pytest.approx(-0.6931, abs=TOL)
+        assert self._lik(m, ORANGE, 2, NOT_YELLOW) == pytest.approx(0.0, abs=TOL)
+        assert self._lik(m, ORANGE, 2, YELLOW) == -math.inf
+
+        assert self._lik(m, OTHER, 0, LONG) == pytest.approx(-0.6931, abs=TOL)
+        assert self._lik(m, OTHER, 1, SWEET) == pytest.approx(-0.2877, abs=TOL)
+        assert self._lik(m, OTHER, 1, NOT_SWEET) == pytest.approx(-1.3863, abs=TOL)
+        assert self._lik(m, OTHER, 2, YELLOW) == pytest.approx(-1.3863, abs=TOL)
+        assert self._lik(m, OTHER, 2, NOT_YELLOW) == pytest.approx(-0.2877, abs=TOL)
+
+    def test_log_score(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, (LONG, NOT_SWEET, NOT_YELLOW))
+        )
+        assert score == pytest.approx(-4.2304, abs=TOL)
+
+    def test_log_score_unknown_feature_is_neg_inf(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, (LONG, NOT_SWEET, "Not Exist"))
+        )
+        assert score == -math.inf
+
+    def test_log_score_unknown_label_is_none(self, fruit_model):
+        assert (
+            fruit_model.log_score(
+                LabeledPoint("Not Exist", (LONG, NOT_SWEET, YELLOW))
+            )
+            is None
+        )
+
+    def test_log_score_default_likelihood(self, fruit_model):
+        # reference: ls => ls.min - log(2)
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, (LONG, NOT_SWEET, "Not Exist")),
+            lambda ls: min(ls) - math.log(2),
+        )
+        assert score is not None and np.isfinite(score)
+        # slot-2 fallback = min(Banana slot-2 likelihoods) - log 2
+        expected = (
+            fruit_model.log_priors[fruit_model.label_vocab[BANANA]]
+            + fruit_model.log_likelihoods[0][
+                fruit_model.label_vocab[BANANA],
+                fruit_model.feature_vocabs[0][LONG],
+            ]
+            + fruit_model.log_likelihoods[1][
+                fruit_model.label_vocab[BANANA],
+                fruit_model.feature_vocabs[1][NOT_SWEET],
+            ]
+            + (-1.6094 - math.log(2))
+        )
+        assert score == pytest.approx(expected, abs=TOL)
+
+    def test_predict(self, fruit_model):
+        assert fruit_model.predict((LONG, SWEET, YELLOW)) == BANANA
+        assert fruit_model.predict((NOT_LONG, SWEET, NOT_YELLOW)) == OTHER
+
+    def test_predict_batch_matches_predict(self, fruit_model):
+        m = fruit_model
+        pts = [p.features for p in FRUIT_POINTS]
+        fids = np.array(
+            [[m.feature_vocabs[i][f[i]] for i in range(3)] for f in pts],
+            np.int32,
+        )
+        batch = m.predict_batch(fids)
+        labels = m.labels
+        for f, li in zip(pts, batch):
+            assert labels[int(li)] == m.predict(f)
+
+    def test_empty_and_ragged_raise(self):
+        with pytest.raises(ValueError):
+            naive_bayes.train([])
+        with pytest.raises(ValueError):
+            naive_bayes.train(
+                [LabeledPoint("a", ("x",)), LabeledPoint("b", ("x", "y"))]
+            )
+
+
+TWO_BY_TWO = [(0, 0, 3.0), (0, 1, 7.0), (1, 0, 10.0), (1, 1, 10.0)]
+FIVE_BY_FIVE = [
+    (0, 1, 12.0), (0, 2, 8.0),
+    (1, 0, 3.0), (1, 1, 3.0), (1, 2, 9.0), (1, 3, 2.0), (1, 4, 8.0),
+    (2, 1, 10.0), (2, 2, 8.0), (2, 4, 10.0),
+    (3, 0, 2.0), (3, 3, 3.0), (3, 4, 4.0),
+    (4, 1, 7.0), (4, 3, 8.0), (4, 4, 10.0),
+]
+
+
+def _row_as_dict(model, s):
+    return {
+        int(i): float(p)
+        for i, p in zip(model.indices[s], model.probs[s])
+        if p > 0
+    }
+
+
+class TestMarkovChain:
+    def test_two_by_two_full(self):
+        model = markov.train(TWO_BY_TWO, top_n=2)
+        assert model.n == 2
+        assert _row_as_dict(model, 0) == pytest.approx({0: 0.3, 1: 0.7})
+        assert _row_as_dict(model, 1) == pytest.approx({0: 0.5, 1: 0.5})
+
+    def test_five_by_five_top2(self):
+        # expected values from MarkovChainTest.scala:26-39
+        model = markov.train(FIVE_BY_FIVE, top_n=2)
+        assert _row_as_dict(model, 0) == pytest.approx({1: 0.6, 2: 0.4})
+        assert _row_as_dict(model, 1) == pytest.approx({2: 9 / 25, 4: 8 / 25})
+        assert _row_as_dict(model, 2) == pytest.approx({1: 10 / 28, 4: 10 / 28})
+        assert _row_as_dict(model, 3) == pytest.approx({3: 3 / 9, 4: 4 / 9})
+        assert _row_as_dict(model, 4) == pytest.approx({3: 8 / 25, 4: 0.4})
+
+    def test_predict(self):
+        model = markov.train(TWO_BY_TWO, top_n=2)
+        next_state = model.predict([0.4, 0.6])
+        assert next_state == pytest.approx([0.42, 0.58], abs=1e-6)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            markov.train([], top_n=2)

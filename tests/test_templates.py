@@ -1,0 +1,432 @@
+"""Template tests: classification, similarproduct, ecommerce.
+
+Each template runs the full DASE path end-to-end against an in-process
+event store — the analogue of the reference templates' quickstart flows
+(SURVEY §2.6) — asserting both dataflow wiring and model quality on
+deterministic synthetic events.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.storage import Event, StorageRegistry
+from predictionio_tpu.workflow.context import WorkflowContext
+
+from predictionio_tpu.models import classification, ecommerce, similarproduct
+
+APP_ID = 1
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+@pytest.fixture()
+def registry(tmp_path, monkeypatch):
+    reg = StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+    import predictionio_tpu.storage.registry as regmod
+
+    monkeypatch.setattr(regmod, "_default_registry", reg)
+    reg.get_events().init(APP_ID)
+    return reg
+
+
+@pytest.fixture()
+def ctx():
+    return WorkflowContext(mode="Test")
+
+
+def _t(minutes):
+    return T0 + dt.timedelta(minutes=minutes)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+def ingest_classification(reg, n_per_class=40):
+    """Users whose attr proportions determine their plan."""
+    store = reg.get_events()
+    rng = np.random.default_rng(7)
+    base = {0.0: [20, 2, 2], 1.0: [2, 20, 2], 2.0: [2, 2, 20]}
+    uid = 0
+    for plan, b in base.items():
+        for _ in range(n_per_class):
+            attrs = rng.poisson(b).astype(float)
+            store.insert(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id=f"u{uid}",
+                    properties={
+                        "plan": plan,
+                        "attr0": float(attrs[0]),
+                        "attr1": float(attrs[1]),
+                        "attr2": float(attrs[2]),
+                    },
+                    event_time=_t(uid),
+                ),
+                APP_ID,
+            )
+            uid += 1
+    # one user missing a required property — must be skipped
+    store.insert(
+        Event(
+            event="$set",
+            entity_type="user",
+            entity_id="incomplete",
+            properties={"plan": 0.0, "attr0": 1.0},
+            event_time=_t(uid),
+        ),
+        APP_ID,
+    )
+    return 3 * n_per_class
+
+
+class TestClassificationTemplate:
+    def test_datasource_skips_incomplete(self, registry, ctx):
+        n = ingest_classification(registry)
+        td = classification.ClassificationDataSource().read_training(ctx)
+        assert td.features.shape == (n, 3)
+        assert set(np.unique(td.labels)) == {0.0, 1.0, 2.0}
+
+    def test_engine_trains_both_algorithms(self, registry, ctx):
+        ingest_classification(registry)
+        engine = classification.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", classification.ClassificationDataSourceParams()),
+            algorithm_params_list=[
+                ("naive", classification.NaiveBayesParams(lam=1.0)),
+                (
+                    "randomforest",
+                    classification.RandomForestParams(
+                        num_classes=3, num_trees=8, max_depth=4,
+                        feature_subset_strategy="all",
+                    ),
+                ),
+            ],
+        )
+        models = engine.train(ctx, ep)
+        assert len(models) == 2
+        algos = engine._algorithms(ep)
+        # both algorithms should classify the class-0 prototype correctly
+        q = classification.Query(features=(20.0, 2.0, 2.0))
+        for algo, model in zip(algos, models):
+            assert algo.predict(model, q).label == 0.0
+
+    def test_batch_predict_matches_predict(self, registry, ctx):
+        ingest_classification(registry)
+        algo = classification.NaiveBayesAlgorithm()
+        td = classification.ClassificationDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        queries = [
+            classification.Query(features=tuple(td.features[i]))
+            for i in range(10)
+        ]
+        batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+        for i, q in enumerate(queries):
+            assert batched[i] == algo.predict(model, q)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+
+def ingest_similarproduct(reg):
+    """Two item clusters: users view within their cluster; likes mirror
+    cluster membership."""
+    store = reg.get_events()
+    items_a = [f"a{i}" for i in range(6)]
+    items_b = [f"b{i}" for i in range(6)]
+    for it in items_a:
+        store.insert(
+            Event(event="$set", entity_type="item", entity_id=it,
+                  properties={"categories": ["alpha"]}, event_time=_t(0)),
+            APP_ID,
+        )
+    for it in items_b:
+        store.insert(
+            Event(event="$set", entity_type="item", entity_id=it,
+                  properties={"categories": ["beta"]}, event_time=_t(0)),
+            APP_ID,
+        )
+    rng = np.random.default_rng(3)
+    minute = 1
+    for u in range(24):
+        uid = f"u{u}"
+        store.insert(
+            Event(event="$set", entity_type="user", entity_id=uid,
+                  event_time=_t(0)),
+            APP_ID,
+        )
+        pool = items_a if u % 2 == 0 else items_b
+        for it in rng.choice(pool, size=5, replace=False):
+            # repeated views strengthen the implicit-confidence signal
+            for _ in range(int(rng.integers(2, 5))):
+                store.insert(
+                    Event(event="view", entity_type="user", entity_id=uid,
+                          target_entity_type="item", target_entity_id=str(it),
+                          event_time=_t(minute)),
+                    APP_ID,
+                )
+            store.insert(
+                Event(event="like", entity_type="user", entity_id=uid,
+                      target_entity_type="item", target_entity_id=str(it),
+                      event_time=_t(minute)),
+                APP_ID,
+            )
+            minute += 1
+    return items_a, items_b
+
+
+class TestSimilarProductTemplate:
+    def test_similar_items_stay_in_cluster(self, registry, ctx):
+        items_a, items_b = ingest_similarproduct(registry)
+        engine = similarproduct.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", similarproduct.SimilarProductDataSourceParams()),
+            algorithm_params_list=[
+                ("als", similarproduct.SimilarALSParams(
+                    rank=8, num_iterations=15, seed=1)),
+            ],
+        )
+        models = engine.train(ctx, ep)
+        algo = engine._algorithms(ep)[0]
+        result = algo.predict(
+            models[0], similarproduct.Query(items=("a0",), num=3)
+        )
+        assert len(result.item_scores) == 3
+        top = [s.item for s in result.item_scores]
+        assert "a0" not in top  # query item excluded
+        assert sum(t.startswith("a") for t in top) >= 2, top
+
+    def test_category_and_blacklist_filters(self, registry, ctx):
+        ingest_similarproduct(registry)
+        algo = similarproduct.SimilarALSAlgorithm(
+            similarproduct.SimilarALSParams(rank=8, num_iterations=10, seed=1)
+        )
+        td = similarproduct.SimilarProductDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        res = algo.predict(
+            model,
+            similarproduct.Query(
+                items=("a0",), num=10, categories=("beta",)
+            ),
+        )
+        assert all(s.item.startswith("b") for s in res.item_scores)
+        res = algo.predict(
+            model,
+            similarproduct.Query(items=("a0",), num=10, black_list=("a1", "a2")),
+        )
+        assert not {"a1", "a2"}.intersection(s.item for s in res.item_scores)
+
+    def test_unknown_query_item_empty(self, registry, ctx):
+        ingest_similarproduct(registry)
+        algo = similarproduct.SimilarALSAlgorithm()
+        td = similarproduct.SimilarProductDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        res = algo.predict(model, similarproduct.Query(items=("nope",)))
+        assert res.item_scores == ()
+
+    def test_ensemble_serving_zscore_sum(self, registry, ctx):
+        ingest_similarproduct(registry)
+        engine = similarproduct.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", similarproduct.SimilarProductDataSourceParams()),
+            algorithm_params_list=[
+                ("als", similarproduct.SimilarALSParams(
+                    rank=8, num_iterations=10, seed=1)),
+                ("likealgo", similarproduct.SimilarALSParams(
+                    rank=8, num_iterations=10, seed=2)),
+            ],
+        )
+        models = engine.train(ctx, ep)
+        assert len(models) == 2
+        algos = engine._algorithms(ep)
+        serving = engine._serving(ep)
+        q = similarproduct.Query(items=("a0", "a1"), num=4)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        combined = serving.serve(q, preds)
+        assert 0 < len(combined.item_scores) <= 4
+        # scores are standardized sums, descending
+        scores = [s.score for s in combined.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_serving_zero_std_returns_zero(self):
+        serving = similarproduct.SimilarProductServing()
+        pr = similarproduct.PredictedResult(
+            item_scores=(
+                similarproduct.ItemScore("x", 2.0),
+                similarproduct.ItemScore("y", 2.0),
+            )
+        )
+        out = serving.serve(similarproduct.Query(items=("q",), num=2), [pr])
+        assert all(s.score == 0.0 for s in out.item_scores)
+
+
+# ---------------------------------------------------------------------------
+# ecommerce
+# ---------------------------------------------------------------------------
+
+
+def ingest_ecommerce(reg):
+    store = reg.get_events()
+    items = [f"i{i}" for i in range(8)]
+    for it in items:
+        store.insert(
+            Event(event="$set", entity_type="item", entity_id=it,
+                  properties={"categories": ["cat1" if int(it[1:]) < 4 else "cat2"]},
+                  event_time=_t(0)),
+            APP_ID,
+        )
+    rng = np.random.default_rng(5)
+    minute = 1
+    for u in range(12):
+        uid = f"u{u}"
+        store.insert(
+            Event(event="$set", entity_type="user", entity_id=uid,
+                  event_time=_t(0)),
+            APP_ID,
+        )
+        likes_low = u % 2 == 0
+        for it in items:
+            pref = int(it[1:]) < 4
+            rating = 5.0 if pref == likes_low else 1.0
+            rating += float(rng.normal(0, 0.2))
+            store.insert(
+                Event(event="rate", entity_type="user", entity_id=uid,
+                      target_entity_type="item", target_entity_id=it,
+                      properties={"rating": rating}, event_time=_t(minute)),
+                APP_ID,
+            )
+            minute += 1
+    return items
+
+
+class TestECommerceTemplate:
+    def _algo(self, unseen_only=False, **kw):
+        kw.setdefault("rank", 8)
+        kw.setdefault("num_iterations", 15)
+        kw.setdefault("seed", 1)
+        return ecommerce.ECommerceALSAlgorithm(
+            ecommerce.ECommerceALSParams(
+                app_id=APP_ID, unseen_only=unseen_only, **kw,
+            )
+        )
+
+    def test_known_user_recommendations(self, registry, ctx):
+        ingest_ecommerce(registry)
+        algo = self._algo()
+        td = ecommerce.ECommerceDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        res = algo.predict(model, ecommerce.Query(user="u0", num=3))
+        assert len(res.item_scores) == 3
+        # u0 likes low-numbered items
+        assert sum(int(s.item[1:]) < 4 for s in res.item_scores) >= 2
+
+    def test_unseen_only_filters_rated(self, registry, ctx):
+        ingest_ecommerce(registry)
+        store = registry.get_events()
+        # u0 has "seen" (bought) i0 and i1
+        for it in ("i0", "i1"):
+            store.insert(
+                Event(event="buy", entity_type="user", entity_id="u0",
+                      target_entity_type="item", target_entity_id=it,
+                      event_time=_t(500)),
+                APP_ID,
+            )
+        algo = self._algo(unseen_only=True)
+        td = ecommerce.ECommerceDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        res = algo.predict(model, ecommerce.Query(user="u0", num=8))
+        assert not {"i0", "i1"}.intersection(s.item for s in res.item_scores)
+
+    def test_unavailable_items_constraint(self, registry, ctx):
+        ingest_ecommerce(registry)
+        store = registry.get_events()
+        store.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties={"items": ["i2", "i3"]}, event_time=_t(600)),
+            APP_ID,
+        )
+        algo = self._algo()
+        td = ecommerce.ECommerceDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        res = algo.predict(model, ecommerce.Query(user="u0", num=8))
+        assert not {"i2", "i3"}.intersection(s.item for s in res.item_scores)
+        # a newer $set supersedes the old constraint (latest wins)
+        store.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties={"items": []}, event_time=_t(700)),
+            APP_ID,
+        )
+        res = algo.predict(model, ecommerce.Query(user="u0", num=8))
+        items = {s.item for s in res.item_scores}
+        assert {"i2", "i3"}.intersection(items) or len(items) > 0
+
+    def test_new_user_falls_back_to_recent_views(self, registry, ctx):
+        ingest_ecommerce(registry)
+        store = registry.get_events()
+        algo = self._algo()
+        td = ecommerce.ECommerceDataSource().read_training(ctx)
+        model = algo.train(ctx, td)
+        # unknown user with no views → empty
+        res = algo.predict(model, ecommerce.Query(user="ghost", num=3))
+        assert res.item_scores == ()
+        # unknown user with recent views of low-numbered items
+        for it in ("i0", "i1"):
+            store.insert(
+                Event(event="view", entity_type="user", entity_id="ghost",
+                      target_entity_type="item", target_entity_id=it,
+                      event_time=_t(800)),
+                APP_ID,
+            )
+        res = algo.predict(model, ecommerce.Query(user="ghost", num=3))
+        assert len(res.item_scores) > 0
+
+    def test_latest_rating_wins(self, registry, ctx):
+        store = registry.get_events()
+        for eid in ("u0", "u1"):
+            store.insert(
+                Event(event="$set", entity_type="user", entity_id=eid,
+                      event_time=_t(0)),
+                APP_ID,
+            )
+        for eid in ("i0", "i1"):
+            store.insert(
+                Event(event="$set", entity_type="item", entity_id=eid,
+                      event_time=_t(0)),
+                APP_ID,
+            )
+        # u0 rates i0 twice: 1.0 then 5.0 — the 5.0 must win
+        store.insert(
+            Event(event="rate", entity_type="user", entity_id="u0",
+                  target_entity_type="item", target_entity_id="i0",
+                  properties={"rating": 1.0}, event_time=_t(1)),
+            APP_ID,
+        )
+        store.insert(
+            Event(event="rate", entity_type="user", entity_id="u0",
+                  target_entity_type="item", target_entity_id="i0",
+                  properties={"rating": 5.0}, event_time=_t(2)),
+            APP_ID,
+        )
+        store.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 3.0}, event_time=_t(3)),
+            APP_ID,
+        )
+        algo = self._algo(num_iterations=5)
+        td = ecommerce.ECommerceDataSource().read_training(ctx)
+        assert len(td.rate_events) == 3
+        latest = {}
+        for r in td.rate_events:
+            key = (r.user, r.item)
+            if key not in latest or r.t > latest[key].t:
+                latest[key] = r
+        assert latest[("u0", "i0")].rating == 5.0
