@@ -1,0 +1,13 @@
+"""Operator tools: the ``pio``-equivalent CLI, runners, export/import, dashboard.
+
+Rebuild of ``tools/src/main/scala/io/prediction/tools/`` — the console
+(``console/Console.scala``), the spark-submit assemblers
+(``RunWorkflow.scala`` / ``RunServer.scala``, here plain Python subprocesses),
+engine registration (``RegisterEngine.scala``), event export/import
+(``export/EventsToFile.scala`` / ``imprt/FileToEvents.scala``) and the
+evaluation dashboard (``dashboard/Dashboard.scala``).
+"""
+
+from .register import EngineDir, generate_manifest, register_engine
+
+__all__ = ["EngineDir", "generate_manifest", "register_engine"]

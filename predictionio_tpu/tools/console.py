@@ -1,0 +1,481 @@
+"""The ``pio`` console: operator CLI for the whole framework.
+
+Rebuild of ``tools/src/main/scala/io/prediction/tools/console/Console.scala``
+(scopt grammar ``:122-558``, dispatch ``:582-644``) plus the app/accesskey
+consoles (``console/{App,AccessKey}.scala``).  Subcommands:
+
+    app new|list|show|delete|data-delete
+    accesskey new|list|delete
+    build                      — verify + register the engine project
+    train | eval               — run the training / evaluation workflow
+    deploy | undeploy          — query server lifecycle (undeploy = GET /stop)
+    eventserver | dashboard    — REST servers
+    status                     — storage verification (Storage.scala:230-250)
+    export | import            — events ↔ JSON-lines files
+    template list|get          — scaffold a bundled engine template
+
+Process model: the reference launches train/deploy as separate JVMs via
+spark-submit (``RunWorkflow.scala:103-169``); here ``--spawn`` runs them as
+``python -m predictionio_tpu.tools.run_workflow`` / ``run_server`` child
+processes with the same metadata-store handshake, and the default is
+in-process (the simplification called out in SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import secrets
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+from ..storage import StorageRegistry, get_registry
+from ..storage.metadata import AccessKey, App
+from . import register as register_mod
+from . import run_server, run_workflow
+
+EXIT_OK = 0
+EXIT_FAIL = 1
+
+
+# ---------------------------------------------------------------------------
+# app / accesskey consoles (console/App.scala, console/AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+
+def app_new(
+    registry: StorageRegistry,
+    name: str,
+    app_id: Optional[int] = None,
+    access_key: Optional[str] = None,
+    description: Optional[str] = None,
+) -> dict:
+    """``pio app new`` (``App.scala:33-77``): create app, init its event
+    store, mint a default access key valid for all events."""
+    md = registry.get_metadata()
+    if md.app_get_by_name(name) is not None:
+        raise ValueError(f"App {name!r} already exists")
+    new_id = md.app_insert(
+        App(id=app_id or 0, name=name, description=description)
+    )
+    if new_id is None:
+        raise ValueError(f"Could not create app {name!r} (id conflict?)")
+    registry.get_events().init(new_id)
+    key = access_key or secrets.token_urlsafe(32)
+    md.access_key_insert(AccessKey(key=key, appid=new_id, events=()))
+    return {"name": name, "id": new_id, "accessKey": key}
+
+
+def app_list(registry: StorageRegistry) -> List[dict]:
+    md = registry.get_metadata()
+    out = []
+    for app in sorted(md.app_get_all(), key=lambda a: a.name):
+        keys = [ak.key for ak in md.access_key_get_by_app(app.id)]
+        out.append({"name": app.name, "id": app.id, "accessKeys": keys})
+    return out
+
+
+def app_show(registry: StorageRegistry, name: str) -> dict:
+    md = registry.get_metadata()
+    app = md.app_get_by_name(name)
+    if app is None:
+        raise KeyError(f"App {name!r} not found")
+    keys = [
+        {"key": ak.key, "events": list(ak.events)}
+        for ak in md.access_key_get_by_app(app.id)
+    ]
+    return {
+        "name": app.name,
+        "id": app.id,
+        "description": app.description,
+        "accessKeys": keys,
+    }
+
+
+def app_delete(registry: StorageRegistry, name: str) -> dict:
+    """``pio app delete``: remove app + keys + event data (``App.scala:79-120``)."""
+    md = registry.get_metadata()
+    app = md.app_get_by_name(name)
+    if app is None:
+        raise KeyError(f"App {name!r} not found")
+    registry.get_events().remove(app.id)
+    for ak in md.access_key_get_by_app(app.id):
+        md.access_key_delete(ak.key)
+    md.app_delete(app.id)
+    return {"name": name, "id": app.id, "deleted": True}
+
+
+def app_data_delete(registry: StorageRegistry, name: str) -> dict:
+    """``pio app data-delete``: wipe + re-init the app's event store
+    (``App.scala:122-141``)."""
+    md = registry.get_metadata()
+    app = md.app_get_by_name(name)
+    if app is None:
+        raise KeyError(f"App {name!r} not found")
+    ev = registry.get_events()
+    ev.remove(app.id)
+    ev.init(app.id)
+    return {"name": name, "id": app.id, "dataDeleted": True}
+
+
+def accesskey_new(
+    registry: StorageRegistry,
+    app_name: str,
+    events: Sequence[str] = (),
+    key: Optional[str] = None,
+) -> dict:
+    md = registry.get_metadata()
+    app = md.app_get_by_name(app_name)
+    if app is None:
+        raise KeyError(f"App {app_name!r} not found")
+    new_key = key or secrets.token_urlsafe(32)
+    md.access_key_insert(AccessKey(key=new_key, appid=app.id, events=tuple(events)))
+    return {"app": app_name, "accessKey": new_key, "events": list(events)}
+
+
+def accesskey_list(
+    registry: StorageRegistry, app_name: Optional[str] = None
+) -> List[dict]:
+    md = registry.get_metadata()
+    apps = (
+        [a for a in [md.app_get_by_name(app_name)] if a is not None]
+        if app_name
+        else md.app_get_all()
+    )
+    out = []
+    for app in apps:
+        for ak in md.access_key_get_by_app(app.id):
+            out.append(
+                {"key": ak.key, "app": app.name, "events": list(ak.events)}
+            )
+    return out
+
+
+def accesskey_delete(registry: StorageRegistry, key: str) -> dict:
+    if not registry.get_metadata().access_key_delete(key):
+        raise KeyError(f"Access key {key!r} not found")
+    return {"accessKey": key, "deleted": True}
+
+
+# ---------------------------------------------------------------------------
+# undeploy / status (Console.scala:798-824, :930-986)
+# ---------------------------------------------------------------------------
+
+
+def undeploy(ip: str = "localhost", port: int = 8000) -> dict:
+    """HTTP GET /stop against a running query server."""
+    url = f"http://{ip}:{port}/stop"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return {"url": url, "status": resp.status}
+    except (urllib.error.URLError, OSError) as exc:
+        raise RuntimeError(f"Nothing to undeploy at {url}: {exc}") from exc
+
+
+def status(registry: StorageRegistry) -> dict:
+    """``pio status``: verify every storage repository with live operations."""
+    results = registry.verify_all_data_objects()
+    return {"storage": results, "ok": all(results.values())}
+
+
+# ---------------------------------------------------------------------------
+# CLI grammar + dispatch
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="PredictionIO-TPU operator console"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    app = sub.add_parser("app", help="manage apps")
+    app_sub = app.add_subparsers(dest="app_command", required=True)
+    ap_new = app_sub.add_parser("new")
+    ap_new.add_argument("name")
+    ap_new.add_argument("--id", type=int, default=None)
+    ap_new.add_argument("--access-key", default=None)
+    ap_new.add_argument("--description", default=None)
+    app_sub.add_parser("list")
+    for nm in ("show", "delete", "data-delete"):
+        sp = app_sub.add_parser(nm)
+        sp.add_argument("name")
+        if nm != "show":
+            sp.add_argument("--force", "-f", action="store_true")
+
+    ak = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = ak.add_subparsers(dest="accesskey_command", required=True)
+    ak_new = ak_sub.add_parser("new")
+    ak_new.add_argument("app_name")
+    ak_new.add_argument("events", nargs="*")
+    ak_list = ak_sub.add_parser("list")
+    ak_list.add_argument("app_name", nargs="?", default=None)
+    ak_del = ak_sub.add_parser("delete")
+    ak_del.add_argument("key")
+
+    build = sub.add_parser("build", help="verify + register engine project")
+    build.add_argument("--engine-dir", default=".")
+
+    train = sub.add_parser("train", help="run the training workflow")
+    for flag, kw in _WORKFLOW_FLAGS:
+        train.add_argument(flag, **kw)
+    train.add_argument("--spawn", action="store_true")
+
+    ev = sub.add_parser("eval", help="run an evaluation")
+    ev.add_argument("evaluation_class")
+    ev.add_argument("engine_params_generator_class", nargs="?", default=None)
+    for flag, kw in _WORKFLOW_FLAGS:
+        ev.add_argument(flag, **kw)
+    ev.add_argument("--spawn", action="store_true")
+
+    dp = sub.add_parser("deploy", help="serve the latest trained instance")
+    dp.add_argument("--engine-dir", default=".")
+    dp.add_argument("--engine-instance-id", default=None)
+    dp.add_argument("--ip", default="localhost")
+    dp.add_argument("--port", type=int, default=8000)
+    dp.add_argument("--feedback", action="store_true")
+    dp.add_argument("--event-server-ip", default="localhost")
+    dp.add_argument("--event-server-port", type=int, default=7070)
+    dp.add_argument("--accesskey", default=None)
+    dp.add_argument("--batch", default="")
+    dp.add_argument("--spawn", action="store_true")
+
+    ud = sub.add_parser("undeploy", help="stop a running query server")
+    ud.add_argument("--ip", default="localhost")
+    ud.add_argument("--port", type=int, default=8000)
+
+    es = sub.add_parser("eventserver", help="run the event REST server")
+    es.add_argument("--ip", default="localhost")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+
+    db = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    db.add_argument("--ip", default="localhost")
+    db.add_argument("--port", type=int, default=9000)
+
+    sub.add_parser("status", help="verify storage backends")
+
+    ex = sub.add_parser("export", help="export app events to JSON-lines")
+    ex.add_argument("--appid", type=int, required=True)
+    ex.add_argument("--output", required=True)
+
+    im = sub.add_parser("import", help="import JSON-lines events into an app")
+    im.add_argument("--appid", type=int, required=True)
+    im.add_argument("--input", required=True)
+
+    tp = sub.add_parser("template", help="scaffold a bundled engine template")
+    tp_sub = tp.add_subparsers(dest="template_command", required=True)
+    tp_sub.add_parser("list")
+    tp_get = tp_sub.add_parser("get")
+    tp_get.add_argument("template_name")
+    tp_get.add_argument("directory")
+    return p
+
+
+_WORKFLOW_FLAGS = [
+    ("--engine-dir", {"default": "."}),
+    ("--engine-variant", {"default": "engine.json"}),
+    ("--engine-params-key", {"default": None}),
+    ("--batch", {"default": ""}),
+    ("--verbose", {"action": "store_true"}),
+    ("--skip-sanity-check", {"action": "store_true"}),
+    ("--stop-after-read", {"action": "store_true"}),
+    ("--stop-after-prepare", {"action": "store_true"}),
+]
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def _spawn(module: str, argv: Sequence[str]) -> int:
+    """Child-process launch, the spark-submit analogue
+    (``RunWorkflow.scala:103-169``)."""
+    return subprocess.call([sys.executable, "-m", module, *argv])
+
+
+def _workflow_argv(args: argparse.Namespace, extra: Sequence[str] = ()) -> List[str]:
+    argv = [
+        "--engine-dir", args.engine_dir,
+        "--engine-variant", args.engine_variant,
+        "--batch", args.batch,
+    ]
+    if args.engine_params_key:
+        argv += ["--engine-params-key", args.engine_params_key]
+    for flag in ("verbose", "skip_sanity_check", "stop_after_read", "stop_after_prepare"):
+        if getattr(args, flag):
+            argv.append("--" + flag.replace("_", "-"))
+    return argv + list(extra)
+
+
+def main(
+    argv: Optional[Sequence[str]] = None,
+    registry: Optional[StorageRegistry] = None,
+) -> int:
+    args = build_parser().parse_args(argv)
+    registry = registry or get_registry()
+    try:
+        return _dispatch(args, registry)
+    except KeyboardInterrupt:
+        return EXIT_FAIL
+    except Exception as exc:  # every operator error → JSON + exit 1
+        _emit({"error": str(exc)})
+        return EXIT_FAIL
+
+
+def _confirm_destructive(args: argparse.Namespace, action: str) -> bool:
+    """``App.scala:79-120``: destructive app commands prompt 'YES' unless
+    --force; non-interactive invocations must pass --force explicitly."""
+    if args.force:
+        return True
+    if not sys.stdin.isatty():
+        _emit({"error": f"refusing to {action} without --force (non-interactive)"})
+        return False
+    answer = input(f"About to {action}. Enter 'YES' to proceed: ")
+    if answer != "YES":
+        _emit({"error": "aborted"})
+        return False
+    return True
+
+
+def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
+    cmd = args.command
+    if cmd == "app":
+        sub = args.app_command
+        if sub == "new":
+            _emit(app_new(registry, args.name, args.id, args.access_key, args.description))
+        elif sub == "list":
+            _emit(app_list(registry))
+        elif sub == "show":
+            _emit(app_show(registry, args.name))
+        elif sub == "delete":
+            if not _confirm_destructive(args, f"delete app {args.name!r} and ALL its data"):
+                return EXIT_FAIL
+            _emit(app_delete(registry, args.name))
+        elif sub == "data-delete":
+            if not _confirm_destructive(args, f"delete ALL event data of app {args.name!r}"):
+                return EXIT_FAIL
+            _emit(app_data_delete(registry, args.name))
+        return EXIT_OK
+
+    if cmd == "accesskey":
+        sub = args.accesskey_command
+        if sub == "new":
+            _emit(accesskey_new(registry, args.app_name, args.events))
+        elif sub == "list":
+            _emit(accesskey_list(registry, args.app_name))
+        elif sub == "delete":
+            _emit(accesskey_delete(registry, args.key))
+        return EXIT_OK
+
+    if cmd == "build":
+        ed = register_mod.register_engine(registry, args.engine_dir)
+        _emit({"engineId": ed.manifest.id, "engineVersion": ed.manifest.version})
+        return EXIT_OK
+
+    if cmd == "train":
+        register_mod.register_engine(registry, args.engine_dir, verify_import=False)
+        if args.spawn:
+            return _spawn("predictionio_tpu.tools.run_workflow", _workflow_argv(args))
+        wf_args = run_workflow.build_parser().parse_args(_workflow_argv(args))
+        instance_id = run_workflow.run(wf_args, registry)
+        _emit({"engineInstanceId": instance_id})
+        return EXIT_OK
+
+    if cmd == "eval":
+        extra = ["--evaluation-class", args.evaluation_class]
+        if args.engine_params_generator_class:
+            extra += [
+                "--engine-params-generator-class",
+                args.engine_params_generator_class,
+            ]
+        if args.spawn:
+            return _spawn(
+                "predictionio_tpu.tools.run_workflow", _workflow_argv(args, extra)
+            )
+        wf_args = run_workflow.build_parser().parse_args(_workflow_argv(args, extra))
+        instance_id = run_workflow.run(wf_args, registry)
+        _emit({"evaluationInstanceId": instance_id})
+        return EXIT_OK
+
+    if cmd == "deploy":
+        srv_argv = [
+            "--engine-dir", args.engine_dir,
+            "--ip", args.ip,
+            "--port", str(args.port),
+            "--event-server-ip", args.event_server_ip,
+            "--event-server-port", str(args.event_server_port),
+            "--batch", args.batch,
+        ]
+        if args.engine_instance_id:
+            srv_argv += ["--engine-instance-id", args.engine_instance_id]
+        if args.feedback:
+            srv_argv.append("--feedback")
+        if args.accesskey:
+            srv_argv += ["--accesskey", args.accesskey]
+        if args.spawn:
+            return _spawn("predictionio_tpu.tools.run_server", srv_argv)
+        srv_args = run_server.build_parser().parse_args(srv_argv)
+        run_server.make_server(srv_args, registry, block=True)
+        return EXIT_OK
+
+    if cmd == "undeploy":
+        _emit(undeploy(args.ip, args.port))
+        return EXIT_OK
+
+    if cmd == "eventserver":
+        from ..api.event_server import EventServerConfig, create_event_server
+
+        create_event_server(
+            EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+            registry=registry,
+            block=True,
+        )
+        return EXIT_OK
+
+    if cmd == "dashboard":
+        from .dashboard import DashboardConfig, create_dashboard
+
+        create_dashboard(
+            DashboardConfig(ip=args.ip, port=args.port), registry, block=True
+        )
+        return EXIT_OK
+
+    if cmd == "status":
+        result = status(registry)
+        _emit(result)
+        return EXIT_OK if result["ok"] else EXIT_FAIL
+
+    if cmd == "export":
+        from .export_events import export_events
+
+        with open(args.output, "w", encoding="utf-8") as fh:
+            n = export_events(registry, args.appid, fh)
+        _emit({"appId": args.appid, "events": n, "output": args.output})
+        return EXIT_OK
+
+    if cmd == "import":
+        from .import_events import import_events
+
+        with open(args.input, "r", encoding="utf-8") as fh:
+            n = import_events(registry, args.appid, fh)
+        _emit({"appId": args.appid, "events": n, "input": args.input})
+        return EXIT_OK
+
+    if cmd == "template":
+        from .templates import get_template, list_templates
+
+        if args.template_command == "list":
+            _emit(list_templates())
+        else:
+            _emit(get_template(args.template_name, args.directory))
+        return EXIT_OK
+
+    raise ValueError(f"Unknown command {cmd!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Evaluation dashboard: HTML list of completed evaluations + drill-down.
+
+Rebuild of ``tools/.../dashboard/Dashboard.scala`` (spray server on :9000
+listing completed ``EvaluationInstance`` rows newest-first, with per-instance
+HTML and JSON result pages) and ``CorsSupport.scala`` (CORS headers on every
+response so external UIs can consume the JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import html
+import sys
+from typing import Optional, Sequence
+from urllib.parse import urlparse
+
+from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..storage import StorageRegistry, get_registry
+
+DEFAULT_PORT = 9000  # Dashboard.scala default
+
+
+@dataclasses.dataclass(frozen=True)
+class DashboardConfig:
+    ip: str = "localhost"
+    port: int = DEFAULT_PORT
+
+
+def _fmt_time(dt) -> str:
+    return dt.strftime("%Y-%m-%d %H:%M:%S")
+
+
+def render_index(instances) -> str:
+    """The main listing page (``Dashboard.scala`` index route)."""
+    rows = []
+    for inst in instances:
+        rows.append(
+            "<tr>"
+            f"<td>{html.escape(inst.id)}</td>"
+            f"<td>{html.escape(inst.evaluation_class)}</td>"
+            f"<td>{html.escape(inst.engine_params_generator_class)}</td>"
+            f"<td>{html.escape(inst.batch)}</td>"
+            f"<td>{_fmt_time(inst.start_time)}</td>"
+            f"<td>{_fmt_time(inst.end_time)}</td>"
+            f"<td>{html.escape(inst.evaluator_results)}</td>"
+            f'<td><a href="/engine_instances/{inst.id}/evaluator_results.html">HTML</a> '
+            f'<a href="/engine_instances/{inst.id}/evaluator_results.json">JSON</a></td>'
+            "</tr>"
+        )
+    return (
+        "<!DOCTYPE html><html><head><title>PredictionIO-TPU Dashboard</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+        "<h1>Completed evaluations</h1>"
+        "<table><tr><th>ID</th><th>Evaluation</th><th>Params generator</th>"
+        "<th>Batch</th><th>Start</th><th>End</th><th>Result</th><th>Detail</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+class _DashboardHandler(JsonHTTPHandler):
+    server: "DashboardServer"
+
+    def end_headers(self) -> None:
+        # CorsSupport.scala: allow-all origin on every response.
+        self.send_header("Access-Control-Allow-Origin", "*")
+        super().end_headers()
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        md = self.server.registry.get_metadata()
+        if path == "/":
+            instances = md.evaluation_instance_get_completed()
+            self.respond(200, render_index(instances), content_type="text/html")
+            return
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 3 and parts[0] == "engine_instances":
+            inst = md.evaluation_instance_get(parts[1])
+            if inst is None:
+                self.respond(404, {"message": f"{parts[1]} not found"})
+                return
+            if parts[2] == "evaluator_results.html":
+                self.respond(
+                    200, inst.evaluator_results_html or "<html></html>",
+                    content_type="text/html",
+                )
+                return
+            if parts[2] == "evaluator_results.json":
+                self.respond(
+                    200, inst.evaluator_results_json or "{}",
+                    content_type="application/json; charset=utf-8",
+                )
+                return
+        self.respond(404, {"message": "Not Found"})
+
+
+class DashboardServer(BackgroundHTTPServer):
+    def __init__(self, config: DashboardConfig, registry: StorageRegistry):
+        self.config = config
+        self.registry = registry
+        super().__init__((config.ip, config.port), _DashboardHandler)
+
+
+def create_dashboard(
+    config: DashboardConfig = DashboardConfig(),
+    registry: Optional[StorageRegistry] = None,
+    block: bool = True,
+) -> DashboardServer:
+    registry = registry or get_registry()
+    server = DashboardServer(config, registry)
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+    else:
+        server.start_background()
+    return server
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="dashboard")
+    p.add_argument("--ip", default="localhost")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = p.parse_args(argv)
+    create_dashboard(DashboardConfig(ip=args.ip, port=args.port), block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

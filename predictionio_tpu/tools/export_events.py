@@ -1,0 +1,49 @@
+"""Event export: app's events → JSON-lines file.
+
+Rebuild of ``tools/.../export/EventsToFile.scala`` (``PEvents.find`` → one
+JSON document per line via SQLContext there; a streamed JSON-lines writer
+here — same on-disk format as the reference's ``--format json`` mode, so
+files round-trip between the two).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence, TextIO
+
+from ..storage import EventFilter, StorageRegistry, get_registry
+
+
+def export_events(
+    registry: StorageRegistry,
+    app_id: int,
+    out: TextIO,
+    event_filter: Optional[EventFilter] = None,
+) -> int:
+    """Stream every matching event as one JSON object per line; returns the
+    number of events written."""
+    store = registry.get_events()
+    count = 0
+    for event in store.find(app_id, event_filter or EventFilter()):
+        out.write(json.dumps(event.to_json_dict(), separators=(",", ":")))
+        out.write("\n")
+        count += 1
+    return count
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="export_events")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--output", required=True)
+    args = p.parse_args(argv)
+    registry = get_registry()
+    with open(args.output, "w", encoding="utf-8") as fh:
+        n = export_events(registry, args.appid, fh)
+    print(json.dumps({"appId": args.appid, "events": n, "output": args.output}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

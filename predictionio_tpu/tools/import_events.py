@@ -1,0 +1,74 @@
+"""Event import: JSON-lines file → app's event store.
+
+Rebuild of ``tools/.../imprt/FileToEvents.scala`` (read json lines →
+``PEvents.write``): each line is one event document; invalid lines abort with
+the offending line number (the reference fails the Spark job on first parse
+error).  Uses the store's bulk ``write`` path, which on the native backend is
+a single columnar append batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, Optional, Sequence
+
+from ..storage import Event, StorageRegistry, get_registry
+from ..storage.event import validate_event
+
+
+class ImportError_(ValueError):
+    """A line failed to parse/validate."""
+
+
+def _parse_lines(lines: Iterable[str]) -> Iterable[Event]:
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = Event.from_json_dict(json.loads(line))
+            validate_event(event)
+        except Exception as exc:
+            raise ImportError_(f"line {lineno}: {exc}") from exc
+        yield event
+
+
+def import_events(
+    registry: StorageRegistry,
+    app_id: int,
+    lines: Iterable[str],
+    batch_size: int = 1000,
+) -> int:
+    """Bulk-insert events in batches; returns the number imported."""
+    store = registry.get_events()
+    store.init(app_id)
+    batch = []
+    count = 0
+    for event in _parse_lines(lines):
+        batch.append(event)
+        if len(batch) >= batch_size:
+            store.write(batch, app_id)
+            count += len(batch)
+            batch = []
+    if batch:
+        store.write(batch, app_id)
+        count += len(batch)
+    return count
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="import_events")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--input", required=True)
+    args = p.parse_args(argv)
+    registry = get_registry()
+    with open(args.input, "r", encoding="utf-8") as fh:
+        n = import_events(registry, args.appid, fh)
+    print(json.dumps({"appId": args.appid, "events": n, "input": args.input}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Serving driver process — the ``CreateServer`` spawn analogue.
+
+Rebuild of ``tools/.../RunServer.scala:29-139`` + the served ``CreateServer``
+main (``core/.../workflow/CreateServer.scala:100-182``): resolve the engine
+project, load its factory, and serve the latest COMPLETED engine instance on
+``POST /queries.json`` (with ``GET /reload`` hot-swap and ``GET /stop``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Optional, Sequence
+
+from ..storage import StorageRegistry, get_registry
+from ..workflow import loader
+from ..workflow.serving import QueryServer, ServerConfig, create_query_server
+from .register import load_engine_dir
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Flag grammar (``CreateServer.scala:101-147``)."""
+    p = argparse.ArgumentParser(prog="run_server")
+    p.add_argument("--engine-dir", default=".")
+    p.add_argument("--engine-instance-id", default=None)
+    p.add_argument("--ip", default="localhost")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--engine-variant", default="engine.json")
+    p.add_argument("--feedback", action="store_true")
+    p.add_argument("--event-server-ip", default="localhost")
+    p.add_argument("--event-server-port", type=int, default=7070)
+    p.add_argument("--accesskey", default=None)
+    p.add_argument("--batch", default="")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def make_server(
+    args: argparse.Namespace,
+    registry: Optional[StorageRegistry] = None,
+    block: bool = True,
+) -> QueryServer:
+    loader.modify_logging(args.verbose)
+    registry = registry or get_registry()
+    ed = load_engine_dir(args.engine_dir)
+    engine = loader.get_engine(ed.engine_factory, search_dir=ed.path)
+    config = ServerConfig(
+        ip=args.ip,
+        port=args.port,
+        engine_instance_id=args.engine_instance_id,
+        engine_id=ed.manifest.id,
+        engine_version=ed.manifest.version,
+        engine_variant=args.engine_variant,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.accesskey,
+        batch=args.batch,
+    )
+    return create_query_server(engine, config, registry, block=block)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    make_server(args, block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

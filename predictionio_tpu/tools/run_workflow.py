@@ -1,0 +1,114 @@
+"""Train/eval driver process — the ``CreateWorkflow`` analogue.
+
+Rebuild of ``core/src/main/scala/io/prediction/workflow/CreateWorkflow.scala``:
+the ``main`` of every ``pio train`` / ``pio eval``.  The reference is spawned
+via spark-submit (``RunWorkflow.scala:103-169``); here the console either
+invokes :func:`run` in-process or spawns
+``python -m predictionio_tpu.tools.run_workflow`` to preserve the process
+boundary (CLI process ↔ training driver process) with the same
+metadata-store handshake.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Optional, Sequence
+
+from ..controller.engine import WorkflowParams
+from ..storage import StorageRegistry, get_registry
+from ..workflow import loader
+from ..workflow.core_workflow import run_evaluation, run_train
+from .register import load_engine_dir
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Flag grammar (``CreateWorkflow.scala:87-140``)."""
+    p = argparse.ArgumentParser(prog="run_workflow")
+    p.add_argument("--engine-dir", default=".", help="engine project directory")
+    p.add_argument("--engine-id", default=None)
+    p.add_argument("--engine-version", default=None)
+    p.add_argument("--engine-variant", default="engine.json")
+    p.add_argument("--engine-factory", default=None)
+    p.add_argument("--engine-params-key", default=None)
+    p.add_argument("--evaluation-class", default=None)
+    p.add_argument("--engine-params-generator-class", default=None)
+    p.add_argument("--batch", default="")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    p.add_argument("--verbosity", type=int, default=0)
+    return p
+
+
+def run(
+    args: argparse.Namespace, registry: Optional[StorageRegistry] = None
+) -> str:
+    """Execute one train or eval run; returns the instance id
+    (``CreateWorkflow.main``, ``CreateWorkflow.scala:142-279``)."""
+    loader.modify_logging(args.verbose)
+    registry = registry or get_registry()
+    wp = WorkflowParams(
+        batch=args.batch,
+        verbose=args.verbosity,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+
+    if args.evaluation_class:
+        # Eval path (``CreateWorkflow.scala:180-199,264-277``).
+        evaluation = loader.get_evaluation(args.evaluation_class, args.engine_dir)
+        if args.engine_params_generator_class:
+            generator = loader.get_engine_params_generator(
+                args.engine_params_generator_class, args.engine_dir
+            )
+        else:
+            # An Evaluation may itself carry the params list
+            # (``Evaluation.scala:59-124`` couples engine+params).
+            from ..controller.evaluation import EngineParamsGenerator
+
+            generator = EngineParamsGenerator(
+                [evaluation.engine.default_engine_params()]
+                if hasattr(evaluation.engine, "default_engine_params")
+                else []
+            )
+        return run_evaluation(evaluation, generator, registry, workflow_params=wp)
+
+    # Train path (``CreateWorkflow.scala:219-263``).
+    ed = load_engine_dir(args.engine_dir)
+    factory = args.engine_factory or ed.engine_factory
+    engine = loader.get_engine(factory, search_dir=ed.path)
+    if args.engine_params_key:
+        # Programmatic params: factory object exposes engine_params(key)
+        # (``CreateWorkflow.scala:227-231``).
+        factory_obj = loader.load_object(factory, ed.path)
+        engine_params = factory_obj.engine_params(args.engine_params_key)
+    else:
+        engine_params = engine.json_to_engine_params(ed.variant)
+    return run_train(
+        engine,
+        engine_params,
+        registry,
+        engine_id=args.engine_id or ed.manifest.id,
+        engine_version=args.engine_version or ed.manifest.version,
+        engine_variant=args.engine_variant,
+        engine_factory=factory,
+        workflow_params=wp,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    instance_id = run(args)
+    print(json.dumps({"engineInstanceId": instance_id}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Reflective loading of user engine factories / evaluations.
+
+Rebuild of ``core/src/main/scala/io/prediction/workflow/WorkflowUtils.scala``:
+``getEngine`` / ``getEvaluation`` / ``getEngineParamsGenerator``
+(``WorkflowUtils.scala:61-117``) resolve a user-supplied class name against the
+classpath, trying Scala-object and Java-class conventions.  The TPU-native
+equivalent resolves a dotted path (``pkg.module:attr`` or ``pkg.module.attr``)
+against ``sys.path``, with the engine project directory prepended so an
+``engine.py`` next to ``engine.json`` is importable — the analogue of the
+reference registering built jars on the classpath
+(``RegisterEngine.scala:30-120``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import sys
+from typing import Any, Callable, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class EngineFactoryError(Exception):
+    """Factory path did not resolve (``WorkflowUtils.scala:84-91``)."""
+
+
+def load_object(path: str, search_dir: Optional[str] = None) -> Any:
+    """Resolve ``module:attr`` (preferred) or dotted ``module.attr``.
+
+    ``search_dir`` (the engine project directory) is prepended to ``sys.path``
+    for the import, mirroring the reference's engine-jar classpath injection.
+    """
+    if not path:
+        raise EngineFactoryError("empty factory path")
+    if search_dir:
+        search_dir = os.path.abspath(search_dir)
+        # Stays on sys.path for the process lifetime: the engine's own
+        # module-level imports of sibling files must keep working after load
+        # (the reference keeps engine jars on the classpath the same way).
+        if search_dir not in sys.path:
+            sys.path.insert(0, search_dir)
+    if ":" in path:
+        mod_name, _, attr = path.partition(":")
+        try:
+            module = _import_module(mod_name, search_dir)
+        except ImportError as exc:
+            raise EngineFactoryError(f"could not import {mod_name!r}: {exc}") from exc
+        try:
+            return _get_attr_chain(module, attr)
+        except AttributeError as exc:
+            raise EngineFactoryError(f"{path}: {exc}") from exc
+    # Dotted form: try progressively shorter module prefixes
+    # (``WorkflowUtils.getEngine`` tries object-then-class the same way).
+    parts = path.split(".")
+    for split in range(len(parts) - 1, 0, -1):
+        mod_name = ".".join(parts[:split])
+        try:
+            module = _import_module(mod_name, search_dir)
+        except ImportError:
+            continue
+        try:
+            return _get_attr_chain(module, ".".join(parts[split:]))
+        except AttributeError:
+            continue
+    # Whole path may itself be a module exposing a callable engine factory.
+    try:
+        return _import_module(path, search_dir)
+    except ImportError as exc:
+        raise EngineFactoryError(
+            f"could not resolve {path!r} (searched sys.path"
+            + (f" + {search_dir!r}" if search_dir else "")
+            + ")"
+        ) from exc
+
+
+def _import_module(mod_name: str, search_dir: Optional[str]) -> Any:
+    """Import ``mod_name``, preferring a file inside ``search_dir``.
+
+    Engine projects all tend to name their module ``engine`` (the scaffolds
+    do), so a plain ``import engine`` would collide in ``sys.modules`` across
+    projects.  Project-local modules are therefore loaded by file location
+    under a per-directory unique name — the analogue of the reference giving
+    each engine its own jar on an isolated classpath entry
+    (``RegisterEngine.scala:46-120``).
+    """
+    if search_dir:
+        candidate = os.path.join(search_dir, *mod_name.split(".")) + ".py"
+        if os.path.exists(candidate):
+            import hashlib
+            import importlib.util
+
+            # Flat (dot-free) synthetic name: pickle resolves a class's
+            # ``__module__`` via ``__import__``, which for a dotted name
+            # imports the (nonexistent) parent package but for a flat name
+            # hits the sys.modules entry directly — so models defined in a
+            # project-local engine.py pickle/unpickle cleanly.  The tag is a
+            # digest of the project path, deterministic across processes:
+            # deploy re-registers the same name before unpickling.
+            tag = hashlib.sha1(search_dir.encode("utf-8")).hexdigest()[:12]
+            unique = f"_pio_engine_{tag}_{mod_name.replace('.', '_')}"
+            if unique in sys.modules:
+                return sys.modules[unique]
+            spec = importlib.util.spec_from_file_location(unique, candidate)
+            assert spec is not None and spec.loader is not None
+            module = importlib.util.module_from_spec(spec)
+            sys.modules[unique] = module
+            spec.loader.exec_module(module)
+            return module
+    return importlib.import_module(mod_name)
+
+
+def _get_attr_chain(obj: Any, attr_path: str) -> Any:
+    for attr in attr_path.split("."):
+        obj = getattr(obj, attr)
+    return obj
+
+
+def _instantiate(obj: Any) -> Any:
+    """A factory may be the instance itself, a zero-arg callable, or a class."""
+    if callable(obj):
+        return obj()
+    return obj
+
+
+def get_engine(factory: str, search_dir: Optional[str] = None):
+    """``WorkflowUtils.getEngine`` (``WorkflowUtils.scala:61-91``)."""
+    from ..controller.engine import Engine
+
+    obj = _instantiate(load_object(factory, search_dir))
+    if not isinstance(obj, Engine):
+        raise EngineFactoryError(
+            f"{factory!r} resolved to {type(obj).__name__}, not an Engine"
+        )
+    return obj
+
+
+def get_evaluation(path: str, search_dir: Optional[str] = None):
+    """``WorkflowUtils.getEvaluation`` (``WorkflowUtils.scala:93-103``)."""
+    from ..controller.evaluation import Evaluation
+
+    obj = _instantiate(load_object(path, search_dir))
+    if not isinstance(obj, Evaluation):
+        raise EngineFactoryError(
+            f"{path!r} resolved to {type(obj).__name__}, not an Evaluation"
+        )
+    return obj
+
+
+def get_engine_params_generator(path: str, search_dir: Optional[str] = None):
+    """``WorkflowUtils.getEngineParamsGenerator``
+    (``WorkflowUtils.scala:105-117``)."""
+    from ..controller.evaluation import EngineParamsGenerator
+
+    obj = _instantiate(load_object(path, search_dir))
+    if not isinstance(obj, EngineParamsGenerator):
+        raise EngineFactoryError(
+            f"{path!r} resolved to {type(obj).__name__}, "
+            "not an EngineParamsGenerator"
+        )
+    return obj
+
+
+def modify_logging(verbose: bool) -> None:
+    """``WorkflowUtils.modifyLogging`` (``WorkflowUtils.scala:278-289``)."""
+    level = logging.DEBUG if verbose else logging.INFO
+    logging.getLogger("predictionio_tpu").setLevel(level)
+    logging.basicConfig(level=level)

@@ -88,7 +88,13 @@ def decode_query(algorithms: Sequence[Any], payload: Any) -> Any:
 
 
 def encode_result(obj: Any) -> Any:
-    """Prediction → JSON-compatible structure."""
+    """Prediction → JSON-compatible structure.
+
+    A result type may define ``to_json_dict`` to control its wire shape (the
+    per-algo querySerializer analogue, ``CreateServer.scala:475-478``) —
+    templates use it for the reference's camelCase field names."""
+    if hasattr(obj, "to_json_dict") and not isinstance(obj, type):
+        return encode_result(obj.to_json_dict())
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: encode_result(v) for k, v in dataclasses.asdict(obj).items()}
     if isinstance(obj, dict):
