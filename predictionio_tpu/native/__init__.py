@@ -1,0 +1,74 @@
+"""Native (C++) runtime components.
+
+The reference keeps all native-performance code behind JVM dependencies
+(SURVEY §2.9: Spark/netlib, HBase client, netty — no in-tree C++). Here the
+framework owns its native runtime: sources in this package are compiled
+on demand with the system toolchain into per-ABI shared libraries and loaded
+via ctypes — no pybind11 dependency.
+
+Build artifacts land in ``_build/`` next to the sources and are rebuilt
+whenever a source file's SHA-1 changes (stamp file per library).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+class NativeBuildError(RuntimeError):
+    """Compilation of a native component failed."""
+
+
+def _source_digest(sources) -> str:
+    sha = hashlib.sha1()
+    for src in sources:
+        with open(src, "rb") as f:
+            sha.update(f.read())
+    return sha.hexdigest()
+
+
+def build_library(name: str, sources=None, extra_flags=()) -> str:
+    """Compile ``<name>.cc`` (or explicit sources) into ``_build/lib<name>.so``
+    if missing or stale. Returns the library path."""
+    sources = [
+        os.path.join(_HERE, s) for s in (sources or [f"{name}.cc"])
+    ]
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    lib_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    stamp_path = os.path.join(_BUILD_DIR, f"lib{name}.stamp")
+    digest = _source_digest(sources)
+    if os.path.exists(lib_path) and os.path.exists(stamp_path):
+        with open(stamp_path) as f:
+            if f.read().strip() == digest:
+                return lib_path
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [
+        cxx, "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-Wall", "-Wextra",
+        *extra_flags, "-o", lib_path, *sources,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
+        )
+    with open(stamp_path, "w") as f:
+        f.write(digest)
+    return lib_path
+
+
+def load_library(name: str, sources=None) -> ctypes.CDLL:
+    """Build (if needed) and dlopen a native component, cached per process."""
+    with _LOCK:
+        if name not in _CACHE:
+            _CACHE[name] = ctypes.CDLL(build_library(name, sources))
+        return _CACHE[name]

@@ -1,0 +1,384 @@
+// Native append-only event log with mmap bulk scans.
+//
+// The storage-plane replacement for the reference's HBase events backend
+// (data/src/main/scala/io/prediction/data/storage/hbase/: HBEventsUtil.scala
+// row-key + scan push-down, HBLEvents.scala point ops, HBPEvents.scala bulk
+// region scans). Where the reference pushes SingleColumnValueFilter/time-range
+// predicates to regionservers (HBEventsUtil.scala:280-404), this log stores
+// fixed 80-byte numeric headers per record and scans them with mmap at memory
+// bandwidth; only records surviving the numeric prefilter have their JSON
+// payload decoded by the Python layer (which also re-verifies exact string
+// matches, so 64-bit hash collisions cannot produce wrong results for
+// inserts; tombstone matching is hash-exact only).
+//
+// Record layout (little-endian, 8-byte aligned):
+//   u32 record_len        total bytes incl. header, multiple of 8
+//   u32 flags             bit0 = tombstone (delete marker)
+//   i64 event_time_ms
+//   i64 creation_time_ms
+//   u64 etype_hash        fnv1a64(entityType)
+//   u64 entity_hash       fnv1a64(entityType \0 entityId)
+//   u64 event_hash        fnv1a64(event name)
+//   u64 ttype_hash        fnv1a64(targetEntityType), 0 when no target
+//   u64 target_hash       fnv1a64(targetType \0 targetId), 0 when no target
+//   u64 id_hash           fnv1a64(event_id string)
+//   u32 payload_len       JSON payload bytes (record_len - 80 >= payload_len)
+//   u32 reserved
+//   u8  payload[...]      UTF-8 JSON (the event's wire-format dict)
+//
+// A tombstone record carries the id_hash of the deleted event; it is always
+// appended after the insert it deletes, so a single forward pass that
+// collects candidate matches and the tombstone set, then filters, is exact.
+//
+// Concurrency: appends are serialized by a per-handle mutex + O_APPEND;
+// scans mmap the file at its current committed size, so readers never see a
+// torn record (record_len is written with the rest of the record in one
+// write(2) call). Open truncates any torn tail left by a crash.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kHeaderSize = 80;
+constexpr uint32_t kFlagTombstone = 1u;
+
+#pragma pack(push, 1)
+struct RecordHeader {
+  uint32_t record_len;
+  uint32_t flags;
+  int64_t event_time_ms;
+  int64_t creation_time_ms;
+  uint64_t etype_hash;
+  uint64_t entity_hash;
+  uint64_t event_hash;
+  uint64_t ttype_hash;
+  uint64_t target_hash;
+  uint64_t id_hash;
+  uint32_t payload_len;
+  uint32_t reserved;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(RecordHeader) == kHeaderSize, "header must be 80 bytes");
+
+struct Handle {
+  int fd = -1;
+  int64_t size = 0;       // committed (validated) file size
+  int64_t n_records = 0;  // records incl. tombstones
+  std::mutex mu;
+  std::string path;
+};
+
+struct Match {
+  int64_t time_ms;
+  int64_t off;  // payload offset in file
+  int64_t len;  // payload length
+  uint64_t id_hash;
+};
+
+// Validate records in [from, file_size); set *committed to the offset of the
+// first invalid byte and *count to the number of valid records seen. Returns
+// false when the file could not be inspected at all (mmap failure) — callers
+// must NOT truncate in that case.
+bool validate_range(int fd, int64_t file_size, int64_t from, int64_t* committed,
+                    int64_t* count) {
+  *committed = from;
+  *count = 0;
+  if (file_size - from < (int64_t)kHeaderSize) return true;
+  void* map = mmap(nullptr, (size_t)file_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) return false;
+  const uint8_t* base = (const uint8_t*)map;
+  int64_t off = from;
+  while (off + (int64_t)kHeaderSize <= file_size) {
+    RecordHeader h;
+    memcpy(&h, base + off, kHeaderSize);
+    if (h.record_len < kHeaderSize || h.record_len % 8 != 0 ||
+        off + (int64_t)h.record_len > file_size ||
+        h.payload_len > h.record_len - kHeaderSize) {
+      break;
+    }
+    off += h.record_len;
+    (*count)++;
+  }
+  munmap(map, (size_t)file_size);
+  *committed = off;
+  return true;
+}
+
+// Pick up records appended through other handles/processes (O_APPEND writers
+// on the same file): extend h->size over any newly committed tail. Caller
+// must hold h->mu. On inspection failure the old bound is kept (safe: scans
+// just miss the newest records until the next successful refresh).
+void refresh_size(Handle* h) {
+  struct stat st;
+  if (fstat(h->fd, &st) != 0) return;
+  if ((int64_t)st.st_size <= h->size) return;
+  int64_t committed, count;
+  if (validate_range(h->fd, (int64_t)st.st_size, h->size, &committed, &count)) {
+    h->size = committed;
+    h->n_records += count;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t evlog_fnv1a64(const uint8_t* data, int64_t len) {
+  uint64_t h = 14695981039346656037ull;
+  for (int64_t i = 0; i < len; i++) {
+    h ^= (uint64_t)data[i];
+    h *= 1099511628211ull;
+  }
+  return h ? h : 1;  // 0 is reserved for "absent / don't care"
+}
+
+void* evlog_open(const char* path) {
+  int fd = open(path, O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  auto* h = new Handle();
+  h->fd = fd;
+  h->path = path;
+  if (!validate_range(fd, (int64_t)st.st_size, 0, &h->size, &h->n_records)) {
+    // Could not inspect the file (mmap failure): refuse to open rather than
+    // risk truncating valid data on a transient error.
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  if (h->size < (int64_t)st.st_size) {
+    // torn tail from a crash: drop it
+    if (ftruncate(fd, (off_t)h->size) != 0) { /* keep going; scans use h->size */ }
+  }
+  return h;
+}
+
+void evlog_close(void* vh) {
+  auto* h = (Handle*)vh;
+  if (!h) return;
+  if (h->fd >= 0) close(h->fd);
+  delete h;
+}
+
+int64_t evlog_count(void* vh) { return ((Handle*)vh)->n_records; }
+int64_t evlog_size(void* vh) { return ((Handle*)vh)->size; }
+
+int evlog_sync(void* vh) {
+  auto* h = (Handle*)vh;
+  std::lock_guard<std::mutex> lock(h->mu);
+  return fdatasync(h->fd) == 0 ? 0 : -errno;
+}
+
+// Append one record. Returns payload offset in file, or -errno.
+int64_t evlog_append(void* vh, uint32_t flags, int64_t event_time_ms,
+                     int64_t creation_time_ms, uint64_t etype_hash,
+                     uint64_t entity_hash, uint64_t event_hash,
+                     uint64_t ttype_hash, uint64_t target_hash,
+                     uint64_t id_hash, const uint8_t* payload,
+                     uint32_t payload_len) {
+  auto* h = (Handle*)vh;
+  uint32_t record_len = kHeaderSize + ((payload_len + 7u) & ~7u);
+  std::vector<uint8_t> buf(record_len, 0);
+  RecordHeader hdr;
+  memset(&hdr, 0, sizeof(hdr));
+  hdr.record_len = record_len;
+  hdr.flags = flags;
+  hdr.event_time_ms = event_time_ms;
+  hdr.creation_time_ms = creation_time_ms;
+  hdr.etype_hash = etype_hash;
+  hdr.entity_hash = entity_hash;
+  hdr.event_hash = event_hash;
+  hdr.ttype_hash = ttype_hash;
+  hdr.target_hash = target_hash;
+  hdr.id_hash = id_hash;
+  hdr.payload_len = payload_len;
+  memcpy(buf.data(), &hdr, kHeaderSize);
+  if (payload_len) memcpy(buf.data() + kHeaderSize, payload, payload_len);
+
+  std::lock_guard<std::mutex> lock(h->mu);
+  ssize_t n = write(h->fd, buf.data(), record_len);
+  if (n != (ssize_t)record_len) {
+    int saved = errno ? errno : EIO;
+    if (n > 0) {
+      // partial write: roll back exactly the bytes we wrote. The file end may
+      // be past h->size (other O_APPEND writers), so compute from fstat.
+      struct stat st;
+      if (fstat(h->fd, &st) == 0) {
+        if (ftruncate(h->fd, (off_t)(st.st_size - n)) != 0) {
+          /* scans remain bounded by validated sizes */
+        }
+      }
+    }
+    return -(int64_t)saved;
+  }
+  // Our record ends at the current file end (O_APPEND). Fold in anything
+  // other writers appended before us as well.
+  struct stat st;
+  if (fstat(h->fd, &st) != 0) {
+    h->size += record_len;  // fallback: at least account for our own write
+    h->n_records++;
+    return h->size - record_len + kHeaderSize;
+  }
+  int64_t end = (int64_t)st.st_size;
+  if (end - record_len > h->size) {
+    int64_t committed, count;
+    if (validate_range(h->fd, end - record_len, h->size, &committed, &count)) {
+      h->n_records += count;
+    }
+  }
+  h->size = end;
+  h->n_records++;
+  return end - (int64_t)record_len + (int64_t)kHeaderSize;
+}
+
+// Bulk scan with predicate push-down. Any hash argument of 0 means "any";
+// start_ms/until_ms of INT64_MIN/INT64_MAX mean unbounded; has_target:
+// -1 any, 0 must-have-no-target, 1 must-have-target. Matches are sorted by
+// (event_time_ms, file offset) ascending. Returns the total number of
+// matches; only the first `cap` (payload offset, payload len, event time ms)
+// triples are written to out_off/out_len/out_time. Call again with a larger
+// cap if truncated.
+int64_t evlog_scan(void* vh, int64_t start_ms, int64_t until_ms,
+                   uint64_t etype_hash, uint64_t entity_hash,
+                   const uint64_t* event_hashes, int32_t n_event_hashes,
+                   uint64_t ttype_hash, uint64_t target_hash,
+                   int32_t has_target, int64_t* out_off, int64_t* out_len,
+                   int64_t* out_time, int64_t cap) {
+  auto* h = (Handle*)vh;
+  int64_t size;
+  {
+    std::lock_guard<std::mutex> lock(h->mu);
+    refresh_size(h);
+    size = h->size;
+  }
+  if (size < (int64_t)kHeaderSize) return 0;
+  void* map = mmap(nullptr, (size_t)size, PROT_READ, MAP_SHARED, h->fd, 0);
+  if (map == MAP_FAILED) return -(int64_t)errno;
+  madvise(map, (size_t)size, MADV_SEQUENTIAL);
+  const uint8_t* base = (const uint8_t*)map;
+
+  std::unordered_set<uint64_t> ev_set;
+  for (int32_t i = 0; i < n_event_hashes; i++) ev_set.insert(event_hashes[i]);
+  // Order-sensitive tombstones: a delete marker only kills records appended
+  // BEFORE it, so an id re-inserted after a delete stays live (matching the
+  // upsert semantics of the SQLite backend). live_by_id tracks, per id_hash,
+  // the indices of not-yet-killed matches.
+  std::vector<Match> matches;
+  std::vector<bool> dead_flags;
+  std::unordered_map<uint64_t, std::vector<size_t>> live_by_id;
+
+  int64_t off = 0;
+  while (off + (int64_t)kHeaderSize <= size) {
+    RecordHeader hd;
+    memcpy(&hd, base + off, kHeaderSize);
+    if (hd.record_len < kHeaderSize || off + (int64_t)hd.record_len > size)
+      break;  // defensive; open() validated the tail
+    if (hd.flags & kFlagTombstone) {
+      auto it = live_by_id.find(hd.id_hash);
+      if (it != live_by_id.end()) {
+        for (size_t i : it->second) dead_flags[i] = true;
+        live_by_id.erase(it);
+      }
+    } else {
+      bool ok = hd.event_time_ms >= start_ms && hd.event_time_ms < until_ms;
+      if (ok && etype_hash && hd.etype_hash != etype_hash) ok = false;
+      if (ok && entity_hash && hd.entity_hash != entity_hash) ok = false;
+      if (ok && n_event_hashes > 0 && !ev_set.count(hd.event_hash)) ok = false;
+      if (ok && ttype_hash && hd.ttype_hash != ttype_hash) ok = false;
+      if (ok && target_hash && hd.target_hash != target_hash) ok = false;
+      if (ok && has_target == 0 && hd.ttype_hash != 0) ok = false;
+      if (ok && has_target == 1 && hd.ttype_hash == 0) ok = false;
+      if (ok) {
+        live_by_id[hd.id_hash].push_back(matches.size());
+        matches.push_back({hd.event_time_ms, off + (int64_t)kHeaderSize,
+                           (int64_t)hd.payload_len, hd.id_hash});
+        dead_flags.push_back(false);
+      }
+    }
+    off += hd.record_len;
+  }
+  munmap(map, (size_t)size);
+
+  {
+    std::vector<Match> alive;
+    alive.reserve(matches.size());
+    for (size_t i = 0; i < matches.size(); i++) {
+      if (!dead_flags[i]) alive.push_back(matches[i]);
+    }
+    matches.swap(alive);
+  }
+  std::stable_sort(matches.begin(), matches.end(),
+                   [](const Match& a, const Match& b) {
+                     return a.time_ms != b.time_ms ? a.time_ms < b.time_ms
+                                                   : a.off < b.off;
+                   });
+  int64_t n = (int64_t)matches.size();
+  int64_t write_n = std::min(n, cap);
+  for (int64_t i = 0; i < write_n; i++) {
+    out_off[i] = matches[i].off;
+    out_len[i] = matches[i].len;
+    out_time[i] = matches[i].time_ms;
+  }
+  return n;
+}
+
+// Latest live record with the given id_hash. Returns 1 and fills
+// out_off/out_len (payload), or 0 when absent / deleted.
+int32_t evlog_get(void* vh, uint64_t id_hash, int64_t* out_off,
+                  int64_t* out_len) {
+  auto* h = (Handle*)vh;
+  int64_t size;
+  {
+    std::lock_guard<std::mutex> lock(h->mu);
+    refresh_size(h);
+    size = h->size;
+  }
+  if (size < (int64_t)kHeaderSize) return 0;
+  void* map = mmap(nullptr, (size_t)size, PROT_READ, MAP_SHARED, h->fd, 0);
+  if (map == MAP_FAILED) return 0;
+  const uint8_t* base = (const uint8_t*)map;
+  int64_t found_off = -1, found_len = 0;
+  bool dead = false;
+  int64_t off = 0;
+  while (off + (int64_t)kHeaderSize <= size) {
+    RecordHeader hd;
+    memcpy(&hd, base + off, kHeaderSize);
+    if (hd.record_len < kHeaderSize || off + (int64_t)hd.record_len > size)
+      break;
+    if (hd.id_hash == id_hash) {
+      if (hd.flags & kFlagTombstone) {
+        dead = true;
+      } else {
+        found_off = off + (int64_t)kHeaderSize;
+        found_len = (int64_t)hd.payload_len;
+        dead = false;
+      }
+    }
+    off += hd.record_len;
+  }
+  munmap(map, (size_t)size);
+  if (found_off < 0 || dead) return 0;
+  *out_off = found_off;
+  *out_len = found_len;
+  return 1;
+}
+
+}  // extern "C"

@@ -1,0 +1,349 @@
+"""Native (C++) event store backend.
+
+The bulk-scan event backend: each app gets an append-only log file managed by
+the ``eventlog`` native library (``predictionio_tpu/native/eventlog.cc``) —
+fixed numeric record headers scanned with mmap at memory bandwidth, hashed
+predicate push-down for entity/event/target/time filters, tombstone deletes.
+This plays the role of the reference's HBase backend
+(``data/src/main/scala/io/prediction/data/storage/hbase/HBLEvents.scala``,
+``HBPEvents.scala``): the native scan is the regionserver-side filter
+push-down, the JSON payload decode in Python is the client-side
+``Result``→``Event`` codec (``HBEventsUtil.scala:138-273``).
+
+Hash prefilters may (with ~2^-64 probability) pass a colliding record; every
+decoded event is re-checked against the exact :class:`EventFilter`, so query
+results are always exact.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import json
+import mmap
+import os
+import shutil
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..native import load_library
+from .event import Event, to_millis as _ms, validate_event
+from .events import EventFilter, EventStore
+from .sqlite_events import make_event_id
+
+_INT64_MIN = -(1 << 63)
+_INT64_MAX = (1 << 63) - 1
+
+
+def _lib() -> ctypes.CDLL:
+    lib = load_library("eventlog")
+    if not getattr(lib, "_pio_configured", False):
+        lib.evlog_open.restype = ctypes.c_void_p
+        lib.evlog_open.argtypes = [ctypes.c_char_p]
+        lib.evlog_close.argtypes = [ctypes.c_void_p]
+        lib.evlog_count.restype = ctypes.c_int64
+        lib.evlog_count.argtypes = [ctypes.c_void_p]
+        lib.evlog_size.restype = ctypes.c_int64
+        lib.evlog_size.argtypes = [ctypes.c_void_p]
+        lib.evlog_sync.restype = ctypes.c_int
+        lib.evlog_sync.argtypes = [ctypes.c_void_p]
+        lib.evlog_fnv1a64.restype = ctypes.c_uint64
+        lib.evlog_fnv1a64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.evlog_append.restype = ctypes.c_int64
+        lib.evlog_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.evlog_scan.restype = ctypes.c_int64
+        lib.evlog_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.evlog_get.restype = ctypes.c_int32
+        lib.evlog_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib._pio_configured = True
+    return lib
+
+
+def _fnv(text: str) -> int:
+    data = text.encode("utf-8")
+    return int(_lib().evlog_fnv1a64(data, len(data)))
+
+
+class NativeEventStore(EventStore):
+    """Event store over per-app native append-only logs."""
+
+    def __init__(self, root: str):
+        self._root = root
+        self._lib = _lib()
+        self._handles: Dict[int, int] = {}
+        self._lock = threading.RLock()
+        os.makedirs(root, exist_ok=True)
+
+    def _log_path(self, app_id: int) -> str:
+        return os.path.join(self._root, f"app_{int(app_id)}", "events.log")
+
+    def _handle(self, app_id: int, create: bool = False) -> Optional[int]:
+        with self._lock:
+            h = self._handles.get(app_id)
+            if h:
+                return h
+            path = self._log_path(app_id)
+            if not os.path.exists(path) and not create:
+                return None
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            h = self._lib.evlog_open(path.encode())
+            if not h:
+                raise OSError(f"evlog_open failed for {path}")
+            self._handles[app_id] = h
+            return h
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, app_id: int) -> bool:
+        self._handle(app_id, create=True)
+        return True
+
+    def remove(self, app_id: int) -> bool:
+        with self._lock:
+            h = self._handles.pop(app_id, None)
+            if h:
+                self._lib.evlog_close(h)
+            app_dir = os.path.dirname(self._log_path(app_id))
+            shutil.rmtree(app_dir, ignore_errors=True)
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            for h in self._handles.values():
+                self._lib.evlog_close(h)
+            self._handles.clear()
+
+    # -- point ops --------------------------------------------------------
+    def insert(self, event: Event, app_id: int) -> str:
+        validate_event(event)
+        h = self._handle(app_id, create=True)
+        event_id = event.event_id or make_event_id(event)
+        if event.event_id is not None:
+            # Upsert semantics to match the SQLite backend's INSERT OR
+            # REPLACE on event_id: a tombstone first kills any earlier record
+            # with this id (scans are order-sensitive, so the fresh record
+            # appended after it stays live). Harmless no-op for unseen ids.
+            tomb = event_id.encode("utf-8")
+            self._lib.evlog_append(
+                h, 1, _INT64_MIN, 0, 0, 0, 0, 0, 0, _fnv(event_id),
+                tomb, len(tomb),
+            )
+        stored = dataclasses.replace(event, event_id=event_id)
+        payload = json.dumps(stored.to_json_dict()).encode("utf-8")
+        tt, ti = event.target_entity_type, event.target_entity_id
+        off = self._lib.evlog_append(
+            h, 0, _ms(event.event_time), _ms(event.creation_time),
+            _fnv(event.entity_type),
+            _fnv(f"{event.entity_type}\x00{event.entity_id}"),
+            _fnv(event.event),
+            _fnv(tt) if tt is not None else 0,
+            _fnv(f"{tt}\x00{ti}") if tt is not None else 0,
+            _fnv(event_id), payload, len(payload),
+        )
+        if off < 0:
+            raise OSError(f"evlog_append failed: errno {-off}")
+        return event_id
+
+    def get(self, event_id: str, app_id: int) -> Optional[Event]:
+        h = self._handle(app_id)
+        if h is None:
+            return None
+        out_off = ctypes.c_int64()
+        out_len = ctypes.c_int64()
+        found = self._lib.evlog_get(
+            h, _fnv(event_id), ctypes.byref(out_off), ctypes.byref(out_len)
+        )
+        if not found:
+            return None
+        event = self._decode_one(app_id, out_off.value, out_len.value)
+        # exact-id check guards against id_hash collisions
+        return event if event and event.event_id == event_id else None
+
+    def delete(self, event_id: str, app_id: int) -> bool:
+        if self.get(event_id, app_id) is None:
+            return False
+        h = self._handle(app_id, create=True)
+        payload = event_id.encode("utf-8")
+        off = self._lib.evlog_append(
+            h, 1, _INT64_MIN, 0, 0, 0, 0, 0, 0, _fnv(event_id),
+            payload, len(payload),
+        )
+        return off >= 0
+
+    # -- bulk scan --------------------------------------------------------
+    def _scan_offsets(
+        self, app_id: int, f: EventFilter
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray, np.ndarray]]:
+        h = self._handle(app_id)
+        if h is None:
+            return None
+        start = _ms(f.start_time) if f.start_time else _INT64_MIN
+        until = _ms(f.until_time) if f.until_time else _INT64_MAX
+        etype = _fnv(f.entity_type) if f.entity_type else 0
+        entity = (
+            _fnv(f"{f.entity_type}\x00{f.entity_id}")
+            if f.entity_type and f.entity_id
+            else 0
+        )
+        if f.event_names:
+            ev_hashes = np.array(
+                [_fnv(n) for n in f.event_names], dtype=np.uint64
+            )
+            ev_ptr, ev_n = ev_hashes.ctypes.data_as(ctypes.c_void_p), len(ev_hashes)
+        else:
+            ev_hashes, ev_ptr, ev_n = None, None, 0
+        ttype = _fnv(f.target_entity_type) if f.target_entity_type else 0
+        target = (
+            _fnv(f"{f.target_entity_type}\x00{f.target_entity_id}")
+            if f.target_entity_type and f.target_entity_id
+            else 0
+        )
+        has_target = -1
+        if f.has_target_entity_type is not None:
+            has_target = 1 if f.has_target_entity_type else 0
+
+        cap = max(1024, int(self._lib.evlog_count(h)))
+        while True:
+            out_off = np.empty(cap, dtype=np.int64)
+            out_len = np.empty(cap, dtype=np.int64)
+            out_time = np.empty(cap, dtype=np.int64)
+            n = self._lib.evlog_scan(
+                h, start, until, etype, entity, ev_ptr, ev_n, ttype, target,
+                has_target,
+                out_off.ctypes.data_as(ctypes.c_void_p),
+                out_len.ctypes.data_as(ctypes.c_void_p),
+                out_time.ctypes.data_as(ctypes.c_void_p), cap,
+            )
+            if n < 0:
+                raise OSError(f"evlog_scan failed: errno {-n}")
+            if n <= cap:
+                return h, out_off[:n], out_len[:n], out_time[:n]
+            cap = int(n)
+
+    def _decode_one(self, app_id: int, off: int, length: int) -> Optional[Event]:
+        path = self._log_path(app_id)
+        with open(path, "rb") as fh:
+            fh.seek(off)
+            data = fh.read(length)
+        try:
+            return Event.from_json_dict(json.loads(data))
+        except Exception:
+            return None
+
+    def find(
+        self, app_id: int, filter: Optional[EventFilter] = None
+    ) -> Iterator[Event]:
+        f = filter or EventFilter()
+        scan = self._scan_offsets(app_id, f)
+        if scan is None:
+            return iter(())
+        _, offs, lens, _times = scan
+        return self._decode_iter(app_id, f, offs, lens)
+
+    @staticmethod
+    def _dict_matches(f: EventFilter, obj: dict) -> bool:
+        """Exact re-check of the string predicates on the raw wire dict —
+        the hash-collision guard of :meth:`find` without constructing Event
+        objects (time bounds were already applied exactly by the native scan
+        on the stored millis)."""
+        if f.entity_type is not None and obj.get("entityType") != f.entity_type:
+            return False
+        if f.entity_id is not None and obj.get("entityId") != f.entity_id:
+            return False
+        if f.event_names is not None and obj.get("event") not in set(f.event_names):
+            return False
+        tt = obj.get("targetEntityType")
+        if f.has_target_entity_type is not None and (
+            f.has_target_entity_type != (tt is not None)
+        ):
+            return False
+        if f.target_entity_type is not None and tt != f.target_entity_type:
+            return False
+        ti = obj.get("targetEntityId")
+        if f.has_target_entity_id is not None and (
+            f.has_target_entity_id != (ti is not None)
+        ):
+            return False
+        if f.target_entity_id is not None and ti != f.target_entity_id:
+            return False
+        return True
+
+    def scan_columnar(self, app_id: int, filter: Optional[EventFilter] = None):
+        """Bulk scan returning a column dict (training-path fast lane; same
+        contract as :meth:`SqliteEventStore.scan_columnar`). Payloads are
+        decoded straight from the mmap'd log into columns — no per-event
+        ``Event``/``DataMap`` objects."""
+        f = filter or EventFilter()
+        cols = {
+            "event": [], "entity_type": [], "entity_id": [],
+            "target_entity_type": [], "target_entity_id": [],
+            "properties": [], "event_time_ms": [],
+        }
+        times = []
+        scan = self._scan_offsets(app_id, f)
+        if scan is None:
+            cols["event_time_ms"] = np.asarray([], dtype=np.int64)
+            return cols
+        _, offs, lens, tms = scan
+        if f.reversed:
+            offs, lens, tms = offs[::-1], lens[::-1], tms[::-1]
+        limit = f.limit if f.limit is not None and f.limit >= 0 else None
+        if len(offs):
+            path = self._log_path(app_id)
+            with open(path, "rb") as fh:
+                size = os.fstat(fh.fileno()).st_size
+                with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
+                    for off, length, tm in zip(
+                        offs.tolist(), lens.tolist(), tms.tolist()
+                    ):
+                        obj = json.loads(mm[off : off + length])
+                        if not self._dict_matches(f, obj):
+                            continue
+                        cols["event"].append(obj["event"])
+                        cols["entity_type"].append(obj["entityType"])
+                        cols["entity_id"].append(obj["entityId"])
+                        cols["target_entity_type"].append(obj.get("targetEntityType"))
+                        cols["target_entity_id"].append(obj.get("targetEntityId"))
+                        cols["properties"].append(obj.get("properties") or {})
+                        times.append(tm)
+                        if limit is not None and len(times) >= limit:
+                            break
+        cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
+        return cols
+
+    def _decode_iter(
+        self, app_id: int, f: EventFilter, offs: np.ndarray, lens: np.ndarray
+    ) -> Iterator[Event]:
+        if f.reversed:
+            offs, lens = offs[::-1], lens[::-1]
+        limit = f.limit if f.limit is not None and f.limit >= 0 else None
+        emitted = 0
+        path = self._log_path(app_id)
+        if len(offs) == 0:
+            return
+        with open(path, "rb") as fh:
+            size = os.fstat(fh.fileno()).st_size
+            with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
+                for off, length in zip(offs.tolist(), lens.tolist()):
+                    obj = json.loads(mm[off : off + length])
+                    event = Event.from_json_dict(obj)
+                    # exact re-check (hash-collision guard)
+                    if not f.matches(event):
+                        continue
+                    yield event
+                    emitted += 1
+                    if limit is not None and emitted >= limit:
+                        return
