@@ -181,6 +181,11 @@ class ALSAlgorithmParams(Params):
     seed: int = 3
     implicit_prefs: bool = False
     alpha: float = 1.0
+    #: Shard the training run over the workflow context's device mesh
+    #: (solve rows on the ``data`` axis); "replicated" or "model" controls
+    #: the factor-table layout (see :func:`ops.als.als_train`).
+    distributed: bool = False
+    factor_sharding: str = "replicated"
 
 
 @dataclasses.dataclass
@@ -220,6 +225,7 @@ class ALSAlgorithm(Algorithm):
             implicit_prefs=p.implicit_prefs,
             alpha=p.alpha,
         )
+        mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         factors = als_train_coo(
             pd.users,
             pd.items,
@@ -227,6 +233,8 @@ class ALSAlgorithm(Algorithm):
             n_users=len(pd.user_map),
             n_items=len(pd.item_map),
             cfg=cfg,
+            mesh=mesh,
+            factor_sharding=p.factor_sharding,
         )
         return ALSModel(
             rank=p.rank,

@@ -30,10 +30,15 @@
 // appended after the insert it deletes, so a single forward pass that
 // collects candidate matches and the tombstone set, then filters, is exact.
 //
-// Concurrency: appends are serialized by a per-handle mutex + O_APPEND;
-// scans mmap the file at its current committed size, so readers never see a
-// torn record (record_len is written with the rest of the record in one
-// write(2) call). Open truncates any torn tail left by a crash.
+// Concurrency: appends are serialized by a per-handle mutex within a
+// process and an advisory flock(2) across processes (multiple handles on
+// one log — the event server + `pio import` coexistence case). The lock
+// makes the append's write(2) + rollback atomic with respect to other
+// writers, and open-time torn-tail truncation can never clip a record
+// another live process is mid-appending. Scans take no lock: they bound
+// themselves to the last validated size, so a concurrent append is either
+// fully visible or not yet scanned. Open truncates any torn tail left by a
+// crashed process (under the same lock).
 
 #include <algorithm>
 #include <cerrno>
@@ -46,6 +51,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/file.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -87,6 +93,16 @@ struct Match {
   int64_t off;  // payload offset in file
   int64_t len;  // payload length
   uint64_t id_hash;
+};
+
+// RAII advisory whole-file lock (cross-process append serialization).
+struct FileLock {
+  int fd;
+  bool held;
+  explicit FileLock(int fd_) : fd(fd_), held(flock(fd_, LOCK_EX) == 0) {}
+  ~FileLock() {
+    if (held) flock(fd, LOCK_UN);
+  }
 };
 
 // Validate records in [from, file_size); set *committed to the offset of the
@@ -149,6 +165,9 @@ uint64_t evlog_fnv1a64(const uint8_t* data, int64_t len) {
 void* evlog_open(const char* path) {
   int fd = open(path, O_RDWR | O_CREAT | O_APPEND, 0644);
   if (fd < 0) return nullptr;
+  // Exclusive lock: no other process is mid-append while we validate (and
+  // possibly truncate) the tail, so an in-flight record can't be clipped.
+  FileLock lock(fd);
   struct stat st;
   if (fstat(fd, &st) != 0) {
     close(fd);
@@ -214,12 +233,13 @@ int64_t evlog_append(void* vh, uint32_t flags, int64_t event_time_ms,
   if (payload_len) memcpy(buf.data() + kHeaderSize, payload, payload_len);
 
   std::lock_guard<std::mutex> lock(h->mu);
+  FileLock flock_guard(h->fd);  // serialize with other processes' appends
   ssize_t n = write(h->fd, buf.data(), record_len);
   if (n != (ssize_t)record_len) {
     int saved = errno ? errno : EIO;
     if (n > 0) {
-      // partial write: roll back exactly the bytes we wrote. The file end may
-      // be past h->size (other O_APPEND writers), so compute from fstat.
+      // Partial write: under the file lock no other writer can interleave,
+      // so the last n bytes of the file are exactly ours — roll them back.
       struct stat st;
       if (fstat(h->fd, &st) == 0) {
         if (ftruncate(h->fd, (off_t)(st.st_size - n)) != 0) {

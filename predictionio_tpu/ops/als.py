@@ -220,15 +220,21 @@ def _block_rows_for(width: int) -> int:
     return max(16, (1 << 26) // max(1, width * 64))
 
 
-def stage(side: BucketedMatrix, sharding=None) -> StagedMatrix:
+def stage(
+    side: BucketedMatrix, sharding=None, row_multiple: int = 1
+) -> StagedMatrix:
     """Move a bucketed matrix to device in chunked layout.
 
-    ``sharding`` (optional ``jax.sharding.Sharding``) shards the chunk
-    dimension — rows of the solve — across the mesh data axis.
+    ``sharding`` (optional ``jax.sharding.Sharding``) shards the block-row
+    dimension — the rows being solved — across the mesh data axis;
+    ``row_multiple`` rounds the block size up so the sharded dim divides
+    evenly over the axis.
     """
     staged = []
     for bucket in side.buckets:
         block = _block_rows_for(bucket.width)
+        if row_multiple > 1:
+            block = ((block + row_multiple - 1) // row_multiple) * row_multiple
         n = bucket.rows.shape[0]
         n_chunks = max(1, (n + block - 1) // block)
         padded = n_chunks * block
@@ -328,11 +334,7 @@ def _solve_side_traced(y, buckets, n_rows, rank, implicit, lam, alpha, yty):
     return x
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("rank", "implicit", "n_users", "n_items"),
-)
-def _als_iteration(
+def _als_iteration_body(
     user_buckets, item_buckets, y, lam, alpha,
     rank, implicit, n_users, n_items,
 ):
@@ -362,10 +364,30 @@ def _als_iteration(
     return x, y2
 
 
+_als_iteration = functools.partial(
+    jax.jit,
+    static_argnames=("rank", "implicit", "n_users", "n_items"),
+)(_als_iteration_body)
+
+
+@functools.lru_cache(maxsize=32)
+def _als_iteration_sharded(out_sharding):
+    """Jit of the iteration with factor-table output shardings pinned (both
+    tables get ``out_sharding``); cached per sharding so sweeps reuse the
+    compilation."""
+    return jax.jit(
+        _als_iteration_body,
+        static_argnames=("rank", "implicit", "n_users", "n_items"),
+        out_shardings=(out_sharding, out_sharding),
+    )
+
+
 def als_train(
     by_user,
     by_item,
     cfg: ALSConfig,
+    mesh=None,
+    factor_sharding: str = "replicated",
 ) -> ALSFactors:
     """Alternating solves: items → users → items … for ``cfg.iterations``.
 
@@ -375,18 +397,52 @@ def als_train(
     order: item factors are initialized and users are solved first. Bucket
     tensors are staged to device once; the full run is one fused device
     program.
+
+    Distributed training: pass a ``jax.sharding.Mesh`` with a ``data`` axis
+    (and a ``model`` axis when ``factor_sharding="model"``). Solve rows ride
+    the ``data`` axis (the analogue of the reference's RDD partitions);
+    factor tables are either replicated (default — XLA all-gathers fresh
+    factors each half-iteration over ICI) or row-sharded over ``model``
+    (MLlib's ALS block partitioning analogue: gathers become cross-shard
+    collectives, for tables too big to replicate). The collective schedule
+    is derived by XLA from these annotations, not hand-written.
     """
     if cfg.iterations < 1:
         raise ValueError(f"ALS iterations must be >= 1, got {cfg.iterations}")
     rank = cfg.rank
-    by_user = stage(by_user) if isinstance(by_user, BucketedMatrix) else by_user
-    by_item = stage(by_item) if isinstance(by_item, BucketedMatrix) else by_item
+
+    iteration = _als_iteration
+    row_sharding = None
+    row_multiple = 1
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        if factor_sharding == "model":
+            tbl_spec = NamedSharding(mesh, P(MODEL_AXIS))
+        elif factor_sharding == "replicated":
+            tbl_spec = NamedSharding(mesh, P())
+        else:
+            raise ValueError(
+                f"factor_sharding must be 'replicated' or 'model', "
+                f"got {factor_sharding!r}"
+            )
+        row_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+        row_multiple = mesh.shape[DATA_AXIS]
+        iteration = _als_iteration_sharded(tbl_spec)
+
+    if isinstance(by_user, BucketedMatrix):
+        by_user = stage(by_user, row_sharding, row_multiple)
+    if isinstance(by_item, BucketedMatrix):
+        by_item = stage(by_item, row_sharding, row_multiple)
     y = init_factors(by_item.n_rows, rank, cfg.seed)  # item factors
+    if mesh is not None:
+        y = jax.device_put(y, tbl_spec)
     ub, ib = _bucket_tensors(by_user), _bucket_tensors(by_item)
     lam, alpha = jnp.float32(cfg.lambda_), jnp.float32(cfg.alpha)
     x = None
     for _ in range(cfg.iterations):
-        x, y = _als_iteration(
+        x, y = iteration(
             ub, ib, y, lam, alpha,
             rank=rank,
             implicit=cfg.implicit_prefs,
@@ -403,11 +459,15 @@ def als_train_coo(
     n_users: int,
     n_items: int,
     cfg: ALSConfig,
+    mesh=None,
+    factor_sharding: str = "replicated",
 ) -> ALSFactors:
     """Convenience: COO triplets → bucketized both ways → train."""
     by_user = bucketize(users, items, ratings, n_users, n_items)
     by_item = bucketize(items, users, ratings, n_items, n_users)
-    return als_train(by_user, by_item, cfg)
+    return als_train(
+        by_user, by_item, cfg, mesh=mesh, factor_sharding=factor_sharding
+    )
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -1,0 +1,199 @@
+"""Pallas TPU kernels for the serving hot path.
+
+The deployed recommender's inner loop is "score every item for a batch of
+queries, keep the top k" (reference:
+``MatrixFactorizationModel.recommendProducts`` dot-products invoked per query,
+``examples/.../ALSAlgorithm.scala:76-86``). The XLA path in
+:mod:`predictionio_tpu.ops.scoring` materializes the full ``[B, N]`` score
+matrix in HBM before ``top_k``; for large catalogs that write is the
+bandwidth bill. This kernel streams item blocks through VMEM instead: each
+grid step computes one ``[B, T]`` score tile on the MXU and folds it into a
+running ``[B, K]`` top-k kept in VMEM — the ``[B, N]`` matrix never exists.
+
+Exclusion (seen/unavailable items — the e-commerce template's serving-time
+filters) is per-query index lists (``[B, E]``, -1 padded), matched against
+the block's global item indices, instead of a dense ``[B, N]`` mask.
+
+On non-TPU backends the kernel runs in interpret mode (tests), and
+:func:`top_k_streaming` transparently falls back to the XLA path when pallas
+is unavailable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = float("-inf")  # plain scalar: jnp constants cannot be captured by kernels
+
+try:  # pallas is TPU/GPU-oriented; keep the module importable anywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _select_topk(cand_s, cand_i, k: int):
+    """Top-k of (scores, indices) along axis 1 by unrolled max-extraction —
+    only jnp primitives that lower in Mosaic (no sort/top_k inside kernels).
+    """
+    b, c = cand_s.shape
+    pos_iota = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+    out_s, out_i = [], []
+    for _ in range(k):
+        m = jnp.max(cand_s, axis=1, keepdims=True)  # [B, 1]
+        # first position attaining the max
+        pos = jnp.min(
+            jnp.where(cand_s == m, pos_iota, jnp.int32(c)), axis=1, keepdims=True
+        )  # [B, 1]
+        sel = pos_iota == pos  # [B, C] one-hot
+        idx = jnp.sum(jnp.where(sel, cand_i, 0), axis=1)  # [B]
+        out_s.append(m[:, 0])
+        out_i.append(idx)
+        cand_s = jnp.where(sel, _NEG_INF, cand_s)
+    return jnp.stack(out_s, axis=1), jnp.stack(out_i, axis=1)
+
+
+def _topk_kernel(q_ref, items_ref, excl_ref, out_s_ref, out_i_ref, *,
+                 k: int, block_items: int, n_items: int, n_excl: int):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        out_s_ref[:] = jnp.full_like(out_s_ref[:], _NEG_INF)
+        out_i_ref[:] = jnp.full_like(out_i_ref[:], -1)
+
+    b = q_ref.shape[0]
+    scores = jax.lax.dot_general(
+        q_ref[:], items_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, T]
+    gidx = j * block_items + jax.lax.broadcasted_iota(
+        jnp.int32, (b, block_items), 1
+    )
+    scores = jnp.where(gidx < n_items, scores, _NEG_INF)
+    for e in range(n_excl):
+        scores = jnp.where(gidx == excl_ref[:, e][:, None], _NEG_INF, scores)
+
+    cand_s = jnp.concatenate([out_s_ref[:], scores], axis=1)
+    cand_i = jnp.concatenate([out_i_ref[:], gidx], axis=1)
+    new_s, new_i = _select_topk(cand_s, cand_i, k)
+    out_s_ref[:] = new_s
+    out_i_ref[:] = new_i
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block_items", "interpret"),
+)
+def _topk_streaming_call(query_vectors, item_factors, exclude_idx, k,
+                         block_items, interpret):
+    b, r = query_vectors.shape
+    n_items = item_factors.shape[0]
+    n_pad = _round_up(n_items, block_items)
+    items = jnp.pad(item_factors, ((0, n_pad - n_items), (0, 0)))
+    n_excl = exclude_idx.shape[1]
+    grid = n_pad // block_items
+
+    kernel = functools.partial(
+        _topk_kernel,
+        k=k, block_items=block_items, n_items=n_items, n_excl=n_excl,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((b, r), lambda j: (0, 0)),
+            pl.BlockSpec((block_items, r), lambda j: (j, 0)),
+            pl.BlockSpec((b, max(1, n_excl)), lambda j: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(query_vectors, items, exclude_idx)
+
+
+def top_k_streaming(
+    query_vectors: jax.Array,  # [B, R] float32
+    item_factors: jax.Array,  # [N, R] float32
+    k: int,
+    exclude_idx: Optional[jax.Array] = None,  # [B, E] int32, -1 padded
+    block_items: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Streaming top-k gather-dot: returns (scores ``[B, k]``, item indices
+    ``[B, k]``) without materializing ``[B, N]`` scores in HBM.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (CPU tests). Queries/rank are padded to VPU/MXU tile boundaries; padding
+    never appears in results (-inf / -1 masking).
+    """
+    if not _HAVE_PALLAS:
+        from .scoring import top_k_for_vectors  # XLA fallback
+
+        scores, idx = top_k_for_vectors(query_vectors, item_factors, k)
+        return scores, idx
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, r = query_vectors.shape
+    n_items = item_factors.shape[0]
+    k_eff = min(k, n_items)
+    b_pad = _round_up(b, 8)
+    r_pad = _round_up(r, 128)
+    q = jnp.pad(
+        jnp.asarray(query_vectors, jnp.float32),
+        ((0, b_pad - b), (0, r_pad - r)),
+    )
+    items = jnp.pad(
+        jnp.asarray(item_factors, jnp.float32), ((0, 0), (0, r_pad - r))
+    )
+    if exclude_idx is None:
+        excl = jnp.full((b_pad, 1), -1, dtype=jnp.int32)
+    else:
+        e = exclude_idx.shape[1]
+        excl = jnp.pad(
+            jnp.asarray(exclude_idx, jnp.int32),
+            ((0, b_pad - b), (0, 0)),
+            constant_values=-1,
+        ) if e > 0 else jnp.full((b_pad, 1), -1, dtype=jnp.int32)
+
+    block = min(block_items, _round_up(n_items, 128))
+    scores, idx = _topk_streaming_call(q, items, excl, k_eff, block, interpret)
+    scores, idx = scores[:b], idx[:b]
+    if k_eff < k:
+        pad = k - k_eff
+        scores = jnp.pad(scores, ((0, 0), (0, pad)), constant_values=-np.inf)
+        idx = jnp.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
+    return scores, idx
+
+
+def top_k_for_users_streaming(
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    user_idx: jax.Array,
+    k: int,
+    exclude_idx: Optional[jax.Array] = None,
+    **kw,
+) -> Tuple[jax.Array, jax.Array]:
+    """Known-user wrapper (gather user vectors, then stream)."""
+    return top_k_streaming(
+        user_factors[user_idx], item_factors, k, exclude_idx, **kw
+    )

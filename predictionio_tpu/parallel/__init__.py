@@ -1,5 +1,14 @@
-"""Parallelism: mesh construction, shardings, collective helpers (SURVEY §2.8)."""
+"""Parallelism: mesh construction, shardings, collectives, multi-host init
+(SURVEY §2.8, §5 "Distributed communication backend")."""
 
+from .collectives import (
+    all_gather_rows,
+    all_reduce_sum,
+    reduce_scatter_rows,
+    ring_shift,
+    sharded_matmul_allreduce,
+)
+from .distributed import hybrid_mesh, initialize_from_env, process_info
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -15,9 +24,17 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "MeshConfig",
+    "all_gather_rows",
+    "all_reduce_sum",
     "create_mesh",
     "data_sharding",
+    "hybrid_mesh",
+    "initialize_from_env",
     "model_sharding",
+    "process_info",
+    "reduce_scatter_rows",
     "replicated",
+    "ring_shift",
     "shard_batch",
+    "sharded_matmul_allreduce",
 ]

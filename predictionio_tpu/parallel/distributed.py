@@ -1,0 +1,90 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The reference scales out by launching Spark executors on a cluster (the
+``spark-submit`` boundary, ``RunWorkflow.scala:103-169``); the TPU-native
+analogue is one JAX process per host of a pod slice, joined through
+``jax.distributed``. Configuration is env-driven like the rest of the
+framework (SURVEY §5 config tiers):
+
+- ``PIO_DIST_COORDINATOR``   — ``host:port`` of process 0 (presence turns
+  multi-process mode on)
+- ``PIO_DIST_NUM_PROCESSES`` — world size
+- ``PIO_DIST_PROCESS_ID``    — this process's rank
+
+On TPU pods these usually come from the platform and plain
+``jax.distributed.initialize()`` autodetects them; the env vars are the
+explicit override path (self-managed clusters, CPU simulation).
+
+``hybrid_mesh`` builds the ICI×DCN mesh for multi-slice jobs: axes listed in
+``dcn_axes`` cross slice boundaries (data-parallel outermost, per the
+scaling-book recipe — only gradient/Gramian reductions ride DCN), everything
+else stays inside a slice on ICI.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize_from_env(env: Optional[Dict[str, str]] = None) -> bool:
+    """Join the multi-process runtime when configured; no-op otherwise.
+
+    Returns True when running multi-process (after initialization).
+    Idempotent: repeated calls are safe.
+    """
+    e = env if env is not None else os.environ
+    coordinator = e.get("PIO_DIST_COORDINATOR")
+    if not coordinator:
+        return False
+    if getattr(initialize_from_env, "_initialized", False):
+        return True
+    num = int(e.get("PIO_DIST_NUM_PROCESSES", "1"))
+    pid = int(e.get("PIO_DIST_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num, process_id=pid
+    )
+    initialize_from_env._initialized = True
+    return True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count) — (0, 1) in single-process mode."""
+    return jax.process_index(), jax.process_count()
+
+
+def hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Mesh spanning slices: ``dcn_axes`` (outermost) cross slice boundaries
+    over DCN, ``ici_axes`` stay within a slice on ICI.
+
+    Single-slice (or CPU-simulated) environments collapse to a plain mesh
+    with the same axis names, so sharding annotations written against a
+    hybrid mesh run anywhere.
+    """
+    from jax.experimental import mesh_utils
+
+    dcn_axes = dcn_axes or {}
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    dcn_shape = tuple(dcn_axes.values())
+    ici_shape = tuple(ici_axes.values())
+    n_needed = int(np.prod(dcn_shape + ici_shape, dtype=np.int64))
+    devices = jax.devices()
+    if len(devices) < n_needed:
+        raise ValueError(
+            f"hybrid mesh needs {n_needed} devices, have {len(devices)}"
+        )
+    if dcn_shape and jax.process_count() > 1:
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices[:n_needed]
+        )
+        # create_hybrid_device_mesh returns dcn-outermost grid
+        return Mesh(grid, names)
+    grid = np.array(devices[:n_needed]).reshape(dcn_shape + ici_shape)
+    return Mesh(grid, names)

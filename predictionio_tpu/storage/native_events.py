@@ -137,10 +137,13 @@ class NativeEventStore(EventStore):
             # with this id (scans are order-sensitive, so the fresh record
             # appended after it stays live). Harmless no-op for unseen ids.
             tomb = event_id.encode("utf-8")
-            self._lib.evlog_append(
+            toff = self._lib.evlog_append(
                 h, 1, _INT64_MIN, 0, 0, 0, 0, 0, 0, _fnv(event_id),
                 tomb, len(tomb),
             )
+            if toff < 0:
+                # an unrecorded tombstone would leave duplicate live records
+                raise OSError(f"evlog_append (upsert tombstone) failed: errno {-toff}")
         stored = dataclasses.replace(event, event_id=event_id)
         payload = json.dumps(stored.to_json_dict()).encode("utf-8")
         tt, ti = event.target_entity_type, event.target_entity_id
@@ -215,7 +218,10 @@ class NativeEventStore(EventStore):
         if f.has_target_entity_type is not None:
             has_target = 1 if f.has_target_entity_type else 0
 
-        cap = max(1024, int(self._lib.evlog_count(h)))
+        # Start with a bounded buffer; the n > cap retry below grows it to
+        # the exact match count (one extra scan worst-case) instead of
+        # allocating record-count-sized buffers for selective filters.
+        cap = min(max(1024, int(self._lib.evlog_count(h))), 65536)
         while True:
             out_off = np.empty(cap, dtype=np.int64)
             out_len = np.empty(cap, dtype=np.int64)
