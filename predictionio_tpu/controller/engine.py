@@ -278,7 +278,9 @@ class Engine:
 
         algorithms = variant.get("algorithms")
         if algorithms is None:
-            algo_list: List[Tuple[str, Params]] = [("", EmptyParams())]
+            algo_list: List[Tuple[str, Params]] = [
+                ("", _default_params(self.algorithm_class_map, ""))
+            ]
         else:
             algo_list = []
             for block in algorithms:
@@ -305,7 +307,7 @@ class Engine:
         (``Engine.scala:372-425``) — the deploy path's parameter source."""
         def parse(text: str, class_map: ClassMap, stage: str) -> Tuple[str, Params]:
             if not text:
-                return ("", EmptyParams())
+                return ("", _default_params(class_map, ""))
             obj = json.loads(text)
             name = obj.get("name", "")
             if name not in class_map:
@@ -332,7 +334,7 @@ class Engine:
                     (name, extract_params(_component_params_class(cls), block.get("params")))
                 )
         else:
-            algo_list = [("", EmptyParams())]
+            algo_list = [("", _default_params(self.algorithm_class_map, ""))]
         return EngineParams(
             data_source_params=parse(
                 instance.data_source_params, self.data_source_class_map, "datasource"
@@ -382,7 +384,7 @@ def _named_params(
     (``WorkflowUtils.scala:169-209``)."""
     block = variant.get(field)
     if block is None:
-        return ("", EmptyParams())
+        return ("", _default_params(class_map, ""))
     name = block.get("name", "")
     if name not in class_map:
         raise ParamsError(
@@ -390,9 +392,24 @@ def _named_params(
         )
     params_json = block.get("params")
     if params_json is None:
-        return (name, EmptyParams())
+        return (name, _default_params(class_map, name))
     cls = class_map[name]
     return (name, extract_params(_component_params_class(cls), params_json))
+
+
+def _default_params(class_map: ClassMap, name: str) -> Params:
+    """An absent params block means "the component's declared defaults", not
+    EmptyParams — otherwise a component whose ``params_class`` has required
+    behavior (e.g. SeqPreparator's seq_len) breaks when the variant omits
+    the block."""
+    cls = class_map.get(name)
+    if cls is None:
+        return EmptyParams()
+    params_cls = _component_params_class(cls)
+    try:
+        return params_cls()
+    except TypeError:  # params class with required fields: caller must supply
+        return EmptyParams()
 
 
 class SimpleEngine(Engine):

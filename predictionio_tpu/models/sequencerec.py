@@ -1,0 +1,396 @@
+"""Sequence-recommendation engine: transformer next-item prediction.
+
+The long-context upgrade of the reference's sequence machinery: where
+briandamage/PredictionIO offers only a first-order ``MarkovChain`` over item
+transitions (``e2/src/main/scala/io/prediction/e2/engine/MarkovChain.scala``),
+this engine models whole interaction histories with a causal transformer —
+same DASE shape as every other template (DataSource reads view/buy events,
+Preparator indexes items and builds windows, Algorithm trains, Serving
+answers ``queries.json``), but the context window is a first-class scaling
+axis: attention dispatches to ring or Ulysses sequence parallelism over the
+mesh ``seq`` axis for histories too long for one chip
+(:mod:`predictionio_tpu.ops.attention`).
+
+The transformer is deliberately framework-light (pure jax + optax pytrees,
+pre-LN blocks, tied input/output embeddings) so the model pytree persists
+through the standard model store like any other template's model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+)
+from ..ops.attention import attention
+from ..storage import BiMap, EventFilter, get_registry
+
+
+# -- query / result ---------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """Next-item query: by user history (``user``) or explicit recent items."""
+
+    user: Optional[str] = None
+    recent_items: Tuple[str, ...] = ()
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...] = ()
+
+
+# -- training data ----------------------------------------------------------
+@dataclasses.dataclass
+class TrainingData:
+    """Per-user, time-ordered item-id sequences."""
+
+    user_ids: List[str]
+    sequences: List[List[str]]
+
+    def sanity_check(self):
+        if not self.sequences:
+            raise ValueError("No interaction sequences found; check app id "
+                             "and event names.")
+
+
+@dataclasses.dataclass
+class PreparedData:
+    item_map: BiMap
+    windows: np.ndarray  # [W, seq_len + 1] int32, PAD = len(item_map)
+    user_recent: Dict[str, List[int]]  # tail of each user's history
+    seq_len: int
+
+    @property
+    def pad_id(self) -> int:
+        return len(self.item_map)
+
+
+# -- DASE components --------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SeqDataSourceParams(Params):
+    app_id: int = 1
+    event_names: Tuple[str, ...] = ("view", "buy")
+
+
+class SeqDataSource(DataSource):
+    """Orders each user's view/buy events by event time into one sequence."""
+
+    params_class = SeqDataSourceParams
+
+    def __init__(self, params: SeqDataSourceParams = SeqDataSourceParams()):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        store = get_registry().get_events()
+        cols = store.scan_columnar(
+            self.params.app_id,
+            EventFilter(event_names=list(self.params.event_names)),
+        )
+        by_user: Dict[str, List[Tuple[int, str]]] = {}
+        for uid, tid, tms in zip(
+            cols["entity_id"], cols["target_entity_id"],
+            cols["event_time_ms"].tolist(),
+        ):
+            if tid is None:
+                continue
+            by_user.setdefault(uid, []).append((tms, tid))
+        users, seqs = [], []
+        for uid, pairs in by_user.items():
+            pairs.sort(key=lambda p: p[0])
+            users.append(uid)
+            seqs.append([tid for _, tid in pairs])
+        return TrainingData(user_ids=users, sequences=seqs)
+
+    def read_eval(self, ctx):
+        """Leave-one-out: last item of each ≥2-length sequence is the label."""
+        td = self.read_training(ctx)
+        train_seqs, qa = [], []
+        users = []
+        for uid, seq in zip(td.user_ids, td.sequences):
+            if len(seq) >= 2:
+                train_seqs.append(seq[:-1])
+                users.append(uid)
+                qa.append(
+                    (Query(recent_items=tuple(seq[:-1]), num=10),
+                     ItemScore(item=seq[-1], score=1.0))
+                )
+            else:
+                train_seqs.append(seq)
+                users.append(uid)
+        return [(TrainingData(user_ids=users, sequences=train_seqs), None, qa)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqPreparatorParams(Params):
+    seq_len: int = 64
+    #: slide stride when a history is longer than seq_len + 1
+    window_stride: int = 32
+
+
+class SeqPreparator(Preparator):
+    """Item indexing + fixed-shape training windows (ragged histories become
+    left-padded ``[W, seq_len+1]`` blocks — the static-shape layout XLA
+    needs, same move as the ALS degree buckets)."""
+
+    params_class = SeqPreparatorParams
+
+    def __init__(self, params: SeqPreparatorParams = SeqPreparatorParams()):
+        self.params = params
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        L = self.params.seq_len
+        item_map = BiMap.string_int(
+            [i for seq in td.sequences for i in seq]
+        )
+        pad = len(item_map)
+        windows: List[np.ndarray] = []
+        user_recent: Dict[str, List[int]] = {}
+        for uid, seq in zip(td.user_ids, td.sequences):
+            idx = [item_map[i] for i in seq]
+            user_recent[uid] = idx[-L:]
+            if len(idx) < 2:
+                continue
+            span = L + 1
+            starts = list(range(0, max(1, len(idx) - span + 1),
+                                self.params.window_stride))
+            # anchor a final window on the newest interactions — a stride
+            # that doesn't divide the history must not drop the tail
+            if len(idx) > span and starts[-1] != len(idx) - span:
+                starts.append(len(idx) - span)
+            for s in starts:
+                w = idx[s : s + span]
+                if len(w) < span:
+                    w = [pad] * (span - len(w)) + w
+                windows.append(np.asarray(w, dtype=np.int32))
+        if not windows:
+            raise ValueError("No training windows (all histories length < 2)")
+        return PreparedData(
+            item_map=item_map,
+            windows=np.stack(windows),
+            user_recent=user_recent,
+            seq_len=L,
+        )
+
+
+# -- transformer ------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SeqRecAlgorithmParams(Params):
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    steps: int = 300
+    batch_size: int = 64
+    learning_rate: float = 1e-3
+    seed: int = 0
+    #: attention schedule: "flash" (single device), "ring", "ulysses",
+    #: or "auto" (ring when the ctx mesh has a seq axis of size > 1)
+    schedule: str = "flash"
+
+
+def _init_params(rng: np.random.Generator, vocab: int, p: SeqRecAlgorithmParams):
+    d = p.d_model
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return (rng.normal(size=shape) * scale).astype(np.float32)
+
+    layers = []
+    for _ in range(p.n_layers):
+        layers.append({
+            "ln1_g": np.ones(d, np.float32), "ln1_b": np.zeros(d, np.float32),
+            "qkv": w(d, 3 * d), "proj": w(d, d),
+            "ln2_g": np.ones(d, np.float32), "ln2_b": np.zeros(d, np.float32),
+            "mlp_in": w(d, 4 * d), "mlp_out": w(4 * d, d),
+        })
+    return {
+        "embed": w(vocab, d, scale=0.02),
+        "pos": w(2048, d, scale=0.02),  # max context 2048 positions
+        "layers": layers,
+        "lnf_g": np.ones(d, np.float32), "lnf_b": np.zeros(d, np.float32),
+    }
+
+
+def _layer_norm(x, g, b):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
+
+
+def forward(params, tokens, n_heads: int, mesh=None, schedule: str = "flash"):
+    """Causal LM forward: tokens [B, L] int32 → logits [B, L, V]."""
+    b, l = tokens.shape
+    d = params["embed"].shape[1]
+    h = params["embed"][tokens] + params["pos"][:l][None]
+    dh = d // n_heads
+    for layer in params["layers"]:
+        x = _layer_norm(h, layer["ln1_g"], layer["ln1_b"])
+        qkv = x @ layer["qkv"]  # [B, L, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, l, n_heads, dh).transpose(0, 2, 1, 3)
+
+        o = attention(
+            heads(q), heads(k), heads(v),
+            mesh=mesh if schedule in ("ring", "ulysses", "auto") else None,
+            causal=True,
+            schedule=schedule if schedule != "flash" else "auto",
+        )
+        o = o.transpose(0, 2, 1, 3).reshape(b, l, d)
+        h = h + o @ layer["proj"]
+        x = _layer_norm(h, layer["ln2_g"], layer["ln2_b"])
+        h = h + jax.nn.gelu(x @ layer["mlp_in"]) @ layer["mlp_out"]
+    h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
+    return h @ params["embed"].T  # tied softmax
+
+
+@dataclasses.dataclass
+class SeqRecModel:
+    """Trained transformer + id maps + per-user recent histories."""
+
+    params: dict  # numpy pytree
+    item_map: BiMap
+    user_recent: Dict[str, List[int]]
+    seq_len: int
+    n_heads: int
+
+    def sanity_check(self):
+        flat, _ = jax.tree_util.tree_flatten(self.params)
+        for leaf in flat:
+            if not np.isfinite(np.asarray(leaf)).all():
+                raise ValueError("sequencerec produced non-finite weights")
+
+    def device_params(self):
+        """Device-resident weight pytree, uploaded once per model — serving
+        must not pay a full host→device weight transfer per query."""
+        cache = self.__dict__.get("_device_params")
+        if cache is None:
+            cache = jax.tree_util.tree_map(jnp.asarray, self.params)
+            self.__dict__["_device_params"] = cache
+        return cache
+
+    def __getstate__(self):
+        # never pickle the device cache (model blobs stay pure numpy)
+        state = dict(self.__dict__)
+        state.pop("_device_params", None)
+        return state
+
+
+class SeqRecAlgorithm(Algorithm):
+    """Causal-transformer next-item trainer (optax AdamW)."""
+
+    params_class = SeqRecAlgorithmParams
+
+    def __init__(self, params: SeqRecAlgorithmParams = SeqRecAlgorithmParams()):
+        self.params = params
+
+    def train(self, ctx, pd: PreparedData) -> SeqRecModel:
+        import optax
+
+        p = self.params
+        vocab = len(pd.item_map) + 1  # + PAD
+        pad_id = pd.pad_id
+        rng = np.random.default_rng(p.seed)
+        model_params = jax.tree_util.tree_map(
+            jnp.asarray, _init_params(rng, vocab, p)
+        )
+        mesh = ctx.mesh if (ctx is not None and p.schedule != "flash") else None
+
+        opt = optax.adamw(p.learning_rate)
+        opt_state = opt.init(model_params)
+
+        def loss_fn(mp, batch):
+            inp, tgt = batch[:, :-1], batch[:, 1:]
+            logits = forward(mp, inp, p.n_heads, mesh, p.schedule)
+            mask = (tgt != pad_id).astype(jnp.float32)
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            return (ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        @jax.jit
+        def step(mp, os_, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(mp, batch)
+            updates, os_ = opt.update(grads, os_, mp)
+            return optax.apply_updates(mp, updates), os_, loss
+
+        n = pd.windows.shape[0]
+        for i in range(p.steps):
+            take = rng.integers(0, n, size=min(p.batch_size, n))
+            batch = jnp.asarray(pd.windows[take])
+            model_params, opt_state, loss = step(model_params, opt_state, batch)
+        return SeqRecModel(
+            params=jax.tree_util.tree_map(np.asarray, model_params),
+            item_map=pd.item_map,
+            user_recent=pd.user_recent,
+            seq_len=pd.seq_len,
+            n_heads=p.n_heads,
+        )
+
+    # -- serving ----------------------------------------------------------
+    def _tokens_for(self, model: SeqRecModel, query: Query) -> Optional[List[int]]:
+        if query.recent_items:
+            idx = [
+                model.item_map[i]
+                for i in query.recent_items
+                if model.item_map.get(i) is not None
+            ]
+            return idx[-model.seq_len:] or None
+        if query.user is not None:
+            return model.user_recent.get(query.user)
+        return None
+
+    def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
+        recent = self._tokens_for(model, query)
+        if not recent:
+            return PredictedResult(item_scores=())
+        pad_id = len(model.item_map)
+        # left-pad to the training context length: one compiled shape for
+        # every query (the serving-cache move the scoring kernels also make)
+        seq = [pad_id] * (model.seq_len - len(recent)) + list(recent)
+        tokens = jnp.asarray(np.asarray(seq, np.int32)[None, :], jnp.int32)
+        logits = forward(model.device_params(), tokens, model.n_heads)[0, -1]
+        # Next-item prediction keeps previously-seen items eligible (Markov
+        # semantics: the next state may be a revisit) — only PAD is masked.
+        scores = np.array(jax.nn.log_softmax(logits))  # writable copy
+        scores[pad_id] = -np.inf
+        k = min(query.num, len(model.item_map))
+        top = np.argsort(-scores, kind="stable")[:k]
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.item_map.inverse[int(i)],
+                          score=float(scores[i]))
+                for i in top
+                if np.isfinite(scores[i])
+            )
+        )
+
+    def query_class(self):
+        return Query
+
+
+def engine_factory() -> Engine:
+    """EngineFactory for the sequence-recommendation template."""
+    return Engine(
+        {"": SeqDataSource},
+        {"": SeqPreparator},
+        {"transformer": SeqRecAlgorithm, "": SeqRecAlgorithm},
+        {"": FirstServing},
+    )

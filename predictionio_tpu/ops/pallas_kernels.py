@@ -39,6 +39,10 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
+#: exclusion-compare chunk width inside the kernel (VMEM tile [B, T, C])
+_EXCL_CHUNK = 16
+
+
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -83,8 +87,18 @@ def _topk_kernel(q_ref, items_ref, excl_ref, out_s_ref, out_i_ref, *,
         jnp.int32, (b, block_items), 1
     )
     scores = jnp.where(gidx < n_items, scores, _NEG_INF)
-    for e in range(n_excl):
-        scores = jnp.where(gidx == excl_ref[:, e][:, None], _NEG_INF, scores)
+    if n_excl:
+        # Exclusions in fixed-size chunks via fori_loop: program size stays
+        # O(1) in the exclusion-list width (the wrapper pads E to a multiple
+        # of the chunk); [B, T, C] compare tiles stay small in VMEM.
+        chunk = min(_EXCL_CHUNK, n_excl)
+
+        def body(i, sc):
+            ex = excl_ref[:, pl.ds(i * chunk, chunk)]  # [B, C]
+            hit = (gidx[:, :, None] == ex[:, None, :]).any(axis=-1)
+            return jnp.where(hit, _NEG_INF, sc)
+
+        scores = jax.lax.fori_loop(0, n_excl // chunk, body, scores)
 
     cand_s = jnp.concatenate([out_s_ref[:], scores], axis=1)
     cand_i = jnp.concatenate([out_i_ref[:], gidx], axis=1)
@@ -95,15 +109,14 @@ def _topk_kernel(q_ref, items_ref, excl_ref, out_s_ref, out_i_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "block_items", "interpret"),
+    static_argnames=("k", "block_items", "n_excl", "interpret"),
 )
 def _topk_streaming_call(query_vectors, item_factors, exclude_idx, k,
-                         block_items, interpret):
+                         block_items, n_excl, interpret):
     b, r = query_vectors.shape
     n_items = item_factors.shape[0]
     n_pad = _round_up(n_items, block_items)
     items = jnp.pad(item_factors, ((0, n_pad - n_items), (0, 0)))
-    n_excl = exclude_idx.shape[1]
     grid = n_pad // block_items
 
     kernel = functools.partial(
@@ -116,7 +129,7 @@ def _topk_streaming_call(query_vectors, item_factors, exclude_idx, k,
         in_specs=[
             pl.BlockSpec((b, r), lambda j: (0, 0)),
             pl.BlockSpec((block_items, r), lambda j: (j, 0)),
-            pl.BlockSpec((b, max(1, n_excl)), lambda j: (0, 0)),
+            pl.BlockSpec((b, exclude_idx.shape[1]), lambda j: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((b, k), lambda j: (0, 0)),
@@ -146,9 +159,29 @@ def top_k_streaming(
     never appears in results (-inf / -1 masking).
     """
     if not _HAVE_PALLAS:
-        from .scoring import top_k_for_vectors  # XLA fallback
+        # XLA fallback with the SAME contract: exclusions applied (dense
+        # mask) and k clamped/padded to the catalog size.
+        from .scoring import top_k_for_vectors
 
-        scores, idx = top_k_for_vectors(query_vectors, item_factors, k)
+        n_items = item_factors.shape[0]
+        k_eff = min(k, n_items)
+        mask = None
+        if exclude_idx is not None and exclude_idx.shape[1] > 0:
+            b = query_vectors.shape[0]
+            excl = jnp.asarray(exclude_idx, jnp.int32)
+            one_hot = jax.nn.one_hot(
+                jnp.where(excl >= 0, excl, n_items), n_items + 1,
+                dtype=jnp.bool_,
+            ).any(axis=1)[:, :n_items]
+            mask = one_hot
+        scores, idx = top_k_for_vectors(
+            query_vectors, item_factors, k_eff, exclude_mask=mask
+        )
+        if k_eff < k:
+            scores = jnp.pad(
+                scores, ((0, 0), (0, k - k_eff)), constant_values=-np.inf
+            )
+            idx = jnp.pad(idx, ((0, 0), (0, k - k_eff)), constant_values=-1)
         return scores, idx
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -165,18 +198,25 @@ def top_k_streaming(
     items = jnp.pad(
         jnp.asarray(item_factors, jnp.float32), ((0, 0), (0, r_pad - r))
     )
-    if exclude_idx is None:
+    if exclude_idx is None or exclude_idx.shape[1] == 0:
+        # n_excl=0 → the kernel skips exclusion entirely (the 1-wide filler
+        # column only exists because pallas inputs need a nonzero dim)
         excl = jnp.full((b_pad, 1), -1, dtype=jnp.int32)
+        n_excl = 0
     else:
         e = exclude_idx.shape[1]
+        e_pad = _round_up(e, min(_EXCL_CHUNK, e))
         excl = jnp.pad(
             jnp.asarray(exclude_idx, jnp.int32),
-            ((0, b_pad - b), (0, 0)),
+            ((0, b_pad - b), (0, e_pad - e)),
             constant_values=-1,
-        ) if e > 0 else jnp.full((b_pad, 1), -1, dtype=jnp.int32)
+        )
+        n_excl = e_pad
 
     block = min(block_items, _round_up(n_items, 128))
-    scores, idx = _topk_streaming_call(q, items, excl, k_eff, block, interpret)
+    scores, idx = _topk_streaming_call(
+        q, items, excl, k_eff, block, n_excl, interpret
+    )
     scores, idx = scores[:b], idx[:b]
     if k_eff < k:
         pad = k - k_eff

@@ -81,10 +81,14 @@ def hybrid_mesh(
             f"hybrid mesh needs {n_needed} devices, have {len(devices)}"
         )
     if dcn_shape and jax.process_count() > 1:
+        # create_hybrid_device_mesh wants same-rank per-axis shape pairs
+        # (elementwise product per axis): DCN axes get 1 on the ICI side and
+        # vice versa, so axis i spans dcn_i * ici_i devices.
+        mesh_shape = (1,) * len(dcn_shape) + ici_shape
+        dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
         grid = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devices[:n_needed]
+            mesh_shape, dcn_mesh_shape, devices=devices[:n_needed]
         )
-        # create_hybrid_device_mesh returns dcn-outermost grid
         return Mesh(grid, names)
     grid = np.array(devices[:n_needed]).reshape(dcn_shape + ici_shape)
     return Mesh(grid, names)

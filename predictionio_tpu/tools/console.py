@@ -292,9 +292,24 @@ def _emit(obj) -> None:
 
 
 def _spawn(module: str, argv: Sequence[str]) -> int:
-    """Child-process launch, the spark-submit analogue
-    (``RunWorkflow.scala:103-169``)."""
+    """Blocking child-process launch, the spark-submit analogue for batch
+    runs — train/eval wait for completion (``RunWorkflow.scala:103-169``)."""
     return subprocess.call([sys.executable, "-m", module, *argv])
+
+
+def _spawn_detached(module: str, argv: Sequence[str]) -> int:
+    """Detached child-process launch for long-running servers: ``deploy
+    --spawn`` returns immediately with the server pid (the reference's
+    RunServer child, ``RunServer.scala:77-126`` — its CLI parent exits and
+    the driver JVM keeps serving; ``undeploy`` stops it over HTTP)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, *argv],
+        start_new_session=True,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    _emit({"spawned": module, "pid": proc.pid})
+    return EXIT_OK
 
 
 def _workflow_argv(args: argparse.Namespace, extra: Sequence[str] = ()) -> List[str]:
@@ -417,7 +432,7 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         if args.accesskey:
             srv_argv += ["--accesskey", args.accesskey]
         if args.spawn:
-            return _spawn("predictionio_tpu.tools.run_server", srv_argv)
+            return _spawn_detached("predictionio_tpu.tools.run_server", srv_argv)
         srv_args = run_server.build_parser().parse_args(srv_argv)
         run_server.make_server(srv_args, registry, block=True)
         return EXIT_OK

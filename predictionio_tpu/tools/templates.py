@@ -72,6 +72,26 @@ _TEMPLATES: Dict[str, Dict[str, object]] = {
             ],
         },
     },
+    "sequencerec": {
+        "blurb": "Transformer next-item prediction over interaction histories",
+        "factory": "predictionio_tpu.models.sequencerec",
+        "variant": {
+            "id": "default",
+            "description": "Sequence-recommendation engine (TPU transformer)",
+            "engineFactory": "engine:engine_factory",
+            "datasource": {"params": {"app_id": 1}},
+            "algorithms": [
+                {
+                    "name": "transformer",
+                    "params": {
+                        "d_model": 64,
+                        "n_layers": 2,
+                        "steps": 300,
+                    },
+                }
+            ],
+        },
+    },
     "ecommerce": {
         "blurb": "E-commerce recommendation with live serving-time filters",
         "factory": "predictionio_tpu.models.ecommerce",

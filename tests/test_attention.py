@@ -1,0 +1,91 @@
+"""Attention schedules: flash vs naive, ring/Ulysses vs flash on the mesh.
+
+The sequence-parallel schedules must be numerically equivalent to plain
+attention — the mesh changes the communication pattern, never the math.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.attention import (
+    attention,
+    flash_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from predictionio_tpu.parallel import MeshConfig, create_mesh
+
+
+def naive(q, k, v, causal):
+    d = q.shape[-1]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((q.shape[2], k.shape[2]), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    shape = (2, 4, 64, 16)  # B, H, L, D
+    return tuple(rng.normal(size=shape).astype(np.float32) for _ in range(3))
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return create_mesh(MeshConfig((("seq", 8),)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block_k", [16, 64, 48])
+def test_flash_matches_naive(qkv, causal, block_k):
+    q, k, v = qkv
+    ref = naive(q, k, v, causal)
+    got = np.asarray(flash_attention(q, k, v, causal=causal, block_k=block_k))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_naive(qkv, seq_mesh, causal):
+    q, k, v = qkv
+    ref = naive(q, k, v, causal)
+    got = np.asarray(ring_attention(q, k, v, seq_mesh, causal=causal))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_naive(qkv, causal):
+    # H=4 heads need a 4-device seq axis (heads must divide)
+    mesh4 = create_mesh(
+        MeshConfig((("seq", 4),)), devices=jax.devices()[:4]
+    )
+    q, k, v = qkv
+    ref = naive(q, k, v, causal)
+    got = np.asarray(ulysses_attention(q, k, v, mesh4, causal=causal))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_dispatch(qkv, seq_mesh):
+    q, k, v = qkv
+    # no mesh → flash; mesh → ring; both equal naive
+    ref = naive(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(attention(q, k, v)), ref, rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(attention(q, k, v, mesh=seq_mesh)), ref, rtol=2e-4, atol=2e-5
+    )
+    with pytest.raises(ValueError):
+        attention(q, k, v, mesh=seq_mesh, schedule="bogus")
+
+
+def test_ring_rejects_indivisible_length(seq_mesh):
+    rng = np.random.default_rng(1)
+    q, k, v = (rng.normal(size=(1, 2, 60, 8)).astype(np.float32)
+               for _ in range(3))
+    with pytest.raises(AssertionError):
+        ring_attention(q, k, v, seq_mesh)

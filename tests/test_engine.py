@@ -235,11 +235,15 @@ class TestJsonToEngineParams:
         )
         assert ep.serving_params == ("", IdParams(id=8))
 
-    def test_missing_fields_default_empty(self):
+    def test_missing_fields_use_component_defaults(self):
+        # An absent params block yields the component's declared default
+        # Params (its params_class()), not EmptyParams — a component with
+        # meaningful defaults (e.g. a preparator's seq_len) must still work
+        # when the variant omits the block.
         engine = make_engine()
         ep = engine.json_to_engine_params({"engineFactory": "f"})
-        assert ep.data_source_params == ("", EmptyParams())
-        assert ep.algorithm_params_list == (("", EmptyParams()),)
+        assert ep.data_source_params == ("", DSParams())
+        assert ep.algorithm_params_list == (("", IdParams()),)
 
     def test_unknown_algorithm_name_rejected(self):
         engine = make_engine()

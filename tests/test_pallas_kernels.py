@@ -79,3 +79,38 @@ def test_user_gather_wrapper_agrees_with_xla_path():
     assert (np.asarray(i1) == np.asarray(i2)).all() or np.allclose(
         np.asarray(s1), np.asarray(s2)
     )
+
+
+def test_fallback_path_contract(monkeypatch):
+    """The no-pallas fallback must honor exclusions and k > catalog."""
+    import predictionio_tpu.ops.pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_HAVE_PALLAS", False)
+    rng = np.random.default_rng(4)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    items = rng.normal(size=(20, 8)).astype(np.float32)
+    s0, i0 = pk.top_k_streaming(q, items, 2)
+    excl = np.concatenate(
+        [np.asarray(i0), np.full((3, 2), -1, np.int32)], axis=1
+    ).astype(np.int32)
+    s, i = pk.top_k_streaming(q, items, 5, exclude_idx=jnp.asarray(excl))
+    for row in range(3):
+        assert not set(np.asarray(i)[row]).intersection(set(np.asarray(i0)[row]))
+    s2, i2 = pk.top_k_streaming(q, items, 25)
+    assert s2.shape == (3, 25)
+    assert np.isneginf(np.asarray(s2)[:, 20:]).all()
+
+
+def test_wide_exclusion_list():
+    """Exclusion lists wider than the kernel chunk (fori_loop path)."""
+    rng = np.random.default_rng(5)
+    b, n, r = 2, 300, 8
+    q = rng.normal(size=(b, r)).astype(np.float32)
+    items = rng.normal(size=(n, r)).astype(np.float32)
+    # exclude the top 40 of each row (several 16-wide chunks + padding)
+    _, i0 = top_k_streaming(q, items, 40, block_items=128)
+    s, i = top_k_streaming(
+        q, items, 10, exclude_idx=np.asarray(i0, np.int32), block_items=128
+    )
+    for row in range(b):
+        assert not set(np.asarray(i)[row]).intersection(set(np.asarray(i0)[row]))

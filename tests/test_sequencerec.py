@@ -1,0 +1,198 @@
+"""Sequence-recommendation engine: transformer next-item prediction.
+
+Toy data with a deterministic transition pattern (item i is always followed
+by item i+1 mod V) — the trained model must put the correct next item in its
+top predictions, and the whole DASE chain must run through the Engine.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.models.sequencerec import (
+    PreparedData,
+    Query,
+    SeqDataSource,
+    SeqDataSourceParams,
+    SeqPreparator,
+    SeqPreparatorParams,
+    SeqRecAlgorithm,
+    SeqRecAlgorithmParams,
+    TrainingData,
+    engine_factory,
+)
+from predictionio_tpu.storage import Event, get_registry
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+V = 12  # vocabulary of items i0..i11
+
+
+def cyclic_training_data(n_users=30, length=40, seed=0):
+    rng = np.random.default_rng(seed)
+    users, seqs = [], []
+    for u in range(n_users):
+        start = int(rng.integers(0, V))
+        seqs.append([f"i{(start + t) % V}" for t in range(length)])
+        users.append(f"u{u}")
+    return TrainingData(user_ids=users, sequences=seqs)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    td = cyclic_training_data()
+    pd = SeqPreparator(SeqPreparatorParams(seq_len=16, window_stride=8)).prepare(
+        None, td
+    )
+    algo = SeqRecAlgorithm(
+        SeqRecAlgorithmParams(
+            d_model=32, n_heads=2, n_layers=2, steps=250, batch_size=32,
+            learning_rate=3e-3, seed=0,
+        )
+    )
+    model = algo.train(None, pd)
+    return algo, model
+
+
+class TestPreparator:
+    def test_windows_and_padding(self):
+        td = TrainingData(
+            user_ids=["a", "b"],
+            sequences=[["x", "y", "z"], ["y"]],
+        )
+        pd = SeqPreparator(SeqPreparatorParams(seq_len=4)).prepare(None, td)
+        assert pd.windows.shape[1] == 5
+        # short history is left-padded with the PAD id
+        assert pd.windows[0, 0] == pd.pad_id
+        # single-item user contributes recents but no window
+        assert pd.user_recent["b"] == [pd.item_map["y"]]
+
+    def test_empty_histories_rejected(self):
+        td = TrainingData(user_ids=["a"], sequences=[["x"]])
+        with pytest.raises(ValueError):
+            SeqPreparator().prepare(None, td)
+
+
+class TestModelQuality:
+    def test_learns_cycle(self, trained):
+        algo, model = trained
+        hits = 0
+        for start in range(V):
+            recent = tuple(f"i{(start + t) % V}" for t in range(8))
+            res = algo.predict(model, Query(recent_items=recent, num=3))
+            want = f"i{(start + 8) % V}"
+            got = [s.item for s in res.item_scores]
+            hits += want in got
+        assert hits >= 10, f"only {hits}/12 cycle continuations in top-3"
+
+    def test_user_history_query(self, trained):
+        algo, model = trained
+        res = algo.predict(model, Query(user="u0", num=5))
+        assert len(res.item_scores) == 5
+        # never recommends items in the user's recent window context? at
+        # minimum: scores are finite and sorted descending
+        scores = [s.score for s in res.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty(self, trained):
+        algo, model = trained
+        assert algo.predict(model, Query(user="nobody")).item_scores == ()
+
+    def test_sanity_check(self, trained):
+        _, model = trained
+        model.sanity_check()
+
+
+class TestSequenceParallelTraining:
+    def test_ring_schedule_trains(self):
+        from predictionio_tpu.parallel.mesh import MeshConfig
+
+        td = cyclic_training_data(n_users=8, length=20)
+        pd = SeqPreparator(SeqPreparatorParams(seq_len=8)).prepare(None, td)
+        ctx = WorkflowContext(mesh_config=MeshConfig((("seq", 8),)))
+        algo = SeqRecAlgorithm(
+            SeqRecAlgorithmParams(
+                d_model=16, n_heads=2, n_layers=1, steps=5, schedule="ring"
+            )
+        )
+        model = algo.train(ctx, pd)
+        model.sanity_check()
+
+
+class TestEngineIntegration:
+    def test_datasource_orders_by_time(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        get_registry(refresh=True)
+        store = get_registry().get_events()
+        store.init(7)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        # insert out of order; sequence must come back time-ordered
+        for i in [2, 0, 1]:
+            store.insert(
+                Event(event="view", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      event_time=t0 + dt.timedelta(minutes=i)),
+                7,
+            )
+        td = SeqDataSource(SeqDataSourceParams(app_id=7)).read_training(None)
+        assert td.sequences[td.user_ids.index("u1")] == ["i0", "i1", "i2"]
+        get_registry(refresh=True)
+
+    def test_engine_train_and_eval_chain(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        get_registry(refresh=True)
+        store = get_registry().get_events()
+        store.init(9)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        for u in range(6):
+            for t in range(12):
+                store.insert(
+                    Event(event="view", entity_type="user",
+                          entity_id=f"u{u}",
+                          target_entity_type="item",
+                          target_entity_id=f"i{(u + t) % 6}",
+                          event_time=t0 + dt.timedelta(minutes=t)),
+                    9,
+                )
+        engine = engine_factory()
+        algo_params = SeqRecAlgorithmParams(
+            d_model=16, n_heads=2, n_layers=1, steps=10)
+        ep = EngineParams(
+            data_source_params=("", SeqDataSourceParams(app_id=9)),
+            preparator_params=("", SeqPreparatorParams(seq_len=8)),
+            algorithm_params_list=[("", algo_params)],
+        )
+        ctx = WorkflowContext()
+        models = engine.train(ctx, ep)
+        assert len(models) == 1
+        algo = SeqRecAlgorithm(algo_params)
+        preds = algo.predict(
+            models[0], Query(recent_items=("i0", "i1"), num=3)
+        )
+        assert len(preds.item_scores) <= 3
+        get_registry(refresh=True)
+
+
+class TestWindowTail:
+    def test_tail_window_anchored(self):
+        # stride not dividing the history: newest items must appear
+        td = TrainingData(
+            user_ids=["a"],
+            sequences=[[f"x{i}" for i in range(96)]],
+        )
+        pd = SeqPreparator(
+            SeqPreparatorParams(seq_len=64, window_stride=32)
+        ).prepare(None, td)
+        last = pd.item_map["x95"]
+        assert (pd.windows == last).any(), "newest interaction not in any window"
+
+    def test_device_params_not_pickled(self, trained):
+        import pickle
+
+        _, model = trained
+        model.device_params()  # populate cache
+        blob = pickle.dumps(model)
+        clone = pickle.loads(blob)
+        assert "_device_params" not in clone.__dict__

@@ -181,7 +181,8 @@ def test_import_rejects_bad_lines(registry):
 
 def test_template_list_and_get(tmp_path):
     names = {t["name"] for t in list_templates()}
-    assert names == {"recommendation", "classification", "similarproduct", "ecommerce"}
+    assert names == {"recommendation", "classification", "similarproduct",
+                     "ecommerce", "sequencerec"}
     target = tmp_path / "proj"
     out = get_template("recommendation", str(target))
     assert os.path.exists(target / "engine.json")
