@@ -10,6 +10,7 @@ each stage, static train (``Engine.scala:499-586``) and eval
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import logging
@@ -41,6 +42,10 @@ from .dase import (
 from .params import EmptyParams, Params, ParamsError, extract_params, params_to_json
 
 logger = logging.getLogger(__name__)
+
+
+def _null_phase(name: str):
+    return contextlib.nullcontext()
 
 ClassMap = Dict[str, Type]
 
@@ -144,9 +149,12 @@ class Engine:
         data_source = self._data_source(engine_params)
         preparator = self._preparator(engine_params)
         algorithms = self._algorithms(engine_params)
+        timer = getattr(ctx, "timer", None)
+        timed = timer.time if timer is not None else _null_phase
 
         try:
-            training_data = data_source.read_training(ctx)
+            with timed("read"):
+                training_data = data_source.read_training(ctx)
         except Exception as exc:
             # Engine.scala:517-524 wraps read errors with a storage hint.
             raise RuntimeError(
@@ -158,15 +166,20 @@ class Engine:
         if workflow_params.stop_after_read:
             raise StopAfterReadInterruption()
 
-        prepared_data = preparator.prepare(ctx, training_data)
+        with timed("prepare"):
+            prepared_data = preparator.prepare(ctx, training_data)
         if not workflow_params.skip_sanity_check:
             run_sanity_check(prepared_data, "prepared data")
         if workflow_params.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
         models = []
-        for algo in algorithms:
-            model = algo.train(ctx, prepared_data)
+        for i, algo in enumerate(algorithms):
+            if ctx is not None:
+                # lets algorithms namespace per-run resources (checkpoints)
+                ctx.algorithm_index = i
+            with timed(f"train[{i}]"):
+                model = algo.train(ctx, prepared_data)
             if not workflow_params.skip_sanity_check:
                 run_sanity_check(model, "model")
             models.append(model)

@@ -186,6 +186,9 @@ class ALSAlgorithmParams(Params):
     #: the factor-table layout (see :func:`ops.als.als_train`).
     distributed: bool = False
     factor_sharding: str = "replicated"
+    #: checkpoint factor tables every N iterations (0 = off); a rerun of the
+    #: same workflow resumes from the newest step
+    checkpoint_every: int = 0
 
 
 @dataclasses.dataclass
@@ -226,6 +229,15 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
         )
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
+        checkpoint = None
+        if p.checkpoint_every > 0 and ctx is not None:
+            manager_factory = getattr(ctx, "checkpoint_manager", None)
+            if manager_factory:
+                # one namespace per algorithm slot: a second ALS block in the
+                # same engine must never resume from this one's factors
+                checkpoint = manager_factory(
+                    subdir=f"algo_{getattr(ctx, 'algorithm_index', 0)}"
+                )
         factors = als_train_coo(
             pd.users,
             pd.items,
@@ -235,6 +247,8 @@ class ALSAlgorithm(Algorithm):
             cfg=cfg,
             mesh=mesh,
             factor_sharding=p.factor_sharding,
+            checkpoint=checkpoint,
+            checkpoint_every=p.checkpoint_every,
         )
         return ALSModel(
             rank=p.rank,

@@ -206,7 +206,10 @@ class SeqRecAlgorithmParams(Params):
     schedule: str = "flash"
 
 
-def _init_params(rng: np.random.Generator, vocab: int, p: SeqRecAlgorithmParams):
+def _init_params(
+    rng: np.random.Generator, vocab: int, p: SeqRecAlgorithmParams,
+    max_positions: int,
+):
     d = p.d_model
 
     def w(*shape, scale=None):
@@ -223,7 +226,8 @@ def _init_params(rng: np.random.Generator, vocab: int, p: SeqRecAlgorithmParams)
         })
     return {
         "embed": w(vocab, d, scale=0.02),
-        "pos": w(2048, d, scale=0.02),  # max context 2048 positions
+        # sized to the training context (pd.seq_len): no silent cap
+        "pos": w(max_positions, d, scale=0.02),
         "layers": layers,
         "lnf_g": np.ones(d, np.float32), "lnf_b": np.zeros(d, np.float32),
     }
@@ -239,6 +243,12 @@ def forward(params, tokens, n_heads: int, mesh=None, schedule: str = "flash"):
     """Causal LM forward: tokens [B, L] int32 → logits [B, L, V]."""
     b, l = tokens.shape
     d = params["embed"].shape[1]
+    max_pos = params["pos"].shape[0]
+    if l > max_pos:
+        raise ValueError(
+            f"sequence length {l} exceeds the model's positional table "
+            f"({max_pos} positions — trained with a shorter seq_len)"
+        )
     h = params["embed"][tokens] + params["pos"][:l][None]
     dh = d // n_heads
     for layer in params["layers"]:
@@ -311,7 +321,7 @@ class SeqRecAlgorithm(Algorithm):
         pad_id = pd.pad_id
         rng = np.random.default_rng(p.seed)
         model_params = jax.tree_util.tree_map(
-            jnp.asarray, _init_params(rng, vocab, p)
+            jnp.asarray, _init_params(rng, vocab, p, max_positions=pd.seq_len)
         )
         mesh = ctx.mesh if (ctx is not None and p.schedule != "flash") else None
 
@@ -369,16 +379,16 @@ class SeqRecAlgorithm(Algorithm):
         logits = forward(model.device_params(), tokens, model.n_heads)[0, -1]
         # Next-item prediction keeps previously-seen items eligible (Markov
         # semantics: the next state may be a revisit) — only PAD is masked.
-        scores = np.array(jax.nn.log_softmax(logits))  # writable copy
-        scores[pad_id] = -np.inf
+        # Top-k on device: no full-catalog sort on the serving hot path.
         k = min(query.num, len(model.item_map))
-        top = np.argsort(-scores, kind="stable")[:k]
+        scores = jax.nn.log_softmax(logits).at[pad_id].set(-jnp.inf)
+        top_s, top_i = jax.lax.top_k(scores, k)
+        top_s, top_i = np.asarray(top_s), np.asarray(top_i)
         return PredictedResult(
             item_scores=tuple(
-                ItemScore(item=model.item_map.inverse[int(i)],
-                          score=float(scores[i]))
-                for i in top
-                if np.isfinite(scores[i])
+                ItemScore(item=model.item_map.inverse[int(i)], score=float(s))
+                for s, i in zip(top_s, top_i)
+                if np.isfinite(s)
             )
         )
 

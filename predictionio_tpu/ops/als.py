@@ -388,6 +388,8 @@ def als_train(
     cfg: ALSConfig,
     mesh=None,
     factor_sharding: str = "replicated",
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> ALSFactors:
     """Alternating solves: items → users → items … for ``cfg.iterations``.
 
@@ -441,7 +443,35 @@ def als_train(
     ub, ib = _bucket_tensors(by_user), _bucket_tensors(by_item)
     lam, alpha = jnp.float32(cfg.lambda_), jnp.float32(cfg.alpha)
     x = None
-    for _ in range(cfg.iterations):
+
+    # step-level resume (SURVEY §5: strictly better than the reference's
+    # run-to-completion-or-die ALS). A checkpoint is only resumed when its
+    # FULL training configuration matches — rank/shape alone is not identity
+    # (two algorithm blocks can share shapes but differ in lambda/seed).
+    ck_meta = {
+        "rank": rank,
+        "lambda": float(cfg.lambda_),
+        "alpha": float(cfg.alpha),
+        "implicit": bool(cfg.implicit_prefs),
+        "seed": int(cfg.seed),
+        "nnz": int(by_user.nnz),
+    }
+    start = 0
+    if checkpoint is not None and checkpoint.latest_step() is not None:
+        step, tree, meta = checkpoint.restore(like={"x": 0, "y": 0})
+        if (
+            all(meta.get(k) == v for k, v in ck_meta.items())
+            and tuple(tree["y"].shape) == (by_item.n_rows, rank)
+            and tuple(tree["x"].shape) == (by_user.n_rows, rank)
+            and step <= cfg.iterations
+        ):
+            x = jnp.asarray(tree["x"])
+            y = jnp.asarray(tree["y"])
+            if mesh is not None:
+                x, y = jax.device_put(x, tbl_spec), jax.device_put(y, tbl_spec)
+            start = step
+
+    for i in range(start, cfg.iterations):
         x, y = iteration(
             ub, ib, y, lam, alpha,
             rank=rank,
@@ -449,6 +479,17 @@ def als_train(
             n_users=by_user.n_rows,
             n_items=by_item.n_rows,
         )
+        done = i + 1
+        if (
+            checkpoint is not None
+            and checkpoint_every > 0
+            and (done % checkpoint_every == 0 or done == cfg.iterations)
+        ):
+            checkpoint.save(
+                done,
+                {"x": np.asarray(x), "y": np.asarray(y)},
+                {**ck_meta, "iteration": done},
+            )
     return ALSFactors(user_factors=x, item_factors=y, rank=rank)
 
 
@@ -461,12 +502,15 @@ def als_train_coo(
     cfg: ALSConfig,
     mesh=None,
     factor_sharding: str = "replicated",
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> ALSFactors:
     """Convenience: COO triplets → bucketized both ways → train."""
     by_user = bucketize(users, items, ratings, n_users, n_items)
     by_item = bucketize(items, users, ratings, n_items, n_users)
     return als_train(
-        by_user, by_item, cfg, mesh=mesh, factor_sharding=factor_sharding
+        by_user, by_item, cfg, mesh=mesh, factor_sharding=factor_sharding,
+        checkpoint=checkpoint, checkpoint_every=checkpoint_every,
     )
 
 

@@ -25,9 +25,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import secrets
 import subprocess
 import sys
+import time
 import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
@@ -299,16 +301,43 @@ def _spawn(module: str, argv: Sequence[str]) -> int:
 
 def _spawn_detached(module: str, argv: Sequence[str]) -> int:
     """Detached child-process launch for long-running servers: ``deploy
-    --spawn`` returns immediately with the server pid (the reference's
-    RunServer child, ``RunServer.scala:77-126`` — its CLI parent exits and
-    the driver JVM keeps serving; ``undeploy`` stops it over HTTP)."""
-    proc = subprocess.Popen(
-        [sys.executable, "-m", module, *argv],
-        start_new_session=True,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
-    _emit({"spawned": module, "pid": proc.pid})
+    --spawn`` returns with the server pid (the reference's RunServer child,
+    ``RunServer.scala:77-126`` — its CLI parent exits and the driver JVM
+    keeps serving; ``undeploy`` stops it over HTTP).
+
+    The child's output goes to a log file under ``$PIO_FS_BASEDIR/logs``
+    and a short liveness poll catches immediate failures (bad port, broken
+    engine dir) instead of reporting a dead pid as success."""
+    from ..storage.registry import base_dir
+
+    log_dir = os.path.join(base_dir(), "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    log_path = os.path.join(log_dir, f"{module.rsplit('.', 1)[-1]}-{stamp}.log")
+    with open(log_path, "ab") as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", module, *argv],
+            start_new_session=True,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+    # liveness poll: long enough to catch startup failures that surface
+    # after the (slow) jax import; a healthy server costs the full window,
+    # still far below the reference's spark-submit launch time
+    deadline = time.monotonic() + 4.0
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(0.2)
+    if proc.poll() is not None:
+        with open(log_path, "rb") as f:
+            tail = f.read()[-2000:].decode("utf-8", "replace")
+        _emit({
+            "error": f"spawned {module} exited immediately "
+                     f"(code {proc.returncode})",
+            "log": log_path,
+            "log_tail": tail,
+        })
+        return EXIT_FAIL
+    _emit({"spawned": module, "pid": proc.pid, "log": log_path})
     return EXIT_OK
 
 

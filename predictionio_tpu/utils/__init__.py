@@ -1,0 +1,5 @@
+"""Shared utilities (profiling, logging helpers)."""
+
+from .profiling import StepTimer, device_trace
+
+__all__ = ["StepTimer", "device_trace"]

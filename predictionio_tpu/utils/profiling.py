@@ -1,0 +1,73 @@
+"""Profiling hooks: phase timers + device traces.
+
+The reference's observability is request counters on the serving page and
+the Spark UI for everything else (SURVEY §5 "Tracing / profiling"). Here
+every workflow run carries a :class:`StepTimer` (phase wall-clock, exposed
+in logs and queryable from the context), and :func:`device_trace` wraps
+``jax.profiler.trace`` so a run can emit a TensorBoard-loadable device
+profile with one env var (``PIO_PROFILE_DIR``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class StepTimer:
+    """Accumulates named phase timings (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: Dict[str, list] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._records.setdefault(name, []).append(float(seconds))
+
+    @contextlib.contextmanager
+    def time(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": len(vals),
+                    "total_s": sum(vals),
+                    "mean_s": sum(vals) / len(vals),
+                    "max_s": max(vals),
+                }
+                for name, vals in self._records.items()
+                if vals
+            }
+
+    def format_summary(self) -> str:
+        parts = [
+            f"{name}: {s['total_s']:.3f}s"
+            + (f" ({s['count']}x, mean {s['mean_s']:.3f}s)" if s["count"] > 1 else "")
+            for name, s in sorted(self.summary().items())
+        ]
+        return "; ".join(parts) or "(no phases recorded)"
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` wrapper: no-op when ``logdir`` is falsy or the
+    profiler is unavailable; otherwise writes a TensorBoard trace."""
+    if not logdir:
+        yield
+        return
+    try:
+        import jax.profiler as profiler
+    except Exception:
+        yield
+        return
+    with profiler.trace(logdir):
+        yield

@@ -1,0 +1,143 @@
+"""Step-level checkpoint/resume for training runs.
+
+The reference checkpoints only whole trained models (Kryo blob per
+EngineInstance, ``CoreWorkflow.scala:71-73``) — a crash mid-ALS means
+retraining from scratch (SURVEY §5 "Checkpoint / resume"). Here training
+loops save their state pytree every N steps and resume from the newest
+valid step: strictly better, same external API.
+
+Format: one directory per step (``step_<n>/``) holding an ``arrays.npz``
+with '/'-joined pytree paths as keys, a ``meta.json`` with user metadata,
+and a ``_COMPLETE`` marker written last — a checkpoint without the marker
+(crash mid-save) is ignored and cleaned up on the next save. No dependency
+on checkpoint-library APIs; any pytree of numpy/jax arrays round-trips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_SEP = "/"
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Pytree (nested dict/list/tuple of arrays) → {path: array}."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = str(k)
+            if _SEP in key:
+                raise ValueError(f"checkpoint dict keys may not contain '/': {key!r}")
+            out.update(_flatten(v, f"{prefix}{key}{_SEP}"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}{_SEP}"))
+    else:
+        out[prefix.rstrip(_SEP)] = np.asarray(tree)
+    return out
+
+
+def _unflatten_into(like: Any, flat: Dict[str, np.ndarray], prefix: str = "") -> Any:
+    """Rebuild ``like``'s structure with arrays from ``flat``."""
+    if isinstance(like, dict):
+        return {
+            k: _unflatten_into(v, flat, f"{prefix}{k}{_SEP}")
+            for k, v in like.items()
+        }
+    if isinstance(like, tuple):
+        return tuple(
+            _unflatten_into(v, flat, f"{prefix}{i}{_SEP}")
+            for i, v in enumerate(like)
+        )
+    if isinstance(like, list):
+        return [
+            _unflatten_into(v, flat, f"{prefix}{i}{_SEP}")
+            for i, v in enumerate(like)
+        ]
+    key = prefix.rstrip(_SEP)
+    if key not in flat:
+        raise KeyError(f"checkpoint missing array {key!r}")
+    return flat[key]
+
+
+class CheckpointManager:
+    """Save/restore/prune step checkpoints under one run directory."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- introspection ----------------------------------------------------
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(
+                os.path.join(self.directory, name, "_COMPLETE")
+            ):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    # -- save -------------------------------------------------------------
+    def save(self, step: int, tree: Any, metadata: Optional[dict] = None) -> None:
+        d = self._step_dir(step)
+        if os.path.exists(d):
+            shutil.rmtree(d)  # replace an incomplete/old attempt
+        os.makedirs(d)
+        flat = _flatten(tree)
+        np.savez(os.path.join(d, "arrays.npz"), **flat)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(metadata or {}, f)
+        with open(os.path.join(d, "_COMPLETE"), "w") as f:
+            f.write("ok")
+        self._prune()
+
+    def _prune(self) -> None:
+        steps = self.all_steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # drop incomplete directories (crashed saves)
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and not os.path.exists(
+                os.path.join(self.directory, name, "_COMPLETE")
+            ):
+                if int(m.group(1)) not in steps:
+                    shutil.rmtree(
+                        os.path.join(self.directory, name), ignore_errors=True
+                    )
+
+    # -- restore ----------------------------------------------------------
+    def restore(
+        self, step: Optional[int] = None, like: Any = None
+    ) -> Tuple[int, Any, dict]:
+        """(step, pytree, metadata). ``like`` gives the structure to rebuild
+        (arrays in ``like`` are placeholders); without it a flat
+        {path: array} dict is returned."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        d = self._step_dir(step)
+        if not os.path.exists(os.path.join(d, "_COMPLETE")):
+            raise FileNotFoundError(f"checkpoint step {step} is incomplete")
+        with np.load(os.path.join(d, "arrays.npz")) as z:
+            flat = {k: z[k] for k in z.files}
+        with open(os.path.join(d, "meta.json")) as f:
+            metadata = json.load(f)
+        tree = _unflatten_into(like, flat) if like is not None else flat
+        return step, tree, metadata

@@ -48,6 +48,29 @@ class WorkflowContext:
         self._mesh_config = mesh_config
         self._devices = devices
         self._mesh = None
+        #: per-run phase timings (read/prepare/train/...), always available
+        from ..utils.profiling import StepTimer
+
+        self.timer = StepTimer()
+        #: set by the training workflow to the run's checkpoint directory;
+        #: algorithms with step checkpointing call ``checkpoint_manager()``
+        self.checkpoint_dir: Optional[str] = None
+
+    def checkpoint_manager(self, subdir: Optional[str] = None, keep: int = 3):
+        """CheckpointManager for this run, or None when the workflow did not
+        assign a checkpoint directory (e.g. bare Engine.train in tests).
+
+        ``subdir`` namespaces independent training loops sharing one run —
+        e.g. each algorithm of a multi-algorithm engine — so one loop never
+        resumes from another's state."""
+        if not self.checkpoint_dir:
+            return None
+        from .checkpoint import CheckpointManager
+
+        d = self.checkpoint_dir
+        if subdir:
+            d = os.path.join(d, subdir)
+        return CheckpointManager(d, keep=keep)
 
     @property
     def app_name(self) -> str:

@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import pickle
+import re
+import shutil
 from typing import Any, List, Optional, Sequence
 
 from ..controller.engine import (
@@ -63,8 +66,22 @@ def run_train(
     instance_id = md.engine_instance_insert(instance)
 
     ctx = ctx or WorkflowContext(mode="Training", batch=workflow_params.batch)
+    if ctx.checkpoint_dir is None:
+        from ..storage.registry import base_dir
+
+        # Stable across reruns of the same workflow (NOT the per-run
+        # instance id): a crashed run's rerun finds and resumes these
+        # checkpoints; a successful run deletes them below.
+        slug = re.sub(r"[^A-Za-z0-9_.-]", "_", workflow_params.batch) or "default"
+        ctx.checkpoint_dir = os.path.join(
+            base_dir(), "checkpoints", engine_id, engine_version, slug
+        )
     try:
-        models = engine.train(ctx, engine_params, workflow_params)
+        from ..utils.profiling import device_trace
+
+        with device_trace(os.environ.get("PIO_PROFILE_DIR")):
+            models = engine.train(ctx, engine_params, workflow_params)
+        logger.info("train phases: %s", ctx.timer.format_summary())
         persisted = engine.make_serializable_models(
             ctx, engine_params, instance_id, models
         )
@@ -79,6 +96,9 @@ def run_train(
             )
         )
         logger.info("Training completed; engine instance %s", instance_id)
+        # resume data is only for crashed runs — a completed run clears it
+        # (also bounds disk: no snapshot outlives its run's success)
+        shutil.rmtree(ctx.checkpoint_dir, ignore_errors=True)
         return instance_id
     except KeyboardInterrupt:
         # CoreWorkflow.scala:83-88: interruptions leave the INIT row behind.

@@ -1,0 +1,231 @@
+"""Step checkpoint/resume + profiling hooks.
+
+The reference retrains from scratch on any mid-train crash (SURVEY §5);
+these tests pin the stronger contract: ALS resumes from the newest complete
+checkpoint and produces the same factors as an uninterrupted run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+from predictionio_tpu.utils.profiling import StepTimer, device_trace
+from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+
+def toy_ratings(seed=0):
+    rng = np.random.default_rng(seed)
+    n_users, n_items, nnz = 60, 30, 1500
+    users = rng.integers(0, n_users, nnz)
+    items = rng.integers(0, n_items, nnz)
+    ratings = rng.uniform(1, 5, nnz).astype(np.float32)
+    return users, items, ratings, n_users, n_items
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        tree = {"a": np.arange(6).reshape(2, 3), "nest": [np.ones(4), np.zeros(2)]}
+        cm.save(3, tree, {"k": "v"})
+        step, got, meta = cm.restore(like={"a": 0, "nest": [0, 0]})
+        assert step == 3 and meta == {"k": "v"}
+        np.testing.assert_array_equal(got["a"], tree["a"])
+        np.testing.assert_array_equal(got["nest"][0], tree["nest"][0])
+
+    def test_flat_restore_without_template(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(1, {"x": np.ones(3)})
+        _, flat, _ = cm.restore()
+        assert set(flat) == {"x"}
+
+    def test_prune_keeps_newest(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path), keep=2)
+        for s in (1, 2, 3, 4):
+            cm.save(s, {"x": np.full(2, s)})
+        assert cm.all_steps() == [3, 4]
+        assert cm.latest_step() == 4
+
+    def test_incomplete_checkpoint_ignored(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(1, {"x": np.ones(2)})
+        # simulate a crash mid-save: step dir without the _COMPLETE marker
+        os.makedirs(tmp_path / "step_2")
+        (tmp_path / "step_2" / "arrays.npz").write_bytes(b"torn")
+        assert cm.latest_step() == 1
+        step, _, _ = cm.restore()
+        assert step == 1
+
+    def test_restore_empty_raises(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            cm.restore()
+
+    def test_slash_in_key_rejected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(ValueError):
+            cm.save(1, {"a/b": np.ones(1)})
+
+
+class TestALSResume:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        users, items, ratings, nu, ni = toy_ratings()
+        cfg = ALSConfig(rank=6, iterations=6, lambda_=0.05, seed=0)
+        full = als_train_coo(users, items, ratings, nu, ni, cfg)
+
+        # interrupted run: 3 iterations, checkpointing every step
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        cfg3 = ALSConfig(rank=6, iterations=3, lambda_=0.05, seed=0)
+        als_train_coo(users, items, ratings, nu, ni, cfg3,
+                      checkpoint=cm, checkpoint_every=1)
+        assert cm.latest_step() == 3
+
+        # resumed run: picks up at step 3, finishes the remaining 3
+        resumed = als_train_coo(users, items, ratings, nu, ni, cfg,
+                                checkpoint=cm, checkpoint_every=1)
+        np.testing.assert_allclose(
+            np.asarray(full.user_factors),
+            np.asarray(resumed.user_factors),
+            rtol=1e-4, atol=1e-5,
+        )
+        assert cm.latest_step() == 6
+
+    def test_stale_checkpoint_shape_mismatch_ignored(self, tmp_path):
+        users, items, ratings, nu, ni = toy_ratings()
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        cm.save(2, {"x": np.ones((5, 5)), "y": np.ones((4, 5))},
+                {"rank": 5, "iteration": 2})
+        cfg = ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0)
+        out = als_train_coo(users, items, ratings, nu, ni, cfg,
+                            checkpoint=cm, checkpoint_every=2)
+        assert out.user_factors.shape == (nu, 6)
+
+
+class TestProfiling:
+    def test_step_timer(self):
+        t = StepTimer()
+        with t.time("read"):
+            pass
+        t.record("train[0]", 1.5)
+        t.record("train[0]", 0.5)
+        s = t.summary()
+        assert s["train[0]"]["count"] == 2
+        assert s["train[0]"]["total_s"] == 2.0
+        assert "read" in t.format_summary()
+
+    def test_device_trace_noop_and_real(self, tmp_path):
+        with device_trace(None):
+            pass
+        with device_trace(str(tmp_path / "prof")):
+            import jax.numpy as jnp
+
+            jnp.ones(4).sum().block_until_ready()
+
+    def test_workflow_records_phases(self):
+        from predictionio_tpu.workflow.context import WorkflowContext
+
+        ctx = WorkflowContext()
+        with ctx.timer.time("read"):
+            pass
+        assert "read" in ctx.timer.summary()
+
+    def test_engine_train_times_phases(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from sample_engine import (
+            Algo0, DataSource0, DSParams, IdParams, Preparator0, Serving0,
+        )
+
+        from predictionio_tpu.controller.engine import Engine, EngineParams
+        from predictionio_tpu.workflow.context import WorkflowContext
+
+        engine = Engine(DataSource0, Preparator0, Algo0, Serving0)
+        ctx = WorkflowContext()
+        engine.train(
+            ctx,
+            EngineParams(
+                data_source_params=("", DSParams(id=1)),
+                preparator_params=("", IdParams(id=1)),
+                algorithm_params_list=[("", IdParams(id=1))],
+            ),
+        )
+        phases = ctx.timer.summary()
+        assert {"read", "prepare", "train[0]"} <= set(phases)
+
+
+class TestCheckpointIdentity:
+    def test_different_hyperparams_do_not_resume(self, tmp_path):
+        # same shapes, different lambda: the checkpoint must be ignored
+        users, items, ratings, nu, ni = toy_ratings()
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        cfg_a = ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0)
+        als_train_coo(users, items, ratings, nu, ni, cfg_a,
+                      checkpoint=cm, checkpoint_every=1)
+        cfg_b = ALSConfig(rank=6, iterations=2, lambda_=0.5, seed=0)
+        fresh = als_train_coo(users, items, ratings, nu, ni, cfg_b)
+        maybe_resumed = als_train_coo(users, items, ratings, nu, ni, cfg_b,
+                                      checkpoint=cm, checkpoint_every=0)
+        np.testing.assert_allclose(
+            np.asarray(fresh.user_factors),
+            np.asarray(maybe_resumed.user_factors),
+            rtol=1e-5,
+        )
+
+    def test_multi_algo_namespacing(self, tmp_path, monkeypatch):
+        # two ALS blocks in one engine: each gets its own checkpoint subdir
+        import datetime as dt
+
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        from predictionio_tpu.storage import Event, get_registry
+
+        get_registry(refresh=True)
+        store = get_registry().get_events()
+        store.init(3)
+        rng = np.random.default_rng(0)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        store.write(
+            [Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                   target_entity_type="item", target_entity_id=f"i{i}",
+                   properties={"rating": float(r)}, event_time=t0)
+             for u, i, r in zip(rng.integers(0, 20, 300),
+                                rng.integers(0, 10, 300),
+                                rng.uniform(1, 5, 300))],
+            3,
+        )
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithmParams, RecDataSourceParams, engine_factory)
+        from predictionio_tpu.workflow.context import WorkflowContext
+
+        ctx = WorkflowContext()
+        ctx.checkpoint_dir = str(tmp_path / "run-ck")
+        ep = EngineParams(
+            data_source_params=("", RecDataSourceParams(
+                app_id=3, event_names=("rate",))),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2,
+                                           lambda_=0.05, checkpoint_every=1)),
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2,
+                                           lambda_=0.9, seed=7,
+                                           checkpoint_every=1)),
+            ],
+        )
+        models = engine_factory().train(ctx, ep)
+        assert (tmp_path / "run-ck" / "algo_0").exists()
+        assert (tmp_path / "run-ck" / "algo_1").exists()
+        # different hyperparams must produce different factors
+        assert not np.allclose(models[0].user_factors, models[1].user_factors)
+        get_registry(refresh=True)
+
+
+def test_spawn_detached_reports_dead_child(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    from predictionio_tpu.tools.console import EXIT_FAIL, _spawn_detached
+
+    rc = _spawn_detached("predictionio_tpu.tools.run_server",
+                         ["--bogus-flag-that-does-not-exist"])
+    assert rc == EXIT_FAIL
+    logs = list((tmp_path / "logs").glob("*.log"))
+    assert logs and logs[0].stat().st_size > 0
