@@ -45,6 +45,26 @@ def base_dir(env: Optional[Dict[str, str]] = None) -> str:
     )
 
 
+def make_event_store(stype: str, root: str) -> EventStore:
+    """Event-store factory: the single place mapping a source ``type`` string
+    to a backend and its on-disk layout (used by the registry and by
+    ``pio upgrade``, so the two can never diverge)."""
+    if stype in ("sqlite", "localfs"):
+        return SqliteEventStore(os.path.join(root, "events.db"))
+    if stype == "memory":
+        return SqliteEventStore(":memory:")
+    if stype == "native":
+        try:
+            from .native_events import NativeEventStore
+        except ImportError as exc:
+            raise StorageError(
+                "native event store backend is not built "
+                f"(predictionio_tpu.storage.native_events): {exc}"
+            ) from exc
+        return NativeEventStore(os.path.join(root, "events_native"))
+    raise StorageError(f"Unknown event store type {stype!r}")
+
+
 class StorageRegistry:
     """Lazily-constructed, cached storage clients keyed by source name."""
 
@@ -106,26 +126,10 @@ class StorageRegistry:
         with self._lock:
             if name not in self._event_stores:
                 conf = self._source_conf(name)
-                stype = conf.get("type", "sqlite")
-                if stype in ("sqlite", "localfs"):
-                    self._event_stores[name] = SqliteEventStore(
-                        self._source_path(name, "events.db")
-                    )
-                elif stype == "memory":
-                    self._event_stores[name] = SqliteEventStore(":memory:")
-                elif stype == "native":
-                    try:
-                        from .native_events import NativeEventStore
-                    except ImportError as exc:
-                        raise StorageError(
-                            "native event store backend is not built "
-                            f"(predictionio_tpu.storage.native_events): {exc}"
-                        ) from exc
-                    self._event_stores[name] = NativeEventStore(
-                        self._source_path(name, "events_native")
-                    )
-                else:
-                    raise StorageError(f"Unknown event store type {stype!r}")
+                self._event_stores[name] = make_event_store(
+                    conf.get("type", "sqlite"),
+                    conf.get("path", base_dir(self._env)),
+                )
             return self._event_stores[name]
 
     def get_metadata(self) -> MetadataStore:

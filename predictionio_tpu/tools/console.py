@@ -274,6 +274,18 @@ def build_parser() -> argparse.ArgumentParser:
     tp_get = tp_sub.add_parser("get")
     tp_get.add_argument("template_name")
     tp_get.add_argument("directory")
+
+    up = sub.add_parser(
+        "upgrade", help="migrate event data between storage backends"
+    )
+    up.add_argument("--from-type", required=True,
+                    choices=("sqlite", "native"))
+    up.add_argument("--from-path", required=True)
+    up.add_argument("--to-type", required=True,
+                    choices=("sqlite", "native"))
+    up.add_argument("--to-path", required=True)
+    up.add_argument("--appid", type=int, action="append", default=None,
+                    help="app to migrate (repeatable; default: all apps)")
     return p
 
 
@@ -492,6 +504,15 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         result = status(registry)
         _emit(result)
         return EXIT_OK if result["ok"] else EXIT_FAIL
+
+    if cmd == "upgrade":
+        from .upgrade import run_upgrade
+
+        _emit(run_upgrade(
+            registry, args.from_type, args.from_path,
+            args.to_type, args.to_path, app_ids=args.appid,
+        ))
+        return EXIT_OK
 
     if cmd == "export":
         from .export_events import export_events

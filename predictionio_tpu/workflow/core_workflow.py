@@ -66,6 +66,7 @@ def run_train(
     instance_id = md.engine_instance_insert(instance)
 
     ctx = ctx or WorkflowContext(mode="Training", batch=workflow_params.batch)
+    derived_checkpoint_dir = False
     if ctx.checkpoint_dir is None:
         from ..storage.registry import base_dir
 
@@ -76,6 +77,7 @@ def run_train(
         ctx.checkpoint_dir = os.path.join(
             base_dir(), "checkpoints", engine_id, engine_version, slug
         )
+        derived_checkpoint_dir = True
     try:
         from ..utils.profiling import device_trace
 
@@ -96,9 +98,11 @@ def run_train(
             )
         )
         logger.info("Training completed; engine instance %s", instance_id)
-        # resume data is only for crashed runs — a completed run clears it
-        # (also bounds disk: no snapshot outlives its run's success)
-        shutil.rmtree(ctx.checkpoint_dir, ignore_errors=True)
+        if derived_checkpoint_dir:
+            # resume data is only for crashed runs — a completed run clears
+            # it (bounds disk). Only the path THIS function derived is
+            # deleted; a caller-supplied directory may be shared.
+            shutil.rmtree(ctx.checkpoint_dir, ignore_errors=True)
         return instance_id
     except KeyboardInterrupt:
         # CoreWorkflow.scala:83-88: interruptions leave the INIT row behind.

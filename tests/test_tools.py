@@ -380,3 +380,71 @@ def test_dashboard_lists_evaluations(registry):
     finally:
         server.stop_async()
         server.server_close()
+
+
+def test_upgrade_migrates_between_backends(tmp_path, monkeypatch):
+    """pio upgrade: sqlite → native migration preserves every event."""
+    import datetime as dt
+
+    from predictionio_tpu.storage.data_map import DataMap
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SqliteEventStore
+    from predictionio_tpu.storage.native_events import NativeEventStore
+    from predictionio_tpu.tools.upgrade import migrate_events
+
+    src = SqliteEventStore(str(tmp_path / "src" / "events.db"))
+    src.init(1)
+    src.init(2)
+    t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+    for i in range(25):
+        src.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{i % 3}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": float(i % 5)}),
+                  event_time=t0 + dt.timedelta(minutes=i)),
+            1 if i % 2 else 2,
+        )
+    dst = NativeEventStore(str(tmp_path / "dst"))
+    counts = migrate_events(src, dst, [1, 2])
+    assert counts == {1: 13, 2: 12} or counts == {1: 12, 2: 13}
+    for app in (1, 2):
+        src_events = {e.event_id: e for e in src.find(app)}
+        dst_events = {e.event_id: e for e in dst.find(app)}
+        assert set(src_events) == set(dst_events)
+        for eid, e in src_events.items():
+            got = dst_events[eid]
+            assert got.properties.to_dict() == e.properties.to_dict()
+            assert got.event_time == e.event_time
+    # idempotent: rerunning does not duplicate (upsert by event id)
+    counts2 = migrate_events(src, dst, [1])
+    assert sum(1 for _ in dst.find(1)) == counts2[1] == counts[1]
+    src.close(); dst.close()
+
+
+def test_upgrade_cli(tmp_path, monkeypatch):
+    import json as _json
+
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SqliteEventStore
+    from predictionio_tpu.tools.console import main
+
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path / "base"))
+    from predictionio_tpu.storage import get_registry
+
+    get_registry(refresh=True)
+    src = SqliteEventStore(str(tmp_path / "a" / "events.db"))
+    src.init(5)
+    src.insert(Event(event="x", entity_type="t", entity_id="1"), 5)
+    src.close()
+    rc = main([
+        "upgrade", "--from-type", "sqlite", "--from-path", str(tmp_path / "a"),
+        "--to-type", "native", "--to-path", str(tmp_path / "b"),
+        "--appid", "5",
+    ])
+    assert rc == 0
+    from predictionio_tpu.storage.native_events import NativeEventStore
+
+    dst = NativeEventStore(str(tmp_path / "b" / "events_native"))
+    assert sum(1 for _ in dst.find(5)) == 1
+    dst.close()
+    get_registry(refresh=True)
