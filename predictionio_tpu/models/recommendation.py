@@ -13,7 +13,7 @@ predict is the batched gather-dot top-k kernel
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -21,7 +21,11 @@ from ..controller import (
     Algorithm,
     DataSource,
     Engine,
+    EngineParams,
+    Evaluation,
+    EngineParamsGenerator,
     FirstServing,
+    OptionAverageMetric,
     Params,
     Preparator,
 )
@@ -317,3 +321,64 @@ def engine_factory() -> Engine:
         {"als": ALSAlgorithm, "": ALSAlgorithm},
         {"": FirstServing},
     )
+
+
+# -- evaluation (reference evaluation example: Precision@K on MovieLens,
+#    examples/experimental/scala-local-movielens-evaluation/src/main/scala/
+#    Evaluation.scala:83,115) --------------------------------------------
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of relevant held-out interactions recovered in the top-k.
+
+    A held-out (query, actual) row counts only when the actual rating meets
+    ``rating_threshold`` (irrelevant rows are skipped — the Option part);
+    the point score is 1.0 when the actual item appears in the predicted
+    top-k."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 4.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k} (threshold={self.rating_threshold})"
+
+    def calculate_point(self, q, p, a) -> Optional[float]:
+        if a.score < self.rating_threshold:
+            return None
+        top = [s.item for s in p.item_scores[: self.k]]
+        return 1.0 if a.item in top else 0.0
+
+
+class RecEvaluation(Evaluation):
+    """``pio eval`` target for this template."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 4.0):
+        super().__init__()
+        self.engine_metric = (
+            engine_factory(),
+            PrecisionAtK(k=k, rating_threshold=rating_threshold),
+        )
+
+
+class RecParamsGenerator(EngineParamsGenerator):
+    """Hyperparameter grid over rank x lambda (the reference example's
+    EngineParamsGenerator pattern)."""
+
+    def __init__(
+        self,
+        app_id: int = 1,
+        ranks: Sequence[int] = (8, 16),
+        lambdas: Sequence[float] = (0.01, 0.1),
+    ):
+        base_ds = RecDataSourceParams(app_id=app_id)
+        grid = [
+            EngineParams(
+                data_source_params=("", base_ds),
+                algorithm_params_list=[
+                    ("als", ALSAlgorithmParams(rank=r, lambda_=lam)),
+                ],
+            )
+            for r in ranks
+            for lam in lambdas
+        ]
+        super().__init__(grid)

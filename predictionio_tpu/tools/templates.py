@@ -47,6 +47,19 @@ _TEMPLATES: Dict[str, Dict[str, object]] = {
                 }
             ],
         },
+        "evaluation": '''"""Evaluation: Precision@K over a rank x lambda grid.
+
+Run with:  pio eval --evaluation-class evaluation:RecEvaluation \\
+                    --engine-params-generator-class evaluation:RecParamsGenerator
+(the reference movielens-evaluation example's shape).
+"""
+
+from predictionio_tpu.models.recommendation import (  # noqa: F401
+    PrecisionAtK,
+    RecEvaluation,
+    RecParamsGenerator,
+)
+''',
     },
     "classification": {
         "blurb": "Naive Bayes / random forest over entity properties",
@@ -132,4 +145,9 @@ def get_template(name: str, directory: str) -> dict:
         fh.write("\n")
     with open(os.path.join(directory, "engine.py"), "w", encoding="utf-8") as fh:
         fh.write(_engine_py(str(spec["factory"]), str(spec["blurb"])))
+    if "evaluation" in spec:
+        with open(
+            os.path.join(directory, "evaluation.py"), "w", encoding="utf-8"
+        ) as fh:
+            fh.write(str(spec["evaluation"]))
     return {"template": name, "directory": directory}
