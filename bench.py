@@ -1,7 +1,8 @@
 """Benchmark: ALS rank-50 on a MovieLens-20M-shaped workload.
 
-Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+Prints ONE JSON line on stdout:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``
+(diagnostics go to stderr).
 
 The north-star target (BASELINE.json) is MLlib ALS rank-50 on MovieLens-20M
 training in < 60 s on a v5e-8 at RMSE parity. This bench runs on whatever
@@ -12,19 +13,93 @@ wall-clock includes bucketization, host→device staging and training — and
 verifies holdout RMSE approaches the noise floor (quality gate; the run
 fails loudly rather than reporting a fast-but-wrong number).
 
+Bring-up: before committing to the full workload the bench probes the
+device with a tiny op in a subprocess (a wedged accelerator tunnel would
+otherwise hang or stack-trace the whole run). One retry, then a clean
+fallback to the CPU backend at reduced scale — a measured number on a
+fallback device beats a traceback.
+
 ``vs_baseline`` = 60 s / measured train seconds (>1 beats the 8-chip target
 even on this single chip).
 
 Env knobs: ``BENCH_SCALE`` (default 1.0) scales the rating count for quick
-smoke runs; ``BENCH_ITERATIONS`` (default 10).
+smoke runs; ``BENCH_ITERATIONS`` (default 10); ``BENCH_CPU_SCALE`` (default
+0.01) is the scale used when falling back to CPU.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+#: v5e peak is 197 TFLOP/s bf16 (MXU); the solves here run f32, so treat
+#: ~half of that as the attainable ceiling for the MFU estimate.
+_V5E_PEAK_F32 = 98.5e12
+
+_PROBE_SNIPPET = (
+    "import jax, sys; "
+    "d = jax.devices(); "
+    "x = jax.numpy.ones((128, 128)) @ jax.numpy.ones((128, 128)); "
+    "x.block_until_ready(); "
+    "print('PROBE_OK', d[0].platform, len(d), file=sys.stderr)"
+)
+
+
+def probe_device(timeout_s: float = 240.0) -> str:
+    """Run a tiny device op in a subprocess with a timeout. Returns "ok",
+    "failed" (fast error — worth one retry), or "timeout" (unresponsive
+    tunnel; killing the child may wedge it further, so the caller should
+    go straight to fallback rather than re-probe)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            timeout=timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"bench bring-up: device probe timed out after {timeout_s:.0f}s "
+            "(accelerator tunnel unresponsive)",
+            file=sys.stderr,
+        )
+        return "timeout"
+    tail = proc.stderr.decode("utf-8", "replace").strip().splitlines()
+    if proc.returncode == 0 and any("PROBE_OK" in ln for ln in tail):
+        print(f"bench bring-up: {[l for l in tail if 'PROBE_OK' in l][0]}",
+              file=sys.stderr)
+        return "ok"
+    last = tail[-1] if tail else "(no stderr)"
+    print(
+        f"bench bring-up: device probe failed rc={proc.returncode}: {last}",
+        file=sys.stderr,
+    )
+    return "failed"
+
+
+def _fallback_to_cpu(scale: float) -> int:
+    """Re-exec this script hard-pinned to the CPU backend at reduced scale.
+    The child's stdout (the JSON line) passes straight through."""
+    sys.path.insert(0, _REPO_ROOT)
+    from predictionio_tpu.utils.platform import force_cpu_env
+
+    cpu_scale = min(scale, float(os.environ.get("BENCH_CPU_SCALE", "0.01")))
+    env = force_cpu_env()
+    env["_PIO_BENCH_CHILD"] = "cpu-fallback"
+    env["BENCH_SCALE"] = str(cpu_scale)
+    print(
+        f"bench bring-up: falling back to CPU backend at scale {cpu_scale}",
+        file=sys.stderr,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env, cwd=_REPO_ROOT
+    )
+    return proc.returncode
 
 
 def synth_ml20m(scale: float, seed: int = 0):
@@ -50,10 +125,7 @@ def synth_ml20m(scale: float, seed: int = 0):
     return users, items, ratings, n_users, n_items
 
 
-def main() -> int:
-    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
-    iterations = int(os.environ.get("BENCH_ITERATIONS", "10"))
-
+def run_bench(scale: float, iterations: int, fallback: str) -> int:
     import jax
 
     from predictionio_tpu.ops.als import (
@@ -86,14 +158,17 @@ def main() -> int:
     np.asarray(als_train(wu, wi, warm_cfg).user_factors)
     del wu, wi
 
+    profile: dict = {}
     t0 = time.time()
+    t_b = time.monotonic()
     by_user = stage(
         bucketize(users[tr], items[tr], ratings[tr], n_users, n_items)
     )
     by_item = stage(
         bucketize(items[tr], users[tr], ratings[tr], n_items, n_users)
     )
-    factors = als_train(by_user, by_item, cfg)
+    bucketize_stage_s = time.monotonic() - t_b
+    factors = als_train(by_user, by_item, cfg, profile=profile)
     # force full materialization: block_until_ready alone does not
     # synchronize through some remote-device relays
     np.asarray(factors.user_factors)
@@ -101,36 +176,81 @@ def main() -> int:
     train_s = time.time() - t0
 
     holdout = rmse(factors, users[test], items[test], ratings[test])
+
+    iter_s = profile.get("iteration_s", [])
+    flops = profile.get("flops_per_iteration", 0.0)
+    avg_iter = float(np.mean(iter_s)) if iter_s else 0.0
+    tflops_per_s = (flops / avg_iter / 1e12) if avg_iter else 0.0
+    mfu = (flops / avg_iter / _V5E_PEAK_F32) if avg_iter else 0.0
+
+    record = {
+        "metric": "ml20m_als_rank50_train_s",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": round(60.0 / train_s, 2),
+        "holdout_rmse": round(holdout, 4),
+        "nnz": int(tr.sum()),
+        "scale": scale,
+        "iterations": iterations,
+        "device": str(jax.devices()[0]),
+        "bucketize_stage_s": round(bucketize_stage_s, 3),
+        "iteration_s": [round(s, 4) for s in iter_s],
+        "est_tflops_per_s": round(tflops_per_s, 2),
+        "est_mfu_f32_v5e": round(mfu, 4),
+        "bucket_shapes": profile.get("bucket_shapes"),
+    }
+    if fallback:
+        # A fallback run measures a shrunken workload on the wrong device:
+        # the headline comparison must not claim the baseline was beaten.
+        record["fallback"] = fallback
+        record["vs_baseline"] = 0.0
     # quality gate: noise floor is 0.5; MLlib-parity training lands near it.
     if holdout > 0.62:
+        record["vs_baseline"] = 0.0
+        record["error"] = f"holdout RMSE {holdout:.4f} failed quality gate"
+        print(json.dumps(record))
+        return 1
+    print(json.dumps(record))
+    return 0
+
+
+def main() -> int:
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    iterations = int(os.environ.get("BENCH_ITERATIONS", "10"))
+    fallback = os.environ.get("_PIO_BENCH_CHILD", "")
+
+    if not fallback:
+        # Bring-up: probe the configured backend before the real workload.
+        # A fast failure gets one retry (transient tunnel hiccup); a
+        # timeout goes straight to fallback — the kill that ended the
+        # probe can itself wedge the tunnel, so re-probing is futile.
+        status = probe_device()
+        if status == "failed":
+            time.sleep(10.0)
+            status = probe_device()
+        if status != "ok":
+            return _fallback_to_cpu(scale)
+
+    try:
+        return run_bench(scale, iterations, fallback)
+    except Exception as exc:  # never leave the driver a bare traceback
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        if not fallback:
+            return _fallback_to_cpu(scale)
         print(
             json.dumps(
                 {
                     "metric": "ml20m_als_rank50_train_s",
-                    "value": round(train_s, 3),
+                    "value": -1.0,
                     "unit": "s",
                     "vs_baseline": 0.0,
-                    "error": f"holdout RMSE {holdout:.4f} failed quality gate",
+                    "error": f"{type(exc).__name__}: {exc}",
                 }
             )
         )
         return 1
-
-    print(
-        json.dumps(
-            {
-                "metric": "ml20m_als_rank50_train_s",
-                "value": round(train_s, 3),
-                "unit": "s",
-                "vs_baseline": round(60.0 / train_s, 2),
-                "holdout_rmse": round(holdout, 4),
-                "nnz": int(tr.sum()),
-                "scale": scale,
-                "device": str(jax.devices()[0]),
-            }
-        )
-    )
-    return 0
 
 
 if __name__ == "__main__":

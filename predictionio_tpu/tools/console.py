@@ -307,8 +307,18 @@ def _emit(obj) -> None:
 
 def _spawn(module: str, argv: Sequence[str]) -> int:
     """Blocking child-process launch, the spark-submit analogue for batch
-    runs — train/eval wait for completion (``RunWorkflow.scala:103-169``)."""
-    return subprocess.call([sys.executable, "-m", module, *argv])
+    runs — train/eval wait for completion (``RunWorkflow.scala:103-169``).
+
+    The child gets an explicit platform environment (``jax_child_env``):
+    a CPU-pinned parent produces a hard-pinned CPU child even when a
+    sitecustomize boot hook would otherwise drag the child onto an
+    accelerator backend (the spark-submit ``--env`` propagation analogue,
+    ``RunWorkflow.scala:37-40,169``)."""
+    from ..utils.platform import jax_child_env
+
+    return subprocess.call(
+        [sys.executable, "-m", module, *argv], env=jax_child_env()
+    )
 
 
 def _spawn_detached(module: str, argv: Sequence[str]) -> int:
@@ -326,12 +336,15 @@ def _spawn_detached(module: str, argv: Sequence[str]) -> int:
     os.makedirs(log_dir, exist_ok=True)
     stamp = time.strftime("%Y%m%d-%H%M%S")
     log_path = os.path.join(log_dir, f"{module.rsplit('.', 1)[-1]}-{stamp}.log")
+    from ..utils.platform import jax_child_env
+
     with open(log_path, "ab") as log_f:
         proc = subprocess.Popen(
             [sys.executable, "-m", module, *argv],
             start_new_session=True,
             stdout=log_f,
             stderr=subprocess.STDOUT,
+            env=jax_child_env(),
         )
     # liveness poll: long enough to catch startup failures that surface
     # after the (slow) jax import; a healthy server costs the full window,

@@ -64,6 +64,10 @@ def make_server(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    # Platform self-forcing before any backend init (see run_workflow.main).
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
     args = build_parser().parse_args(argv)
     make_server(args, block=True)
     return 0

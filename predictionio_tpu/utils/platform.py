@@ -1,0 +1,143 @@
+"""JAX platform selection that survives environment boot hooks.
+
+The reference's process model launches every train/eval/deploy run as a
+child JVM via spark-submit, propagating the parent's configuration
+explicitly (``tools/src/main/scala/io/prediction/tools/RunWorkflow.scala:
+103-169`` passes ``--env`` and SPARK_YARN_USER_ENV through). The TPU-native
+analogue has a sharper failure mode: deployment environments may install a
+``sitecustomize`` boot hook that registers an accelerator PJRT plugin in
+*every* Python interpreter and pins ``JAX_PLATFORMS`` to it. A child
+process that must run on the CPU backend (tests, multi-chip dry-runs on a
+virtual device mesh, CI) cannot rely on inheriting the parent's intent —
+the hook runs before any user code and may initialize the accelerator
+backend eagerly.
+
+This module centralizes the fix:
+
+- :func:`force_cpu_env` — build a child-process environment hard-pinned to
+  the CPU backend: sets ``JAX_PLATFORMS=cpu``, strips the accelerator boot
+  hook's trigger variables AND its ``PYTHONPATH`` entry (so the hook's
+  ``sitecustomize`` is never imported), and optionally forces an N-device
+  virtual CPU mesh via ``XLA_FLAGS=--xla_force_host_platform_device_count``.
+- :func:`jax_child_env` — environment for spawned workflow/server children:
+  if the current process is CPU-pinned (tests), children are CPU-pinned the
+  same hard way; otherwise the environment passes through untouched so
+  production children reach the real accelerator.
+- :func:`force_cpu_in_process` — best-effort in-process CPU pinning for
+  code that runs before any JAX backend initialization (mirrors
+  ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Mapping, Optional
+
+#: Env vars that trigger or configure accelerator boot hooks; removed when a
+#: child must come up on the CPU backend. (Prefixes.)
+_ACCEL_HOOK_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")
+
+#: PYTHONPATH entries containing these substrings carry boot-hook
+#: ``sitecustomize`` modules and are dropped for CPU children.
+_ACCEL_HOOK_PATH_MARKERS = ("axon_site",)
+
+_FORCE_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def _strip_hook_pythonpath(pythonpath: str) -> str:
+    parts = [
+        p
+        for p in pythonpath.split(os.pathsep)
+        if p and not any(m in p for m in _ACCEL_HOOK_PATH_MARKERS)
+    ]
+    return os.pathsep.join(parts)
+
+
+def force_cpu_env(
+    base: Optional[Mapping[str, str]] = None,
+    n_devices: Optional[int] = None,
+) -> Dict[str, str]:
+    """Child-process environment hard-pinned to the JAX CPU backend.
+
+    ``n_devices`` > 1 additionally forces a virtual CPU device mesh
+    (the test analogue of the reference's ``local[4]`` Spark master).
+    """
+    env = dict(base if base is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PIO_JAX_PLATFORM"] = "cpu"
+    for key in list(env):
+        if key.startswith(_ACCEL_HOOK_PREFIXES):
+            del env[key]
+    if "PYTHONPATH" in env:
+        stripped = _strip_hook_pythonpath(env["PYTHONPATH"])
+        if stripped:
+            env["PYTHONPATH"] = stripped
+        else:
+            del env["PYTHONPATH"]
+    if n_devices is not None:
+        flags = env.get("XLA_FLAGS", "")
+        flags = _FORCE_COUNT_RE.sub("", flags).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env
+
+
+def current_platform() -> str:
+    """The platform this process intends: explicit ``PIO_JAX_PLATFORM``
+    wins, then ``JAX_PLATFORMS``; empty string means 'let JAX choose'."""
+    plat = os.environ.get("PIO_JAX_PLATFORM") or os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+    return plat.split(",")[0].strip().lower()
+
+
+def jax_child_env(
+    base: Optional[Mapping[str, str]] = None,
+    n_devices: Optional[int] = None,
+) -> Dict[str, str]:
+    """Environment for a spawned workflow/server child process.
+
+    CPU-pinned parents (tests, dry-runs) produce hard-pinned CPU children —
+    inheriting ``JAX_PLATFORMS=cpu`` alone is NOT enough when a boot hook
+    registers an accelerator plugin eagerly. Anything else passes through
+    unchanged so production children reach the real device.
+    """
+    if current_platform() == "cpu":
+        return force_cpu_env(base, n_devices=n_devices)
+    return dict(base if base is not None else os.environ)
+
+
+def force_cpu_in_process() -> None:
+    """Pin THIS process to the CPU backend (only reliable before the first
+    JAX backend initialization). Mirrors ``tests/conftest.py``."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PIO_JAX_PLATFORM"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # jax missing/already initialized: env pin stands
+        pass
+
+
+def apply_env_platform() -> None:
+    """Entry-point hook for driver processes (run_workflow / run_server):
+    make the environment's platform choice stick. A boot hook's plugin
+    registration can programmatically override ``JAX_PLATFORMS=cpu``;
+    re-asserting via ``jax.config.update`` before any backend
+    initialization wins (same mechanism as tests/conftest.py)."""
+    if current_platform() == "cpu":
+        force_cpu_in_process()
+
+
+def cpu_device_count() -> Optional[int]:
+    """Number of visible CPU devices, or ``None`` when the CPU backend is
+    unavailable / cannot be queried without initializing an accelerator."""
+    try:
+        import jax
+
+        return len(jax.devices("cpu"))
+    except Exception:
+        return None

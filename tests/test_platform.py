@@ -1,0 +1,76 @@
+"""Platform self-forcing: the spawn/dry-run boundary must come up on the
+CPU backend regardless of accelerator boot hooks in the environment
+(the spark-submit env-propagation analogue, ``RunWorkflow.scala:37-40``)."""
+
+import os
+import subprocess
+import sys
+
+from predictionio_tpu.utils.platform import (
+    current_platform,
+    force_cpu_env,
+    jax_child_env,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_force_cpu_env_scrubs_boot_hook():
+    base = {
+        "JAX_PLATFORMS": "axon",
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "PALLAS_AXON_REMOTE_COMPILE": "1",
+        "AXON_LOOPBACK_RELAY": "1",
+        "TPU_WORKER_HOSTNAMES": "localhost",
+        "PYTHONPATH": "/root/.axon_site" + os.pathsep + "/somewhere/else",
+        "HOME": "/root",
+    }
+    env = force_cpu_env(base, n_devices=8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["PIO_JAX_PLATFORM"] == "cpu"
+    assert not any(k.startswith(("PALLAS_AXON", "AXON_", "TPU_")) for k in env)
+    assert "axon_site" not in env.get("PYTHONPATH", "")
+    assert "/somewhere/else" in env["PYTHONPATH"]
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["HOME"] == "/root"  # unrelated vars pass through
+
+
+def test_force_cpu_env_replaces_existing_device_count():
+    base = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --other"}
+    env = force_cpu_env(base, n_devices=8)
+    assert env["XLA_FLAGS"].count("device_count") == 1
+    assert "device_count=8" in env["XLA_FLAGS"]
+    assert "--other" in env["XLA_FLAGS"]
+
+
+def test_jax_child_env_passthrough_on_accelerator():
+    base = {"JAX_PLATFORMS": "axon", "PALLAS_AXON_POOL_IPS": "1.2.3.4"}
+    # current process is cpu-pinned under conftest, so patch the decision
+    # inputs explicitly via the base mapping semantics: jax_child_env reads
+    # the *process* platform, which conftest pins to cpu — children of a
+    # cpu parent must be hard-pinned.
+    assert current_platform() == "cpu"
+    env = jax_child_env(base)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+
+
+def test_dryrun_multichip_self_forces_from_accelerator_env():
+    """The driver artifact path: a parent pinned to an accelerator platform
+    (JAX_PLATFORMS=axon, jax never imported) must still complete the CPU
+    dry-run by re-execing itself with a scrubbed environment."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"  # simulate the driver's pinned env
+    env.pop("_PIO_DRYRUN_CHILD", None)
+    env.pop("PIO_JAX_PLATFORM", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "__graft_entry__.py"),
+         "--dryrun", "8"],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dryrun_multichip(8) ok" in proc.stdout
