@@ -496,19 +496,35 @@ def als_train(
         "nnz": int(by_user.nnz),
     }
     start = 0
-    if checkpoint is not None and checkpoint.latest_step() is not None:
-        step, tree, meta = checkpoint.restore(like={"x": 0, "y": 0})
-        if (
-            all(meta.get(k) == v for k, v in ck_meta.items())
-            and tuple(tree["y"].shape) == (by_item.n_rows, rank)
-            and tuple(tree["x"].shape) == (by_user.n_rows, rank)
-            and step <= cfg.iterations
-        ):
-            x = jnp.asarray(tree["x"])
-            y = jnp.asarray(tree["y"])
-            if mesh is not None:
-                x, y = jax.device_put(x, tbl_spec), jax.device_put(y, tbl_spec)
-            start = step
+    if checkpoint is not None:
+        # Scan steps newest-first for the first VALID one: config identity
+        # matches, shapes match, and step <= cfg.iterations (a stale
+        # higher-step checkpoint from a longer past run must not block
+        # resume from an earlier in-range step). An unreadable/corrupt
+        # checkpoint is treated as absent, not fatal.
+        for step in reversed(checkpoint.all_steps()):
+            if step > cfg.iterations:
+                continue
+            try:
+                step, tree, meta = checkpoint.restore(
+                    step, like={"x": 0, "y": 0}
+                )
+            except Exception:
+                continue  # torn/corrupt save — keep scanning older steps
+            if (
+                all(meta.get(k) == v for k, v in ck_meta.items())
+                and tuple(tree["y"].shape) == (by_item.n_rows, rank)
+                and tuple(tree["x"].shape) == (by_user.n_rows, rank)
+            ):
+                x = jnp.asarray(tree["x"])
+                y = jnp.asarray(tree["y"])
+                if mesh is not None:
+                    x, y = (
+                        jax.device_put(x, tbl_spec),
+                        jax.device_put(y, tbl_spec),
+                    )
+                start = step
+                break
 
     for i in range(start, cfg.iterations):
         t_iter = _time.monotonic()

@@ -154,6 +154,12 @@ def top_k_streaming(
     """Streaming top-k gather-dot: returns (scores ``[B, k]``, item indices
     ``[B, k]``) without materializing ``[B, N]`` scores in HBM.
 
+    Sentinel contract (all paths — kernel, interpret, XLA fallback): a slot
+    with fewer than ``k`` valid candidates (catalog smaller than ``k``, or
+    exclusions masking the rest) holds score ``-inf`` and index ``-1``.
+    Callers gathering items by index MUST treat ``-1`` as absent — negative
+    indexing would otherwise silently map it to the last catalog item.
+
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
     (CPU tests). Queries/rank are padded to VPU/MXU tile boundaries; padding
     never appears in results (-inf / -1 masking).
@@ -177,6 +183,9 @@ def top_k_streaming(
         scores, idx = top_k_for_vectors(
             query_vectors, item_factors, k_eff, exclude_mask=mask
         )
+        # Same contract as the kernel: any -inf slot (excluded/invalid)
+        # carries the -1 index sentinel, never a real (excluded) item id.
+        idx = jnp.where(jnp.isneginf(scores), -1, idx)
         if k_eff < k:
             scores = jnp.pad(
                 scores, ((0, 0), (0, k - k_eff)), constant_values=-np.inf

@@ -27,6 +27,21 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 _SEP = "/"
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so newly-created entries are durable (no-op on
+    platforms that disallow opening directories)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     """Pytree (nested dict/list/tuple of arrays) → {path: array}."""
     out: Dict[str, np.ndarray] = {}
@@ -103,8 +118,21 @@ class CheckpointManager:
         np.savez(os.path.join(d, "arrays.npz"), **flat)
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(metadata or {}, f)
+        # Durability ordering: data files (and the directory entry) must hit
+        # disk before the _COMPLETE marker, or a power loss can leave a
+        # durable marker pointing at garbage.
+        for name in ("arrays.npz", "meta.json"):
+            fd = os.open(os.path.join(d, name), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        _fsync_dir(d)
         with open(os.path.join(d, "_COMPLETE"), "w") as f:
             f.write("ok")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(d)
         self._prune()
 
     def _prune(self) -> None:

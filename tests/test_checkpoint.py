@@ -101,6 +101,44 @@ class TestALSResume:
                             checkpoint=cm, checkpoint_every=2)
         assert out.user_factors.shape == (nu, 6)
 
+    def test_stale_higher_step_falls_back_to_valid_lower_step(self, tmp_path):
+        """After lowering cfg.iterations, a surviving higher-step checkpoint
+        must not force a from-scratch retrain when an in-range step exists
+        (ADVICE round-1: stale step > iterations blocked resume forever)."""
+        users, items, ratings, nu, ni = toy_ratings()
+        cm = CheckpointManager(str(tmp_path / "ck"), keep=10)
+        cfg6 = ALSConfig(rank=6, iterations=6, lambda_=0.05, seed=0)
+        als_train_coo(users, items, ratings, nu, ni, cfg6,
+                      checkpoint=cm, checkpoint_every=1)
+        assert cm.latest_step() == 6
+
+        # rerun with iterations lowered to 4: step_4 must be resumed (a
+        # no-op finish), not a full retrain from 0 blocked by step_5/6
+        cfg4 = ALSConfig(rank=6, iterations=4, lambda_=0.05, seed=0)
+        four = als_train_coo(users, items, ratings, nu, ni, cfg4,
+                             checkpoint=cm, checkpoint_every=1)
+        step, tree, _ = cm.restore(4, like={"x": 0, "y": 0})
+        np.testing.assert_allclose(
+            np.asarray(four.user_factors), tree["x"], rtol=1e-5, atol=1e-6
+        )
+
+    def test_corrupt_checkpoint_treated_as_absent(self, tmp_path):
+        """An unreadable arrays.npz under a durable _COMPLETE marker (power
+        loss torn write) must fall back to fresh training, not crash."""
+        users, items, ratings, nu, ni = toy_ratings()
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        cfg = ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0)
+        als_train_coo(users, items, ratings, nu, ni, cfg,
+                      checkpoint=cm, checkpoint_every=1)
+        # corrupt every saved step's arrays while keeping markers durable
+        for step in cm.all_steps():
+            (tmp_path / "ck" / f"step_{step}" / "arrays.npz").write_bytes(
+                b"not-an-npz"
+            )
+        out = als_train_coo(users, items, ratings, nu, ni, cfg,
+                            checkpoint=cm, checkpoint_every=0)
+        assert np.isfinite(np.asarray(out.user_factors)).all()
+
 
 class TestProfiling:
     def test_step_timer(self):

@@ -101,6 +101,29 @@ def test_fallback_path_contract(monkeypatch):
     assert np.isneginf(np.asarray(s2)[:, 20:]).all()
 
 
+def test_fallback_sentinel_matches_kernel_when_exclusions_exhaust_catalog(
+    monkeypatch,
+):
+    """Both paths must return -1 (never a real excluded id) in -inf slots —
+    the divergence flagged in round-1 ADVICE: a caller gathering by index
+    would map a real-but-excluded id to a live item."""
+    import predictionio_tpu.ops.pallas_kernels as pk
+
+    rng = np.random.default_rng(6)
+    q = rng.normal(size=(2, 4)).astype(np.float32)
+    items = rng.normal(size=(5, 4)).astype(np.float32)
+    # exclude ALL 5 items: fewer than k=3 valid candidates remain
+    excl = np.tile(np.arange(5, dtype=np.int32), (2, 1))
+
+    s_k, i_k = pk.top_k_streaming(q, items, 3, exclude_idx=jnp.asarray(excl))
+    monkeypatch.setattr(pk, "_HAVE_PALLAS", False)
+    s_f, i_f = pk.top_k_streaming(q, items, 3, exclude_idx=jnp.asarray(excl))
+
+    for s, i in ((s_k, i_k), (s_f, i_f)):
+        assert np.isneginf(np.asarray(s)).all()
+        assert (np.asarray(i) == -1).all()
+
+
 def test_wide_exclusion_list():
     """Exclusion lists wider than the kernel chunk (fori_loop path)."""
     rng = np.random.default_rng(5)
