@@ -30,7 +30,7 @@ from ..controller import (
     Preparator,
 )
 from ..ops.als import ALSConfig, als_train_coo
-from ..ops.scoring import top_k_for_users
+from ..ops.scoring import pad_pow2, top_k_for_users
 from ..storage import BiMap, EventFilter, get_registry
 
 
@@ -280,17 +280,23 @@ class ALSAlgorithm(Algorithm):
             if model.user_map.get(q.user) is None
         ]
         if known:
-            max_k = min(
-                max(q.num for _, q in known), model.item_factors.shape[0]
-            )
+            n_items = model.item_factors.shape[0]
+            max_k = min(max(q.num for _, q in known), n_items)
             user_idx = np.asarray(
                 [model.user_map[q.user] for _, q in known], dtype=np.int32
             )
+            # Shape bucketing (ops/scoring.pad_pow2): micro-batched serving
+            # produces every batch size — pad B and k to powers of two so
+            # the device program set stays O(log^2), then slice on host.
+            b = len(user_idx)
+            b_pad = pad_pow2(b)
+            k_pad = min(pad_pow2(max_k, lo=8), n_items)
+            padded_idx = np.pad(user_idx, (0, b_pad - b))
             scores, items = top_k_for_users(
-                model.user_factors, model.item_factors, user_idx, k=max_k
+                model.user_factors, model.item_factors, padded_idx, k=k_pad
             )
-            scores = np.asarray(scores)
-            items = np.asarray(items)
+            scores = np.asarray(scores)[:b, :max_k]
+            items = np.asarray(items)[:b, :max_k]
             inv = model.item_map.inverse
             for row, (i, q) in enumerate(known):
                 k = min(q.num, max_k)

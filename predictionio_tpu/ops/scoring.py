@@ -22,6 +22,17 @@ import jax.numpy as jnp
 NEG_INF = jnp.float32(-jnp.inf)
 
 
+def pad_pow2(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo).
+
+    Serving shape-bucketing: micro-batched query batches arrive at every
+    size from 1 to batch_max; dispatching each size directly would compile
+    a fresh XLA program per size (20-40 s each on TPU). Padding batch and
+    k to powers of two bounds the compile set to O(log) shapes."""
+    n = max(int(n), lo)
+    return 1 << (n - 1).bit_length()
+
+
 def _score_topk(query_vectors, item_factors, k, exclude_mask):
     scores = jnp.einsum(
         "br,ir->bi", query_vectors, item_factors, preferred_element_type=jnp.float32

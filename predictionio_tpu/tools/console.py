@@ -384,6 +384,9 @@ def main(
     argv: Optional[Sequence[str]] = None,
     registry: Optional[StorageRegistry] = None,
 ) -> int:
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
     args = build_parser().parse_args(argv)
     registry = registry or get_registry()
     try:

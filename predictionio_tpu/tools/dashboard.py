@@ -121,6 +121,9 @@ def create_dashboard(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
     p = argparse.ArgumentParser(prog="dashboard")
     p.add_argument("--ip", default="localhost")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)

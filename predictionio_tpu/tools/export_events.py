@@ -34,6 +34,9 @@ def export_events(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
     p = argparse.ArgumentParser(prog="export_events")
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--output", required=True)

@@ -59,6 +59,9 @@ def import_events(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
     p = argparse.ArgumentParser(prog="import_events")
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--input", required=True)

@@ -1,0 +1,213 @@
+"""Serving load generator: measure query throughput and tail latency.
+
+The BASELINE.json north star asks for ≥10k queries/s/chip from the
+deployed recommender. This tool produces the evidence: it hammers a query
+server with concurrent persistent-connection workers and reports QPS and
+latency percentiles as one JSON line.
+
+Two modes:
+
+- **HTTP** (default): end-to-end through ``POST /queries.json`` — what a
+  client sees, including HTTP parsing and the Python server stack.
+- **--in-process**: builds the deployment and drives
+  ``QueryServer.handle_query`` directly from worker threads — isolates
+  the prediction path (micro-batcher + device dispatch) from HTTP
+  overhead, i.e. the ceiling the serving stack itself imposes.
+
+Usage::
+
+    python -m predictionio_tpu.tools.loadgen \
+        --url http://localhost:8000/queries.json \
+        --payload '{"user": "1", "num": 10}' \
+        --concurrency 32 --duration 10
+
+The payload may contain ``{i}`` which each worker substitutes with a
+rotating integer (vary the queried user).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence
+from urllib.parse import urlparse
+
+import numpy as np
+
+
+class _Worker(threading.Thread):
+    def __init__(self, target, payloads: Sequence[bytes], stop_at: float):
+        super().__init__(daemon=True)
+        self.target = target
+        self.payloads = payloads
+        self.stop_at = stop_at
+        self.latencies: List[float] = []
+        self.errors = 0
+
+    def run(self) -> None:
+        i = 0
+        while time.monotonic() < self.stop_at:
+            payload = self.payloads[i % len(self.payloads)]
+            t0 = time.monotonic()
+            try:
+                ok = self.target(payload)
+            except Exception:
+                ok = False
+            elapsed = time.monotonic() - t0
+            if ok:
+                self.latencies.append(elapsed)
+            else:
+                self.errors += 1
+            i += 1
+
+
+def _http_target(url: str):
+    parsed = urlparse(url)
+    # One persistent connection PER WORKER THREAD: http.client connections
+    # are not thread-safe, and sharing one socket across workers would
+    # interleave request/response pairs and corrupt every measurement.
+    local = threading.local()
+
+    def send(payload: bytes) -> bool:
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port or 80, timeout=30
+            )
+            local.conn = conn
+        try:
+            conn.request(
+                "POST",
+                parsed.path or "/queries.json",
+                body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except Exception:
+            local.conn = None  # reconnect next attempt
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+
+    return send
+
+
+def run_load(
+    target,
+    payloads: Sequence[bytes],
+    concurrency: int,
+    duration_s: float,
+) -> dict:
+    """Drive ``target(payload) -> bool`` from ``concurrency`` threads for
+    ``duration_s``; returns {qps, p50_ms, p99_ms, ...}."""
+    stop_at = time.monotonic() + duration_s
+    t0 = time.monotonic()
+    workers = [_Worker(target, payloads, stop_at) for _ in range(concurrency)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    wall = time.monotonic() - t0
+    lats = np.concatenate(
+        [np.asarray(w.latencies) for w in workers if w.latencies]
+    ) if any(w.latencies for w in workers) else np.zeros(0)
+    errors = sum(w.errors for w in workers)
+    n = int(lats.size)
+    out = {
+        "requests": n,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "qps": round(n / wall, 1) if wall > 0 else 0.0,
+        "concurrency": concurrency,
+    }
+    if n:
+        out["p50_ms"] = round(float(np.percentile(lats, 50)) * 1000, 3)
+        out["p90_ms"] = round(float(np.percentile(lats, 90)) * 1000, 3)
+        out["p99_ms"] = round(float(np.percentile(lats, 99)) * 1000, 3)
+        out["mean_ms"] = round(float(lats.mean()) * 1000, 3)
+    return out
+
+
+def _expand_payloads(template: str, n: int = 256) -> List[bytes]:
+    if "{i}" in template:
+        return [template.replace("{i}", str(i)).encode() for i in range(n)]
+    return [template.encode()]
+
+
+def _inprocess_target(engine_dir: str, batching: bool):
+    """Build a QueryServer (without binding HTTP traffic through sockets)
+    and return a callable driving handle_query directly."""
+    from ..storage.registry import get_registry
+    from ..workflow import loader
+    from ..workflow.serving import QueryServer, ServerConfig
+    from .register import load_engine_dir
+
+    ed = load_engine_dir(engine_dir)
+    engine = loader.get_engine(ed.engine_factory, search_dir=ed.path)
+    config = ServerConfig(
+        port=0,
+        engine_id=ed.manifest.id,
+        engine_version=ed.manifest.version,
+        batching=batching,
+    )
+    server = QueryServer(config, engine, get_registry())
+
+    def send(payload: bytes) -> bool:
+        result, status = server.handle_query(json.loads(payload))
+        return status == 200
+
+    return send, server
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()
+    p = argparse.ArgumentParser(prog="loadgen")
+    p.add_argument("--url", default="http://localhost:8000/queries.json")
+    p.add_argument("--payload", default='{"user": "{i}", "num": 10}')
+    p.add_argument("--concurrency", type=int, default=32)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--in-process", action="store_true",
+                   help="drive handle_query directly (no HTTP)")
+    p.add_argument("--engine-dir", default=".",
+                   help="engine project dir for --in-process")
+    p.add_argument("--no-batching", action="store_true",
+                   help="disable micro-batching in --in-process mode")
+    args = p.parse_args(argv)
+
+    payloads = _expand_payloads(args.payload)
+    server = None
+    if args.in_process:
+        target, server = _inprocess_target(
+            args.engine_dir, batching=not args.no_batching
+        )
+    else:
+        target = _http_target(args.url)
+
+    # warm-up: first queries pay jit compile
+    for payload in payloads[:4]:
+        try:
+            target(payload)
+        except Exception as exc:
+            print(f"loadgen warm-up failed: {exc}", file=sys.stderr)
+            return 1
+
+    result = run_load(target, payloads, args.concurrency, args.duration)
+    result["mode"] = "in-process" if args.in_process else "http"
+    if server is not None and server._batcher is not None:
+        result["batching"] = server._batcher.stats
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

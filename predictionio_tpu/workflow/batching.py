@@ -1,0 +1,161 @@
+"""Micro-batching aggregator for the serving hot path.
+
+The reference serves each query with its own ``predictBase`` call
+(``core/src/main/scala/io/prediction/workflow/CreateServer.scala:479-485``)
+— fine on a JVM thread pool doing CPU dot-products, fatal on an
+accelerator: a batch-1 device dispatch per HTTP request leaves the MXU
+idle and pays full dispatch latency per query. SURVEY §7 flags "batched
+query aggregation into the gather-dot kernel without killing tail
+latency" as the hard part of the ≥10k QPS target.
+
+:class:`MicroBatcher` is the aggregator: concurrent request threads
+``submit()`` work items; a single dispatcher thread collects whatever has
+arrived within ``max_wait_ms`` (or up to ``max_batch``), invokes the
+batched processor ONCE, and fans results back to the waiting threads.
+Under load, batches fill instantly (wait ≈ 0 — the next batch forms while
+the previous one is on the device); at low rates a lone query pays at
+most ``max_wait_ms`` extra latency. This is the classic accelerator-
+serving pattern (cf. TF Serving's batching layer), sized so tail latency
+stays bounded: p99 <= device_time(max_batch) + max_wait_ms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence
+
+__all__ = ["MicroBatcher"]
+
+
+class MicroBatcher:
+    """Aggregate concurrent ``submit()`` calls into batched processor runs.
+
+    ``process``: callable taking a list of items and returning a list of
+    results of the same length (index-aligned). It runs on the dispatcher
+    thread. A result element that is an ``Exception`` instance fails only
+    its own request; an exception *raised* by ``process`` fails every
+    request in that batch (and only that batch).
+
+    ``default_timeout_s`` bounds each ``submit()`` wait; size it to cover
+    worst-case first-dispatch latency (an XLA compile for a fresh shape
+    bucket can cost tens of seconds on TPU).
+    """
+
+    def __init__(
+        self,
+        process: Callable[[Sequence[Any]], Sequence[Any]],
+        max_batch: int = 64,
+        max_wait_ms: float = 1.0,
+        name: str = "microbatch",
+        default_timeout_s: float = 120.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._process = process
+        self._max_batch = max_batch
+        self._max_wait_s = max(0.0, max_wait_ms) / 1000.0
+        self._default_timeout_s = default_timeout_s
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._items: List[Any] = []
+        self._futures: List[Future] = []
+        self._closed = False
+        self._batches = 0
+        self._submitted = 0
+        self._dispatcher = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._dispatcher.start()
+
+    # -- client side ------------------------------------------------------
+    def submit(self, item: Any, timeout: Optional[float] = None) -> Any:
+        """Block until the batched processor has handled ``item``; returns
+        its index-aligned result (or raises that item's exception)."""
+        fut: Future = Future()
+        with self._nonempty:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._items.append(item)
+            self._futures.append(fut)
+            self._submitted += 1
+            self._nonempty.notify()
+        return fut.result(
+            timeout=timeout if timeout is not None else self._default_timeout_s
+        )
+
+    # -- dispatcher -------------------------------------------------------
+    def _take_batch(self) -> tuple:
+        """Wait for at least one item, linger up to max_wait for more (or
+        until the batch is full), then drain. Returns ([], []) on close."""
+        with self._nonempty:
+            while not self._items and not self._closed:
+                self._nonempty.wait(0.1)
+            if self._closed and not self._items:
+                return (), ()
+            if self._max_wait_s > 0:
+                deadline = time.monotonic() + self._max_wait_s
+                while len(self._items) < self._max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._nonempty.wait(remaining)
+            items = self._items[: self._max_batch]
+            futures = self._futures[: self._max_batch]
+            del self._items[: self._max_batch]
+            del self._futures[: self._max_batch]
+            return items, futures
+
+    def _run(self) -> None:
+        while True:
+            items, futures = self._take_batch()
+            if not items:
+                if self._closed:
+                    return
+                continue
+            try:
+                results = self._process(items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"batch processor returned {len(results)} results "
+                        f"for {len(items)} items"
+                    )
+            except Exception as exc:
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            self._batches += 1
+            for fut, result in zip(futures, results):
+                if fut.done():
+                    continue
+                if isinstance(result, Exception):
+                    fut.set_exception(result)  # per-item failure channel
+                else:
+                    fut.set_result(result)
+
+    # -- lifecycle / stats ------------------------------------------------
+    def close(self) -> None:
+        with self._nonempty:
+            self._closed = True
+            self._nonempty.notify_all()
+        self._dispatcher.join(timeout=5.0)
+        # fail anything still queued
+        with self._nonempty:
+            for fut in self._futures:
+                if not fut.done():
+                    fut.set_exception(RuntimeError("MicroBatcher closed"))
+            self._items.clear()
+            self._futures.clear()
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self._submitted,
+                "batches": self._batches,
+                "avg_batch": (
+                    self._submitted / self._batches if self._batches else 0.0
+                ),
+            }

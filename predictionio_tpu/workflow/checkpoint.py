@@ -133,6 +133,8 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         _fsync_dir(d)
+        # the step_N dirent itself lives in the parent directory
+        _fsync_dir(self.directory)
         self._prune()
 
     def _prune(self) -> None:
