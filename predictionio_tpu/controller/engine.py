@@ -66,6 +66,9 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    #: hyperparameter-sweep parallelism: 0 = auto (one slice per candidate
+    #: up to the mesh data-axis size), 1 = serial, N = N mesh slices
+    eval_parallelism: int = 0
 
 
 class StopAfterReadInterruption(Exception):
@@ -273,10 +276,27 @@ class Engine:
         ctx,
         engine_params_list: Sequence[EngineParams],
         workflow_params: WorkflowParams = WorkflowParams(),
+        parallelism: int = 1,
     ) -> List[Tuple[EngineParams, List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]]:
         """Evaluate every EngineParams (``BaseEngine.batchEval``,
         ``core/BaseEngine.scala:47-55``); FastEvalEngine overrides with
-        prefix memoization."""
+        prefix memoization.
+
+        ``parallelism > 1`` runs candidates concurrently on independent
+        mesh slices (``WorkflowContext.slices``; SURVEY §2.8 row 5 — the
+        TPU-native form of the reference's ``.par`` sweep): each
+        candidate's training dispatches onto a disjoint device subset, so
+        an 8-device mesh evaluates a 4-way grid as 4 concurrent 2-device
+        trainings."""
+        if parallelism > 1 and len(engine_params_list) > 1:
+            from ..parallel.sweep import run_sliced
+
+            tasks = [
+                (lambda sliced, ep=ep: self.eval(sliced, ep, workflow_params))
+                for ep in engine_params_list
+            ]
+            results = run_sliced(ctx, tasks, parallelism)
+            return list(zip(engine_params_list, results))
         return [
             (ep, self.eval(ctx, ep, workflow_params))
             for ep in engine_params_list

@@ -19,8 +19,10 @@ upstream.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from collections import defaultdict
-from typing import Any, Dict, Generic, List, Optional, Sequence, Tuple, TypeVar
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Generic, List, Optional, Sequence, Tuple, TypeVar
 
 from .dase import doer
 from .engine import Engine, EngineParams, WorkflowParams
@@ -31,22 +33,57 @@ V = TypeVar("V")
 
 
 class AssocCache(Generic[K, V]):
-    """Equality-keyed cache (no hashability requirement on params)."""
+    """Equality-keyed cache (no hashability requirement on params) with
+    exactly-once compute under concurrency.
+
+    Parallel sweeps (mesh-sliced ``batch_eval``) hit these caches from
+    several threads; the memoization-count contract
+    (``FastEvalEngineTest.scala:30-146``: DataSource read exactly once per
+    distinct prefix) must survive that. ``get_or_compute`` registers an
+    in-flight Future under the lock, so a second thread asking for the
+    same prefix blocks on the first thread's result instead of
+    re-invoking the component."""
 
     def __init__(self):
-        self._items: List[Tuple[K, V]] = []
+        self._items: List[Tuple[K, Future]] = []
+        self._lock = threading.Lock()
 
     def get(self, key: K) -> Optional[V]:
-        for k, v in self._items:
-            if k == key:
-                return v
-        return None
+        with self._lock:
+            found = next((fut for k, fut in self._items if k == key), None)
+        return found.result() if found is not None else None  # wait unlocked
 
     def put(self, key: K, value: V) -> None:
-        self._items.append((key, value))
+        fut: Future = Future()
+        fut.set_result(value)
+        with self._lock:
+            self._items.append((key, fut))
+
+    def get_or_compute(self, key: K, compute: Callable[[], V]) -> V:
+        with self._lock:
+            for k, fut in self._items:
+                if k == key:
+                    found: Optional[Future] = fut
+                    break
+            else:
+                found = None
+                mine: Future = Future()
+                self._items.append((key, mine))
+        if found is not None:
+            return found.result()  # blocks if another thread is computing
+        try:
+            value = compute()
+        except BaseException as exc:
+            mine.set_exception(exc)
+            with self._lock:  # failed computes are not cached
+                self._items.remove((key, mine))
+            raise
+        mine.set_result(value)
+        return value
 
     def __len__(self) -> int:
-        return len(self._items)
+        with self._lock:
+            return len(self._items)
 
 
 # Prefix keys (FastEvalEngine.scala:52-87)
@@ -80,46 +117,57 @@ class FastEvalEngineWorkflow:
     """Holds the per-sweep caches (``FastEvalEngineWorkflow``,
     ``FastEvalEngine.scala:89-344``)."""
 
-    def __init__(self, engine: "FastEvalEngine", ctx, workflow_params: WorkflowParams):
+    def __init__(
+        self,
+        engine: "FastEvalEngine",
+        ctx,
+        workflow_params: WorkflowParams,
+        train_slices=None,
+    ):
         self.engine = engine
         self.ctx = ctx
         self.workflow_params = workflow_params
+        #: optional SlicePool: the training stage (the device-heavy one)
+        #: checks out a free mesh slice per distinct algorithms-prefix, so
+        #: concurrent trainings run on disjoint devices. Only that one
+        #: stage acquires — nested acquisition would deadlock, and the
+        #: other stages are host-bound.
+        self._train_slices = train_slices
         # caches (FastEvalEngine.scala:299-302)
         self.data_source_cache: AssocCache = AssocCache()
         self.preparator_cache: AssocCache = AssocCache()
         self.algorithms_cache: AssocCache = AssocCache()
         self.serving_cache: AssocCache = AssocCache()
 
-    # each stage: compute through the previous stage's cached result
+    # each stage: compute through the previous stage's cached result,
+    # exactly once per distinct prefix even under concurrent sweeps
     def get_data_source_result(self, prefix: DataSourcePrefix):
-        cached = self.data_source_cache.get(prefix)
-        if cached is None:
+        def compute():
             name, params = prefix.data_source_params
             data_source = doer(self.engine.data_source_class_map[name], params)
-            cached = data_source.read_eval(self.ctx)
-            self.data_source_cache.put(prefix, cached)
-        return cached
+            return data_source.read_eval(self.ctx)
+
+        return self.data_source_cache.get_or_compute(prefix, compute)
 
     def get_preparator_result(self, prefix: PreparatorPrefix):
-        cached = self.preparator_cache.get(prefix)
-        if cached is None:
+        def compute():
             eval_sets = self.get_data_source_result(
                 DataSourcePrefix(prefix.data_source_params)
             )
             name, params = prefix.preparator_params
             preparator = doer(self.engine.preparator_class_map[name], params)
-            cached = [
+            return [
                 (preparator.prepare(self.ctx, td), ei, qa)
                 for td, ei, qa in eval_sets
             ]
-            self.preparator_cache.put(prefix, cached)
-        return cached
+
+        return self.preparator_cache.get_or_compute(prefix, compute)
 
     def get_algorithms_result(self, prefix: AlgorithmsPrefix):
         """Per fold: list over algos of indexed predictions
         (``computeAlgorithmsResult``, ``FastEvalEngine.scala:170-242``)."""
-        cached = self.algorithms_cache.get(prefix)
-        if cached is None:
+
+        def compute_with(ctx):
             prepared_sets = self.get_preparator_result(
                 PreparatorPrefix(
                     prefix.data_source_params, prefix.preparator_params
@@ -129,21 +177,27 @@ class FastEvalEngineWorkflow:
                 doer(self.engine.algorithm_class_map[name], params)
                 for name, params in prefix.algorithm_params_list
             ]
-            cached = []
+            out = []
             for pd, ei, qa in prepared_sets:
-                models = [a.train(self.ctx, pd) for a in algos]
+                models = [a.train(ctx, pd) for a in algos]
                 indexed = list(enumerate(q for q, _ in qa))
                 per_algo = [
                     a.batch_predict(m, indexed)
                     for a, m in zip(algos, models)
                 ]
-                cached.append((per_algo, ei, qa))
-            self.algorithms_cache.put(prefix, cached)
-        return cached
+                out.append((per_algo, ei, qa))
+            return out
+
+        def compute():
+            if self._train_slices is not None:
+                with self._train_slices.acquire() as sliced:
+                    return compute_with(sliced)
+            return compute_with(self.ctx)
+
+        return self.algorithms_cache.get_or_compute(prefix, compute)
 
     def get_serving_result(self, prefix: ServingPrefix):
-        cached = self.serving_cache.get(prefix)
-        if cached is None:
+        def compute():
             algo_sets = self.get_algorithms_result(
                 AlgorithmsPrefix(
                     prefix.data_source_params,
@@ -153,7 +207,7 @@ class FastEvalEngineWorkflow:
             )
             name, params = prefix.serving_params
             serving = doer(self.engine.serving_class_map[name], params)
-            cached = []
+            out = []
             for per_algo, ei, qa in algo_sets:
                 by_query: Dict[int, Dict[int, Any]] = defaultdict(dict)
                 for ai, indexed_preds in enumerate(per_algo):
@@ -164,9 +218,10 @@ class FastEvalEngineWorkflow:
                     preds = by_query.get(qi, {})
                     ordered = [preds[ai] for ai in sorted(preds)]
                     qpa.append((q, serving.serve(q, ordered), a))
-                cached.append((ei, qpa))
-            self.serving_cache.put(prefix, cached)
-        return cached
+                out.append((ei, qpa))
+            return out
+
+        return self.serving_cache.get_or_compute(prefix, compute)
 
 
 class FastEvalEngine(Engine):
@@ -178,15 +233,45 @@ class FastEvalEngine(Engine):
         ctx,
         engine_params_list: Sequence[EngineParams],
         workflow_params: WorkflowParams = WorkflowParams(),
+        parallelism: int = 1,
     ):
-        workflow = FastEvalEngineWorkflow(self, ctx, workflow_params)
-        results = []
-        for ep in engine_params_list:
-            prefix = ServingPrefix(
+        """Memoized sweep; ``parallelism > 1`` evaluates candidates
+        concurrently on independent mesh slices while the exactly-once
+        caches keep the invocation counts identical to a serial sweep
+        (``FastEvalEngineTest.scala:30-146`` semantics)."""
+        prefixes = [
+            ServingPrefix(
                 ep.data_source_params,
                 ep.preparator_params,
                 tuple(ep.algorithm_params_list),
                 ep.serving_params,
             )
-            results.append((ep, workflow.get_serving_result(prefix)))
-        return results
+            for ep in engine_params_list
+        ]
+        if parallelism > 1 and len(engine_params_list) > 1:
+            from ..parallel.sweep import SlicePool
+
+            # Candidates run concurrently; the training stage checks a
+            # free slice out of the pool per distinct algorithms-prefix,
+            # so disjoint devices carry the concurrent trains while the
+            # exactly-once caches keep invocation counts serial-identical.
+            pool = SlicePool(ctx, parallelism)
+            workflow = FastEvalEngineWorkflow(
+                self, ctx, workflow_params, train_slices=pool
+            )
+            with ThreadPoolExecutor(
+                max_workers=pool.n_slices, thread_name_prefix="sweep"
+            ) as executor:
+                futs = [
+                    executor.submit(workflow.get_serving_result, p)
+                    for p in prefixes
+                ]
+                return [
+                    (ep, fut.result())
+                    for ep, fut in zip(engine_params_list, futs)
+                ]
+        workflow = FastEvalEngineWorkflow(self, ctx, workflow_params)
+        return [
+            (ep, workflow.get_serving_result(p))
+            for ep, p in zip(engine_params_list, prefixes)
+        ]

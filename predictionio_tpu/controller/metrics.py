@@ -211,16 +211,37 @@ class MetricEvaluator(Generic[EI, Q, P, A, R]):
         evaluation,
         engine_eval_data_set: Sequence[Tuple[EngineParams, EvalDataSet]],
         workflow_params=None,
+        parallelism: int = 0,
     ) -> MetricEvaluatorResult[R]:
-        scored: List[Tuple[EngineParams, MetricScores[R]]] = []
-        for ep, eval_data_set in engine_eval_data_set:
-            scores = MetricScores(
+        def score_one(pair) -> Tuple[EngineParams, MetricScores[R]]:
+            ep, eval_data_set = pair
+            return ep, MetricScores(
                 score=self.metric.calculate(ctx, eval_data_set),
                 other_scores=tuple(
                     m.calculate(ctx, eval_data_set) for m in self.other_metrics
                 ),
             )
-            scored.append((ep, scores))
+
+        # Concurrent candidate scoring — the reference scores with a
+        # parallel collection (``MetricEvaluator.scala:202-211``, ``.par``).
+        # Metrics must be thread-safe across candidates (they are in the
+        # reference for the same reason); jit'd batch metrics release the
+        # GIL during device work.
+        # scoring is host-bound: cap the pool regardless of how wide the
+        # sweep itself ran (the mesh carried the sweep; threads carry this)
+        n = min(parallelism if parallelism > 0 else 8,
+                8, len(engine_eval_data_set))
+        if n > 1 and len(engine_eval_data_set) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="metric"
+            ) as pool:
+                scored: List[Tuple[EngineParams, MetricScores[R]]] = list(
+                    pool.map(score_one, engine_eval_data_set)
+                )
+        else:
+            scored = [score_one(pair) for pair in engine_eval_data_set]
         for idx, (ep, r) in enumerate(scored):
             logger.info("Iteration %d: score %s", idx, r.score)
 

@@ -89,6 +89,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def slice_mesh(mesh: Mesh, n: int, *, axis: str = DATA_AXIS) -> list:
+    """Split a mesh into up to ``n`` independent submeshes along ``axis``.
+
+    The hyperparameter-sweep device topology (SURVEY §2.8 row 5): each
+    EngineParams candidate trains on its own slice, so a 4-way sweep on an
+    8-device mesh runs 4 concurrent 2-device trainings instead of 4
+    sequential 8-device ones. Returns as many slices as the axis actually
+    divides into (>= 1); every slice keeps the full axis-name set so all
+    sharding annotations stay valid on the smaller mesh.
+    """
+    if n <= 1:
+        return [mesh]
+    axis_names = list(mesh.axis_names)
+    if axis not in axis_names:  # nothing to slice along — run shared
+        return [mesh]
+    axis_idx = axis_names.index(axis)
+    devs = np.asarray(mesh.devices)
+    size = devs.shape[axis_idx]
+    n = min(n, size)
+    while size % n != 0:  # only even splits keep static shapes
+        n -= 1
+    if n <= 1:
+        return [mesh]
+    return [
+        Mesh(chunk, tuple(axis_names))
+        for chunk in np.split(devs, n, axis=axis_idx)
+    ]
+
+
 def shard_batch(mesh: Mesh, array, *, axis: str = DATA_AXIS):
     """Pad the leading dim to a multiple of the axis size and device_put with
     batch sharding. Returns (sharded_array, original_length)."""

@@ -1,0 +1,66 @@
+"""Sweep execution over mesh slices.
+
+The reference evaluates a hyperparameter grid with a parallel collection
+(``MetricEvaluator.scala:202-211``); the TPU-native analogue runs each
+candidate on its own mesh slice (SURVEY §2.8 row 5). This module owns the
+scheduling so ``Engine.batch_eval`` and ``FastEvalEngine.batch_eval``
+share one implementation:
+
+- :class:`SlicePool` — a checkout pool of slice contexts. Tasks acquire a
+  FREE slice (not a submission-index-mapped one), so when candidates
+  outnumber slices a finishing slice is immediately reused and no two
+  concurrent trainings ever contend for the same devices.
+- :func:`run_sliced` — ordered map of tasks over the pool.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Sequence
+
+__all__ = ["SlicePool", "run_sliced"]
+
+
+class SlicePool:
+    """Checkout pool over a context's mesh slices."""
+
+    def __init__(self, ctx, parallelism: int):
+        slices = ctx.slices(parallelism) if hasattr(ctx, "slices") else [ctx]
+        self._free: "queue.Queue" = queue.Queue()
+        for s in slices:
+            self._free.put(s)
+        self.n_slices = len(slices)
+
+    @contextlib.contextmanager
+    def acquire(self):
+        """Check out a slice context; blocks until one is free. Never nest
+        acquisitions on the same pool from within a held slice — with all
+        slices held by waiting parents that deadlocks."""
+        ctx = self._free.get()
+        try:
+            yield ctx
+        finally:
+            self._free.put(ctx)
+
+
+def run_sliced(
+    ctx,
+    tasks: Sequence[Callable[[Any], Any]],
+    parallelism: int,
+) -> List[Any]:
+    """Run ``tasks`` (each a callable taking a slice context) concurrently,
+    one free slice per running task; returns results in task order. The
+    first task exception propagates (after all tasks settle)."""
+    pool = SlicePool(ctx, parallelism)
+
+    def run(task):
+        with pool.acquire() as sliced:
+            return task(sliced)
+
+    with ThreadPoolExecutor(
+        max_workers=pool.n_slices, thread_name_prefix="sweep"
+    ) as executor:
+        futs = [executor.submit(run, t) for t in tasks]
+        return [f.result() for f in futs]

@@ -298,6 +298,7 @@ _WORKFLOW_FLAGS = [
     ("--skip-sanity-check", {"action": "store_true"}),
     ("--stop-after-read", {"action": "store_true"}),
     ("--stop-after-prepare", {"action": "store_true"}),
+    ("--eval-parallelism", {"type": int, "default": 0}),
 ]
 
 
@@ -377,6 +378,8 @@ def _workflow_argv(args: argparse.Namespace, extra: Sequence[str] = ()) -> List[
     for flag in ("verbose", "skip_sanity_check", "stop_after_read", "stop_after_prepare"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
+    if getattr(args, "eval_parallelism", 0):
+        argv += ["--eval-parallelism", str(args.eval_parallelism)]
     return argv + list(extra)
 
 

@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--verbosity", type=int, default=0)
+    p.add_argument(
+        "--eval-parallelism", type=int, default=0,
+        help="sweep parallelism over mesh slices (0 = auto, 1 = serial)",
+    )
     return p
 
 
@@ -59,6 +63,7 @@ def run(
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
+        eval_parallelism=args.eval_parallelism,
     )
 
     if args.evaluation_class:

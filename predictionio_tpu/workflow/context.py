@@ -90,6 +90,25 @@ class WorkflowContext:
     def replicated(self):
         return replicated(self.mesh)
 
+    def slices(self, n: int) -> list:
+        """Split this context into up to ``n`` contexts over independent
+        mesh slices (hyperparameter-sweep parallelism, SURVEY §2.8 row 5).
+        Each slice context shares the timer/env/checkpoint settings but
+        owns a disjoint device subset, so concurrent evals dispatch onto
+        disjoint hardware."""
+        from ..parallel.mesh import slice_mesh
+
+        meshes = slice_mesh(self.mesh, n)
+        if len(meshes) == 1:
+            return [self]
+        out = []
+        for m in meshes:
+            child = WorkflowContext.__new__(WorkflowContext)
+            child.__dict__.update(self.__dict__)
+            child._mesh = m
+            out.append(child)
+        return out
+
     def stop(self) -> None:
         """SparkContext.stop analogue — release the mesh."""
         self._mesh = None

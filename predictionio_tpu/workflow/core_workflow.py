@@ -146,11 +146,21 @@ def run_evaluation(
     ctx = ctx or WorkflowContext(mode="Evaluation", batch=workflow_params.batch)
     try:
         engine, evaluator = evaluation.engine_evaluator
+        params_list = engine_params_generator.engine_params_list
+        # sweep parallelism: candidates ride independent mesh slices
+        # (SURVEY §2.8 row 5); auto = one slice per candidate, bounded by
+        # the mesh's data-axis size inside ctx.slices
+        parallelism = (
+            workflow_params.eval_parallelism
+            if workflow_params.eval_parallelism > 0
+            else len(params_list)
+        )
         engine_eval_data = engine.batch_eval(
-            ctx, engine_params_generator.engine_params_list, workflow_params
+            ctx, params_list, workflow_params, parallelism=parallelism
         )
         result = evaluator.evaluate_base(
-            ctx, evaluation, engine_eval_data, workflow_params
+            ctx, evaluation, engine_eval_data, workflow_params,
+            parallelism=parallelism,
         )
         stored = md.evaluation_instance_get(instance_id)
         assert stored is not None

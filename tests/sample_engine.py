@@ -10,6 +10,7 @@ class-level invocation counters back the FastEvalEngine memoization tests
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import List, Optional, Tuple
 
 from predictionio_tpu.controller import (
@@ -84,15 +85,20 @@ class DSParams(Params):
 
 # -- components -------------------------------------------------------------
 class CountingMixin:
-    """Class-level invocation counters (FastEvalEngineTest's count asserts)."""
+    """Class-level invocation counters (FastEvalEngineTest's count asserts).
+    Lock-guarded so parallel-sweep tests count exactly."""
+
+    _count_lock = threading.Lock()
 
     @classmethod
     def reset_count(cls):
-        cls.count = 0
+        with CountingMixin._count_lock:
+            cls.count = 0
 
     @classmethod
     def bump(cls):
-        cls.count = getattr(cls, "count", 0) + 1
+        with CountingMixin._count_lock:
+            cls.count = getattr(cls, "count", 0) + 1
 
 
 class DataSource0(DataSource, CountingMixin):
