@@ -1,0 +1,121 @@
+"""Pluggable storage-backend families.
+
+Rebuild of the reference's reflective DAO lookup
+(``data/src/main/scala/io/prediction/data/storage/Storage.scala:176-217``):
+there, a source ``type`` string like ``elasticsearch`` resolves to classes
+``io.prediction.data.storage.elasticsearch.ESApps`` etc. by classname
+reflection, so a new backend drops in without editing ``Storage.scala``.
+
+The Python analogue is a registration table plus import-time discovery:
+
+* A backend family calls :func:`register_backend` (usually at module import)
+  with factories for whichever repositories it supports.
+* When the registry meets an unknown ``type``, it tries, in order:
+  the source's ``module`` conf key (``PIO_STORAGE_SOURCES_<NAME>_MODULE`` —
+  the escape hatch for third-party packages), then
+  ``predictionio_tpu.storage.<type>`` — importing either is expected to
+  register the family as a side effect, exactly like JVM classloading in the
+  reference.
+
+Each factory receives the full source conf dict (the lower-cased
+``PIO_STORAGE_SOURCES_<NAME>_*`` key/values, e.g. ``path``, ``host``,
+``port``) so families define their own connection surface, mirroring how the
+reference passes ``StorageClientConfig(hosts, ports)`` through to backend
+constructors (``Storage.scala:124-174``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+from typing import Callable, Dict, Optional
+
+SourceConf = Dict[str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendFamily:
+    """One storage backend family (= one reference backend package).
+
+    A family may serve any subset of the three repositories; ``None`` means
+    "this family cannot back that repository" (parity with the reference,
+    where e.g. mongodb provides metadata DAOs but no events —
+    ``Storage.scala:193-204`` simply fails to find the class).
+    """
+
+    name: str
+    events: Optional[Callable[[SourceConf], object]] = None
+    metadata: Optional[Callable[[SourceConf], object]] = None
+    models: Optional[Callable[[SourceConf], object]] = None
+
+
+class BackendLookupError(Exception):
+    """No family provides the requested (type, repository) pair."""
+
+
+_FAMILIES: Dict[str, BackendFamily] = {}
+_LOCK = threading.Lock()
+
+
+def register_backend(family: BackendFamily) -> None:
+    """Register (or replace) a backend family. Idempotent per name —
+    re-import of a backend module must not fail."""
+    with _LOCK:
+        _FAMILIES[family.name] = family
+
+
+def registered_backends() -> Dict[str, BackendFamily]:
+    with _LOCK:
+        return dict(_FAMILIES)
+
+
+def resolve_backend(stype: str, conf: Optional[SourceConf] = None) -> BackendFamily:
+    """Find the family for a source ``type``, importing its module on demand.
+
+    Discovery order mirrors the reference's classname reflection
+    (``Storage.scala:176-191``): explicit ``module`` conf key first (the
+    third-party hook), then the in-tree package ``predictionio_tpu.storage.
+    <type>``.
+    """
+    with _LOCK:
+        fam = _FAMILIES.get(stype)
+    if fam is not None:
+        return fam
+
+    candidates = []
+    if conf and conf.get("module"):
+        candidates.append(conf["module"])
+    candidates.append(f"predictionio_tpu.storage.{stype}")
+
+    errors = []
+    for mod in candidates:
+        try:
+            importlib.import_module(mod)
+        except ImportError as exc:
+            errors.append(f"{mod}: {exc}")
+            continue
+        with _LOCK:
+            fam = _FAMILIES.get(stype)
+        if fam is not None:
+            return fam
+        errors.append(f"{mod}: imported but did not register type {stype!r}")
+
+    raise BackendLookupError(
+        f"No storage backend family for type {stype!r} "
+        f"(registered: {sorted(registered_backends())}; tried modules: "
+        f"{'; '.join(errors)})"
+    )
+
+
+def make_store(stype: str, repo_kind: str, conf: SourceConf) -> object:
+    """Construct a store for one repository kind ('events' | 'metadata' |
+    'models') — the ``Storage.getDataObject`` analogue."""
+    fam = resolve_backend(stype, conf)
+    factory = getattr(fam, repo_kind, None)
+    if factory is None:
+        raise BackendLookupError(
+            f"Backend family {stype!r} does not support the {repo_kind} "
+            "repository"
+        )
+    return factory(conf)

@@ -21,6 +21,12 @@ import re
 import threading
 from typing import Dict, Optional
 
+from .backends import (
+    BackendFamily,
+    BackendLookupError,
+    make_store,
+    register_backend,
+)
 from .event import Event, utcnow
 from .events import EventStore
 from .metadata import MetadataStore
@@ -45,24 +51,59 @@ def base_dir(env: Optional[Dict[str, str]] = None) -> str:
     )
 
 
+def _conf_root(conf: Dict[str, str]) -> str:
+    return conf.get("path") or base_dir()
+
+
+def _native_events(conf: Dict[str, str]) -> EventStore:
+    try:
+        from .native_events import NativeEventStore
+    except ImportError as exc:
+        raise StorageError(
+            "native event store backend is not built "
+            f"(predictionio_tpu.storage.native_events): {exc}"
+        ) from exc
+    return NativeEventStore(os.path.join(_conf_root(conf), "events_native"))
+
+
+# Built-in families (the analogue of the reference's in-tree backend
+# packages hbase/elasticsearch/localfs/hdfs, registered here instead of
+# discovered by classname). Third-party families self-register on import —
+# see backends.resolve_backend for the discovery order.
+register_backend(
+    BackendFamily(
+        name="sqlite",
+        events=lambda c: SqliteEventStore(os.path.join(_conf_root(c), "events.db")),
+        metadata=lambda c: MetadataStore(os.path.join(_conf_root(c), "metadata.db")),
+        models=lambda c: SqliteModelStore(os.path.join(_conf_root(c), "models.db")),
+    )
+)
+register_backend(
+    BackendFamily(
+        name="localfs",
+        events=lambda c: SqliteEventStore(os.path.join(_conf_root(c), "events.db")),
+        metadata=lambda c: MetadataStore(os.path.join(_conf_root(c), "metadata.db")),
+        models=lambda c: LocalFSModelStore(os.path.join(_conf_root(c), "models")),
+    )
+)
+register_backend(
+    BackendFamily(
+        name="memory",
+        events=lambda c: SqliteEventStore(":memory:"),
+        metadata=lambda c: MetadataStore(":memory:"),
+        models=lambda c: SqliteModelStore(":memory:"),
+    )
+)
+register_backend(BackendFamily(name="native", events=_native_events))
+
+
 def make_event_store(stype: str, root: str) -> EventStore:
-    """Event-store factory: the single place mapping a source ``type`` string
-    to a backend and its on-disk layout (used by the registry and by
-    ``pio upgrade``, so the two can never diverge)."""
-    if stype in ("sqlite", "localfs"):
-        return SqliteEventStore(os.path.join(root, "events.db"))
-    if stype == "memory":
-        return SqliteEventStore(":memory:")
-    if stype == "native":
-        try:
-            from .native_events import NativeEventStore
-        except ImportError as exc:
-            raise StorageError(
-                "native event store backend is not built "
-                f"(predictionio_tpu.storage.native_events): {exc}"
-            ) from exc
-        return NativeEventStore(os.path.join(root, "events_native"))
-    raise StorageError(f"Unknown event store type {stype!r}")
+    """Event-store factory (used by the registry and by ``pio upgrade``, so
+    the two can never diverge). Thin wrapper over the family table."""
+    try:
+        return make_store(stype, "events", {"type": stype, "path": root})
+    except BackendLookupError as exc:
+        raise StorageError(str(exc)) from exc
 
 
 class StorageRegistry:
@@ -115,58 +156,29 @@ class StorageRegistry:
     def _source_conf(self, name: str) -> Dict[str, str]:
         return self._sources[name]
 
-    def _source_path(self, name: str, filename: str) -> str:
-        conf = self._source_conf(name)
-        root = conf.get("path", base_dir(self._env))
-        return os.path.join(root, filename)
-
     # -- repository accessors (Storage.scala:252-276) ---------------------
-    def get_events(self) -> EventStore:
-        name = self._repo_source_name(REPO_EVENTDATA)
+    def _get_store(self, repo: str, repo_kind: str, cache: Dict[str, object]):
+        name = self._repo_source_name(repo)
         with self._lock:
-            if name not in self._event_stores:
-                conf = self._source_conf(name)
-                self._event_stores[name] = make_event_store(
-                    conf.get("type", "sqlite"),
-                    conf.get("path", base_dir(self._env)),
-                )
-            return self._event_stores[name]
+            if name not in cache:
+                conf = dict(self._source_conf(name))
+                conf.setdefault("path", base_dir(self._env))
+                try:
+                    cache[name] = make_store(
+                        conf.get("type", "sqlite"), repo_kind, conf
+                    )
+                except BackendLookupError as exc:
+                    raise StorageError(str(exc)) from exc
+            return cache[name]
+
+    def get_events(self) -> EventStore:
+        return self._get_store(REPO_EVENTDATA, "events", self._event_stores)
 
     def get_metadata(self) -> MetadataStore:
-        name = self._repo_source_name(REPO_METADATA)
-        with self._lock:
-            if name not in self._metadata_stores:
-                conf = self._source_conf(name)
-                stype = conf.get("type", "sqlite")
-                if stype == "memory":
-                    self._metadata_stores[name] = MetadataStore(":memory:")
-                elif stype in ("sqlite", "localfs"):
-                    self._metadata_stores[name] = MetadataStore(
-                        self._source_path(name, "metadata.db")
-                    )
-                else:
-                    raise StorageError(f"Unknown metadata store type {stype!r}")
-            return self._metadata_stores[name]
+        return self._get_store(REPO_METADATA, "metadata", self._metadata_stores)
 
     def get_models(self) -> ModelStore:
-        name = self._repo_source_name(REPO_MODELDATA)
-        with self._lock:
-            if name not in self._model_stores:
-                conf = self._source_conf(name)
-                stype = conf.get("type", "sqlite")
-                if stype == "localfs":
-                    self._model_stores[name] = LocalFSModelStore(
-                        self._source_path(name, "models")
-                    )
-                elif stype == "memory":
-                    self._model_stores[name] = SqliteModelStore(":memory:")
-                elif stype == "sqlite":
-                    self._model_stores[name] = SqliteModelStore(
-                        self._source_path(name, "models.db")
-                    )
-                else:
-                    raise StorageError(f"Unknown model store type {stype!r}")
-            return self._model_stores[name]
+        return self._get_store(REPO_MODELDATA, "models", self._model_stores)
 
     # -- verification (pio status; Storage.scala:230-250) ------------------
     def verify_all_data_objects(self) -> Dict[str, bool]:

@@ -1,0 +1,229 @@
+"""Remote storage backend family (type ``remote``).
+
+Client half of the server-mode storage pair (``storage/storage_server.py``)
+— the rebuild's analogue of the reference's networked backends, where every
+store is a client to a storage service (HBase ``StorageClient`` holding an
+HConnection, ES ``StorageClient`` holding a ``TransportClient``;
+``data/src/main/scala/io/prediction/data/storage/hbase/StorageClient.scala``,
+``elasticsearch/StorageClient.scala``). Source conf keys::
+
+    PIO_STORAGE_SOURCES_<NAME>_TYPE=remote
+    PIO_STORAGE_SOURCES_<NAME>_HOST=10.0.0.2     (default 127.0.0.1)
+    PIO_STORAGE_SOURCES_<NAME>_PORT=7079
+
+This module self-registers the family on import: the registry's
+``resolve_backend`` imports ``predictionio_tpu.storage.remote`` the first
+time it meets ``type=remote`` — nothing in ``registry.py`` names this
+backend (the pluggability contract, ``Storage.scala:176-217``).
+
+Event scans stream as ndjson, so ``find`` over a huge app yields in bounded
+memory on both sides.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Iterator, Optional
+
+from .backends import BackendFamily, SourceConf, register_backend
+from .event import Event
+from .events import EventFilter, EventStore
+from .model_store import Model, ModelStore
+from .storage_server import DEFAULT_PORT, METADATA_RPC_METHODS
+from .wire import decode, encode
+
+
+class RemoteStorageError(Exception):
+    """Transport or server-side failure, with the server's message.
+    ``code`` is the HTTP status, or ``None`` for transport errors."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+
+def _request(
+    url: str, method: str = "GET", body: Optional[bytes] = None, timeout: float = 60.0
+):
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode("utf-8", "replace")[:500]
+        raise RemoteStorageError(
+            f"{method} {url} → HTTP {exc.code}: {detail}", code=exc.code
+        ) from exc
+    except urllib.error.URLError as exc:
+        raise RemoteStorageError(f"{method} {url} unreachable: {exc.reason}") from exc
+
+
+def _json(resp) -> dict:
+    return json.loads(resp.read())
+
+
+class RemoteEventStore(EventStore):
+    """``EventStore`` over the storage server's /events routes."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        # 60 s default mirrors the reference LEvents op timeout
+        # (LEvents.scala:35).
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+
+    def _url(self, app_id: int, suffix: str = "") -> str:
+        return f"{self._base}/events/{app_id}{suffix}"
+
+    def init(self, app_id: int) -> bool:
+        with _request(self._url(app_id, "/init"), "POST", b"{}", self._timeout) as r:
+            return bool(_json(r)["ok"])
+
+    def remove(self, app_id: int) -> bool:
+        with _request(self._url(app_id, "/remove"), "POST", b"{}", self._timeout) as r:
+            return bool(_json(r)["ok"])
+
+    def insert(self, event: Event, app_id: int) -> str:
+        body = json.dumps(event.to_json_dict()).encode()
+        with _request(self._url(app_id), "POST", body, self._timeout) as r:
+            return _json(r)["eventId"]
+
+    def get(self, event_id: str, app_id: int) -> Optional[Event]:
+        try:
+            with _request(self._url(app_id, f"/{event_id}"), timeout=self._timeout) as r:
+                return Event.from_json_dict(_json(r))
+        except RemoteStorageError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def delete(self, event_id: str, app_id: int) -> bool:
+        with _request(
+            self._url(app_id, f"/{event_id}"), "DELETE", timeout=self._timeout
+        ) as r:
+            return bool(_json(r)["found"])
+
+    def find(
+        self, app_id: int, filter: Optional[EventFilter] = None
+    ) -> Iterator[Event]:
+        body = self._filter_dict(filter or EventFilter())
+        resp = _request(
+            self._url(app_id, "/find"), "POST", json.dumps(body).encode(),
+            self._timeout,
+        )
+
+        def iterate() -> Iterator[Event]:
+            with resp:
+                for line in resp:  # http.client decodes the chunked framing
+                    line = line.strip()
+                    if line:
+                        yield Event.from_json_dict(json.loads(line))
+
+        return iterate()
+
+    def _filter_dict(self, flt: EventFilter) -> dict:
+        return {
+            "start_time": flt.start_time.isoformat() if flt.start_time else None,
+            "until_time": flt.until_time.isoformat() if flt.until_time else None,
+            "entity_type": flt.entity_type,
+            "entity_id": flt.entity_id,
+            "event_names": list(flt.event_names) if flt.event_names else None,
+            "target_entity_type": flt.target_entity_type,
+            "target_entity_id": flt.target_entity_id,
+            "has_target_entity_type": flt.has_target_entity_type,
+            "has_target_entity_id": flt.has_target_entity_id,
+            "limit": flt.limit,
+            "reversed": flt.reversed,
+        }
+
+    def scan_columnar(self, app_id: int, filter: Optional[EventFilter] = None):
+        """Columnar fast path over the wire (same contract as
+        ``SqliteEventStore.scan_columnar``); the server delegates to the
+        backing store's native columnar scan."""
+        import numpy as np
+
+        body = json.dumps(self._filter_dict(filter or EventFilter())).encode()
+        with _request(
+            self._url(app_id, "/scan_columnar"), "POST", body, self._timeout
+        ) as r:
+            cols = _json(r)
+        cols["event_time_ms"] = np.asarray(cols["event_time_ms"], dtype=np.int64)
+        return cols
+
+    def write(self, events, app_id: int) -> None:
+        body = json.dumps([e.to_json_dict() for e in events]).encode()
+        with _request(self._url(app_id, "/batch"), "POST", body, self._timeout):
+            pass
+
+
+class _RemoteRPC:
+    """One metadata RPC method bound to a URL."""
+
+    def __init__(self, base: str, method: str, timeout: float):
+        self._base, self._method, self._timeout = base, method, timeout
+
+    def __call__(self, *args):
+        body = json.dumps(
+            {"method": self._method, "args": [encode(a) for a in args]}
+        ).encode()
+        with _request(f"{self._base}/metadata/rpc", "POST", body, self._timeout) as r:
+            return decode(_json(r)["result"])
+
+
+class RemoteMetadataStore:
+    """Duck-typed ``MetadataStore`` forwarding every DAO method over RPC.
+
+    The method list is pinned server-side (``METADATA_RPC_METHODS``); here
+    each becomes a bound callable, so call sites are oblivious to the wire.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        base = base_url.rstrip("/")
+        for method in METADATA_RPC_METHODS:
+            setattr(self, method, _RemoteRPC(base, method, timeout))
+
+    def close(self) -> None:
+        pass
+
+
+class RemoteModelStore(ModelStore):
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+
+    def insert(self, model: Model) -> None:
+        with _request(
+            f"{self._base}/models/{model.id}", "PUT", model.models, self._timeout
+        ):
+            pass
+
+    def get(self, id: str) -> Optional[Model]:
+        try:
+            with _request(f"{self._base}/models/{id}", timeout=self._timeout) as r:
+                return Model(id=id, models=r.read())
+        except RemoteStorageError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def delete(self, id: str) -> None:
+        with _request(f"{self._base}/models/{id}", "DELETE", timeout=self._timeout):
+            pass
+
+
+def _base_url(conf: SourceConf) -> str:
+    host = conf.get("host", "127.0.0.1")
+    port = int(conf.get("port", DEFAULT_PORT))
+    return f"http://{host}:{port}"
+
+
+register_backend(
+    BackendFamily(
+        name="remote",
+        events=lambda c: RemoteEventStore(_base_url(c)),
+        metadata=lambda c: RemoteMetadataStore(_base_url(c)),
+        models=lambda c: RemoteModelStore(_base_url(c)),
+    )
+)

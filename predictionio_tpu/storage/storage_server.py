@@ -1,0 +1,281 @@
+"""Server-mode storage: one process serving all three repositories over HTTP.
+
+The reference's production stores are *server-mode* — HBase regionservers
+for events, an Elasticsearch cluster for metadata
+(``data/src/main/scala/io/prediction/data/storage/hbase/StorageClient.scala``,
+``elasticsearch/StorageClient.scala``): many PredictionIO processes (CLI,
+event server, training, serving) share state through a storage service on
+the network. This module is the TPU rebuild's equivalent service: it exposes
+a local registry's event/metadata/model stores over a small HTTP API that
+``storage/remote.py`` clients consume, so multiple hosts (e.g. every worker
+of a multi-host TPU pod) can share one storage endpoint.
+
+Wire surface (all JSON unless noted):
+
+* ``POST /events/<app_id>``            insert one event → ``{"eventId"}``
+* ``POST /events/<app_id>/batch``      bulk write ``[event, ...]``
+* ``GET|DELETE /events/<app_id>/<id>`` point get / delete
+* ``POST /events/<app_id>/find``       body = filter dict → **ndjson** stream
+* ``POST /events/<app_id>/init|remove`` lifecycle
+* ``POST /metadata/rpc``               ``{"method", "args"}`` → ``{"result"}``
+  (whitelisted MetadataStore methods; dataclasses encoded by ``wire.py``)
+* ``PUT|GET|DELETE /models/<id>``      raw model bytes
+* ``GET /health``                      liveness probe
+
+Run it with ``pio storageserver`` or :func:`create_storage_server`.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from .event import Event
+from .events import EventFilter
+from .metadata import MetadataStore
+from .wire import decode, encode
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 7079
+
+#: MetadataStore methods callable over /metadata/rpc. Everything public and
+#: data-plane-free; an explicit list so a future store method with side
+#: effects outside storage cannot be reached remotely by accident.
+METADATA_RPC_METHODS = frozenset(
+    {
+        "gen_next",
+        "app_insert",
+        "app_get",
+        "app_get_by_name",
+        "app_get_all",
+        "app_update",
+        "app_delete",
+        "access_key_insert",
+        "access_key_get",
+        "access_key_get_by_app",
+        "access_key_delete",
+        "manifest_update",
+        "manifest_get",
+        "engine_instance_insert",
+        "engine_instance_get",
+        "engine_instance_get_all",
+        "engine_instance_get_latest_completed",
+        "engine_instance_update",
+        "engine_instance_delete",
+        "evaluation_instance_insert",
+        "evaluation_instance_get",
+        "evaluation_instance_get_completed",
+        "evaluation_instance_update",
+    }
+)
+
+
+def _parse_filter(obj: dict) -> EventFilter:
+    kwargs = dict(obj)
+    for key in ("start_time", "until_time"):
+        if kwargs.get(key) is not None:
+            kwargs[key] = _dt.datetime.fromisoformat(kwargs[key])
+    return EventFilter(**kwargs)
+
+
+class _StorageHandler(JsonHTTPHandler):
+    server: "StorageServer"
+
+    # -- routing ----------------------------------------------------------
+    def _route(self, method: str) -> None:
+        self._headers_sent = False  # reset per request (keep-alive reuse)
+        path = urlparse(self.path).path.rstrip("/")
+        parts = [p for p in path.split("/") if p]
+        try:
+            if parts == ["health"]:
+                self.respond(200, {"status": "alive"})
+            elif parts and parts[0] == "events":
+                self._route_events(method, parts[1:])
+            elif parts == ["metadata", "rpc"] and method == "POST":
+                self._metadata_rpc()
+            elif parts and parts[0] == "models" and len(parts) == 2:
+                self._route_models(method, parts[1])
+            else:
+                self.read_body()
+                self.respond(404, {"message": "Not found"})
+        except Exception as exc:  # one bad request must not kill the server
+            logger.exception("storage server error on %s %s", method, path)
+            if getattr(self, "_headers_sent", False):
+                # Mid-stream failure: a second response would corrupt the
+                # chunked framing. Drop the connection so the client fails
+                # loudly instead of parsing a truncated stream as complete.
+                self.close_connection = True
+                return
+            try:
+                self.respond(500, {"message": f"{type(exc).__name__}: {exc}"})
+            except Exception:
+                pass  # client hung up mid-response
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._route("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._route("POST")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._route("PUT")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._route("DELETE")
+
+    # -- events -----------------------------------------------------------
+    def _route_events(self, method: str, parts: list) -> None:
+        store = self.server.events
+        if not parts:
+            self.read_body()
+            self.respond(404, {"message": "Missing app id"})
+            return
+        app_id = int(parts[0])
+        rest = parts[1:]
+        if method == "POST" and not rest:
+            event = Event.from_json_dict(json.loads(self.read_body()))
+            self.respond(201, {"eventId": store.insert(event, app_id)})
+        elif method == "POST" and rest == ["batch"]:
+            events = [
+                Event.from_json_dict(o) for o in json.loads(self.read_body())
+            ]
+            store.write(events, app_id)
+            self.respond(200, {"count": len(events)})
+        elif method == "POST" and rest == ["find"]:
+            flt = _parse_filter(json.loads(self.read_body() or b"{}"))
+            self._stream_events(store.find(app_id, flt))
+        elif method == "POST" and rest == ["scan_columnar"]:
+            flt = _parse_filter(json.loads(self.read_body() or b"{}"))
+            self._scan_columnar(store, app_id, flt)
+        elif method == "POST" and rest == ["init"]:
+            self.read_body()
+            self.respond(200, {"ok": store.init(app_id)})
+        elif method == "POST" and rest == ["remove"]:
+            self.read_body()
+            self.respond(200, {"ok": store.remove(app_id)})
+        elif method == "GET" and len(rest) == 1:
+            event = store.get(rest[0], app_id)
+            if event is None:
+                self.respond(404, {"message": "Not found"})
+            else:
+                self.respond(200, event.to_json_dict())
+        elif method == "DELETE" and len(rest) == 1:
+            self.respond(200, {"found": store.delete(rest[0], app_id)})
+        else:
+            self.read_body()
+            self.respond(404, {"message": "Not found"})
+
+    def _stream_events(self, events) -> None:
+        """ndjson chunked stream — the scan never materializes server-side,
+        so an arbitrarily large app streams in bounded memory (the HBase
+        scanner-caching analogue, ``HBPEvents.scala:85``)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self._headers_sent = True
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+        buf = bytearray()
+        for event in events:
+            buf += json.dumps(event.to_json_dict()).encode() + b"\n"
+            if len(buf) >= 64 * 1024:
+                chunk(bytes(buf))
+                buf.clear()
+        if buf:
+            chunk(bytes(buf))
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _scan_columnar(self, store, app_id: int, flt: EventFilter) -> None:
+        """Columnar fast path. Delegates to the backing store's native
+        columnar scan when it has one; otherwise derives the columns from
+        ``find`` so every backend honors the contract."""
+        if hasattr(store, "scan_columnar"):
+            cols = dict(store.scan_columnar(app_id, flt))
+            cols["event_time_ms"] = [int(v) for v in cols["event_time_ms"]]
+        else:
+            from .event import to_millis
+
+            cols = {
+                "event": [], "entity_type": [], "entity_id": [],
+                "target_entity_type": [], "target_entity_id": [],
+                "properties": [], "event_time_ms": [],
+            }
+            for e in store.find(app_id, flt):
+                cols["event"].append(e.event)
+                cols["entity_type"].append(e.entity_type)
+                cols["entity_id"].append(e.entity_id)
+                cols["target_entity_type"].append(e.target_entity_type)
+                cols["target_entity_id"].append(e.target_entity_id)
+                cols["properties"].append(e.properties.to_json_dict())
+                cols["event_time_ms"].append(to_millis(e.event_time))
+        self.respond(200, cols)
+
+    # -- metadata ---------------------------------------------------------
+    def _metadata_rpc(self) -> None:
+        req = json.loads(self.read_body())
+        method = req.get("method", "")
+        if method not in METADATA_RPC_METHODS:
+            self.respond(400, {"message": f"Unknown RPC method {method!r}"})
+            return
+        args = [decode(a) for a in req.get("args", [])]
+        result = getattr(self.server.metadata, method)(*args)
+        self.respond(200, {"result": encode(result)})
+
+    # -- models -----------------------------------------------------------
+    def _route_models(self, method: str, model_id: str) -> None:
+        from .model_store import Model
+
+        store = self.server.models
+        if method == "PUT":
+            store.insert(Model(id=model_id, models=self.read_body()))
+            self.respond(200, {"ok": True})
+        elif method == "GET":
+            model = store.get(model_id)
+            if model is None:
+                self.respond(404, {"message": "Not found"})
+            else:
+                self.respond(200, model.models, content_type="application/octet-stream")
+        elif method == "DELETE":
+            store.delete(model_id)
+            self.respond(200, {"ok": True})
+        else:
+            self.read_body()
+            self.respond(404, {"message": "Not found"})
+
+
+class StorageServer(BackgroundHTTPServer):
+    """HTTP front for one set of backing stores."""
+
+    def __init__(self, host: str, port: int, events, metadata: MetadataStore, models):
+        super().__init__((host, port), _StorageHandler)
+        self.events = events
+        self.metadata = metadata
+        self.models = models
+
+
+def create_storage_server(
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_PORT,
+    registry: Optional[object] = None,
+) -> StorageServer:
+    """Build a storage server fronting ``registry`` (default: the
+    process-wide env-configured registry)."""
+    if registry is None:
+        from .registry import get_registry
+
+        registry = get_registry()
+    return StorageServer(
+        host,
+        port,
+        registry.get_events(),
+        registry.get_metadata(),
+        registry.get_models(),
+    )

@@ -1,0 +1,65 @@
+"""JSON wire codec for metadata records.
+
+The remote storage backend (``storage/remote.py`` ↔
+``storage/storage_server.py``) ships MetadataStore arguments and results as
+JSON. The reference does the same job with Elasticsearch document
+serializers (one json4s codec per DAO, e.g.
+``data/src/main/scala/io/prediction/data/storage/elasticsearch/ESEngineInstances.scala``);
+here one generic dataclass codec covers all record types: a tagged envelope
+``{"__dc__": "EngineInstance", ...fields}`` for dataclasses and
+``{"__dt__": iso8601}`` for datetimes, everything else plain JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Any, Dict, Type
+
+from .metadata import (
+    AccessKey,
+    App,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+)
+
+_RECORD_TYPES: Dict[str, Type] = {
+    cls.__name__: cls
+    for cls in (App, AccessKey, EngineManifest, EngineInstance, EvaluationInstance)
+}
+
+
+def encode(obj: Any) -> Any:
+    """Python value → JSON-safe value."""
+    if dataclasses.is_dataclass(obj) and type(obj).__name__ in _RECORD_TYPES:
+        out = {"__dc__": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, _dt.datetime):
+        return {"__dt__": obj.isoformat()}
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    return obj
+
+
+def decode(obj: Any) -> Any:
+    """JSON value → Python value (inverse of :func:`encode`)."""
+    if isinstance(obj, dict):
+        if "__dt__" in obj and len(obj) == 1:
+            return _dt.datetime.fromisoformat(obj["__dt__"])
+        if "__dc__" in obj:
+            cls = _RECORD_TYPES[obj["__dc__"]]
+            fields = {
+                k: decode(v) for k, v in obj.items() if k != "__dc__"
+            }
+            # Sequence fields (AccessKey.events, EngineManifest.files) come
+            # back as lists; the dataclasses accept any Sequence.
+            return cls(**fields)
+        return {k: decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode(v) for v in obj]
+    return obj

@@ -258,6 +258,13 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--ip", default="localhost")
     db.add_argument("--port", type=int, default=9000)
 
+    ss = sub.add_parser(
+        "storageserver",
+        help="serve this host's storage backends over HTTP (type=remote peer)",
+    )
+    ss.add_argument("--ip", default="localhost")
+    ss.add_argument("--port", type=int, default=7079)
+
     sub.add_parser("status", help="verify storage backends")
 
     ex = sub.add_parser("export", help="export app events to JSON-lines")
@@ -517,6 +524,17 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         create_dashboard(
             DashboardConfig(ip=args.ip, port=args.port), registry, block=True
         )
+        return EXIT_OK
+
+    if cmd == "storageserver":
+        from ..storage.storage_server import create_storage_server
+
+        server = create_storage_server(args.ip, args.port, registry)
+        _emit({"status": "serving", "port": server.bound_port})
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.server_close()
         return EXIT_OK
 
     if cmd == "status":

@@ -29,15 +29,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
-@pytest.fixture(params=["sqlite", "native"])
+@pytest.fixture(params=["sqlite", "native", "remote"])
 def event_store(request, tmp_path):
-    """Every event-store test runs against both the SQLite backend and the
-    native (C++) append-only log backend — the analogue of the reference
-    running its EventsSpec against each configured storage source."""
+    """Every event-store test runs against the SQLite backend, the native
+    (C++) append-only log backend, and the remote (HTTP server-mode)
+    backend — the analogue of the reference running its EventsSpec against
+    each configured storage source."""
+    server = None
     if request.param == "sqlite":
         from predictionio_tpu.storage import SqliteEventStore
 
         store = SqliteEventStore(":memory:")
+    elif request.param == "remote":
+        from predictionio_tpu.storage import MetadataStore, SqliteEventStore
+        from predictionio_tpu.storage.model_store import SqliteModelStore
+        from predictionio_tpu.storage.remote import RemoteEventStore
+        from predictionio_tpu.storage.storage_server import StorageServer
+
+        server = StorageServer(
+            "127.0.0.1",
+            0,
+            SqliteEventStore(":memory:"),
+            MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        server.start_background()
+        store = RemoteEventStore(f"http://127.0.0.1:{server.bound_port}")
     else:
         from predictionio_tpu.native import NativeBuildError
 
@@ -50,6 +67,9 @@ def event_store(request, tmp_path):
     store.init(1)
     yield store
     store.close()
+    if server is not None:
+        server.shutdown()
+        server.server_close()
 
 
 @pytest.fixture()

@@ -1,0 +1,237 @@
+"""Server-mode (remote) storage backend + pluggable-registry tests.
+
+The remote family is the rebuild's analogue of the reference's networked
+backends (HBase/Elasticsearch clients); registry pluggability mirrors the
+reflective DAO lookup of ``Storage.scala:176-217``. The event-store surface
+itself is covered by the shared ``event_store`` fixture (conftest) running
+every storage test against the remote backend; this file covers the
+metadata RPC, model blobs, registry resolution from env config, and
+third-party registration without editing ``registry.py``.
+"""
+
+import datetime as dt
+import textwrap
+
+import pytest
+
+from predictionio_tpu.storage import MetadataStore, SqliteEventStore
+from predictionio_tpu.storage.backends import (
+    BackendLookupError,
+    registered_backends,
+    resolve_backend,
+)
+from predictionio_tpu.storage.metadata import (
+    AccessKey,
+    App,
+    EngineInstance,
+    EngineManifest,
+    STATUS_COMPLETED,
+    STATUS_INIT,
+)
+from predictionio_tpu.storage.model_store import Model, SqliteModelStore
+from predictionio_tpu.storage.registry import StorageRegistry
+from predictionio_tpu.storage.remote import (
+    RemoteEventStore,
+    RemoteMetadataStore,
+    RemoteModelStore,
+    RemoteStorageError,
+)
+from predictionio_tpu.storage.storage_server import StorageServer
+from predictionio_tpu.storage.wire import decode, encode
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def server():
+    srv = StorageServer(
+        "127.0.0.1",
+        0,
+        SqliteEventStore(":memory:"),
+        MetadataStore(":memory:"),
+        SqliteModelStore(":memory:"),
+    )
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def base_url(server):
+    return f"http://127.0.0.1:{server.bound_port}"
+
+
+# -- wire codec -----------------------------------------------------------
+
+
+def test_wire_roundtrip_records():
+    inst = EngineInstance(
+        id="i1",
+        status=STATUS_INIT,
+        start_time=dt.datetime(2026, 7, 1, 12, 0, tzinfo=UTC),
+        end_time=dt.datetime(2026, 7, 1, 12, 5, tzinfo=UTC),
+        engine_id="e",
+        engine_version="1",
+        engine_variant="default",
+        engine_factory="f",
+        env={"A": "B"},
+    )
+    out = decode(encode(inst))
+    assert out == inst
+    # nested containers
+    assert decode(encode([inst, {"k": inst}])) == [inst, {"k": inst}]
+    # plain values pass through
+    assert decode(encode({"x": [1, "a", None, 2.5]})) == {"x": [1, "a", None, 2.5]}
+
+
+# -- metadata over RPC ----------------------------------------------------
+
+
+def test_remote_metadata_app_and_accesskey(base_url):
+    md = RemoteMetadataStore(base_url)
+    app_id = md.app_insert(App(id=0, name="remoteapp"))
+    assert isinstance(app_id, int)
+    assert md.app_get(app_id).name == "remoteapp"
+    assert md.app_get_by_name("remoteapp").id == app_id
+    assert [a.name for a in md.app_get_all()] == ["remoteapp"]
+
+    key = md.access_key_insert(AccessKey(key="", appid=app_id, events=["rate"]))
+    got = md.access_key_get(key)
+    assert got.appid == app_id and list(got.events) == ["rate"]
+    assert md.access_key_delete(key)
+
+
+def test_remote_metadata_engine_instances(base_url):
+    md = RemoteMetadataStore(base_url)
+    t0 = dt.datetime(2026, 7, 2, tzinfo=UTC)
+    inst = EngineInstance(
+        id="", status=STATUS_INIT, start_time=t0, end_time=t0,
+        engine_id="e", engine_version="v", engine_variant="default",
+        engine_factory="pkg.Factory",
+    )
+    iid = md.engine_instance_insert(inst)
+    got = md.engine_instance_get(iid)
+    assert got.start_time == t0 and got.status == STATUS_INIT
+    import dataclasses
+
+    md.engine_instance_update(
+        dataclasses.replace(got, status=STATUS_COMPLETED)
+    )
+    latest = md.engine_instance_get_latest_completed("e", "v", "default")
+    assert latest is not None and latest.id == iid
+
+    assert md.manifest_update(
+        EngineManifest(id="m", version="1", name="n", engine_factory="f")
+    )
+    assert md.manifest_get("m", "1").name == "n"
+    assert md.gen_next("seq") == 1 and md.gen_next("seq") == 2
+
+
+def test_remote_metadata_rejects_unknown_method(base_url):
+    from predictionio_tpu.storage.remote import _RemoteRPC
+
+    with pytest.raises(RemoteStorageError, match="HTTP 400"):
+        _RemoteRPC(base_url, "os_system", 5.0)("rm -rf /")
+
+
+# -- model blobs ----------------------------------------------------------
+
+
+def test_remote_models_roundtrip(base_url):
+    ms = RemoteModelStore(base_url)
+    blob = bytes(range(256)) * 10
+    ms.insert(Model(id="m1", models=blob))
+    assert ms.get("m1").models == blob
+    ms.delete("m1")
+    assert ms.get("m1") is None
+
+
+# -- registry resolution --------------------------------------------------
+
+
+def test_registry_resolves_remote_type_from_env(base_url, server):
+    env = {
+        "PIO_STORAGE_SOURCES_RS_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_RS_HOST": "127.0.0.1",
+        "PIO_STORAGE_SOURCES_RS_PORT": str(server.bound_port),
+    }
+    reg = StorageRegistry(env)
+    ev = reg.get_events()
+    assert isinstance(ev, RemoteEventStore)
+    from predictionio_tpu.storage.event import Event, utcnow
+
+    ev.init(7)
+    eid = ev.insert(
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=utcnow()),
+        7,
+    )
+    assert ev.get(eid, 7).entity_id == "u1"
+    assert isinstance(reg.get_metadata(), RemoteMetadataStore)
+    assert isinstance(reg.get_models(), RemoteModelStore)
+    # and the registry verification path works end-to-end over the wire
+    assert reg.verify_all_data_objects() == {
+        "metadata": True, "modeldata": True, "eventdata": True,
+    }
+
+
+def test_unknown_backend_type_reports_candidates():
+    reg = StorageRegistry({"PIO_STORAGE_SOURCES_X_TYPE": "nosuchbackend"})
+    from predictionio_tpu.storage.registry import StorageError
+
+    with pytest.raises(StorageError, match="nosuchbackend"):
+        reg.get_events()
+
+
+# -- third-party pluggability (the Storage.scala:176-217 contract) --------
+
+
+def test_third_party_backend_registers_without_editing_registry(
+    tmp_path, monkeypatch
+):
+    """A backend shipped outside predictionio_tpu plugs in via the source's
+    ``module`` conf key — nothing in registry.py names it."""
+    pkg = tmp_path / "thirdparty_kv.py"
+    pkg.write_text(
+        textwrap.dedent(
+            """
+            from predictionio_tpu.storage.backends import (
+                BackendFamily, register_backend,
+            )
+            from predictionio_tpu.storage.sqlite_events import SqliteEventStore
+
+            def _events(conf):
+                store = SqliteEventStore(":memory:")
+                store.thirdparty_marker = conf.get("flavor", "")
+                return store
+
+            register_backend(BackendFamily(name="kvtest", events=_events))
+            """
+        )
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    assert "kvtest" not in registered_backends()
+    reg = StorageRegistry(
+        {
+            "PIO_STORAGE_SOURCES_KV_TYPE": "kvtest",
+            "PIO_STORAGE_SOURCES_KV_MODULE": "thirdparty_kv",
+            "PIO_STORAGE_SOURCES_KV_FLAVOR": "tangy",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "KV",
+        }
+    )
+    ev = reg.get_events()
+    assert ev.thirdparty_marker == "tangy"
+    assert "kvtest" in registered_backends()
+
+
+def test_resolve_backend_error_lists_tried_modules():
+    with pytest.raises(BackendLookupError, match="predictionio_tpu.storage.zzz"):
+        resolve_backend("zzz", {})
+
+
+def test_builtin_families_present():
+    fams = registered_backends()
+    for name in ("sqlite", "localfs", "memory", "native"):
+        assert name in fams
