@@ -32,6 +32,7 @@ from ..controller import (
 from ..ops.als import ALSConfig, als_train_coo
 from ..ops.scoring import pad_pow2, top_k_for_users
 from ..storage import BiMap, EventFilter, get_registry
+from ..workflow.infeed import stream_ratings
 
 
 # -- queries / results (template's Query.scala / PredictedResult) -----------
@@ -62,12 +63,24 @@ class PredictedResult:
 # -- training data ----------------------------------------------------------
 @dataclasses.dataclass
 class TrainingData:
-    user_ids: List[str]
-    item_ids: List[str]
+    """Streamed, pre-indexed ratings.
+
+    The reference's TrainingData carries ``RDD[Rating]`` with *string* ids,
+    translated later by the preparator (``DataSource.scala:25-55``). Here
+    translation happens during the streaming read (12 bytes retained per
+    rating instead of three Python strings), so TrainingData already holds
+    dense indices plus the BiMaps to decode them — the host-memory contract
+    of SURVEY §7 ("no triple materialization").
+    """
+
+    users: np.ndarray  # int32 [nnz]
+    items: np.ndarray  # int32 [nnz]
     ratings: np.ndarray  # float32 [nnz]
+    user_map: BiMap
+    item_map: BiMap
 
     def sanity_check(self):
-        if len(self.user_ids) == 0:
+        if len(self.users) == 0:
             raise ValueError(
                 "No rating events found; check app id and event names."
             )
@@ -100,79 +113,88 @@ class RecDataSource(DataSource):
     def __init__(self, params: RecDataSourceParams = RecDataSourceParams()):
         self.params = params
 
+    def _value_rules(self) -> dict:
+        """Per-event value rule (the template's rate/buy pattern-match):
+        'rate' reads the required 'rating' property, 'buy' maps to a fixed
+        implicit rating. Unsupported names fail in stream_ratings' rule
+        lookup rather than pattern-match crash."""
+        rules: dict = {}
+        for name in self.params.event_names:
+            if name == "rate":
+                rules[name] = "rating"
+            elif name == "buy":
+                rules[name] = self.params.buy_rating
+            else:
+                raise ValueError(
+                    f"Unsupported event {name!r} in recommendation "
+                    "DataSource (supported: 'rate', 'buy')"
+                )
+        return rules
+
     def read_training(self, ctx) -> TrainingData:
         store = get_registry().get_events()
-        cols = store.scan_columnar(
-            self.params.app_id,
-            EventFilter(event_names=list(self.params.event_names)),
+        batch = stream_ratings(
+            store, self.params.app_id, self._value_rules()
         )
-        user_ids: List[str] = []
-        item_ids: List[str] = []
-        ratings: List[float] = []
-        for ev, uid, tid, props in zip(
-            cols["event"], cols["entity_id"],
-            cols["target_entity_id"], cols["properties"],
-        ):
-            if tid is None:
-                continue
-            if ev == "rate":
-                # required, like the template's properties.get[Double]
-                if "rating" not in props:
-                    raise ValueError(
-                        f"'rate' event for {uid}->{tid} has no 'rating' "
-                        "property"
-                    )
-                rating = float(props["rating"])
-            elif ev == "buy":
-                rating = self.params.buy_rating
-            else:
-                # reference template pattern-matches rate|buy and crashes on
-                # anything else; fail with a named error instead
-                raise ValueError(
-                    f"Unsupported event {ev!r} in recommendation DataSource "
-                    "(supported: 'rate', 'buy')"
-                )
-            user_ids.append(uid)
-            item_ids.append(tid)
-            ratings.append(rating)
         return TrainingData(
-            user_ids=user_ids,
-            item_ids=item_ids,
-            ratings=np.asarray(ratings, dtype=np.float32),
+            users=batch.users,
+            items=batch.items,
+            ratings=batch.ratings,
+            user_map=batch.user_map,
+            item_map=batch.item_map,
         )
 
     def read_eval(self, ctx):
         """K-fold by event index parity — mirrors the evaluation example's
         random splits but deterministic."""
         td = self.read_training(ctx)
-        n = len(td.user_ids)
+        n = len(td.users)
         idx = np.arange(n)
         test = idx % 4 == 0
+        u_inv, i_inv = td.user_map.inverse, td.item_map.inverse
+        # Rebuild maps from the TRAIN split only: a user/item whose every
+        # rating landed in the test split must be absent from the model's
+        # maps so predict() takes the unknown-user path (empty result)
+        # instead of scoring its never-solved zero factor row.
+        tr_users, tr_items = td.users[~test], td.items[~test]
+        uniq_u = np.unique(tr_users)
+        uniq_i = np.unique(tr_items)
+        u_remap = np.full(len(td.user_map), -1, dtype=np.int32)
+        u_remap[uniq_u] = np.arange(len(uniq_u), dtype=np.int32)
+        i_remap = np.full(len(td.item_map), -1, dtype=np.int32)
+        i_remap[uniq_i] = np.arange(len(uniq_i), dtype=np.int32)
         train_td = TrainingData(
-            user_ids=[u for i, u in enumerate(td.user_ids) if not test[i]],
-            item_ids=[it for i, it in enumerate(td.item_ids) if not test[i]],
+            users=u_remap[tr_users],
+            items=i_remap[tr_items],
             ratings=td.ratings[~test],
+            user_map=BiMap(
+                {u_inv[int(old)]: new for new, old in enumerate(uniq_u)}
+            ),
+            item_map=BiMap(
+                {i_inv[int(old)]: new for new, old in enumerate(uniq_i)}
+            ),
         )
         qa = [
-            (Query(user=td.user_ids[i], num=10),
-             ItemScore(item=td.item_ids[i], score=float(td.ratings[i])))
+            (Query(user=u_inv[int(td.users[i])], num=10),
+             ItemScore(item=i_inv[int(td.items[i])],
+                       score=float(td.ratings[i])))
             for i in idx[test]
         ]
         return [(train_td, None, qa)]
 
 
 class RecPreparator(Preparator):
-    """BiMap string-id → dense-index translation (reference custom-preparator
-    variant; ``BiMap.stringInt`` usage)."""
+    """Hands the streamed, pre-indexed ratings to the algorithm (reference
+    custom-preparator variant, ``BiMap.stringInt`` usage — the string→index
+    translation it performed now happens inside the streaming read, so
+    preparation is a re-shape, not a copy)."""
 
     def prepare(self, ctx, td: TrainingData) -> PreparedData:
-        user_map = BiMap.string_int(td.user_ids)
-        item_map = BiMap.string_int(td.item_ids)
         return PreparedData(
-            user_map=user_map,
-            item_map=item_map,
-            users=user_map.map_array(td.user_ids),
-            items=item_map.map_array(td.item_ids),
+            user_map=td.user_map,
+            item_map=td.item_map,
+            users=td.users,
+            items=td.items,
             ratings=td.ratings,
         )
 

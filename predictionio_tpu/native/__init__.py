@@ -30,7 +30,11 @@ class NativeBuildError(RuntimeError):
 
 def _source_digest(sources) -> str:
     sha = hashlib.sha1()
-    for src in sources:
+    # Headers are not compile inputs but must invalidate the stamp.
+    headers = sorted(
+        os.path.join(_HERE, f) for f in os.listdir(_HERE) if f.endswith(".h")
+    )
+    for src in list(sources) + headers:
         with open(src, "rb") as f:
             sha.update(f.read())
     return sha.hexdigest()

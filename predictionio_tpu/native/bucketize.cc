@@ -1,0 +1,150 @@
+// Degree-bucketed COO -> padded-CSR scatter (training infeed hot path).
+//
+// Native counterpart of the numpy bucketize in ops/als.py (same output
+// contract, bit-identical arrays): the reference delegates this shaping to
+// Spark MLlib's ALS block partitioner (inside ALS.train, invoked from e.g.
+// examples/scala-parallel-recommendation/.../ALSAlgorithm.scala:56-62);
+// here it is a two-pass threaded scatter:
+//
+//   pass A: per-thread row-degree histograms over disjoint nnz ranges
+//   prefix: per-(thread,row) write bases so every element's slot is a pure
+//           function of (thread, arrival order) -> fully parallel AND
+//           deterministic pass B (no atomics, no sort)
+//   pass B: scatter cols/vals straight into the caller-allocated padded
+//           bucket slabs; elements beyond a row's bucket width are dropped
+//           (same truncation rule as the numpy path)
+//   pass C: mask fill (1.0 for the first min(count, width) slots per row)
+//
+// The numpy path costs an O(nnz log nnz) argsort; this is O(nnz) with
+// sequential writes per thread in pass A and per-row locality in pass B.
+//
+// Python allocates all outputs (numpy owns the memory); this file only
+// fills them. Buckets and slot assignments are computed in numpy (cheap,
+// O(n_rows)) and passed down.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int hardware_threads(int64_t n_rows) {
+  unsigned n = std::thread::hardware_concurrency();
+  int t = n == 0 ? 4 : static_cast<int>(n > 16 ? 16 : n);
+  // Pass A allocates one n_rows int32 histogram per thread; bound the
+  // total at ~512 MB so huge row spaces degrade to fewer threads instead
+  // of O(n_rows x threads) memory blow-up.
+  const int64_t budget = 512ll << 20;
+  int64_t per_thread = n_rows * 4;
+  if (per_thread > 0 && per_thread * t > budget) {
+    t = static_cast<int>(std::max<int64_t>(1, budget / per_thread));
+  }
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+// rows/cols: [nnz] int32, vals: [nnz] float32.
+// bucket_of: [n_rows] int32 -- bucket index per row id (every row with
+//   degree > 0 has one; rows absent from the data never appear in `rows`).
+// slot_of: [n_rows] int32 -- row's position within its bucket.
+// counts: [n_rows] int32 -- row degree (uncapped).
+// widths: [n_buckets] int32.
+// idx_ptrs/val_ptrs/mask_ptrs: [n_buckets] pointers to zero-initialized
+//   slabs of shape [B_b * widths[b]] (int32 / float32 / float32).
+// Returns 0 on success.
+int pio_bucketize_fill(const int32_t* rows, const int32_t* cols,
+                       const float* vals, int64_t nnz, int64_t n_rows,
+                       const int32_t* bucket_of, const int32_t* slot_of,
+                       const int32_t* counts, const int32_t* widths,
+                       int32_t n_buckets, int32_t** idx_ptrs,
+                       float** val_ptrs, float** mask_ptrs) {
+  (void)n_buckets;
+  const int nthreads = hardware_threads(n_rows);
+  const int64_t chunk = (nnz + nthreads - 1) / nthreads;
+
+  // pass A: per-thread degree histograms over [t*chunk, (t+1)*chunk)
+  std::vector<std::vector<int32_t>> hist(static_cast<size_t>(nthreads));
+  {
+    std::vector<std::thread> ts;
+    ts.reserve(static_cast<size_t>(nthreads));
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t]() {
+        auto& h = hist[static_cast<size_t>(t)];
+        h.assign(static_cast<size_t>(n_rows), 0);
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min<int64_t>(nnz, lo + chunk);
+        for (int64_t k = lo; k < hi; ++k) ++h[static_cast<size_t>(rows[k])];
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  // prefix over threads: hist[t][r] becomes the within-row write base for
+  // thread t (number of row-r elements in threads < t)
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int32_t acc = 0;
+    for (int t = 0; t < nthreads; ++t) {
+      int32_t c = hist[static_cast<size_t>(t)][static_cast<size_t>(r)];
+      hist[static_cast<size_t>(t)][static_cast<size_t>(r)] = acc;
+      acc += c;
+    }
+  }
+
+  // pass B: deterministic parallel scatter into the padded slabs
+  {
+    std::vector<std::thread> ts;
+    ts.reserve(static_cast<size_t>(nthreads));
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t]() {
+        auto& base = hist[static_cast<size_t>(t)];
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min<int64_t>(nnz, lo + chunk);
+        for (int64_t k = lo; k < hi; ++k) {
+          const int32_t r = rows[k];
+          const int32_t w = base[static_cast<size_t>(r)]++;
+          const int32_t b = bucket_of[r];
+          const int32_t width = widths[b];
+          if (w >= width) continue;  // truncated tail of an over-wide row
+          const int64_t off =
+              static_cast<int64_t>(slot_of[r]) * width + w;
+          idx_ptrs[b][off] = cols[k];
+          val_ptrs[b][off] = vals[k];
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  // pass C: mask fill, parallel over row ids (each row owns a disjoint
+  // mask segment)
+  {
+    std::vector<std::thread> ts;
+    ts.reserve(static_cast<size_t>(nthreads));
+    const int64_t rchunk = (n_rows + nthreads - 1) / nthreads;
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t]() {
+        const int64_t lo = t * rchunk;
+        const int64_t hi = std::min<int64_t>(n_rows, lo + rchunk);
+        for (int64_t r = lo; r < hi; ++r) {
+          const int32_t c = counts[r];
+          if (c == 0) continue;
+          const int32_t b = bucket_of[r];
+          const int32_t width = widths[b];
+          const int32_t fill = c < width ? c : width;
+          float* m = mask_ptrs[b] +
+                     static_cast<int64_t>(slot_of[r]) * width;
+          for (int32_t j = 0; j < fill; ++j) m[j] = 1.0f;
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  return 0;
+}
+
+}  // extern "C"

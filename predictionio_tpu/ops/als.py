@@ -80,20 +80,154 @@ def bucketize(
     """COO → degree-bucketed padded CSR.
 
     Rows with degree above the largest width are truncated to it (keeping
-    arbitrary ratings) — with the default widths this only triggers beyond
-    32768 ratings per row.
+    the first ratings in input order) — with the default widths this only
+    triggers beyond 32768 ratings per row.
 
-    Host-bandwidth-tuned (this runs inside the training wall-clock): int32
-    temporaries throughout (valid while nnz and row ids fit in 31 bits),
-    group boundaries from a diff instead of ``np.unique``, and the pad mask
-    from a broadcast compare instead of a third scatter.
+    Dispatches to the native (C++ threaded O(nnz) scatter,
+    ``native/bucketize.cc``) or the numpy (argsort-based) implementation;
+    both produce bit-identical arrays. ``PIO_NO_NATIVE_BUCKETIZE=1`` forces
+    the numpy path; a missing toolchain falls back silently.
     """
     nnz = len(rows)
     if nnz >= 2**31 or n_rows >= 2**31 or n_cols >= 2**31:
         raise ValueError("bucketize supports up to 2^31-1 ratings/ids")
-    rows = np.asarray(rows).astype(np.int32, copy=False)
-    cols = np.asarray(cols).astype(np.int32, copy=False)
-    vals = np.asarray(vals, dtype=np.float32)
+    rows = np.ascontiguousarray(np.asarray(rows), dtype=np.int32)
+    cols = np.ascontiguousarray(np.asarray(cols), dtype=np.int32)
+    vals = np.ascontiguousarray(np.asarray(vals), dtype=np.float32)
+    import os as _os
+
+    global _NATIVE_BUCKETIZE_BROKEN
+    if (
+        nnz
+        and not _NATIVE_BUCKETIZE_BROKEN
+        and _os.environ.get("PIO_NO_NATIVE_BUCKETIZE") != "1"
+    ):
+        from ..native import NativeBuildError
+
+        try:
+            return _bucketize_native(
+                rows, cols, vals, n_rows, n_cols, bucket_widths
+            )
+        except NativeBuildError as exc:
+            # Toolchain-less host: numpy is full parity. Cache the verdict
+            # so we don't re-spawn a doomed compiler on every call; any
+            # OTHER failure propagates — a native-path bug must not become
+            # a silent slowdown.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native bucketize unavailable, using numpy path: %s", exc
+            )
+            _NATIVE_BUCKETIZE_BROKEN = True
+    return _bucketize_numpy(rows, cols, vals, n_rows, n_cols, bucket_widths)
+
+
+#: Set after the first failed native-bucketize build (per process).
+_NATIVE_BUCKETIZE_BROKEN = False
+
+
+def _bucketize_native(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    n_cols: int,
+    bucket_widths: Sequence[int] = DEFAULT_BUCKET_WIDTHS,
+) -> BucketedMatrix:
+    """Threaded two-pass scatter (no sort): numpy computes the O(n_rows)
+    bucket/slot assignment, C++ fills the padded slabs deterministically."""
+    import ctypes
+
+    from ..native import load_library
+
+    lib = load_library("bucketize")
+    lib.pio_bucketize_fill.restype = ctypes.c_int
+
+    nnz = len(rows)
+    widths = np.asarray(sorted(bucket_widths), dtype=np.int32)
+    max_w = int(widths[-1])
+    counts = np.bincount(rows, minlength=n_rows).astype(np.int32)
+    present = np.nonzero(counts)[0].astype(np.int32)  # ascending row ids
+    assignment = np.searchsorted(
+        widths, np.minimum(counts[present], max_w), side="left"
+    )
+
+    bucket_of = np.zeros(n_rows, dtype=np.int32)
+    slot_of = np.zeros(n_rows, dtype=np.int32)
+    slabs = []  # (sel, idx, val, mask) per width, empty buckets included
+    for wi, width in enumerate(widths):
+        sel = present[assignment == wi]
+        bucket_of[sel] = wi
+        slot_of[sel] = np.arange(len(sel), dtype=np.int32)
+        b = len(sel)
+        slabs.append(
+            (
+                sel,
+                np.zeros(b * width, dtype=np.int32),
+                np.zeros(b * width, dtype=np.float32),
+                np.zeros(b * width, dtype=np.float32),
+            )
+        )
+
+    i32p, f32p = ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)
+    idx_ptrs = (i32p * len(widths))(
+        *[s[1].ctypes.data_as(i32p) for s in slabs]
+    )
+    val_ptrs = (f32p * len(widths))(
+        *[s[2].ctypes.data_as(f32p) for s in slabs]
+    )
+    mask_ptrs = (f32p * len(widths))(
+        *[s[3].ctypes.data_as(f32p) for s in slabs]
+    )
+    rc = lib.pio_bucketize_fill(
+        rows.ctypes.data_as(i32p),
+        cols.ctypes.data_as(i32p),
+        vals.ctypes.data_as(f32p),
+        ctypes.c_int64(nnz),
+        ctypes.c_int64(n_rows),
+        bucket_of.ctypes.data_as(i32p),
+        slot_of.ctypes.data_as(i32p),
+        counts.ctypes.data_as(i32p),
+        widths.ctypes.data_as(i32p),
+        ctypes.c_int32(len(widths)),
+        idx_ptrs,
+        val_ptrs,
+        mask_ptrs,
+    )
+    if rc != 0:
+        raise RuntimeError(f"pio_bucketize_fill failed rc={rc}")
+
+    buckets = [
+        Bucket(
+            rows=sel,
+            idx=idx.reshape(len(sel), int(w)),
+            val=val.reshape(len(sel), int(w)),
+            mask=mask.reshape(len(sel), int(w)),
+        )
+        for w, (sel, idx, val, mask) in zip(widths, slabs)
+        if len(sel)
+    ]
+    return BucketedMatrix(
+        n_rows=n_rows, n_cols=n_cols, nnz=int(nnz), buckets=buckets
+    )
+
+
+def _bucketize_numpy(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    n_cols: int,
+    bucket_widths: Sequence[int] = DEFAULT_BUCKET_WIDTHS,
+) -> BucketedMatrix:
+    """Pure-numpy reference implementation (argsort-based).
+
+    Host-bandwidth-tuned: int32 temporaries throughout (valid while nnz and
+    row ids fit in 31 bits), group boundaries from a diff instead of
+    ``np.unique``, and the pad mask from a broadcast compare instead of a
+    third scatter.
+    """
+    nnz = len(rows)
     order = np.argsort(rows, kind="stable")  # radix for int keys
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     if nnz:

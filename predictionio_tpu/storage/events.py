@@ -116,6 +116,54 @@ class EventStore(abc.ABC):
     ) -> Iterator[Event]:
         """Events ordered by event time (descending when ``filter.reversed``)."""
 
+    def scan_columnar_iter(
+        self,
+        app_id: int,
+        filter: Optional[EventFilter] = None,
+        chunk_rows: int = 1_000_000,
+    ) -> Iterator[dict]:
+        """Chunked columnar scan: yields column dicts of at most
+        ``chunk_rows`` rows each (same keys as ``scan_columnar``).
+
+        The streaming-infeed primitive (the analogue of the reference's
+        region-split reads feeding executors, ``HBPEvents.scala:58-98``):
+        a training pipeline can translate + stage each chunk while the next
+        is being read, holding one chunk of Python objects at a time
+        instead of the whole app. Backends override with columnar fast
+        paths; this base version derives chunks from ``find``.
+        """
+        import numpy as np
+
+        from .event import to_millis
+
+        def new_cols() -> dict:
+            return {
+                "event": [], "entity_type": [], "entity_id": [],
+                "target_entity_type": [], "target_entity_id": [],
+                "properties": [], "event_time_ms": [],
+            }
+
+        cols = new_cols()
+        for e in self.find(app_id, filter):
+            cols["event"].append(e.event)
+            cols["entity_type"].append(e.entity_type)
+            cols["entity_id"].append(e.entity_id)
+            cols["target_entity_type"].append(e.target_entity_type)
+            cols["target_entity_id"].append(e.target_entity_id)
+            cols["properties"].append(e.properties.to_dict())
+            cols["event_time_ms"].append(to_millis(e.event_time))
+            if len(cols["event"]) >= chunk_rows:
+                cols["event_time_ms"] = np.asarray(
+                    cols["event_time_ms"], dtype=np.int64
+                )
+                yield cols
+                cols = new_cols()
+        if cols["event"]:
+            cols["event_time_ms"] = np.asarray(
+                cols["event_time_ms"], dtype=np.int64
+            )
+            yield cols
+
     # -- derived views ----------------------------------------------------
     def aggregate_properties(
         self,

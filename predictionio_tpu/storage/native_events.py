@@ -54,7 +54,7 @@ _SYNC_EVERY = 256
 
 
 def _lib() -> ctypes.CDLL:
-    lib = load_library("eventlog")
+    lib = load_library("eventlog", sources=["eventlog.cc", "ratings.cc"])
     if not getattr(lib, "_pio_configured", False):
         lib.evlog_open.restype = ctypes.c_void_p
         lib.evlog_open.argtypes = [ctypes.c_char_p]
@@ -85,6 +85,27 @@ def _lib() -> ctypes.CDLL:
         lib.evlog_get.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.evlog_ratings_scan.restype = ctypes.c_void_p
+        lib.evlog_ratings_scan.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        for fn in ("evlog_ratings_n_users", "evlog_ratings_n_items",
+                   "evlog_ratings_user_pool_bytes",
+                   "evlog_ratings_item_pool_bytes"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.evlog_ratings_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.evlog_ratings_user_pool_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.evlog_ratings_item_pool_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.evlog_ratings_free.argtypes = [ctypes.c_void_p]
         lib._pio_configured = True
     return lib
 
@@ -338,48 +359,196 @@ class NativeEventStore(EventStore):
             return False
         return True
 
+    def scan_ratings(self, app_id: int, value_rules: dict):
+        """Full DataSource inner loop in C++ (``native/ratings.cc``): one
+        pass over the log producing dense index/value arrays plus the
+        unique-id lists — per-event Python objects are never created.
+
+        ``value_rules`` maps event name → property name (str) or fixed
+        float, with at most one distinct property name across rules (the
+        recommendation template needs one). Returns
+        ``(users_i32, items_i32, vals_f32, user_ids, item_ids)`` ordered by
+        (event_time, offset) — identical index assignment to the streaming
+        Python path. Raises ``ValueError`` when the rules need more than
+        one property name (callers fall back to the generic path).
+        """
+        prop_names = {r for r in value_rules.values() if isinstance(r, str)}
+        if len(prop_names) > 1:
+            raise ValueError(
+                f"native ratings scan supports one property name, got "
+                f"{sorted(prop_names)}"
+            )
+        prop_name = next(iter(prop_names), "")
+        empty = (
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32), [], [],
+        )
+        h = self._handle(app_id)
+        if h is None:
+            return empty
+
+        names = list(value_rules)
+        n = len(names)
+        hashes = np.asarray([_fnv(nm) for nm in names], dtype=np.uint64)
+        is_prop = np.asarray(
+            [1 if isinstance(value_rules[nm], str) else 0 for nm in names],
+            dtype=np.int32,
+        )
+        fixed = np.asarray(
+            [
+                0.0 if isinstance(value_rules[nm], str) else float(value_rules[nm])
+                for nm in names
+            ],
+            dtype=np.float64,
+        )
+        names_buf = b"".join(nm.encode("utf-8") + b"\0" for nm in names)
+        out_n = ctypes.c_int64(0)
+        out_bad = ctypes.c_int64(0)
+        res = self._lib.evlog_ratings_scan(
+            h,
+            hashes.ctypes.data_as(ctypes.c_void_p),
+            is_prop.ctypes.data_as(ctypes.c_void_p),
+            fixed.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(n),
+            names_buf,
+            prop_name.encode("utf-8"),
+            ctypes.byref(out_n),
+            ctypes.byref(out_bad),
+        )
+        if not res:
+            raise OSError("evlog_ratings_scan failed (mmap)")
+        try:
+            if out_bad.value:
+                raise ValueError(
+                    f"{out_bad.value} events missing required property "
+                    f"{prop_name!r} (or malformed payloads)"
+                )
+            count = out_n.value
+            users = np.empty(count, dtype=np.int32)
+            items = np.empty(count, dtype=np.int32)
+            vals = np.empty(count, dtype=np.float32)
+            if count:
+                self._lib.evlog_ratings_fill(
+                    res,
+                    users.ctypes.data_as(ctypes.c_void_p),
+                    items.ctypes.data_as(ctypes.c_void_p),
+                    vals.ctypes.data_as(ctypes.c_void_p),
+                )
+
+            def pool(n_fn, bytes_fn, fill_fn):
+                n_ids = n_fn(res)
+                nbytes = bytes_fn(res)
+                buf = np.empty(nbytes, dtype=np.uint8)
+                ends = np.empty(n_ids, dtype=np.int64)
+                if n_ids:
+                    fill_fn(
+                        res,
+                        buf.ctypes.data_as(ctypes.c_void_p),
+                        ends.ctypes.data_as(ctypes.c_void_p),
+                    )
+                raw = buf.tobytes()
+                out, start = [], 0
+                for end in ends.tolist():
+                    out.append(raw[start:end].decode("utf-8"))
+                    start = end
+                return out
+
+            user_ids = pool(
+                self._lib.evlog_ratings_n_users,
+                self._lib.evlog_ratings_user_pool_bytes,
+                self._lib.evlog_ratings_user_pool_fill,
+            )
+            item_ids = pool(
+                self._lib.evlog_ratings_n_items,
+                self._lib.evlog_ratings_item_pool_bytes,
+                self._lib.evlog_ratings_item_pool_fill,
+            )
+            return users, items, vals, user_ids, item_ids
+        finally:
+            self._lib.evlog_ratings_free(res)
+
+    @staticmethod
+    def _empty_cols() -> dict:
+        return {
+            "event": [], "entity_type": [], "entity_id": [],
+            "target_entity_type": [], "target_entity_id": [],
+            "properties": [], "event_time_ms": np.asarray([], dtype=np.int64),
+        }
+
     def scan_columnar(self, app_id: int, filter: Optional[EventFilter] = None):
         """Bulk scan returning a column dict (training-path fast lane; same
         contract as :meth:`SqliteEventStore.scan_columnar`). Payloads are
         decoded straight from the mmap'd log into columns — no per-event
         ``Event``/``DataMap`` objects."""
-        f = filter or EventFilter()
-        cols = {
-            "event": [], "entity_type": [], "entity_id": [],
-            "target_entity_type": [], "target_entity_id": [],
-            "properties": [], "event_time_ms": [],
+        chunks = list(self.scan_columnar_iter(app_id, filter))
+        if not chunks:
+            return self._empty_cols()
+        if len(chunks) == 1:
+            return chunks[0]
+        out = {
+            k: [v for c in chunks for v in c[k]]
+            for k in chunks[0]
+            if k != "event_time_ms"
         }
-        times = []
+        out["event_time_ms"] = np.concatenate(
+            [c["event_time_ms"] for c in chunks]
+        )
+        return out
+
+    def scan_columnar_iter(
+        self,
+        app_id: int,
+        filter: Optional[EventFilter] = None,
+        chunk_rows: int = 1_000_000,
+    ):
+        """Chunked columnar scan (``EventStore.scan_columnar_iter`` fast
+        path): the native index scan resolves all offsets up front (numpy
+        arrays, 20 B/event), then payload decode proceeds chunk by chunk
+        from the mmap — bounded Python-object footprint regardless of app
+        size (the region-split analogue, ``HBPEvents.scala:91-97``)."""
+        f = filter or EventFilter()
         scan = self._scan_offsets(app_id, f)
         if scan is None:
-            cols["event_time_ms"] = np.asarray([], dtype=np.int64)
-            return cols
+            return
         _, offs, lens, tms = scan
         if f.reversed:
             offs, lens, tms = offs[::-1], lens[::-1], tms[::-1]
         limit = f.limit if f.limit is not None and f.limit >= 0 else None
-        if len(offs):
-            path = self._log_path(app_id)
-            with open(path, "rb") as fh:
-                size = os.fstat(fh.fileno()).st_size
-                with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
-                    for off, length, tm in zip(
-                        offs.tolist(), lens.tolist(), tms.tolist()
-                    ):
-                        obj = json.loads(mm[off : off + length])
-                        if not self._dict_matches(f, obj):
-                            continue
-                        cols["event"].append(obj["event"])
-                        cols["entity_type"].append(obj["entityType"])
-                        cols["entity_id"].append(obj["entityId"])
-                        cols["target_entity_type"].append(obj.get("targetEntityType"))
-                        cols["target_entity_id"].append(obj.get("targetEntityId"))
-                        cols["properties"].append(obj.get("properties") or {})
-                        times.append(tm)
-                        if limit is not None and len(times) >= limit:
-                            break
-        cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
-        return cols
+        if not len(offs):
+            return
+        emitted = 0
+        path = self._log_path(app_id)
+        with open(path, "rb") as fh:
+            size = os.fstat(fh.fileno()).st_size
+            with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
+                cols = self._empty_cols()
+                times: list = []
+                for off, length, tm in zip(
+                    offs.tolist(), lens.tolist(), tms.tolist()
+                ):
+                    obj = json.loads(mm[off : off + length])
+                    if not self._dict_matches(f, obj):
+                        continue
+                    cols["event"].append(obj["event"])
+                    cols["entity_type"].append(obj["entityType"])
+                    cols["entity_id"].append(obj["entityId"])
+                    cols["target_entity_type"].append(obj.get("targetEntityType"))
+                    cols["target_entity_id"].append(obj.get("targetEntityId"))
+                    cols["properties"].append(obj.get("properties") or {})
+                    times.append(tm)
+                    emitted += 1
+                    full = len(times) >= chunk_rows
+                    done = limit is not None and emitted >= limit
+                    if full or done:
+                        cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
+                        yield cols
+                        if done:
+                            return
+                        cols = self._empty_cols()
+                        times = []
+                if times:
+                    cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
+                    yield cols
 
     def _decode_iter(
         self, app_id: int, f: EventFilter, offs: np.ndarray, lens: np.ndarray

@@ -276,6 +276,12 @@ class SqliteEventStore(EventStore):
         )
         with self._lock:
             rows = self._conn.execute(sql, params).fetchall()
+        return self._rows_to_cols(rows)
+
+    @staticmethod
+    def _rows_to_cols(rows) -> dict:
+        import numpy as np
+
         return {
             "event": [r[0] for r in rows],
             "entity_type": [r[1] for r in rows],
@@ -285,3 +291,28 @@ class SqliteEventStore(EventStore):
             "properties": [json.loads(r[5]) for r in rows],
             "event_time_ms": np.asarray([r[6] for r in rows], dtype=np.int64),
         }
+
+    def scan_columnar_iter(
+        self,
+        app_id: int,
+        filter: Optional[EventFilter] = None,
+        chunk_rows: int = 1_000_000,
+    ):
+        """Chunked columnar scan (``EventStore.scan_columnar_iter`` fast
+        path): one cursor, ``fetchmany`` batches, no per-event objects."""
+        table = self._ensure_table(app_id)
+        f = filter or EventFilter()
+        sql, params = self._build_query(
+            table,
+            f,
+            columns="event, entity_type, entity_id, target_entity_type, "
+            "target_entity_id, properties, event_time_ms",
+        )
+        with self._lock:
+            cursor = self._conn.execute(sql, params)
+        while True:
+            with self._lock:
+                rows = cursor.fetchmany(chunk_rows)
+            if not rows:
+                return
+            yield self._rows_to_cols(rows)

@@ -214,7 +214,7 @@ class _StorageHandler(JsonHTTPHandler):
                 cols["entity_id"].append(e.entity_id)
                 cols["target_entity_type"].append(e.target_entity_type)
                 cols["target_entity_id"].append(e.target_entity_id)
-                cols["properties"].append(e.properties.to_json_dict())
+                cols["properties"].append(e.properties.to_dict())
                 cols["event_time_ms"].append(to_millis(e.event_time))
         self.respond(200, cols)
 

@@ -356,8 +356,9 @@ def _spawn_detached(module: str, argv: Sequence[str]) -> int:
         )
     # liveness poll: long enough to catch startup failures that surface
     # after the (slow) jax import; a healthy server costs the full window,
-    # still far below the reference's spark-submit launch time
-    deadline = time.monotonic() + 4.0
+    # still far below the reference's spark-submit launch time.
+    # PIO_SPAWN_POLL_S overrides (e.g. on heavily loaded hosts).
+    deadline = time.monotonic() + float(os.environ.get("PIO_SPAWN_POLL_S", "4"))
     while time.monotonic() < deadline and proc.poll() is None:
         time.sleep(0.2)
     if proc.poll() is not None:

@@ -1,0 +1,137 @@
+"""Ingest benchmark: event log → streaming infeed → bucketized matrices.
+
+Measures the host half of the training pipeline that the reference gets
+from HBase region scans feeding executors
+(``data/src/main/scala/io/prediction/data/storage/hbase/HBPEvents.scala:58-98``):
+synthesizes N rate events into a native (C++) event log, then measures
+
+* **ingest**: bulk append throughput into the log (events/s)
+* **scan→arrays**: ``stream_ratings`` — chunked columnar scan + incremental
+  id indexing → int32/float32 arrays (events/s)
+* **bucketize**: COO → degree-bucketed padded CSR, both sides (events/s)
+* **peak RSS** across the scan+bucketize phase, the bounded-memory claim
+
+Run:  ``python -m predictionio_tpu.tools.ingestbench --events 20000000``
+Prints one JSON line (diagnostics on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def run(n_events: int, chunk_rows: int, tmp_root: str) -> dict:
+    import datetime as _dt
+
+    from ..storage.event import UTC, Event
+
+    def from_millis(ms: int) -> _dt.datetime:
+        return _dt.datetime.fromtimestamp(ms / 1000.0, tz=UTC)
+    from ..storage.native_events import NativeEventStore
+    from ..workflow.infeed import stream_ratings
+    from ..ops.als import bucketize
+
+    n_users = max(64, n_events // 145)  # ML-20M-ish density
+    n_items = max(32, n_events // 740)
+    rng = np.random.default_rng(0)
+
+    store = NativeEventStore(os.path.join(tmp_root, "events_native"))
+    store.init(1)
+
+    # -- ingest -----------------------------------------------------------
+    t0 = time.monotonic()
+    written = 0
+    batch_n = 200_000
+    base_ms = 1_750_000_000_000
+    while written < n_events:
+        b = min(batch_n, n_events - written)
+        users = rng.integers(0, n_users, b)
+        items = rng.integers(0, n_items, b)
+        vals = rng.integers(1, 6, b)
+        events = [
+            Event(
+                event="rate",
+                entity_type="user",
+                entity_id=f"u{users[j]}",
+                target_entity_type="item",
+                target_entity_id=f"i{items[j]}",
+                properties={"rating": float(vals[j])},
+                event_time=from_millis(base_ms + written + j),
+            )
+            for j in range(b)
+        ]
+        store.write(events, 1)
+        written += b
+        if written % 2_000_000 < batch_n:
+            print(f"ingest: {written}/{n_events}", file=sys.stderr)
+    ingest_s = time.monotonic() - t0
+
+    rss_before_scan = _rss_mb()
+
+    # -- scan → arrays ----------------------------------------------------
+    t1 = time.monotonic()
+    batch = stream_ratings(
+        store, 1, {"rate": "rating"}, chunk_rows=chunk_rows
+    )
+    scan_s = time.monotonic() - t1
+    nnz = len(batch.ratings)
+
+    # -- bucketize both sides --------------------------------------------
+    t2 = time.monotonic()
+    nu, ni = len(batch.user_map), len(batch.item_map)
+    by_user = bucketize(batch.users, batch.items, batch.ratings, nu, ni)
+    by_item = bucketize(batch.items, batch.users, batch.ratings, ni, nu)
+    bucketize_s = time.monotonic() - t2
+    assert by_user.nnz == nnz and by_item.nnz == nnz
+
+    store.close()
+    return {
+        "metric": "ingest_pipeline_events_per_s",
+        "value": round(nnz / (scan_s + bucketize_s), 1),
+        "unit": "events/s",
+        "events": nnz,
+        "ingest_events_per_s": round(written / ingest_s, 1),
+        "scan_to_arrays_events_per_s": round(nnz / scan_s, 1),
+        "bucketize_events_per_s": round(nnz / bucketize_s, 1),
+        "ingest_s": round(ingest_s, 2),
+        "scan_s": round(scan_s, 2),
+        "bucketize_s": round(bucketize_s, 2),
+        "peak_rss_mb": round(_rss_mb(), 1),
+        "rss_before_scan_mb": round(rss_before_scan, 1),
+        "chunk_rows": chunk_rows,
+        "n_users": nu,
+        "n_items": ni,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--events", type=int, default=20_000_000)
+    ap.add_argument("--chunk-rows", type=int, default=1_000_000)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir, removed)")
+    args = ap.parse_args(argv)
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        record = run(args.events, args.chunk_rows, args.workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="pio-ingestbench-") as d:
+            record = run(args.events, args.chunk_rows, d)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

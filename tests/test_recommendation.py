@@ -145,6 +145,25 @@ class TestEndToEnd:
         q, p, a = qpa[0]
         assert isinstance(q, Query)
 
+    def test_eval_train_split_excludes_test_only_entities(self, registry):
+        """A user whose every rating fell in the test split must be absent
+        from the train-split maps, so predict() returns the unknown-user
+        empty result instead of scoring a never-solved zero factor row."""
+        ingest_ratings(registry)
+        from predictionio_tpu.models.recommendation import RecDataSource
+
+        ds = RecDataSource(RecDataSourceParams(app_id=1))
+        [(train_td, _, qa)] = ds.read_eval(None)
+        # maps contain exactly the train split's entities
+        full = ds.read_training(None)
+        test_mask = np.arange(len(full.users)) % 4 == 0
+        u_inv = full.user_map.inverse
+        train_users = {u_inv[int(u)] for u in full.users[~test_mask]}
+        assert set(train_td.user_map) == train_users
+        # indices are dense and consistent with the arrays
+        assert train_td.users.max() == len(train_td.user_map) - 1
+        assert train_td.items.max() == len(train_td.item_map) - 1
+
     def test_empty_events_fails_sanity(self, registry):
         registry.get_events().init(1)
         engine = engine_factory()
