@@ -10,7 +10,7 @@ from .aggregator import (
     aggregate_properties,
     aggregate_single,
 )
-from .bimap import BiMap, EntityMap
+from .bimap import BiMap, EntityMap, HashedIdMap
 from .data_map import DataMap, DataMapException, PropertyMap
 from .event import (
     Event,
@@ -44,6 +44,7 @@ __all__ = [
     "AccessKey",
     "App",
     "BiMap",
+    "HashedIdMap",
     "DataMap",
     "DataMapException",
     "EngineInstance",

@@ -117,6 +117,128 @@ class BiMap(Generic[K, V]):
         return [inv[i] for i in indices]
 
 
+class HashedIdMap:
+    """Fixed-capacity hashed ID → index map for huge ID spaces.
+
+    The exact :class:`BiMap` costs ~194 bytes per unique id on the host
+    (measured: 5M ids → 970 MB for the forward+inverse dicts and their key
+    strings), so a billion-entity catalog needs ~190 GB — the host-memory
+    wall SURVEY §7 flags. This map stores **nothing per id**: an id's index
+    is ``fnv1a64(id, salt) & (capacity - 1)`` (the hashing trick), computed
+    natively in batch (``native/idhash.cc``), so memory is O(1) on the host
+    and ``capacity × rank × 4`` bytes for the factor table on device.
+
+    Trade-offs, stated plainly:
+
+    * **Collisions alias entities.** The fraction of ids sharing a slot
+      with some other id is ≈ ``1 − exp(−n / capacity)``; size capacity ≥
+      16n to keep aliasing under ~6 % (≥ 8n gives ~12 %). Aliased entities
+      share a factor row (their ratings merge) — acceptable for the *query
+      side* of a recommender (a user's own id is supplied at query time),
+      not for the *result side*.
+    * **Capacity tops out at 2³¹** (indices are int32, and a factor table
+      cannot exceed 2³¹ rows anyway). Beyond ~10⁸ entities, shard the id
+      space across hosts — each host hashes its shard into its own factor
+      shard — rather than growing one map.
+    * **No inverse.** Decoded results need id strings back, so keep the
+      exact BiMap for the smaller side (items). ``inverse`` raises.
+
+    Interface-compatible with BiMap where forward-only semantics make
+    sense (``map_array``, ``__getitem__``, ``get``, ``__len__`` = capacity).
+    """
+
+    _MAX_CAPACITY = 1 << 31
+
+    def __init__(self, capacity: int, salt: int = 0):
+        if capacity <= 0 or capacity & (capacity - 1):
+            raise ValueError(f"capacity must be a power of two, got {capacity}")
+        if capacity > self._MAX_CAPACITY:
+            raise ValueError(
+                f"capacity {capacity} exceeds 2^31 (int32 indices); shard "
+                "the id space across hosts instead of growing one map"
+            )
+        self.capacity = capacity
+        self.salt = salt
+
+    def __len__(self) -> int:
+        return self.capacity
+
+    def __getitem__(self, key: str) -> int:
+        return int(self.map_array([key])[0])
+
+    def get(self, key: str) -> int:
+        # every key hashes somewhere — a hashed map has no "unknown id"
+        return self[key]
+
+    def __contains__(self, key: str) -> bool:
+        return True
+
+    @property
+    def inverse(self):
+        raise TypeError(
+            "HashedIdMap cannot be inverted (indices do not decode to ids);"
+            " use an exact BiMap for the side whose ids must be recovered"
+        )
+
+    def expected_collision_fraction(self, n_ids: int) -> float:
+        """Fraction of ids expected to share a slot with some other id
+        (≈ 1 − exp(−n/capacity) for n ids thrown into capacity slots)."""
+        import math
+
+        return 1.0 - math.exp(-n_ids / self.capacity)
+
+    def map_array(self, keys, missing: int = -1) -> np.ndarray:
+        """Vectorized hash-index of a chunk of string ids (native batch
+        fnv1a64; pure-Python fallback on toolchain-less hosts).
+
+        ``missing`` exists for BiMap signature compatibility but is a
+        no-op: a hashed map has no unknown keys — every id hashes to a
+        valid slot, so callers cannot mask out never-trained ids.
+        """
+        keys = list(keys)
+        if not keys:
+            return np.zeros(0, dtype=np.int32)
+        hashes = _fnv1a64_batch(keys, self.salt)
+        return (hashes & np.uint64(self.capacity - 1)).astype(np.int32)
+
+
+def _fnv1a64_batch(keys, salt: int) -> np.ndarray:
+    encoded = [k.encode("utf-8") for k in keys]
+    try:
+        import ctypes
+
+        from ..native import load_library
+
+        lib = load_library("idhash")
+        if not getattr(lib, "_pio_configured", False):
+            lib.pio_fnv1a64_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            lib._pio_configured = True
+        buf = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        ends = np.cumsum([len(e) for e in encoded], dtype=np.int64)
+        out = np.empty(len(encoded), dtype=np.uint64)
+        lib.pio_fnv1a64_batch(
+            buf.ctypes.data_as(ctypes.c_void_p),
+            ends.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(len(encoded)),
+            ctypes.c_uint64(salt),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    except Exception:
+        # pure-Python fnv1a64 (same constants as native/idhash.cc)
+        out = np.empty(len(encoded), dtype=np.uint64)
+        mask = (1 << 64) - 1
+        for j, data in enumerate(encoded):
+            h = 14695981039346656037 ^ salt
+            for b in data:
+                h = ((h ^ b) * 1099511628211) & mask
+            out[j] = h if h else 1
+        return out
+
+
 class EntityMap(BiMap[str, int]):
     """BiMap from entity id → dense index that also carries entity payloads
     (``EntityMap.scala``)."""

@@ -12,7 +12,7 @@ consoles (``console/{App,AccessKey}.scala``).  Subcommands:
     eventserver | dashboard    — REST servers
     status                     — storage verification (Storage.scala:230-250)
     export | import            — events ↔ JSON-lines files
-    template list|get          — scaffold a bundled engine template
+    template list|get          — bundled + remote engine templates
 
 Process model: the reference launches train/deploy as separate JVMs via
 spark-submit (``RunWorkflow.scala:103-169``); here ``--spawn`` runs them as
@@ -275,7 +275,11 @@ def build_parser() -> argparse.ArgumentParser:
     im.add_argument("--appid", type=int, required=True)
     im.add_argument("--input", required=True)
 
-    tp = sub.add_parser("template", help="scaffold a bundled engine template")
+    tp = sub.add_parser(
+        "template",
+        help="engine templates: bundled scaffolds + remote gallery "
+        "(PIO_TEMPLATE_GALLERY_URL)",
+    )
     tp_sub = tp.add_subparsers(dest="template_command", required=True)
     tp_sub.add_parser("list")
     tp_get = tp_sub.add_parser("get")
@@ -569,12 +573,28 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         return EXIT_OK
 
     if cmd == "template":
+        from .gallery import GalleryError, gallery_url, get_remote, list_remote
         from .templates import get_template, list_templates
 
         if args.template_command == "list":
-            _emit(list_templates())
+            out = {"bundled": list_templates()}
+            if gallery_url():
+                # a broken gallery (unreachable, HTML error page, malformed
+                # index) must not take down the bundled listing
+                try:
+                    out["remote"] = list_remote()
+                except Exception as exc:
+                    out["remote_error"] = f"{type(exc).__name__}: {exc}"
+            _emit(out)
         else:
-            _emit(get_template(args.template_name, args.directory))
+            # bundled names win; anything else resolves via the remote
+            # gallery when one is configured (Template.scala:287-375)
+            try:
+                _emit(get_template(args.template_name, args.directory))
+            except KeyError:
+                if not gallery_url():
+                    raise
+                _emit(get_remote(args.template_name, args.directory))
         return EXIT_OK
 
     raise ValueError(f"Unknown command {cmd!r}")

@@ -60,6 +60,32 @@ class StreamingIndexer:
 ValueRule = Dict[str, object]
 
 
+def _extract_chunk(cols: dict, value_rules: ValueRule):
+    """One column chunk → (user ids, target ids, values), applying the
+    per-event value rules and skipping target-less events."""
+    uids: List[str] = []
+    tids: List[str] = []
+    vals: List[float] = []
+    for ev, uid, tid, props in zip(
+        cols["event"], cols["entity_id"],
+        cols["target_entity_id"], cols["properties"],
+    ):
+        if tid is None:
+            continue
+        rule = value_rules[ev]
+        if isinstance(rule, str):
+            if rule not in props:
+                raise ValueError(
+                    f"{ev!r} event for {uid}->{tid} has no {rule!r} property"
+                )
+            vals.append(float(props[rule]))
+        else:
+            vals.append(float(rule))
+        uids.append(uid)
+        tids.append(tid)
+    return uids, tids, vals
+
+
 @dataclasses.dataclass
 class RatingBatch:
     """Final product of a streaming read."""
@@ -77,6 +103,7 @@ def stream_ratings(
     value_rules: ValueRule,
     chunk_rows: int = 1_000_000,
     on_chunk: Optional[Callable[[np.ndarray, np.ndarray, np.ndarray], None]] = None,
+    hashed_users: int = 0,
 ) -> RatingBatch:
     """Stream (entity → target, value) events into dense rating arrays.
 
@@ -85,12 +112,24 @@ def stream_ratings(
     rate-vs-buy rule, ``DataSource.scala:25-55``). Events without a target
     entity are skipped. ``on_chunk`` (optional) observes each translated
     chunk — the hook a sharded device infeed attaches to.
+
+    ``hashed_users`` (a power-of-two capacity) switches the user side to
+    the O(1)-host-memory :class:`~predictionio_tpu.storage.bimap.
+    HashedIdMap` — the big-ID path for catalogs whose unique-user dict
+    would not fit one host (the exact BiMap costs ~194 B/id; see the
+    HashedIdMap docstring for the aliasing trade-off). Items keep the
+    exact map: serving must decode item indices back to ids.
     """
     # Native fast path: the event log's C++ ratings scan does the whole
     # loop below in one pass (ratings.cc) — only the unique-id strings
     # cross into Python. Constraint: one distinct property name.
     n_props = len({r for r in value_rules.values() if isinstance(r, str)})
-    if on_chunk is None and n_props <= 1 and hasattr(store, "scan_ratings"):
+    if (
+        not hashed_users
+        and on_chunk is None
+        and n_props <= 1
+        and hasattr(store, "scan_ratings")
+    ):
         users, items, vals, user_ids, item_ids = store.scan_ratings(
             app_id, value_rules
         )
@@ -102,7 +141,16 @@ def stream_ratings(
             item_map=BiMap({k: i for i, k in enumerate(item_ids)}),
         )
 
-    user_ix = StreamingIndexer()
+    if hashed_users:
+        from ..storage.bimap import HashedIdMap
+
+        user_map = HashedIdMap(hashed_users)
+        index_users = user_map.map_array
+        finish_user_map = lambda: user_map  # noqa: E731
+    else:
+        user_ix = StreamingIndexer()
+        index_users = user_ix.index_chunk
+        finish_user_map = user_ix.to_bimap
     item_ix = StreamingIndexer()
     u_parts: List[np.ndarray] = []
     i_parts: List[np.ndarray] = []
@@ -110,30 +158,10 @@ def stream_ratings(
 
     flt = EventFilter(event_names=list(value_rules))
     for cols in store.scan_columnar_iter(app_id, flt, chunk_rows=chunk_rows):
-        uids: List[str] = []
-        tids: List[str] = []
-        vals: List[float] = []
-        for ev, uid, tid, props in zip(
-            cols["event"], cols["entity_id"],
-            cols["target_entity_id"], cols["properties"],
-        ):
-            if tid is None:
-                continue
-            rule = value_rules[ev]
-            if isinstance(rule, str):
-                if rule not in props:
-                    raise ValueError(
-                        f"{ev!r} event for {uid}->{tid} has no "
-                        f"{rule!r} property"
-                    )
-                vals.append(float(props[rule]))
-            else:
-                vals.append(float(rule))
-            uids.append(uid)
-            tids.append(tid)
+        uids, tids, vals = _extract_chunk(cols, value_rules)
         if not uids:
             continue
-        u = user_ix.index_chunk(uids)
+        u = index_users(uids)
         i = item_ix.index_chunk(tids)
         v = np.asarray(vals, dtype=np.float32)
         if on_chunk is not None:
@@ -151,6 +179,6 @@ def stream_ratings(
             if v_parts
             else np.zeros(0, dtype=np.float32)
         ),
-        user_map=user_ix.to_bimap(),
+        user_map=finish_user_map(),
         item_map=item_ix.to_bimap(),
     )

@@ -126,6 +126,68 @@ def test_stream_ratings_empty_store(event_store):
     assert len(batch.users) == 0 and len(batch.user_map) == 0
 
 
+# -- hashed big-ID path ---------------------------------------------------
+
+
+def test_hashed_id_map_basics():
+    from predictionio_tpu.storage.bimap import HashedIdMap
+
+    m = HashedIdMap(1 << 16)
+    idx = m.map_array([f"user_{j}" for j in range(1000)])
+    assert idx.dtype == np.int32
+    assert ((idx >= 0) & (idx < (1 << 16))).all()
+    # deterministic and salt-sensitive
+    again = m.map_array([f"user_{j}" for j in range(1000)])
+    assert np.array_equal(idx, again)
+    salted = HashedIdMap(1 << 16, salt=7).map_array(
+        [f"user_{j}" for j in range(1000)]
+    )
+    assert not np.array_equal(idx, salted)
+    assert m["user_3"] == idx[3]
+    with pytest.raises(ValueError, match="power of two"):
+        HashedIdMap(1000)
+    with pytest.raises(TypeError, match="inverted"):
+        m.inverse
+    # aliased-id estimate: 1000 ids in 65536 slots ≈ 1-e^-0.0153 ≈ 1.5%
+    assert 0.01 < m.expected_collision_fraction(1000) < 0.02
+    with pytest.raises(ValueError, match="2\\^31"):
+        HashedIdMap(1 << 32)
+
+
+def test_hashed_batch_matches_pure_python():
+    """Native batch fnv1a64 must equal the reference Python implementation
+    (and the event log's evlog_fnv1a64 constants)."""
+    from predictionio_tpu.storage import bimap as bm
+
+    keys = ["", "a", "user_1", "ü–🎉", "x" * 300]
+    native = bm._fnv1a64_batch(keys, salt=5)
+    mask = (1 << 64) - 1
+    for j, k in enumerate(keys):
+        h = 14695981039346656037 ^ 5
+        for b in k.encode("utf-8"):
+            h = ((h ^ b) * 1099511628211) & mask
+        assert native[j] == (h if h else 1)
+
+
+def test_stream_ratings_hashed_users(event_store):
+    from predictionio_tpu.storage.bimap import HashedIdMap
+
+    _insert_rates(event_store, 30)
+    exact = stream_ratings(event_store, 1, {"rate": "rating"})
+    hashed = stream_ratings(
+        event_store, 1, {"rate": "rating"}, hashed_users=1 << 12
+    )
+    assert isinstance(hashed.user_map, HashedIdMap)
+    # same interactions, same item indexing, user indices are the hashes
+    assert np.array_equal(hashed.items, exact.items)
+    assert np.array_equal(hashed.ratings, exact.ratings)
+    u_inv = exact.user_map.inverse
+    expect = hashed.user_map.map_array(
+        [u_inv[int(u)] for u in exact.users]
+    )
+    assert np.array_equal(hashed.users, expect)
+
+
 # -- native ratings scan --------------------------------------------------
 
 
