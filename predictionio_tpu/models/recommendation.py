@@ -317,8 +317,14 @@ class ALSAlgorithm(Algorithm):
             scores, items = top_k_for_users(
                 model.user_factors, model.item_factors, padded_idx, k=k_pad
             )
-            scores = np.asarray(scores)[:b, :max_k]
-            items = np.asarray(items)[:b, :max_k]
+            # one fetch for both arrays: each device_get is a full host↔
+            # device round trip, which dominates per-batch latency on
+            # high-latency links (tunneled/remote devices)
+            import jax
+
+            scores, items = jax.device_get((scores, items))
+            scores = scores[:b, :max_k]
+            items = items[:b, :max_k]
             inv = model.item_map.inverse
             for row, (i, q) in enumerate(known):
                 k = min(q.num, max_k)

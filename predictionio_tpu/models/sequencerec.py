@@ -383,7 +383,7 @@ class SeqRecAlgorithm(Algorithm):
         k = min(query.num, len(model.item_map))
         scores = jax.nn.log_softmax(logits).at[pad_id].set(-jnp.inf)
         top_s, top_i = jax.lax.top_k(scores, k)
-        top_s, top_i = np.asarray(top_s), np.asarray(top_i)
+        top_s, top_i = jax.device_get((top_s, top_i))  # one round trip
         return PredictedResult(
             item_scores=tuple(
                 ItemScore(item=model.item_map.inverse[int(i)], score=float(s))

@@ -341,12 +341,17 @@ _solve_block_explicit = functools.partial(jax.jit, static_argnames=("rank",))(
 
 @dataclasses.dataclass
 class _StagedBucket:
-    """Bucket tensors resident on device, pre-chunked along a leading C axis."""
+    """Bucket tensors resident on device, pre-chunked along a leading C axis.
+
+    The [B, K] validity mask is NOT transferred: it is a pure function of
+    the per-row rating count, so only ``counts`` ([C, B] int32) crosses
+    host→device and the mask is rebuilt inside the traced solve — a third
+    of the staging bytes, which on a remote-tunnel device is wall-clock."""
 
     rows: jax.Array  # [C, B] int32 (padded with n_rows → dropped by scatter)
     idx: jax.Array  # [C, B, K] int32
     val: jax.Array  # [C, B, K] float32
-    mask: jax.Array  # [C, B, K] float32
+    counts: jax.Array  # [C, B] int32 — ratings per row (0 on padding)
 
 
 @dataclasses.dataclass
@@ -395,7 +400,9 @@ def stage(
         ).reshape(n_chunks, block)  # out-of-range → dropped by scatter
         idx = pad2(bucket.idx).reshape(n_chunks, block, bucket.width)
         val = pad2(bucket.val).reshape(n_chunks, block, bucket.width)
-        mask = pad2(bucket.mask).reshape(n_chunks, block, bucket.width)
+        counts = np.pad(
+            bucket.mask.sum(axis=1).astype(np.int32), (0, pad)
+        ).reshape(n_chunks, block)
         put = (
             (lambda a: jax.device_put(a, sharding))
             if sharding is not None
@@ -406,7 +413,7 @@ def stage(
                 rows=put(rows.astype(np.int32)),
                 idx=put(idx),
                 val=put(val),
-                mask=put(mask),
+                counts=put(counts),
             )
         )
     return StagedMatrix(
@@ -456,26 +463,36 @@ class ALSFactors:
 
 
 def _bucket_tensors(side: StagedMatrix):
-    return tuple((b.rows, b.idx, b.val, b.mask) for b in side.buckets)
+    return tuple((b.rows, b.idx, b.val, b.counts) for b in side.buckets)
 
 
 def _solve_side_traced(y, buckets, n_rows, rank, implicit, lam, alpha, yty):
     """Unrolled bucket loop inside a traced program (no per-bucket dispatch)."""
     x = jnp.zeros((n_rows, rank), dtype=jnp.float32)
-    for rows, idx, val, mask in buckets:
+
+    def expand_mask(idx_blk, counts_blk):
+        # validity mask rebuilt on device from per-row counts (free: fuses
+        # into the gather/einsum; saves a [B, K] f32 host transfer)
+        k = idx_blk.shape[-1]
+        return (
+            jnp.arange(k, dtype=jnp.int32)[None, :] < counts_blk[:, None]
+        ).astype(jnp.float32)
+
+    for rows, idx, val, counts in buckets:
         if implicit:
             solved = jax.lax.map(
                 lambda c: _solve_block_implicit_body(
-                    y, yty, c[0], c[1], c[2], lam, alpha, rank
+                    y, yty, c[0], c[1], expand_mask(c[0], c[2]), lam, alpha,
+                    rank
                 ),
-                (idx, val, mask),
+                (idx, val, counts),
             )
         else:
             solved = jax.lax.map(
                 lambda c: _solve_block_explicit_body(
-                    y, c[0], c[1], c[2], lam, rank
+                    y, c[0], c[1], expand_mask(c[0], c[2]), lam, rank
                 ),
-                (idx, val, mask),
+                (idx, val, counts),
             )
         x = x.at[rows.reshape(-1)].set(solved.reshape(-1, rank), mode="drop")
     return x

@@ -140,6 +140,11 @@ class HashedIdMap:
       cannot exceed 2³¹ rows anyway). Beyond ~10⁸ entities, shard the id
       space across hosts — each host hashes its shard into its own factor
       shard — rather than growing one map.
+    * **Capacity is also the factor-table row count** downstream: training
+      allocates O(capacity) device memory (capacity × rank × 4 B) and
+      O(capacity) small host arrays in bucketize (~12 B/slot), so size
+      capacity to what a device can hold (e.g. ≤ 2²⁷ rows at rank 50 on a
+      16 GB chip), not to the raw id-space size.
     * **No inverse.** Decoded results need id strings back, so keep the
       exact BiMap for the smaller side (items). ``inverse`` raises.
 
@@ -202,41 +207,61 @@ class HashedIdMap:
         return (hashes & np.uint64(self.capacity - 1)).astype(np.int32)
 
 
+#: Latched after the first failed native-idhash build (per process), so a
+#: toolchain-less host pays one compiler attempt, not one per chunk.
+_NATIVE_IDHASH_BROKEN = False
+
+
 def _fnv1a64_batch(keys, salt: int) -> np.ndarray:
+    global _NATIVE_IDHASH_BROKEN
     encoded = [k.encode("utf-8") for k in keys]
-    try:
-        import ctypes
+    if not _NATIVE_IDHASH_BROKEN:
+        from ..native import NativeBuildError
 
-        from ..native import load_library
+        try:
+            return _fnv1a64_batch_native(encoded, salt)
+        except NativeBuildError as exc:
+            import logging
 
-        lib = load_library("idhash")
-        if not getattr(lib, "_pio_configured", False):
-            lib.pio_fnv1a64_batch.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                ctypes.c_uint64, ctypes.c_void_p,
-            ]
-            lib._pio_configured = True
-        buf = np.frombuffer(b"".join(encoded), dtype=np.uint8)
-        ends = np.cumsum([len(e) for e in encoded], dtype=np.int64)
-        out = np.empty(len(encoded), dtype=np.uint64)
-        lib.pio_fnv1a64_batch(
-            buf.ctypes.data_as(ctypes.c_void_p),
-            ends.ctypes.data_as(ctypes.c_void_p),
-            ctypes.c_int64(len(encoded)),
-            ctypes.c_uint64(salt),
-            out.ctypes.data_as(ctypes.c_void_p),
-        )
-        return out
-    except Exception:
-        # pure-Python fnv1a64 (same constants as native/idhash.cc)
-        out = np.empty(len(encoded), dtype=np.uint64)
-        mask = (1 << 64) - 1
-        for j, data in enumerate(encoded):
-            h = 14695981039346656037 ^ salt
-            for b in data:
-                h = ((h ^ b) * 1099511628211) & mask
-            out[j] = h if h else 1
-        return out
+            logging.getLogger(__name__).warning(
+                "native idhash unavailable, using (slow) Python hashing: %s",
+                exc,
+            )
+            _NATIVE_IDHASH_BROKEN = True
+    # pure-Python fnv1a64 (same constants as native/idhash.cc)
+    out = np.empty(len(encoded), dtype=np.uint64)
+    mask = (1 << 64) - 1
+    for j, data in enumerate(encoded):
+        h = 14695981039346656037 ^ salt
+        for b in data:
+            h = ((h ^ b) * 1099511628211) & mask
+        out[j] = h if h else 1
+    return out
+
+
+def _fnv1a64_batch_native(encoded, salt: int) -> np.ndarray:
+    import ctypes
+
+    from ..native import load_library
+
+    lib = load_library("idhash")
+    if not getattr(lib, "_pio_configured", False):
+        lib.pio_fnv1a64_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_void_p,
+        ]
+        lib._pio_configured = True
+    buf = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    ends = np.cumsum([len(e) for e in encoded], dtype=np.int64)
+    out = np.empty(len(encoded), dtype=np.uint64)
+    lib.pio_fnv1a64_batch(
+        buf.ctypes.data_as(ctypes.c_void_p),
+        ends.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(len(encoded)),
+        ctypes.c_uint64(salt),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
 
 
 class EntityMap(BiMap[str, int]):

@@ -577,14 +577,22 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         from .templates import get_template, list_templates
 
         if args.template_command == "list":
-            out = {"bundled": list_templates()}
+            # one flat list (the original CLI contract — scripts iterate
+            # entries); remote entries are tagged by "source"
+            out = [dict(t, source="bundled") for t in list_templates()]
             if gallery_url():
                 # a broken gallery (unreachable, HTML error page, malformed
                 # index) must not take down the bundled listing
                 try:
-                    out["remote"] = list_remote()
+                    out.extend(
+                        dict(t, source="remote") for t in list_remote()
+                    )
                 except Exception as exc:
-                    out["remote_error"] = f"{type(exc).__name__}: {exc}"
+                    print(
+                        f"warning: remote gallery failed: "
+                        f"{type(exc).__name__}: {exc}",
+                        file=sys.stderr,
+                    )
             _emit(out)
         else:
             # bundled names win; anything else resolves via the remote

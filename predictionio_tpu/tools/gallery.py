@@ -79,7 +79,10 @@ def fetch_cached(url: str, timeout: float = 30.0) -> bytes:
                 fh.write(etag)
             return body
     except urllib.error.HTTPError as exc:
-        if exc.code == 304 and os.path.exists(body_path):
+        if os.path.exists(body_path):
+            # 304: the conditional request validated the cache. Any other
+            # HTTP error (5xx from the gallery or a proxy): degrade to the
+            # cached copy, same as being unreachable (Template.scala:106-113).
             with open(body_path, "rb") as fh:
                 return fh.read()
         raise GalleryError(f"GET {url} → HTTP {exc.code}") from exc

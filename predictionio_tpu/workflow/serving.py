@@ -73,7 +73,10 @@ class ServerConfig:
     # dispatch. Worst-case added latency = batch_wait_ms; under load the
     # batch fills instantly and the wait never triggers.
     batching: bool = True
-    batch_max: int = 128
+    # 512 keeps the padded top-k program set small (pad_pow2) while letting
+    # a high-latency dispatch path (e.g. a remote-relay device) amortize
+    # the round trip over a large batch; device time grows sub-linearly.
+    batch_max: int = 512
     batch_wait_ms: float = 1.0
 
 

@@ -1,0 +1,45 @@
+// SDK quickstart: ingest a few events, query a deployed engine.
+//
+// Build:  g++ -std=c++17 -O2 -I.. quickstart.cc ../predictionio_client.cc \
+//             -o quickstart
+// Run:    ./quickstart <event_host> <event_port> <access_key> \
+//                      [<engine_host> <engine_port>]
+//
+// Mirrors the reference Java SDK quickstart shape: EventClient for
+// ingestion, EngineClient for queries.
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "predictionio_client.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <event_host> <event_port> <access_key> "
+            "[<engine_host> <engine_port>]\n",
+            argv[0]);
+    return 2;
+  }
+  try {
+    pio::EventClient events(argv[1], atoi(argv[2]), argv[3]);
+    std::string id = events.create_event(
+        R"({"event": "rate", "entityType": "user", "entityId": "u1",)"
+        R"( "targetEntityType": "item", "targetEntityId": "i1",)"
+        R"( "properties": {"rating": 5.0}})");
+    printf("created event: %s\n", id.c_str());
+    std::string fetched = events.get_event(id);
+    printf("fetched: %s\n", fetched.c_str());
+
+    if (argc >= 6) {
+      pio::EngineClient engine(argv[4], atoi(argv[5]));
+      std::string result =
+          engine.send_query(R"({"user": "u1", "num": 4})");
+      printf("query result: %s\n", result.c_str());
+    }
+    return 0;
+  } catch (const pio::ClientError& e) {
+    fprintf(stderr, "client error (HTTP %d): %s\n", e.status(), e.what());
+    return 1;
+  }
+}

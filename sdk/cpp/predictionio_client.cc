@@ -1,0 +1,240 @@
+// Implementation of the PredictionIO-TPU C++ client SDK (see header).
+
+#include "predictionio_client.hpp"
+
+#include <cstring>
+#include <sstream>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace pio {
+
+namespace {
+
+// Tiny percent-encoder for query-string values (access keys are
+// url-safe base64 but defensive encoding costs nothing).
+std::string url_encode(const std::string& s) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out.push_back((char)c);
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 15]);
+    }
+  }
+  return out;
+}
+
+struct Socket {
+  int fd = -1;
+  ~Socket() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+}  // namespace
+
+HttpClient::HttpClient(std::string host, int port, double timeout_s)
+    : host_(std::move(host)), port_(port), timeout_s_(timeout_s) {}
+
+HttpResponse HttpClient::request(const std::string& method,
+                                 const std::string& path,
+                                 const std::string& body,
+                                 const std::string& content_type) {
+  // resolve
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port_);
+  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    throw ClientError(0, "resolve " + host_ + ": " + gai_strerror(rc));
+  }
+  Socket sock;
+  std::string connect_err;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    sock.fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (sock.fd < 0) continue;
+    struct timeval tv;
+    tv.tv_sec = (time_t)timeout_s_;
+    tv.tv_usec = (suseconds_t)((timeout_s_ - (time_t)timeout_s_) * 1e6);
+    setsockopt(sock.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(sock.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    setsockopt(sock.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(sock.fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    connect_err = strerror(errno);
+    close(sock.fd);
+    sock.fd = -1;
+  }
+  freeaddrinfo(res);
+  if (sock.fd < 0) {
+    throw ClientError(0, "connect " + host_ + ":" + port_str + " failed: " +
+                             connect_err);
+  }
+
+  // send request (Connection: close keeps framing trivial)
+  std::ostringstream req;
+  req << method << " " << path << " HTTP/1.1\r\n"
+      << "Host: " << host_ << ":" << port_str << "\r\n"
+      << "Connection: close\r\n";
+  if (!body.empty() || method == "POST" || method == "PUT") {
+    req << "Content-Type: " << content_type << "\r\n"
+        << "Content-Length: " << body.size() << "\r\n";
+  }
+  req << "\r\n" << body;
+  const std::string data = req.str();
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = send(sock.fd, data.data() + sent, data.size() - sent, 0);
+    if (n <= 0) throw ClientError(0, "send failed: " + std::string(strerror(errno)));
+    sent += (size_t)n;
+  }
+
+  // read full response
+  std::string raw;
+  char buf[8192];
+  for (;;) {
+    ssize_t n = recv(sock.fd, buf, sizeof(buf), 0);
+    if (n < 0) throw ClientError(0, "recv failed: " + std::string(strerror(errno)));
+    if (n == 0) break;
+    raw.append(buf, (size_t)n);
+  }
+
+  // parse status line + headers
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos || raw.compare(0, 5, "HTTP/") != 0) {
+    throw ClientError(0, "malformed HTTP response");
+  }
+  HttpResponse out;
+  {
+    size_t sp1 = raw.find(' ');
+    out.status = atoi(raw.c_str() + sp1 + 1);
+  }
+  std::string headers = raw.substr(0, hdr_end);
+  std::string payload = raw.substr(hdr_end + 4);
+  // chunked decoding (servers speak HTTP/1.1; with Connection: close most
+  // respond with Content-Length, but decode chunked when present)
+  bool chunked = false;
+  {
+    std::string lower;
+    lower.reserve(headers.size());
+    for (char c : headers) lower.push_back((char)tolower((unsigned char)c));
+    chunked = lower.find("transfer-encoding: chunked") != std::string::npos;
+  }
+  if (chunked) {
+    std::string decoded;
+    size_t pos = 0;
+    while (pos < payload.size()) {
+      size_t eol = payload.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long len = strtol(payload.c_str() + pos, nullptr, 16);
+      if (len <= 0) break;
+      decoded.append(payload, eol + 2, (size_t)len);
+      pos = eol + 2 + (size_t)len + 2;
+    }
+    out.body = decoded;
+  } else {
+    out.body = payload;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+
+EventClient::EventClient(std::string host, int port, std::string access_key)
+    : http_(std::move(host), port), access_key_(std::move(access_key)) {}
+
+std::string EventClient::create_event(const std::string& event_json) {
+  auto resp = http_.request(
+      "POST", "/events.json?accessKey=" + url_encode(access_key_), event_json);
+  if (resp.status != 201) {
+    throw ClientError(resp.status, "create_event: " + resp.body);
+  }
+  // response: {"eventId": "..."} — extract without a JSON dependency
+  size_t key = resp.body.find("\"eventId\"");
+  if (key == std::string::npos) return resp.body;
+  size_t q1 = resp.body.find('"', resp.body.find(':', key));
+  size_t q2 = resp.body.find('"', q1 + 1);
+  return resp.body.substr(q1 + 1, q2 - q1 - 1);
+}
+
+std::string EventClient::get_event(const std::string& event_id) {
+  auto resp = http_.request(
+      "GET",
+      "/events/" + url_encode(event_id) +
+          ".json?accessKey=" + url_encode(access_key_),
+      "");
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "get_event: " + resp.body);
+  }
+  return resp.body;
+}
+
+bool EventClient::delete_event(const std::string& event_id) {
+  auto resp = http_.request(
+      "DELETE",
+      "/events/" + url_encode(event_id) +
+          ".json?accessKey=" + url_encode(access_key_),
+      "");
+  // wire parity: 200 {"message": "Found"} when deleted, 404 when absent
+  if (resp.status == 404) return false;
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "delete_event: " + resp.body);
+  }
+  return true;
+}
+
+std::string EventClient::find_events(const std::string& extra_query) {
+  auto resp = http_.request(
+      "GET", "/events.json?accessKey=" + url_encode(access_key_) + extra_query,
+      "");
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "find_events: " + resp.body);
+  }
+  return resp.body;
+}
+
+std::string EventClient::stats() {
+  auto resp = http_.request(
+      "GET", "/stats.json?accessKey=" + url_encode(access_key_), "");
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "stats: " + resp.body);
+  }
+  return resp.body;
+}
+
+// ---------------------------------------------------------------------------
+
+EngineClient::EngineClient(std::string host, int port)
+    : http_(std::move(host), port) {}
+
+std::string EngineClient::send_query(const std::string& query_json) {
+  auto resp = http_.request("POST", "/queries.json", query_json);
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "send_query: " + resp.body);
+  }
+  return resp.body;
+}
+
+std::string EngineClient::status() {
+  auto resp = http_.request("GET", "/", "");
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "status: " + resp.body);
+  }
+  return resp.body;
+}
+
+}  // namespace pio

@@ -1,0 +1,111 @@
+// PredictionIO-TPU C++ client SDK.
+//
+// Second-language client surface (the rebuild's analogue of the reference's
+// Java controller shim + client SDKs, core/src/main/java/io/prediction/
+// controller/java/): a dependency-free HTTP client for the two REST
+// surfaces every deployment exposes —
+//
+//   EventClient  -> the Event Server   (POST/GET/DELETE /events.json,
+//                                       GET /stats.json; EventAPI.scala
+//                                       routes, default port 7070)
+//   EngineClient -> the Query Server   (POST /queries.json;
+//                                       CreateServer.scala:458, port 8000)
+//
+// JSON crosses the boundary as strings: callers bring their own JSON
+// library (the reference Java SDK does the same with Gson at the edge).
+// Plain POSIX sockets + HTTP/1.1, no external dependencies.
+//
+// Usage:
+//   pio::EventClient events("127.0.0.1", 7070, access_key);
+//   std::string id = events.create_event(R"({"event":"rate",...})");
+//   pio::EngineClient engine("127.0.0.1", 8000);
+//   std::string result = engine.send_query(R"({"user":"u1","num":10})");
+
+#ifndef PREDICTIONIO_CLIENT_HPP_
+#define PREDICTIONIO_CLIENT_HPP_
+
+#include <stdexcept>
+#include <string>
+
+namespace pio {
+
+// Thrown on transport failures and non-2xx responses.
+class ClientError : public std::runtime_error {
+ public:
+  ClientError(int status, const std::string& message)
+      : std::runtime_error(message), status_(status) {}
+  // HTTP status, or 0 for transport-level failures.
+  int status() const { return status_; }
+
+ private:
+  int status_;
+};
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+};
+
+// Minimal HTTP/1.1 client: one connection per request (keep-alive is the
+// servers' default but reconnect-per-call keeps the SDK stateless and
+// thread-compatible — callers wanting throughput pool EventClient
+// instances per thread).
+class HttpClient {
+ public:
+  HttpClient(std::string host, int port, double timeout_s = 30.0);
+
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body,
+                       const std::string& content_type = "application/json");
+
+ private:
+  std::string host_;
+  int port_;
+  double timeout_s_;
+};
+
+// Client for the Event Server REST API (EventAPI.scala:168-345 surface).
+class EventClient {
+ public:
+  EventClient(std::string host, int port, std::string access_key);
+
+  // POST /events.json — returns the created event id.
+  // `event_json` is the wire-format event dict.
+  std::string create_event(const std::string& event_json);
+
+  // GET /events/<id>.json — returns the event JSON.
+  std::string get_event(const std::string& event_id);
+
+  // DELETE /events/<id>.json — true when the event existed.
+  bool delete_event(const std::string& event_id);
+
+  // GET /events.json with optional query filters appended verbatim,
+  // e.g. "&event=rate&limit=20". Returns the JSON array.
+  std::string find_events(const std::string& extra_query = "");
+
+  // GET /stats.json (requires the server's --stats mode).
+  std::string stats();
+
+ private:
+  HttpClient http_;
+  std::string access_key_;
+};
+
+// Client for a deployed engine's query API (CreateServer.scala:458).
+class EngineClient {
+ public:
+  EngineClient(std::string host, int port);
+
+  // POST /queries.json — returns the PredictedResult JSON.
+  std::string send_query(const std::string& query_json);
+
+  // GET / — the status page (HTML).
+  std::string status();
+
+ private:
+  HttpClient http_;
+};
+
+}  // namespace pio
+
+#endif  // PREDICTIONIO_CLIENT_HPP_

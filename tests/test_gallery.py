@@ -111,6 +111,13 @@ def test_offline_falls_back_to_cache(gallery_server, monkeypatch):
         fetch_cached(url.replace("/index.json", "/never.json"))
 
 
+def test_server_error_falls_back_to_cache(gallery_server):
+    srv, url = gallery_server
+    assert list_remote() != []  # warm the cache
+    srv.routes["/index.json"] = (None, None)  # now 404s
+    assert list_remote() != []  # served from cache despite HTTP error
+
+
 def test_get_remote_extracts_and_strips_root(gallery_server, tmp_path):
     srv, url = gallery_server
     target = tmp_path / "proj"
