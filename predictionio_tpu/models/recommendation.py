@@ -323,18 +323,20 @@ class ALSAlgorithm(Algorithm):
             import jax
 
             scores, items = jax.device_get((scores, items))
-            scores = scores[:b, :max_k]
-            items = items[:b, :max_k]
+            # bulk ndarray→python conversion: one C call instead of
+            # 2×B×k scalar __float__/__int__ calls on the hot path
+            scores = scores[:b, :max_k].tolist()
+            items = items[:b, :max_k].tolist()
             inv = model.item_map.inverse
             for row, (i, q) in enumerate(known):
                 k = min(q.num, max_k)
+                s_row, i_row = scores[row], items[row]
                 out.append(
                     (
                         i,
                         PredictedResult(
                             item_scores=tuple(
-                                ItemScore(item=inv[int(items[row, j])],
-                                          score=float(scores[row, j]))
+                                ItemScore(item=inv[i_row[j]], score=s_row[j])
                                 for j in range(k)
                             )
                         ),

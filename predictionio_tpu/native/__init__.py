@@ -60,7 +60,13 @@ def build_library(name: str, sources=None, extra_flags=()) -> str:
         "-Wall", "-Wextra",
         *extra_flags, "-o", lib_path, *sources,
     ]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except (FileNotFoundError, OSError) as exc:
+        # no compiler on PATH (or it can't exec) — the same "native
+        # unavailable" condition as a failed compile, so callers' single
+        # NativeBuildError fallback covers it
+        raise NativeBuildError(f"cannot run {cxx!r}: {exc}") from exc
     if proc.returncode != 0:
         raise NativeBuildError(
             f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
@@ -74,5 +80,12 @@ def load_library(name: str, sources=None) -> ctypes.CDLL:
     """Build (if needed) and dlopen a native component, cached per process."""
     with _LOCK:
         if name not in _CACHE:
-            _CACHE[name] = ctypes.CDLL(build_library(name, sources))
+            try:
+                _CACHE[name] = ctypes.CDLL(build_library(name, sources))
+            except NativeBuildError:
+                raise
+            except OSError as exc:  # dlopen failure
+                raise NativeBuildError(
+                    f"loading lib{name}.so failed: {exc}"
+                ) from exc
         return _CACHE[name]

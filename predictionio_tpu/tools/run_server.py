@@ -46,6 +46,7 @@ def make_server(
     loader.modify_logging(args.verbose)
     registry = registry or get_registry()
     ed = load_engine_dir(args.engine_dir)
+    loader.apply_runtime_conf(ed.variant)  # the embedded-sparkConf analogue
     engine = loader.get_engine(ed.engine_factory, search_dir=ed.path)
     config = ServerConfig(
         ip=args.ip,

@@ -66,6 +66,17 @@ def run(
         eval_parallelism=args.eval_parallelism,
     )
 
+    # runtimeConf binds to every workflow run, train AND eval — the
+    # reference applies embedded sparkConf to all SparkContext creations
+    # (WorkflowUtils.scala:321-339). Eval runs may lack an engine.json
+    # (evaluation classes can carry their own engines); absent = no-op.
+    try:
+        _ed_for_conf = load_engine_dir(args.engine_dir)
+    except Exception:
+        _ed_for_conf = None
+    if _ed_for_conf is not None:
+        loader.apply_runtime_conf(_ed_for_conf.variant)
+
     if args.evaluation_class:
         # Eval path (``CreateWorkflow.scala:180-199,264-277``).
         evaluation = loader.get_evaluation(args.evaluation_class, args.engine_dir)

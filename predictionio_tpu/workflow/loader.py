@@ -162,6 +162,60 @@ def get_engine_params_generator(path: str, search_dir: Optional[str] = None):
     return obj
 
 
+def apply_runtime_conf(variant) -> dict:
+    """Apply an engine variant's embedded runtime configuration — the
+    analogue of engine.json's ``sparkConf`` block
+    (``WorkflowUtils.extractSparkConf``, ``WorkflowUtils.scala:321-339``,
+    consumed at SparkContext creation, ``WorkflowContext.scala:78-96``).
+
+    ``engine.json`` may carry::
+
+        "runtimeConf": {
+          "env":       {"PIO_PROFILE_DIR": "/tmp/prof"},   # process env
+          "platform":  "cpu",                               # JAX_PLATFORMS
+          "xla_flags": "--xla_force_host_platform_device_count=8",
+          "jax":       {"jax_enable_x64": true}             # jax.config
+        }
+
+    Like the reference's sparkConf, settings bind at runtime start-up:
+    ``env``/``platform``/``xla_flags`` fully apply only when the driver is
+    a fresh process (``--spawn``); ``jax`` config keys apply immediately.
+    Returns the dict of applied settings (for logging / tests).
+    """
+    conf = (variant or {}).get("runtimeConf") or {}
+    applied: dict = {}
+    for key, value in (conf.get("env") or {}).items():
+        os.environ[key] = str(value)
+        applied.setdefault("env", {})[key] = str(value)
+    if conf.get("xla_flags"):
+        # token-wise idempotency: substring tests would treat "…count=1"
+        # as already present when "…count=16" is set
+        existing = os.environ.get("XLA_FLAGS", "").split()
+        new = [t for t in conf["xla_flags"].split() if t not in existing]
+        if new:
+            os.environ["XLA_FLAGS"] = " ".join(existing + new)
+        applied["xla_flags"] = conf["xla_flags"]
+    if conf.get("platform"):
+        os.environ["JAX_PLATFORMS"] = conf["platform"]
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", conf["platform"])
+        except Exception:
+            pass  # jax not importable yet: the env var carries it
+        applied["platform"] = conf["platform"]
+    jax_conf = conf.get("jax") or {}
+    if jax_conf:
+        import jax
+
+        for key, value in jax_conf.items():
+            jax.config.update(key, value)
+            applied.setdefault("jax", {})[key] = value
+    if applied:
+        logger.info("applied runtimeConf: %s", applied)
+    return applied
+
+
 def modify_logging(verbose: bool) -> None:
     """``WorkflowUtils.modifyLogging`` (``WorkflowUtils.scala:278-289``)."""
     level = logging.DEBUG if verbose else logging.INFO

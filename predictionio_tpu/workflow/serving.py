@@ -76,6 +76,11 @@ class ServerConfig:
     # 512 keeps the padded top-k program set small (pad_pow2) while letting
     # a high-latency dispatch path (e.g. a remote-relay device) amortize
     # the round trip over a large batch; device time grows sub-linearly.
+    # Memory envelope: scoring materializes a [batch, n_items] f32 matrix,
+    # so peak device memory scales linearly with this cap — at 10M items,
+    # 512×1e7×4 B ≈ 20 GB. Size batch_max to the catalog:
+    # batch_max ≲ device_bytes / (n_items × 4) (e.g. 128 for 10M items on
+    # a 16 GB chip).
     batch_max: int = 512
     batch_wait_ms: float = 1.0
 

@@ -118,3 +118,54 @@ class TestContext:
         mesh = ctx.mesh
         assert mesh.shape["data"] == 8  # virtual CPU devices from conftest
         ctx.stop()
+
+
+class TestRuntimeConf:
+    """engine.json runtimeConf — the embedded-sparkConf analogue
+    (WorkflowUtils.scala:321-339)."""
+
+    def test_apply_env_and_flags(self, monkeypatch):
+        import os
+
+        from predictionio_tpu.workflow.loader import apply_runtime_conf
+
+        monkeypatch.delenv("PIO_RTCONF_PROBE", raising=False)
+        monkeypatch.setenv("XLA_FLAGS", "--existing_flag")
+        applied = apply_runtime_conf(
+            {
+                "runtimeConf": {
+                    "env": {"PIO_RTCONF_PROBE": "42"},
+                    "xla_flags": "--xla_fake_probe_flag=1",
+                }
+            }
+        )
+        assert os.environ["PIO_RTCONF_PROBE"] == "42"
+        assert "--existing_flag" in os.environ["XLA_FLAGS"]
+        assert "--xla_fake_probe_flag=1" in os.environ["XLA_FLAGS"]
+        assert applied["env"] == {"PIO_RTCONF_PROBE": "42"}
+        # idempotent: reapplying does not duplicate the flag
+        apply_runtime_conf(
+            {"runtimeConf": {"xla_flags": "--xla_fake_probe_flag=1"}}
+        )
+        assert os.environ["XLA_FLAGS"].count("--xla_fake_probe_flag=1") == 1
+
+    def test_jax_config_keys(self):
+        import jax
+
+        from predictionio_tpu.workflow.loader import apply_runtime_conf
+
+        before = jax.config.jax_default_matmul_precision
+        try:
+            applied = apply_runtime_conf(
+                {"runtimeConf": {"jax": {"jax_default_matmul_precision": "float32"}}}
+            )
+            assert applied["jax"] == {"jax_default_matmul_precision": "float32"}
+            assert jax.config.jax_default_matmul_precision == "float32"
+        finally:
+            jax.config.update("jax_default_matmul_precision", before)
+
+    def test_absent_conf_is_noop(self):
+        from predictionio_tpu.workflow.loader import apply_runtime_conf
+
+        assert apply_runtime_conf({}) == {}
+        assert apply_runtime_conf(None) == {}
