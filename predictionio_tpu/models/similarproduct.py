@@ -22,6 +22,7 @@ standardization is :func:`predictionio_tpu.ops.scoring.standardize`.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -174,6 +175,22 @@ class SimilarALSModel:
         if not np.isfinite(self.item_factors).all():
             raise ValueError("SimilarALSModel factors are non-finite")
 
+    @functools.cached_property
+    def unit_factors(self) -> np.ndarray:
+        """Row-normalized factors, computed once per model instance —
+        cosine scoring needs them on every query, and renormalizing the
+        whole table per request was the serving hot path's biggest host
+        cost. Excluded from pickling (``__getstate__``) so persisted
+        model blobs don't double in size; recomputed on first use after
+        load."""
+        norms = np.linalg.norm(self.item_factors, axis=1, keepdims=True)
+        return self.item_factors / np.maximum(norms, 1e-12)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("unit_factors", None)  # cached_property stores under its name
+        return state
+
 
 def _candidate_mask(
     model: SimilarALSModel,
@@ -274,9 +291,7 @@ class SimilarALSAlgorithm(Algorithm):
         ]
         if not query_idx:
             return PredictedResult(item_scores=())
-        f = model.item_factors
-        norms = np.linalg.norm(f, axis=1, keepdims=True)
-        unit = f / np.maximum(norms, 1e-12)
+        unit = model.unit_factors
         # Σ_q cos(q, i) = (Σ_q unit_q) · unit_i — one matvec
         qvec = unit[query_idx].sum(axis=0)
         scores = unit @ qvec

@@ -83,11 +83,21 @@ def hybrid_mesh(
     if dcn_shape and jax.process_count() > 1:
         # create_hybrid_device_mesh wants same-rank per-axis shape pairs
         # (elementwise product per axis): DCN axes get 1 on the ICI side and
-        # vice versa, so axis i spans dcn_i * ici_i devices.
+        # vice versa, so axis i spans dcn_i * ici_i devices. On TPU pods the
+        # DCN granule is the slice (devices carry slice_index); everywhere
+        # else (CPU simulation, single-slice-per-host clusters) the granule
+        # is the process.
+        distinct_slices = {
+            getattr(d, "slice_index", None) for d in devices[:n_needed]
+        }
+        has_slices = None not in distinct_slices and len(distinct_slices) > 1
         mesh_shape = (1,) * len(dcn_shape) + ici_shape
         dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
         grid = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape, dcn_mesh_shape, devices=devices[:n_needed]
+            mesh_shape,
+            dcn_mesh_shape,
+            devices=devices[:n_needed],
+            process_is_granule=not has_slices,
         )
         return Mesh(grid, names)
     grid = np.array(devices[:n_needed]).reshape(dcn_shape + ici_shape)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from typing import Optional, Sequence
 
@@ -69,13 +70,16 @@ def run(
     # runtimeConf binds to every workflow run, train AND eval — the
     # reference applies embedded sparkConf to all SparkContext creations
     # (WorkflowUtils.scala:321-339). Eval runs may lack an engine.json
-    # (evaluation classes can carry their own engines); absent = no-op.
-    try:
-        _ed_for_conf = load_engine_dir(args.engine_dir)
-    except Exception:
-        _ed_for_conf = None
-    if _ed_for_conf is not None:
-        loader.apply_runtime_conf(_ed_for_conf.variant)
+    # (evaluation classes can carry their own engines): absent = no-op,
+    # but a PRESENT-yet-broken engine dir must not silently drop config.
+    ed = None
+    if args.evaluation_class and not os.path.exists(
+        os.path.join(args.engine_dir, "engine.json")
+    ):
+        pass  # eval without an engine.json: nothing to apply
+    else:
+        ed = load_engine_dir(args.engine_dir)
+        loader.apply_runtime_conf(ed.variant)
 
     if args.evaluation_class:
         # Eval path (``CreateWorkflow.scala:180-199,264-277``).
@@ -96,8 +100,8 @@ def run(
             )
         return run_evaluation(evaluation, generator, registry, workflow_params=wp)
 
-    # Train path (``CreateWorkflow.scala:219-263``).
-    ed = load_engine_dir(args.engine_dir)
+    # Train path (``CreateWorkflow.scala:219-263``). ``ed`` was loaded
+    # above (train always has an engine dir).
     factory = args.engine_factory or ed.engine_factory
     engine = loader.get_engine(factory, search_dir=ed.path)
     if args.engine_params_key:

@@ -188,12 +188,21 @@ def apply_runtime_conf(variant) -> dict:
         os.environ[key] = str(value)
         applied.setdefault("env", {})[key] = str(value)
     if conf.get("xla_flags"):
-        # token-wise idempotency: substring tests would treat "…count=1"
-        # as already present when "…count=16" is set
-        existing = os.environ.get("XLA_FLAGS", "").split()
-        new = [t for t in conf["xla_flags"].split() if t not in existing]
-        if new:
-            os.environ["XLA_FLAGS"] = " ".join(existing + new)
+        # Flag-NAME-aware merge: a requested flag replaces any existing
+        # setting of the same flag (token/substring comparisons either
+        # leave contradictory duplicates or treat "…count=1" as present
+        # because "…count=16" is).
+        def flag_name(token: str) -> str:
+            return token.split("=", 1)[0]
+
+        requested = conf["xla_flags"].split()
+        names = {flag_name(t) for t in requested}
+        kept = [
+            t
+            for t in os.environ.get("XLA_FLAGS", "").split()
+            if flag_name(t) not in names
+        ]
+        os.environ["XLA_FLAGS"] = " ".join(kept + requested)
         applied["xla_flags"] = conf["xla_flags"]
     if conf.get("platform"):
         os.environ["JAX_PLATFORMS"] = conf["platform"]

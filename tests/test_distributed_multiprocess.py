@@ -1,0 +1,113 @@
+"""True multi-process distributed smoke test.
+
+The dryrun (`__graft_entry__.dryrun_multichip`) validates multi-device
+sharding in ONE process; this test validates the multi-HOST path — two OS
+processes joined through ``jax.distributed`` (the framework's analogue of
+the reference's driver↔executor cluster boundary), each contributing 4
+virtual CPU devices to an 8-device global mesh, running a psum that spans
+the process boundary over the distributed runtime.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, os.environ["PIO_REPO"])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from predictionio_tpu.parallel.distributed import (
+        initialize_from_env, process_info, hybrid_mesh,
+    )
+
+    assert initialize_from_env()
+    rank, world = process_info()
+    assert world == 2, world
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # dp axis crosses the process (DCN) boundary, data stays process-local
+    mesh = hybrid_mesh({"data": 4}, {"dp": 2})
+    assert dict(mesh.shape) == {"dp": 2, "data": 4}
+
+    # global array sharded over both axes; psum must cross processes
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    sharding = NamedSharding(mesh, P(("dp", "data")))
+    global_shape = (16,)
+    local = np.arange(16, dtype=np.float32).reshape(2, 4, 2)[rank]
+    arrs = [
+        jax.device_put(local[i], d)
+        for i, d in enumerate(mesh.local_devices)
+    ]
+    x = jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrs
+    )
+    result = float(total(x))
+    assert result == float(np.arange(16).sum()), result
+    print(f"RANK_{rank}_OK", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_psum(tmp_path):
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update(
+            PIO_REPO=REPO,
+            PIO_DIST_COORDINATOR=f"127.0.0.1:{port}",
+            PIO_DIST_NUM_PROCESSES="2",
+            PIO_DIST_PROCESS_ID=str(rank),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out")
+        outs.append((proc.returncode, out, err))
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+        assert f"RANK_{rank}_OK" in out

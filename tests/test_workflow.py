@@ -129,7 +129,9 @@ class TestRuntimeConf:
 
         from predictionio_tpu.workflow.loader import apply_runtime_conf
 
-        monkeypatch.delenv("PIO_RTCONF_PROBE", raising=False)
+        # own the env var so teardown restores it even though the code
+        # under test (not monkeypatch) performs the write
+        monkeypatch.setenv("PIO_RTCONF_PROBE", "sentinel")
         monkeypatch.setenv("XLA_FLAGS", "--existing_flag")
         applied = apply_runtime_conf(
             {
@@ -147,7 +149,13 @@ class TestRuntimeConf:
         apply_runtime_conf(
             {"runtimeConf": {"xla_flags": "--xla_fake_probe_flag=1"}}
         )
-        assert os.environ["XLA_FLAGS"].count("--xla_fake_probe_flag=1") == 1
+        assert os.environ["XLA_FLAGS"].count("--xla_fake_probe_flag") == 1
+        # flag-NAME-aware: a new value REPLACES the old, no duplicates
+        apply_runtime_conf(
+            {"runtimeConf": {"xla_flags": "--xla_fake_probe_flag=2"}}
+        )
+        assert os.environ["XLA_FLAGS"].count("--xla_fake_probe_flag") == 1
+        assert "--xla_fake_probe_flag=2" in os.environ["XLA_FLAGS"]
 
     def test_jax_config_keys(self):
         import jax
