@@ -23,6 +23,15 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 _LOCK = threading.Lock()
 _CACHE = {}
 
+#: Every native component: library name → source list (None = <name>.cc).
+#: Single source of truth shared by the runtime load sites and `pio build`'s
+#: ahead-of-time compile, so the precompile can never drift stale.
+LIBRARIES = {
+    "eventlog": ["eventlog.cc", "ratings.cc"],
+    "bucketize": None,
+    "idhash": None,
+}
+
 
 class NativeBuildError(RuntimeError):
     """Compilation of a native component failed."""

@@ -54,7 +54,9 @@ _SYNC_EVERY = 256
 
 
 def _lib() -> ctypes.CDLL:
-    lib = load_library("eventlog", sources=["eventlog.cc", "ratings.cc"])
+    from ..native import LIBRARIES
+
+    lib = load_library("eventlog", sources=LIBRARIES["eventlog"])
     if not getattr(lib, "_pio_configured", False):
         lib.evlog_open.restype = ctypes.c_void_p
         lib.evlog_open.argtypes = [ctypes.c_char_p]

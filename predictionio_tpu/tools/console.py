@@ -460,7 +460,25 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
 
     if cmd == "build":
         ed = register_mod.register_engine(registry, args.engine_dir)
-        _emit({"engineId": ed.manifest.id, "engineVersion": ed.manifest.version})
+        # Pre-compile the native runtime components so the first train /
+        # deploy doesn't pay the C++ build (the reference's `pio build`
+        # runs sbt compile up front — same idea, RunWorkflow launches are
+        # then pure execution). Best-effort: a toolchain-less host falls
+        # back to the Python paths at runtime anyway.
+        from ..native import LIBRARIES, NativeBuildError, build_library
+
+        native_built = []
+        for name, sources in LIBRARIES.items():
+            try:
+                build_library(name, sources)
+                native_built.append(name)
+            except NativeBuildError:
+                pass
+        _emit({
+            "engineId": ed.manifest.id,
+            "engineVersion": ed.manifest.version,
+            "nativeLibraries": native_built,
+        })
         return EXIT_OK
 
     if cmd == "train":

@@ -72,9 +72,11 @@ def run(
     # (WorkflowUtils.scala:321-339). Eval runs may lack an engine.json
     # (evaluation classes can carry their own engines): absent = no-op,
     # but a PRESENT-yet-broken engine dir must not silently drop config.
+    from .register import ENGINE_JSON
+
     ed = None
     if args.evaluation_class and not os.path.exists(
-        os.path.join(args.engine_dir, "engine.json")
+        os.path.join(args.engine_dir, ENGINE_JSON)
     ):
         pass  # eval without an engine.json: nothing to apply
     else:
