@@ -50,11 +50,15 @@ def _source_digest(sources) -> str:
 
 
 def build_library(name: str, sources=None, extra_flags=()) -> str:
-    """Compile ``<name>.cc`` (or explicit sources) into ``_build/lib<name>.so``
-    if missing or stale. Returns the library path."""
-    sources = [
-        os.path.join(_HERE, s) for s in (sources or [f"{name}.cc"])
-    ]
+    """Compile a library from ``LIBRARIES[name]`` (or explicit sources)
+    into ``_build/lib<name>.so`` if missing or stale. Returns the path.
+
+    The .so is written to a temp name and renamed into place, so a
+    concurrent process (e.g. ``pio build`` racing a lazily-compiling
+    server) can never dlopen a half-written file."""
+    if sources is None:
+        sources = LIBRARIES.get(name) or [f"{name}.cc"]
+    sources = [os.path.join(_HERE, s) for s in sources]
     os.makedirs(_BUILD_DIR, exist_ok=True)
     lib_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
     stamp_path = os.path.join(_BUILD_DIR, f"lib{name}.stamp")
@@ -64,10 +68,11 @@ def build_library(name: str, sources=None, extra_flags=()) -> str:
             if f.read().strip() == digest:
                 return lib_path
     cxx = os.environ.get("CXX", "g++")
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}"
     cmd = [
         cxx, "-O2", "-shared", "-fPIC", "-std=c++17",
         "-Wall", "-Wextra",
-        *extra_flags, "-o", lib_path, *sources,
+        *extra_flags, "-o", tmp_path, *sources,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -77,9 +82,14 @@ def build_library(name: str, sources=None, extra_flags=()) -> str:
         # NativeBuildError fallback covers it
         raise NativeBuildError(f"cannot run {cxx!r}: {exc}") from exc
     if proc.returncode != 0:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
         raise NativeBuildError(
             f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
         )
+    os.replace(tmp_path, lib_path)  # atomic: readers see old or new, whole
     with open(stamp_path, "w") as f:
         f.write(digest)
     return lib_path

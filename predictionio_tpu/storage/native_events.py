@@ -54,9 +54,7 @@ _SYNC_EVERY = 256
 
 
 def _lib() -> ctypes.CDLL:
-    from ..native import LIBRARIES
-
-    lib = load_library("eventlog", sources=LIBRARIES["eventlog"])
+    lib = load_library("eventlog")  # sources come from native.LIBRARIES
     if not getattr(lib, "_pio_configured", False):
         lib.evlog_open.restype = ctypes.c_void_p
         lib.evlog_open.argtypes = [ctypes.c_char_p]

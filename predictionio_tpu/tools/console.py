@@ -468,11 +468,13 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         from ..native import LIBRARIES, NativeBuildError, build_library
 
         native_built = []
-        for name, sources in LIBRARIES.items():
+        for name in LIBRARIES:
             try:
-                build_library(name, sources)
+                build_library(name)
                 native_built.append(name)
-            except NativeBuildError:
+            except (NativeBuildError, OSError):
+                # best-effort: toolchain-less or read-only installs fall
+                # back to the Python paths at runtime
                 pass
         _emit({
             "engineId": ed.manifest.id,

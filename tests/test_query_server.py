@@ -154,6 +154,49 @@ def test_reload_hot_swaps_to_latest(server):
     assert r.status_code == 200
 
 
+def test_reload_under_traffic(server):
+    """Hot swap while queries are in flight: the micro-batcher may see a
+    batch mixing deployments across the swap — the mixed-generation
+    grouping in ``QueryServer._predict_batch`` must route every query to
+    its own deployment and none may error (``GET /reload`` parity with
+    the MasterActor swap, ``CreateServer.scala:250-372``)."""
+    import threading
+
+    base, srv, registry, engine = server
+    stop = threading.Event()
+    failures = []
+    ok = [0]
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                r = requests.post(f"{base}/queries.json", json={"id": 3},
+                                  timeout=10)
+                if r.status_code != 200 or r.json()["combined"] != [11, 13]:
+                    failures.append(r.text[:200])
+                else:
+                    ok[0] += 1
+            except Exception as exc:
+                failures.append(repr(exc))
+
+    workers = [threading.Thread(target=hammer) for _ in range(8)]
+    for w in workers:
+        w.start()
+    try:
+        for _ in range(3):  # three hot swaps under load
+            new_id = _train(registry, engine, algo_ids=(11, 13))
+            r = requests.get(f"{base}/reload", timeout=30)
+            assert r.status_code == 200
+            assert srv.deployment.instance.id == new_id
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+    assert not failures, failures[:3]
+    assert ok[0] > 20  # real traffic flowed throughout
+
+
 def test_stop_shuts_down(server):
     base, srv, _, _ = server
     r = requests.get(f"{base}/stop")
