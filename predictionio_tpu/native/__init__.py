@@ -75,21 +75,24 @@ def build_library(name: str, sources=None, extra_flags=()) -> str:
         *extra_flags, "-o", tmp_path, *sources,
     ]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-    except (FileNotFoundError, OSError) as exc:
-        # no compiler on PATH (or it can't exec) — the same "native
-        # unavailable" condition as a failed compile, so callers' single
-        # NativeBuildError fallback covers it
-        raise NativeBuildError(f"cannot run {cxx!r}: {exc}") from exc
-    if proc.returncode != 0:
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except (FileNotFoundError, OSError) as exc:
+            # no compiler on PATH (or it can't exec) — the same "native
+            # unavailable" condition as a failed compile, so callers'
+            # single NativeBuildError fallback covers it
+            raise NativeBuildError(f"cannot run {cxx!r}: {exc}") from exc
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
+            )
+        os.replace(tmp_path, lib_path)  # atomic: readers see old or new
+    finally:
+        # interrupt / late failure: never leak the pid-suffixed temp
         try:
             os.unlink(tmp_path)
         except OSError:
             pass
-        raise NativeBuildError(
-            f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
-        )
-    os.replace(tmp_path, lib_path)  # atomic: readers see old or new, whole
     with open(stamp_path, "w") as f:
         f.write(digest)
     return lib_path

@@ -132,6 +132,78 @@ int evlog_sync(void* vh) {
   return fdatasync(h->fd) == 0 ? 0 : -errno;
 }
 
+namespace {
+
+// Fill one record header (shared by single and batch append paths).
+void fill_header(RecordHeader* hdr, uint32_t flags, int64_t event_time_ms,
+                 int64_t creation_time_ms, uint64_t etype_hash,
+                 uint64_t entity_hash, uint64_t event_hash,
+                 uint64_t ttype_hash, uint64_t target_hash, uint64_t id_hash,
+                 uint32_t payload_len) {
+  memset(hdr, 0, sizeof(*hdr));
+  hdr->record_len = kHeaderSize + ((payload_len + 7u) & ~7u);
+  hdr->flags = flags;
+  hdr->event_time_ms = event_time_ms;
+  hdr->creation_time_ms = creation_time_ms;
+  hdr->etype_hash = etype_hash;
+  hdr->entity_hash = entity_hash;
+  hdr->event_hash = event_hash;
+  hdr->ttype_hash = ttype_hash;
+  hdr->target_hash = target_hash;
+  hdr->id_hash = id_hash;
+  hdr->payload_len = payload_len;
+}
+
+// Append a pre-serialized run of n_new records under the handle mutex +
+// advisory file lock: full-write-or-rollback, then fold any foreign
+// appends into the handle's size/count accounting. Returns the file
+// offset where the run begins, or -errno.
+int64_t append_locked(Handle* h, const uint8_t* data, int64_t total,
+                      int64_t n_new) {
+  std::lock_guard<std::mutex> lock(h->mu);
+  FileLock flock_guard(h->fd);  // serialize with other processes' appends
+  ssize_t written = 0;
+  while (written < (ssize_t)total) {
+    ssize_t w = write(h->fd, data + written, (size_t)total - written);
+    if (w <= 0) {
+      int saved = errno ? errno : EIO;
+      if (written > 0) {
+        // Partial write: under the file lock no other writer can
+        // interleave, so the last `written` bytes are exactly ours —
+        // roll them back.
+        struct stat st;
+        if (fstat(h->fd, &st) == 0) {
+          if (ftruncate(h->fd, (off_t)(st.st_size - written)) != 0) {
+            /* scans remain bounded by validated sizes */
+          }
+        }
+      }
+      return -(int64_t)saved;
+    }
+    written += w;
+  }
+  // Our run ends at the current file end (O_APPEND). Fold in anything
+  // other writers appended before us as well.
+  struct stat st;
+  if (fstat(h->fd, &st) != 0) {
+    h->size += total;  // fallback: at least account for our own write
+    h->n_records += n_new;
+    return h->size - total;
+  }
+  int64_t end = (int64_t)st.st_size;
+  if (end - total > h->size) {
+    int64_t committed, count;
+    if (validate_range(h->fd, end - total, h->size, &committed, &count)) {
+      h->n_records += count;
+    }
+  }
+  h->size = end;
+  h->n_records += n_new;
+  return end - total;
+}
+
+}  // namespace
+
 // Append one record. Returns payload offset in file, or -errno.
 int64_t evlog_append(void* vh, uint32_t flags, int64_t event_time_ms,
                      int64_t creation_time_ms, uint64_t etype_hash,
@@ -143,56 +215,59 @@ int64_t evlog_append(void* vh, uint32_t flags, int64_t event_time_ms,
   uint32_t record_len = kHeaderSize + ((payload_len + 7u) & ~7u);
   std::vector<uint8_t> buf(record_len, 0);
   RecordHeader hdr;
-  memset(&hdr, 0, sizeof(hdr));
-  hdr.record_len = record_len;
-  hdr.flags = flags;
-  hdr.event_time_ms = event_time_ms;
-  hdr.creation_time_ms = creation_time_ms;
-  hdr.etype_hash = etype_hash;
-  hdr.entity_hash = entity_hash;
-  hdr.event_hash = event_hash;
-  hdr.ttype_hash = ttype_hash;
-  hdr.target_hash = target_hash;
-  hdr.id_hash = id_hash;
-  hdr.payload_len = payload_len;
+  fill_header(&hdr, flags, event_time_ms, creation_time_ms, etype_hash,
+              entity_hash, event_hash, ttype_hash, target_hash, id_hash,
+              payload_len);
   memcpy(buf.data(), &hdr, kHeaderSize);
   if (payload_len) memcpy(buf.data() + kHeaderSize, payload, payload_len);
+  int64_t start = append_locked(h, buf.data(), record_len, 1);
+  if (start < 0) return start;
+  return start + (int64_t)kHeaderSize;
+}
 
-  std::lock_guard<std::mutex> lock(h->mu);
-  FileLock flock_guard(h->fd);  // serialize with other processes' appends
-  ssize_t n = write(h->fd, buf.data(), record_len);
-  if (n != (ssize_t)record_len) {
-    int saved = errno ? errno : EIO;
-    if (n > 0) {
-      // Partial write: under the file lock no other writer can interleave,
-      // so the last n bytes of the file are exactly ours — roll them back.
-      struct stat st;
-      if (fstat(h->fd, &st) == 0) {
-        if (ftruncate(h->fd, (off_t)(st.st_size - n)) != 0) {
-          /* scans remain bounded by validated sizes */
-        }
-      }
-    }
-    return -(int64_t)saved;
+// Append a batch of insert records under ONE lock acquisition and ONE
+// write(2): the bulk-import fast path (`pio import`, PEvents.write parity —
+// the reference batches via saveAsNewAPIHadoopDataset, HBPEvents.scala:
+// 166-184). payload_blob holds all payloads concatenated; payload_ends[i]
+// is the exclusive end offset of payload i. All records are plain inserts
+// (flags=0). Returns the number appended (== n), or -errno; on a partial
+// write the whole batch is rolled back (truncate under the lock), so the
+// batch is atomic with respect to durability.
+int64_t evlog_append_batch(void* vh, int64_t n, const int64_t* event_time_ms,
+                           const int64_t* creation_time_ms,
+                           const uint64_t* etype_hash,
+                           const uint64_t* entity_hash,
+                           const uint64_t* event_hash,
+                           const uint64_t* ttype_hash,
+                           const uint64_t* target_hash,
+                           const uint64_t* id_hash,
+                           const uint8_t* payload_blob,
+                           const int64_t* payload_ends) {
+  auto* h = (Handle*)vh;
+  // serialize every record into one contiguous buffer
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t start = i == 0 ? 0 : payload_ends[i - 1];
+    uint32_t plen = (uint32_t)(payload_ends[i] - start);
+    total += kHeaderSize + ((plen + 7u) & ~7u);
   }
-  // Our record ends at the current file end (O_APPEND). Fold in anything
-  // other writers appended before us as well.
-  struct stat st;
-  if (fstat(h->fd, &st) != 0) {
-    h->size += record_len;  // fallback: at least account for our own write
-    h->n_records++;
-    return h->size - record_len + kHeaderSize;
+  std::vector<uint8_t> buf((size_t)total, 0);
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t start = i == 0 ? 0 : payload_ends[i - 1];
+    uint32_t plen = (uint32_t)(payload_ends[i] - start);
+    RecordHeader hdr;
+    fill_header(&hdr, 0, event_time_ms[i], creation_time_ms[i],
+                etype_hash[i], entity_hash[i], event_hash[i], ttype_hash[i],
+                target_hash[i], id_hash[i], plen);
+    memcpy(buf.data() + off, &hdr, kHeaderSize);
+    if (plen) memcpy(buf.data() + off + kHeaderSize, payload_blob + start, plen);
+    off += hdr.record_len;
   }
-  int64_t end = (int64_t)st.st_size;
-  if (end - record_len > h->size) {
-    int64_t committed, count;
-    if (validate_range(h->fd, end - record_len, h->size, &committed, &count)) {
-      h->n_records += count;
-    }
-  }
-  h->size = end;
-  h->n_records++;
-  return end - (int64_t)record_len + (int64_t)kHeaderSize;
+
+  int64_t start = append_locked(h, buf.data(), total, n);
+  if (start < 0) return start;
+  return n;
 }
 
 // Bulk scan with predicate push-down. Any hash argument of 0 means "any";

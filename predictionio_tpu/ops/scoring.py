@@ -19,7 +19,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-jnp.inf)
+# plain float, NOT jnp.float32(...): a module-level jnp scalar would
+# initialize the device backend at import time (slow start-up for every
+# CLI invocation, and a hang if the accelerator is unreachable)
+NEG_INF = float("-inf")
 
 
 def pad_pow2(n: int, lo: int = 1) -> int:
