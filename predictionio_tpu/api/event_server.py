@@ -196,6 +196,8 @@ class _EventServiceHandler(JsonHTTPHandler):
                 self._respond(200, {"status": "alive"})
             elif path == "/events.json" and method == "POST":
                 self._post_event(query)
+            elif path == "/batches/events.json" and method == "POST":
+                self._post_event_batch(query)
             elif path == "/events.json" and method == "GET":
                 self._find_events(query)
             elif (
@@ -246,6 +248,55 @@ class _EventServiceHandler(JsonHTTPHandler):
         if self.server.stats_tracker is not None:
             self.server.stats_tracker.bookkeeping(app_id, status, event)
         self._respond(status, {"eventId": event_id})
+
+    def _post_event_batch(self, query: Dict[str, list]) -> None:
+        """``POST /batches/events.json`` — bulk ingestion (the official
+        SDKs' batch surface; added to PredictionIO after the surveyed
+        release, kept wire-compatible with it here). Body is a JSON array
+        of events; the response is a per-event array of
+        ``{"status": 201, "eventId": ...}`` or ``{"status": 400,
+        "message": ...}`` in input order — one bad event does not reject
+        the batch. Valid events take the store's batched append path."""
+        app_id = self._auth(query)
+        try:
+            objs = json.loads(self._body.decode("utf-8"))
+            if not isinstance(objs, list):
+                raise ValueError("batch body must be a JSON array")
+        except ValueError as exc:
+            self._respond(400, {"message": str(exc)})
+            return
+        results: list = [None] * len(objs)
+        valid: list = []  # (position, event)
+        for pos, obj in enumerate(objs):
+            try:
+                event = Event.from_json_dict(obj)
+                validate_event(event)
+                valid.append((pos, event))
+            except (ValueError, KeyError, TypeError, EventValidationError) as exc:
+                results[pos] = {"status": 400, "message": str(exc)}
+        if valid:
+            import dataclasses as _dc
+
+            from ..storage.sqlite_events import make_event_id
+
+            fresh = []  # server-minted ids: guaranteed-new batch path
+            upserts = []  # client-supplied ids keep upsert semantics
+            for pos, event in valid:
+                if event.event_id is None:
+                    eid = make_event_id(event)
+                    fresh.append(_dc.replace(event, event_id=eid))
+                else:
+                    eid = event.event_id
+                    upserts.append(event)
+                results[pos] = {"status": 201, "eventId": eid}
+            if fresh:
+                self.server.events.write_new(fresh, app_id)
+            if upserts:
+                self.server.events.write(upserts, app_id)
+            if self.server.stats_tracker is not None:
+                for _pos, event in valid:
+                    self.server.stats_tracker.bookkeeping(app_id, 201, event)
+        self._respond(200, results)
 
     def _find_events(self, query: Dict[str, list]) -> None:
         """``EventAPI.scala:254-325``; single ``event`` name, limit default 20."""

@@ -349,7 +349,8 @@ class _StagedBucket:
     of the staging bytes, which on a remote-tunnel device is wall-clock."""
 
     rows: jax.Array  # [C, B] int32 (padded with n_rows → dropped by scatter)
-    idx: jax.Array  # [C, B, K] int32
+    idx: jax.Array  # [C, B, K] int32, or uint16 when n_cols <= 0xFFFF
+    #                 (transfer packing; widened in _solve_side_traced)
     val: jax.Array  # [C, B, K] float32
     counts: jax.Array  # [C, B] int32 — ratings per row (0 on padding)
 
@@ -399,6 +400,11 @@ def stage(
             bucket.rows, (0, pad), constant_values=side.n_rows
         ).reshape(n_chunks, block)  # out-of-range → dropped by scatter
         idx = pad2(bucket.idx).reshape(n_chunks, block, bucket.width)
+        if side.n_cols <= 0xFFFF:
+            # column ids fit uint16: halves the largest staged tensor's
+            # host→device bytes (widened back to int32 inside the traced
+            # solve, where the cast fuses for free)
+            idx = idx.astype(np.uint16)
         val = pad2(bucket.val).reshape(n_chunks, block, bucket.width)
         counts = np.pad(
             bucket.mask.sum(axis=1).astype(np.int32), (0, pad)
@@ -479,6 +485,8 @@ def _solve_side_traced(y, buckets, n_rows, rank, implicit, lam, alpha, yty):
         ).astype(jnp.float32)
 
     for rows, idx, val, counts in buckets:
+        if idx.dtype != jnp.int32:
+            idx = idx.astype(jnp.int32)  # uint16 transfer packing
         if implicit:
             solved = jax.lax.map(
                 lambda c: _solve_block_implicit_body(

@@ -244,3 +244,9 @@ class EventStore(abc.ABC):
         """Bulk write (``PEvents.write``, ``PEvents.scala:105-118``)."""
         for e in events:
             self.insert(e, app_id)
+
+    def write_new(self, events: Sequence[Event], app_id: int) -> None:
+        """Bulk write of events the caller GUARANTEES are fresh (every
+        ``event_id`` newly minted and unique) — backends may skip their
+        upsert/replace machinery. Default: plain ``write``."""
+        self.write(events, app_id)

@@ -225,8 +225,20 @@ class NativeEventStore(EventStore):
             # single inserts" durability bound
             self.sync(app_id)
 
+    def write_new(self, events, app_id: int) -> None:
+        """Batch append for caller-guaranteed-fresh events: pre-assigned
+        ids skip the tombstone-first upsert dance entirely (the batch
+        ingestion route's path — ids are minted for the response before
+        the write)."""
+        events = list(events)
+        if events:
+            self._write_batch(events, app_id)
+        self.sync(app_id)
+
     def _write_batch(self, events, app_id: int) -> None:
-        """Native batch append for id-less inserts (see ``write``)."""
+        """Native batch append for fresh inserts (see ``write`` /
+        ``write_new``). Uses the event's own id when present (write_new's
+        freshness contract), else mints one."""
         from .bimap import _fnv1a64_batch
 
         h = self._handle(app_id, create=True)
@@ -241,7 +253,7 @@ class NativeEventStore(EventStore):
         payloads: list = []
         for i, event in enumerate(events):
             validate_event(event)
-            event_id = make_event_id(event)
+            event_id = event.event_id or make_event_id(event)
             stored = dataclasses.replace(event, event_id=event_id)
             payloads.append(json.dumps(stored.to_json_dict()).encode("utf-8"))
             times[i] = _ms(event.event_time)

@@ -157,6 +157,15 @@ class RemoteEventStore(EventStore):
         with _request(self._url(app_id, "/batch"), "POST", body, self._timeout):
             pass
 
+    def write_new(self, events, app_id: int) -> None:
+        """Freshness contract forwarded to the server so the backing store
+        can take its guaranteed-new batch path."""
+        body = json.dumps([e.to_json_dict() for e in events]).encode()
+        with _request(
+            self._url(app_id, "/batch?fresh=1"), "POST", body, self._timeout
+        ):
+            pass
+
 
 class _RemoteRPC:
     """One metadata RPC method bound to a URL."""

@@ -31,7 +31,7 @@ import datetime as _dt
 import json
 import logging
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from .event import Event
@@ -141,10 +141,14 @@ class _StorageHandler(JsonHTTPHandler):
             event = Event.from_json_dict(json.loads(self.read_body()))
             self.respond(201, {"eventId": store.insert(event, app_id)})
         elif method == "POST" and rest == ["batch"]:
+            fresh = parse_qs(urlparse(self.path).query).get("fresh")
             events = [
                 Event.from_json_dict(o) for o in json.loads(self.read_body())
             ]
-            store.write(events, app_id)
+            if fresh and fresh[0] == "1":
+                store.write_new(events, app_id)
+            else:
+                store.write(events, app_id)
             self.respond(200, {"count": len(events)})
         elif method == "POST" and rest == ["find"]:
             flt = _parse_filter(json.loads(self.read_body() or b"{}"))

@@ -171,6 +171,17 @@ std::string EventClient::create_event(const std::string& event_json) {
   return resp.body.substr(q1 + 1, q2 - q1 - 1);
 }
 
+std::string EventClient::create_events_batch(
+    const std::string& events_json_array) {
+  auto resp = http_.request(
+      "POST", "/batches/events.json?accessKey=" + url_encode(access_key_),
+      events_json_array);
+  if (resp.status != 200) {
+    throw ClientError(resp.status, "create_events_batch: " + resp.body);
+  }
+  return resp.body;
+}
+
 std::string EventClient::get_event(const std::string& event_id) {
   auto resp = http_.request(
       "GET",

@@ -73,6 +73,12 @@ class EventClient {
   // `event_json` is the wire-format event dict.
   std::string create_event(const std::string& event_json);
 
+  // POST /batches/events.json — bulk ingestion. `events_json_array` is a
+  // JSON array of wire-format event dicts; returns the server's
+  // per-event result array (status 201 + eventId, or 400 + message) as
+  // raw JSON.
+  std::string create_events_batch(const std::string& events_json_array);
+
   // GET /events/<id>.json — returns the event JSON.
   std::string get_event(const std::string& event_id);
 

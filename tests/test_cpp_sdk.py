@@ -58,6 +58,20 @@ int main(int argc, char** argv) {
       return 1;
     }
   }
+  // batch ingestion
+  std::string batch = ev.create_events_batch(
+      R"([{"event": "rate", "entityType": "user", "entityId": "cb1",)"
+      R"( "targetEntityType": "item", "targetEntityId": "ci1",)"
+      R"( "properties": {"rating": 1.0}},)"
+      R"( {"event": "rate", "entityType": "user", "entityId": "cb2",)"
+      R"( "targetEntityType": "item", "targetEntityId": "ci2",)"
+      R"( "properties": {"rating": 2.0}}])");
+  if (batch.find("201") == std::string::npos ||
+      batch.find("eventId") == std::string::npos) {
+    fprintf(stderr, "batch result unexpected: %s\n", batch.c_str());
+    return 1;
+  }
+
   // bad access key must be rejected
   pio::EventClient bad(host, port, "wrong-key");
   try {

@@ -223,3 +223,49 @@ def test_stats_tracker_hour_rollover():
     assert snap["longLive"]["statusCode"] == [{"key": 201, "value": 2}]
     # other app sees nothing
     assert tracker.get(8)["longLive"]["basic"] == []
+
+
+def test_batch_events_route(server):
+    """POST /batches/events.json — bulk ingestion with per-event results
+    (valid events succeed even when the batch contains invalid ones)."""
+    base, app_id = server
+    batch = [
+        _event_payload(entityId=f"b{i}") for i in range(5)
+    ] + [
+        {"event": "", "entityType": "user", "entityId": "bad"},  # invalid
+        _event_payload(entityId="b-last", eventId="client-chosen-id"),
+    ]
+    r = requests.post(f"{base}/batches/events.json?accessKey=SECRET", json=batch)
+    assert r.status_code == 200
+    results = r.json()
+    assert len(results) == 7
+    assert [x["status"] for x in results] == [201] * 5 + [400, 201]
+    assert "message" in results[5]
+    assert results[6]["eventId"] == "client-chosen-id"
+    # every accepted event is durably findable
+    found = requests.get(
+        f"{base}/events.json?accessKey=SECRET&limit=-1"
+    ).json()
+    ids = {e["entityId"] for e in found}
+    assert {f"b{i}" for i in range(5)} <= ids and "b-last" in ids
+    assert "bad" not in ids
+    # returned eventIds resolve via point GET
+    eid = results[0]["eventId"]
+    got = requests.get(f"{base}/events/{eid}.json?accessKey=SECRET")
+    assert got.status_code == 200
+
+
+def test_batch_events_rejects_non_array(server):
+    base, _ = server
+    r = requests.post(
+        f"{base}/batches/events.json?accessKey=SECRET", json={"not": "array"}
+    )
+    assert r.status_code == 400
+
+
+def test_batch_events_requires_auth(server):
+    base, _ = server
+    r = requests.post(
+        f"{base}/batches/events.json?accessKey=WRONG", json=[_event_payload()]
+    )
+    assert r.status_code == 401
