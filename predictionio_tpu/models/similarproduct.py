@@ -36,6 +36,7 @@ from ..controller import (
     Serving,
 )
 from ..ops.als import ALSConfig, als_train_coo
+from ..ops.scoring import pad_pow2, top_k_for_vectors
 from ..storage import BiMap, EventFilter, get_registry
 
 
@@ -255,6 +256,19 @@ class SimilarALSAlgorithm(Algorithm):
             for u, i, r in triplets
             if user_map.get(u) is not None and item_map.get(i) is not None
         ]
+        if not valid:
+            # Training would silently produce an all-zero model (empty
+            # solve): the usual cause is view events whose users/items
+            # were never $set (the reference template only trains over
+            # entities present in its users/items RDDs,
+            # ``DataSource.scala`` of the similarproduct template).
+            raise ValueError(
+                f"No {type(self).__name__} rating events match $set "
+                f"users/items: {len(triplets)} rating pairs, "
+                f"{len(user_map)} users, {len(item_map)} items. Send $set "
+                "events for the entities referenced by the interaction "
+                "events."
+            )
         users = np.array([v[0] for v in valid], np.int64)
         items = np.array([v[1] for v in valid], np.int64)
         vals = np.array([v[2] for v in valid], np.float32)
@@ -284,32 +298,67 @@ class SimilarALSAlgorithm(Algorithm):
 
     # -- predict ----------------------------------------------------------
     def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
-        query_idx = [
-            model.item_map.get(it)
-            for it in query.items
-            if model.item_map.get(it) is not None
-        ]
-        if not query_idx:
-            return PredictedResult(item_scores=())
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(
+        self, model: SimilarALSModel, indexed_queries
+    ) -> List[Tuple[int, PredictedResult]]:
+        """Micro-batched serving path: ONE device dispatch for the whole
+        batch via :func:`ops.scoring.top_k_for_vectors` (the [B, R] ×
+        [R, I] cosine matmul + masked top-k on the MXU), with per-query
+        candidate masks built on host — the batched analogue of the
+        reference's per-request cosine scoring
+        (``ALSAlgorithm.scala:76-252``). Shape bucketing (pad_pow2, as in
+        the recommendation template) keeps the compiled-program set small
+        across batch sizes."""
+        import jax
+
         unit = model.unit_factors
-        # Σ_q cos(q, i) = (Σ_q unit_q) · unit_i — one matvec
-        qvec = unit[query_idx].sum(axis=0)
-        scores = unit @ qvec
-        excluded = _candidate_mask(model, query, query_idx)
-        scores = np.where(excluded | (scores <= 0), -np.inf, scores)
-        k = min(query.num, (np.isfinite(scores)).sum())
-        if k <= 0:
-            return PredictedResult(item_scores=())
-        top = np.argpartition(-scores, k - 1)[:k]
-        top = top[np.argsort(-scores[top])]
-        inv = model.item_map.inverse
-        return PredictedResult(
-            item_scores=tuple(
-                ItemScore(item=inv[int(i)], score=float(scores[i]))
-                for i in top
-                if np.isfinite(scores[i])
-            )
+        n_items = unit.shape[0]
+        out: List[Tuple[int, PredictedResult]] = []
+        rows = []  # (pos, query, query_idx)
+        for pos, query in indexed_queries:
+            query_idx = [
+                model.item_map.get(it)
+                for it in query.items
+                if model.item_map.get(it) is not None
+            ]
+            if not query_idx:
+                out.append((pos, PredictedResult(item_scores=())))
+            else:
+                rows.append((pos, query, query_idx))
+        if not rows:
+            return out
+        # Σ_q cos(q, i) = (Σ_q unit_q) · unit_i
+        qvecs = np.stack([unit[qi].sum(axis=0) for _, _, qi in rows])
+        exclude = np.stack(
+            [_candidate_mask(model, q, qi) for _, q, qi in rows]
         )
+        b = len(rows)
+        b_pad = pad_pow2(b)
+        max_k = min(max(q.num for _, q, _ in rows), n_items)
+        k_pad = min(pad_pow2(max_k, lo=8), n_items)
+        if b_pad > b:
+            qvecs = np.pad(qvecs, ((0, b_pad - b), (0, 0)))
+            # padded rows exclude everything → -inf scores, sliced away
+            exclude = np.pad(
+                exclude, ((0, b_pad - b), (0, 0)), constant_values=True
+            )
+        scores, idx = top_k_for_vectors(qvecs, unit, k_pad, exclude)
+        scores, idx = jax.device_get((scores, idx))
+        scores = scores[:b, :max_k].tolist()
+        idx = idx[:b, :max_k].tolist()
+        inv = model.item_map.inverse
+        for (pos, query, _qi), s_row, i_row in zip(rows, scores, idx):
+            item_scores = []
+            for s, i in zip(s_row[: query.num], i_row[: query.num]):
+                # positive-cosine semantics: excluded (-inf) and
+                # non-similar (<= 0) candidates never surface
+                if s <= 0 or s != s:
+                    continue
+                item_scores.append(ItemScore(item=inv[int(i)], score=s))
+            out.append((pos, PredictedResult(item_scores=tuple(item_scores))))
+        return out
 
     def query_class(self):
         return Query
