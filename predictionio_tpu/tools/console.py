@@ -243,6 +243,8 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--event-server-port", type=int, default=7070)
     dp.add_argument("--accesskey", default=None)
     dp.add_argument("--batch", default="")
+    dp.add_argument("--log-url", default=None,
+                    help="POST serving errors here (CreateServer --log-url)")
     dp.add_argument("--spawn", action="store_true")
 
     ud = sub.add_parser("undeploy", help="stop a running query server")
@@ -523,6 +525,8 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
             srv_argv.append("--feedback")
         if args.accesskey:
             srv_argv += ["--accesskey", args.accesskey]
+        if args.log_url:
+            srv_argv += ["--log-url", args.log_url]
         if args.spawn:
             return _spawn_detached("predictionio_tpu.tools.run_server", srv_argv)
         srv_args = run_server.build_parser().parse_args(srv_argv)

@@ -34,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-server-port", type=int, default=7070)
     p.add_argument("--accesskey", default=None)
     p.add_argument("--batch", default="")
+    p.add_argument("--log-url", default=None,
+                   help="POST serving errors here (CreateServer --log-url)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -60,6 +62,7 @@ def make_server(
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
         batch=args.batch,
+        log_url=args.log_url,
     )
     return create_query_server(engine, config, registry, block=block)
 

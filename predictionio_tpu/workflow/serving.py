@@ -83,6 +83,9 @@ class ServerConfig:
     # a 16 GB chip).
     batch_max: int = 512
     batch_wait_ms: float = 1.0
+    #: Remote error log: serving failures POST {message, query} here
+    #: (``--log-url``, ``CreateServer.scala:409-420``). None = disabled.
+    log_url: Optional[str] = None
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +245,7 @@ class _QueryHandler(JsonHTTPHandler):
             self.respond(400, {"message": str(exc)})
         except Exception as exc:
             logger.exception("Query failed")
+            self.server.post_error_log(str(exc), payload)
             self.respond(500, {"message": str(exc)})
 
     def do_GET(self) -> None:  # noqa: N802
@@ -338,6 +342,28 @@ class QueryServer(BackgroundHTTPServer):
             ) / (self.request_count + 1)
             self.request_count += 1
         return result, 200
+
+    def post_error_log(self, message: str, payload: Any) -> None:
+        """Fire-and-forget POST of a serving failure to ``log_url``
+        (``CreateServer.scala:409-420`` — remote error reporting for
+        fleet-monitored deployments). Rides the bounded feedback pool so
+        an error storm against a slow sink cannot spawn unbounded
+        threads, and never adds a failure of its own to the request."""
+        url = self.config.log_url
+        if not url:
+            return
+
+        def send() -> None:
+            try:
+                requests.post(
+                    url,
+                    json={"message": message, "query": payload},
+                    timeout=10,
+                )
+            except Exception:
+                logger.debug("error-log POST to %s failed", url, exc_info=True)
+
+        self._feedback_pool.submit(send)
 
     @staticmethod
     def _predict_one(dep: Deployment, query: Any) -> List[Any]:

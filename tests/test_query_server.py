@@ -298,3 +298,58 @@ def test_encode_result_nested():
         "inner": {"v": 3},
         "xs": [1, 2.5],
     }
+
+
+def test_error_log_posted_to_log_url(registry):
+    """Serving failures POST to --log-url (CreateServer.scala:409-420)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    received = []
+    got_one = threading.Event()
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            received.append(_json.loads(body))
+            got_one.set()
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    sink = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+
+    engine = _typed_engine()
+    _train(registry, engine, algo_ids=(11, 13))
+    srv = QueryServer(
+        ServerConfig(
+            ip="127.0.0.1", port=0,
+            log_url=f"http://127.0.0.1:{sink.server_address[1]}/log",
+        ),
+        engine, registry,
+    )
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.bound_port}"
+    try:
+        # Serving0 raises on a poison query marker → 500 → error log POST
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            srv.deployment.serving, "serve",
+            side_effect=RuntimeError("boom-for-log"),
+        ):
+            r = requests.post(f"{base}/queries.json", json={"id": 1})
+        assert r.status_code == 500
+        assert got_one.wait(timeout=10)
+        assert received[0]["message"] == "boom-for-log"
+        assert received[0]["query"] == {"id": 1}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        sink.shutdown()
+        sink.server_close()
