@@ -242,6 +242,9 @@ class _QueryHandler(JsonHTTPHandler):
             result, status = self.server.handle_query(payload)
             self.respond(status, result)
         except QueryDecodeError as exc:
+            # the reference remote-logs the bad-query branch too
+            # (CreateServer.scala:583-590)
+            self.server.post_error_log(str(exc), payload)
             self.respond(400, {"message": str(exc)})
         except Exception as exc:
             logger.exception("Query failed")
@@ -352,18 +355,34 @@ class QueryServer(BackgroundHTTPServer):
         url = self.config.log_url
         if not url:
             return
+        # engine-instance identity so a shared fleet sink can attribute
+        # the error (the reference posts {engineInstance, message},
+        # CreateServer.scala:412-414)
+        try:
+            instance_id = self.deployment.instance.id
+        except Exception:
+            instance_id = None
 
         def send() -> None:
             try:
                 requests.post(
                     url,
-                    json={"message": message, "query": payload},
+                    json={
+                        "engineInstance": instance_id,
+                        "message": message,
+                        "query": payload,
+                    },
                     timeout=10,
                 )
             except Exception:
                 logger.debug("error-log POST to %s failed", url, exc_info=True)
 
-        self._feedback_pool.submit(send)
+        try:
+            self._feedback_pool.submit(send)
+        except RuntimeError:
+            # pool already shut down (/stop racing an in-flight failure):
+            # the log post is best-effort; the response must still go out
+            logger.debug("error-log skipped: feedback pool closed")
 
     @staticmethod
     def _predict_one(dep: Deployment, query: Any) -> List[Any]:
