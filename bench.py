@@ -144,14 +144,19 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     test = rng.random(nnz) < 0.05
     tr = ~test
 
-    cfg = ALSConfig(rank=50, iterations=iterations, lambda_=0.05, seed=0)
+    solve_mode = os.environ.get("BENCH_SOLVE_MODE", "chunked")
+    cfg = ALSConfig(
+        rank=50, iterations=iterations, lambda_=0.05, seed=0,
+        solve_mode=solve_mode,
+    )
 
     # Warm the compilation cache with the REAL bucket shapes (jit keys on
     # shapes: a smaller sliver would leave the timed run paying XLA compile).
     # One warm-up iteration compiles every bucket kernel; the timed section
     # then measures steady-state bucketize + staging + training.
     warm_cfg = ALSConfig(
-        rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed
+        rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed,
+        solve_mode=solve_mode,
     )
     wu = stage(bucketize(users[tr], items[tr], ratings[tr], n_users, n_items))
     wi = stage(bucketize(items[tr], users[tr], ratings[tr], n_items, n_users))
@@ -198,6 +203,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "est_tflops_per_s": round(tflops_per_s, 2),
         "est_mfu_f32_v5e": round(mfu, 4),
         "bucket_shapes": profile.get("bucket_shapes"),
+        "solve_mode": solve_mode,
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:

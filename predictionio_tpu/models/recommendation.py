@@ -215,6 +215,8 @@ class ALSAlgorithmParams(Params):
     #: checkpoint factor tables every N iterations (0 = off); a rerun of the
     #: same workflow resumes from the newest step
     checkpoint_every: int = 0
+    #: "chunked" | "two_phase" — see ops.als.ALSConfig.solve_mode
+    solve_mode: str = "chunked"
 
 
 @dataclasses.dataclass
@@ -253,6 +255,7 @@ class ALSAlgorithm(Algorithm):
             seed=p.seed,
             implicit_prefs=p.implicit_prefs,
             alpha=p.alpha,
+            solve_mode=p.solve_mode,
         )
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None

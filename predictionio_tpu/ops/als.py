@@ -290,13 +290,18 @@ class ALSConfig:
     implicit_prefs: bool = False
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 0
+    #: "chunked" (default) fuses each block's Cholesky into the chunk map;
+    #: "two_phase" batches one Cholesky per bucket — far less sequential
+    #: solve depth at ~1 GB extra peak HBM on ML-20M (see
+    #: _solve_side_traced). Identical results up to float reassociation.
+    solve_mode: str = "chunked"
 
 
 # ---------------------------------------------------------------------------
 # Device kernels
 # ---------------------------------------------------------------------------
-def _solve_block_explicit_body(y, idx, val, mask, lam, rank):
-    """Explicit normal-equation solve for one row block (traceable body).
+def _system_explicit(y, idx, val, mask, lam, rank):
+    """Normal equations for one row block (traceable body).
 
     y: [N, R] opposite factors; idx/val/mask: [B, K].
     A_u = Gᵀ G + λ n_u I,  b_u = Gᵀ r_u   (G = masked gathered factors)
@@ -307,12 +312,12 @@ def _solve_block_explicit_body(y, idx, val, mask, lam, rank):
     n_u = mask.sum(axis=1)  # [B]
     a = a + (lam * n_u)[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
     b = jnp.einsum("bkr,bk->br", g, val, preferred_element_type=jnp.float32)
-    chol = jax.scipy.linalg.cho_factor(a, lower=True)
-    return jax.scipy.linalg.cho_solve(chol, b)
+    return a, b
 
 
-def _solve_block_implicit_body(y, yty, idx, val, mask, lam, alpha, rank):
-    """Implicit-feedback solve (Hu-Koren-Volinsky, MLlib semantics).
+def _system_implicit(y, yty, idx, val, mask, lam, alpha, rank):
+    """Implicit-feedback normal equations (Hu-Koren-Volinsky, MLlib
+    semantics).
 
     A_u = YᵀY + Σ_observed (c-1) y yᵀ + λ n_u I,  b_u = Σ_observed c·p·y
     with confidence c = 1 + α·|r| and preference p = 1[r > 0] (MLlib's
@@ -330,13 +335,12 @@ def _solve_block_implicit_body(y, yty, idx, val, mask, lam, alpha, rank):
     b = jnp.einsum(
         "bkr,bk->br", g, (1.0 + c_minus_1) * pref, preferred_element_type=jnp.float32
     )
+    return a, b
+
+
+def _cho_solve(a, b):
     chol = jax.scipy.linalg.cho_factor(a, lower=True)
     return jax.scipy.linalg.cho_solve(chol, b)
-
-
-_solve_block_explicit = functools.partial(jax.jit, static_argnames=("rank",))(
-    _solve_block_explicit_body
-)
 
 
 @dataclasses.dataclass
@@ -472,8 +476,23 @@ def _bucket_tensors(side: StagedMatrix):
     return tuple((b.rows, b.idx, b.val, b.counts) for b in side.buckets)
 
 
-def _solve_side_traced(y, buckets, n_rows, rank, implicit, lam, alpha, yty):
-    """Unrolled bucket loop inside a traced program (no per-bucket dispatch)."""
+def _solve_side_traced(
+    y, buckets, n_rows, rank, implicit, lam, alpha, yty,
+    solve_mode="chunked",
+):
+    """Unrolled bucket loop inside a traced program (no per-bucket dispatch).
+
+    ``solve_mode``:
+
+    * ``"chunked"`` — each lax.map step builds one block's normal
+      equations AND Cholesky-solves it. Minimal live memory, but the
+      sequential depth is (chunks × Cholesky's ~R-step loop).
+    * ``"two_phase"`` — the lax.map only builds A/b per chunk (the
+      memory-bounded gather stays chunked); ONE batched Cholesky then
+      solves the whole bucket, cutting sequential solve depth from
+      O(chunks × R) to O(R) per bucket at the cost of materializing
+      A [C·B, R, R] (≈1 GB for ML-20M's largest bucket at rank 50).
+    """
     x = jnp.zeros((n_rows, rank), dtype=jnp.float32)
 
     def expand_mask(idx_blk, counts_blk):
@@ -484,31 +503,32 @@ def _solve_side_traced(y, buckets, n_rows, rank, implicit, lam, alpha, yty):
             jnp.arange(k, dtype=jnp.int32)[None, :] < counts_blk[:, None]
         ).astype(jnp.float32)
 
+    def system(c):
+        mask = expand_mask(c[0], c[2])
+        if implicit:
+            return _system_implicit(
+                y, yty, c[0], c[1], mask, lam, alpha, rank
+            )
+        return _system_explicit(y, c[0], c[1], mask, lam, rank)
+
     for rows, idx, val, counts in buckets:
         if idx.dtype != jnp.int32:
             idx = idx.astype(jnp.int32)  # uint16 transfer packing
-        if implicit:
-            solved = jax.lax.map(
-                lambda c: _solve_block_implicit_body(
-                    y, yty, c[0], c[1], expand_mask(c[0], c[2]), lam, alpha,
-                    rank
-                ),
-                (idx, val, counts),
+        if solve_mode == "two_phase":
+            a, b = jax.lax.map(system, (idx, val, counts))
+            solved = _cho_solve(
+                a.reshape(-1, rank, rank), b.reshape(-1, rank)
             )
         else:
-            solved = jax.lax.map(
-                lambda c: _solve_block_explicit_body(
-                    y, c[0], c[1], expand_mask(c[0], c[2]), lam, rank
-                ),
-                (idx, val, counts),
-            )
+            solved = jax.lax.map(lambda c: _cho_solve(*system(c)),
+                                 (idx, val, counts))
         x = x.at[rows.reshape(-1)].set(solved.reshape(-1, rank), mode="drop")
     return x
 
 
 def _als_iteration_body(
     user_buckets, item_buckets, y, lam, alpha,
-    rank, implicit, n_users, n_items,
+    rank, implicit, n_users, n_items, solve_mode="chunked",
 ):
     """One full ALS iteration (user solve + item solve, all buckets) as a
     single device program — one dispatch per iteration. ``lam``/``alpha``
@@ -523,7 +543,8 @@ def _als_iteration_body(
         else None
     )
     x = _solve_side_traced(
-        y, user_buckets, n_users, rank, implicit, lam, alpha, yty
+        y, user_buckets, n_users, rank, implicit, lam, alpha, yty,
+        solve_mode=solve_mode,
     )
     xtx = (
         jnp.einsum("nr,ns->rs", x, x, preferred_element_type=jnp.float32)
@@ -531,14 +552,15 @@ def _als_iteration_body(
         else None
     )
     y2 = _solve_side_traced(
-        x, item_buckets, n_items, rank, implicit, lam, alpha, xtx
+        x, item_buckets, n_items, rank, implicit, lam, alpha, xtx,
+        solve_mode=solve_mode,
     )
     return x, y2
 
 
 _als_iteration = functools.partial(
     jax.jit,
-    static_argnames=("rank", "implicit", "n_users", "n_items"),
+    static_argnames=("rank", "implicit", "n_users", "n_items", "solve_mode"),
 )(_als_iteration_body)
 
 
@@ -549,7 +571,7 @@ def _als_iteration_sharded(out_sharding):
     compilation."""
     return jax.jit(
         _als_iteration_body,
-        static_argnames=("rank", "implicit", "n_users", "n_items"),
+        static_argnames=("rank", "implicit", "n_users", "n_items", "solve_mode"),
         out_shardings=(out_sharding, out_sharding),
     )
 
@@ -592,6 +614,11 @@ def als_train(
 
     if cfg.iterations < 1:
         raise ValueError(f"ALS iterations must be >= 1, got {cfg.iterations}")
+    if cfg.solve_mode not in ("chunked", "two_phase"):
+        raise ValueError(
+            f"solve_mode must be 'chunked' or 'two_phase', got "
+            f"{cfg.solve_mode!r}"
+        )
     rank = cfg.rank
 
     iteration = _als_iteration
@@ -693,6 +720,7 @@ def als_train(
             implicit=cfg.implicit_prefs,
             n_users=by_user.n_rows,
             n_items=by_item.n_rows,
+            solve_mode=cfg.solve_mode,
         )
         if profile is not None:
             jax.block_until_ready((x, y))

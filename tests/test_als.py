@@ -202,3 +202,49 @@ class TestScoring:
         s = standardize(np.array([1.0, 2.0, 3.0], dtype=np.float32))
         np.testing.assert_allclose(np.asarray(s).mean(), 0.0, atol=1e-6)
         np.testing.assert_allclose(np.asarray(s).std(), 1.0, atol=1e-5)
+
+
+class TestSolveModes:
+    """"two_phase" (one batched Cholesky per bucket) must reproduce the
+    default chunked solve to float tolerance, explicit and implicit."""
+
+    def _data(self):
+        rng = np.random.default_rng(5)
+        nnz, n_u, n_i = 30_000, 900, 250
+        w = 1.0 / np.arange(1, n_u + 1) ** 0.8
+        u = rng.choice(n_u, size=nnz, p=w / w.sum()).astype(np.int32)
+        i = rng.integers(0, n_i, nnz).astype(np.int32)
+        v = rng.integers(1, 6, nnz).astype(np.float32)
+        return u, i, v, n_u, n_i
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_two_phase_matches_chunked(self, implicit):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        u, i, v, n_u, n_i = self._data()
+        out = {}
+        for mode in ("chunked", "two_phase"):
+            cfg = ALSConfig(
+                rank=12, iterations=4, lambda_=0.05,
+                implicit_prefs=implicit, alpha=1.0, seed=2,
+                solve_mode=mode,
+            )
+            f = als_train_coo(u, i, v, n_users=n_u, n_items=n_i, cfg=cfg)
+            out[mode] = (
+                np.asarray(f.user_factors), np.asarray(f.item_factors)
+            )
+        np.testing.assert_allclose(
+            out["chunked"][0], out["two_phase"][0], rtol=2e-3, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            out["chunked"][1], out["two_phase"][1], rtol=2e-3, atol=2e-4
+        )
+
+    def test_unknown_mode_fails_loudly(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        u, i, v, n_u, n_i = self._data()
+        cfg = ALSConfig(rank=4, iterations=1, solve_mode="bogus")
+        # unknown mode silently behaving like "chunked" would hide typos
+        with pytest.raises(ValueError, match="solve_mode"):
+            als_train_coo(u, i, v, n_users=n_u, n_items=n_i, cfg=cfg)
