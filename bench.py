@@ -144,7 +144,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     test = rng.random(nnz) < 0.05
     tr = ~test
 
-    solve_mode = os.environ.get("BENCH_SOLVE_MODE", "chunked")
+    solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")
     cfg = ALSConfig(
         rank=50, iterations=iterations, lambda_=0.05, seed=0,
         solve_mode=solve_mode,
@@ -203,7 +203,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "est_tflops_per_s": round(tflops_per_s, 2),
         "est_mfu_f32_v5e": round(mfu, 4),
         "bucket_shapes": profile.get("bucket_shapes"),
-        "solve_mode": solve_mode,
+        "solve_mode": profile.get("solve_mode", solve_mode),
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:

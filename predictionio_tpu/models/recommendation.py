@@ -215,8 +215,10 @@ class ALSAlgorithmParams(Params):
     #: checkpoint factor tables every N iterations (0 = off); a rerun of the
     #: same workflow resumes from the newest step
     checkpoint_every: int = 0
-    #: "chunked" | "two_phase" — see ops.als.ALSConfig.solve_mode
-    solve_mode: str = "chunked"
+    #: "auto" | "chunked" | "two_phase" | "pallas" — see
+    #: ops.als.ALSConfig.solve_mode ("auto" picks the fused pallas
+    #: Cholesky kernel on a single-chip TPU run, "chunked" elsewhere)
+    solve_mode: str = "auto"
 
 
 @dataclasses.dataclass

@@ -234,6 +234,110 @@ def top_k_streaming(
     return scores, idx
 
 
+# ---------------------------------------------------------------------------
+# Batched SPD solve (the ALS normal-equation hot op)
+# ---------------------------------------------------------------------------
+#
+# XLA's batched Cholesky lowering runs at ~10 GFLOP/s on TPU for the [B, 50,
+# 50] systems ALS produces (measured: ~6.7 µs per matrix — it was ~2/3 of the
+# ALS iteration). This kernel fuses factorization + both triangular solves
+# into one VMEM-resident pass in a transposed [n, n, B] layout: the batch
+# rides the 128-wide lane dimension (full vector-register utilization), and
+# extracting column j of every matrix is a cheap dim-0 slice instead of a
+# masked reduction. Measured marginal cost ~0.24 µs per matrix (~25×).
+#
+# Algorithm (right-looking Cholesky, one fused FMA pass per step):
+#   step j: colj = a[j]            (trailing block is symmetric)
+#           lj   = colj / sqrt(a[j,j])
+#           a   -= (lj - e_j) ⊗ lj (trailing update + stores L's column j
+#                                    into row j of `a`, which the update has
+#                                    just zeroed)
+# Forward substitution interleaves with factorization (z_j available as soon
+# as column j is); back substitution replays the stored rows in reverse.
+# Zero-padding (rank → n multiple of 8, and all-zero padding matrices from
+# bucket padding) flows through inv_d = where(d>0, 1/d, 0): padded outputs
+# are exactly 0, no NaNs.
+
+#: lane-block of matrices per grid step; VMEM scratch is n*n*blk*4 bytes.
+_SPD_BLK = 128
+
+
+def _spd_kernel(a_ref, b_ref, x_ref, a_s, y_s, *, n: int):
+    a_s[...] = a_ref[...]
+    y_s[...] = b_ref[...]
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+
+    def fwd(j, _):
+        colj = a_s[j]  # [n, blk] — column j of the trailing block
+        ej = (row_iota == j).astype(jnp.float32)  # [n, 1]
+        d2 = jnp.sum(colj * ej, axis=0)  # [blk] — diagonal entry
+        inv_d = jnp.where(d2 > 0, jax.lax.rsqrt(d2), 0.0)
+        lj = colj * inv_d[None, :]  # column j of L (diag value at row j)
+        ljm = lj - ej  # (d - 1) at row j → the update stores lj into row j
+        a_s[...] = a_s[...] - ljm[:, None, :] * lj[None, :, :]
+        zj = jnp.sum(y_s[...] * ej, axis=0) * inv_d  # [blk]
+        y_s[...] = y_s[...] - ljm * zj[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, n, fwd, 0)
+    x_ref[...] = jnp.zeros_like(x_ref)
+
+    def bwd(jj, _):
+        j = n - 1 - jj
+        lrow = a_s[j]  # row j now holds L[:, j]
+        ej = (row_iota == j).astype(jnp.float32)
+        d = jnp.sum(lrow * ej, axis=0)
+        inv_d = jnp.where(d > 0, 1.0 / d, 0.0)
+        dot = jnp.sum(lrow * x_ref[...], axis=0)  # x[j] still 0 here
+        zj = jnp.sum(y_s[...] * ej, axis=0)
+        x_ref[...] = x_ref[...] + ej * ((zj - dot) * inv_d)[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, n, bwd, 0)
+
+
+def spd_solve_t(
+    a_t: jax.Array,  # [n, n, B] float32 — SPD systems, batch on lanes
+    b_t: jax.Array,  # [n, B] float32
+    interpret: Optional[bool] = None,
+) -> jax.Array:  # [n, B] float32
+    """Fused batched Cholesky solve in transposed layout.
+
+    Requires ``n % 8 == 0`` and ``B % 128 == 0`` (callers pad; zero-padding
+    solves to exactly 0). Falls back to ``cho_solve`` when pallas is
+    unavailable. ``interpret=None`` auto-selects interpreter off-TPU.
+    """
+    n, n2, bsz = a_t.shape
+    if n != n2 or n % 8 != 0 or bsz % _SPD_BLK != 0:
+        raise ValueError(f"spd_solve_t: bad shapes {a_t.shape}")
+    if not _HAVE_PALLAS:
+        a = jnp.moveaxis(a_t, -1, 0)  # [B, n, n]
+        # zero-padding guard: cho_factor of a zero matrix NaNs, so ridge the
+        # padded systems with I (their rhs is 0 ⇒ solution stays 0)
+        zero = jnp.trace(a, axis1=-2, axis2=-1) == 0
+        a = a + zero[:, None, None] * jnp.eye(n, dtype=a.dtype)
+        chol = jax.scipy.linalg.cho_factor(a, lower=True)
+        x = jax.scipy.linalg.cho_solve(chol, b_t.T)
+        return x.T
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        functools.partial(_spd_kernel, n=n),
+        grid=(bsz // _SPD_BLK,),
+        in_specs=[
+            pl.BlockSpec((n, n, _SPD_BLK), lambda i: (0, 0, i)),
+            pl.BlockSpec((n, _SPD_BLK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((n, _SPD_BLK), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n, bsz), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((n, n, _SPD_BLK), jnp.float32),
+            pltpu.VMEM((n, _SPD_BLK), jnp.float32),
+        ],
+        interpret=interpret,
+    )(a_t, b_t)
+
+
 def top_k_for_users_streaming(
     user_factors: jax.Array,
     item_factors: jax.Array,

@@ -218,12 +218,12 @@ class TestSolveModes:
         return u, i, v, n_u, n_i
 
     @pytest.mark.parametrize("implicit", [False, True])
-    def test_two_phase_matches_chunked(self, implicit):
+    def test_alternate_modes_match_chunked(self, implicit):
         from predictionio_tpu.ops.als import ALSConfig, als_train_coo
 
         u, i, v, n_u, n_i = self._data()
         out = {}
-        for mode in ("chunked", "two_phase"):
+        for mode in ("chunked", "two_phase", "pallas"):
             cfg = ALSConfig(
                 rank=12, iterations=4, lambda_=0.05,
                 implicit_prefs=implicit, alpha=1.0, seed=2,
@@ -233,12 +233,13 @@ class TestSolveModes:
             out[mode] = (
                 np.asarray(f.user_factors), np.asarray(f.item_factors)
             )
-        np.testing.assert_allclose(
-            out["chunked"][0], out["two_phase"][0], rtol=2e-3, atol=2e-4
-        )
-        np.testing.assert_allclose(
-            out["chunked"][1], out["two_phase"][1], rtol=2e-3, atol=2e-4
-        )
+        for mode in ("two_phase", "pallas"):
+            np.testing.assert_allclose(
+                out["chunked"][0], out[mode][0], rtol=2e-3, atol=2e-4
+            )
+            np.testing.assert_allclose(
+                out["chunked"][1], out[mode][1], rtol=2e-3, atol=2e-4
+            )
 
     def test_unknown_mode_fails_loudly(self):
         from predictionio_tpu.ops.als import ALSConfig, als_train_coo
@@ -248,3 +249,32 @@ class TestSolveModes:
         # unknown mode silently behaving like "chunked" would hide typos
         with pytest.raises(ValueError, match="solve_mode"):
             als_train_coo(u, i, v, n_users=n_u, n_items=n_i, cfg=cfg)
+
+
+class TestPallasModeGuards:
+    """Explicit solve_mode="pallas" outside the kernel's envelope must fail
+    loudly (the kernel neither partitions under pjit nor fits VMEM at high
+    rank) — "auto" silently falls back instead."""
+
+    def test_pallas_rejects_mesh(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+        from predictionio_tpu.parallel.mesh import create_mesh
+
+        u = np.array([0, 1, 2], dtype=np.int32)
+        i = np.array([0, 1, 0], dtype=np.int32)
+        v = np.ones(3, dtype=np.float32)
+        cfg = ALSConfig(rank=4, iterations=1, solve_mode="pallas")
+        with pytest.raises(ValueError, match="mesh-distributed"):
+            als_train_coo(
+                u, i, v, n_users=3, n_items=2, cfg=cfg, mesh=create_mesh()
+            )
+
+    def test_pallas_rejects_high_rank(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        u = np.array([0, 1, 2], dtype=np.int32)
+        i = np.array([0, 1, 0], dtype=np.int32)
+        v = np.ones(3, dtype=np.float32)
+        cfg = ALSConfig(rank=88, iterations=1, solve_mode="pallas")
+        with pytest.raises(ValueError, match="rank"):
+            als_train_coo(u, i, v, n_users=3, n_items=2, cfg=cfg)

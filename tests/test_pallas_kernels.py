@@ -137,3 +137,63 @@ def test_wide_exclusion_list():
     )
     for row in range(b):
         assert not set(np.asarray(i)[row]).intersection(set(np.asarray(i0)[row]))
+
+
+# ---------------------------------------------------------------------------
+# spd_solve_t — fused batched Cholesky solve
+# ---------------------------------------------------------------------------
+class TestSpdSolve:
+    def _systems(self, bsz, r, k, seed=0, lam=0.05):
+        rng = np.random.default_rng(seed)
+        g = rng.standard_normal((bsz, k, r)).astype(np.float32)
+        a = np.einsum("bkr,bks->brs", g, g) + lam * k * np.eye(
+            r, dtype=np.float32
+        )
+        b = rng.standard_normal((bsz, r)).astype(np.float32)
+        return a, b
+
+    def _to_t(self, a, b, n):
+        bsz, r = b.shape
+        a_t = np.zeros((n, n, bsz), np.float32)
+        a_t[:r, :r] = np.transpose(a, (1, 2, 0))
+        b_t = np.zeros((n, bsz), np.float32)
+        b_t[:r] = b.T
+        return jnp.asarray(a_t), jnp.asarray(b_t)
+
+    @pytest.mark.parametrize("r,n", [(4, 8), (50, 56), (13, 16)])
+    def test_matches_cho_solve(self, r, n):
+        from predictionio_tpu.ops.pallas_kernels import spd_solve_t
+
+        bsz = 128
+        a, b = self._systems(bsz, r, k=32)
+        ref = np.linalg.solve(a, b[..., None])[..., 0]
+        a_t, b_t = self._to_t(a, b, n)
+        x = np.asarray(spd_solve_t(a_t, b_t))[:r].T
+        rel = np.linalg.norm(x - ref, axis=-1) / (
+            np.linalg.norm(ref, axis=-1) + 1e-9
+        )
+        assert np.max(rel) < 1e-4
+
+    def test_zero_padded_systems_solve_to_zero(self):
+        """Bucket-padding rows are all-zero systems; the inv_d guard must
+        produce exact zeros (NaNs would poison the factor scatter)."""
+        from predictionio_tpu.ops.pallas_kernels import spd_solve_t
+
+        bsz, r, n = 128, 8, 8
+        a, b = self._systems(64, r, k=16)
+        a_t, b_t = self._to_t(a, b, n)
+        a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, bsz - 64)))
+        b_t = jnp.pad(b_t, ((0, 0), (0, bsz - 64)), constant_values=1.0)
+        x = np.asarray(spd_solve_t(a_t, b_t))
+        assert np.all(np.isfinite(x))
+        np.testing.assert_array_equal(x[:, 64:], 0.0)
+        ref = np.linalg.solve(a, b[..., None])[..., 0]
+        np.testing.assert_allclose(x[:r, :64].T, ref, rtol=1e-3, atol=1e-4)
+
+    def test_shape_validation(self):
+        from predictionio_tpu.ops.pallas_kernels import spd_solve_t
+
+        with pytest.raises(ValueError, match="spd_solve_t"):
+            spd_solve_t(jnp.zeros((7, 7, 128)), jnp.zeros((7, 128)))
+        with pytest.raises(ValueError, match="spd_solve_t"):
+            spd_solve_t(jnp.zeros((8, 8, 100)), jnp.zeros((8, 100)))
