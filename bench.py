@@ -158,8 +158,10 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed,
         solve_mode=solve_mode,
     )
-    wu = stage(bucketize(users[tr], items[tr], ratings[tr], n_users, n_items))
-    wi = stage(bucketize(items[tr], users[tr], ratings[tr], n_items, n_users))
+    wu = stage(bucketize(users[tr], items[tr], ratings[tr], n_users,
+                         n_items, pad_to_blocks=True))
+    wi = stage(bucketize(items[tr], users[tr], ratings[tr], n_items,
+                         n_users, pad_to_blocks=True))
     np.asarray(als_train(wu, wi, warm_cfg).user_factors)
     del wu, wi
 
@@ -167,10 +169,12 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     t0 = time.time()
     t_b = time.monotonic()
     by_user = stage(
-        bucketize(users[tr], items[tr], ratings[tr], n_users, n_items)
+        bucketize(users[tr], items[tr], ratings[tr], n_users, n_items,
+                  pad_to_blocks=True)
     )
     by_item = stage(
-        bucketize(items[tr], users[tr], ratings[tr], n_items, n_users)
+        bucketize(items[tr], users[tr], ratings[tr], n_items, n_users,
+                  pad_to_blocks=True)
     )
     bucketize_stage_s = time.monotonic() - t_b
     factors = als_train(by_user, by_item, cfg, profile=profile)

@@ -13,7 +13,12 @@
 //   pass B: scatter cols/vals straight into the caller-allocated padded
 //           bucket slabs; elements beyond a row's bucket width are dropped
 //           (same truncation rule as the numpy path)
-//   pass C: mask fill (1.0 for the first min(count, width) slots per row)
+//
+// The validity mask is NOT materialized here: it is a pure function of the
+// per-row count (prefix-form by construction), which the Python side keeps
+// as a [B] int32 array and the device solve re-expands for free. Column
+// indices write as uint16 when the opposite-side id space fits (halves the
+// largest slab's bytes both in host fill and host->device transfer).
 //
 // The numpy path costs an O(nnz log nnz) argsort; this is O(nnz) with
 // sequential writes per thread in pass A and per-row locality in pass B.
@@ -29,6 +34,26 @@
 #include <vector>
 
 namespace {
+
+// Pass-B scatter body, instantiated per idx element type. `base` carries
+// the per-(thread,row) write offsets computed by the histogram prefix.
+template <class IdxT>
+void scatter_range(const int32_t* rows, const int32_t* cols,
+                   const float* vals, int64_t lo, int64_t hi,
+                   std::vector<int32_t>& base, const int32_t* bucket_of,
+                   const int32_t* slot_of, const int32_t* widths,
+                   void** idx_ptrs, float** val_ptrs) {
+  for (int64_t k = lo; k < hi; ++k) {
+    const int32_t r = rows[k];
+    const int32_t w = base[static_cast<size_t>(r)]++;
+    const int32_t b = bucket_of[r];
+    const int32_t width = widths[b];
+    if (w >= width) continue;  // truncated tail of an over-wide row
+    const int64_t off = static_cast<int64_t>(slot_of[r]) * width + w;
+    static_cast<IdxT*>(idx_ptrs[b])[off] = static_cast<IdxT>(cols[k]);
+    val_ptrs[b][off] = vals[k];
+  }
+}
 
 int hardware_threads(int64_t n_rows) {
   unsigned n = std::thread::hardware_concurrency();
@@ -52,17 +77,17 @@ extern "C" {
 // bucket_of: [n_rows] int32 -- bucket index per row id (every row with
 //   degree > 0 has one; rows absent from the data never appear in `rows`).
 // slot_of: [n_rows] int32 -- row's position within its bucket.
-// counts: [n_rows] int32 -- row degree (uncapped).
 // widths: [n_buckets] int32.
-// idx_ptrs/val_ptrs/mask_ptrs: [n_buckets] pointers to zero-initialized
-//   slabs of shape [B_b * widths[b]] (int32 / float32 / float32).
+// idx_ptrs/val_ptrs: [n_buckets] pointers to zero-initialized slabs of
+//   shape [B_b * widths[b]] (uint16 when idx_u16 else int32 / float32).
+// idx_u16: nonzero when column ids fit uint16 and the idx slabs are
+//   uint16 (caller guarantees max col id <= 0xFFFF).
 // Returns 0 on success.
 int pio_bucketize_fill(const int32_t* rows, const int32_t* cols,
                        const float* vals, int64_t nnz, int64_t n_rows,
                        const int32_t* bucket_of, const int32_t* slot_of,
-                       const int32_t* counts, const int32_t* widths,
-                       int32_t n_buckets, int32_t** idx_ptrs,
-                       float** val_ptrs, float** mask_ptrs) {
+                       const int32_t* widths, int32_t n_buckets,
+                       void** idx_ptrs, float** val_ptrs, int32_t idx_u16) {
   (void)n_buckets;
   const int nthreads = hardware_threads(n_rows);
   const int64_t chunk = (nnz + nthreads - 1) / nthreads;
@@ -104,41 +129,14 @@ int pio_bucketize_fill(const int32_t* rows, const int32_t* cols,
         auto& base = hist[static_cast<size_t>(t)];
         const int64_t lo = t * chunk;
         const int64_t hi = std::min<int64_t>(nnz, lo + chunk);
-        for (int64_t k = lo; k < hi; ++k) {
-          const int32_t r = rows[k];
-          const int32_t w = base[static_cast<size_t>(r)]++;
-          const int32_t b = bucket_of[r];
-          const int32_t width = widths[b];
-          if (w >= width) continue;  // truncated tail of an over-wide row
-          const int64_t off =
-              static_cast<int64_t>(slot_of[r]) * width + w;
-          idx_ptrs[b][off] = cols[k];
-          val_ptrs[b][off] = vals[k];
-        }
-      });
-    }
-    for (auto& th : ts) th.join();
-  }
-
-  // pass C: mask fill, parallel over row ids (each row owns a disjoint
-  // mask segment)
-  {
-    std::vector<std::thread> ts;
-    ts.reserve(static_cast<size_t>(nthreads));
-    const int64_t rchunk = (n_rows + nthreads - 1) / nthreads;
-    for (int t = 0; t < nthreads; ++t) {
-      ts.emplace_back([&, t]() {
-        const int64_t lo = t * rchunk;
-        const int64_t hi = std::min<int64_t>(n_rows, lo + rchunk);
-        for (int64_t r = lo; r < hi; ++r) {
-          const int32_t c = counts[r];
-          if (c == 0) continue;
-          const int32_t b = bucket_of[r];
-          const int32_t width = widths[b];
-          const int32_t fill = c < width ? c : width;
-          float* m = mask_ptrs[b] +
-                     static_cast<int64_t>(slot_of[r]) * width;
-          for (int32_t j = 0; j < fill; ++j) m[j] = 1.0f;
+        if (idx_u16) {
+          scatter_range<uint16_t>(rows, cols, vals, lo, hi, base,
+                                  bucket_of, slot_of, widths, idx_ptrs,
+                                  val_ptrs);
+        } else {
+          scatter_range<int32_t>(rows, cols, vals, lo, hi, base,
+                                 bucket_of, slot_of, widths, idx_ptrs,
+                                 val_ptrs);
         }
       });
     }

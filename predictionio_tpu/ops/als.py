@@ -47,16 +47,30 @@ _BLOCK_ROWS = {8: 16384, 32: 8192, 128: 4096, 512: 1024, 2048: 256, 8192: 64, 32
 @dataclasses.dataclass
 class Bucket:
     """One padded degree bucket: ``rows[i]`` has its ratings in
-    ``idx/val[i, :len_i]`` with ``mask[i, :len_i] = 1``."""
+    ``idx/val[i, :counts[i]]``.
+
+    When built with ``pad_to_blocks=True`` the bucket additionally carries
+    whole padding rows (``rows == n_rows`` sentinel, ``counts == 0``) so
+    :func:`stage` can ship the slabs without re-padding copies.
+    """
 
     rows: np.ndarray  # [B] int32 — row ids in the full matrix
-    idx: np.ndarray  # [B, K] int32 — column indices (0-padded)
+    idx: np.ndarray  # [B, K] int32/uint16 — column indices (0-padded)
     val: np.ndarray  # [B, K] float32 — ratings (0-padded)
-    mask: np.ndarray  # [B, K] float32 — 1 where a rating exists
+    counts: np.ndarray  # [B] int32 — valid entries per row (<= K)
 
     @property
     def width(self) -> int:
         return self.idx.shape[1]
+
+    @property
+    def mask(self) -> np.ndarray:
+        """[B, K] float32 validity mask, derived on demand — ratings are
+        prefix-packed, so the mask is a pure function of ``counts``."""
+        return (
+            np.arange(self.width, dtype=np.int32)[None, :]
+            < self.counts[:, None]
+        ).astype(np.float32)
 
 
 @dataclasses.dataclass
@@ -76,12 +90,18 @@ def bucketize(
     n_rows: int,
     n_cols: int,
     bucket_widths: Sequence[int] = DEFAULT_BUCKET_WIDTHS,
+    pad_to_blocks: bool = False,
 ) -> BucketedMatrix:
     """COO → degree-bucketed padded CSR.
 
     Rows with degree above the largest width are truncated to it (keeping
     the first ratings in input order) — with the default widths this only
     triggers beyond 32768 ratings per row.
+
+    ``pad_to_blocks=True`` allocates each bucket's slabs rounded up to the
+    device chunk size (``_BLOCK_ROWS``) with sentinel padding rows, so
+    :func:`stage` ships them zero-copy — the training fast path. Column
+    indices are uint16 whenever ``n_cols`` fits (half the transfer bytes).
 
     Dispatches to the native (C++ threaded O(nnz) scatter,
     ``native/bucketize.cc``) or the numpy (argsort-based) implementation;
@@ -106,7 +126,8 @@ def bucketize(
 
         try:
             return _bucketize_native(
-                rows, cols, vals, n_rows, n_cols, bucket_widths
+                rows, cols, vals, n_rows, n_cols, bucket_widths,
+                pad_to_blocks,
             )
         except NativeBuildError as exc:
             # Toolchain-less host: numpy is full parity. Cache the verdict
@@ -119,11 +140,30 @@ def bucketize(
                 "native bucketize unavailable, using numpy path: %s", exc
             )
             _NATIVE_BUCKETIZE_BROKEN = True
-    return _bucketize_numpy(rows, cols, vals, n_rows, n_cols, bucket_widths)
+    return _bucketize_numpy(
+        rows, cols, vals, n_rows, n_cols, bucket_widths, pad_to_blocks
+    )
 
 
 #: Set after the first failed native-bucketize build (per process).
 _NATIVE_BUCKETIZE_BROKEN = False
+
+
+def _alloc_rows(sel, counts_clip, n_rows, width, pad_to_blocks):
+    """Rows/counts arrays for one bucket, optionally rounded up to the
+    device chunk size with (n_rows, 0) sentinel padding rows. Empty
+    buckets stay empty (they are dropped later; padding them would zero a
+    whole block-sized slab for nothing)."""
+    b = len(sel)
+    if not pad_to_blocks or b == 0:
+        return sel, counts_clip, b
+    block = _block_rows_for(int(width))
+    b_alloc = -(-b // block) * block
+    rows_arr = np.full(b_alloc, n_rows, dtype=np.int32)
+    rows_arr[:b] = sel
+    cnt = np.zeros(b_alloc, dtype=np.int32)
+    cnt[:b] = counts_clip
+    return rows_arr, cnt, b_alloc
 
 
 def _bucketize_native(
@@ -133,6 +173,7 @@ def _bucketize_native(
     n_rows: int,
     n_cols: int,
     bucket_widths: Sequence[int] = DEFAULT_BUCKET_WIDTHS,
+    pad_to_blocks: bool = False,
 ) -> BucketedMatrix:
     """Threaded two-pass scatter (no sort): numpy computes the O(n_rows)
     bucket/slot assignment, C++ fills the padded slabs deterministically."""
@@ -146,6 +187,7 @@ def _bucketize_native(
     nnz = len(rows)
     widths = np.asarray(sorted(bucket_widths), dtype=np.int32)
     max_w = int(widths[-1])
+    idx_dtype = np.uint16 if n_cols <= 0xFFFF else np.int32
     counts = np.bincount(rows, minlength=n_rows).astype(np.int32)
     present = np.nonzero(counts)[0].astype(np.int32)  # ascending row ids
     assignment = np.searchsorted(
@@ -154,29 +196,31 @@ def _bucketize_native(
 
     bucket_of = np.zeros(n_rows, dtype=np.int32)
     slot_of = np.zeros(n_rows, dtype=np.int32)
-    slabs = []  # (sel, idx, val, mask) per width, empty buckets included
+    slabs = []  # (sel, counts, b_alloc, idx, val) per width, empties too
     for wi, width in enumerate(widths):
         sel = present[assignment == wi]
         bucket_of[sel] = wi
         slot_of[sel] = np.arange(len(sel), dtype=np.int32)
-        b = len(sel)
+        cnt = np.minimum(counts[sel], int(width)).astype(np.int32)
+        rows_arr, cnt, b_alloc = _alloc_rows(
+            sel, cnt, n_rows, width, pad_to_blocks
+        )
         slabs.append(
             (
-                sel,
-                np.zeros(b * width, dtype=np.int32),
-                np.zeros(b * width, dtype=np.float32),
-                np.zeros(b * width, dtype=np.float32),
+                rows_arr,
+                cnt,
+                np.zeros(b_alloc * width, dtype=idx_dtype),
+                np.zeros(b_alloc * width, dtype=np.float32),
+                len(sel),
             )
         )
 
     i32p, f32p = ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)
-    idx_ptrs = (i32p * len(widths))(
-        *[s[1].ctypes.data_as(i32p) for s in slabs]
+    voidp = ctypes.c_void_p
+    idx_ptrs = (voidp * len(widths))(
+        *[s[2].ctypes.data_as(voidp) for s in slabs]
     )
     val_ptrs = (f32p * len(widths))(
-        *[s[2].ctypes.data_as(f32p) for s in slabs]
-    )
-    mask_ptrs = (f32p * len(widths))(
         *[s[3].ctypes.data_as(f32p) for s in slabs]
     )
     rc = lib.pio_bucketize_fill(
@@ -187,25 +231,24 @@ def _bucketize_native(
         ctypes.c_int64(n_rows),
         bucket_of.ctypes.data_as(i32p),
         slot_of.ctypes.data_as(i32p),
-        counts.ctypes.data_as(i32p),
         widths.ctypes.data_as(i32p),
         ctypes.c_int32(len(widths)),
         idx_ptrs,
         val_ptrs,
-        mask_ptrs,
+        ctypes.c_int32(1 if idx_dtype == np.uint16 else 0),
     )
     if rc != 0:
         raise RuntimeError(f"pio_bucketize_fill failed rc={rc}")
 
     buckets = [
         Bucket(
-            rows=sel,
-            idx=idx.reshape(len(sel), int(w)),
-            val=val.reshape(len(sel), int(w)),
-            mask=mask.reshape(len(sel), int(w)),
+            rows=rows_arr,
+            idx=idx.reshape(len(rows_arr), int(w)),
+            val=val.reshape(len(rows_arr), int(w)),
+            counts=cnt,
         )
-        for w, (sel, idx, val, mask) in zip(widths, slabs)
-        if len(sel)
+        for w, (rows_arr, cnt, idx, val, n_present) in zip(widths, slabs)
+        if n_present
     ]
     return BucketedMatrix(
         n_rows=n_rows, n_cols=n_cols, nnz=int(nnz), buckets=buckets
@@ -219,15 +262,17 @@ def _bucketize_numpy(
     n_rows: int,
     n_cols: int,
     bucket_widths: Sequence[int] = DEFAULT_BUCKET_WIDTHS,
+    pad_to_blocks: bool = False,
 ) -> BucketedMatrix:
     """Pure-numpy reference implementation (argsort-based).
 
     Host-bandwidth-tuned: int32 temporaries throughout (valid while nnz and
     row ids fit in 31 bits), group boundaries from a diff instead of
-    ``np.unique``, and the pad mask from a broadcast compare instead of a
-    third scatter.
+    ``np.unique``, and validity kept as per-row counts instead of a
+    materialized mask.
     """
     nnz = len(rows)
+    idx_dtype = np.uint16 if n_cols <= 0xFFFF else np.int32
     order = np.argsort(rows, kind="stable")  # radix for int keys
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     if nnz:
@@ -252,6 +297,9 @@ def _bucketize_numpy(
             continue
         b = sel.size
         c = np.minimum(counts[sel], width).astype(np.int32)
+        rows_arr, cnt, b_alloc = _alloc_rows(
+            uniq[sel].astype(np.int32), c, n_rows, width, pad_to_blocks
+        )
         total = int(c.sum())
         # within-row offsets [0..c0), [0..c1), … concatenated (vectorized)
         cum = np.cumsum(c, dtype=np.int32)
@@ -260,19 +308,16 @@ def _bucketize_numpy(
         dst = np.repeat(
             (np.arange(b, dtype=np.int64) * width).astype(np.int64), c
         ) + within
-        idx = np.zeros(b * width, dtype=np.int32)
-        val = np.zeros(b * width, dtype=np.float32)
-        idx[dst] = cols_s[src]
+        idx = np.zeros(b_alloc * width, dtype=idx_dtype)
+        val = np.zeros(b_alloc * width, dtype=np.float32)
+        idx[dst] = cols_s[src].astype(idx_dtype)
         val[dst] = vals_s[src]
-        mask = (
-            np.arange(width, dtype=np.int32)[None, :] < c[:, None]
-        ).astype(np.float32)
         buckets.append(
             Bucket(
-                rows=uniq[sel].astype(np.int32),
-                idx=idx.reshape(b, width),
-                val=val.reshape(b, width),
-                mask=mask,
+                rows=rows_arr,
+                idx=idx.reshape(b_alloc, width),
+                val=val.reshape(b_alloc, width),
+                counts=cnt,
             )
         )
     return BucketedMatrix(
@@ -390,6 +435,12 @@ def stage(
     dimension — the rows being solved — across the mesh data axis;
     ``row_multiple`` rounds the block size up so the sharded dim divides
     evenly over the axis.
+
+    Buckets built with ``bucketize(..., pad_to_blocks=True)`` are already
+    chunk-aligned with uint16 indices where applicable: this function then
+    only reshapes views and issues the async ``device_put`` — no host
+    copies (the copies were ~the whole staging wall-clock on a 1-core
+    host).
     """
     staged = []
     for bucket in side.buckets:
@@ -401,22 +452,21 @@ def stage(
         padded = n_chunks * block
         pad = padded - n
 
-        def pad2(a, fill=0):
-            return np.pad(a, ((0, pad), (0, 0)), constant_values=fill)
-
-        rows = np.pad(
-            bucket.rows, (0, pad), constant_values=side.n_rows
-        ).reshape(n_chunks, block)  # out-of-range → dropped by scatter
-        idx = pad2(bucket.idx).reshape(n_chunks, block, bucket.width)
-        if side.n_cols <= 0xFFFF:
+        rows, idx, val, counts = (
+            bucket.rows, bucket.idx, bucket.val, bucket.counts,
+        )
+        if pad:
+            # rows pad with n_rows sentinel → dropped by the mode="drop"
+            # scatter in the solve
+            rows = np.pad(rows, (0, pad), constant_values=side.n_rows)
+            idx = np.pad(idx, ((0, pad), (0, 0)))
+            val = np.pad(val, ((0, pad), (0, 0)))
+            counts = np.pad(counts, (0, pad))
+        if idx.dtype != np.uint16 and side.n_cols <= 0xFFFF:
             # column ids fit uint16: halves the largest staged tensor's
             # host→device bytes (widened back to int32 inside the traced
             # solve, where the cast fuses for free)
             idx = idx.astype(np.uint16)
-        val = pad2(bucket.val).reshape(n_chunks, block, bucket.width)
-        counts = np.pad(
-            bucket.mask.sum(axis=1).astype(np.int32), (0, pad)
-        ).reshape(n_chunks, block)
         put = (
             (lambda a: jax.device_put(a, sharding))
             if sharding is not None
@@ -424,10 +474,10 @@ def stage(
         )
         staged.append(
             _StagedBucket(
-                rows=put(rows.astype(np.int32)),
-                idx=put(idx),
-                val=put(val),
-                counts=put(counts),
+                rows=put(rows.reshape(n_chunks, block)),
+                idx=put(idx.reshape(n_chunks, block, bucket.width)),
+                val=put(val.reshape(n_chunks, block, bucket.width)),
+                counts=put(counts.reshape(n_chunks, block)),
             )
         )
     return StagedMatrix(
@@ -530,7 +580,7 @@ def _solve_side_traced(
         eye_t = jnp.eye(n_pad, dtype=jnp.float32)[:, :, None]
 
         def solve_chunk_pallas(c):
-            from .pallas_kernels import spd_solve_t
+            from .pallas_kernels import _SPD_BLK, spd_solve_t
 
             idx_blk, val_blk, counts_blk = c
             mask = expand_mask(idx_blk, counts_blk)
@@ -555,7 +605,7 @@ def _solve_side_traced(
                 "bkr,bk->rb", g, rhs, preferred_element_type=jnp.float32
             )
             bsz = idx_blk.shape[0]
-            pad_b = -bsz % 128
+            pad_b = -bsz % _SPD_BLK
             if pad_b:
                 a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, pad_b)))
                 b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
@@ -859,8 +909,12 @@ def als_train_coo(
     checkpoint_every: int = 0,
 ) -> ALSFactors:
     """Convenience: COO triplets → bucketized both ways → train."""
-    by_user = bucketize(users, items, ratings, n_users, n_items)
-    by_item = bucketize(items, users, ratings, n_items, n_users)
+    by_user = bucketize(
+        users, items, ratings, n_users, n_items, pad_to_blocks=True
+    )
+    by_item = bucketize(
+        items, users, ratings, n_items, n_users, pad_to_blocks=True
+    )
     return als_train(
         by_user, by_item, cfg, mesh=mesh, factor_sharding=factor_sharding,
         checkpoint=checkpoint, checkpoint_every=checkpoint_every,

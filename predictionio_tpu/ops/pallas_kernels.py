@@ -312,13 +312,15 @@ def spd_solve_t(
         raise ValueError(f"spd_solve_t: bad shapes {a_t.shape}")
     if not _HAVE_PALLAS:
         a = jnp.moveaxis(a_t, -1, 0)  # [B, n, n]
-        # zero-padding guard: cho_factor of a zero matrix NaNs, so ridge the
-        # padded systems with I (their rhs is 0 ⇒ solution stays 0)
+        # zero-padding guard: cho_factor of a zero matrix NaNs, so ridge
+        # the padded systems with I and zero their solutions afterwards —
+        # the kernel contract is "all-zero system ⇒ exactly-zero x"
+        # regardless of the rhs.
         zero = jnp.trace(a, axis1=-2, axis2=-1) == 0
         a = a + zero[:, None, None] * jnp.eye(n, dtype=a.dtype)
         chol = jax.scipy.linalg.cho_factor(a, lower=True)
         x = jax.scipy.linalg.cho_solve(chol, b_t.T)
-        return x.T
+        return jnp.where(zero[None, :], 0.0, x.T)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return pl.pallas_call(
