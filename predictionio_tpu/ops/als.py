@@ -149,6 +149,14 @@ def bucketize(
 _NATIVE_BUCKETIZE_BROKEN = False
 
 
+def _idx_dtype(n_cols: int):
+    """Staged column-index dtype: uint16 when the opposite-side id space
+    fits (halves the largest slab's bytes), else int32. Single source of
+    truth for bucketize (both paths), stage, and the C++ fill's
+    caller-guarantee."""
+    return np.uint16 if n_cols <= 0xFFFF else np.int32
+
+
 def _alloc_rows(sel, counts_clip, n_rows, width, pad_to_blocks):
     """Rows/counts arrays for one bucket, optionally rounded up to the
     device chunk size with (n_rows, 0) sentinel padding rows. Empty
@@ -187,7 +195,7 @@ def _bucketize_native(
     nnz = len(rows)
     widths = np.asarray(sorted(bucket_widths), dtype=np.int32)
     max_w = int(widths[-1])
-    idx_dtype = np.uint16 if n_cols <= 0xFFFF else np.int32
+    idx_dtype = _idx_dtype(n_cols)
     counts = np.bincount(rows, minlength=n_rows).astype(np.int32)
     present = np.nonzero(counts)[0].astype(np.int32)  # ascending row ids
     assignment = np.searchsorted(
@@ -272,7 +280,7 @@ def _bucketize_numpy(
     materialized mask.
     """
     nnz = len(rows)
-    idx_dtype = np.uint16 if n_cols <= 0xFFFF else np.int32
+    idx_dtype = _idx_dtype(n_cols)
     order = np.argsort(rows, kind="stable")  # radix for int keys
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     if nnz:
@@ -462,7 +470,8 @@ def stage(
             idx = np.pad(idx, ((0, pad), (0, 0)))
             val = np.pad(val, ((0, pad), (0, 0)))
             counts = np.pad(counts, (0, pad))
-        if idx.dtype != np.uint16 and side.n_cols <= 0xFFFF:
+        target_dtype = _idx_dtype(side.n_cols)
+        if idx.dtype != target_dtype and target_dtype == np.uint16:
             # column ids fit uint16: halves the largest staged tensor's
             # host→device bytes (widened back to int32 inside the traced
             # solve, where the cast fuses for free)

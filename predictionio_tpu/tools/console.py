@@ -461,6 +461,9 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         return EXIT_OK
 
     if cmd == "build":
+        from ..workflow.version_check import check_upgrade
+
+        check_upgrade("build")  # Console.scala:842-844
         ed = register_mod.register_engine(registry, args.engine_dir)
         # Pre-compile the native runtime components so the first train /
         # deploy doesn't pay the C++ build (the reference's `pio build`

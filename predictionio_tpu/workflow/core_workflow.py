@@ -52,6 +52,9 @@ def run_train(
 ) -> str:
     """Train and persist; returns the engine instance id
     (``CoreWorkflow.runTrain``, ``CoreWorkflow.scala:43-93``)."""
+    from .version_check import check_upgrade
+
+    check_upgrade("training", engine_factory)  # CoreWorkflow.scala:51
     md = registry.get_metadata()
     params_cols = serialize_engine_params(engine_params)
     instance = new_engine_instance(
@@ -129,6 +132,9 @@ def run_evaluation(
 ) -> str:
     """Full evaluation run (``CoreWorkflow.runEvaluation``,
     ``CoreWorkflow.scala:95-144`` + ``EvaluationWorkflow.scala:68-81``)."""
+    from .version_check import check_upgrade
+
+    check_upgrade("evaluation", type(evaluation).__name__)  # :108
     md = registry.get_metadata()
     now = utcnow()
     instance = EvaluationInstance(

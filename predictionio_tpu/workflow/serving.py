@@ -557,7 +557,9 @@ def create_query_server(
 ) -> QueryServer:
     """Deploy an engine (``CreateServer.main``, ``CreateServer.scala:100-182``)."""
     from ..storage.registry import get_registry
+    from .version_check import check_upgrade
 
+    check_upgrade("deployment", type(engine).__name__)  # CreateServer.scala:246
     registry = registry or get_registry()
     server = QueryServer(config, engine, registry)
     logger.info(

@@ -1,0 +1,104 @@
+"""Upgrade check: the ``UpgradeCheckRunner`` analogue.
+
+The reference fires a background thread from every train/eval/deploy/build
+that fetches ``http://direct.prediction.io/<version>/<component>.json`` and
+ignores the result (the upgrade logic is a literal ``// TODO`` —
+``core/src/main/scala/io/prediction/workflow/WorkflowUtils.scala:392-413``,
+invoked from ``CoreWorkflow.scala:51,108``, ``CreateServer.scala:246`` and
+``Console.scala:842-844``). This analogue completes the TODO: when the
+version index is reachable and advertises a newer release, an INFO line
+says so; every failure mode (no network, 404, bad JSON, slow host) is a
+DEBUG line at most. The check never blocks the caller (daemon thread, short
+timeout) and is disabled by ``PIO_NO_UPGRADE_CHECK=1`` — the polite default
+for CI and air-gapped deployments is a single fast connection failure.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.request
+from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: Override with PIO_VERSIONS_HOST (trailing slash optional). The
+#: reference used plain http (``WorkflowUtils.scala:396``); https here —
+#: this check runs inside production training/serving processes.
+DEFAULT_VERSIONS_HOST = "https://direct.prediction.io/"
+
+_TIMEOUT_S = 3.0
+#: Response size cap: the index is a tiny JSON document; never buffer an
+#: arbitrarily large body from a (potentially hijacked) remote host.
+_MAX_BODY = 1 << 16
+
+
+def _parse_version(v: str) -> Optional[Tuple[int, ...]]:
+    """Dotted version → int tuple; None when unparseable (pre-release tags
+    compare as their numeric prefix: "0.9.2-SNAPSHOT" → (0, 9, 2))."""
+    parts = []
+    for piece in str(v).split("."):
+        digits = ""
+        for ch in piece:
+            if not ch.isdigit():
+                break
+            digits += ch
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts) if parts else None
+
+
+def check_url(component: str, engine: str = "", version: str = "",
+              host: str = "") -> str:
+    """The reference's URL scheme (``WorkflowUtils.scala:399-404``)."""
+    if not version:
+        from .. import __version__ as version
+    host = (host or os.environ.get("PIO_VERSIONS_HOST")
+            or DEFAULT_VERSIONS_HOST).rstrip("/")
+    if engine:
+        return f"{host}/{version}/{component}/{engine}.json"
+    return f"{host}/{version}/{component}.json"
+
+
+def _run_check(component: str, engine: str) -> Optional[str]:
+    """Fetch + compare. Returns the newer-version string when an upgrade is
+    advertised, else None. Never raises."""
+    from .. import __version__
+
+    url = check_url(component, engine, __version__)
+    try:
+        with urllib.request.urlopen(url, timeout=_TIMEOUT_S) as resp:
+            data = json.loads(resp.read(_MAX_BODY).decode("utf-8"))
+    except Exception as exc:  # any failure: a debug line, nothing more
+        log.debug("upgrade metainfo not available (%s): %s", url, exc)
+        return None
+    latest = data.get("version") if isinstance(data, dict) else None
+    if not latest:
+        return None
+    cur, new = _parse_version(__version__), _parse_version(latest)
+    if cur is not None and new is not None and new > cur:
+        log.info(
+            "A newer version %s is available (running %s) — component %s",
+            latest, __version__, component or "core",
+        )
+        return str(latest)
+    return None
+
+
+def check_upgrade(component: str = "core", engine: str = "") -> Optional[threading.Thread]:
+    """Fire-and-forget upgrade check (``WorkflowUtils.checkUpgrade``).
+
+    Returns the daemon thread (tests join it) or None when disabled via
+    ``PIO_NO_UPGRADE_CHECK=1``.
+    """
+    if os.environ.get("PIO_NO_UPGRADE_CHECK") == "1":
+        return None
+    t = threading.Thread(
+        target=_run_check, args=(component, engine),
+        name="pio-upgrade-check", daemon=True,
+    )
+    t.start()
+    return t

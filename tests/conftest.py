@@ -14,6 +14,9 @@ import sys
 # tunnel (via sitecustomize), but tests must run on the 8-device virtual
 # CPU mesh. The config.update overrides any platform the boot hook set.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# No phone-home threads from the train/eval/deploy/build call sites under
+# test; the version-check tests drive the mechanism directly.
+os.environ["PIO_NO_UPGRADE_CHECK"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

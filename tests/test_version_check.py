@@ -1,0 +1,109 @@
+"""Upgrade-check tests (the UpgradeCheckRunner analogue,
+reference ``WorkflowUtils.scala:392-413``)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from predictionio_tpu import __version__
+from predictionio_tpu.workflow.version_check import (
+    _parse_version,
+    _run_check,
+    check_upgrade,
+    check_url,
+)
+
+
+class _IndexHandler(http.server.BaseHTTPRequestHandler):
+    payload: dict = {}
+    requests: list = []
+
+    def do_GET(self):
+        type(self).requests.append(self.path)
+        body = json.dumps(self.payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def versions_host(monkeypatch):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _IndexHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    _IndexHandler.requests = []
+    monkeypatch.setenv(
+        "PIO_VERSIONS_HOST", f"http://127.0.0.1:{srv.server_address[1]}/"
+    )
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestUrlScheme:
+    def test_component_url_matches_reference_scheme(self, monkeypatch):
+        monkeypatch.setenv("PIO_VERSIONS_HOST", "http://h/")
+        assert check_url("training", version="1.2.3") == (
+            "http://h/1.2.3/training.json"
+        )
+
+    def test_engine_url_variant(self, monkeypatch):
+        monkeypatch.setenv("PIO_VERSIONS_HOST", "http://h")
+        assert check_url("training", "MyEngine", version="1.2.3") == (
+            "http://h/1.2.3/training/MyEngine.json"
+        )
+
+
+class TestVersionParse:
+    @pytest.mark.parametrize(
+        "s,expect",
+        [
+            ("0.9.2", (0, 9, 2)),
+            ("0.9.2-SNAPSHOT", (0, 9, 2)),
+            ("1.10", (1, 10)),
+            ("garbage", None),
+        ],
+    )
+    def test_parse(self, s, expect):
+        assert _parse_version(s) == expect
+
+
+class TestCheck:
+    def test_newer_version_detected(self, versions_host):
+        _IndexHandler.payload = {"version": "99.0.0"}
+        assert _run_check("training", "") == "99.0.0"
+        assert _IndexHandler.requests == [f"/{__version__}/training.json"]
+
+    def test_current_version_is_quiet(self, versions_host):
+        _IndexHandler.payload = {"version": __version__}
+        assert _run_check("training", "") is None
+
+    def test_unreachable_host_is_silent(self, monkeypatch):
+        monkeypatch.setenv("PIO_VERSIONS_HOST", "http://127.0.0.1:9/")
+        assert _run_check("training", "") is None  # must not raise
+
+    def test_bad_payload_is_silent(self, versions_host):
+        _IndexHandler.payload = {"unexpected": True}
+        assert _run_check("training", "") is None
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_NO_UPGRADE_CHECK", "1")
+        assert check_upgrade("training") is None
+
+    def test_fire_and_forget_thread(self, versions_host, monkeypatch):
+        monkeypatch.delenv("PIO_NO_UPGRADE_CHECK", raising=False)
+        _IndexHandler.payload = {"version": "99.0.0"}
+        t = check_upgrade("deployment", "Engine0")
+        assert t is not None
+        t.join(10.0)
+        assert not t.is_alive()
+        assert _IndexHandler.requests == [
+            f"/{__version__}/deployment/Engine0.json"
+        ]
