@@ -39,6 +39,7 @@ class BiMap(Generic[K, V]):
             self._inverse = inverse
         else:
             self._inverse = dict(_inverse)
+        self._inverse_view: Optional["BiMap[V, K]"] = None
 
     # -- accessors --------------------------------------------------------
     def __getitem__(self, key: K) -> V:
@@ -64,8 +65,35 @@ class BiMap(Generic[K, V]):
 
     @property
     def inverse(self) -> "BiMap[V, K]":
-        """O(1) inverted view (``BiMap.scala:45-50``)."""
-        return BiMap(self._inverse, _inverse=self._forward)
+        """O(1) inverted view (``BiMap.scala:45-50``).
+
+        Cached and dict-sharing: the first access builds a view object
+        whose forward/inverse ARE this map's dicts (BiMaps are
+        never mutated after construction), so serving-path code can take
+        ``.inverse`` per query without copying the catalog."""
+        inv = self._inverse_view
+        if inv is None:
+            inv = BiMap.__new__(BiMap)
+            inv._forward = self._inverse
+            inv._inverse = self._forward
+            # deliberately NOT a back-pointer to self: a map↔view cycle
+            # would keep catalog-sized dicts alive past refcount zero
+            # (until a gen-2 gc) when a deployment is dropped on /reload.
+            # Chaining .inverse.inverse just builds another shared-dict
+            # view — equal, not identical.
+            inv._inverse_view = None
+            self._inverse_view = inv
+        return inv
+
+    def __getstate__(self):
+        # the view is a cheap derived cache; keep persisted blobs lean
+        state = dict(self.__dict__)
+        state.pop("_inverse_view", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._inverse_view = None
 
     def to_dict(self) -> Dict[K, V]:
         return dict(self._forward)

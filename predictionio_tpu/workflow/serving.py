@@ -113,6 +113,9 @@ def encode_result(obj: Any) -> Any:
     A result type may define ``to_json_dict`` to control its wire shape (the
     per-algo querySerializer analogue, ``CreateServer.scala:475-478``) —
     templates use it for the reference's camelCase field names."""
+    # hot path: most nodes of a result tree are leaves
+    if obj is None or type(obj) in (str, int, float, bool):
+        return obj
     if hasattr(obj, "to_json_dict") and not isinstance(obj, type):
         return encode_result(obj.to_json_dict())
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):

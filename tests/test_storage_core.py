@@ -378,6 +378,24 @@ class TestBiMap:
         with pytest.raises(ValueError):
             BiMap({"a": 1, "b": 1})
 
+    def test_inverse_is_cached_and_cycle_free(self):
+        """Serving takes .inverse per batch: it must be O(1) (cached,
+        dict-sharing — no catalog copies), survive pickling, and not form
+        a reference cycle that would keep catalog-sized dicts alive past
+        a /reload (refcount-freed, no gc pass needed)."""
+        import pickle
+        import weakref
+
+        m = BiMap({f"i{k}": k for k in range(100)})
+        assert m.inverse is m.inverse  # cached view, not a copy per access
+        assert m.inverse._forward is m._inverse  # shared dicts
+        assert m.inverse.inverse["i5"] == 5
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2.inverse[7] == "i7"
+        ref = weakref.ref(m)
+        del m, m2
+        assert ref() is None  # refcount alone frees it → no cycle
+
     def test_string_int_dense(self):
         m = BiMap.string_int(["x", "y", "x", "z", "y"])
         assert len(m) == 3
