@@ -275,8 +275,7 @@ class _EventServiceHandler(JsonHTTPHandler):
             except (ValueError, KeyError, TypeError, EventValidationError) as exc:
                 results[pos] = {"status": 400, "message": str(exc)}
         if valid:
-            import dataclasses as _dc
-
+            from ..storage.event import with_event_id
             from ..storage.sqlite_events import make_event_id
 
             fresh = []  # server-minted ids: guaranteed-new batch path
@@ -284,7 +283,9 @@ class _EventServiceHandler(JsonHTTPHandler):
             for pos, event in valid:
                 if event.event_id is None:
                     eid = make_event_id(event)
-                    fresh.append(_dc.replace(event, event_id=eid))
+                    # with_event_id, not dataclasses.replace: replace()
+                    # re-validates every field per event on this hot path
+                    fresh.append(with_event_id(event, eid))
                 else:
                     eid = event.event_id
                     upserts.append(event)

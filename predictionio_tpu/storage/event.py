@@ -76,11 +76,25 @@ def to_millis(when: _dt.datetime) -> int:
     return int(when.timestamp() * 1000)
 
 
+#: one-slot memo for format_event_time: bulk imports and server-assigned
+#: creation times repeat timestamps heavily (benign racy swap under
+#: threads). Keyed on (datetime, utcoffset) — equal instants at different
+#: offsets render differently and must not share an entry.
+_last_time_fmt: tuple = (None, None, "")
+
+
 def format_event_time(when: _dt.datetime) -> str:
     """ISO-8601 with millisecond precision and explicit offset."""
-    if when.tzinfo is None:
-        when = when.replace(tzinfo=UTC)
-    return when.isoformat(timespec="milliseconds")
+    last = _last_time_fmt
+    offset = when.utcoffset()
+    if last[0] is not None and when == last[0] and offset == last[1]:
+        return last[2]
+    out = when
+    if out.tzinfo is None:
+        out = out.replace(tzinfo=UTC)
+    text = out.isoformat(timespec="milliseconds")
+    globals()["_last_time_fmt"] = (when, offset, text)
+    return text
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,52 +178,76 @@ def _require(cond: bool, message: str) -> None:
         raise EventValidationError(message)
 
 
+def with_event_id(event: Event, event_id: str) -> Event:
+    """Copy of ``event`` with ``event_id`` set — the bulk-ingest fast path.
+
+    ``dataclasses.replace`` re-runs ``__init__``/``__post_init__`` (field
+    normalization + property validation) per event; on a batch of
+    already-validated events that is pure overhead, so this clones the
+    instance dict directly. Only safe because Event is frozen (no
+    aliasing hazards) and the input was already constructed through
+    ``__init__``.
+    """
+    clone = object.__new__(Event)
+    clone.__dict__.update(event.__dict__)
+    clone.__dict__["event_id"] = event_id
+    return clone
+
+
 def validate_event(e: Event) -> None:
-    """Apply the reference's validation rules (``Event.scala:70-99``)."""
-    _require(bool(e.event), "event must not be empty.")
-    _require(bool(e.entity_type), "entityType must not be empty string.")
-    _require(bool(e.entity_id), "entityId must not be empty string.")
-    _require(
-        e.target_entity_type is None or bool(e.target_entity_type),
-        "targetEntityType must not be empty string",
-    )
-    _require(
-        e.target_entity_id is None or bool(e.target_entity_id),
-        "targetEntityId must not be empty string.",
-    )
-    _require(
-        (e.target_entity_type is None) == (e.target_entity_id is None),
-        "targetEntityType and targetEntityId must be specified together.",
-    )
-    _require(
-        not (e.event == "$unset" and e.properties.is_empty()),
-        "properties cannot be empty for $unset event",
-    )
-    _require(
-        not is_reserved_prefix(e.event) or is_special_event(e.event),
-        f"{e.event} is not a supported reserved event name.",
-    )
-    _require(
-        not is_special_event(e.event)
-        or (e.target_entity_type is None and e.target_entity_id is None),
-        f"Reserved event {e.event} cannot have targetEntity",
-    )
-    _require(
-        not is_reserved_prefix(e.entity_type)
-        or e.entity_type in BUILTIN_ENTITY_TYPES,
-        f"The entityType {e.entity_type} is not allowed. "
-        "'pio_' is a reserved name prefix.",
-    )
-    if e.target_entity_type is not None:
-        _require(
-            not is_reserved_prefix(e.target_entity_type)
-            or e.target_entity_type in BUILTIN_ENTITY_TYPES,
-            f"The targetEntityType {e.target_entity_type} is not allowed. "
-            "'pio_' is a reserved name prefix.",
+    """Apply the reference's validation rules (``Event.scala:70-99``).
+
+    Written as plain conditionals (no helper-call/f-string work on the
+    valid path): this runs per event on the bulk-ingest hot path.
+    """
+    if not e.event:
+        raise EventValidationError("event must not be empty.")
+    if not e.entity_type:
+        raise EventValidationError("entityType must not be empty string.")
+    if not e.entity_id:
+        raise EventValidationError("entityId must not be empty string.")
+    tet, tei = e.target_entity_type, e.target_entity_id
+    if tet == "":
+        raise EventValidationError("targetEntityType must not be empty string")
+    if tei == "":
+        raise EventValidationError("targetEntityId must not be empty string.")
+    if (tet is None) != (tei is None):
+        raise EventValidationError(
+            "targetEntityType and targetEntityId must be specified together."
+        )
+    if is_reserved_prefix(e.event):
+        if not is_special_event(e.event):
+            raise EventValidationError(
+                f"{e.event} is not a supported reserved event name."
+            )
+        if e.event == "$unset" and e.properties.is_empty():
+            raise EventValidationError(
+                "properties cannot be empty for $unset event"
+            )
+        if tet is not None or tei is not None:
+            raise EventValidationError(
+                f"Reserved event {e.event} cannot have targetEntity"
+            )
+    if (
+        is_reserved_prefix(e.entity_type)
+        and e.entity_type not in BUILTIN_ENTITY_TYPES
+    ):
+        raise EventValidationError(
+            f"The entityType {e.entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix."
+        )
+    if (
+        tet is not None
+        and is_reserved_prefix(tet)
+        and tet not in BUILTIN_ENTITY_TYPES
+    ):
+        raise EventValidationError(
+            f"The targetEntityType {tet} is not allowed. "
+            "'pio_' is a reserved name prefix."
         )
     for key in e.properties.keyset():
-        _require(
-            not is_reserved_prefix(key) or key in BUILTIN_PROPERTIES,
-            f"The property {key} is not allowed. "
-            "'pio_' is a reserved name prefix.",
-        )
+        if is_reserved_prefix(key) and key not in BUILTIN_PROPERTIES:
+            raise EventValidationError(
+                f"The property {key} is not allowed. "
+                "'pio_' is a reserved name prefix."
+            )

@@ -254,8 +254,13 @@ class NativeEventStore(EventStore):
         for i, event in enumerate(events):
             validate_event(event)
             event_id = event.event_id or make_event_id(event)
-            stored = dataclasses.replace(event, event_id=event_id)
-            payloads.append(json.dumps(stored.to_json_dict()).encode("utf-8"))
+            # build the payload dict directly instead of
+            # dataclasses.replace(event, event_id=...): replace() re-runs
+            # __init__/__post_init__ (property re-validation) per event —
+            # pure overhead on the bulk path
+            d = event.to_json_dict()
+            d["eventId"] = event_id
+            payloads.append(json.dumps(d).encode("utf-8"))
             times[i] = _ms(event.event_time)
             ctimes[i] = _ms(event.creation_time)
             has_target[i] = event.target_entity_type is not None
