@@ -22,6 +22,11 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
     """Request handler base: JSON responses, body draining, quiet logs."""
 
     protocol_version = "HTTP/1.1"
+    # Keep-alive request/response with Nagle on hits the classic
+    # delayed-ACK interaction: every small response waits ~40 ms for the
+    # peer's ACK before the kernel flushes it. Measured p50 on loopback:
+    # 44 ms → 0.3 ms with TCP_NODELAY.
+    disable_nagle_algorithm = True
 
     def respond(
         self, status: int, payload: Any, content_type: str = "application/json"

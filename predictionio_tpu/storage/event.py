@@ -153,12 +153,24 @@ class Event:
                 raise EventValidationError(f"field {key} is required")
             return obj[key]
 
+        def req_str(key: str) -> str:
+            v = req(key)
+            if not isinstance(v, str):
+                raise EventValidationError(f"field {key} must be a string")
+            return v
+
+        tet = obj.get("targetEntityType")
+        if tet is not None and not isinstance(tet, str):
+            # ids coerce (numeric ids are common) but TYPE names must be
+            # strings — a JSON 0/false here would otherwise surface as an
+            # uncaught AttributeError deep in validation (500, not 400)
+            raise EventValidationError("field targetEntityType must be a string")
         now = utcnow()
         return cls(
-            event=req("event"),
-            entity_type=req("entityType"),
+            event=req_str("event"),
+            entity_type=req_str("entityType"),
             entity_id=str(req("entityId")),
-            target_entity_type=obj.get("targetEntityType"),
+            target_entity_type=tet,
             target_entity_id=(
                 None
                 if obj.get("targetEntityId") is None
@@ -171,11 +183,6 @@ class Event:
             creation_time=_as_datetime(obj.get("creationTime")) or now,
             event_id=obj.get("eventId"),
         )
-
-
-def _require(cond: bool, message: str) -> None:
-    if not cond:
-        raise EventValidationError(message)
 
 
 def with_event_id(event: Event, event_id: str) -> Event:
@@ -207,9 +214,9 @@ def validate_event(e: Event) -> None:
     if not e.entity_id:
         raise EventValidationError("entityId must not be empty string.")
     tet, tei = e.target_entity_type, e.target_entity_id
-    if tet == "":
+    if tet is not None and not tet:
         raise EventValidationError("targetEntityType must not be empty string")
-    if tei == "":
+    if tei is not None and not tei:
         raise EventValidationError("targetEntityId must not be empty string.")
     if (tet is None) != (tei is None):
         raise EventValidationError(

@@ -118,6 +118,28 @@ def test_post_malformed_body_is_400(server):
     assert r.status_code == 400
 
 
+@pytest.mark.parametrize(
+    "field,value",
+    [
+        ("targetEntityType", 0),
+        ("targetEntityType", False),
+        ("entityType", 5),
+        ("event", None),
+    ],
+)
+def test_non_string_type_fields_are_400_not_500(server, field, value):
+    """Wrong-typed JSON for name/type fields must be a clean 400 — falsy
+    or numeric values once slipped past validation and crashed deeper in
+    the pipeline as a 500."""
+    base, _ = server
+    r = requests.post(
+        f"{base}/events.json?accessKey=SECRET",
+        json=_event_payload(**{field: value}),
+    )
+    assert r.status_code == 400, r.text
+    assert "message" in r.json()
+
+
 def test_find_with_filters(server):
     base, _ = server
     for i in range(5):
