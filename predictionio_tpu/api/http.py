@@ -62,6 +62,19 @@ class BackgroundHTTPServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
+    def handle_error(self, request, client_address) -> None:
+        """Client disconnects mid-response (an abandoned streaming scan, a
+        killed curl) are normal operation, not stack-trace material."""
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(
+            exc, (BrokenPipeError, ConnectionResetError, TimeoutError)
+        ):
+            logger.debug("client %s dropped: %s", client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
     @property
     def bound_port(self) -> int:
         return self.server_address[1]

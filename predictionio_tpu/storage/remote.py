@@ -22,9 +22,10 @@ memory on both sides.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
+import urllib.parse
 from typing import Iterator, Optional
 
 from .backends import BackendFamily, SourceConf, register_backend
@@ -44,21 +45,151 @@ class RemoteStorageError(Exception):
         self.code = code
 
 
+# -- pooled keep-alive transport ---------------------------------------------
+#
+# Every storage operation used to open a fresh TCP connection (urllib);
+# for the multi-host storage plane that is connection setup per metadata
+# RPC / event op. Connections are now pooled per (thread, host:port) and
+# reused when the previous response was fully drained — a response
+# abandoned mid-stream (a partially consumed `find`) discards its
+# connection, since leftover body bytes would desync the next request.
+# A pooled connection that died while idle (server restart) gets one
+# transparent retry on a fresh connection.
+
+
+class _NetlocPool(threading.local):
+    def __init__(self):
+        self.conns: dict = {}
+
+
+_pool = _NetlocPool()
+
+
+def _return_conn(netloc: str, conn) -> None:
+    """Pool a reusable connection; close any displaced one (possible when
+    an RPC ran while a streaming response held the slot's connection)."""
+    old = _pool.conns.get(netloc)
+    if old is not None and old is not conn:
+        try:
+            old.close()
+        except Exception:
+            pass
+    _pool.conns[netloc] = conn
+
+
+class _PooledResponse:
+    """Proxy over ``http.client.HTTPResponse`` that returns the connection
+    to the per-thread pool when the body was fully read."""
+
+    def __init__(self, resp, conn, netloc: str):
+        self._resp = resp
+        self._conn = conn
+        self._netloc = netloc
+
+    # the three access patterns used by this module's callers
+    def read(self, *a):
+        return self._resp.read(*a)
+
+    def __iter__(self):
+        return iter(self._resp)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is None:
+            return
+        resp = self._resp
+        if not resp.isclosed():
+            # Callers that only wanted the status (`with _request(...):
+            # pass` on write paths) leave a small JSON body unread —
+            # drain a bounded amount so those connections still pool;
+            # genuinely large/streaming leftovers get discarded.
+            try:
+                resp.read(1 << 16)
+            except Exception:
+                conn.close()
+                return
+        if resp.isclosed() and not getattr(resp, "will_close", False):
+            _return_conn(self._netloc, conn)
+        else:
+            conn.close()
+
+    def __del__(self):  # a response dropped without close(): free the fd
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
 def _request(
     url: str, method: str = "GET", body: Optional[bytes] = None, timeout: float = 60.0
 ):
-    req = urllib.request.Request(url, data=body, method=method)
-    if body is not None:
-        req.add_header("Content-Type", "application/json")
-    try:
-        return urllib.request.urlopen(req, timeout=timeout)
-    except urllib.error.HTTPError as exc:
-        detail = exc.read().decode("utf-8", "replace")[:500]
-        raise RemoteStorageError(
-            f"{method} {url} → HTTP {exc.code}: {detail}", code=exc.code
-        ) from exc
-    except urllib.error.URLError as exc:
-        raise RemoteStorageError(f"{method} {url} unreachable: {exc.reason}") from exc
+    parsed = urllib.parse.urlsplit(url)
+    netloc = parsed.netloc
+    path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    for attempt in (0, 1):
+        conn = _pool.conns.pop(netloc, None)
+        fresh = conn is None
+        if fresh:
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port or DEFAULT_PORT, timeout=timeout
+            )
+        elif conn.sock is not None:
+            try:
+                conn.sock.settimeout(timeout)  # caller-specific op timeout
+            except OSError:  # pooled socket already dead
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port or DEFAULT_PORT,
+                    timeout=timeout,
+                )
+                fresh = True
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+        except Exception as exc:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            # Retry ONLY the stale-keep-alive signature: a pooled
+            # connection the server closed while idle fails with a
+            # connection-level error. Timeouts and fresh-connection
+            # failures must NOT retry — the request may have executed
+            # server-side, and storage writes are not idempotent.
+            stale_reuse = not fresh and isinstance(
+                exc,
+                (
+                    BrokenPipeError,
+                    ConnectionResetError,
+                    http.client.RemoteDisconnected,
+                ),
+            )
+            if not stale_reuse:
+                raise RemoteStorageError(
+                    f"{method} {url} unreachable: {exc}"
+                ) from exc
+            continue
+        if resp.status >= 400:
+            detail = resp.read().decode("utf-8", "replace")[:500]
+            if resp.isclosed() and not getattr(resp, "will_close", False):
+                _return_conn(netloc, conn)
+            else:
+                conn.close()
+            raise RemoteStorageError(
+                f"{method} {url} → HTTP {resp.status}: {detail}",
+                code=resp.status,
+            )
+        return _PooledResponse(resp, conn, netloc)
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
 def _json(resp) -> dict:

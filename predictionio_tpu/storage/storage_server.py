@@ -103,6 +103,11 @@ class _StorageHandler(JsonHTTPHandler):
             else:
                 self.read_body()
                 self.respond(404, {"message": "Not found"})
+        except (BrokenPipeError, ConnectionResetError) as exc:
+            # client dropped mid-stream (abandoned scan): normal operation
+            logger.debug("client dropped during %s %s: %s", method, path, exc)
+            self.close_connection = True
+            return
         except Exception as exc:  # one bad request must not kill the server
             logger.exception("storage server error on %s %s", method, path)
             if getattr(self, "_headers_sent", False):

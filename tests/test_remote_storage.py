@@ -235,3 +235,73 @@ def test_builtin_families_present():
     fams = registered_backends()
     for name in ("sqlite", "localfs", "memory", "native"):
         assert name in fams
+
+
+# -- connection pooling ----------------------------------------------------
+
+
+class TestConnectionPooling:
+    def _store(self, base_url):
+        from predictionio_tpu.storage.remote import RemoteEventStore
+
+        return RemoteEventStore(base_url)
+
+    def _event(self):
+        from predictionio_tpu.storage import DataMap, Event
+
+        return Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({"rating": 4.0}),
+        )
+
+    def test_write_path_reuses_connection(self, base_url):
+        """Unread response bodies must be drained and the connection
+        pooled — the write path (`with _request(...): pass`) is exactly
+        the bulk path pooling exists for."""
+        from predictionio_tpu.storage import remote
+
+        st = self._store(base_url)
+        st.init(7)
+        st.write_new([self._event()], 7)
+        netloc = base_url.split("//")[1]
+        conn1 = remote._pool.conns.get(netloc)
+        assert conn1 is not None, "connection not pooled after write"
+        st.write_new([self._event()], 7)
+        assert remote._pool.conns.get(netloc) is conn1, "pool not reused"
+
+    def test_stale_pooled_connection_retries_once(self, base_url, server):
+        st = self._store(base_url)
+        st.init(8)
+        eid = st.insert(self._event(), 8)
+        # kill the pooled connection from the client side to simulate an
+        # idle keep-alive the server dropped
+        from predictionio_tpu.storage import remote
+
+        netloc = base_url.split("//")[1]
+        conn = remote._pool.conns.get(netloc)
+        assert conn is not None
+        conn.sock.close()  # next use raises a connection-level error
+        assert st.get(eid, 8) is not None  # transparent retry
+
+    def test_abandoned_stream_discards_connection(self, base_url):
+        from predictionio_tpu.storage import remote
+        from predictionio_tpu.storage.events import EventFilter
+
+        st = self._store(base_url)
+        st.init(9)
+        # enough events that the abandoned remainder exceeds the bounded
+        # drain in _PooledResponse.close (64 KB) — a small remainder is
+        # deliberately drained and the connection reused
+        for _ in range(5):
+            st.write_new([self._event() for _ in range(200)], 9)
+        it = st.find(9, EventFilter(event_names=["rate"]))
+        next(it)
+        netloc = base_url.split("//")[1]
+        before = remote._pool.conns.get(netloc)
+        it.close()  # abandon mid-stream
+        # the streaming connection must NOT have been pooled for reuse
+        after = remote._pool.conns.get(netloc)
+        assert after is before
+        # and subsequent ops still work
+        assert len(list(st.find(9, EventFilter(event_names=["rate"])))) == 1000
