@@ -145,9 +145,10 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     tr = ~test
 
     solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")
+    gather_dtype = os.environ.get("BENCH_GATHER_DTYPE", "f32")
     cfg = ALSConfig(
         rank=50, iterations=iterations, lambda_=0.05, seed=0,
-        solve_mode=solve_mode,
+        solve_mode=solve_mode, gather_dtype=gather_dtype,
     )
 
     # Warm the compilation cache with the REAL bucket shapes (jit keys on
@@ -156,7 +157,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     # then measures steady-state bucketize + staging + training.
     warm_cfg = ALSConfig(
         rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed,
-        solve_mode=solve_mode,
+        solve_mode=solve_mode, gather_dtype=gather_dtype,
     )
     wu = stage(bucketize(users[tr], items[tr], ratings[tr], n_users,
                          n_items, pad_to_blocks=True))
@@ -208,6 +209,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "est_mfu_f32_v5e": round(mfu, 4),
         "bucket_shapes": profile.get("bucket_shapes"),
         "solve_mode": profile.get("solve_mode", solve_mode),
+        "gather_dtype": gather_dtype,
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:

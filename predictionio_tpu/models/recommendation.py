@@ -219,6 +219,10 @@ class ALSAlgorithmParams(Params):
     #: ops.als.ALSConfig.solve_mode ("auto" picks the fused pallas
     #: Cholesky kernel on a single-chip TPU run, "chunked" elsewhere)
     solve_mode: str = "auto"
+    #: "f32" | "bf16" — gathered-factor precision for the normal-equation
+    #: einsums (see ops.als.ALSConfig.gather_dtype; quality-gate before
+    #: adopting bf16)
+    gather_dtype: str = "f32"
 
 
 @dataclasses.dataclass
@@ -258,6 +262,7 @@ class ALSAlgorithm(Algorithm):
             implicit_prefs=p.implicit_prefs,
             alpha=p.alpha,
             solve_mode=p.solve_mode,
+            gather_dtype=p.gather_dtype,
         )
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None

@@ -129,26 +129,45 @@ class _PooledResponse:
 
 
 def _request(
-    url: str, method: str = "GET", body: Optional[bytes] = None, timeout: float = 60.0
+    url: str,
+    method: str = "GET",
+    body: Optional[bytes] = None,
+    timeout: float = 60.0,
+    idempotent: Optional[bool] = None,
 ):
+    """``idempotent`` enables the one-shot stale-connection retry. Default:
+    GET/DELETE only. POST call sites that are semantically reads (find,
+    columnar scans) or natural upserts (init, model put) opt in; event
+    writes must NOT — a request the server executed before dying would be
+    applied twice."""
     parsed = urllib.parse.urlsplit(url)
-    netloc = parsed.netloc
+    if parsed.scheme not in ("http", "https"):
+        raise RemoteStorageError(f"unsupported URL scheme in {url!r}")
+    conn_cls = (
+        http.client.HTTPSConnection
+        if parsed.scheme == "https"
+        else http.client.HTTPConnection
+    )
+    default_port = 443 if parsed.scheme == "https" else DEFAULT_PORT
+    if idempotent is None:
+        idempotent = method in ("GET", "DELETE")
+    netloc = f"{parsed.scheme}://{parsed.netloc}"
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
     headers = {"Content-Type": "application/json"} if body is not None else {}
     for attempt in (0, 1):
         conn = _pool.conns.pop(netloc, None)
         fresh = conn is None
         if fresh:
-            conn = http.client.HTTPConnection(
-                parsed.hostname, parsed.port or DEFAULT_PORT, timeout=timeout
+            conn = conn_cls(
+                parsed.hostname, parsed.port or default_port, timeout=timeout
             )
         elif conn.sock is not None:
             try:
                 conn.sock.settimeout(timeout)  # caller-specific op timeout
             except OSError:  # pooled socket already dead
                 conn.close()
-                conn = http.client.HTTPConnection(
-                    parsed.hostname, parsed.port or DEFAULT_PORT,
+                conn = conn_cls(
+                    parsed.hostname, parsed.port or default_port,
                     timeout=timeout,
                 )
                 fresh = True
@@ -165,13 +184,17 @@ def _request(
             # connection-level error. Timeouts and fresh-connection
             # failures must NOT retry — the request may have executed
             # server-side, and storage writes are not idempotent.
-            stale_reuse = not fresh and isinstance(
-                exc,
-                (
-                    BrokenPipeError,
-                    ConnectionResetError,
-                    http.client.RemoteDisconnected,
-                ),
+            stale_reuse = (
+                not fresh
+                and idempotent
+                and isinstance(
+                    exc,
+                    (
+                        BrokenPipeError,
+                        ConnectionResetError,
+                        http.client.RemoteDisconnected,
+                    ),
+                )
             )
             if not stale_reuse:
                 raise RemoteStorageError(
@@ -209,11 +232,13 @@ class RemoteEventStore(EventStore):
         return f"{self._base}/events/{app_id}{suffix}"
 
     def init(self, app_id: int) -> bool:
-        with _request(self._url(app_id, "/init"), "POST", b"{}", self._timeout) as r:
+        with _request(self._url(app_id, "/init"), "POST", b"{}",
+                      self._timeout, idempotent=True) as r:
             return bool(_json(r)["ok"])
 
     def remove(self, app_id: int) -> bool:
-        with _request(self._url(app_id, "/remove"), "POST", b"{}", self._timeout) as r:
+        with _request(self._url(app_id, "/remove"), "POST", b"{}",
+                      self._timeout, idempotent=True) as r:
             return bool(_json(r)["ok"])
 
     def insert(self, event: Event, app_id: int) -> str:
@@ -242,7 +267,7 @@ class RemoteEventStore(EventStore):
         body = self._filter_dict(filter or EventFilter())
         resp = _request(
             self._url(app_id, "/find"), "POST", json.dumps(body).encode(),
-            self._timeout,
+            self._timeout, idempotent=True,  # pure read
         )
 
         def iterate() -> Iterator[Event]:
@@ -277,7 +302,8 @@ class RemoteEventStore(EventStore):
 
         body = json.dumps(self._filter_dict(filter or EventFilter())).encode()
         with _request(
-            self._url(app_id, "/scan_columnar"), "POST", body, self._timeout
+            self._url(app_id, "/scan_columnar"), "POST", body,
+            self._timeout, idempotent=True,  # pure read
         ) as r:
             cols = _json(r)
         cols["event_time_ms"] = np.asarray(cols["event_time_ms"], dtype=np.int64)
@@ -334,8 +360,10 @@ class RemoteModelStore(ModelStore):
         self._timeout = timeout
 
     def insert(self, model: Model) -> None:
+        # PUT-by-id is a natural upsert: replaying it is safe
         with _request(
-            f"{self._base}/models/{model.id}", "PUT", model.models, self._timeout
+            f"{self._base}/models/{model.id}", "PUT", model.models,
+            self._timeout, idempotent=True,
         ):
             pass
 

@@ -278,3 +278,40 @@ class TestPallasModeGuards:
         cfg = ALSConfig(rank=88, iterations=1, solve_mode="pallas")
         with pytest.raises(ValueError, match="rank"):
             als_train_coo(u, i, v, n_users=3, n_items=2, cfg=cfg)
+
+
+class TestGatherDtype:
+    """bf16 gathers must track the f32 result closely (input rounding at
+    2^-8 relative; the λ·n_u ridge keeps solves stable) and fail loudly on
+    unknown dtypes."""
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_bf16_tracks_f32(self, implicit):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        rng = np.random.default_rng(11)
+        nnz, n_u, n_i = 20_000, 600, 200
+        u = rng.integers(0, n_u, nnz).astype(np.int32)
+        i = rng.integers(0, n_i, nnz).astype(np.int32)
+        v = rng.integers(1, 6, nnz).astype(np.float32)
+        out = {}
+        for gd in ("f32", "bf16"):
+            cfg = ALSConfig(rank=8, iterations=3, lambda_=0.1,
+                            implicit_prefs=implicit, gather_dtype=gd)
+            f = als_train_coo(u, i, v, n_users=n_u, n_items=n_i, cfg=cfg)
+            out[gd] = np.asarray(f.user_factors)
+        rel = np.linalg.norm(out["f32"] - out["bf16"]) / np.linalg.norm(
+            out["f32"]
+        )
+        assert np.isfinite(out["bf16"]).all()
+        assert rel < 0.05, rel  # tracks, within reduced-precision drift
+
+    def test_unknown_dtype_fails_loudly(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        cfg = ALSConfig(rank=4, iterations=1, gather_dtype="f16")
+        with pytest.raises(ValueError, match="gather_dtype"):
+            als_train_coo(
+                np.array([0], dtype=np.int32), np.array([0], dtype=np.int32),
+                np.ones(1, dtype=np.float32), n_users=1, n_items=1, cfg=cfg,
+            )

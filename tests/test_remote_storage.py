@@ -264,25 +264,89 @@ class TestConnectionPooling:
         st = self._store(base_url)
         st.init(7)
         st.write_new([self._event()], 7)
-        netloc = base_url.split("//")[1]
-        conn1 = remote._pool.conns.get(netloc)
+        conn1 = remote._pool.conns.get(base_url)
         assert conn1 is not None, "connection not pooled after write"
         st.write_new([self._event()], 7)
-        assert remote._pool.conns.get(netloc) is conn1, "pool not reused"
+        assert remote._pool.conns.get(base_url) is conn1, "pool not reused"
 
-    def test_stale_pooled_connection_retries_once(self, base_url, server):
-        st = self._store(base_url)
-        st.init(8)
-        eid = st.insert(self._event(), 8)
-        # kill the pooled connection from the client side to simulate an
-        # idle keep-alive the server dropped
+    @staticmethod
+    def _lying_keepalive_server():
+        """A server that claims keep-alive (HTTP/1.1, no Connection: close)
+        but closes the TCP connection after every response — the exact
+        idle-stale-connection scenario the retry exists for. Returns
+        (port, hits list, closer)."""
+        import socket
+        import threading
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        port = lsock.getsockname()[1]
+        hits = []
+
+        def serve():
+            while True:
+                try:
+                    c, _ = lsock.accept()
+                except OSError:
+                    return
+                with c:
+                    data = b""
+                    while b"\r\n\r\n" not in data:
+                        chunk = c.recv(4096)
+                        if not chunk:
+                            break
+                        data += chunk
+                    if not data:
+                        continue
+                    hits.append(data.split(b"\r\n", 1)[0].decode())
+                    body = b'{"ok": true}'
+                    c.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
+                        b"\r\nContent-Length: %d\r\n\r\n%s"
+                        % (len(body), body)
+                    )
+                    # close WITHOUT having announced Connection: close
+
+        threading.Thread(target=serve, daemon=True).start()
+        return port, hits, lsock.close
+
+    def test_stale_pooled_connection_retries_idempotent_request(self):
         from predictionio_tpu.storage import remote
 
-        netloc = base_url.split("//")[1]
-        conn = remote._pool.conns.get(netloc)
-        assert conn is not None
-        conn.sock.close()  # next use raises a connection-level error
-        assert st.get(eid, 8) is not None  # transparent retry
+        port, hits, closer = self._lying_keepalive_server()
+        try:
+            url = f"http://127.0.0.1:{port}/x"
+            with remote._request(url) as r:
+                assert b"ok" in r.read()
+            # response looked reusable -> pooled, but the server closed it
+            assert remote._pool.conns.get(f"http://127.0.0.1:{port}")
+            with remote._request(url) as r:  # GET: retries transparently
+                assert b"ok" in r.read()
+            assert len(hits) == 2
+        finally:
+            closer()
+
+    def test_non_idempotent_write_does_not_retry_on_stale_conn(self):
+        from predictionio_tpu.storage import remote
+        from predictionio_tpu.storage.remote import RemoteStorageError
+
+        port, hits, closer = self._lying_keepalive_server()
+        try:
+            url = f"http://127.0.0.1:{port}/x"
+            with remote._request(url, "POST", b"{}") as r:
+                r.read()
+            assert remote._pool.conns.get(f"http://127.0.0.1:{port}")
+            # POST on the stale pooled connection: must raise, not replay
+            with pytest.raises(RemoteStorageError):
+                remote._request(url, "POST", b"{}")
+            assert len(hits) == 1  # the failed attempt never re-sent
+            # next op recovers on a fresh connection
+            with remote._request(url, "POST", b"{}") as r:
+                r.read()
+            assert len(hits) == 2
+        finally:
+            closer()
 
     def test_abandoned_stream_discards_connection(self, base_url):
         from predictionio_tpu.storage import remote
@@ -297,11 +361,10 @@ class TestConnectionPooling:
             st.write_new([self._event() for _ in range(200)], 9)
         it = st.find(9, EventFilter(event_names=["rate"]))
         next(it)
-        netloc = base_url.split("//")[1]
-        before = remote._pool.conns.get(netloc)
+        before = remote._pool.conns.get(base_url)
         it.close()  # abandon mid-stream
         # the streaming connection must NOT have been pooled for reuse
-        after = remote._pool.conns.get(netloc)
+        after = remote._pool.conns.get(base_url)
         assert after is before
         # and subsequent ops still work
         assert len(list(st.find(9, EventFilter(event_names=["rate"])))) == 1000
