@@ -29,7 +29,7 @@ import datetime as _dt
 import json
 import logging
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from .http import BackgroundHTTPServer, JsonHTTPHandler

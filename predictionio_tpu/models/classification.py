@@ -17,7 +17,7 @@ TPU restatement: both algorithms train on device via the sufficient-statistic
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

@@ -31,7 +31,7 @@ from ..controller import (
 )
 from ..ops.als import ALSConfig, als_train_coo
 from ..ops.scoring import pad_pow2, top_k_for_users
-from ..storage import BiMap, EventFilter, get_registry
+from ..storage import BiMap, get_registry
 from ..workflow.infeed import stream_ratings
 
 

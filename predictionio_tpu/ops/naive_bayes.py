@@ -23,7 +23,6 @@ mesh instead of a shuffle (SURVEY §2.8: combineByKey → scatter-add + psum).
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax

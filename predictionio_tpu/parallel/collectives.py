@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def all_reduce_sum(x, mesh: Mesh, axis: str):

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
-from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+from typing import Any, Dict, Iterable, Optional
 
 from .data_map import PropertyMap
 from .event import SPECIAL_EVENTS, Event, to_millis as _millis

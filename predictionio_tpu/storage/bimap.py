@@ -11,7 +11,7 @@ results coming back from the scoring kernel.
 
 from __future__ import annotations
 
-from typing import Dict, Generic, Iterable, Iterator, List, Mapping, Optional, Tuple, TypeVar
+from typing import Dict, Generic, Iterable, Iterator, List, Mapping, Optional, TypeVar
 
 import numpy as np
 

@@ -15,7 +15,7 @@ import os
 import pickle
 import re
 import shutil
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional
 
 from ..controller.engine import (
     Engine,

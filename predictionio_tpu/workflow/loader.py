@@ -17,7 +17,7 @@ import importlib
 import logging
 import os
 import sys
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
 
