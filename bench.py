@@ -41,6 +41,9 @@ _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 #: ~half of that as the attainable ceiling for the MFU estimate.
 _V5E_PEAK_F32 = 98.5e12
 
+#: North-star wall-clock target (BASELINE.md): ML-20M rank-50 in < 60 s.
+_BASELINE_S = 60.0
+
 _PROBE_SNIPPET = (
     "import jax, sys; "
     "d = jax.devices(); "
@@ -197,7 +200,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "metric": "ml20m_als_rank50_train_s",
         "value": round(train_s, 3),
         "unit": "s",
-        "vs_baseline": round(60.0 / train_s, 2),
+        "vs_baseline": round(_BASELINE_S / train_s, 2),
         "holdout_rmse": round(holdout, 4),
         "nnz": int(tr.sum()),
         "scale": scale,
@@ -216,14 +219,63 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         # the headline comparison must not claim the baseline was beaten.
         record["fallback"] = fallback
         record["vs_baseline"] = 0.0
+        _attach_last_good(record)
     # quality gate: noise floor is 0.5; MLlib-parity training lands near it.
     if holdout > 0.62:
         record["vs_baseline"] = 0.0
         record["error"] = f"holdout RMSE {holdout:.4f} failed quality gate"
         print(json.dumps(record))
         return 1
+    if (
+        not fallback
+        and scale >= 1.0
+        and record.get("device", "").startswith("TPU")
+    ):
+        _save_last_good(record)
     print(json.dumps(record))
     return 0
+
+
+#: Last successful full-scale TPU measurement, persisted so a run that has
+#: to fall back (the accelerator tunnel wedges for hours at a time) can
+#: still report the most recent REAL number — clearly labeled as prior
+#: evidence, never merged into the fallback run's own fields.
+_LAST_GOOD_PATH = os.path.join(_REPO_ROOT, "BENCH_LAST_GOOD.json")
+
+
+def _save_last_good(record: dict) -> None:
+    try:
+        payload = dict(record)
+        payload["recorded_at_unix"] = time.time()
+        tmp = _LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, _LAST_GOOD_PATH)
+    except Exception:
+        pass  # evidence caching must never fail a real run
+
+
+def _attach_last_good(record: dict) -> None:
+    try:
+        with open(_LAST_GOOD_PATH) as fh:
+            last = json.load(fh)
+    except (OSError, ValueError):
+        return
+    record["last_known_tpu"] = {
+        "value": last.get("value"),
+        "scale": last.get("scale"),
+        "nnz": last.get("nnz"),
+        "vs_baseline_then": last.get("vs_baseline"),
+        "holdout_rmse": last.get("holdout_rmse"),
+        "device": last.get("device"),
+        "solve_mode": last.get("solve_mode"),
+        "recorded_at_unix": last.get("recorded_at_unix"),
+        "note": (
+            "most recent successful full-scale TPU run, attached because "
+            "THIS run fell back to CPU (accelerator unreachable); not a "
+            "measurement of the current code state"
+        ),
+    }
 
 
 def main() -> int:
