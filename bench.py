@@ -229,7 +229,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     if (
         not fallback
         and scale >= 1.0
-        and record.get("device", "").startswith("TPU")
+        and jax.devices()[0].platform == "tpu"  # stable API, not str repr
     ):
         _save_last_good(record)
     print(json.dumps(record))

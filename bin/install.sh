@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# First-run setup (reference: bin/install.sh, minus the JVM downloads):
+# writes conf/pio-env.sh from the template if absent, loads it, creates
+# the storage base directory, pre-compiles the native C++ runtime
+# libraries, and verifies every storage DAO with a live write.
+set -euo pipefail
+PIO_HOME="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+if [ ! -f "${PIO_HOME}/conf/pio-env.sh" ] && [ -f "${PIO_HOME}/conf/pio-env.sh.template" ]; then
+  cp "${PIO_HOME}/conf/pio-env.sh.template" "${PIO_HOME}/conf/pio-env.sh"
+  echo "Wrote conf/pio-env.sh from template (edit to configure storage)."
+fi
+
+# shellcheck disable=SC1091
+. "${PIO_HOME}/bin/load-pio-env.sh"
+mkdir -p "${PIO_FS_BASEDIR:-$HOME/.predictionio_tpu}"
+
+export PYTHONPATH="${PIO_HOME}${PYTHONPATH:+:${PYTHONPATH}}"
+python3 - <<'PY'
+from predictionio_tpu.native import LIBRARIES, NativeBuildError, build_library
+
+for name in LIBRARIES:
+    try:
+        build_library(name)
+        print(f"native library ready: {name}")
+    except NativeBuildError as exc:
+        print(f"native build skipped ({name}): {exc} — Python fallbacks apply")
+PY
+
+"${PIO_HOME}/bin/pio" status
+echo "Installation verified. Next: bin/pio app new <name>"

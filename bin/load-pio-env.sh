@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Load conf/pio-env.sh exactly once, exporting every assignment
+# (reference: bin/load-pio-env.sh). Honors PIO_CONF_DIR. Sourced by every
+# launcher (pio, pio-start-all, pio-stop-all, pio-daemon, install.sh) so
+# services and the CLI see the same storage configuration.
+if [ -z "${PIO_ENV_LOADED:-}" ]; then
+  export PIO_ENV_LOADED=1
+  _pio_parent="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+  _pio_conf_dir="${PIO_CONF_DIR:-${_pio_parent}/conf}"
+  if [ -f "${_pio_conf_dir}/pio-env.sh" ]; then
+    set -a  # export every assignment the env file makes
+    # shellcheck disable=SC1091
+    . "${_pio_conf_dir}/pio-env.sh"
+    set +a
+  fi
+  unset _pio_parent _pio_conf_dir
+fi

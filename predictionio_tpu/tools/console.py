@@ -405,6 +405,21 @@ def main(
 
     apply_env_platform()
     args = build_parser().parse_args(argv)
+    if args.command not in (
+        "eventserver", "dashboard", "storageserver", "deploy",
+    ):
+        # Short-lived CLI commands die quietly on a closed pipe
+        # (`pio app new | grep -q ...` closes stdout early) — default
+        # Unix behavior, not a Python traceback. Server subcommands keep
+        # Python's SIGPIPE=ignored so a client disconnect mid-write
+        # surfaces as the BrokenPipeError their handlers already treat
+        # as normal operation, instead of killing the process.
+        import signal
+
+        try:
+            signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+        except (AttributeError, ValueError):
+            pass  # non-POSIX, or called from a non-main thread (tests)
     registry = registry or get_registry()
     try:
         return _dispatch(args, registry)
