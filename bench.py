@@ -44,6 +44,10 @@ _V5E_PEAK_F32 = 98.5e12
 #: North-star wall-clock target (BASELINE.md): ML-20M rank-50 in < 60 s.
 _BASELINE_S = 60.0
 
+#: v5e HBM bandwidth (819 GB/s) for the bandwidth-utilization estimate —
+#: the gather-bound solve's honest efficiency number.
+_V5E_HBM_BPS = 819e9
+
 _PROBE_SNIPPET = (
     "import jax, sys; "
     "d = jax.devices(); "
@@ -192,9 +196,13 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
 
     iter_s = profile.get("iteration_s", [])
     flops = profile.get("flops_per_iteration", 0.0)
-    avg_iter = float(np.mean(iter_s)) if iter_s else 0.0
+    hbm_bytes = profile.get("hbm_bytes_per_iteration", 0.0)
+    # steady state: the first iteration absorbs the async staging transfer
+    steady = iter_s[1:] if len(iter_s) > 1 else iter_s
+    avg_iter = float(np.mean(steady)) if steady else 0.0
     tflops_per_s = (flops / avg_iter / 1e12) if avg_iter else 0.0
     mfu = (flops / avg_iter / _V5E_PEAK_F32) if avg_iter else 0.0
+    hbm_util = (hbm_bytes / avg_iter / _V5E_HBM_BPS) if avg_iter else 0.0
 
     record = {
         "metric": "ml20m_als_rank50_train_s",
@@ -210,6 +218,8 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "iteration_s": [round(s, 4) for s in iter_s],
         "est_tflops_per_s": round(tflops_per_s, 2),
         "est_mfu_f32_v5e": round(mfu, 4),
+        "est_hbm_gb_per_iter": round(hbm_bytes / 1e9, 2),
+        "est_hbm_util_v5e": round(hbm_util, 3),
         "bucket_shapes": profile.get("bucket_shapes"),
         "solve_mode": profile.get("solve_mode", solve_mode),
         "gather_dtype": gather_dtype,

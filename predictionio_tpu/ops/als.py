@@ -825,6 +825,9 @@ def als_train(
         profile["flops_per_iteration"] = estimate_iteration_flops(
             by_user, by_item, rank, cfg.implicit_prefs
         )
+        profile["hbm_bytes_per_iteration"] = estimate_iteration_hbm_bytes(
+            by_user, by_item, rank, cfg.gather_dtype
+        )
         profile["bucket_shapes"] = {
             "by_user": [
                 [int(np.prod(b.rows.shape)), b.idx.shape[-1]]
@@ -934,6 +937,33 @@ def estimate_iteration_flops(
             )
         if implicit:
             total += 2.0 * side.n_cols * rank * rank  # YᵀY
+    return total
+
+
+def estimate_iteration_hbm_bytes(
+    by_user: StagedMatrix, by_item: StagedMatrix, rank: int,
+    gather_dtype: str = "f32",
+) -> float:
+    """Padded-shape HBM-traffic estimate for one full iteration — the ALS
+    solve is gather-bound, so bandwidth utilization (not MFU) is the
+    honest efficiency number. Per padded row of width K, per side: the
+    factor gather reads K·R elements (the dominant term — counted at the
+    gather dtype's width), idx/val/counts stream in once, and the solved
+    row writes back R floats. Real gathers touch whole (8,128) tiles, so
+    treat this as a lower bound on true traffic."""
+    elt = 2.0 if gather_dtype == "bf16" else 4.0
+    total = 0.0
+    for side in (by_user, by_item):
+        for b in side.buckets:
+            rows = float(np.prod(b.rows.shape))
+            k = float(b.idx.shape[-1])
+            idx_b = b.idx.dtype.itemsize
+            total += rows * (
+                k * rank * elt  # gathered opposite factors
+                + k * (idx_b + 4.0)  # idx + val stream
+                + 4.0  # per-row counts read
+                + rank * 4.0  # solution write
+            )
     return total
 
 
