@@ -1,0 +1,32 @@
+"""Generate a MovieLens-shaped events.jsonl for the quickstart.
+
+Usage: python gen_events.py [n_users] [n_items] [n_events] > events.jsonl
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    n_items = int(sys.argv[2]) if len(sys.argv) > 2 else 80
+    n_events = int(sys.argv[3]) if len(sys.argv) > 3 else 5000
+    rng = np.random.default_rng(0)
+    # two-cohort structure so recommendations are visibly non-random
+    for _ in range(n_events):
+        u = int(rng.integers(n_users))
+        i = int(rng.integers(n_items))
+        aligned = (u % 2) == (i % 2)
+        rating = float(rng.choice([4, 5] if aligned else [1, 2]))
+        print(json.dumps({
+            "event": "rate",
+            "entityType": "user", "entityId": f"u{u}",
+            "targetEntityType": "item", "targetEntityId": f"i{i}",
+            "properties": {"rating": rating},
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Full lifecycle on synthetic data: app -> import -> engine scaffold ->
+# build -> train -> deploy -> query -> undeploy. Runs anywhere (CPU ok);
+# set PIO_FS_BASEDIR to keep the demo's storage isolated.
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PIO="${HERE}/../../bin/pio"
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+PORT="${QUICKSTART_PORT:-8199}"
+export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
+
+echo "== 1. app + events"
+# unique per-run app name: the demo works against pre-existing storage
+# and reruns of the same workdir
+APP_NAME="quickstart-$(date +%s)-$$"
+"$PIO" app new "$APP_NAME" | tee "$WORK/app.json"
+APP_ID=$(python3 -c "import json,sys; print(json.load(open(sys.argv[1]))['id'])" "$WORK/app.json")
+python3 "$HERE/gen_events.py" > "$WORK/events.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/events.jsonl"
+
+echo "== 2. engine project"
+if [ ! -f "$WORK/engine/engine.json" ]; then
+  "$PIO" template get recommendation "$WORK/engine"
+fi
+cd "$WORK/engine"
+# point the scaffolded variant at THIS run's app id
+python3 - "$APP_ID" <<'PY'
+import json, sys
+v = json.load(open("engine.json"))
+v["datasource"]["params"]["app_id"] = int(sys.argv[1])
+json.dump(v, open("engine.json", "w"), indent=2)
+PY
+"$PIO" build --engine-dir .
+
+echo "== 3. train"
+"$PIO" train --engine-dir .
+
+echo "== 4. deploy + query"
+"$PIO" deploy --engine-dir . --port "$PORT" --spawn
+trap '"$PIO" undeploy --port "$PORT" >/dev/null 2>&1 || true' EXIT
+up=""
+for i in $(seq 1 45); do
+  if curl -sf "http://127.0.0.1:$PORT/" >/dev/null 2>&1; then up=1; break; fi
+  sleep 1
+done
+if [ -z "$up" ]; then
+  echo "ERROR: query server did not come up on :$PORT within 45s" >&2
+  tail -20 "$PIO_FS_BASEDIR"/logs/run_server-*.log >&2 || true
+  exit 1
+fi
+echo "-- u0 (even cohort) top 5:"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"user": "u0", "num": 5}'
+echo
+echo "-- u1 (odd cohort) top 5:"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"user": "u1", "num": 5}'
+echo
+
+echo "== 5. undeploy"
+"$PIO" undeploy --port "$PORT"
+trap - EXIT
+echo "QUICKSTART COMPLETE (workdir: $WORK)"
