@@ -7,6 +7,7 @@ HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 PIO="${HERE}/../../bin/pio"
 WORK="${1:-$(mktemp -d)}"
 mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"  # absolutize: the script cds into the engine dir
 PORT="${QUICKSTART_PORT:-8199}"
 export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
 

@@ -269,13 +269,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("status", help="verify storage backends")
 
-    ex = sub.add_parser("export", help="export app events to JSON-lines")
+    ex = sub.add_parser("export", help="export app events (json/parquet)")
     ex.add_argument("--appid", type=int, required=True)
     ex.add_argument("--output", required=True)
+    ex.add_argument("--format", choices=("json", "parquet"), default="json")
 
-    im = sub.add_parser("import", help="import JSON-lines events into an app")
+    im = sub.add_parser("import", help="import events into an app (json/parquet)")
     im.add_argument("--appid", type=int, required=True)
     im.add_argument("--input", required=True)
+    im.add_argument("--format", choices=("json", "parquet"), default="json")
 
     tp = sub.add_parser(
         "template",
@@ -599,18 +601,25 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         return EXIT_OK
 
     if cmd == "export":
-        from .export_events import export_events
+        from .export_events import export_events, export_events_parquet
 
-        with open(args.output, "w", encoding="utf-8") as fh:
-            n = export_events(registry, args.appid, fh)
-        _emit({"appId": args.appid, "events": n, "output": args.output})
+        if args.format == "parquet":
+            n = export_events_parquet(registry, args.appid, args.output)
+        else:
+            with open(args.output, "w", encoding="utf-8") as fh:
+                n = export_events(registry, args.appid, fh)
+        _emit({"appId": args.appid, "events": n, "output": args.output,
+               "format": args.format})
         return EXIT_OK
 
     if cmd == "import":
-        from .import_events import import_events
+        from .import_events import import_events, import_events_parquet
 
-        with open(args.input, "r", encoding="utf-8") as fh:
-            n = import_events(registry, args.appid, fh)
+        if args.format == "parquet":
+            n = import_events_parquet(registry, args.appid, args.input)
+        else:
+            with open(args.input, "r", encoding="utf-8") as fh:
+                n = import_events(registry, args.appid, fh)
         _emit({"appId": args.appid, "events": n, "input": args.input})
         return EXIT_OK
 

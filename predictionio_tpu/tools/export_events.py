@@ -1,19 +1,51 @@
-"""Event export: app's events → JSON-lines file.
+"""Event export: app's events → JSON-lines or Parquet.
 
-Rebuild of ``tools/.../export/EventsToFile.scala`` (``PEvents.find`` → one
-JSON document per line via SQLContext there; a streamed JSON-lines writer
-here — same on-disk format as the reference's ``--format json`` mode, so
-files round-trip between the two).
+Rebuild of ``tools/.../export/EventsToFile.scala``: ``--format json``
+streams one JSON document per line (the cross-implementation interop
+format — files round-trip with the reference); ``--format parquet``
+writes a columnar archive (the reference's default format, produced there
+via SQLContext schema inference). Here the parquet schema is fixed and
+exact-roundtrip: scalar event fields as columns, ``properties``/``tags``
+as JSON-encoded strings — schema inference over free-form property bags
+would null-fill missing keys, which corrupts ``$unset`` semantics on
+re-import.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Optional, Sequence, TextIO
+from typing import Iterator, Optional, Sequence, TextIO
 
 from ..storage import EventFilter, StorageRegistry, get_registry
+from ..storage.event import Event, format_event_time
+
+#: rows per parquet row group / streaming chunk
+_CHUNK = 10_000
+
+_PARQUET_COLUMNS = (
+    "eventId", "event", "entityType", "entityId", "targetEntityType",
+    "targetEntityId", "properties", "eventTime", "tags", "prId",
+    "creationTime",
+)
+
+
+def _event_row(e: Event) -> dict:
+    return {
+        "eventId": e.event_id,
+        "event": e.event,
+        "entityType": e.entity_type,
+        "entityId": e.entity_id,
+        "targetEntityType": e.target_entity_type,
+        "targetEntityId": e.target_entity_id,
+        "properties": json.dumps(e.properties.to_dict(), separators=(",", ":")),
+        "eventTime": format_event_time(e.event_time),
+        "tags": json.dumps(list(e.tags)),
+        "prId": e.pr_id,
+        "creationTime": format_event_time(e.creation_time),
+    }
 
 
 def export_events(
@@ -33,6 +65,52 @@ def export_events(
     return count
 
 
+def export_events_parquet(
+    registry: StorageRegistry,
+    app_id: int,
+    path: str,
+    event_filter: Optional[EventFilter] = None,
+) -> int:
+    """Columnar export, streamed in row groups (bounded memory)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    schema = pa.schema([(c, pa.string()) for c in _PARQUET_COLUMNS])
+    store = registry.get_events()
+
+    def chunks() -> Iterator[list]:
+        buf: list = []
+        for event in store.find(app_id, event_filter or EventFilter()):
+            buf.append(_event_row(event))
+            if len(buf) >= _CHUNK:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    count = 0
+    writer = pq.ParquetWriter(path, schema)
+    try:
+        wrote = False
+        for buf in chunks():
+            writer.write_table(pa.Table.from_pylist(buf, schema=schema))
+            count += len(buf)
+            wrote = True
+        if not wrote:  # schema-only file so imports of empty exports work
+            writer.write_table(pa.Table.from_pylist([], schema=schema))
+    except BaseException:
+        # close() finalizes a VALID footer over whatever was written — a
+        # partial archive that would later import silently. Remove it.
+        writer.close()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
+    writer.close()
+    return count
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..utils.platform import apply_env_platform
 
@@ -40,11 +118,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="export_events")
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--output", required=True)
+    p.add_argument(
+        "--format", choices=("json", "parquet"), default="json",
+        help="json = interop JSON-lines (default); parquet = columnar "
+        "archive (the reference's default format)",
+    )
     args = p.parse_args(argv)
     registry = get_registry()
-    with open(args.output, "w", encoding="utf-8") as fh:
-        n = export_events(registry, args.appid, fh)
-    print(json.dumps({"appId": args.appid, "events": n, "output": args.output}))
+    if args.format == "parquet":
+        n = export_events_parquet(registry, args.appid, args.output)
+    else:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            n = export_events(registry, args.appid, fh)
+    print(
+        json.dumps(
+            {
+                "appId": args.appid,
+                "events": n,
+                "output": args.output,
+                "format": args.format,
+            }
+        )
+    )
     return 0
 
 

@@ -35,18 +35,12 @@ def _parse_lines(lines: Iterable[str]) -> Iterable[Event]:
         yield event
 
 
-def import_events(
-    registry: StorageRegistry,
-    app_id: int,
-    lines: Iterable[str],
-    batch_size: int = 1000,
-) -> int:
-    """Bulk-insert events in batches; returns the number imported."""
-    store = registry.get_events()
-    store.init(app_id)
-    batch = []
+def _write_batches(store, app_id: int, events: Iterable[Event],
+                   batch_size: int) -> int:
+    """Accumulate-and-flush shared by every import format."""
+    batch: list = []
     count = 0
-    for event in _parse_lines(lines):
+    for event in events:
         batch.append(event)
         if len(batch) >= batch_size:
             store.write(batch, app_id)
@@ -58,6 +52,60 @@ def import_events(
     return count
 
 
+def import_events(
+    registry: StorageRegistry,
+    app_id: int,
+    lines: Iterable[str],
+    batch_size: int = 1000,
+) -> int:
+    """Bulk-insert events in batches; returns the number imported."""
+    store = registry.get_events()
+    store.init(app_id)
+    return _write_batches(store, app_id, _parse_lines(lines), batch_size)
+
+
+def _parse_parquet_rows(path: str, batch_size: int) -> Iterable[Event]:
+    """Row → Event stream with row-index error attribution (matching the
+    JSON path's line-number contract)."""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    rowno = 0
+    for record_batch in pf.iter_batches(batch_size=batch_size):
+        for row in record_batch.to_pylist():
+            rowno += 1
+            try:
+                obj = {
+                    k: v
+                    for k, v in row.items()
+                    if k not in ("properties", "tags") and v is not None
+                }
+                obj["properties"] = json.loads(row["properties"] or "{}")
+                tags = json.loads(row["tags"] or "[]")
+                if tags:
+                    obj["tags"] = tags
+                event = Event.from_json_dict(obj)
+                validate_event(event)
+            except Exception as exc:
+                raise ImportError_(f"row {rowno}: {exc}") from exc
+            yield event
+
+
+def import_events_parquet(
+    registry: StorageRegistry,
+    app_id: int,
+    path: str,
+    batch_size: int = 1000,
+) -> int:
+    """Import a parquet archive written by ``export_events_parquet``
+    (row groups stream through bounded batches)."""
+    store = registry.get_events()
+    store.init(app_id)
+    return _write_batches(
+        store, app_id, _parse_parquet_rows(path, batch_size), batch_size
+    )
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..utils.platform import apply_env_platform
 
@@ -65,10 +113,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="import_events")
     p.add_argument("--appid", type=int, required=True)
     p.add_argument("--input", required=True)
+    p.add_argument(
+        "--format", choices=("json", "parquet"), default="json",
+        help="json = JSON-lines (default); parquet = archives written by "
+        "`pio export --format parquet`",
+    )
     args = p.parse_args(argv)
     registry = get_registry()
-    with open(args.input, "r", encoding="utf-8") as fh:
-        n = import_events(registry, args.appid, fh)
+    if args.format == "parquet":
+        n = import_events_parquet(registry, args.appid, args.input)
+    else:
+        with open(args.input, "r", encoding="utf-8") as fh:
+            n = import_events(registry, args.appid, fh)
     print(json.dumps({"appId": args.appid, "events": n, "input": args.input}))
     return 0
 

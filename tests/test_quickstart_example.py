@@ -4,16 +4,23 @@ undeploy through the real CLI and subprocesses); keep it runnable."""
 
 import json
 import os
+import socket
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_quickstart_runs_end_to_end(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["QUICKSTART_PORT"] = "8431"
+    env["QUICKSTART_PORT"] = str(_free_port())
     env.pop("PIO_FS_BASEDIR", None)
     out = subprocess.run(
         ["bash", "examples/movielens_quickstart/run.sh", str(tmp_path)],

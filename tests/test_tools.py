@@ -448,3 +448,76 @@ def test_upgrade_cli(tmp_path, monkeypatch):
     assert sum(1 for _ in dst.find(5)) == 1
     dst.close()
     get_registry(refresh=True)
+
+
+class TestParquetExportImport:
+    """Parquet archive roundtrip (the reference EventsToFile's default
+    format) — exact event fidelity including $unset null properties."""
+
+    def test_roundtrip(self, registry, tmp_path):
+        import datetime as dt
+
+        from predictionio_tpu.storage import DataMap, Event
+        from predictionio_tpu.tools.export_events import export_events_parquet
+        from predictionio_tpu.tools.import_events import import_events_parquet
+
+        ev = registry.get_events()
+        ev.init(1)
+        t = dt.datetime(2026, 7, 3, 12, 0, tzinfo=dt.timezone.utc)
+        events = [
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.5, "note": "héllo"}),
+                  event_time=t, pr_id="PR123"),
+            Event(event="$set", entity_type="user", entity_id="u2",
+                  properties=DataMap({"plan": "gold"}), event_time=t),
+            Event(event="$unset", entity_type="user", entity_id="u2",
+                  properties=DataMap({"plan": None}), event_time=t,
+                  tags=("a", "b")),
+        ]
+        ev.write(events, 1)
+        path = str(tmp_path / "events.parquet")
+        n = export_events_parquet(registry, 1, path)
+        assert n == 3
+
+        n2 = import_events_parquet(registry, 2, path)
+        assert n2 == 3
+        from predictionio_tpu.storage.events import EventFilter
+
+        back = list(ev.find(2, EventFilter()))
+        assert len(back) == 3
+        rate = [e for e in back if e.event == "rate"][0]
+        assert rate.properties["rating"] == 4.5
+        assert rate.properties["note"] == "héllo"
+        assert rate.pr_id == "PR123"
+        unset = [e for e in back if e.event == "$unset"][0]
+        assert unset.properties.to_dict() == {"plan": None}  # keys survive
+        assert unset.tags == ("a", "b")
+
+    def test_empty_export_imports_cleanly(self, registry, tmp_path):
+        from predictionio_tpu.tools.export_events import export_events_parquet
+        from predictionio_tpu.tools.import_events import import_events_parquet
+
+        registry.get_events().init(5)
+        path = str(tmp_path / "empty.parquet")
+        assert export_events_parquet(registry, 5, path) == 0
+        assert import_events_parquet(registry, 6, path) == 0
+
+    def test_cli_flags(self, registry, tmp_path, monkeypatch):
+        import predictionio_tpu.storage.registry as regmod
+        from predictionio_tpu.storage import DataMap, Event
+        from predictionio_tpu.tools.console import main
+
+        monkeypatch.setattr(regmod, "_default_registry", registry)
+        ev = registry.get_events()
+        ev.init(3)
+        ev.write([Event(event="view", entity_type="user", entity_id="u9",
+                        target_entity_type="item", target_entity_id="i9")], 3)
+        out = str(tmp_path / "a.parquet")
+        assert main(["export", "--appid", "3", "--output", out,
+                     "--format", "parquet"], registry) == 0
+        assert main(["import", "--appid", "4", "--input", out,
+                     "--format", "parquet"], registry) == 0
+        from predictionio_tpu.storage.events import EventFilter
+
+        assert len(list(ev.find(4, EventFilter()))) == 1
