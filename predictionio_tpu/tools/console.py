@@ -405,31 +405,48 @@ def main(
 ) -> int:
     from ..utils.platform import apply_env_platform
 
+    import signal
+
     apply_env_platform()
     args = build_parser().parse_args(argv)
+    # Short-lived CLI commands die quietly on a closed pipe (`pio app new
+    # | grep -q ...` closes stdout early) — default Unix behavior, not a
+    # Python traceback. Server subcommands keep Python's SIGPIPE=ignored
+    # so a client disconnect mid-write surfaces as the BrokenPipeError
+    # their handlers treat as normal operation, instead of killing the
+    # process. The old disposition is RESTORED on return (after a flush
+    # that still runs under SIG_DFL, so a dead pipe kills quietly before
+    # the interpreter's exit flush can raise noisily): in-process callers
+    # (tests, embedding apps) must not inherit a process-killing SIGPIPE.
+    prev = None
     if args.command not in (
         "eventserver", "dashboard", "storageserver", "deploy",
     ):
-        # Short-lived CLI commands die quietly on a closed pipe
-        # (`pio app new | grep -q ...` closes stdout early) — default
-        # Unix behavior, not a Python traceback. Server subcommands keep
-        # Python's SIGPIPE=ignored so a client disconnect mid-write
-        # surfaces as the BrokenPipeError their handlers already treat
-        # as normal operation, instead of killing the process.
-        import signal
-
         try:
-            signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+            cur = signal.getsignal(signal.SIGPIPE)
+            if cur is not None:  # None = C-installed handler: unrestorable,
+                signal.signal(signal.SIGPIPE, signal.SIG_DFL)  # leave as-is
+                prev = cur
         except (AttributeError, ValueError):
-            pass  # non-POSIX, or called from a non-main thread (tests)
-    registry = registry or get_registry()
+            pass  # non-POSIX, or a non-main thread (tests)
     try:
+        registry = registry or get_registry()
         return _dispatch(args, registry)
     except KeyboardInterrupt:
         return EXIT_FAIL
     except Exception as exc:  # every operator error → JSON + exit 1
         _emit({"error": str(exc)})
         return EXIT_FAIL
+    finally:
+        if prev is not None:
+            try:
+                sys.stdout.flush()
+            except (BrokenPipeError, OSError):
+                pass
+            try:
+                signal.signal(signal.SIGPIPE, prev)
+            except (AttributeError, ValueError):
+                pass
 
 
 def _confirm_destructive(args: argparse.Namespace, action: str) -> bool:
