@@ -1,0 +1,29 @@
+"""$set-property events for the classification quickstart.
+
+Three feature attributes determine the plan label by a simple rule the
+classifier should recover: plan = 1 when attr0 + attr1 > attr2 else 0.
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    rng = np.random.default_rng(0)
+    for u in range(n_users):
+        a0, a1, a2 = (int(rng.integers(0, 5)) for _ in range(3))
+        print(json.dumps({
+            "event": "$set",
+            "entityType": "user", "entityId": f"u{u}",
+            "properties": {
+                "attr0": a0, "attr1": a1, "attr2": a2,
+                "plan": 1 if a0 + a1 > a2 else 0,
+            },
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

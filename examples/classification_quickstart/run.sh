@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Classification lifecycle: $set entity properties -> aggregate ->
+# Naive Bayes on device -> deployed label predictions.
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PIO="${HERE}/../../bin/pio"
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"
+PORT="${QUICKSTART_PORT:-8198}"
+export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
+
+echo "== 1. app + property events"
+APP_NAME="clsdemo-$(date +%s)-$$"
+"$PIO" app new "$APP_NAME" | tee "$WORK/app.json"
+APP_ID=$(python3 -c "import json,sys; print(json.load(open(sys.argv[1]))['id'])" "$WORK/app.json")
+python3 "$HERE/gen_events.py" > "$WORK/events.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/events.jsonl"
+
+echo "== 2. engine + train"
+if [ ! -f "$WORK/engine/engine.json" ]; then
+  "$PIO" template get classification "$WORK/engine"
+fi
+cd "$WORK/engine"
+python3 - "$APP_ID" <<'PY'
+import json, sys
+v = json.load(open("engine.json"))
+v["datasource"]["params"]["app_id"] = int(sys.argv[1])
+json.dump(v, open("engine.json", "w"), indent=2)
+PY
+"$PIO" build --engine-dir .
+"$PIO" train --engine-dir .
+
+echo "== 3. deploy + query"
+"$PIO" deploy --engine-dir . --port "$PORT" --spawn
+trap '"$PIO" undeploy --port "$PORT" >/dev/null 2>&1 || true' EXIT
+up=""
+for i in $(seq 1 45); do
+  if curl -sf "http://127.0.0.1:$PORT/" >/dev/null 2>&1; then up=1; break; fi
+  sleep 1
+done
+if [ -z "$up" ]; then
+  echo "ERROR: query server did not come up on :$PORT within 45s" >&2
+  tail -20 "$PIO_FS_BASEDIR"/logs/run_server-*.log >&2 || true
+  exit 1
+fi
+echo "-- features [4,4,0] (4+4>0 => expect label 1):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"features": [4, 4, 0]}'
+echo
+echo "-- features [0,0,4] (0+0<4 => expect label 0):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"features": [0, 0, 4]}'
+echo
+
+"$PIO" undeploy --port "$PORT"
+trap - EXIT
+echo "CLASSIFICATION QUICKSTART COMPLETE (workdir: $WORK)"

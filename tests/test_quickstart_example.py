@@ -1,12 +1,11 @@
-"""The examples/movielens_quickstart script is the full-lifecycle
-integration proof (app → import → build → train → deploy → query →
-undeploy through the real CLI and subprocesses); keep it runnable."""
+"""The examples/ quickstart scripts are the full-lifecycle integration
+proofs (app → import → build → train → deploy → query → undeploy through
+the real CLI and subprocesses); keep them runnable."""
 
 import json
 import os
 import socket
 import subprocess
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -17,13 +16,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_quickstart_runs_end_to_end(tmp_path):
+def _run_quickstart(script: str, workdir, marker: str) -> str:
+    """Launch one quickstart script the way a user would; returns stdout."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["QUICKSTART_PORT"] = str(_free_port())
-    env.pop("PIO_FS_BASEDIR", None)
+    env.pop("PIO_FS_BASEDIR", None)  # storage isolated to the workdir
     out = subprocess.run(
-        ["bash", "examples/movielens_quickstart/run.sh", str(tmp_path)],
+        ["bash", script, str(workdir)],
         cwd=REPO,
         env=env,
         capture_output=True,
@@ -31,10 +31,18 @@ def test_quickstart_runs_end_to_end(tmp_path):
         timeout=540,
     )
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
-    assert "QUICKSTART COMPLETE" in out.stdout
+    assert marker in out.stdout, out.stdout[-2000:]
+    return out.stdout
+
+
+def test_quickstart_runs_end_to_end(tmp_path):
+    stdout = _run_quickstart(
+        "examples/movielens_quickstart/run.sh", tmp_path,
+        "QUICKSTART COMPLETE",
+    )
     # the two cohorts' top lists must come from opposite item parities
-    lines = [ln for ln in out.stdout.splitlines() if ln.startswith('{"itemScores"')]
-    assert len(lines) == 2, out.stdout[-2000:]
+    lines = [ln for ln in stdout.splitlines() if ln.startswith('{"itemScores"')]
+    assert len(lines) == 2, stdout[-2000:]
     tops = [
         [int(r["item"][1:]) % 2 for r in json.loads(ln)["itemScores"]]
         for ln in lines
@@ -43,5 +51,14 @@ def test_quickstart_runs_end_to_end(tmp_path):
     assert sum(tops[1]) >= 4, tops  # u1 (odd): nearly all odd items
 
 
-if __name__ == "__main__":
-    sys.exit(0)
+def test_classification_quickstart_runs_end_to_end(tmp_path):
+    stdout = _run_quickstart(
+        "examples/classification_quickstart/run.sh", tmp_path,
+        "CLASSIFICATION QUICKSTART COMPLETE",
+    )
+    labels = [
+        json.loads(ln)["label"]
+        for ln in stdout.splitlines()
+        if ln.startswith('{"label"')
+    ]
+    assert labels == [1.0, 0.0], stdout[-1500:]
