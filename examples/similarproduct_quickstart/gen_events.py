@@ -1,0 +1,42 @@
+"""Events for the similarproduct quickstart: $set users/items (with
+categories), view streams, and like/dislike signals.
+
+Items form two category clusters; users view within their cluster, so
+items from one cluster should surface as most similar to each other.
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+    n_items = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    rng = np.random.default_rng(0)
+    for u in range(n_users):
+        print(json.dumps({"event": "$set", "entityType": "user",
+                          "entityId": f"u{u}", "properties": {}}))
+    for i in range(n_items):
+        cluster = "electronics" if i % 2 == 0 else "books"
+        print(json.dumps({"event": "$set", "entityType": "item",
+                          "entityId": f"i{i}",
+                          "properties": {"categories": [cluster]}}))
+    for u in range(n_users):
+        parity = u % 2
+        for _ in range(30):
+            i = int(rng.integers(n_items // 2)) * 2 + parity
+            print(json.dumps({"event": "view", "entityType": "user",
+                              "entityId": f"u{u}",
+                              "targetEntityType": "item",
+                              "targetEntityId": f"i{i}"}))
+            if rng.random() < 0.3:
+                print(json.dumps({"event": "like", "entityType": "user",
+                                  "entityId": f"u{u}",
+                                  "targetEntityType": "item",
+                                  "targetEntityId": f"i{i}"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Similar-product lifecycle: $set users/items + view/like streams ->
+# ALS item factors -> deployed "items similar to X" queries (ensemble
+# serving with the like-filtered algorithm when configured).
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PIO="${HERE}/../../bin/pio"
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"
+PORT="${QUICKSTART_PORT:-8197}"
+export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
+
+echo "== 1. app + events"
+APP_NAME="simdemo-$(date +%s)-$$"
+"$PIO" app new "$APP_NAME" | tee "$WORK/app.json"
+APP_ID=$(python3 -c "import json,sys; print(json.load(open(sys.argv[1]))['id'])" "$WORK/app.json")
+python3 "$HERE/gen_events.py" > "$WORK/events.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/events.jsonl"
+
+echo "== 2. engine + train"
+if [ ! -f "$WORK/engine/engine.json" ]; then
+  "$PIO" template get similarproduct "$WORK/engine"
+fi
+cd "$WORK/engine"
+python3 - "$APP_ID" <<'PY'
+import json, sys
+v = json.load(open("engine.json"))
+v["datasource"]["params"]["app_id"] = int(sys.argv[1])
+json.dump(v, open("engine.json", "w"), indent=2)
+PY
+"$PIO" build --engine-dir .
+"$PIO" train --engine-dir .
+
+echo "== 3. deploy + query"
+"$PIO" deploy --engine-dir . --port "$PORT" --spawn
+trap '"$PIO" undeploy --port "$PORT" >/dev/null 2>&1 || true' EXIT
+up=""
+for i in $(seq 1 45); do
+  if curl -sf "http://127.0.0.1:$PORT/" >/dev/null 2>&1; then up=1; break; fi
+  sleep 1
+done
+if [ -z "$up" ]; then
+  echo "ERROR: query server did not come up on :$PORT within 45s" >&2
+  tail -20 "$PIO_FS_BASEDIR"/logs/run_server-*.log >&2 || true
+  exit 1
+fi
+echo "-- items similar to i0 (electronics cluster => expect even ids):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"items": ["i0"], "num": 5}'
+echo
+echo "-- items similar to i1 (books cluster => expect odd ids):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"items": ["i1"], "num": 5}'
+echo
+
+"$PIO" undeploy --port "$PORT"
+trap - EXIT
+echo "SIMILARPRODUCT QUICKSTART COMPLETE (workdir: $WORK)"

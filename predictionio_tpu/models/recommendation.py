@@ -53,11 +53,9 @@ class PredictedResult:
     item_scores: Tuple[ItemScore, ...]
 
     def to_json_dict(self) -> dict:
-        return {
-            "itemScores": [
-                {"item": s.item, "score": s.score} for s in self.item_scores
-            ]
-        }
+        from .wire import item_scores_json
+
+        return item_scores_json(self.item_scores)
 
 
 # -- training data ----------------------------------------------------------

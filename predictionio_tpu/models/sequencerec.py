@@ -57,6 +57,12 @@ class ItemScore:
 class PredictedResult:
     item_scores: Tuple[ItemScore, ...] = ()
 
+    def to_json_dict(self) -> dict:
+        # same camelCase wire shape as the recommender templates
+        from .wire import item_scores_json
+
+        return item_scores_json(self.item_scores)
+
 
 # -- training data ----------------------------------------------------------
 @dataclasses.dataclass

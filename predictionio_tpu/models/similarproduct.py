@@ -71,6 +71,13 @@ class ItemScore:
 class PredictedResult:
     item_scores: Tuple[ItemScore, ...]
 
+    def to_json_dict(self) -> dict:
+        # reference wire shape: camelCase itemScores
+        # (examples/scala-parallel-similarproduct Engine.scala)
+        from .wire import item_scores_json
+
+        return item_scores_json(self.item_scores)
+
 
 @dataclasses.dataclass
 class ViewEvent:

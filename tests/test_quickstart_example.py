@@ -62,3 +62,17 @@ def test_classification_quickstart_runs_end_to_end(tmp_path):
         if ln.startswith('{"label"')
     ]
     assert labels == [1.0, 0.0], stdout[-1500:]
+
+
+def test_similarproduct_quickstart_runs_end_to_end(tmp_path):
+    stdout = _run_quickstart(
+        "examples/similarproduct_quickstart/run.sh", tmp_path,
+        "SIMILARPRODUCT QUICKSTART COMPLETE",
+    )
+    # reference wire shape (camelCase) and cluster structure
+    lines = [ln for ln in stdout.splitlines() if ln.startswith('{"itemScores"')]
+    assert len(lines) == 2, stdout[-2000:]
+    for ln, parity in zip(lines, (0, 1)):
+        items = [r["item"] for r in json.loads(ln)["itemScores"]]
+        wrong = [it for it in items if int(it[1:]) % 2 != parity]
+        assert len(wrong) <= 1, (items, parity)

@@ -196,3 +196,15 @@ class TestWindowTail:
         blob = pickle.dumps(model)
         clone = pickle.loads(blob)
         assert "_device_params" not in clone.__dict__
+
+
+def test_predicted_result_wire_shape():
+    """Serving JSON must be the reference's camelCase itemScores — shared
+    with every recommender template via models.wire."""
+    from predictionio_tpu.models.sequencerec import ItemScore, PredictedResult
+    from predictionio_tpu.workflow.serving import encode_result
+
+    r = PredictedResult(item_scores=(ItemScore(item="i1", score=0.5),))
+    assert encode_result(r) == {
+        "itemScores": [{"item": "i1", "score": 0.5}]
+    }
