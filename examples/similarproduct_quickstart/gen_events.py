@@ -30,8 +30,14 @@ def main() -> int:
                               "entityId": f"u{u}",
                               "targetEntityType": "item",
                               "targetEntityId": f"i{i}"}))
-            if rng.random() < 0.3:
+            r = rng.random()
+            if r < 0.3:
                 print(json.dumps({"event": "like", "entityType": "user",
+                                  "entityId": f"u{u}",
+                                  "targetEntityType": "item",
+                                  "targetEntityId": f"i{i}"}))
+            elif r > 0.95:
+                print(json.dumps({"event": "dislike", "entityType": "user",
                                   "entityId": f"u{u}",
                                   "targetEntityType": "item",
                                   "targetEntityId": f"i{i}"}))

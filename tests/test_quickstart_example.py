@@ -74,5 +74,6 @@ def test_similarproduct_quickstart_runs_end_to_end(tmp_path):
     assert len(lines) == 2, stdout[-2000:]
     for ln, parity in zip(lines, (0, 1)):
         items = [r["item"] for r in json.loads(ln)["itemScores"]]
+        assert len(items) >= 3, (items, parity)  # empty results must fail
         wrong = [it for it in items if int(it[1:]) % 2 != parity]
         assert len(wrong) <= 1, (items, parity)
