@@ -1,0 +1,33 @@
+"""Events for the ecommerce quickstart: $set users/items + rate events
+(two-cohort structure: even users love even items)."""
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 60
+    n_items = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    rng = np.random.default_rng(0)
+    for u in range(n_users):
+        print(json.dumps({"event": "$set", "entityType": "user",
+                          "entityId": f"u{u}", "properties": {}}))
+    for i in range(n_items):
+        print(json.dumps({"event": "$set", "entityType": "item",
+                          "entityId": f"i{i}", "properties": {}}))
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.random() < 0.6:
+                aligned = (u % 2) == (i % 2)
+                print(json.dumps({
+                    "event": "rate",
+                    "entityType": "user", "entityId": f"u{u}",
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {"rating": 5.0 if aligned else 1.0},
+                }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# E-commerce lifecycle with LIVE serving-time filters: after deployment,
+# new buy events and an $set unavailableItems constraint change results
+# WITHOUT retraining -- the algorithm reads them from the event store at
+# query time under a 200 ms budget.
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PIO="${HERE}/../../bin/pio"
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"
+PORT="${QUICKSTART_PORT:-8196}"
+export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
+
+echo "== 1. app + events"
+APP_NAME="ecomdemo-$(date +%s)-$$"
+"$PIO" app new "$APP_NAME" | tee "$WORK/app.json"
+APP_ID=$(python3 -c "import json,sys; print(json.load(open(sys.argv[1]))['id'])" "$WORK/app.json")
+python3 "$HERE/gen_events.py" > "$WORK/events.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/events.jsonl"
+
+echo "== 2. engine + train"
+if [ ! -f "$WORK/engine/engine.json" ]; then
+  "$PIO" template get ecommerce "$WORK/engine"
+fi
+cd "$WORK/engine"
+python3 - "$APP_ID" <<'PY'
+import json, sys
+v = json.load(open("engine.json"))
+app_id = int(sys.argv[1])
+v["datasource"]["params"]["app_id"] = app_id
+for algo in v["algorithms"]:
+    algo["params"]["app_id"] = app_id  # live serving-time reads
+json.dump(v, open("engine.json", "w"), indent=2)
+PY
+"$PIO" build --engine-dir .
+"$PIO" train --engine-dir .
+
+echo "== 3. deploy"
+"$PIO" deploy --engine-dir . --port "$PORT" --spawn
+trap '"$PIO" undeploy --port "$PORT" >/dev/null 2>&1 || true' EXIT
+up=""
+for i in $(seq 1 45); do
+  if curl -sf "http://127.0.0.1:$PORT/" >/dev/null 2>&1; then up=1; break; fi
+  sleep 1
+done
+if [ -z "$up" ]; then
+  echo "ERROR: query server did not come up on :$PORT within 45s" >&2
+  tail -20 "$PIO_FS_BASEDIR"/logs/run_server-*.log >&2 || true
+  exit 1
+fi
+
+query() {
+  curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+    -H 'Content-Type: application/json' -d '{"user": "u0", "num": 3}'
+}
+echo "-- u0 top 3 before any live events:"
+FIRST=$(query); echo "$FIRST"
+TOP=$(python3 -c "import json,sys; print(json.loads(sys.argv[1])['itemScores'][0]['item'])" "$FIRST")
+SECOND_ITEM=$(python3 -c "import json,sys; print(json.loads(sys.argv[1])['itemScores'][1]['item'])" "$FIRST")
+
+echo "-- u0 buys $TOP (live event, no retrain)"
+python3 -c "
+import json
+print(json.dumps({'event': 'buy', 'entityType': 'user', 'entityId': 'u0',
+                  'targetEntityType': 'item', 'targetEntityId': '$TOP'}))
+" > "$WORK/live.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/live.jsonl" >/dev/null
+
+echo "-- $SECOND_ITEM goes out of stock (constraint entity)"
+python3 -c "
+import json
+print(json.dumps({'event': '\$set', 'entityType': 'constraint',
+                  'entityId': 'unavailableItems',
+                  'properties': {'items': ['$SECOND_ITEM']}}))
+" > "$WORK/live2.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/live2.jsonl" >/dev/null
+
+echo "-- u0 top 3 after (bought + unavailable items filtered):"
+AFTER=$(query); echo "$AFTER"
+python3 - "$FIRST" "$AFTER" "$TOP" "$SECOND_ITEM" <<'PY'
+import json, sys
+first, after, top, second = sys.argv[1:5]
+after_items = [r["item"] for r in json.loads(after)["itemScores"]]
+assert top not in after_items, f"bought item {top} still recommended"
+assert second not in after_items, f"unavailable item {second} still recommended"
+print(f"live filters verified: {top} (bought) and {second} (unavailable) dropped")
+PY
+
+"$PIO" undeploy --port "$PORT"
+trap - EXIT
+echo "ECOMMERCE QUICKSTART COMPLETE (workdir: $WORK)"
