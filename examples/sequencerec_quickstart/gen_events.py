@@ -1,0 +1,30 @@
+"""Timestamped view events for the sequencerec quickstart.
+
+Users walk a fixed cycle i0 -> i1 -> ... -> i11 -> i0 with per-user
+phase offsets, so the transformer can learn "next item = current + 1"
+and the demo query's prediction is checkable.
+"""
+import datetime as dt
+import json
+import sys
+
+
+def main() -> int:
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 40
+    cycle = 12
+    base = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    for u in range(n_users):
+        for step in range(24):
+            item = (u + step) % cycle
+            t = base + dt.timedelta(minutes=u * 1000 + step)
+            print(json.dumps({
+                "event": "view",
+                "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{item}",
+                "eventTime": t.isoformat().replace("+00:00", "Z"),
+            }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

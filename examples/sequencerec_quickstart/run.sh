@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Sequence-recommendation lifecycle: timestamped view streams -> causal
+# transformer next-item training -> deployed history-aware predictions.
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PIO="${HERE}/../../bin/pio"
+WORK="${1:-$(mktemp -d)}"
+mkdir -p "$WORK"
+WORK="$(cd "$WORK" && pwd)"
+PORT="${QUICKSTART_PORT:-8195}"
+export PIO_FS_BASEDIR="${PIO_FS_BASEDIR:-$WORK/storage}"
+
+echo "== 1. app + events"
+APP_NAME="seqdemo-$(date +%s)-$$"
+"$PIO" app new "$APP_NAME" | tee "$WORK/app.json"
+APP_ID=$(python3 -c "import json,sys; print(json.load(open(sys.argv[1]))['id'])" "$WORK/app.json")
+python3 "$HERE/gen_events.py" > "$WORK/events.jsonl"
+"$PIO" import --appid "$APP_ID" --input "$WORK/events.jsonl"
+
+echo "== 2. engine + train (small transformer for the demo)"
+if [ ! -f "$WORK/engine/engine.json" ]; then
+  "$PIO" template get sequencerec "$WORK/engine"
+fi
+cd "$WORK/engine"
+python3 - "$APP_ID" <<'PY'
+import json, sys
+v = json.load(open("engine.json"))
+v["datasource"]["params"]["app_id"] = int(sys.argv[1])
+v["algorithms"][0]["params"].update(
+    {"d_model": 32, "n_layers": 1, "steps": 200}
+)
+json.dump(v, open("engine.json", "w"), indent=2)
+PY
+"$PIO" build --engine-dir .
+"$PIO" train --engine-dir .
+
+echo "== 3. deploy + query"
+"$PIO" deploy --engine-dir . --port "$PORT" --spawn
+trap '"$PIO" undeploy --port "$PORT" >/dev/null 2>&1 || true' EXIT
+up=""
+for i in $(seq 1 45); do
+  if curl -sf "http://127.0.0.1:$PORT/" >/dev/null 2>&1; then up=1; break; fi
+  sleep 1
+done
+if [ -z "$up" ]; then
+  echo "ERROR: query server did not come up on :$PORT within 45s" >&2
+  tail -20 "$PIO_FS_BASEDIR"/logs/run_server-*.log >&2 || true
+  exit 1
+fi
+echo "-- history i3,i4,i5 (cycle says next = i6):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' \
+  -d '{"recent_items": ["i3", "i4", "i5"], "num": 3}'
+echo
+echo "-- u0's stored history (ends ...i10,i11 => expect i0-ish):"
+curl -s -X POST "http://127.0.0.1:$PORT/queries.json" \
+  -H 'Content-Type: application/json' -d '{"user": "u0", "num": 3}'
+echo
+
+"$PIO" undeploy --port "$PORT"
+trap - EXIT
+echo "SEQUENCEREC QUICKSTART COMPLETE (workdir: $WORK)"

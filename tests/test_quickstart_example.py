@@ -87,3 +87,15 @@ def test_ecommerce_quickstart_runs_end_to_end(tmp_path):
     # the script itself asserts the live filters dropped the bought and
     # unavailable items; confirm that verification line ran
     assert "live filters verified" in stdout, stdout[-2000:]
+
+
+def test_sequencerec_quickstart_runs_end_to_end(tmp_path):
+    stdout = _run_quickstart(
+        "examples/sequencerec_quickstart/run.sh", tmp_path,
+        "SEQUENCEREC QUICKSTART COMPLETE",
+    )
+    lines = [ln for ln in stdout.splitlines() if ln.startswith('{"itemScores"')]
+    assert len(lines) == 2, stdout[-2000:]
+    tops = [json.loads(ln)["itemScores"][0]["item"] for ln in lines]
+    # the cycle rule: [i3,i4,i5] -> i6; u0's history ends at i11 -> i0
+    assert tops == ["i6", "i0"], (tops, stdout[-1500:])
