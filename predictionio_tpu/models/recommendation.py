@@ -221,6 +221,12 @@ class ALSAlgorithmParams(Params):
     #: einsums (see ops.als.ALSConfig.gather_dtype; quality-gate before
     #: adopting bf16)
     gather_dtype: str = "f32"
+    #: Serving top-k path: "auto" (default) streams item blocks through
+    #: the Pallas kernel — never materializing the [batch, n_items] score
+    #: matrix in HBM — when on TPU and that matrix would exceed ~1 GB;
+    #: "always"/"never" force the choice (see
+    #: ops.pallas_kernels.top_k_for_users_streaming).
+    streaming_top_k: str = "auto"
 
 
 @dataclasses.dataclass
@@ -252,6 +258,13 @@ class ALSAlgorithm(Algorithm):
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         p = self.params
+        if p.streaming_top_k not in ("auto", "always", "never"):
+            # a config typo must fail the training run, not the first
+            # serving query after deploy
+            raise ValueError(
+                f"streaming_top_k must be 'auto', 'always' or 'never', "
+                f"got {p.streaming_top_k!r}"
+            )
         cfg = ALSConfig(
             rank=p.rank,
             iterations=p.num_iterations,
@@ -322,9 +335,18 @@ class ALSAlgorithm(Algorithm):
             b_pad = pad_pow2(b)
             k_pad = min(pad_pow2(max_k, lo=8), n_items)
             padded_idx = np.pad(user_idx, (0, b_pad - b))
-            scores, items = top_k_for_users(
-                model.user_factors, model.item_factors, padded_idx, k=k_pad
-            )
+            if self._use_streaming_topk(b_pad, n_items):
+                from ..ops.pallas_kernels import top_k_for_users_streaming
+
+                scores, items = top_k_for_users_streaming(
+                    model.user_factors, model.item_factors, padded_idx,
+                    k=k_pad,
+                )
+            else:
+                scores, items = top_k_for_users(
+                    model.user_factors, model.item_factors, padded_idx,
+                    k=k_pad,
+                )
             # one fetch for both arrays: each device_get is a full host↔
             # device round trip, which dominates per-batch latency on
             # high-latency links (tunneled/remote devices)
@@ -351,6 +373,29 @@ class ALSAlgorithm(Algorithm):
                     )
                 )
         return out
+
+    def _use_streaming_topk(self, b_pad: int, n_items: int) -> bool:
+        """Streaming keeps the [B, N] score matrix out of HBM entirely —
+        mandatory for huge catalogs, pointless overhead for small ones.
+        "auto" switches at ~1 GB of would-be scores on TPU (the XLA dense
+        path is faster below that and the interpret-mode kernel is slow
+        off-TPU)."""
+        mode = self.params.streaming_top_k
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        if mode != "auto":
+            raise ValueError(
+                f"streaming_top_k must be 'auto', 'always' or 'never', "
+                f"got {mode!r}"
+            )
+        import jax
+
+        return (
+            jax.default_backend() == "tpu"
+            and b_pad * n_items * 4 > (1 << 30)
+        )
 
     def query_class(self):
         return Query

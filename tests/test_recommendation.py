@@ -170,3 +170,51 @@ class TestEndToEnd:
         ctx = WorkflowContext("Training")
         with pytest.raises(ValueError, match="No rating events"):
             engine.train(ctx, engine_params())
+
+
+class TestStreamingTopKServing:
+    """The streaming serving path must produce the same results as the
+    dense path (forced via streaming_top_k="always"; on CPU the kernel
+    runs in interpret mode)."""
+
+    def test_streaming_matches_dense(self, registry):
+        """One trained model served through both paths — streaming_top_k
+        is serving-only, so the model is shared."""
+        from predictionio_tpu.models.recommendation import ALSAlgorithm
+
+        ingest_ratings(registry)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=1)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=4,
+                                           lambda_=0.05))
+            ],
+        )
+        iid = run_train(engine, params, registry, engine_id="stream")
+        model = load_models(registry, iid)[0]
+        results = {}
+        for mode in ("never", "always"):
+            algo = ALSAlgorithm(
+                ALSAlgorithmParams(rank=4, streaming_top_k=mode)
+            )
+            out = algo.batch_predict(
+                model,
+                [(0, Query(user="u0", num=4)), (1, Query(user="u3", num=4))],
+            )
+            results[mode] = {
+                i: [s.item for s in r.item_scores] for i, r in out
+            }
+        assert results["never"] == results["always"]
+
+    def test_bad_mode_fails_loudly_at_train_time(self, registry):
+        ingest_ratings(registry)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=1)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(streaming_top_k="bogus"))
+            ],
+        )
+        with pytest.raises(ValueError, match="streaming_top_k"):
+            run_train(engine, params, registry, engine_id="bad-mode")
