@@ -226,9 +226,13 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:
-        # the headline comparison must not claim the baseline was beaten.
+        # the headline comparison must not claim the baseline was beaten,
+        # and v5e-relative efficiency ratios computed from a CPU run are
+        # noise — drop them rather than let a dashboard chart them.
         record["fallback"] = fallback
         record["vs_baseline"] = 0.0
+        del record["est_mfu_f32_v5e"]
+        del record["est_hbm_util_v5e"]
         _attach_last_good(record)
     # quality gate: noise floor is 0.5; MLlib-parity training lands near it.
     if holdout > 0.62:

@@ -135,11 +135,14 @@ def _request(
     timeout: float = 60.0,
     idempotent: Optional[bool] = None,
 ):
-    """``idempotent`` enables the one-shot stale-connection retry. Default:
-    GET/DELETE only. POST call sites that are semantically reads (find,
-    columnar scans) or natural upserts (init, model put) opt in; event
-    writes must NOT — a request the server executed before dying would be
-    applied twice."""
+    """``idempotent`` enables connection pooling plus the one-shot
+    stale-connection retry. Default: GET/DELETE only. POST call sites that
+    are semantically reads (find, columnar scans) or natural upserts (init,
+    model put) opt in. Non-idempotent requests (event inserts, bulk writes)
+    never touch the pool: a pooled socket the server closed while idle
+    would fail the write, and retrying it is unsafe — a request the server
+    executed before dying would be applied twice. A fresh connection per
+    write keeps the old always-succeeds behavior for low-rate writers."""
     parsed = urllib.parse.urlsplit(url)
     if parsed.scheme not in ("http", "https"):
         raise RemoteStorageError(f"unsupported URL scheme in {url!r}")
@@ -155,7 +158,7 @@ def _request(
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
     headers = {"Content-Type": "application/json"} if body is not None else {}
     for attempt in (0, 1):
-        conn = _pool.conns.pop(netloc, None)
+        conn = _pool.conns.pop(netloc, None) if idempotent else None
         fresh = conn is None
         if fresh:
             conn = conn_cls(
@@ -324,17 +327,26 @@ class RemoteEventStore(EventStore):
             pass
 
 
+#: Pure-read metadata RPCs: pooled keep-alive + stale retry is safe for
+#: these (re-reading is harmless). Mutations (gen_next, inserts, updates,
+#: deletes) stay on fresh connections — gen_next retried twice burns a
+#: sequence value, an insert retried twice duplicates a row.
+_READ_RPC_METHODS = frozenset(m for m in METADATA_RPC_METHODS if "_get" in m)
+
+
 class _RemoteRPC:
     """One metadata RPC method bound to a URL."""
 
     def __init__(self, base: str, method: str, timeout: float):
         self._base, self._method, self._timeout = base, method, timeout
+        self._idempotent = method in _READ_RPC_METHODS
 
     def __call__(self, *args):
         body = json.dumps(
             {"method": self._method, "args": [encode(a) for a in args]}
         ).encode()
-        with _request(f"{self._base}/metadata/rpc", "POST", body, self._timeout) as r:
+        with _request(f"{self._base}/metadata/rpc", "POST", body,
+                      self._timeout, idempotent=self._idempotent) as r:
             return decode(_json(r)["result"])
 
 

@@ -1,14 +1,16 @@
 """Event export: app's events → JSON-lines or Parquet.
 
 Rebuild of ``tools/.../export/EventsToFile.scala``: ``--format json``
-streams one JSON document per line (the cross-implementation interop
-format — files round-trip with the reference); ``--format parquet``
-writes a columnar archive (the reference's default format, produced there
-via SQLContext schema inference). Here the parquet schema is fixed and
-exact-roundtrip: scalar event fields as columns, ``properties``/``tags``
-as JSON-encoded strings — schema inference over free-form property bags
-would null-fill missing keys, which corrupts ``$unset`` semantics on
-re-import.
+streams one JSON document per line — the ONLY cross-implementation interop
+format; these files round-trip with the reference. ``--format parquet``
+writes a columnar archive in *this implementation's own schema* (scalar
+event fields as string columns, ``properties``/``tags`` as JSON-encoded
+strings); it round-trips exactly within this framework but is NOT readable
+by the reference's parquet import, which expects SQLContext-inferred
+nested schemas. The fixed schema is deliberate: inference over free-form
+property bags would null-fill missing keys, which corrupts ``$unset``
+semantics on re-import. Use ``json`` for interchange, ``parquet`` for
+compact self-archives.
 """
 
 from __future__ import annotations

@@ -9,8 +9,14 @@ invoked from ``CoreWorkflow.scala:51,108``, ``CreateServer.scala:246`` and
 version index is reachable and advertises a newer release, an INFO line
 says so; every failure mode (no network, 404, bad JSON, slow host) is a
 DEBUG line at most. The check never blocks the caller (daemon thread, short
-timeout) and is disabled by ``PIO_NO_UPGRADE_CHECK=1`` — the polite default
-for CI and air-gapped deployments is a single fast connection failure.
+timeout).
+
+Unlike the reference, the check is **opt-in**: it only runs when
+``PIO_VERSIONS_HOST`` names an index the operator controls. The reference's
+hard-coded ``direct.prediction.io`` belongs to a defunct project — a
+default-on request to a lapsed domain from every production process is a
+takeover target, not a feature. ``PIO_NO_UPGRADE_CHECK=1`` force-disables
+even a configured host.
 """
 
 from __future__ import annotations
@@ -24,10 +30,12 @@ from typing import Optional, Tuple
 
 log = logging.getLogger(__name__)
 
-#: Override with PIO_VERSIONS_HOST (trailing slash optional). The
-#: reference used plain http (``WorkflowUtils.scala:396``); https here —
-#: this check runs inside production training/serving processes.
-DEFAULT_VERSIONS_HOST = "https://direct.prediction.io/"
+#: No default host: the check is opt-in via PIO_VERSIONS_HOST (trailing
+#: slash optional). The reference hard-coded plain-http
+#: ``direct.prediction.io`` (``WorkflowUtils.scala:396``) — a domain this
+#: project does not control; defaulting to it would point every production
+#: train/eval/deploy process at whoever registers it next.
+DEFAULT_VERSIONS_HOST = ""
 
 _TIMEOUT_S = 3.0
 #: Response size cap: the index is a tiny JSON document; never buffer an
@@ -78,6 +86,11 @@ def _run_check(component: str, engine: str) -> Optional[str]:
     latest = data.get("version") if isinstance(data, dict) else None
     if not latest:
         return None
+    # Sanitize before the string reaches a log line: printable ASCII only,
+    # clamped — a hijacked index must not inject control chars into logs.
+    latest = "".join(
+        ch for ch in str(latest)[:64] if ch.isprintable() and ord(ch) < 128
+    )
     cur, new = _parse_version(__version__), _parse_version(latest)
     if cur is not None and new is not None and new > cur:
         log.info(
@@ -91,10 +104,13 @@ def _run_check(component: str, engine: str) -> Optional[str]:
 def check_upgrade(component: str = "core", engine: str = "") -> Optional[threading.Thread]:
     """Fire-and-forget upgrade check (``WorkflowUtils.checkUpgrade``).
 
-    Returns the daemon thread (tests join it) or None when disabled via
-    ``PIO_NO_UPGRADE_CHECK=1``.
+    Returns the daemon thread (tests join it) or None when skipped: the
+    check only runs when ``PIO_VERSIONS_HOST`` is configured (opt-in), and
+    ``PIO_NO_UPGRADE_CHECK=1`` disables it even then.
     """
     if os.environ.get("PIO_NO_UPGRADE_CHECK") == "1":
+        return None
+    if not (os.environ.get("PIO_VERSIONS_HOST") or DEFAULT_VERSIONS_HOST):
         return None
     t = threading.Thread(
         target=_run_check, args=(component, engine),

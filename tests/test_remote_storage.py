@@ -255,10 +255,10 @@ class TestConnectionPooling:
             properties=DataMap({"rating": 4.0}),
         )
 
-    def test_write_path_reuses_connection(self, base_url):
-        """Unread response bodies must be drained and the connection
-        pooled — the write path (`with _request(...): pass`) is exactly
-        the bulk path pooling exists for."""
+    def test_write_path_never_pops_the_pool(self, base_url):
+        """Non-idempotent writes always open a fresh connection (a stale
+        pooled socket must not be able to fail a write), but a completed
+        write's connection is still pooled for idempotent readers."""
         from predictionio_tpu.storage import remote
 
         st = self._store(base_url)
@@ -267,7 +267,15 @@ class TestConnectionPooling:
         conn1 = remote._pool.conns.get(base_url)
         assert conn1 is not None, "connection not pooled after write"
         st.write_new([self._event()], 7)
-        assert remote._pool.conns.get(base_url) is conn1, "pool not reused"
+        conn2 = remote._pool.conns.get(base_url)
+        # the second write did NOT reuse the pooled connection — it opened
+        # fresh and displaced conn1 in the pool on completion
+        assert conn2 is not None and conn2 is not conn1
+        # an idempotent read DOES reuse the pooled connection
+        from predictionio_tpu.storage.events import EventFilter
+
+        assert len(list(st.find(7, EventFilter()))) == 2
+        assert remote._pool.conns.get(base_url) is conn2, "read not pooled"
 
     @staticmethod
     def _lying_keepalive_server():
@@ -327,22 +335,41 @@ class TestConnectionPooling:
         finally:
             closer()
 
-    def test_non_idempotent_write_does_not_retry_on_stale_conn(self):
+    def test_non_idempotent_write_survives_stale_pooled_conn(self):
+        """Against a server that drops keep-alive connections while idle,
+        a write must neither fail (the pre-pooling behavior regression the
+        round-2 advisor flagged) nor silently replay: it bypasses the pool
+        and sends exactly once on a fresh connection."""
         from predictionio_tpu.storage import remote
-        from predictionio_tpu.storage.remote import RemoteStorageError
 
         port, hits, closer = self._lying_keepalive_server()
         try:
             url = f"http://127.0.0.1:{port}/x"
+            netloc = f"http://127.0.0.1:{port}"
             with remote._request(url, "POST", b"{}") as r:
                 r.read()
-            assert remote._pool.conns.get(f"http://127.0.0.1:{port}")
-            # POST on the stale pooled connection: must raise, not replay
-            with pytest.raises(RemoteStorageError):
-                remote._request(url, "POST", b"{}")
-            assert len(hits) == 1  # the failed attempt never re-sent
-            # next op recovers on a fresh connection
+            assert remote._pool.conns.get(netloc)  # stale conn pooled
+            # POST ignores the stale pooled connection entirely: one fresh
+            # send, success, no replay
             with remote._request(url, "POST", b"{}") as r:
+                r.read()
+            assert len(hits) == 2
+        finally:
+            closer()
+
+    def test_idempotent_read_retries_stale_pooled_conn(self):
+        """GETs keep the pool + one-shot stale retry: the pooled connection
+        the server closed while idle is transparently replaced."""
+        from predictionio_tpu.storage import remote
+
+        port, hits, closer = self._lying_keepalive_server()
+        try:
+            url = f"http://127.0.0.1:{port}/x"
+            with remote._request(url, "GET") as r:
+                r.read()
+            assert len(hits) == 1
+            # pooled conn is stale (server closed it); GET retries fresh
+            with remote._request(url, "GET") as r:
                 r.read()
             assert len(hits) == 2
         finally:

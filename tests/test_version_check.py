@@ -97,6 +97,23 @@ class TestCheck:
         monkeypatch.setenv("PIO_NO_UPGRADE_CHECK", "1")
         assert check_upgrade("training") is None
 
+    def test_opt_in_no_host_means_no_check(self, monkeypatch):
+        # With no PIO_VERSIONS_HOST configured the check must not fire at
+        # all: the reference's hard-coded direct.prediction.io is a defunct
+        # domain, and a default-on request there is a takeover vector.
+        monkeypatch.delenv("PIO_VERSIONS_HOST", raising=False)
+        monkeypatch.delenv("PIO_NO_UPGRADE_CHECK", raising=False)
+        assert check_upgrade("training") is None
+
+    def test_advertised_version_sanitized(self, versions_host):
+        # Control chars / non-ASCII from a hijacked index must never reach
+        # the logs; the numeric comparison still sees the newer version.
+        _IndexHandler.payload = {"version": "99.0.0\x1b[31mEVIL\nLOG"}
+        out = _run_check("training", "")
+        assert out is not None
+        assert "\x1b" not in out and "\n" not in out
+        assert out.startswith("99.0.0")
+
     def test_fire_and_forget_thread(self, versions_host, monkeypatch):
         monkeypatch.delenv("PIO_NO_UPGRADE_CHECK", raising=False)
         _IndexHandler.payload = {"version": "99.0.0"}
