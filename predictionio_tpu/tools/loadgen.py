@@ -142,7 +142,8 @@ def _expand_payloads(template: str, n: int = 256) -> List[bytes]:
     return [template.encode()]
 
 
-def _inprocess_target(engine_dir: str, batching: bool):
+def _inprocess_target(engine_dir: str, batching: bool,
+                      pipeline_depth: int = 2):
     """Build a QueryServer (without binding HTTP traffic through sockets)
     and return a callable driving handle_query directly."""
     from ..storage.registry import get_registry
@@ -157,6 +158,7 @@ def _inprocess_target(engine_dir: str, batching: bool):
         engine_id=ed.manifest.id,
         engine_version=ed.manifest.version,
         batching=batching,
+        batch_pipeline_depth=pipeline_depth,
     )
     server = QueryServer(config, engine, get_registry())
 
@@ -182,13 +184,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="engine project dir for --in-process")
     p.add_argument("--no-batching", action="store_true",
                    help="disable micro-batching in --in-process mode")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="in-flight batch depth in --in-process mode")
     args = p.parse_args(argv)
 
     payloads = _expand_payloads(args.payload)
     server = None
     if args.in_process:
         target, server = _inprocess_target(
-            args.engine_dir, batching=not args.no_batching
+            args.engine_dir, batching=not args.no_batching,
+            pipeline_depth=args.pipeline_depth,
         )
     else:
         target = _http_target(args.url)

@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", default="")
     p.add_argument("--log-url", default=None,
                    help="POST serving errors here (CreateServer --log-url)")
+    p.add_argument("--batch-max", type=int, default=None,
+                   help="micro-batch size cap (default 512; size to catalog)")
+    p.add_argument("--batch-pipeline-depth", type=int, default=None,
+                   help="batches in flight at once (default 2; raise when "
+                        "the host-to-device round trip dwarfs device time)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -63,6 +68,16 @@ def make_server(
         access_key=args.accesskey,
         batch=args.batch,
         log_url=args.log_url,
+        # frozen dataclass: only override the defaults when flags were given
+        **{
+            k: v
+            for k, v in (
+                ("batch_max", getattr(args, "batch_max", None)),
+                ("batch_pipeline_depth",
+                 getattr(args, "batch_pipeline_depth", None)),
+            )
+            if v is not None
+        },
     )
     return create_query_server(engine, config, registry, block=block)
 

@@ -76,13 +76,22 @@ class ServerConfig:
     # 512 keeps the padded top-k program set small (pad_pow2) while letting
     # a high-latency dispatch path (e.g. a remote-relay device) amortize
     # the round trip over a large batch; device time grows sub-linearly.
-    # Memory envelope: scoring materializes a [batch, n_items] f32 matrix,
-    # so peak device memory scales linearly with this cap — at 10M items,
-    # 512×1e7×4 B ≈ 20 GB. Size batch_max to the catalog:
-    # batch_max ≲ device_bytes / (n_items × 4) (e.g. 128 for 10M items on
-    # a 16 GB chip).
+    # Memory envelope: scoring materializes a [batch, n_items] f32 matrix
+    # PER IN-FLIGHT BATCH, so peak device memory scales with
+    # batch_pipeline_depth × batch_max — at 10M items and depth 2,
+    # 2×512×1e7×4 B ≈ 41 GB. Size batch_max to the catalog AND depth:
+    # batch_max ≲ device_bytes / (batch_pipeline_depth × n_items × 4)
+    # (e.g. 64 for 10M items at depth 2 on a 16 GB chip). The Pallas
+    # streaming top-k (auto-selected for huge catalogs) sidesteps the
+    # score matrix entirely.
     batch_max: int = 512
     batch_wait_ms: float = 1.0
+    # In-flight batch pipelining: while one batch's results travel back
+    # from the device, the next is already dispatched. Depth 2 hides one
+    # full host↔device round trip (the binding resource on a tunneled or
+    # remote-relay device); raise it when round_trip >> device_time. Peak
+    # device memory scales with depth × the batch_max envelope above.
+    batch_pipeline_depth: int = 2
     #: Remote error log: serving failures POST {message, query} here
     #: (``--log-url``, ``CreateServer.scala:409-420``). None = disabled.
     log_url: Optional[str] = None
@@ -307,6 +316,7 @@ class QueryServer(BackgroundHTTPServer):
                 max_batch=config.batch_max,
                 max_wait_ms=config.batch_wait_ms,
                 name="predict-batch",
+                pipeline_depth=config.batch_pipeline_depth,
             )
             if config.batching
             else None

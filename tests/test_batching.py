@@ -95,6 +95,200 @@ class TestMicroBatcher:
             mb.submit(1)
 
 
+class TestPipelinedDispatch:
+    """Round-3 pipelining: up to pipeline_depth batches in flight at once
+    so the next batch dispatches while the previous one's results are
+    still traveling back from the device (the round-2 single-in-flight
+    dispatcher capped QPS at max_batch / round_trip)."""
+
+    def test_batches_overlap_up_to_depth(self):
+        """With a slow processor and depth 2, two batches must be observed
+        running concurrently — the whole point of the pipeline."""
+        running = []
+        peak = []
+        lock = threading.Lock()
+        entered = threading.Barrier(2, timeout=10)
+
+        def process(items):
+            with lock:
+                running.append(1)
+                peak.append(len(running))
+            try:
+                entered.wait()  # both batches provably inside process()
+            except threading.BrokenBarrierError:
+                pass
+            time.sleep(0.02)
+            with lock:
+                running.pop()
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0,
+                          pipeline_depth=2)
+        try:
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                futs = [pool.submit(mb.submit, i) for i in range(4)]
+                assert sorted(f.result(timeout=30) for f in futs) == [0, 1, 2, 3]
+            assert max(peak) == 2
+            assert mb.stats["inflight_hwm"] == 2
+        finally:
+            mb.close()
+
+    def test_depth_bounds_concurrency(self):
+        """Never more than pipeline_depth batches in process() at once,
+        regardless of queue pressure."""
+        concurrent = []
+        count = [0]
+        lock = threading.Lock()
+
+        def process(items):
+            with lock:
+                count[0] += 1
+                concurrent.append(count[0])
+            time.sleep(0.005)
+            with lock:
+                count[0] -= 1
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=2, max_wait_ms=0.0,
+                          pipeline_depth=3)
+        try:
+            with ThreadPoolExecutor(max_workers=24) as pool:
+                futs = [pool.submit(mb.submit, i) for i in range(96)]
+                [f.result(timeout=30) for f in futs]
+            assert max(concurrent) <= 3
+        finally:
+            mb.close()
+
+    def test_depth_one_is_strictly_serial(self):
+        """pipeline_depth=1 reproduces the round-2 contract: batches never
+        overlap."""
+        concurrent = []
+        count = [0]
+        lock = threading.Lock()
+
+        def process(items):
+            with lock:
+                count[0] += 1
+                concurrent.append(count[0])
+            time.sleep(0.002)
+            with lock:
+                count[0] -= 1
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=4, max_wait_ms=0.0,
+                          pipeline_depth=1)
+        try:
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                futs = [pool.submit(mb.submit, i) for i in range(64)]
+                [f.result(timeout=30) for f in futs]
+            assert max(concurrent) == 1
+        finally:
+            mb.close()
+
+    def test_out_of_order_completion_resolves_correct_futures(self):
+        """A later batch finishing before an earlier one must deliver each
+        item to its own submitter (futures are per-item, not positional
+        across batches)."""
+        first_batch_gate = threading.Event()
+        batch_no = [0]
+        batch_lock = threading.Lock()
+
+        def process(items):
+            with batch_lock:
+                batch_no[0] += 1
+                mine = batch_no[0]
+            if mine == 1:
+                # stall batch 1 until batch 2 has finished
+                first_batch_gate.wait(timeout=10)
+            return [x * 100 for x in items]
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0,
+                          pipeline_depth=2)
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                f1 = pool.submit(mb.submit, 1)
+                time.sleep(0.05)  # ensure 1 is taken as its own batch first
+                f2 = pool.submit(mb.submit, 2)
+                assert f2.result(timeout=10) == 200  # batch 2 completes first
+                assert not f1.done()
+                first_batch_gate.set()
+                assert f1.result(timeout=10) == 100
+        finally:
+            first_batch_gate.set()
+            mb.close()
+
+    def test_error_in_one_inflight_batch_spares_the_other(self):
+        gate = threading.Event()
+
+        def process(items):
+            if "bad" in items:
+                raise ValueError("bad batch")
+            gate.wait(timeout=10)
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0,
+                          pipeline_depth=2)
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                f_ok = pool.submit(mb.submit, "ok")
+                time.sleep(0.05)
+                f_bad = pool.submit(mb.submit, "bad")
+                with pytest.raises(ValueError, match="bad batch"):
+                    f_bad.result(timeout=10)
+                gate.set()
+                assert f_ok.result(timeout=10) == "ok"
+        finally:
+            gate.set()
+            mb.close()
+
+    def test_close_is_bounded_with_hung_batch(self):
+        """A batch hung on a dead device must not hang close() (the /stop
+        and hot-swap path) forever: close returns after its grace period,
+        leaving the daemon worker behind."""
+        hang = threading.Event()
+
+        def process(items):
+            hang.wait(timeout=60)  # simulates a wedged device dispatch
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0,
+                          pipeline_depth=2)
+        try:
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut = pool.submit(mb.submit, 1, 30)
+                time.sleep(0.1)  # batch is in flight and hung
+                t0 = time.monotonic()
+                mb.close(grace_s=0.3)
+                assert time.monotonic() - t0 < 5.0
+                hang.set()  # release the "device"; submitter completes
+                assert fut.result(timeout=10) == 1
+        finally:
+            hang.set()
+
+    def test_close_with_inflight_batches_completes_them(self):
+        """close() must let in-flight batches finish (their callers are
+        blocked on the result), then fail whatever never dispatched."""
+        release = threading.Event()
+
+        def process(items):
+            release.wait(timeout=10)
+            return list(items)
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0,
+                          pipeline_depth=2)
+        try:
+            with ThreadPoolExecutor(max_workers=3) as pool:
+                futs = [pool.submit(mb.submit, i) for i in range(2)]
+                time.sleep(0.1)  # both in flight
+                closer = pool.submit(mb.close)
+                time.sleep(0.05)
+                release.set()
+                closer.result(timeout=10)
+                assert sorted(f.result(timeout=10) for f in futs) == [0, 1]
+        finally:
+            release.set()
+
+
 class TestBatchedServing:
     def test_batched_and_unbatched_agree(self, registry):
         from predictionio_tpu.workflow.serving import QueryServer, ServerConfig
