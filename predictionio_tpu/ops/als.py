@@ -343,14 +343,15 @@ class ALSConfig:
     implicit_prefs: bool = False
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 0
-    #: "auto" (default) resolves at train time: "pallas" on a single-chip
-    #: TPU run with rank <= 80, else "chunked". "chunked" fuses each
-    #: block's Cholesky into the chunk map; "two_phase" batches one
-    #: Cholesky per bucket (measured slower than chunked on v5e);
-    #: "pallas" replaces XLA's batched Cholesky with the fused
-    #: transposed-layout kernel (ops/pallas_kernels.spd_solve_t, ~25×
-    #: on the solve stage). All modes produce identical results up to
-    #: float reassociation.
+    #: "auto" (default) resolves at train time: "pallas" on TPU with
+    #: rank <= 80 (single-chip or mesh — under a mesh the kernel runs
+    #: per-device inside shard_map over the data axis), else "chunked".
+    #: "chunked" fuses each block's Cholesky into the chunk map;
+    #: "two_phase" batches one Cholesky per bucket (measured slower than
+    #: chunked on v5e); "pallas" replaces XLA's batched Cholesky with
+    #: the fused transposed-layout kernel
+    #: (ops/pallas_kernels.spd_solve_t, ~25× on the solve stage). All
+    #: modes produce identical results up to float reassociation.
     solve_mode: str = "auto"
     #: "f32" (default) or "bf16": dtype of the gathered opposite-side
     #: factors feeding the normal-equation einsums (accumulation stays
@@ -555,7 +556,7 @@ def _bucket_tensors(side: StagedMatrix):
 
 def _solve_side_traced(
     y, buckets, n_rows, rank, implicit, lam, alpha, yty,
-    solve_mode="chunked", gather_dtype="f32",
+    solve_mode="chunked", gather_dtype="f32", mesh=None,
 ):
     """Unrolled bucket loop inside a traced program (no per-bucket dispatch).
 
@@ -573,6 +574,14 @@ def _solve_side_traced(
       transposed [R, R, B] layout and solves with the fused Cholesky
       kernel (``ops/pallas_kernels.spd_solve_t``); the XLA batched
       Cholesky was ~2/3 of the iteration wall-clock on v5e.
+
+    Under a ``mesh``, the per-chunk SPD systems are embarrassingly
+    parallel across solve rows, so the pallas kernel (which does not
+    auto-partition under pjit) is wrapped in ``shard_map`` over the
+    ``data`` axis: each device Cholesky-solves its local ``[R, R,
+    B/n_data]`` block with zero collectives inside the solve. The XLA
+    paths (chunked/two_phase) partition automatically and ignore
+    ``mesh``.
     """
     x = jnp.zeros((n_rows, rank), dtype=jnp.float32)
     gdt = jnp.bfloat16 if gather_dtype == "bf16" else jnp.float32
@@ -634,11 +643,31 @@ def _solve_side_traced(
                 preferred_element_type=jnp.float32,
             )
             bsz = idx_blk.shape[0]
-            pad_b = -bsz % _SPD_BLK
-            if pad_b:
-                a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, pad_b)))
-                b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
-            x_t = spd_solve_t(a_t, b_t)
+            if mesh is None:
+                pad_b = -bsz % _SPD_BLK
+                if pad_b:
+                    a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, pad_b)))
+                    b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
+                x_t = spd_solve_t(a_t, b_t)
+            else:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                from ..parallel.mesh import DATA_AXIS
+
+                n_data = mesh.shape[DATA_AXIS]
+                # each device's local block must itself be a multiple of
+                # the kernel's lane block
+                pad_b = -bsz % (_SPD_BLK * n_data)
+                if pad_b:
+                    a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, pad_b)))
+                    b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
+                x_t = shard_map(
+                    spd_solve_t,
+                    mesh=mesh,
+                    in_specs=(P(None, None, DATA_AXIS), P(None, DATA_AXIS)),
+                    out_specs=P(None, DATA_AXIS),
+                    check_vma=False,  # pallas body; replication is by spec
+                )(a_t, b_t)
             return x_t[:rank, :bsz].T  # [B, rank]
 
     for rows, idx, val, counts in buckets:
@@ -663,7 +692,7 @@ def _solve_side_traced(
 def _als_iteration_body(
     user_buckets, item_buckets, y, lam, alpha,
     rank, implicit, n_users, n_items, solve_mode="chunked",
-    gather_dtype="f32",
+    gather_dtype="f32", mesh=None,
 ):
     """One full ALS iteration (user solve + item solve, all buckets) as a
     single device program — one dispatch per iteration. ``lam``/``alpha``
@@ -679,7 +708,7 @@ def _als_iteration_body(
     )
     x = _solve_side_traced(
         y, user_buckets, n_users, rank, implicit, lam, alpha, yty,
-        solve_mode=solve_mode, gather_dtype=gather_dtype,
+        solve_mode=solve_mode, gather_dtype=gather_dtype, mesh=mesh,
     )
     xtx = (
         jnp.einsum("nr,ns->rs", x, x, preferred_element_type=jnp.float32)
@@ -688,16 +717,18 @@ def _als_iteration_body(
     )
     y2 = _solve_side_traced(
         x, item_buckets, n_items, rank, implicit, lam, alpha, xtx,
-        solve_mode=solve_mode, gather_dtype=gather_dtype,
+        solve_mode=solve_mode, gather_dtype=gather_dtype, mesh=mesh,
     )
     return x, y2
 
 
+# ``mesh`` is static: jax.sharding.Mesh is hashable, and the traced program
+# embeds per-device pallas blocks via shard_map when it is set.
 _als_iteration = functools.partial(
     jax.jit,
     static_argnames=(
         "rank", "implicit", "n_users", "n_items", "solve_mode",
-        "gather_dtype",
+        "gather_dtype", "mesh",
     ),
 )(_als_iteration_body)
 
@@ -711,7 +742,7 @@ def _als_iteration_sharded(out_sharding):
         _als_iteration_body,
         static_argnames=(
             "rank", "implicit", "n_users", "n_items", "solve_mode",
-            "gather_dtype",
+            "gather_dtype", "mesh",
         ),
         out_shardings=(out_sharding, out_sharding),
     )
@@ -765,28 +796,19 @@ def als_train(
             f"gather_dtype must be 'f32' or 'bf16', got {cfg.gather_dtype!r}"
         )
     solve_mode = cfg.solve_mode
-    # The pallas solve kernel assumes a single-device run (a pallas call
-    # does not auto-partition under pjit) and bounded VMEM scratch (rank
-    # padded to a multiple of 8, n²·128·4 bytes) — "auto" selects around
-    # these limits; an explicit "pallas" outside them must fail loudly,
-    # not mis-solve against factor shards or die in Mosaic's allocator.
+    # The pallas solve kernel has bounded VMEM scratch (rank padded to a
+    # multiple of 8, n²·128·4 bytes) — "auto" selects around that limit;
+    # an explicit "pallas" beyond it must fail loudly, not die in
+    # Mosaic's allocator. Under a mesh the kernel runs per-device inside
+    # shard_map over the data axis (see _solve_side_traced), so
+    # distributed training keeps the fused-Cholesky iteration win.
     if solve_mode == "auto":
         solve_mode = (
             "pallas"
-            if (
-                mesh is None
-                and cfg.rank <= 80
-                and jax.default_backend() == "tpu"
-            )
+            if (cfg.rank <= 80 and jax.default_backend() == "tpu")
             else "chunked"
         )
     elif solve_mode == "pallas":
-        if mesh is not None:
-            raise ValueError(
-                "solve_mode='pallas' does not support mesh-distributed "
-                "training (the kernel does not partition under pjit); "
-                "use solve_mode='auto' or 'chunked'"
-            )
         if cfg.rank > 80:
             raise ValueError(
                 f"solve_mode='pallas' supports rank <= 80 (VMEM scratch "
@@ -899,6 +921,7 @@ def als_train(
             n_items=by_item.n_rows,
             solve_mode=solve_mode,
             gather_dtype=cfg.gather_dtype,
+            mesh=mesh if solve_mode == "pallas" else None,
         )
         if profile is not None:
             jax.block_until_ready((x, y))

@@ -65,6 +65,22 @@ class _NetlocPool(threading.local):
 _pool = _NetlocPool()
 
 
+def _conn_is_dead(conn) -> bool:
+    """Liveness probe for an idle pooled connection: with no request in
+    flight the socket must have nothing to read, so readability means EOF
+    (server closed while idle) or protocol garbage — either way, dead."""
+    sock = getattr(conn, "sock", None)
+    if sock is None:
+        return True
+    try:
+        import select
+
+        readable, _, _ = select.select([sock], [], [], 0)
+        return bool(readable)
+    except (OSError, ValueError):
+        return True
+
+
 def _return_conn(netloc: str, conn) -> None:
     """Pool a reusable connection; close any displaced one (possible when
     an RPC ran while a streaming response held the slot's connection)."""
@@ -135,14 +151,20 @@ def _request(
     timeout: float = 60.0,
     idempotent: Optional[bool] = None,
 ):
-    """``idempotent`` enables connection pooling plus the one-shot
-    stale-connection retry. Default: GET/DELETE only. POST call sites that
-    are semantically reads (find, columnar scans) or natural upserts (init,
-    model put) opt in. Non-idempotent requests (event inserts, bulk writes)
-    never touch the pool: a pooled socket the server closed while idle
-    would fail the write, and retrying it is unsafe — a request the server
-    executed before dying would be applied twice. A fresh connection per
-    write keeps the old always-succeeds behavior for low-rate writers."""
+    """``idempotent`` enables the one-shot stale-connection retry and
+    unconditional pool reuse. Default: GET/DELETE only. POST call sites
+    that are semantically reads (find, columnar scans) or natural upserts
+    (init, model put) opt in.
+
+    Non-idempotent requests (event inserts, bulk writes) get NO retry — a
+    request the server executed before dying would be applied twice. They
+    may still borrow a pooled connection, but only after a liveness probe
+    (``_conn_is_dead``): a socket the server closed while idle shows EOF
+    and is discarded for a fresh connection, so the common stale-keep-alive
+    failure can't hit a write, while high-rate writers keep keep-alive
+    (no per-event TCP handshake). The probe-to-send race window — server
+    closes in the microseconds between — surfaces as a loud
+    RemoteStorageError, never a silent replay."""
     parsed = urllib.parse.urlsplit(url)
     if parsed.scheme not in ("http", "https"):
         raise RemoteStorageError(f"unsupported URL scheme in {url!r}")
@@ -158,7 +180,15 @@ def _request(
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
     headers = {"Content-Type": "application/json"} if body is not None else {}
     for attempt in (0, 1):
-        conn = _pool.conns.pop(netloc, None) if idempotent else None
+        conn = _pool.conns.pop(netloc, None)
+        if conn is not None and not idempotent and _conn_is_dead(conn):
+            # a write must not meet a stale socket (no retry is allowed);
+            # reads keep the cheap path — their stale retry is safe
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
         fresh = conn is None
         if fresh:
             conn = conn_cls(
@@ -329,9 +359,26 @@ class RemoteEventStore(EventStore):
 
 #: Pure-read metadata RPCs: pooled keep-alive + stale retry is safe for
 #: these (re-reading is harmless). Mutations (gen_next, inserts, updates,
-#: deletes) stay on fresh connections — gen_next retried twice burns a
-#: sequence value, an insert retried twice duplicates a row.
-_READ_RPC_METHODS = frozenset(m for m in METADATA_RPC_METHODS if "_get" in m)
+#: deletes) get no stale retry — gen_next retried twice burns a sequence
+#: value, an insert retried twice duplicates a row. An explicit allowlist,
+#: like METADATA_RPC_METHODS itself: a future method must be classified
+#: deliberately, never by name pattern.
+_READ_RPC_METHODS = frozenset(
+    {
+        "app_get",
+        "app_get_by_name",
+        "app_get_all",
+        "access_key_get",
+        "access_key_get_by_app",
+        "manifest_get",
+        "engine_instance_get",
+        "engine_instance_get_all",
+        "engine_instance_get_latest_completed",
+        "evaluation_instance_get",
+        "evaluation_instance_get_completed",
+    }
+)
+assert _READ_RPC_METHODS <= METADATA_RPC_METHODS
 
 
 class _RemoteRPC:

@@ -133,6 +133,93 @@ class TestDistributedALS:
             rtol=2e-3, atol=2e-4,
         )
 
+    def test_pallas_solve_under_mesh_matches_chunked(self, mesh8):
+        """Round-3 lift of the single-device pallas restriction: the fused
+        SPD solver runs per-device inside shard_map over the data axis.
+        On this CPU mesh the kernel executes in interpret mode per shard —
+        same code path shape as 8 real chips."""
+        users, items, ratings, nu, ni = self._toy(2)
+        chunked = als_train_coo(
+            users, items, ratings, nu, ni,
+            ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0,
+                      solve_mode="chunked"),
+            mesh=mesh8,
+        )
+        pallas = als_train_coo(
+            users, items, ratings, nu, ni,
+            ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0,
+                      solve_mode="pallas"),
+            mesh=mesh8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked.user_factors),
+            np.asarray(pallas.user_factors),
+            rtol=2e-3, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked.item_factors),
+            np.asarray(pallas.item_factors),
+            rtol=2e-3, atol=2e-4,
+        )
+
+    def test_pallas_solve_mesh_with_model_sharding(self, mesh_2d):
+        """pallas solve + model-sharded factor tables compose: the solve
+        shards over `data`, the tables over `model`."""
+        users, items, ratings, nu, ni = self._toy(3)
+        cfg = ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0,
+                        solve_mode="pallas")
+        single = als_train_coo(
+            users, items, ratings, nu, ni,
+            ALSConfig(rank=6, iterations=2, lambda_=0.05, seed=0),
+        )
+        sharded = als_train_coo(
+            users, items, ratings, nu, ni, cfg,
+            mesh=mesh_2d, factor_sharding="model",
+        )
+        assert sharded.item_factors.sharding.spec[0] == "model"
+        np.testing.assert_allclose(
+            np.asarray(single.user_factors),
+            np.asarray(sharded.user_factors),
+            rtol=2e-3, atol=2e-4,
+        )
+
+    def test_model_sharding_memory_at_scale(self):
+        """Scale-realistic sharding validation (round-3 VERDICT item 5):
+        factor tables big enough that replication is the thing being
+        avoided, row-sharded over ``model``; assert the per-device shard
+        bytes match the sharding math exactly.
+
+        Budget being validated (rank 48, f32): full tables are
+        400k×48×4 + 80k×48×4 = 92 MB; sharded over model=4 each device
+        holds (100k + 20k)×48×4 = 23 MB — ML-20M at rank 50 scales the
+        same math to 138k users + 27k items (32 MB full, 8 MB/device on
+        a 4-way model axis), and a 10M-user catalog (1.9 GB full) only
+        fits a 16 GB chip next to the training workspace when sharded."""
+        nu, ni, rank, nnz = 400_000, 80_000, 48, 200_000
+        model = 4
+        mesh = create_mesh(MeshConfig((("data", 2), ("model", model))))
+        rng = np.random.default_rng(7)
+        users = rng.integers(0, nu, size=nnz)
+        items = rng.integers(0, ni, size=nnz)
+        ratings = rng.normal(3.5, 1.0, size=nnz).astype(np.float32)
+        factors = als_train_coo(
+            users, items, ratings, nu, ni,
+            ALSConfig(rank=rank, iterations=1, lambda_=0.1, seed=0),
+            mesh=mesh, factor_sharding="model",
+        )
+        for table, rows in (
+            (factors.user_factors, nu),
+            (factors.item_factors, ni),
+        ):
+            assert table.sharding.spec[0] == "model"
+            shards = table.addressable_shards
+            # every device holds exactly one shard (replicated over data)
+            assert len(shards) == 8
+            for s in shards:
+                assert s.data.shape == (rows // model, rank)
+                assert s.data.nbytes == rows // model * rank * 4
+        assert np.isfinite(np.asarray(factors.user_factors[:64])).all()
+
     def test_bad_factor_sharding_rejected(self, mesh8):
         users, items, ratings, nu, ni = self._toy()
         with pytest.raises(ValueError):

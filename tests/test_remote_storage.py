@@ -255,10 +255,10 @@ class TestConnectionPooling:
             properties=DataMap({"rating": 4.0}),
         )
 
-    def test_write_path_never_pops_the_pool(self, base_url):
-        """Non-idempotent writes always open a fresh connection (a stale
-        pooled socket must not be able to fail a write), but a completed
-        write's connection is still pooled for idempotent readers."""
+    def test_write_path_reuses_live_connection(self, base_url):
+        """Writes keep keep-alive (no per-event TCP handshake): a pooled
+        connection that passes the liveness probe is reused; reads share
+        the same pool."""
         from predictionio_tpu.storage import remote
 
         st = self._store(base_url)
@@ -267,15 +267,13 @@ class TestConnectionPooling:
         conn1 = remote._pool.conns.get(base_url)
         assert conn1 is not None, "connection not pooled after write"
         st.write_new([self._event()], 7)
-        conn2 = remote._pool.conns.get(base_url)
-        # the second write did NOT reuse the pooled connection — it opened
-        # fresh and displaced conn1 in the pool on completion
-        assert conn2 is not None and conn2 is not conn1
-        # an idempotent read DOES reuse the pooled connection
+        assert remote._pool.conns.get(base_url) is conn1, (
+            "live pooled connection not reused by the write path"
+        )
         from predictionio_tpu.storage.events import EventFilter
 
         assert len(list(st.find(7, EventFilter()))) == 2
-        assert remote._pool.conns.get(base_url) is conn2, "read not pooled"
+        assert remote._pool.conns.get(base_url) is conn1, "read not pooled"
 
     @staticmethod
     def _lying_keepalive_server():
@@ -338,8 +336,9 @@ class TestConnectionPooling:
     def test_non_idempotent_write_survives_stale_pooled_conn(self):
         """Against a server that drops keep-alive connections while idle,
         a write must neither fail (the pre-pooling behavior regression the
-        round-2 advisor flagged) nor silently replay: it bypasses the pool
-        and sends exactly once on a fresh connection."""
+        round-2 advisor flagged) nor silently replay: the liveness probe
+        sees EOF on the stale socket and the write goes out exactly once
+        on a fresh connection."""
         from predictionio_tpu.storage import remote
 
         port, hits, closer = self._lying_keepalive_server()
@@ -349,8 +348,10 @@ class TestConnectionPooling:
             with remote._request(url, "POST", b"{}") as r:
                 r.read()
             assert remote._pool.conns.get(netloc)  # stale conn pooled
-            # POST ignores the stale pooled connection entirely: one fresh
-            # send, success, no replay
+            import time
+
+            time.sleep(0.1)  # let the server's FIN land so the probe sees EOF
+            # POST probes the pooled conn, finds it dead, sends once fresh
             with remote._request(url, "POST", b"{}") as r:
                 r.read()
             assert len(hits) == 2
