@@ -39,6 +39,14 @@
 // themselves to the last validated size, so a concurrent append is either
 // fully visible or not yet scanned. Open truncates any torn tail left by a
 // crashed process (under the same lock).
+//
+// Multi-writer scaling happens a level up (storage/native_events.py): N
+// ingest processes each append to their own segment FILE of the same app
+// (this library sees each segment as an independent log, so per-file flock
+// is uncontended), and reads merge segments. The Python layer keeps the
+// ordering invariant that makes merged tombstone filtering exact: segments
+// hold only fresh-id inserts; tombstones and same-id re-inserts live in
+// the primary log only (see evlog_tombstones below).
 
 #include <algorithm>
 #include <cerrno>
@@ -274,15 +282,16 @@ int64_t evlog_append_batch(void* vh, int64_t n, const int64_t* event_time_ms,
 // start_ms/until_ms of INT64_MIN/INT64_MAX mean unbounded; has_target:
 // -1 any, 0 must-have-no-target, 1 must-have-target. Matches are sorted by
 // (event_time_ms, file offset) ascending. Returns the total number of
-// matches; only the first `cap` (payload offset, payload len, event time ms)
-// triples are written to out_off/out_len/out_time. Call again with a larger
-// cap if truncated.
+// matches; only the first `cap` (payload offset, payload len, event time
+// ms, id hash) tuples are written to out_off/out_len/out_time/out_id
+// (out_id may be null when the caller does not need cross-segment
+// tombstone filtering). Call again with a larger cap if truncated.
 int64_t evlog_scan(void* vh, int64_t start_ms, int64_t until_ms,
                    uint64_t etype_hash, uint64_t entity_hash,
                    const uint64_t* event_hashes, int32_t n_event_hashes,
                    uint64_t ttype_hash, uint64_t target_hash,
                    int32_t has_target, int64_t* out_off, int64_t* out_len,
-                   int64_t* out_time, int64_t cap) {
+                   int64_t* out_time, uint64_t* out_id, int64_t cap) {
   auto* h = (Handle*)vh;
   int64_t size;
   {
@@ -357,12 +366,51 @@ int64_t evlog_scan(void* vh, int64_t start_ms, int64_t until_ms,
     out_off[i] = matches[i].off;
     out_len[i] = matches[i].len;
     out_time[i] = matches[i].time_ms;
+    if (out_id) out_id[i] = matches[i].id_hash;
   }
   return n;
 }
 
-// Latest live record with the given id_hash. Returns 1 and fills
-// out_off/out_len (payload), or 0 when absent / deleted.
+// All tombstone id hashes in the log (the primary log's delete/upsert
+// markers). Multi-segment reads subtract this set from secondary-segment
+// matches: segments hold only fresh-id inserts (ids that did not exist
+// before being appended there and are never re-inserted there), so ANY
+// tombstone for an id kills that id's segment records — no ordering
+// needed across files. Returns the total count; fills up to cap.
+int64_t evlog_tombstones(void* vh, uint64_t* out, int64_t cap) {
+  auto* h = (Handle*)vh;
+  int64_t size;
+  {
+    std::lock_guard<std::mutex> lock(h->mu);
+    refresh_size(h);
+    size = h->size;
+  }
+  if (size < (int64_t)kHeaderSize) return 0;
+  void* map = mmap(nullptr, (size_t)size, PROT_READ, MAP_SHARED, h->fd, 0);
+  if (map == MAP_FAILED) return -(int64_t)errno;
+  madvise(map, (size_t)size, MADV_SEQUENTIAL);
+  const uint8_t* base = (const uint8_t*)map;
+  int64_t n = 0;
+  int64_t off = 0;
+  while (off + (int64_t)kHeaderSize <= size) {
+    RecordHeader hd;
+    memcpy(&hd, base + off, kHeaderSize);
+    if (hd.record_len < kHeaderSize || off + (int64_t)hd.record_len > size)
+      break;
+    if (hd.flags & kFlagTombstone) {
+      if (n < cap && out) out[n] = hd.id_hash;
+      n++;
+    }
+    off += hd.record_len;
+  }
+  munmap(map, (size_t)size);
+  return n;
+}
+
+// Latest record with the given id_hash. Returns 1 and fills
+// out_off/out_len (payload) when the latest is a live record, -1 when the
+// latest is a tombstone (deleted — multi-segment readers stop here rather
+// than probing other segments), 0 when the id never appears.
 int32_t evlog_get(void* vh, uint64_t id_hash, int64_t* out_off,
                   int64_t* out_len) {
   auto* h = (Handle*)vh;
@@ -377,7 +425,7 @@ int32_t evlog_get(void* vh, uint64_t id_hash, int64_t* out_off,
   if (map == MAP_FAILED) return 0;
   const uint8_t* base = (const uint8_t*)map;
   int64_t found_off = -1, found_len = 0;
-  bool dead = false;
+  bool dead = false, seen = false;
   int64_t off = 0;
   while (off + (int64_t)kHeaderSize <= size) {
     RecordHeader hd;
@@ -385,6 +433,7 @@ int32_t evlog_get(void* vh, uint64_t id_hash, int64_t* out_off,
     if (hd.record_len < kHeaderSize || off + (int64_t)hd.record_len > size)
       break;
     if (hd.id_hash == id_hash) {
+      seen = true;
       if (hd.flags & kFlagTombstone) {
         dead = true;
       } else {
@@ -396,7 +445,8 @@ int32_t evlog_get(void* vh, uint64_t id_hash, int64_t* out_off,
     off += hd.record_len;
   }
   munmap(map, (size_t)size);
-  if (found_off < 0 || dead) return 0;
+  if (!seen) return 0;
+  if (found_off < 0 || dead) return -1;
   *out_off = found_off;
   *out_len = found_len;
   return 1;

@@ -19,12 +19,16 @@ fdatasync'd on a cadence (every ``_SYNC_EVERY`` appends, after each bulk
 ``write()`` batch, and on ``close()``) — a power failure can drop the last
 few acked single-event inserts, slightly weaker than the SQLite backend's
 per-transaction durability (torn tails are truncated on reopen, so the log
-stays *consistent* either way). Tombstone suppression during scans matches
-on the 64-bit FNV-1a id hash only: two *distinct* event ids colliding could
-let a delete/upsert of one suppress the other during scans (``get()``
-re-verifies the exact id and is immune). At ~2^-64 per id pair this is
-accepted; callers needing exactness across deletes should use the SQLite
-backend.
+stays *consistent* either way). The contract is per file: every writer
+segment gets the same cadence, batch sync, and open-time torn-tail
+validation as the primary log. Tombstone suppression matches on the
+64-bit FNV-1a id hash only: two *distinct* event ids colliding could let a
+delete/upsert of one suppress the other during scans, and a primary-log
+tombstone whose hash collides with a live id can make ``get()`` miss it
+(``get()`` re-verifies the exact id on *matches* and keeps probing other
+segments past a colliding record, but a tombstone carries only the hash).
+At ~2^-64 per id pair this is accepted; callers needing exactness across
+deletes should use the SQLite backend.
 """
 
 from __future__ import annotations
@@ -79,11 +83,16 @@ def _lib() -> ctypes.CDLL:
             ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_int32,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.evlog_get.restype = ctypes.c_int32
         lib.evlog_get.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.evlog_tombstones.restype = ctypes.c_int64
+        lib.evlog_tombstones.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.evlog_ratings_scan.restype = ctypes.c_void_p
         lib.evlog_ratings_scan.argtypes = [
@@ -123,14 +132,98 @@ def _fnv(text: str) -> int:
     return int(_lib().evlog_fnv1a64(data, len(data)))
 
 
-class NativeEventStore(EventStore):
-    """Event store over per-app native append-only logs."""
+#: primary log filename; writer segments are ``events.w-<id>.log``
+_PRIMARY = "events.log"
+_SEG_PREFIX = "events.w-"
 
-    def __init__(self, root: str):
+
+class NativeScanUnsupported(ValueError):
+    """The native fast-path scan declines this workload (unsupported rule
+    shape, or writer segments coexisting with primary-log deletes); the
+    caller should fall back to the generic — always exact — scan path.
+    Distinct from plain ValueError, which signals bad data and must
+    propagate."""
+
+
+def _writer_id_ok(writer_id: str) -> bool:
+    return (
+        0 < len(writer_id) <= 32
+        and all(c.isalnum() or c in "_-" for c in writer_id)
+    )
+
+
+def _merge_rating_parts(parts):
+    """Merge per-segment ``scan_ratings`` results: union the id lists in
+    segment-major first-appearance order and remap each part's dense
+    indices into the union (vectorized per part)."""
+    user_ids: list = []
+    item_ids: list = []
+    u_gidx: dict = {}
+    i_gidx: dict = {}
+    u_arrays, i_arrays, v_arrays = [], [], []
+    for users, items, vals, uids, iids in parts:
+        for pool, gidx, out_ids in (
+            (uids, u_gidx, user_ids), (iids, i_gidx, item_ids)
+        ):
+            for k in pool:
+                if k not in gidx:
+                    gidx[k] = len(out_ids)
+                    out_ids.append(k)
+        if len(users):
+            u_map = np.fromiter(
+                (u_gidx[k] for k in uids), dtype=np.int32, count=len(uids)
+            )
+            i_map = np.fromiter(
+                (i_gidx[k] for k in iids), dtype=np.int32, count=len(iids)
+            )
+            u_arrays.append(u_map[users])
+            i_arrays.append(i_map[items])
+            v_arrays.append(vals)
+    if not u_arrays:
+        return (
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32), user_ids, item_ids,
+        )
+    return (
+        np.concatenate(u_arrays), np.concatenate(i_arrays),
+        np.concatenate(v_arrays), user_ids, item_ids,
+    )
+
+
+class NativeEventStore(EventStore):
+    """Event store over per-app native append-only logs.
+
+    **Multi-writer segments** (the region-parallel-write analogue of the
+    reference's HBase path, ``HBPEvents.scala:166-184``): give each ingest
+    process its own ``writer_id`` (constructor arg or
+    ``PIO_NATIVE_WRITER_ID``) and its fresh-event appends go to a private
+    segment file — no flock contention between writers, near-linear
+    aggregate throughput. Reads merge every segment. Correctness of merged
+    tombstone filtering rests on a routing invariant: segments receive
+    ONLY fresh-id inserts (batch ``write``/``write_new`` paths), while
+    explicit-id upserts, deletes, and their tombstones always go to the
+    shared primary log — so a tombstone in the primary kills a segment
+    record regardless of file order (the id can never be legitimately
+    re-inserted into a segment), and order-sensitive delete/re-insert
+    sequences are totally ordered within the primary exactly as before.
+    """
+
+    def __init__(self, root: str, writer_id: Optional[str] = None):
         self._root = root
         self._lib = _lib()
-        self._handles: Dict[int, int] = {}
+        if writer_id is None:
+            writer_id = os.environ.get("PIO_NATIVE_WRITER_ID") or None
+        if writer_id is not None and not _writer_id_ok(writer_id):
+            raise ValueError(
+                f"writer_id must be 1-32 chars of [A-Za-z0-9_-], "
+                f"got {writer_id!r}"
+            )
+        self._writer_id = writer_id
+        #: (app_id, segment filename) -> native handle
+        self._handles: Dict[Tuple[int, str], int] = {}
         self._unsynced: Dict[int, int] = {}
+        #: app_id -> (primary size at read, tombstone hash array)
+        self._tomb_cache: Dict[int, Tuple[int, np.ndarray]] = {}
         self._lock = threading.RLock()
         os.makedirs(root, exist_ok=True)
 
@@ -145,34 +238,95 @@ class NativeEventStore(EventStore):
             self._unsynced[app_id] = n
 
     def sync(self, app_id: Optional[int] = None) -> None:
-        """fdatasync one app's log (or all open logs)."""
+        """fdatasync one app's open logs (or all open logs)."""
         with self._lock:
-            targets = (
-                [(app_id, self._handles[app_id])]
-                if app_id is not None and app_id in self._handles
-                else list(self._handles.items())
-            )
-            for aid, h in targets:
-                self._lib.evlog_sync(h)
-                self._unsynced[aid] = 0
+            for (aid, _fname), h in list(self._handles.items()):
+                if app_id is None or aid == app_id:
+                    self._lib.evlog_sync(h)
+                    self._unsynced[aid] = 0
 
-    def _log_path(self, app_id: int) -> str:
-        return os.path.join(self._root, f"app_{int(app_id)}", "events.log")
+    def _app_dir(self, app_id: int) -> str:
+        return os.path.join(self._root, f"app_{int(app_id)}")
 
-    def _handle(self, app_id: int, create: bool = False) -> Optional[int]:
+    def _log_path(self, app_id: int, fname: str = _PRIMARY) -> str:
+        return os.path.join(self._app_dir(app_id), fname)
+
+    def _segment_files(self, app_id: int) -> list:
+        """Existing log files of an app: primary first, then writer
+        segments sorted by name (a stable merge order)."""
+        try:
+            names = os.listdir(self._app_dir(app_id))
+        except FileNotFoundError:
+            return []
+        segs = sorted(
+            n for n in names
+            if n.startswith(_SEG_PREFIX) and n.endswith(".log")
+        )
+        return ([_PRIMARY] if _PRIMARY in names else []) + segs
+
+    def _seg_handle(
+        self, app_id: int, fname: str, create: bool = False
+    ) -> Optional[int]:
         with self._lock:
-            h = self._handles.get(app_id)
+            key = (app_id, fname)
+            h = self._handles.get(key)
             if h:
                 return h
-            path = self._log_path(app_id)
+            path = self._log_path(app_id, fname)
             if not os.path.exists(path) and not create:
                 return None
             os.makedirs(os.path.dirname(path), exist_ok=True)
             h = self._lib.evlog_open(path.encode())
             if not h:
                 raise OSError(f"evlog_open failed for {path}")
-            self._handles[app_id] = h
+            self._handles[key] = h
             return h
+
+    def _handle(self, app_id: int, create: bool = False) -> Optional[int]:
+        """Primary-log handle (point ops, tombstones, upserts)."""
+        return self._seg_handle(app_id, _PRIMARY, create)
+
+    def _writer_handle(self, app_id: int) -> int:
+        """Append handle for fresh-event batches: this writer's private
+        segment when a writer_id is set, else the shared primary."""
+        if self._writer_id is None:
+            return self._handle(app_id, create=True)
+        return self._seg_handle(
+            app_id, f"{_SEG_PREFIX}{self._writer_id}.log", create=True
+        )
+
+    def _tombstone_hashes(self, app_id: int) -> np.ndarray:
+        """All tombstone id hashes in the primary log (uint64 array).
+
+        Cached per primary-file size: the log is append-only, so an
+        unchanged size means an unchanged tombstone set — merged scans
+        over a large primary don't pay a second full walk per call."""
+        h = self._handle(app_id)
+        if h is None:
+            return np.zeros(0, dtype=np.uint64)
+        try:
+            size = os.path.getsize(self._log_path(app_id))
+        except OSError:
+            size = -1
+        with self._lock:
+            cached = self._tomb_cache.get(app_id)
+            if cached is not None and cached[0] == size and size >= 0:
+                return cached[1]
+        cap = 1024
+        while True:
+            out = np.empty(cap, dtype=np.uint64)
+            n = self._lib.evlog_tombstones(
+                h, out.ctypes.data_as(ctypes.c_void_p), cap
+            )
+            if n < 0:
+                raise OSError(f"evlog_tombstones failed: errno {-n}")
+            if n <= cap:
+                result = out[:n]
+                if size >= 0:
+                    with self._lock:
+                        self._tomb_cache[app_id] = (size, result)
+                return result
+            cap = int(n)
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int) -> bool:
@@ -181,11 +335,10 @@ class NativeEventStore(EventStore):
 
     def remove(self, app_id: int) -> bool:
         with self._lock:
-            h = self._handles.pop(app_id, None)
-            if h:
-                self._lib.evlog_close(h)
-            app_dir = os.path.dirname(self._log_path(app_id))
-            shutil.rmtree(app_dir, ignore_errors=True)
+            for key in [k for k in self._handles if k[0] == app_id]:
+                self._lib.evlog_close(self._handles.pop(key))
+            self._tomb_cache.pop(app_id, None)
+            shutil.rmtree(self._app_dir(app_id), ignore_errors=True)
         return True
 
     def close(self) -> None:
@@ -238,10 +391,12 @@ class NativeEventStore(EventStore):
     def _write_batch(self, events, app_id: int) -> None:
         """Native batch append for fresh inserts (see ``write`` /
         ``write_new``). Uses the event's own id when present (write_new's
-        freshness contract), else mints one."""
+        freshness contract), else mints one. Appends go to this writer's
+        private segment when a writer_id is set (the multi-writer fast
+        path — see class docstring's routing invariant)."""
         from .bimap import _fnv1a64_batch
 
-        h = self._handle(app_id, create=True)
+        h = self._writer_handle(app_id)
         n = len(events)
         times = np.empty(n, dtype=np.int64)
         ctimes = np.empty(n, dtype=np.int64)
@@ -310,9 +465,16 @@ class NativeEventStore(EventStore):
     # -- point ops --------------------------------------------------------
     def insert(self, event: Event, app_id: int) -> str:
         validate_event(event)
-        h = self._handle(app_id, create=True)
         event_id = event.event_id or make_event_id(event)
-        if event.event_id is not None:
+        if event.event_id is None:
+            # fresh-id insert: eligible for this writer's private segment
+            # (the per-event ingest hot path)
+            h = self._writer_handle(app_id)
+        else:
+            # explicit id ⇒ upsert: MUST go to the primary log, where the
+            # tombstone and the replacement record are totally ordered
+            # (the multi-writer routing invariant)
+            h = self._handle(app_id, create=True)
             # Upsert semantics to match the SQLite backend's INSERT OR
             # REPLACE on event_id: a tombstone first kills any earlier record
             # with this id (scans are order-sensitive, so the fresh record
@@ -343,19 +505,33 @@ class NativeEventStore(EventStore):
         return event_id
 
     def get(self, event_id: str, app_id: int) -> Optional[Event]:
-        h = self._handle(app_id)
-        if h is None:
-            return None
+        id_hash = _fnv(event_id)
         out_off = ctypes.c_int64()
         out_len = ctypes.c_int64()
-        found = self._lib.evlog_get(
-            h, _fnv(event_id), ctypes.byref(out_off), ctypes.byref(out_len)
-        )
-        if not found:
-            return None
-        event = self._decode_one(app_id, out_off.value, out_len.value)
-        # exact-id check guards against id_hash collisions
-        return event if event and event.event_id == event_id else None
+        # Primary first: it is authoritative for deletes/upserts. A -1
+        # (latest record for the id is a tombstone) means DELETED — do not
+        # probe segments, their same-id records are dead by the routing
+        # invariant. A hash match whose exact id differs (collision) keeps
+        # probing the remaining segments; only the tombstone case is
+        # hash-only (the module docstring's accepted ~2^-64 risk).
+        for fname in self._segment_files(app_id):
+            h = self._seg_handle(app_id, fname)
+            if h is None:
+                continue
+            found = self._lib.evlog_get(
+                h, id_hash, ctypes.byref(out_off), ctypes.byref(out_len)
+            )
+            if found == 1:
+                event = self._decode_one(
+                    app_id, out_off.value, out_len.value, fname
+                )
+                # exact-id check guards against id_hash collisions
+                if event and event.event_id == event_id:
+                    return event
+                continue  # colliding foreign id — keep probing
+            if found == -1 and fname == _PRIMARY:
+                return None  # tombstoned in the authoritative log
+        return None
 
     def delete(self, event_id: str, app_id: int) -> bool:
         if self.get(event_id, app_id) is None:
@@ -373,10 +549,51 @@ class NativeEventStore(EventStore):
     # -- bulk scan --------------------------------------------------------
     def _scan_offsets(
         self, app_id: int, f: EventFilter
-    ) -> Optional[Tuple[int, np.ndarray, np.ndarray, np.ndarray]]:
-        h = self._handle(app_id)
-        if h is None:
+    ) -> Optional[Tuple[list, np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+        """Merged index scan across every segment of the app.
+
+        Returns ``(segment_filenames, seg_idx, offs, lens, times)`` sorted
+        by (event_time, segment, offset); ``seg_idx[i]`` indexes into
+        ``segment_filenames`` for row i. Secondary-segment matches whose id
+        hash appears in the primary's tombstone set are dropped (exact
+        under the routing invariant — see class docstring)."""
+        segs = self._segment_files(app_id)
+        if not segs:
             return None
+        tomb = (
+            self._tombstone_hashes(app_id)
+            if any(s != _PRIMARY for s in segs)
+            else np.zeros(0, dtype=np.uint64)
+        )
+        per_seg = []
+        for si, fname in enumerate(segs):
+            h = self._seg_handle(app_id, fname)
+            if h is None:
+                continue
+            offs, lens, tms, ids = self._scan_one(h, f)
+            if fname != _PRIMARY and len(offs) and len(tomb):
+                alive = ~np.isin(ids, tomb)
+                offs, lens, tms = offs[alive], lens[alive], tms[alive]
+            if len(offs):
+                per_seg.append((si, offs, lens, tms))
+        if not per_seg:
+            return segs, *(np.zeros(0, dtype=np.int64) for _ in range(4))
+        if len(per_seg) == 1:
+            si, offs, lens, tms = per_seg[0]
+            seg_idx = np.full(len(offs), si, dtype=np.int64)
+            return segs, seg_idx, offs, lens, tms
+        seg_idx = np.concatenate(
+            [np.full(len(o), si, dtype=np.int64) for si, o, _, _ in per_seg]
+        )
+        offs = np.concatenate([o for _, o, _, _ in per_seg])
+        lens = np.concatenate([ln for _, _, ln, _ in per_seg])
+        tms = np.concatenate([t for _, _, _, t in per_seg])
+        order = np.lexsort((offs, seg_idx, tms))  # time, then segment, off
+        return segs, seg_idx[order], offs[order], lens[order], tms[order]
+
+    def _scan_one(
+        self, h: int, f: EventFilter
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         start = _ms(f.start_time) if f.start_time else _INT64_MIN
         until = _ms(f.until_time) if f.until_time else _INT64_MAX
         etype = _fnv(f.entity_type) if f.entity_type else 0
@@ -410,21 +627,25 @@ class NativeEventStore(EventStore):
             out_off = np.empty(cap, dtype=np.int64)
             out_len = np.empty(cap, dtype=np.int64)
             out_time = np.empty(cap, dtype=np.int64)
+            out_id = np.empty(cap, dtype=np.uint64)
             n = self._lib.evlog_scan(
                 h, start, until, etype, entity, ev_ptr, ev_n, ttype, target,
                 has_target,
                 out_off.ctypes.data_as(ctypes.c_void_p),
                 out_len.ctypes.data_as(ctypes.c_void_p),
-                out_time.ctypes.data_as(ctypes.c_void_p), cap,
+                out_time.ctypes.data_as(ctypes.c_void_p),
+                out_id.ctypes.data_as(ctypes.c_void_p), cap,
             )
             if n < 0:
                 raise OSError(f"evlog_scan failed: errno {-n}")
             if n <= cap:
-                return h, out_off[:n], out_len[:n], out_time[:n]
+                return out_off[:n], out_len[:n], out_time[:n], out_id[:n]
             cap = int(n)
 
-    def _decode_one(self, app_id: int, off: int, length: int) -> Optional[Event]:
-        path = self._log_path(app_id)
+    def _decode_one(
+        self, app_id: int, off: int, length: int, fname: str = _PRIMARY
+    ) -> Optional[Event]:
+        path = self._log_path(app_id, fname)
         with open(path, "rb") as fh:
             fh.seek(off)
             data = fh.read(length)
@@ -440,8 +661,8 @@ class NativeEventStore(EventStore):
         scan = self._scan_offsets(app_id, f)
         if scan is None:
             return iter(())
-        _, offs, lens, _times = scan
-        return self._decode_iter(app_id, f, offs, lens)
+        segs, seg_idx, offs, lens, _times = scan
+        return self._decode_iter(app_id, f, segs, seg_idx, offs, lens)
 
     @staticmethod
     def _dict_matches(f: EventFilter, obj: dict) -> bool:
@@ -479,14 +700,19 @@ class NativeEventStore(EventStore):
         ``value_rules`` maps event name → property name (str) or fixed
         float, with at most one distinct property name across rules (the
         recommendation template needs one). Returns
-        ``(users_i32, items_i32, vals_f32, user_ids, item_ids)`` ordered by
-        (event_time, offset) — identical index assignment to the streaming
-        Python path. Raises ``ValueError`` when the rules need more than
-        one property name (callers fall back to the generic path).
+        ``(users_i32, items_i32, vals_f32, user_ids, item_ids)``. On a
+        single log the order is (event_time, offset) — identical index
+        assignment to the streaming Python path; with writer segments the
+        concatenation is segment-major (index assignment is deterministic
+        but segment-ordered — harmless, indices are arbitrary labels).
+        Raises ``ValueError`` when the rules need more than one property
+        name, or when writer segments coexist with primary-log tombstones
+        (the per-segment native scan cannot apply cross-segment deletes);
+        callers fall back to the generic path.
         """
         prop_names = {r for r in value_rules.values() if isinstance(r, str)}
         if len(prop_names) > 1:
-            raise ValueError(
+            raise NativeScanUnsupported(
                 f"native ratings scan supports one property name, got "
                 f"{sorted(prop_names)}"
             )
@@ -495,10 +721,27 @@ class NativeEventStore(EventStore):
             np.zeros(0, np.int32), np.zeros(0, np.int32),
             np.zeros(0, np.float32), [], [],
         )
+        segs = self._segment_files(app_id)
+        if not segs:
+            return empty
+        if segs != [_PRIMARY]:
+            if len(self._tombstone_hashes(app_id)):
+                raise NativeScanUnsupported(
+                    "native ratings scan cannot apply primary-log deletes "
+                    "across writer segments; use the generic scan path"
+                )
+            parts = []
+            for fname in segs:
+                h = self._seg_handle(app_id, fname)
+                if h is not None:
+                    parts.append(self._scan_ratings_one(h, value_rules, prop_name))
+            return _merge_rating_parts(parts) if parts else empty
         h = self._handle(app_id)
         if h is None:
             return empty
+        return self._scan_ratings_one(h, value_rules, prop_name)
 
+    def _scan_ratings_one(self, h: int, value_rules: dict, prop_name: str):
         names = list(value_rules)
         n = len(names)
         hashes = np.asarray([_fnv(nm) for nm in names], dtype=np.uint64)
@@ -622,66 +865,101 @@ class NativeEventStore(EventStore):
         scan = self._scan_offsets(app_id, f)
         if scan is None:
             return
-        _, offs, lens, tms = scan
+        segs, seg_idx, offs, lens, tms = scan
         if f.reversed:
-            offs, lens, tms = offs[::-1], lens[::-1], tms[::-1]
+            seg_idx, offs, lens, tms = (
+                seg_idx[::-1], offs[::-1], lens[::-1], tms[::-1]
+            )
         limit = f.limit if f.limit is not None and f.limit >= 0 else None
         if not len(offs):
             return
         emitted = 0
-        path = self._log_path(app_id)
-        with open(path, "rb") as fh:
-            size = os.fstat(fh.fileno()).st_size
-            with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
-                cols = self._empty_cols()
-                times: list = []
-                for off, length, tm in zip(
-                    offs.tolist(), lens.tolist(), tms.tolist()
-                ):
-                    obj = json.loads(mm[off : off + length])
-                    if not self._dict_matches(f, obj):
-                        continue
-                    cols["event"].append(obj["event"])
-                    cols["entity_type"].append(obj["entityType"])
-                    cols["entity_id"].append(obj["entityId"])
-                    cols["target_entity_type"].append(obj.get("targetEntityType"))
-                    cols["target_entity_id"].append(obj.get("targetEntityId"))
-                    cols["properties"].append(obj.get("properties") or {})
-                    times.append(tm)
-                    emitted += 1
-                    full = len(times) >= chunk_rows
-                    done = limit is not None and emitted >= limit
-                    if full or done:
-                        cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
-                        yield cols
-                        if done:
-                            return
-                        cols = self._empty_cols()
-                        times = []
-                if times:
+        with self._segment_mmaps(self, app_id, segs) as mms:
+            cols = self._empty_cols()
+            times: list = []
+            for si, off, length, tm in zip(
+                seg_idx.tolist(), offs.tolist(), lens.tolist(), tms.tolist()
+            ):
+                mm = mms[si]
+                obj = json.loads(mm[off : off + length])
+                if not self._dict_matches(f, obj):
+                    continue
+                cols["event"].append(obj["event"])
+                cols["entity_type"].append(obj["entityType"])
+                cols["entity_id"].append(obj["entityId"])
+                cols["target_entity_type"].append(obj.get("targetEntityType"))
+                cols["target_entity_id"].append(obj.get("targetEntityId"))
+                cols["properties"].append(obj.get("properties") or {})
+                times.append(tm)
+                emitted += 1
+                full = len(times) >= chunk_rows
+                done = limit is not None and emitted >= limit
+                if full or done:
                     cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
                     yield cols
+                    if done:
+                        return
+                    cols = self._empty_cols()
+                    times = []
+            if times:
+                cols["event_time_ms"] = np.asarray(times, dtype=np.int64)
+                yield cols
+
+    class _segment_mmaps:
+        """Context manager mapping segment index → read mmap, opened
+        lazily (a scan may touch only some segments)."""
+
+        def __init__(self, store, app_id: int, segs: list):
+            self._store, self._app_id, self._segs = store, app_id, segs
+            self._files: list = []
+            self._mms: dict = {}
+
+        def __enter__(self):
+            return self
+
+        def __getitem__(self, si: int):
+            mm = self._mms.get(si)
+            if mm is None:
+                path = self._store._log_path(self._app_id, self._segs[si])
+                fh = open(path, "rb")
+                self._files.append(fh)
+                size = os.fstat(fh.fileno()).st_size
+                mm = mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ)
+                self._mms[si] = mm
+            return mm
+
+        def __exit__(self, *exc):
+            for mm in self._mms.values():
+                try:
+                    mm.close()
+                except Exception:
+                    pass
+            for fh in self._files:
+                try:
+                    fh.close()
+                except Exception:
+                    pass
 
     def _decode_iter(
-        self, app_id: int, f: EventFilter, offs: np.ndarray, lens: np.ndarray
+        self, app_id: int, f: EventFilter, segs: list,
+        seg_idx: np.ndarray, offs: np.ndarray, lens: np.ndarray,
     ) -> Iterator[Event]:
         if f.reversed:
-            offs, lens = offs[::-1], lens[::-1]
+            seg_idx, offs, lens = seg_idx[::-1], offs[::-1], lens[::-1]
         limit = f.limit if f.limit is not None and f.limit >= 0 else None
         emitted = 0
-        path = self._log_path(app_id)
         if len(offs) == 0:
             return
-        with open(path, "rb") as fh:
-            size = os.fstat(fh.fileno()).st_size
-            with mmap.mmap(fh.fileno(), size, access=mmap.ACCESS_READ) as mm:
-                for off, length in zip(offs.tolist(), lens.tolist()):
-                    obj = json.loads(mm[off : off + length])
-                    event = Event.from_json_dict(obj)
-                    # exact re-check (hash-collision guard)
-                    if not f.matches(event):
-                        continue
-                    yield event
-                    emitted += 1
-                    if limit is not None and emitted >= limit:
-                        return
+        with self._segment_mmaps(self, app_id, segs) as mms:
+            for si, off, length in zip(
+                seg_idx.tolist(), offs.tolist(), lens.tolist()
+            ):
+                obj = json.loads(mms[si][off : off + length])
+                event = Event.from_json_dict(obj)
+                # exact re-check (hash-collision guard)
+                if not f.matches(event):
+                    continue
+                yield event
+                emitted += 1
+                if limit is not None and emitted >= limit:
+                    return

@@ -63,7 +63,12 @@ def _native_events(conf: Dict[str, str]) -> EventStore:
             "native event store backend is not built "
             f"(predictionio_tpu.storage.native_events): {exc}"
         ) from exc
-    return NativeEventStore(os.path.join(_conf_root(conf), "events_native"))
+    return NativeEventStore(
+        os.path.join(_conf_root(conf), "events_native"),
+        # PIO_STORAGE_SOURCES_<N>_WRITER_ID: give each ingest process its
+        # own append segment (multi-writer scaling; see NativeEventStore)
+        writer_id=conf.get("writer_id"),
+    )
 
 
 # Built-in families (the analogue of the reference's in-tree backend

@@ -116,19 +116,112 @@ def run(n_events: int, chunk_rows: int, tmp_root: str) -> dict:
     }
 
 
+def _writer_child(tmp_root: str, writer_id: str, n_events: int,
+                  offset: int) -> None:
+    """One ingest process appending to its private writer segment."""
+    import datetime as _dt
+
+    from ..storage.event import UTC, Event
+    from ..storage.native_events import NativeEventStore
+
+    rng = np.random.default_rng(hash(writer_id) % (1 << 32))
+    store = NativeEventStore(
+        os.path.join(tmp_root, "events_native"), writer_id=writer_id
+    )
+    store.init(1)
+    base = _dt.datetime.fromtimestamp(1_750_000_000 + offset, tz=UTC)
+    written = 0
+    while written < n_events:
+        b = min(200_000, n_events - written)
+        users = rng.integers(0, 100_000, b)
+        items = rng.integers(0, 20_000, b)
+        vals = rng.integers(1, 6, b)
+        store.write(
+            [
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{users[j]}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{items[j]}",
+                    properties={"rating": float(vals[j])},
+                    event_time=base,
+                )
+                for j in range(b)
+            ],
+            1,
+        )
+        written += b
+    store.close()
+
+
+def run_multiwriter(n_events: int, writers: int, tmp_root: str) -> dict:
+    """N concurrent OS processes, each appending to its own segment of ONE
+    app (the HBase region-parallel write analogue, HBPEvents.scala:166-184).
+    Reports aggregate events/s and verifies the merged scan sees every
+    segment's records."""
+    import subprocess
+
+    per = n_events // writers
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c",
+                "from predictionio_tpu.tools.ingestbench import _writer_child;"
+                f"_writer_child({tmp_root!r}, 'w{i}', {per}, {i})",
+            ],
+        )
+        for i in range(writers)
+    ]
+    for p in procs:
+        p.wait()
+    ingest_s = time.monotonic() - t0
+    if any(p.returncode != 0 for p in procs):
+        raise RuntimeError("a writer process failed")
+
+    from ..storage.native_events import NativeEventStore
+
+    store = NativeEventStore(os.path.join(tmp_root, "events_native"))
+    t1 = time.monotonic()
+    u, it, v, uids, iids = store.scan_ratings(1, {"rate": "rating"})
+    scan_s = time.monotonic() - t1
+    total = per * writers
+    assert len(v) == total, f"merged scan saw {len(v)} of {total}"
+    store.close()
+    return {
+        "metric": "multiwriter_ingest_events_per_s",
+        "value": round(total / ingest_s, 1),
+        "unit": "events/s",
+        "writers": writers,
+        "events": total,
+        "ingest_s": round(ingest_s, 2),
+        "merged_scan_events_per_s": round(total / scan_s, 1),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--events", type=int, default=20_000_000)
     ap.add_argument("--chunk-rows", type=int, default=1_000_000)
+    ap.add_argument("--writers", type=int, default=0,
+                    help="N concurrent writer processes appending to "
+                         "private segments of one app (0 = single-process "
+                         "full-pipeline bench)")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a fresh tempdir, removed)")
     args = ap.parse_args(argv)
+
+    def _go(d):
+        if args.writers > 0:
+            return run_multiwriter(args.events, args.writers, d)
+        return run(args.events, args.chunk_rows, d)
+
     if args.workdir:
         os.makedirs(args.workdir, exist_ok=True)
-        record = run(args.events, args.chunk_rows, args.workdir)
+        record = _go(args.workdir)
     else:
         with tempfile.TemporaryDirectory(prefix="pio-ingestbench-") as d:
-            record = run(args.events, args.chunk_rows, d)
+            record = _go(d)
     print(json.dumps(record))
     return 0
 

@@ -130,16 +130,25 @@ def stream_ratings(
         and n_props <= 1
         and hasattr(store, "scan_ratings")
     ):
-        users, items, vals, user_ids, item_ids = store.scan_ratings(
-            app_id, value_rules
-        )
-        return RatingBatch(
-            users=users,
-            items=items,
-            ratings=vals,
-            user_map=BiMap({k: i for i, k in enumerate(user_ids)}),
-            item_map=BiMap({k: i for i, k in enumerate(item_ids)}),
-        )
+        from ..storage.native_events import NativeScanUnsupported
+
+        try:
+            users, items, vals, user_ids, item_ids = store.scan_ratings(
+                app_id, value_rules
+            )
+        except NativeScanUnsupported:
+            # the native scan declined (e.g. writer segments + primary-log
+            # deletes): the generic chunked path below is always exact.
+            # Plain ValueError (bad data) still propagates.
+            pass
+        else:
+            return RatingBatch(
+                users=users,
+                items=items,
+                ratings=vals,
+                user_map=BiMap({k: i for i, k in enumerate(user_ids)}),
+                item_map=BiMap({k: i for i, k in enumerate(item_ids)}),
+            )
 
     if hashed_users:
         from ..storage.bimap import HashedIdMap

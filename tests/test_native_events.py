@@ -251,3 +251,169 @@ def test_concurrent_cross_process_appends(root):
     assert sum(1 for i in ids if i.startswith("p0-")) == 300
     assert sum(1 for i in ids if i.startswith("p1-")) == 300
     store.close()
+
+
+# -- multi-writer segments ---------------------------------------------------
+# N ingest processes each append to a private segment file (no flock
+# contention); reads merge segments. Tombstones/upserts live only in the
+# primary log, which makes cross-segment delete filtering exact.
+
+
+def _ev(i, user="u", item="i", event="rate", val=None, event_id=None):
+    return Event(
+        event=event, entity_type="user", entity_id=f"{user}{i}",
+        target_entity_type="item", target_entity_id=f"{item}{i % 7}",
+        properties=DataMap({"rating": float(val if val is not None else i % 5 + 1)}),
+        event_time=ts(i), event_id=event_id,
+    )
+
+
+class TestWriterSegments:
+    def test_writers_append_to_private_segments(self, root):
+        w1 = NativeEventStore(root, writer_id="w1")
+        w2 = NativeEventStore(root, writer_id="w2")
+        w1.init(1)
+        w1.write([_ev(i) for i in range(0, 10)], 1)
+        w2.write([_ev(i) for i in range(10, 20)], 1)
+        app_dir = os.path.join(root, "app_1")
+        names = sorted(os.listdir(app_dir))
+        assert "events.w-w1.log" in names and "events.w-w2.log" in names
+        w1.close()
+        w2.close()
+
+    def test_merged_find_sees_all_segments_in_time_order(self, root):
+        w1 = NativeEventStore(root, writer_id="w1")
+        w2 = NativeEventStore(root, writer_id="w2")
+        reader = NativeEventStore(root)
+        w1.init(1)
+        w1.write([_ev(i) for i in range(0, 20, 2)], 1)   # even hours
+        w2.write([_ev(i) for i in range(1, 20, 2)], 1)   # odd hours
+        got = list(reader.find(1, EventFilter(event_names=["rate"])))
+        assert len(got) == 20
+        times = [e.event_time for e in got]
+        assert times == sorted(times)  # merged across segments by time
+        assert {e.entity_id for e in got} == {f"u{i}" for i in range(20)}
+        for s in (w1, w2, reader):
+            s.close()
+
+    def test_single_event_insert_goes_to_segment(self, root):
+        w = NativeEventStore(root, writer_id="ingest1")
+        w.init(3)
+        eid = w.insert(_ev(0), 3)  # fresh id -> private segment
+        assert os.path.exists(os.path.join(root, "app_3", "events.w-ingest1.log"))
+        # readable via merged get() from a plain reader
+        reader = NativeEventStore(root)
+        assert reader.get(eid, 3) is not None
+        w.close()
+        reader.close()
+
+    def test_delete_kills_segment_record(self, root):
+        w = NativeEventStore(root, writer_id="w1")
+        reader = NativeEventStore(root)
+        w.init(1)
+        w.write([_ev(i) for i in range(5)], 1)
+        victim = list(reader.find(1))[2]
+        # delete through a store with NO writer id: tombstone -> primary
+        assert reader.delete(victim.event_id, 1)
+        assert reader.get(victim.event_id, 1) is None
+        left = list(reader.find(1))
+        assert len(left) == 4
+        assert victim.event_id not in {e.event_id for e in left}
+        # and through a WRITER store the tombstone also goes to primary
+        victim2 = left[0]
+        assert w.delete(victim2.event_id, 1)
+        assert len(list(reader.find(1))) == 3
+        for s in (w, reader):
+            s.close()
+
+    def test_upsert_replaces_segment_record(self, root):
+        w = NativeEventStore(root, writer_id="w1")
+        reader = NativeEventStore(root)
+        w.init(1)
+        w.write([_ev(i) for i in range(3)], 1)
+        old = list(reader.find(1))[0]
+        updated = _ev(0, val=9.0, event_id=old.event_id)
+        # explicit-id insert (upsert) must route to the primary log
+        w.insert(updated, 1)
+        got = reader.get(old.event_id, 1)
+        assert got is not None and got.properties["rating"] == 9.0
+        # merged scans show exactly one record for the id
+        matching = [
+            e for e in reader.find(1) if e.event_id == old.event_id
+        ]
+        assert len(matching) == 1 and matching[0].properties["rating"] == 9.0
+        for s in (w, reader):
+            s.close()
+
+    def test_columnar_scan_merges_segments(self, root):
+        w1 = NativeEventStore(root, writer_id="w1")
+        w2 = NativeEventStore(root, writer_id="w2")
+        reader = NativeEventStore(root)
+        w1.init(1)
+        w1.write([_ev(i) for i in range(0, 50, 2)], 1)
+        w2.write([_ev(i) for i in range(1, 50, 2)], 1)
+        cols = reader.scan_columnar(1, EventFilter(event_names=["rate"]))
+        assert len(cols["event"]) == 50
+        t = cols["event_time_ms"]
+        assert (t[1:] >= t[:-1]).all()
+        for s in (w1, w2, reader):
+            s.close()
+
+    def test_ratings_scan_merges_segments(self, root):
+        w1 = NativeEventStore(root, writer_id="w1")
+        w2 = NativeEventStore(root, writer_id="w2")
+        single = NativeEventStore(str(root) + "_single")
+        for s in (w1, single):
+            s.init(1)
+        evs_a = [_ev(i) for i in range(0, 30, 2)]
+        evs_b = [_ev(i) for i in range(1, 30, 2)]
+        w1.write(evs_a, 1)
+        w2.write(evs_b, 1)
+        single.write(evs_a + evs_b, 1)
+        reader = NativeEventStore(root)
+        u, it, v, uids, iids = reader.scan_ratings(1, {"rate": "rating"})
+        su, sit, sv, suids, siids = single.scan_ratings(1, {"rate": "rating"})
+        # same triples regardless of segmentation (index labels may differ)
+        def triples(us, its, vs, upool, ipool):
+            return sorted(
+                (upool[a], ipool[b], float(c))
+                for a, b, c in zip(us.tolist(), its.tolist(), vs.tolist())
+            )
+        assert triples(u, it, v, uids, iids) == triples(su, sit, sv, suids, siids)
+        for s in (w1, w2, reader, single):
+            s.close()
+
+    def test_ratings_scan_declines_segments_with_deletes(self, root):
+        from predictionio_tpu.storage.native_events import NativeScanUnsupported
+
+        w = NativeEventStore(root, writer_id="w1")
+        reader = NativeEventStore(root)
+        w.init(1)
+        w.write([_ev(i) for i in range(6)], 1)
+        victim = list(reader.find(1))[0]
+        reader.delete(victim.event_id, 1)
+        with pytest.raises(NativeScanUnsupported):
+            reader.scan_ratings(1, {"rate": "rating"})
+        # the generic path (stream_ratings fallback) stays exact
+        from predictionio_tpu.workflow.infeed import stream_ratings
+
+        batch = stream_ratings(reader, 1, {"rate": "rating"})
+        assert len(batch.ratings) == 5
+        for s in (w, reader):
+            s.close()
+
+    def test_bad_writer_id_rejected(self, root):
+        with pytest.raises(ValueError, match="writer_id"):
+            NativeEventStore(root, writer_id="../evil")
+
+    def test_segment_torn_tail_truncated_on_reopen(self, root):
+        w = NativeEventStore(root, writer_id="w1")
+        w.init(1)
+        w.write([_ev(i) for i in range(4)], 1)
+        w.close()
+        seg = os.path.join(root, "app_1", "events.w-w1.log")
+        with open(seg, "ab") as f:
+            f.write(b"\x55" * 13)  # torn partial record
+        reader = NativeEventStore(root)
+        assert len(list(reader.find(1))) == 4
+        reader.close()
