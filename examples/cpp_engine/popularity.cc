@@ -1,0 +1,79 @@
+// Item-popularity recommender authored in C++.
+//
+// The worked second-language engine example (the counterpart of the
+// reference's examples/experimental/java-local-tutorial engines built on
+// the controller/java shim): this program implements the Algorithm role
+// of a DASE engine over the framework's foreign-component protocol
+// (line-delimited JSON on stdin/stdout; see
+// predictionio_tpu/controller/foreign.py). The Python side supplies the
+// DataSource/Preparator (event-store scan) and plugs this binary in via
+// ForeignAlgorithm — mix-and-match across languages, exactly like the
+// reference mixes Java components into Scala engines.
+//
+// train:   data = {"ratings": [["u1", "i3", 4.0], ...]}
+//          model = {"items": ["i3", ...], "scores": [12.5, ...]}  (sorted)
+// predict: query = {"user": "...", "num": N}
+//          result = {"itemScores": [{"item": "...", "score": S}, ...]}
+//
+// Popularity = sum of rating values per item; the per-params "min_count"
+// knob drops long-tail items. Build:
+//   g++ -O2 -std=c++17 -I ../../sdk/cpp -o popularity popularity.cc
+
+#include <algorithm>
+#include <unordered_map>
+
+#include "pio_engine.hpp"
+
+using pio::Json;
+
+int main() {
+  pio::Handlers h;
+
+  h.train = [](const Json& params, const Json& data) -> Json {
+    int64_t min_count = params["min_count"].is_null()
+                            ? 1
+                            : params["min_count"].as_int();
+    std::unordered_map<std::string, double> score;
+    std::unordered_map<std::string, int64_t> count;
+    for (const Json& row : data["ratings"].items()) {
+      const std::string& item = row.items()[1].as_string();
+      score[item] += row.items()[2].as_number();
+      count[item] += 1;
+    }
+    std::vector<std::pair<std::string, double>> ranked;
+    for (const auto& kv : score) {
+      if (count[kv.first] >= min_count) ranked.push_back(kv);
+    }
+    std::sort(ranked.begin(), ranked.end(), [](const auto& a, const auto& b) {
+      return a.second != b.second ? a.second > b.second : a.first < b.first;
+    });
+    Json items = Json::array(), scores = Json::array();
+    for (const auto& kv : ranked) {
+      items.push(Json(kv.first));
+      scores.push(Json(kv.second));
+    }
+    Json model = Json::object();
+    model.set("items", items);
+    model.set("scores", scores);
+    return model;
+  };
+
+  h.predict = [](const Json& model, const Json& query) -> Json {
+    int64_t num = query["num"].is_null() ? 10 : query["num"].as_int();
+    if (num < 0) throw std::runtime_error("num must be >= 0");
+    const auto& items = model["items"].items();
+    const auto& scores = model["scores"].items();
+    Json out = Json::array();
+    for (size_t i = 0; i < items.size() && (int64_t)i < num; i++) {
+      Json row = Json::object();
+      row.set("item", items[i]);
+      row.set("score", scores[i]);
+      out.push(row);
+    }
+    Json result = Json::object();
+    result.set("itemScores", out);
+    return result;
+  };
+
+  return pio::engine_main(h);
+}

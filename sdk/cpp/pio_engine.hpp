@@ -1,0 +1,395 @@
+// Engine-authoring helper for C++ DASE components.
+//
+// The counterpart of the reference's Java authoring shim
+// (core/src/main/scala/io/prediction/controller/java/LJavaAlgorithm.scala
+// and siblings): where the reference lets JVM languages implement DASE
+// roles in-process, this framework runs a foreign component as a child
+// process speaking line-delimited JSON on stdin/stdout (see
+// predictionio_tpu/controller/foreign.py for the protocol). This header
+// provides everything a C++ component needs: a small self-contained JSON
+// value type (parse + serialize) and pio::engine_main(), the stdio
+// request loop.
+//
+// Usage (see examples/cpp_engine/popularity.cc):
+//
+//   #include "pio_engine.hpp"
+//   int main() {
+//     pio::Handlers h;
+//     h.train   = [](const pio::Json& params, const pio::Json& data) { ... };
+//     h.predict = [](const pio::Json& model, const pio::Json& query) { ... };
+//     return pio::engine_main(h);
+//   }
+//
+// Handlers throw std::runtime_error to report a component-level failure;
+// engine_main turns it into an {"error": ...} response and keeps serving
+// (one bad query must not kill the process — micro-batch parity with the
+// in-tree serving path).
+
+#ifndef PIO_ENGINE_HPP_
+#define PIO_ENGINE_HPP_
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <iostream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace pio {
+
+// ---------------------------------------------------------------------------
+// Json: a compact tagged-union JSON value (enough for the wire protocol).
+// ---------------------------------------------------------------------------
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(double d) : type_(Type::Number), num_(d) {}
+  Json(int i) : type_(Type::Number), num_(i) {}
+  Json(int64_t i) : type_(Type::Number), num_((double)i) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+
+  static Json array() { Json j; j.type_ = Type::Array; return j; }
+  static Json object() { Json j; j.type_ = Type::Object; return j; }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool as_bool() const { expect(Type::Bool); return bool_; }
+  double as_number() const { expect(Type::Number); return num_; }
+  int64_t as_int() const { expect(Type::Number); return (int64_t)num_; }
+  const std::string& as_string() const { expect(Type::String); return str_; }
+  const std::vector<Json>& items() const { expect(Type::Array); return arr_; }
+  const std::map<std::string, Json>& fields() const {
+    expect(Type::Object);
+    return obj_;
+  }
+
+  // object access; missing key -> Null
+  const Json& operator[](const std::string& key) const {
+    static const Json kNull;
+    if (type_ != Type::Object) return kNull;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? kNull : it->second;
+  }
+  void set(const std::string& key, Json v) {
+    expect(Type::Object);
+    obj_[key] = std::move(v);
+  }
+  void push(Json v) { expect(Type::Array); arr_.push_back(std::move(v)); }
+  size_t size() const {
+    return type_ == Type::Array ? arr_.size()
+         : type_ == Type::Object ? obj_.size() : 0;
+  }
+
+  // -- serialize ------------------------------------------------------------
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  // -- parse ----------------------------------------------------------------
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size())
+      throw std::runtime_error("JSON: trailing characters");
+    return v;
+  }
+
+ private:
+  void expect(Type t) const {
+    if (type_ != t) throw std::runtime_error("JSON: wrong type access");
+  }
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == (double)(int64_t)num_ &&
+            std::abs(num_) < 1e15) {
+          os << (int64_t)num_;
+        } else {
+          char buf[32];
+          snprintf(buf, sizeof(buf), "%.17g", num_);
+          os << buf;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); i++) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, kv.first);
+          os << ':';
+          kv.second.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;  // UTF-8 bytes pass through
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() &&
+           (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' || t[p] == '\r'))
+      p++;
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("JSON: unexpected end");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (c == 't') { expect_lit(t, p, "true"); return Json(true); }
+    if (c == 'f') { expect_lit(t, p, "false"); return Json(false); }
+    if (c == 'n') { expect_lit(t, p, "null"); return Json(); }
+    return parse_number(t, p);
+  }
+
+  static void expect_lit(const std::string& t, size_t& p, const char* lit) {
+    size_t n = strlen(lit);
+    if (t.compare(p, n, lit) != 0)
+      throw std::runtime_error("JSON: bad literal");
+    p += n;
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && (t[p] == '-' || t[p] == '+')) p++;
+    while (p < t.size() &&
+           (isdigit((unsigned char)t[p]) || t[p] == '.' || t[p] == 'e' ||
+            t[p] == 'E' || t[p] == '-' || t[p] == '+'))
+      p++;
+    try {
+      return Json(std::stod(t.substr(start, p - start)));
+    } catch (...) {
+      throw std::runtime_error("JSON: bad number");
+    }
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    if (t[p] != '"') throw std::runtime_error("JSON: expected string");
+    p++;
+    std::string out;
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p];
+      if (c == '\\') {
+        p++;
+        if (p >= t.size()) throw std::runtime_error("JSON: bad escape");
+        char e = t[p];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p + 4 >= t.size())
+              throw std::runtime_error("JSON: bad \\u escape");
+            unsigned cp = (unsigned)strtoul(t.substr(p + 1, 4).c_str(),
+                                            nullptr, 16);
+            p += 4;
+            // Surrogate pair: \uD800-\uDBFF must be followed by
+            // \uDC00-\uDFFF — combine into one code point (Python's
+            // json.dumps(ensure_ascii=True) sends every emoji this way).
+            // A lone/mismatched surrogate folds to U+FFFD.
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (p + 6 < t.size() && t[p + 1] == '\\' && t[p + 2] == 'u') {
+                unsigned lo = (unsigned)strtoul(
+                    t.substr(p + 3, 4).c_str(), nullptr, 16);
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                  p += 6;
+                } else {
+                  cp = 0xFFFD;
+                }
+              } else {
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;  // lone low surrogate
+            }
+            if (cp < 0x80) {
+              out += (char)cp;
+            } else if (cp < 0x800) {
+              out += (char)(0xC0 | (cp >> 6));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += (char)(0xE0 | (cp >> 12));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else {
+              out += (char)(0xF0 | (cp >> 18));
+              out += (char)(0x80 | ((cp >> 12) & 0x3F));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("JSON: bad escape");
+        }
+        p++;
+      } else {
+        out += c;
+        p++;
+      }
+    }
+    if (p >= t.size()) throw std::runtime_error("JSON: unterminated string");
+    p++;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    Json a = Json::array();
+    p++;  // [
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') { p++; return a; }
+    while (true) {
+      a.push(parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("JSON: unterminated array");
+      if (t[p] == ',') { p++; continue; }
+      if (t[p] == ']') { p++; return a; }
+      throw std::runtime_error("JSON: bad array separator");
+    }
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    Json o = Json::object();
+    p++;  // {
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') { p++; return o; }
+    while (true) {
+      skip_ws(t, p);
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':')
+        throw std::runtime_error("JSON: expected ':'");
+      p++;
+      o.set(key, parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("JSON: unterminated object");
+      if (t[p] == ',') { p++; continue; }
+      if (t[p] == '}') { p++; return o; }
+      throw std::runtime_error("JSON: bad object separator");
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0.0;
+  std::string str_;
+  std::vector<Json> arr_;
+  std::map<std::string, Json> obj_;
+};
+
+// ---------------------------------------------------------------------------
+// engine_main: the stdio request loop.
+// ---------------------------------------------------------------------------
+
+struct Handlers {
+  // DataSource role
+  std::function<Json(const Json& params)> read_training;
+  // Preparator role
+  std::function<Json(const Json& params, const Json& data)> prepare;
+  // Algorithm role
+  std::function<Json(const Json& params, const Json& data)> train;
+  std::function<Json(const Json& model, const Json& query)> predict;
+};
+
+inline int engine_main(const Handlers& h) {
+  std::ios::sync_with_stdio(false);
+  Json model;        // set by "load" or left by "train"
+  bool has_model = false;
+  std::string line;
+  while (std::getline(std::cin, line)) {
+    if (line.empty()) continue;
+    Json resp = Json::object();
+    try {
+      Json req = Json::parse(line);
+      resp.set("id", req["id"]);
+      const std::string& method = req["method"].as_string();
+      if (method == "train" && h.train) {
+        model = h.train(req["params"], req["data"]);
+        has_model = true;
+        resp.set("result", model);
+      } else if (method == "load") {
+        model = req["model"];
+        has_model = true;
+        resp.set("result", Json(true));
+      } else if (method == "predict" && h.predict) {
+        if (!has_model) throw std::runtime_error("no model loaded");
+        resp.set("result", h.predict(model, req["query"]));
+      } else if (method == "read_training" && h.read_training) {
+        resp.set("result", h.read_training(req["params"]));
+      } else if (method == "prepare" && h.prepare) {
+        resp.set("result", h.prepare(req["params"], req["data"]));
+      } else {
+        throw std::runtime_error("unsupported method: " + method);
+      }
+    } catch (const std::exception& e) {
+      resp.set("error", Json(std::string(e.what())));
+    }
+    std::cout << resp.dump() << "\n" << std::flush;
+  }
+  return 0;
+}
+
+}  // namespace pio
+
+#endif  // PIO_ENGINE_HPP_

@@ -1,0 +1,183 @@
+"""Second-language engine authoring (the reference's controller/java shim
+rebuilt as subprocess DASE components over JSON stdio —
+``controller/foreign.py`` + ``sdk/cpp/pio_engine.hpp``).
+
+The worked example (``examples/cpp_engine/popularity.cc``) is compiled
+with the system toolchain and driven through the real Engine train path,
+the serving predict path (incl. the micro-batcher), pickle round-trip
+(the deploy-time model store), and failure modes (bad query, child
+crash)."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from predictionio_tpu.controller import Engine
+from predictionio_tpu.controller.dase import IdentityPreparator, Serving
+from predictionio_tpu.controller.foreign import (
+    ForeignAlgorithm,
+    ForeignModel,
+    ForeignParams,
+    ForeignProcessError,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLE = os.path.join(_REPO, "examples", "cpp_engine")
+
+
+@pytest.fixture(scope="module")
+def popularity_bin(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("cppengine") / "popularity")
+    subprocess.run(
+        [
+            "g++", "-O2", "-std=c++17",
+            "-I", os.path.join(_REPO, "sdk", "cpp"),
+            "-o", out, os.path.join(_EXAMPLE, "popularity.cc"),
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return out
+
+
+RATINGS = [
+    ["u1", "i1", 5.0], ["u2", "i1", 4.0], ["u3", "i1", 3.0],
+    ["u1", "i2", 5.0], ["u2", "i2", 4.0],
+    ["u1", "i3", 1.0],
+]
+
+
+class TestForeignAlgorithm:
+    def _algo(self, popularity_bin, **params):
+        return ForeignAlgorithm(
+            ForeignParams(cmd=[popularity_bin], params=params, timeout_s=30)
+        )
+
+    def test_train_and_predict(self, popularity_bin):
+        algo = self._algo(popularity_bin)
+        model = algo.train(None, {"ratings": RATINGS})
+        assert isinstance(model, ForeignModel)
+        assert model.model_json["items"][0] == "i1"  # sum 12 > 9 > 1
+        pred = algo.predict(model, {"user": "u9", "num": 2})
+        assert [r["item"] for r in pred["itemScores"]] == ["i1", "i2"]
+        assert pred["itemScores"][0]["score"] == 12.0
+
+    def test_params_reach_the_child(self, popularity_bin):
+        algo = self._algo(popularity_bin, min_count=3)
+        model = algo.train(None, {"ratings": RATINGS})
+        # only i1 has >= 3 ratings
+        assert model.model_json["items"] == ["i1"]
+
+    def test_model_pickle_roundtrip_fresh_child(self, popularity_bin):
+        """Deploy analogue: the trained model goes through the model store
+        (pickle), and a NEW algorithm instance serves it by respawning the
+        child and pushing the model back with `load`."""
+        algo = self._algo(popularity_bin)
+        model = algo.train(None, {"ratings": RATINGS})
+        blob = pickle.dumps(model)
+        restored = pickle.loads(blob)
+        server_algo = self._algo(popularity_bin)  # fresh process
+        pred = server_algo.predict(restored, {"user": "u1", "num": 1})
+        assert pred["itemScores"][0]["item"] == "i1"
+
+    def test_bad_query_fails_alone(self, popularity_bin):
+        algo = self._algo(popularity_bin)
+        model = algo.train(None, {"ratings": RATINGS})
+        with pytest.raises(RuntimeError, match="num must be >= 0"):
+            algo.predict(model, {"user": "u1", "num": -1})
+        # the child survived the component-level error
+        ok = algo.predict(model, {"user": "u1", "num": 1})
+        assert ok["itemScores"][0]["item"] == "i1"
+
+    def test_child_crash_is_loud_then_recovers(self, popularity_bin):
+        algo = self._algo(popularity_bin)
+        model = algo.train(None, {"ratings": RATINGS})
+        algo._proc._proc.kill()  # simulate the component dying
+        algo._proc._proc.wait()
+        # next predict respawns the child and reloads the model
+        pred = algo.predict(model, {"user": "u1", "num": 1})
+        assert pred["itemScores"][0]["item"] == "i1"
+
+    def test_non_bmp_strings_roundtrip(self, popularity_bin):
+        """json.dumps escapes emoji as \\uD83D\\uDE00 surrogate pairs; the
+        C++ JSON codec must recombine them (CESU-8 halves would poison the
+        pipe when echoed back)."""
+        algo = self._algo(popularity_bin)
+        ratings = [["u😀", "item🎉", 5.0], ["u2", "item🎉", 2.0]]
+        model = algo.train(None, {"ratings": ratings})
+        assert model.model_json["items"][0] == "item🎉"
+        pred = algo.predict(model, {"user": "u😀", "num": 1})
+        assert pred["itemScores"][0]["item"] == "item🎉"
+
+    def test_partial_line_hang_trips_timeout(self, tmp_path):
+        """A child that writes half a response then wedges must trip the
+        per-request deadline, not block the serving thread forever."""
+        import textwrap
+
+        script = tmp_path / "wedge.py"
+        script.write_text(textwrap.dedent("""
+            import sys, time
+            sys.stdin.readline()
+            sys.stdout.write('{"id": 1, ')   # partial line, no newline
+            sys.stdout.flush()
+            time.sleep(600)
+        """))
+        algo = ForeignAlgorithm(
+            ForeignParams(cmd=[sys.executable, str(script)], timeout_s=1.5)
+        )
+        import time
+
+        t0 = time.monotonic()
+        with pytest.raises(ForeignProcessError, match="timed out"):
+            algo.train(None, {"ratings": []})
+        assert time.monotonic() - t0 < 10
+
+    def test_missing_binary_is_loud(self):
+        algo = ForeignAlgorithm(
+            ForeignParams(cmd=["/nonexistent/engine-bin"], timeout_s=5)
+        )
+        with pytest.raises(ForeignProcessError, match="cannot start"):
+            algo.train(None, {"ratings": RATINGS})
+
+
+class _DictServing(Serving):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class _ListSource:
+    """Python DataSource feeding the foreign algorithm — the mixed-language
+    engine the reference's Java shim exists for."""
+
+    params = None
+
+    def __init__(self, params=None):
+        self.params = params
+
+    def read_training(self, ctx):
+        return {"ratings": RATINGS}
+
+    def read_eval(self, ctx):
+        return []
+
+
+class TestMixedLanguageEngine:
+    def test_engine_train_with_foreign_algorithm(self, popularity_bin):
+        engine = Engine(
+            {"": _ListSource},
+            {"": IdentityPreparator},
+            {"": ForeignAlgorithm},
+            {"": _DictServing},
+        )
+        from predictionio_tpu.controller.engine import EngineParams
+
+        ep = EngineParams(
+            algorithm_params_list=[
+                ("", ForeignParams(cmd=[popularity_bin], timeout_s=30))
+            ],
+        )
+        models = engine.train(None, ep)
+        assert len(models) == 1 and isinstance(models[0], ForeignModel)
