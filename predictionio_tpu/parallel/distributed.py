@@ -10,6 +10,11 @@ framework (SURVEY §5 config tiers):
   multi-process mode on)
 - ``PIO_DIST_NUM_PROCESSES`` — world size
 - ``PIO_DIST_PROCESS_ID``    — this process's rank
+- ``PIO_DIST_HEARTBEAT_S``   — coordination-service heartbeat timeout
+  (default 100): a dead peer is detected within this bound and every
+  surviving process fails LOUDLY instead of hanging in a collective —
+  the failure-detection half of the SURVEY §5 "fail loud, resume from
+  checkpoint" contract (the recovery half is workflow/checkpoint.py)
 
 On TPU pods these usually come from the platform and plain
 ``jax.distributed.initialize()`` autodetects them; the env vars are the
@@ -46,7 +51,10 @@ def initialize_from_env(env: Optional[Dict[str, str]] = None) -> bool:
     num = int(e.get("PIO_DIST_NUM_PROCESSES", "1"))
     pid = int(e.get("PIO_DIST_PROCESS_ID", "0"))
     jax.distributed.initialize(
-        coordinator_address=coordinator, num_processes=num, process_id=pid
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+        heartbeat_timeout_seconds=int(e.get("PIO_DIST_HEARTBEAT_S", "100")),
     )
     initialize_from_env._initialized = True
     return True
