@@ -153,10 +153,14 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
 
     solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")
     gather_dtype = os.environ.get("BENCH_GATHER_DTYPE", "f32")
+    sort_gather = os.environ.get("BENCH_SORT_GATHER") == "1"
     cfg = ALSConfig(
         rank=50, iterations=iterations, lambda_=0.05, seed=0,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
     )
+    if sort_gather:
+        from predictionio_tpu.ops.als import sort_bucket_indices
+    _maybe_sort = sort_bucket_indices if sort_gather else (lambda b: b)
 
     # Warm the compilation cache with the REAL bucket shapes (jit keys on
     # shapes: a smaller sliver would leave the timed run paying XLA compile).
@@ -166,10 +170,10 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
     )
-    wu = stage(bucketize(users[tr], items[tr], ratings[tr], n_users,
-                         n_items, pad_to_blocks=True))
-    wi = stage(bucketize(items[tr], users[tr], ratings[tr], n_items,
-                         n_users, pad_to_blocks=True))
+    wu = stage(_maybe_sort(bucketize(users[tr], items[tr], ratings[tr],
+                                     n_users, n_items, pad_to_blocks=True)))
+    wi = stage(_maybe_sort(bucketize(items[tr], users[tr], ratings[tr],
+                                     n_items, n_users, pad_to_blocks=True)))
     np.asarray(als_train(wu, wi, warm_cfg).user_factors)
     del wu, wi
 
@@ -177,12 +181,12 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     t0 = time.time()
     t_b = time.monotonic()
     by_user = stage(
-        bucketize(users[tr], items[tr], ratings[tr], n_users, n_items,
-                  pad_to_blocks=True)
+        _maybe_sort(bucketize(users[tr], items[tr], ratings[tr], n_users,
+                              n_items, pad_to_blocks=True))
     )
     by_item = stage(
-        bucketize(items[tr], users[tr], ratings[tr], n_items, n_users,
-                  pad_to_blocks=True)
+        _maybe_sort(bucketize(items[tr], users[tr], ratings[tr], n_items,
+                              n_users, pad_to_blocks=True))
     )
     bucketize_stage_s = time.monotonic() - t_b
     factors = als_train(by_user, by_item, cfg, profile=profile)
@@ -223,6 +227,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "bucket_shapes": profile.get("bucket_shapes"),
         "solve_mode": profile.get("solve_mode", solve_mode),
         "gather_dtype": gather_dtype,
+        "sort_gather": sort_gather,
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:

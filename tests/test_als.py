@@ -281,6 +281,78 @@ class TestPallasModeGuards:
             als_train_coo(u, i, v, n_users=3, n_items=2, cfg=cfg)
 
 
+class TestSortGatherIndices:
+    """Within-row index sorting (gather locality) must be invisible to the
+    math: the Gramian sum over K is permutation-invariant."""
+
+    def test_sorted_buckets_preserve_rows_and_padding(self):
+        from predictionio_tpu.ops.als import bucketize, sort_bucket_indices
+
+        rng = np.random.default_rng(5)
+        nnz, n_u, n_i = 5000, 300, 120
+        u = rng.integers(0, n_u, nnz).astype(np.int32)
+        i = rng.integers(0, n_i, nnz).astype(np.int32)
+        v = rng.normal(size=nnz).astype(np.float32)
+        side = bucketize(u, i, v, n_u, n_i, pad_to_blocks=True)
+        sorted_side = sort_bucket_indices(side)
+        for b0, b1 in zip(side.buckets, sorted_side.buckets):
+            np.testing.assert_array_equal(b0.rows, b1.rows)
+            np.testing.assert_array_equal(b0.counts, b1.counts)
+            for r in range(b0.idx.shape[0]):
+                c = int(b0.counts[r])
+                # valid prefix: same multiset, now ascending
+                assert sorted(b0.idx[r, :c].tolist()) == b1.idx[r, :c].tolist()
+                # (idx, val) pairing preserved
+                assert (
+                    sorted(zip(b0.idx[r, :c], b0.val[r, :c]))
+                    == sorted(zip(b1.idx[r, :c], b1.val[r, :c]))
+                )
+                # padding tail untouched in place
+                np.testing.assert_array_equal(b0.idx[r, c:], b1.idx[r, c:])
+
+    def test_staged_input_with_sort_flag_is_loud(self):
+        """The flag can only act pre-staging; silently ignoring it would
+        corrupt an A/B measurement."""
+        from predictionio_tpu.ops.als import (
+            ALSConfig, als_train, bucketize, stage,
+        )
+
+        rng = np.random.default_rng(7)
+        u = rng.integers(0, 50, 500).astype(np.int32)
+        i = rng.integers(0, 30, 500).astype(np.int32)
+        v = np.ones(500, dtype=np.float32)
+        bu = stage(bucketize(u, i, v, 50, 30, pad_to_blocks=True))
+        bi = stage(bucketize(i, u, v, 30, 50, pad_to_blocks=True))
+        with pytest.raises(ValueError, match="sort_gather_indices"):
+            als_train(
+                bu, bi,
+                ALSConfig(rank=4, iterations=1, sort_gather_indices=True),
+            )
+
+    def test_training_result_unchanged(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+
+        rng = np.random.default_rng(6)
+        nnz, n_u, n_i = 20_000, 500, 200
+        u = rng.integers(0, n_u, nnz).astype(np.int32)
+        i = rng.integers(0, n_i, nnz).astype(np.int32)
+        v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+        base = als_train_coo(
+            u, i, v, n_u, n_i,
+            ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=0),
+        )
+        sorted_run = als_train_coo(
+            u, i, v, n_u, n_i,
+            ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=0,
+                      sort_gather_indices=True),
+        )
+        np.testing.assert_allclose(
+            np.asarray(base.user_factors),
+            np.asarray(sorted_run.user_factors),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
 class TestGatherDtype:
     """bf16 gathers must track the f32 result closely (input rounding at
     2^-8 relative; the λ·n_u ridge keeps solves stable) and fail loudly on
