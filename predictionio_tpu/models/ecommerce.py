@@ -22,6 +22,7 @@ event-store reads so the device scoring path never blocks on storage.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -38,7 +39,13 @@ from ..controller import (
 )
 from ..ops.als import ALSConfig, als_train_coo
 from ..storage import BiMap, EventFilter, get_registry
-from .similarproduct import Item, ItemScore, PredictedResult
+from .similarproduct import (
+    Item,
+    ItemScore,
+    PredictedResult,
+    build_category_members,
+    category_allowed_mask,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -149,6 +156,18 @@ class ECommerceModel:
     def sanity_check(self) -> None:
         if not np.isfinite(self.user_factors).all():
             raise ValueError("ECommerceModel user factors are non-finite")
+
+    @functools.cached_property
+    def category_members(self) -> Dict[str, np.ndarray]:
+        """category → member index arrays (shared builder, see
+        ``similarproduct.build_category_members``), built once per model
+        instance; excluded from pickling — recomputed after load."""
+        return build_category_members(self.items)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("category_members", None)
+        return state
 
 
 class ECommerceALSAlgorithm(Algorithm):
@@ -288,18 +307,17 @@ class ECommerceALSAlgorithm(Algorithm):
             scores = unit @ unit[recent_idx].sum(axis=0)
 
         excluded = np.zeros((model.item_factors.shape[0],), bool)
-        for i in black_idx:
-            excluded[i] = True
+        excluded[list(black_idx)] = True
         if white_idx is not None:
             mask = np.ones_like(excluded)
-            for i in white_idx:
-                mask[i] = False
+            mask[list(white_idx)] = False
             excluded |= mask
         if query.categories is not None:
-            want = set(query.categories)
-            for i in range(excluded.shape[0]):
-                if not want.intersection(model.items.get(i, Item()).categories):
-                    excluded[i] = True
+            # vectorized via the model's precomputed category index arrays
+            excluded |= ~category_allowed_mask(
+                model.category_members, query.categories,
+                excluded.shape[0],
+            )
 
         scores = np.where(excluded | (scores <= 0), -np.inf, scores)
         k = min(query.num, int(np.isfinite(scores).sum()))

@@ -30,7 +30,7 @@ from ..controller import (
     Preparator,
 )
 from ..ops.als import ALSConfig, als_train_coo
-from ..ops.scoring import pad_pow2, top_k_for_users
+from ..ops.scoring import pad_pow2, top_k_for_users, use_streaming_topk
 from ..storage import BiMap, get_registry
 from ..workflow.infeed import stream_ratings
 
@@ -258,13 +258,9 @@ class ALSAlgorithm(Algorithm):
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         p = self.params
-        if p.streaming_top_k not in ("auto", "always", "never"):
-            # a config typo must fail the training run, not the first
-            # serving query after deploy
-            raise ValueError(
-                f"streaming_top_k must be 'auto', 'always' or 'never', "
-                f"got {p.streaming_top_k!r}"
-            )
+        # a config typo must fail the training run, not the first serving
+        # query after deploy (use_streaming_topk raises on unknown modes)
+        use_streaming_topk(p.streaming_top_k, 1, 1)
         cfg = ALSConfig(
             rank=p.rank,
             iterations=p.num_iterations,
@@ -375,27 +371,8 @@ class ALSAlgorithm(Algorithm):
         return out
 
     def _use_streaming_topk(self, b_pad: int, n_items: int) -> bool:
-        """Streaming keeps the [B, N] score matrix out of HBM entirely —
-        mandatory for huge catalogs, pointless overhead for small ones.
-        "auto" switches at ~1 GB of would-be scores on TPU (the XLA dense
-        path is faster below that and the interpret-mode kernel is slow
-        off-TPU)."""
-        mode = self.params.streaming_top_k
-        if mode == "always":
-            return True
-        if mode == "never":
-            return False
-        if mode != "auto":
-            raise ValueError(
-                f"streaming_top_k must be 'auto', 'always' or 'never', "
-                f"got {mode!r}"
-            )
-        import jax
-
-        return (
-            jax.default_backend() == "tpu"
-            and b_pad * n_items * 4 > (1 << 30)
-        )
+        """Shared selection rule — see ``ops.scoring.use_streaming_topk``."""
+        return use_streaming_topk(self.params.streaming_top_k, b_pad, n_items)
 
     def query_class(self):
         return Query

@@ -36,7 +36,7 @@ from ..controller import (
     Serving,
 )
 from ..ops.als import ALSConfig, als_train_coo
-from ..ops.scoring import pad_pow2, top_k_for_vectors
+from ..ops.scoring import pad_pow2, top_k_for_vectors, use_streaming_topk
 from ..storage import BiMap, EventFilter, get_registry
 
 
@@ -167,6 +167,11 @@ class SimilarALSParams(Params):
     num_iterations: int = 20
     lambda_: float = 0.01
     seed: int = 3
+    #: "auto" | "always" | "never" — use the Pallas streaming top-k for
+    #: unconstrained queries (no categories/whiteList, whose filters need
+    #: the dense mask) on huge catalogs, keeping the [B, I] score matrix
+    #: out of HBM. Same selection rule as the recommendation template.
+    streaming_top_k: str = "auto"
 
 
 @dataclasses.dataclass
@@ -194,10 +199,51 @@ class SimilarALSModel:
         norms = np.linalg.norm(self.item_factors, axis=1, keepdims=True)
         return self.item_factors / np.maximum(norms, 1e-12)
 
+    @functools.cached_property
+    def category_members(self) -> Dict[str, np.ndarray]:
+        """category → member index arrays (see ``build_category_members``),
+        built once per model instance; excluded from pickling like
+        ``unit_factors``."""
+        return build_category_members(self.items)
+
     def __getstate__(self):
         state = dict(self.__dict__)
-        state.pop("unit_factors", None)  # cached_property stores under its name
+        # cached_property stores under the property name
+        state.pop("unit_factors", None)
+        state.pop("category_members", None)
         return state
+
+
+def build_category_members(items: Dict[int, Item]) -> Dict[str, np.ndarray]:
+    """category → sorted int32 index array of member items.
+
+    Turns the per-query category filter from an O(catalog) Python loop
+    into a few vectorized index ops — the difference between microseconds
+    and seconds per query on a large catalog. Shared by the
+    similarproduct and ecommerce models (both cache it per instance)."""
+    members: Dict[str, list] = {}
+    for idx, item in items.items():
+        for cat in item.categories:
+            members.setdefault(cat, []).append(idx)
+    return {
+        c: np.asarray(sorted(ids), dtype=np.int32)
+        for c, ids in members.items()
+    }
+
+
+def category_allowed_mask(
+    members: Dict[str, np.ndarray], categories, n: int
+) -> np.ndarray:
+    """Bool mask of items belonging to ANY of ``categories`` (the
+    ``isCandidateItem`` category rule); items absent from ``members``
+    (never $set, or no categories) are not allowed — matching the old
+    per-item ``items.get(i, Item())`` semantics."""
+    allowed = np.zeros((n,), bool)
+    for cat in categories:
+        idx = members.get(cat)
+        if idx is not None:
+            allowed[idx] = True
+    return allowed
 
 
 def _candidate_mask(
@@ -206,30 +252,30 @@ def _candidate_mask(
     query_idx: Sequence[int],
 ) -> np.ndarray:
     """True = excluded. Mirrors ``isCandidateItem``: drop query items
-    themselves, category-mismatched, non-whitelisted, blacklisted."""
+    themselves, category-mismatched, non-whitelisted, blacklisted.
+    Fully vectorized — no per-item Python loop (category membership comes
+    from the model's precomputed index arrays)."""
     n = model.item_factors.shape[0]
     excluded = np.zeros((n,), bool)
     excluded[list(query_idx)] = True
     if query.categories is not None:
-        want = set(query.categories)
-        for i in range(n):
-            cats = model.items.get(i, Item()).categories
-            if not want.intersection(cats):
-                excluded[i] = True
+        excluded |= ~category_allowed_mask(
+            model.category_members, query.categories, n
+        )
     if query.white_list is not None:
-        allowed = {
-            model.item_map.get(it)
-            for it in query.white_list
-            if model.item_map.get(it) is not None
-        }
-        for i in range(n):
-            if i not in allowed:
-                excluded[i] = True
+        allowed = np.zeros((n,), bool)
+        white_idx = [
+            i for i in (model.item_map.get(it) for it in query.white_list)
+            if i is not None
+        ]
+        allowed[white_idx] = True
+        excluded |= ~allowed
     if query.black_list is not None:
-        for it in query.black_list:
-            idx = model.item_map.get(it)
-            if idx is not None:
-                excluded[idx] = True
+        black_idx = [
+            i for i in (model.item_map.get(it) for it in query.black_list)
+            if i is not None
+        ]
+        excluded[black_idx] = True
     return excluded
 
 
@@ -251,6 +297,11 @@ class SimilarALSAlgorithm(Algorithm):
         return [(u, i, c) for (u, i), c in counts.items()]
 
     def train(self, ctx, pd: TrainingData) -> SimilarALSModel:
+        # a streaming_top_k typo must fail the training run, not the
+        # first serving query after deploy (raises on unknown modes)
+        use_streaming_topk(
+            getattr(self.params, "streaming_top_k", "auto"), 1, 1
+        )
         triplets = self._ratings(pd)
         if not triplets:
             raise ValueError(
@@ -338,20 +389,46 @@ class SimilarALSAlgorithm(Algorithm):
             return out
         # Σ_q cos(q, i) = (Σ_q unit_q) · unit_i
         qvecs = np.stack([unit[qi].sum(axis=0) for _, _, qi in rows])
-        exclude = np.stack(
-            [_candidate_mask(model, q, qi) for _, q, qi in rows]
-        )
         b = len(rows)
         b_pad = pad_pow2(b)
         max_k = min(max(q.num for _, q, _ in rows), n_items)
         k_pad = min(pad_pow2(max_k, lo=8), n_items)
         if b_pad > b:
             qvecs = np.pad(qvecs, ((0, b_pad - b), (0, 0)))
-            # padded rows exclude everything → -inf scores, sliced away
-            exclude = np.pad(
-                exclude, ((0, b_pad - b), (0, 0)), constant_values=True
+        if self._use_streaming_topk(b_pad, n_items, rows):
+            # exclusions are small index lists (query items + blacklist):
+            # the streaming kernel applies them per block without a dense
+            # [B, I] mask, and the score matrix never touches HBM
+            from ..ops.pallas_kernels import top_k_streaming
+
+            excl_lists = []
+            for _pos, q, qi in rows:
+                black = [
+                    i
+                    for i in (
+                        model.item_map.get(it) for it in (q.black_list or ())
+                    )
+                    if i is not None
+                ]
+                excl_lists.append(list(qi) + black)
+            # bucket the exclusion width like b and k: a raw
+            # data-dependent width would compile a fresh program per
+            # distinct (query items + blacklist) length
+            width = pad_pow2(max(len(l) for l in excl_lists), lo=16)
+            excl = np.full((b_pad, width), -1, dtype=np.int32)
+            for r, lst in enumerate(excl_lists):
+                excl[r, : len(lst)] = lst
+            scores, idx = top_k_streaming(qvecs, unit, k_pad, excl)
+        else:
+            exclude = np.stack(
+                [_candidate_mask(model, q, qi) for _, q, qi in rows]
             )
-        scores, idx = top_k_for_vectors(qvecs, unit, k_pad, exclude)
+            if b_pad > b:
+                # padded rows exclude everything → -inf scores, sliced away
+                exclude = np.pad(
+                    exclude, ((0, b_pad - b), (0, 0)), constant_values=True
+                )
+            scores, idx = top_k_for_vectors(qvecs, unit, k_pad, exclude)
         scores, idx = jax.device_get((scores, idx))
         scores = scores[:b, :max_k].tolist()
         idx = idx[:b, :max_k].tolist()
@@ -366,6 +443,25 @@ class SimilarALSAlgorithm(Algorithm):
                 item_scores.append(ItemScore(item=inv[int(i)], score=s))
             out.append((pos, PredictedResult(item_scores=tuple(item_scores))))
         return out
+
+    def _use_streaming_topk(self, b_pad: int, n_items: int, rows) -> bool:
+        """Streaming eligibility: category/whiteList filters need the
+        dense mask (their exclusion sets are catalog-sized), so only
+        unconstrained queries stream; size rule shared with the
+        recommendation template (``ops.scoring.use_streaming_topk``)."""
+        if any(
+            q.categories is not None or q.white_list is not None
+            for _, q, _ in rows
+        ):
+            # still validate the mode so a typo cannot hide behind a
+            # constrained batch
+            use_streaming_topk(
+                getattr(self.params, "streaming_top_k", "auto"), 1, 1
+            )
+            return False
+        return use_streaming_topk(
+            getattr(self.params, "streaming_top_k", "auto"), b_pad, n_items
+        )
 
     def query_class(self):
         return Query

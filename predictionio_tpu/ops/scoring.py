@@ -25,6 +25,30 @@ import jax.numpy as jnp
 NEG_INF = float("-inf")
 
 
+def use_streaming_topk(mode: str, b_pad: int, n_items: int) -> bool:
+    """Shared streaming-top-k selection rule for serving templates.
+
+    Streaming (``pallas_kernels.top_k_streaming``) keeps the ``[B, I]``
+    score matrix out of HBM entirely — mandatory for huge catalogs,
+    pointless overhead for small ones. "auto" switches at ~1 GB of
+    would-be scores on TPU (the XLA dense path is faster below that and
+    the interpret-mode kernel is slow off-TPU). Raises on an unknown
+    mode so a config typo fails at validation time, not mid-serving.
+    """
+    if mode not in ("auto", "always", "never"):
+        raise ValueError(
+            f"streaming_top_k must be 'auto', 'always' or 'never', "
+            f"got {mode!r}"
+        )
+    if mode == "never":
+        return False
+    if mode == "always":
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu" and b_pad * n_items * 4 > (1 << 30)
+
+
 def pad_pow2(n: int, lo: int = 1) -> int:
     """Smallest power of two >= max(n, lo).
 

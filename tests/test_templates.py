@@ -234,6 +234,47 @@ class TestSimilarProductTemplate:
             )
         assert batched[1].item_scores == ()
 
+    def test_streaming_topk_matches_dense(self, registry, ctx):
+        """The Pallas streaming path (big-catalog serving, round 3) must
+        return exactly the dense path's items for unconstrained queries —
+        exclusions ride per-query index lists instead of a [B, I] mask.
+        Runs in interpret mode on CPU (same code path shape as TPU)."""
+        ingest_similarproduct(registry)
+        td = similarproduct.SimilarProductDataSource().read_training(ctx)
+        plain = [
+            similarproduct.Query(items=("a0",), num=3),
+            similarproduct.Query(items=("b0", "b1"), num=4,
+                                 black_list=("b2",)),
+        ]
+        constrained = similarproduct.Query(
+            items=("a0",), num=3, categories=("beta",)
+        )
+        results = {}
+        for mode in ("never", "always"):
+            algo = similarproduct.SimilarALSAlgorithm(
+                similarproduct.SimilarALSParams(
+                    rank=8, num_iterations=10, seed=1, streaming_top_k=mode
+                )
+            )
+            model = algo.train(ctx, td)
+            # all-unconstrained batch: streams under "always"
+            assert algo._use_streaming_topk(
+                2, 10, [(0, q, [0]) for q in plain]
+            ) == (mode == "always")
+            results[mode] = dict(
+                algo.batch_predict(model, list(enumerate(plain)))
+            )
+            # a category filter needs the dense mask: streaming declines
+            assert not algo._use_streaming_topk(
+                1, 10, [(0, constrained, [0])]
+            )
+            res_c = algo.predict(model, constrained)
+            assert all(s.item.startswith("b") for s in res_c.item_scores)
+        for i in range(len(plain)):
+            assert [s.item for s in results["always"][i].item_scores] == [
+                s.item for s in results["never"][i].item_scores
+            ], (i, results["always"][i], results["never"][i])
+
     def test_train_without_set_entities_raises(self, registry, ctx):
         """View events whose users/items were never $set must fail loudly
         instead of training a silent all-zero model."""
