@@ -245,6 +245,10 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--batch", default="")
     dp.add_argument("--log-url", default=None,
                     help="POST serving errors here (CreateServer --log-url)")
+    dp.add_argument("--batch-max", type=int, default=None,
+                    help="micro-batch size cap (size to catalog and depth)")
+    dp.add_argument("--batch-pipeline-depth", type=int, default=None,
+                    help="batches in flight at once (default 2)")
     dp.add_argument("--spawn", action="store_true")
 
     ud = sub.add_parser("undeploy", help="stop a running query server")
@@ -564,6 +568,11 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
             srv_argv += ["--accesskey", args.accesskey]
         if args.log_url:
             srv_argv += ["--log-url", args.log_url]
+        if args.batch_max is not None:
+            srv_argv += ["--batch-max", str(args.batch_max)]
+        if args.batch_pipeline_depth is not None:
+            srv_argv += ["--batch-pipeline-depth",
+                         str(args.batch_pipeline_depth)]
         if args.spawn:
             return _spawn_detached("predictionio_tpu.tools.run_server", srv_argv)
         srv_args = run_server.build_parser().parse_args(srv_argv)
