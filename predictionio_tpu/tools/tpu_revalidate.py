@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""One-shot TPU re-validation: the queued round-3 A/B matrix.
+
+The accelerator tunnel wedges for hours at a time; this script exists so
+the moment a probe succeeds, the ENTIRE evidence queue runs unattended
+and lands in one JSON-lines file:
+
+1. ``python bench.py`` — full-scale ALS baseline (expect ≤ 18.3 s).
+2. ``BENCH_GATHER_DTYPE=bf16`` — halved gather bytes; RMSE-gated.
+3. ``BENCH_SORT_GATHER=1`` — gather-locality sort; RMSE-gated.
+4. bf16 + sort combined (only if both individually pass the gate).
+5. With ``--engine-dir <trained engine project>``: serving loadgen over
+   pipeline depth 1/2/4 — deploys on the chip per depth, measures,
+   undeploys (the ≥10k QPS/chip question). Without the flag the sweep is
+   skipped with instructions.
+
+Each step appends its JSON line (plus a ``step`` key) to
+``TPU_REVALIDATION.jsonl``. A wedge mid-step is recorded and the
+remaining independent steps still run; completed steps are always on
+disk. RMSE gate: within +0.002 of the f32 baseline's holdout RMSE.
+
+Usage:
+``python -m predictionio_tpu.tools.tpu_revalidate [--engine-dir D]``
+(aborts immediately, writing nothing, if the device probe fails).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+OUT = os.path.join(REPO, "TPU_REVALIDATION.jsonl")
+RMSE_GATE_DELTA = 0.002
+
+
+def log(msg: str) -> None:
+    print(f"[revalidate +{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr)
+
+
+def append(record: dict) -> None:
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def run_bench(step: str, env_extra: dict, timeout_s: float = 1800) -> dict:
+    env = dict(os.environ, **env_extra)
+    log(f"bench step {step}: {env_extra or '(baseline)'}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        # a mid-run tunnel wedge must not kill the chain: record it and
+        # let the remaining independent steps try (the tunnel sometimes
+        # recovers between runs)
+        rec = {
+            "step": step, "rc": -1,
+            "error": f"bench timed out after {timeout_s:.0f}s "
+                     "(tunnel wedge mid-run?)",
+        }
+        append(rec)
+        log(f"  -> TIMEOUT after {timeout_s:.0f}s; continuing the queue")
+        return rec
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    rec = json.loads(lines[-1]) if lines else {"error": "no JSON line"}
+    rec["step"] = step
+    rec["rc"] = proc.returncode
+    if "fallback" in rec:
+        rec["note"] = "DEVICE FELL BACK — evidence invalid for this step"
+    append(rec)
+    log(f"  -> value={rec.get('value')} rmse={rec.get('holdout_rmse')} "
+        f"device={rec.get('device')}")
+    return rec
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_loadgen_sweep(engine_dir: str, duration_s: float,
+                      concurrency: int) -> None:
+    """Deploy the engine at each pipeline depth, hammer it, undeploy."""
+    import urllib.request
+
+    pio = os.path.join(REPO, "bin", "pio")
+    for depth in (1, 2, 4):
+        port = _free_port()
+        log(f"loadgen sweep: deploying depth={depth} on :{port}")
+        rc = subprocess.run(
+            [pio, "deploy", "--engine-dir", engine_dir,
+             "--port", str(port), "--batch-pipeline-depth", str(depth),
+             "--spawn"],
+            cwd=engine_dir, capture_output=True, text=True,
+        ).returncode
+        if rc != 0:
+            append({"step": f"loadgen_depth{depth}",
+                    "error": f"deploy failed rc={rc}"})
+            continue
+        up = False
+        for _ in range(60):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=2
+                ).read()
+                up = True
+                break
+            except Exception:
+                time.sleep(1)
+        try:
+            if not up:
+                append({"step": f"loadgen_depth{depth}",
+                        "error": "server never came up"})
+                continue
+            time.sleep(3)  # let the first-query compile settle
+            proc = subprocess.run(
+                [sys.executable, "-m", "predictionio_tpu.tools.loadgen",
+                 "--url", f"http://127.0.0.1:{port}/queries.json",
+                 "--concurrency", str(concurrency),
+                 "--duration", str(duration_s)],
+                cwd=REPO, capture_output=True, text=True, timeout=600,
+            )
+            lines = [
+                l for l in proc.stdout.splitlines() if l.startswith("{")
+            ]
+            rec = (
+                json.loads(lines[-1]) if lines
+                else {"error": "no loadgen JSON"}
+            )
+            rec["step"] = f"loadgen_depth{depth}"
+            append(rec)
+            log(f"  -> depth {depth}: qps={rec.get('qps')} "
+                f"p99={rec.get('p99_ms')}ms errors={rec.get('errors')}")
+        finally:
+            subprocess.run(
+                [pio, "undeploy", "--port", str(port)],
+                capture_output=True,
+            )
+            time.sleep(1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-loadgen", action="store_true")
+    ap.add_argument("--engine-dir", default=None,
+                    help="trained engine project for the loadgen sweep "
+                         "(e.g. a movielens_quickstart workdir's engine/); "
+                         "omitting it skips the sweep with instructions")
+    ap.add_argument("--loadgen-duration", type=float, default=15.0)
+    ap.add_argument("--loadgen-concurrency", type=int, default=128)
+    ap.add_argument("--iterations", default=None,
+                    help="override BENCH_ITERATIONS")
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    status = bench.probe_device(timeout_s=120)
+    if status != "ok":
+        log(f"device probe: {status} — aborting (nothing written)")
+        return 2
+
+    base_env: dict = {}
+    if args.iterations:
+        base_env["BENCH_ITERATIONS"] = str(args.iterations)
+
+    baseline = run_bench("baseline_f32", dict(base_env))
+    if baseline.get("rc") != 0 or "fallback" in baseline:
+        log("baseline failed or fell back; aborting the A/B chain")
+        return 1
+    gate = float(baseline["holdout_rmse"]) + RMSE_GATE_DELTA
+
+    def gated(step: str, env: dict) -> dict:
+        rec = run_bench(step, {**base_env, **env})
+        ok = (
+            rec.get("rc") == 0
+            and "fallback" not in rec
+            and float(rec.get("holdout_rmse", 9.9)) <= gate
+        )
+        rec["rmse_gate"] = "pass" if ok else "FAIL"
+        append({"step": f"{step}_gate", "gate": rec["rmse_gate"],
+                "threshold": round(gate, 4)})
+        return rec
+
+    bf16 = gated("bf16_gather", {"BENCH_GATHER_DTYPE": "bf16"})
+    srt = gated("sort_gather", {"BENCH_SORT_GATHER": "1"})
+    if bf16.get("rmse_gate") == "pass" and srt.get("rmse_gate") == "pass":
+        gated("bf16_plus_sort",
+              {"BENCH_GATHER_DTYPE": "bf16", "BENCH_SORT_GATHER": "1"})
+
+    if args.skip_loadgen:
+        pass
+    elif args.engine_dir:
+        run_loadgen_sweep(
+            args.engine_dir, args.loadgen_duration,
+            args.loadgen_concurrency,
+        )
+    else:
+        log("loadgen sweep skipped: pass --engine-dir <trained engine "
+            "project> (e.g. run examples/movielens_quickstart/run.sh "
+            "once, then point at <workdir>/engine)")
+
+    log(f"done; evidence in {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
