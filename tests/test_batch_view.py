@@ -1,0 +1,103 @@
+"""Deprecated batch-view layer (parity with the reference's deprecated
+``data/view/LBatchView.scala``); tests mirror the reference semantics the
+shim preserves: filter combinators (exclusive start), event-ordered
+per-entity folds, and the legacy DataMap aggregator."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage import DataMap, Event, SqliteEventStore
+from predictionio_tpu.storage.batch_view import BatchView, EventSeq
+
+UTC = dt.timezone.utc
+
+
+def ts(h):
+    return dt.datetime(2021, 6, 1, h, tzinfo=UTC)
+
+
+@pytest.fixture()
+def store():
+    s = SqliteEventStore(":memory:")
+    s.init(1)
+    s.write(
+        [
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"a": 1, "b": 2}), event_time=ts(1)),
+            Event(event="$unset", entity_type="item", entity_id="i1",
+                  properties=DataMap({"b": 0}), event_time=ts(2)),
+            Event(event="$set", entity_type="item", entity_id="i2",
+                  properties=DataMap({"a": 9}), event_time=ts(3)),
+            Event(event="$delete", entity_type="item", entity_id="i2",
+                  event_time=ts(4)),
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties=DataMap({"x": 5}), event_time=ts(1)),
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.0}), event_time=ts(5)),
+        ],
+        1,
+    )
+    return s
+
+
+def _view(store, **kw):
+    with pytest.deprecated_call():
+        return BatchView(store, 1, **kw)
+
+
+def test_aggregate_properties_folds_in_event_order(store):
+    view = _view(store)
+    props = view.aggregate_properties("item")
+    # i1: set {a,b} then unset b -> {a: 1}; i2: set then $delete -> dropped
+    assert set(props) == {"i1"}
+    assert dict(props["i1"]) == {"a": 1}
+
+
+def test_aggregate_properties_other_entity_type(store):
+    view = _view(store)
+    props = view.aggregate_properties("user")
+    assert dict(props["u1"]) == {"x": 5}
+
+
+def test_filter_start_time_is_exclusive(store):
+    """ViewPredicates.getStartTimePredicate drops events AT start_time —
+    a reference quirk the shim mirrors verbatim."""
+    view = _view(store)
+    seq = view.events.filter(start_time=ts(1))
+    assert all(e.event_time > ts(1) for e in seq)
+    assert len(seq) == len(view.events) - 2  # the two ts(1) events drop
+
+
+def test_window_applies_at_view_construction(store):
+    view = _view(store, until_time=ts(4))
+    # the rate event at ts(5) and the $delete at ts(4) are outside the
+    # (exclusive-until) window: i2's $set at ts(3) survives
+    props = view.aggregate_properties("item")
+    assert set(props) == {"i1", "i2"}
+    assert dict(props["i2"]) == {"a": 9}
+
+
+def test_aggregate_by_entity_ordered_counts(store):
+    view = _view(store)
+    counts = view.events.filter(entity_type="item").aggregate_by_entity_ordered(
+        0, lambda acc, e: acc + 1
+    )
+    assert counts == {"i1": 2, "i2": 2}
+
+
+def test_eventseq_chained_filters(store):
+    view = _view(store)
+    seq = view.events.filter(event="$set").filter(entity_type="item")
+    assert {e.entity_id for e in seq} == {"i1", "i2"}
+
+
+def test_naive_datetime_bounds_taken_as_utc(store):
+    """Same convention as EventFilter: naive bounds are UTC."""
+    view = _view(store)
+    naive = dt.datetime(2021, 6, 1, 1)  # == ts(1) without tzinfo
+    seq = view.events.filter(start_time=naive)
+    assert all(e.event_time > ts(1) for e in seq)
+    props = view.aggregate_properties("item", until_time=dt.datetime(2021, 6, 1, 4))
+    assert set(props) == {"i1", "i2"}
