@@ -154,9 +154,21 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")
     gather_dtype = os.environ.get("BENCH_GATHER_DTYPE", "f32")
     sort_gather = os.environ.get("BENCH_SORT_GATHER") == "1"
+    fused_gather = os.environ.get("BENCH_FUSED_GATHER") == "1"
+    if fused_gather and fallback:
+        # the fused kernel's per-row DMA loops run in interpret mode off
+        # TPU — hours at any real scale; the A/B is a TPU-only step
+        print(
+            "bench: BENCH_FUSED_GATHER ignored on CPU fallback",
+            file=sys.stderr,
+        )
+        fused_gather = False
+    if fused_gather and solve_mode == "auto":
+        solve_mode = "pallas"  # fused build requires the pallas solver
     cfg = ALSConfig(
         rank=50, iterations=iterations, lambda_=0.05, seed=0,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
+        fused_gather=fused_gather,
     )
     if sort_gather:
         from predictionio_tpu.ops.als import sort_bucket_indices
@@ -166,9 +178,13 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     # shapes: a smaller sliver would leave the timed run paying XLA compile).
     # One warm-up iteration compiles every bucket kernel; the timed section
     # then measures steady-state bucketize + staging + training.
+    # 2 warm-up iterations: the first executed iteration runs as two
+    # half-programs (staging overlap), later ones as the fused program —
+    # both must be compiled before the timed section
     warm_cfg = ALSConfig(
-        rank=cfg.rank, iterations=1, lambda_=cfg.lambda_, seed=cfg.seed,
+        rank=cfg.rank, iterations=2, lambda_=cfg.lambda_, seed=cfg.seed,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
+        fused_gather=fused_gather,
     )
     wu = stage(_maybe_sort(bucketize(users[tr], items[tr], ratings[tr],
                                      n_users, n_items, pad_to_blocks=True)))
@@ -180,15 +196,27 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     profile: dict = {}
     t0 = time.time()
     t_b = time.monotonic()
-    by_user = stage(
-        _maybe_sort(bucketize(users[tr], items[tr], ratings[tr], n_users,
-                              n_items, pad_to_blocks=True))
-    )
-    by_item = stage(
-        _maybe_sort(bucketize(items[tr], users[tr], ratings[tr], n_items,
-                              n_users, pad_to_blocks=True))
-    )
-    bucketize_stage_s = time.monotonic() - t_b
+    # phase timers: bucketize is host CPU (threaded C++ scatter), stage is
+    # view-reshape + async device_put issue — separating them tells the
+    # hardware run WHICH host-side cost dominates (the transfer wait
+    # itself lands in iteration_s[0], excluded from steady-state)
+    bu = _maybe_sort(bucketize(users[tr], items[tr], ratings[tr], n_users,
+                               n_items, pad_to_blocks=True))
+    t_s1 = time.monotonic()
+    by_user = stage(bu)  # async puts: item bucketize below overlaps them
+    t_s2 = time.monotonic()
+    bi = _maybe_sort(bucketize(items[tr], users[tr], ratings[tr], n_items,
+                               n_users, pad_to_blocks=True))
+    t_s3 = time.monotonic()
+    by_item = stage(bi)
+    t_end = time.monotonic()
+    bucketize_stage_s = t_end - t_b
+    phase_s = {
+        "bucketize_user": round(t_s1 - t_b, 3),
+        "stage_user": round(t_s2 - t_s1, 3),
+        "bucketize_item": round(t_s3 - t_s2, 3),
+        "stage_item": round(t_end - t_s3, 3),
+    }
     factors = als_train(by_user, by_item, cfg, profile=profile)
     # force full materialization: block_until_ready alone does not
     # synchronize through some remote-device relays
@@ -219,6 +247,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "iterations": iterations,
         "device": str(jax.devices()[0]),
         "bucketize_stage_s": round(bucketize_stage_s, 3),
+        "bucketize_stage_phases_s": phase_s,
         "iteration_s": [round(s, 4) for s in iter_s],
         "est_tflops_per_s": round(tflops_per_s, 2),
         "est_mfu_f32_v5e": round(mfu, 4),
@@ -228,6 +257,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "solve_mode": profile.get("solve_mode", solve_mode),
         "gather_dtype": gather_dtype,
         "sort_gather": sort_gather,
+        "fused_gather": fused_gather,
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:

@@ -367,6 +367,16 @@ class ALSConfig:
     #: cheap lever against the gather-bound iteration (the solve is
     #: already fused Pallas). Off by default pending a measured win.
     sort_gather_indices: bool = False
+    #: Build the normal equations with the fused gather+Gramian Pallas
+    #: kernel (``ops/pallas_kernels.gramian_fused``) instead of the XLA
+    #: gather + einsum: factor rows stream HBM→VMEM exactly once and the
+    #: ``[B, K, R]`` gathered intermediate never exists (~3× less
+    #: gather-stage HBM traffic by the PERF.md accounting). Requires
+    #: ``solve_mode`` to resolve to "pallas". EXPERIMENTAL: off by
+    #: default until the Mosaic lowering and the DMA-throughput claim
+    #: are validated on hardware (BENCH_FUSED_GATHER=1 A/B in the
+    #: revalidation queue).
+    fused_gather: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -595,9 +605,54 @@ def _bucket_tensors(side: StagedMatrix):
     return tuple((b.rows, b.idx, b.val, b.counts) for b in side.buckets)
 
 
+def _fused_chunk_solve(
+    y_pad, yty_pad, lam, alpha, idx_blk, val_blk, counts_blk,
+    *, implicit, rank,
+):
+    """One chunk's normal equations + SPD solve on the fused Pallas path —
+    per-device logic only (no mesh handling): under a mesh the caller
+    wraps this whole function in ``shard_map`` over the data axis, so the
+    ``[B, K, R]`` gathered intermediate never exists on any device.
+
+    ``yty_pad`` is always an array (zeros in explicit mode) so the
+    function is shard_map-able without closures over tracers.
+    """
+    from .pallas_kernels import _SPD_BLK, gramian_fused, spd_solve_t
+
+    k = idx_blk.shape[-1]
+    maskf = (
+        jnp.arange(k, dtype=jnp.int32)[None, :] < counts_blk[:, None]
+    ).astype(jnp.float32)
+    if implicit:
+        c1 = (alpha * jnp.abs(val_blk)) * maskf
+        w2 = c1
+        rhs = (1.0 + c1) * ((val_blk > 0).astype(jnp.float32) * maskf)
+        yty_arg = yty_pad
+    else:
+        w2 = maskf
+        rhs = val_blk * maskf
+        yty_arg = None
+    ridge = lam * counts_blk.astype(jnp.float32)
+    a, bvec = gramian_fused(y_pad, idx_blk, w2, rhs, ridge, yty_arg)
+    # [B, R, R] → the solver's lane-batched [R, R, B] layout. This
+    # transpose is the one extra HBM round trip the fused path pays
+    # (B·R²·4 B — small next to the 2·B·K·R·4 B it removes for K ≳ R;
+    # the caller auto-gates on bucket width accordingly).
+    a_t = jnp.transpose(a, (1, 2, 0))
+    b_t = bvec.T
+    bsz = idx_blk.shape[0]
+    pad_b = -bsz % _SPD_BLK
+    if pad_b:
+        a_t = jnp.pad(a_t, ((0, 0), (0, 0), (0, pad_b)))
+        b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
+    x_t = spd_solve_t(a_t, b_t)
+    return x_t[:rank, :bsz].T  # [B, rank]
+
+
 def _solve_side_traced(
     y, buckets, n_rows, rank, implicit, lam, alpha, yty,
     solve_mode="chunked", gather_dtype="f32", mesh=None,
+    fused_gather=False,
 ):
     """Unrolled bucket loop inside a traced program (no per-bucket dispatch).
 
@@ -711,13 +766,55 @@ def _solve_side_traced(
                 )(a_t, b_t)
             return x_t[:rank, :bsz].T  # [B, rank]
 
+        def solve_chunk_fused(c):
+            idx_blk, val_blk, counts_blk = c
+            yty_arg = (
+                yty_pad if implicit
+                else jnp.zeros((n_pad, n_pad), jnp.float32)
+            )
+            body = functools.partial(
+                _fused_chunk_solve, implicit=implicit, rank=rank
+            )
+            if mesh is None:
+                return body(
+                    y_pad, yty_arg, lam, alpha, idx_blk, val_blk, counts_blk
+                )
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.mesh import DATA_AXIS
+
+            n_data = mesh.shape[DATA_AXIS]
+            bsz = idx_blk.shape[0]
+            pad_r = -bsz % n_data
+            if pad_r:
+                idx_blk = jnp.pad(idx_blk, ((0, pad_r), (0, 0)))
+                val_blk = jnp.pad(val_blk, ((0, pad_r), (0, 0)))
+                counts_blk = jnp.pad(counts_blk, (0, pad_r))
+            x_blk = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(
+                    P(), P(), P(), P(), P(DATA_AXIS, None),
+                    P(DATA_AXIS, None), P(DATA_AXIS),
+                ),
+                out_specs=P(DATA_AXIS, None),
+                check_vma=False,  # pallas body; replication is by spec
+            )(y_pad, yty_arg, lam, alpha, idx_blk, val_blk, counts_blk)
+            return x_blk[:bsz]
+
     for rows, idx, val, counts in buckets:
         if idx.dtype != jnp.int32:
             idx = idx.astype(jnp.int32)  # uint16 transfer packing
         if solve_mode == "pallas":
-            solved = jax.lax.map(
-                solve_chunk_pallas, (idx, val, counts)
+            # fused gather+Gramian only pays for itself when the removed
+            # [B, K, R] round trip outweighs its [B, R, R] transpose —
+            # i.e. width >= rank; narrow buckets keep the einsum build
+            fn = (
+                solve_chunk_fused
+                if fused_gather and idx.shape[-1] >= rank
+                else solve_chunk_pallas
             )
+            solved = jax.lax.map(fn, (idx, val, counts))
         elif solve_mode == "two_phase":
             a, b = jax.lax.map(system, (idx, val, counts))
             solved = _cho_solve(
@@ -733,7 +830,7 @@ def _solve_side_traced(
 def _als_iteration_body(
     user_buckets, item_buckets, y, lam, alpha,
     rank, implicit, n_users, n_items, solve_mode="chunked",
-    gather_dtype="f32", mesh=None,
+    gather_dtype="f32", mesh=None, fused_gather=False,
 ):
     """One full ALS iteration (user solve + item solve, all buckets) as a
     single device program — one dispatch per iteration. ``lam``/``alpha``
@@ -750,6 +847,7 @@ def _als_iteration_body(
     x = _solve_side_traced(
         y, user_buckets, n_users, rank, implicit, lam, alpha, yty,
         solve_mode=solve_mode, gather_dtype=gather_dtype, mesh=mesh,
+        fused_gather=fused_gather,
     )
     xtx = (
         jnp.einsum("nr,ns->rs", x, x, preferred_element_type=jnp.float32)
@@ -759,8 +857,52 @@ def _als_iteration_body(
     y2 = _solve_side_traced(
         x, item_buckets, n_items, rank, implicit, lam, alpha, xtx,
         solve_mode=solve_mode, gather_dtype=gather_dtype, mesh=mesh,
+        fused_gather=fused_gather,
     )
     return x, y2
+
+
+def _als_half_body(
+    y, buckets, lam, alpha,
+    rank, implicit, n_rows, solve_mode="chunked",
+    gather_dtype="f32", mesh=None, fused_gather=False,
+):
+    """One HALF iteration (solve one side from the opposite factors) as its
+    own device program. The training loop uses this for the first executed
+    iteration only: a program that needs just one side's buckets can start
+    the moment that side's host→device transfer lands, so the other side's
+    transfer overlaps the first solve instead of gating it — the staging
+    overlap of VERDICT r3 item 4. Later iterations keep the fused
+    whole-iteration program (one dispatch each)."""
+    yty = (
+        jnp.einsum("nr,ns->rs", y, y, preferred_element_type=jnp.float32)
+        if implicit
+        else None
+    )
+    return _solve_side_traced(
+        y, buckets, n_rows, rank, implicit, lam, alpha, yty,
+        solve_mode=solve_mode, gather_dtype=gather_dtype, mesh=mesh,
+        fused_gather=fused_gather,
+    )
+
+
+_HALF_STATICS = (
+    "rank", "implicit", "n_rows", "solve_mode",
+    "gather_dtype", "mesh", "fused_gather",
+)
+
+_als_half = functools.partial(
+    jax.jit, static_argnames=_HALF_STATICS
+)(_als_half_body)
+
+
+@functools.lru_cache(maxsize=32)
+def _als_half_sharded(out_sharding):
+    return jax.jit(
+        _als_half_body,
+        static_argnames=_HALF_STATICS,
+        out_shardings=out_sharding,
+    )
 
 
 # ``mesh`` is static: jax.sharding.Mesh is hashable, and the traced program
@@ -769,7 +911,7 @@ _als_iteration = functools.partial(
     jax.jit,
     static_argnames=(
         "rank", "implicit", "n_users", "n_items", "solve_mode",
-        "gather_dtype", "mesh",
+        "gather_dtype", "mesh", "fused_gather",
     ),
 )(_als_iteration_body)
 
@@ -783,7 +925,7 @@ def _als_iteration_sharded(out_sharding):
         _als_iteration_body,
         static_argnames=(
             "rank", "implicit", "n_users", "n_items", "solve_mode",
-            "gather_dtype", "mesh",
+            "gather_dtype", "mesh", "fused_gather",
         ),
         out_shardings=(out_sharding, out_sharding),
     )
@@ -855,9 +997,17 @@ def als_train(
                 f"solve_mode='pallas' supports rank <= 80 (VMEM scratch "
                 f"bound), got rank={cfg.rank}; use 'auto' or 'chunked'"
             )
+    if cfg.fused_gather and solve_mode != "pallas":
+        # a silently ignored flag would corrupt the hardware A/B
+        raise ValueError(
+            "fused_gather=True requires solve_mode to resolve to 'pallas' "
+            f"(resolved to {solve_mode!r}); pass solve_mode='pallas' "
+            "explicitly off-TPU"
+        )
     rank = cfg.rank
 
     iteration = _als_iteration
+    half = _als_half
     row_sharding = None
     row_multiple = 1
     if mesh is not None:
@@ -876,6 +1026,7 @@ def als_train(
         row_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
         row_multiple = mesh.shape[DATA_AXIS]
         iteration = _als_iteration_sharded(tbl_spec)
+        half = _als_half_sharded(tbl_spec)
 
     t_stage = _time.monotonic()
     if cfg.sort_gather_indices:
@@ -966,18 +1117,30 @@ def als_train(
                 start = step
                 break
 
+    common = dict(
+        rank=rank,
+        implicit=cfg.implicit_prefs,
+        solve_mode=solve_mode,
+        gather_dtype=cfg.gather_dtype,
+        mesh=mesh if solve_mode == "pallas" else None,
+        fused_gather=cfg.fused_gather,
+    )
     for i in range(start, cfg.iterations):
         t_iter = _time.monotonic()
-        x, y = iteration(
-            ub, ib, y, lam, alpha,
-            rank=rank,
-            implicit=cfg.implicit_prefs,
-            n_users=by_user.n_rows,
-            n_items=by_item.n_rows,
-            solve_mode=solve_mode,
-            gather_dtype=cfg.gather_dtype,
-            mesh=mesh if solve_mode == "pallas" else None,
-        )
+        if i == start:
+            # first executed iteration as two half programs: the user
+            # solve needs only the user-side buckets, so it starts as
+            # soon as they land while the item-side transfer is still in
+            # flight (same math — the fused body is these two calls)
+            x = half(y, ub, lam, alpha, n_rows=by_user.n_rows, **common)
+            y = half(x, ib, lam, alpha, n_rows=by_item.n_rows, **common)
+        else:
+            x, y = iteration(
+                ub, ib, y, lam, alpha,
+                n_users=by_user.n_rows,
+                n_items=by_item.n_rows,
+                **common,
+            )
         if profile is not None:
             jax.block_until_ready((x, y))
             profile["iteration_s"].append(_time.monotonic() - t_iter)

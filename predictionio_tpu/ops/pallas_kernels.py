@@ -340,6 +340,187 @@ def spd_solve_t(
     )(a_t, b_t)
 
 
+# ---------------------------------------------------------------------------
+# Fused gather + Gramian (the ALS normal-equation build)
+# ---------------------------------------------------------------------------
+#
+# The XLA path materializes the gathered factors ``g = y[idx] * mask`` as a
+# [B, K, R] tensor in HBM and the Gramian einsum re-reads it — the gathered
+# bytes are paid ~3× (write + read + the original gather read). Measured
+# consequence (PERF.md, round 3): the ALS iteration is gather-bound at
+# ~0.32 of v5e HBM peak. This kernel streams each factor row HBM→VMEM
+# exactly once: per solve row, per K-tile, it issues one async copy per
+# rating's factor row into a VMEM tile, accumulates ``A += (g·w)ᵀ g`` and
+# ``b += gᵀ rhs`` in f32 on the MXU, and writes each row's [R, R] system
+# once. The [B, K, R] intermediate never exists.
+#
+# Cost model (why this can win despite per-row DMAs): the XLA path moves
+# ~3 × B·K·R·4 bytes of HBM traffic per chunk; this kernel moves
+# B·K·(R·4 + ~overhead) with K_tile copies in flight to hide latency. The
+# risk is DMA-issue rate on small (rank·4 ≈ 200 B) transfers — which is
+# exactly what the hardware A/B (BENCH_FUSED_GATHER=1) measures; the
+# kernel stays behind an explicit flag until a chip validates both the
+# Mosaic lowering and the throughput claim.
+#
+# Replaces the same MLlib hot loop as the solver above (reference:
+# ``examples/scala-parallel-recommendation/custom-prepartor/src/main/
+# scala/ALSAlgorithm.scala:56-62``; SURVEY §2.8 "per-block normal
+# equations").
+
+#: Max factor rows (DMAs) in flight per K-tile; VMEM tile is kt·r_pad·4 B.
+_FUSED_K_TILE = 512
+#: Max solve rows per grid step — bounds the [Bt, R, R] output block and
+#: the [Bt, K] index block in SMEM (Bt·K ≤ _FUSED_SMEM_IDX ints).
+_FUSED_B_TILE = 128
+_FUSED_SMEM_IDX = 32768
+
+
+def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
+                    a_ref, b_ref, gbuf, sem, *, k_tiles, kt, bt, r):
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
+    ).astype(jnp.float32)
+
+    def row_body(b, _):
+        def tile_body(t, carry):
+            a_acc, b_acc = carry
+
+            def issue(k, _):
+                pltpu.make_async_copy(
+                    y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
+                    gbuf.at[pl.ds(k, 1), :],
+                    sem,
+                ).start()
+                return 0
+
+            jax.lax.fori_loop(0, kt, issue, 0)
+
+            def drain(k, _):
+                # same descriptor; wait() decrements the shared semaphore
+                # by this copy's bytes (all copies are one factor row)
+                pltpu.make_async_copy(
+                    y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
+                    gbuf.at[pl.ds(k, 1), :],
+                    sem,
+                ).wait()
+                return 0
+
+            jax.lax.fori_loop(0, kt, drain, 0)
+            g = gbuf[...]  # [kt, r], y's dtype (f32 or bf16 gathers)
+            w = w2_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)  # [kt]
+            rr = rhs_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)
+            a_acc = a_acc + jax.lax.dot_general(
+                g * w[:, None], g, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            b_acc = b_acc + jnp.sum(
+                (g * rr[:, None]).astype(jnp.float32), axis=0
+            )
+            return a_acc, b_acc
+
+        a0 = yty_ref[...] + ridge_ref[b] * eye
+        a_acc, b_acc = jax.lax.fori_loop(
+            0, k_tiles, tile_body, (a0, jnp.zeros((r,), jnp.float32))
+        )
+        a_ref[b] = a_acc
+        b_ref[b] = b_acc
+        return 0
+
+    jax.lax.fori_loop(0, bt, row_body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bt", "kt", "interpret")
+)
+def _gramian_fused_call(y, idx, w2, rhs, ridge, yty, bt, kt, interpret):
+    b, k = idx.shape
+    r = y.shape[1]
+    return pl.pallas_call(
+        functools.partial(
+            _gramian_kernel, k_tiles=k // kt, kt=kt, bt=bt, r=r
+        ),
+        grid=(b // bt,),
+        in_specs=[
+            pl.BlockSpec((bt, k), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bt, k), lambda i: (i, 0)),
+            pl.BlockSpec((bt, k), lambda i: (i, 0)),
+            pl.BlockSpec((bt,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # y stays in HBM
+            pl.BlockSpec((r, r), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bt, r, r), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bt, r), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, r, r), jnp.float32),
+            jax.ShapeDtypeStruct((b, r), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kt, r), y.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(idx, w2, rhs, ridge, y, yty)
+
+
+def gramian_fused(
+    y: jax.Array,  # [N, R] f32 or bf16 — opposite-side factor table (HBM)
+    idx: jax.Array,  # [B, K] int32 — factor-row index per rating (0-padded)
+    w2: jax.Array,  # [B, K] f32 — Gramian weight (mask, or c-1 implicit)
+    rhs: jax.Array,  # [B, K] f32 — rhs weight (masked rating / c·p)
+    ridge: jax.Array,  # [B] f32 — per-row diagonal ridge (λ·n_u)
+    yty: Optional[jax.Array] = None,  # [R, R] f32 — implicit-mode base
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused normal-equation build: returns ``(A [B, R, R] f32, b [B, R]
+    f32)`` with ``A_b = yty + ridge_b·I + Σ_k w2[b,k]·y[idx[b,k]]⊗y[idx[b,k]]``
+    and ``b_b = Σ_k rhs[b,k]·y[idx[b,k]]`` — without materializing the
+    ``[B, K, R]`` gathered-factor intermediate in HBM.
+
+    Padding contract: invalid (b, k) slots must carry ``w2 = rhs = 0``
+    (their ``idx`` may be any in-range value; 0 by convention) — the
+    gathered row is multiplied by zero, so correctness never depends on
+    the index padding. ``R`` must be a multiple of 8 (callers pad the rank
+    once, as the solver path already does); B and K are padded here.
+
+    ``interpret=None`` auto-selects interpreter off-TPU. No XLA fallback:
+    callers opt in explicitly (flag-gated until hardware-validated) and
+    the surrounding code keeps its einsum path as the default.
+    """
+    if not _HAVE_PALLAS:
+        raise NotImplementedError(
+            "gramian_fused requires pallas; use the einsum path"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, r = y.shape
+    if r % 8 != 0:
+        raise ValueError(f"gramian_fused: rank must be padded to 8s, got {r}")
+    b, k = idx.shape
+    kt = min(k, _FUSED_K_TILE)
+    k_pad = _round_up(k, kt)
+    bt = min(_FUSED_B_TILE, max(1, _FUSED_SMEM_IDX // k_pad))
+    b_pad = _round_up(b, bt)
+    idx = jnp.asarray(idx, jnp.int32)
+    w2 = jnp.asarray(w2, jnp.float32)
+    rhs = jnp.asarray(rhs, jnp.float32)
+    if k_pad != k or b_pad != b:
+        pk, pb = k_pad - k, b_pad - b
+        idx = jnp.pad(idx, ((0, pb), (0, pk)))
+        w2 = jnp.pad(w2, ((0, pb), (0, pk)))
+        rhs = jnp.pad(rhs, ((0, pb), (0, pk)))
+        ridge = jnp.pad(jnp.asarray(ridge, jnp.float32), (0, pb))
+    if yty is None:
+        yty = jnp.zeros((r, r), jnp.float32)
+    a, bvec = _gramian_fused_call(
+        y, idx, w2, rhs, jnp.asarray(ridge, jnp.float32), yty,
+        bt, kt, interpret,
+    )
+    return a[:b], bvec[:b]
+
+
 def top_k_for_users_streaming(
     user_factors: jax.Array,
     item_factors: jax.Array,

@@ -197,8 +197,14 @@ class NativeEventStore(EventStore):
     reference's HBase path, ``HBPEvents.scala:166-184``): give each ingest
     process its own ``writer_id`` (constructor arg or
     ``PIO_NATIVE_WRITER_ID``) and its fresh-event appends go to a private
-    segment file — no flock contention between writers, near-linear
-    aggregate throughput. Reads merge every segment. Correctness of merged
+    segment file — writers share no lock and no file. Measured (1-core
+    dev host, serialization pre-hoisted so the loop is pure
+    flock+write(2) — ``ingestbench --contention``): shared-log append
+    throughput DROPS as writers are added while segmented appends hold or
+    improve; see PERF.md "Ingest lock-contention A/B" for the numbers.
+    Full multi-core scaling remains unmeasured here — the claim is
+    "removes the shared lock", not a measured linear speedup. Reads
+    merge every segment. Correctness of merged
     tombstone filtering rests on a routing invariant: segments receive
     ONLY fresh-id inserts (batch ``write``/``write_new`` paths), while
     explicit-id upserts, deletes, and their tombstones always go to the
@@ -394,9 +400,18 @@ class NativeEventStore(EventStore):
         freshness contract), else mints one. Appends go to this writer's
         private segment when a writer_id is set (the multi-writer fast
         path — see class docstring's routing invariant)."""
+        self._append_prepared(
+            self._writer_handle(app_id), self._prepare_batch(events)
+        )
+
+    def _prepare_batch(self, events) -> tuple:
+        """Serialize a fresh-insert batch into the C-ready arrays
+        ``evlog_append_batch`` takes — all the Python/numpy CPU work,
+        separated from the append call so the ingest contention bench can
+        measure pure lock+write(2) behavior with serialization hoisted
+        out of the timed loop."""
         from .bimap import _fnv1a64_batch
 
-        h = self._writer_handle(app_id)
         n = len(events)
         times = np.empty(n, dtype=np.int64)
         ctimes = np.empty(n, dtype=np.int64)
@@ -446,6 +461,16 @@ class NativeEventStore(EventStore):
 
         blob = b"".join(payloads)
         ends = np.cumsum([len(p) for p in payloads], dtype=np.int64)
+        return (
+            n, times, ctimes, etype_h, entity_h, event_h, ttype_h,
+            target_h, id_h, blob, ends,
+        )
+
+    def _append_prepared(self, h, prepared: tuple) -> None:
+        """One native batch append (one flock + one ``write(2)``) of a
+        :meth:`_prepare_batch` result."""
+        (n, times, ctimes, etype_h, entity_h, event_h, ttype_h, target_h,
+         id_h, blob, ends) = prepared
         rc = self._lib.evlog_append_batch(
             h, ctypes.c_int64(n),
             times.ctypes.data_as(ctypes.c_void_p),

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Persistent accelerator-tunnel watcher (VERDICT r3 item 1).
+#
+# The tunnel wedges for hours; rounds 2 and 3 lost their whole hardware
+# windows because nothing was probing when it recovered. This loop probes
+# every PROBE_INTERVAL_S (default 20 min; 5 min after a fast "failed"),
+# logs EVERY attempt to TUNNEL_WATCH.log, and the moment a probe succeeds
+# runs the full revalidation queue unattended, then exits. The queue
+# script is re-exec'd fresh each time, so edits to tpu_revalidate.py made
+# while this watcher sleeps are picked up automatically.
+#
+# Usage: nohup bash predictionio_tpu/tools/tunnel_watch.sh [engine_dir] &
+set -u
+cd "$(dirname "$0")/../.."
+ENGINE_DIR="${1:-/tmp/qs_r3/engine}"
+LOG=TUNNEL_WATCH.log
+OK_INTERVAL=1200   # 20 min between timeout probes
+FAIL_INTERVAL=300  # 5 min after a fast "failed" (worth a quicker retry)
+
+echo "$(date -u +%FT%TZ) watcher start (engine_dir=$ENGINE_DIR)" >> "$LOG"
+while true; do
+  status=$(timeout 170 python -c \
+    "import bench; print(bench.probe_device(timeout_s=150))" 2>>"$LOG" | tail -1)
+  echo "$(date -u +%FT%TZ) probe=$status" >> "$LOG"
+  case "$status" in
+    ok)
+      echo "$(date -u +%FT%TZ) TUNNEL UP — running revalidation queue" >> "$LOG"
+      python -m predictionio_tpu.tools.tpu_revalidate \
+        --engine-dir "$ENGINE_DIR" >> "$LOG" 2>&1
+      rc=$?
+      if [ "$rc" = 2 ]; then
+        # the tunnel wedged again between OUR probe and the queue's own
+        # probe (rc=2 = aborted, nothing written): keep watching — dying
+        # here is exactly the rounds-2/3 lost-window failure
+        echo "$(date -u +%FT%TZ) revalidate rc=2 (re-wedged before start);"\
+          " watcher continues" >> "$LOG"
+        sleep "$FAIL_INTERVAL"
+        continue
+      fi
+      echo "$(date -u +%FT%TZ) revalidate rc=$rc — watcher exiting" >> "$LOG"
+      exit $rc
+      ;;
+    failed) sleep "$FAIL_INTERVAL" ;;
+    *)      sleep "$OK_INTERVAL" ;;
+  esac
+done
