@@ -377,57 +377,79 @@ _FUSED_SMEM_IDX = 32768
 
 def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
                     a_ref, b_ref, gbuf, sem, *, k_tiles, kt, bt, r):
+    """Double-buffered over (row, K-tile) steps: while tile s's [kt, r]
+    gather block is being multiplied, tile s+1's row copies are already
+    in flight into the other VMEM slot — DMA latency hides behind MXU
+    work instead of serializing with it. One DMA semaphore per slot: a
+    shared semaphore would mix completions of in-flight tiles and could
+    release a wait with the other tile's copies."""
     eye = (
         jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
         == jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
     ).astype(jnp.float32)
+    total = bt * k_tiles
 
-    def row_body(b, _):
-        def tile_body(t, carry):
-            a_acc, b_acc = carry
+    def copies(s, slot, action):
+        """Start or wait the kt row copies of flat tile s in `slot`
+        (wait recreates the same descriptors; each wait consumes one
+        copy's worth of the slot's semaphore)."""
+        b = s // k_tiles
+        t = s % k_tiles
 
-            def issue(k, _):
-                pltpu.make_async_copy(
-                    y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
-                    gbuf.at[pl.ds(k, 1), :],
-                    sem,
-                ).start()
-                return 0
-
-            jax.lax.fori_loop(0, kt, issue, 0)
-
-            def drain(k, _):
-                # same descriptor; wait() decrements the shared semaphore
-                # by this copy's bytes (all copies are one factor row)
-                pltpu.make_async_copy(
-                    y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
-                    gbuf.at[pl.ds(k, 1), :],
-                    sem,
-                ).wait()
-                return 0
-
-            jax.lax.fori_loop(0, kt, drain, 0)
-            g = gbuf[...]  # [kt, r], y's dtype (f32 or bf16 gathers)
-            w = w2_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)  # [kt]
-            rr = rhs_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)
-            a_acc = a_acc + jax.lax.dot_general(
-                g * w[:, None], g, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+        def one(k, _):
+            dma = pltpu.make_async_copy(
+                y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
+                gbuf.at[slot, pl.ds(k, 1), :],
+                sem.at[slot],
             )
-            b_acc = b_acc + jnp.sum(
-                (g * rr[:, None]).astype(jnp.float32), axis=0
-            )
-            return a_acc, b_acc
+            (dma.start if action == "start" else dma.wait)()
+            return 0
 
-        a0 = yty_ref[...] + ridge_ref[b] * eye
-        a_acc, b_acc = jax.lax.fori_loop(
-            0, k_tiles, tile_body, (a0, jnp.zeros((r,), jnp.float32))
+        jax.lax.fori_loop(0, kt, one, 0)
+
+    copies(0, 0, "start")
+
+    def body(s, carry):
+        a_acc, b_acc = carry
+        slot = s % 2
+        b = s // k_tiles
+        t = s % k_tiles
+
+        @pl.when(s + 1 < total)
+        def _():
+            copies(s + 1, (s + 1) % 2, "start")
+
+        copies(s, slot, "wait")
+        g = gbuf[slot]  # [kt, r], y's dtype (f32 or bf16 gathers)
+        w = w2_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)  # [kt]
+        rr = rhs_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)
+        a_acc = a_acc + jax.lax.dot_general(
+            g * w[:, None], g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        a_ref[b] = a_acc
-        b_ref[b] = b_acc
-        return 0
+        b_acc = b_acc + jnp.sum(
+            (g * rr[:, None]).astype(jnp.float32), axis=0
+        )
 
-    jax.lax.fori_loop(0, bt, row_body, 0)
+        is_last_tile = t == k_tiles - 1
+
+        @pl.when(is_last_tile)
+        def _():
+            a_ref[b] = a_acc + yty_ref[...] + ridge_ref[b] * eye
+            b_ref[b] = b_acc
+
+        # reset the accumulators at each row boundary — a select, not a
+        # multiply: 0 * Inf = NaN would leak one bad row's overflow into
+        # every subsequent row of the tile
+        return (
+            jnp.where(is_last_tile, jnp.zeros_like(a_acc), a_acc),
+            jnp.where(is_last_tile, jnp.zeros_like(b_acc), b_acc),
+        )
+
+    jax.lax.fori_loop(
+        0, total, body,
+        (jnp.zeros((r, r), jnp.float32), jnp.zeros((r,), jnp.float32)),
+    )
 
 
 @functools.partial(
@@ -458,8 +480,8 @@ def _gramian_fused_call(y, idx, w2, rhs, ridge, yty, bt, kt, interpret):
             jax.ShapeDtypeStruct((b, r), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((kt, r), y.dtype),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((2, kt, r), y.dtype),  # double-buffered gather tile
+            pltpu.SemaphoreType.DMA((2,)),  # one per slot
         ],
         interpret=interpret,
     )(idx, w2, rhs, ridge, y, yty)

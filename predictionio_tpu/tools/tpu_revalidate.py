@@ -140,7 +140,7 @@ def _free_port() -> int:
 
 
 def run_inprocess_sweep(engine_dir: str, duration_s: float,
-                        concurrency: int) -> None:
+                        concurrency: int, tag: str = "") -> None:
     """In-process loadgen at each pipeline depth: the serving stack's own
     ceiling (micro-batcher + device dispatch) with the HTTP wire removed —
     one subprocess per depth so the device state is fresh each time."""
@@ -156,7 +156,7 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
                 cwd=REPO, capture_output=True, text=True, timeout=600,
             )
         except subprocess.TimeoutExpired:
-            append({"step": f"loadgen_inproc_depth{depth}",
+            append({"step": f"loadgen_inproc_depth{depth}{tag}",
                     "error": "timed out (tunnel wedge mid-run?)"})
             continue
         lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
@@ -169,7 +169,7 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
         if rec is None:
             tail = proc.stderr.strip().splitlines()
             rec = {"error": tail[-1] if tail else "no JSON"}
-        rec["step"] = f"loadgen_inproc_depth{depth}"
+        rec["step"] = f"loadgen_inproc_depth{depth}{tag}"
         rec["rc"] = proc.returncode
         append(rec)
         log(f"  -> depth {depth}: qps={rec.get('qps')} "
@@ -177,7 +177,7 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
 
 
 def run_loadgen_sweep(engine_dir: str, duration_s: float,
-                      concurrency: int) -> None:
+                      concurrency: int, tag: str = "") -> None:
     """Deploy the engine at each pipeline depth, hammer it, undeploy."""
     import urllib.request
 
@@ -192,7 +192,7 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
             cwd=engine_dir, capture_output=True, text=True,
         ).returncode
         if rc != 0:
-            append({"step": f"loadgen_depth{depth}",
+            append({"step": f"loadgen_depth{depth}{tag}",
                     "error": f"deploy failed rc={rc}"})
             continue
         up = False
@@ -207,7 +207,7 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
                 time.sleep(1)
         try:
             if not up:
-                append({"step": f"loadgen_depth{depth}",
+                append({"step": f"loadgen_depth{depth}{tag}",
                         "error": "server never came up"})
                 continue
             time.sleep(3)  # let the first-query compile settle
@@ -228,7 +228,7 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
                 )
             except ValueError:
                 rec = {"error": f"malformed JSON: {lines[-1][:120]!r}"}
-            rec["step"] = f"loadgen_depth{depth}"
+            rec["step"] = f"loadgen_depth{depth}{tag}"
             append(rec)
             log(f"  -> depth {depth}: qps={rec.get('qps')} "
                 f"p99={rec.get('p99_ms')}ms errors={rec.get('errors')}")
@@ -247,6 +247,11 @@ def main() -> int:
                     help="trained engine project for the loadgen sweep "
                          "(e.g. a movielens_quickstart workdir's engine/); "
                          "omitting it skips the sweep with instructions")
+    ap.add_argument("--engine-dir-big", default=None,
+                    help="trained BIG-catalog engine (60k+ items — "
+                         "streaming-top-k territory) for an additional "
+                         "loadgen pass at the catalog shapes the serving "
+                         "claims are priced at")
     ap.add_argument("--loadgen-duration", type=float, default=15.0)
     ap.add_argument("--loadgen-concurrency", type=int, default=128)
     ap.add_argument("--iterations", default=None,
@@ -334,19 +339,31 @@ def main() -> int:
 
     if args.skip_loadgen:
         pass
-    elif args.engine_dir:
-        run_loadgen_sweep(
-            args.engine_dir, args.loadgen_duration,
-            args.loadgen_concurrency,
-        )
-        run_inprocess_sweep(
-            args.engine_dir, args.loadgen_duration,
-            args.loadgen_concurrency,
-        )
     else:
-        log("loadgen sweep skipped: pass --engine-dir <trained engine "
-            "project> (e.g. run examples/movielens_quickstart/run.sh "
-            "once, then point at <workdir>/engine)")
+        if args.engine_dir:
+            run_loadgen_sweep(
+                args.engine_dir, args.loadgen_duration,
+                args.loadgen_concurrency,
+            )
+            run_inprocess_sweep(
+                args.engine_dir, args.loadgen_duration,
+                args.loadgen_concurrency,
+            )
+        if args.engine_dir_big:
+            # independent of --engine-dir: the big-catalog pass alone is
+            # a valid (and sometimes the only wanted) measurement
+            run_loadgen_sweep(
+                args.engine_dir_big, args.loadgen_duration,
+                args.loadgen_concurrency, tag="_big",
+            )
+            run_inprocess_sweep(
+                args.engine_dir_big, args.loadgen_duration,
+                args.loadgen_concurrency, tag="_big",
+            )
+        if not (args.engine_dir or args.engine_dir_big):
+            log("loadgen sweep skipped: pass --engine-dir <trained engine "
+                "project> (e.g. run examples/movielens_quickstart/run.sh "
+                "once, then point at <workdir>/engine)")
 
     log(f"done; evidence in {OUT}")
     return 0

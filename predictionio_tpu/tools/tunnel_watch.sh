@@ -9,10 +9,12 @@
 # script is re-exec'd fresh each time, so edits to tpu_revalidate.py made
 # while this watcher sleeps are picked up automatically.
 #
-# Usage: nohup bash predictionio_tpu/tools/tunnel_watch.sh [engine_dir] &
+# Usage: nohup bash predictionio_tpu/tools/tunnel_watch.sh \
+#   [engine_dir] [engine_dir_big] &
 set -u
 cd "$(dirname "$0")/../.."
 ENGINE_DIR="${1:-/tmp/qs_r3/engine}"
+ENGINE_DIR_BIG="${2:-}"
 LOG=TUNNEL_WATCH.log
 OK_INTERVAL=1200   # 20 min between timeout probes
 FAIL_INTERVAL=300  # 5 min after a fast "failed" (worth a quicker retry)
@@ -26,7 +28,9 @@ while true; do
     ok)
       echo "$(date -u +%FT%TZ) TUNNEL UP — running revalidation queue" >> "$LOG"
       python -m predictionio_tpu.tools.tpu_revalidate \
-        --engine-dir "$ENGINE_DIR" >> "$LOG" 2>&1
+        --engine-dir "$ENGINE_DIR" \
+        ${ENGINE_DIR_BIG:+--engine-dir-big "$ENGINE_DIR_BIG"} \
+        >> "$LOG" 2>&1
       rc=$?
       if [ "$rc" = 2 ]; then
         # the tunnel wedged again between OUR probe and the queue's own
