@@ -221,6 +221,14 @@ class ALSAlgorithmParams(Params):
     #: einsums (see ops.als.ALSConfig.gather_dtype; quality-gate before
     #: adopting bf16)
     gather_dtype: str = "f32"
+    #: Sort each solve row's column indices before staging (gather
+    #: locality; permutation-invariant math — see
+    #: ops.als.ALSConfig.sort_gather_indices)
+    sort_gather_indices: bool = False
+    #: Build normal equations with the fused gather+Gramian Pallas
+    #: kernel (requires solve_mode to resolve to "pallas"; EXPERIMENTAL,
+    #: hardware-gated — see ops.als.ALSConfig.fused_gather)
+    fused_gather: bool = False
     #: Serving top-k path: "auto" (default) streams item blocks through
     #: the Pallas kernel — never materializing the [batch, n_items] score
     #: matrix in HBM — when on TPU and that matrix would exceed ~1 GB;
@@ -270,6 +278,8 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
             solve_mode=p.solve_mode,
             gather_dtype=p.gather_dtype,
+            sort_gather_indices=p.sort_gather_indices,
+            fused_gather=p.fused_gather,
         )
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None

@@ -41,6 +41,16 @@ while true; do
         sleep "$FAIL_INTERVAL"
         continue
       fi
+      # write to a temp file and move only on success: a report crash
+      # must not truncate a prior hardware window's report
+      if python -m predictionio_tpu.tools.reval_report \
+          > TPU_REVAL_REPORT.md.tmp 2>>"$LOG"; then
+        mv TPU_REVAL_REPORT.md.tmp TPU_REVAL_REPORT.md
+      else
+        echo "$(date -u +%FT%TZ) reval_report failed (kept old report)" \
+          >> "$LOG"
+        rm -f TPU_REVAL_REPORT.md.tmp
+      fi
       echo "$(date -u +%FT%TZ) revalidate rc=$rc — watcher exiting" >> "$LOG"
       exit $rc
       ;;

@@ -218,3 +218,39 @@ class TestStreamingTopKServing:
         )
         with pytest.raises(ValueError, match="streaming_top_k"):
             run_train(engine, params, registry, engine_id="bad-mode")
+
+
+class TestGatherLeverParams:
+    """The round-3/4 training levers (sort_gather_indices, fused_gather)
+    must be reachable from engine.json via ALSAlgorithmParams and
+    reproduce the default path's factors."""
+
+    def test_levers_reproduce_default_model(self, registry):
+        ingest_ratings(registry)
+        engine = engine_factory()
+
+        def params(**kw):
+            return EngineParams(
+                data_source_params=("", RecDataSourceParams(app_id=1)),
+                algorithm_params_list=[
+                    ("als", ALSAlgorithmParams(
+                        rank=4, num_iterations=4, lambda_=0.05, **kw
+                    ))
+                ],
+            )
+
+        base = run_train(engine, params(), registry, engine_id="lv0")
+        levered = run_train(
+            engine,
+            params(sort_gather_indices=True, fused_gather=True,
+                   solve_mode="pallas"),
+            registry, engine_id="lv1",
+        )
+        m0 = load_models(registry, base)[0]
+        m1 = load_models(registry, levered)[0]
+        np.testing.assert_allclose(
+            m0.user_factors, m1.user_factors, rtol=5e-3, atol=5e-4
+        )
+        np.testing.assert_allclose(
+            m0.item_factors, m1.item_factors, rtol=5e-3, atol=5e-4
+        )
