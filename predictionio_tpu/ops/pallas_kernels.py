@@ -373,6 +373,13 @@ _FUSED_K_TILE = 512
 #: the [Bt, K] index block in SMEM (Bt·K ≤ _FUSED_SMEM_IDX ints).
 _FUSED_B_TILE = 128
 _FUSED_SMEM_IDX = 32768
+#: Widest K a single kernel call takes. Wider problems (the rare
+#: ultra-high-degree buckets) are split into K-slices summed in XLA.
+#: The per-call SMEM index block is [bt, k] with bt·k ≤ _FUSED_SMEM_IDX,
+#: so the real scalar-memory bound is _FUSED_SMEM_IDX·4 B = 128 KB
+#: regardless of this constant; the split's job is to keep a SINGLE
+#: row's index list (bt can't go below 1) within that same bound.
+_FUSED_K_SPLIT = 8192
 
 
 def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
@@ -521,6 +528,24 @@ def gramian_fused(
     if r % 8 != 0:
         raise ValueError(f"gramian_fused: rank must be padded to 8s, got {r}")
     b, k = idx.shape
+    if k > _FUSED_K_SPLIT:
+        # K-slice split: base terms (ridge·I, yty) ride the first slice
+        # only, the rest contribute pure Σ w·y⊗y — summing slice outputs
+        # is exact. Costs one [B, R, R] add per extra slice, paid only by
+        # the ultra-wide buckets.
+        a_tot, b_tot = None, None
+        zero_ridge = jnp.zeros_like(jnp.asarray(ridge, jnp.float32))
+        for k0 in range(0, k, _FUSED_K_SPLIT):
+            sl = slice(k0, min(k, k0 + _FUSED_K_SPLIT))
+            a_s, b_s = gramian_fused(
+                y, idx[:, sl], w2[:, sl], rhs[:, sl],
+                ridge if k0 == 0 else zero_ridge,
+                yty if k0 == 0 else None,
+                interpret=interpret,
+            )
+            a_tot = a_s if a_tot is None else a_tot + a_s
+            b_tot = b_s if b_tot is None else b_tot + b_s
+        return a_tot, b_tot
     kt = min(k, _FUSED_K_TILE)
     k_pad = _round_up(k, kt)
     bt = min(_FUSED_B_TILE, max(1, _FUSED_SMEM_IDX // k_pad))

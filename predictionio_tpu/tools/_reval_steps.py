@@ -89,6 +89,11 @@ def step_fused_smoke() -> dict:
     for (b, k, n, r, seed) in (
         (32, 16, 500, 56, 0), (16, 512, 300, 56, 1), (8, 1024, 200, 24, 2),
         (25, 13, 77, 16, 3),
+        # bench-realistic wide buckets: k=8192 hits the single-call SMEM
+        # high-water mark ([4, 8192] int32 index block = the full
+        # _FUSED_SMEM_IDX budget), k=32768 exercises the K-slice split —
+        # both must survive Mosaic BEFORE the full-scale A/B commits
+        (4, 8192, 300, 56, 4), (2, 32768, 300, 56, 5),
     ):
         rng = np.random.default_rng(seed)
         y = rng.standard_normal((n, r), dtype=np.float32)

@@ -313,3 +313,21 @@ class TestGramianFused:
             gramian_fused(jnp.zeros((10, 7)), jnp.zeros((4, 4), jnp.int32),
                           jnp.zeros((4, 4)), jnp.zeros((4, 4)),
                           jnp.zeros((4,)))
+
+    def test_wide_k_split_matches_einsum(self, monkeypatch):
+        """K wider than the per-call SMEM bound splits into slices summed
+        in XLA (base terms counted once) — forced small here so the test
+        exercises 3 slices without a 32k-wide problem."""
+        import predictionio_tpu.ops.pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_FUSED_K_SPLIT", 32)
+        y, idx, w2, rhs, ridge = self._data(6, 80, 60, 16, seed=6)
+        yty = (y.T @ y).astype(np.float32)
+        a, bv = pk.gramian_fused(jnp.asarray(y), jnp.asarray(idx),
+                                 jnp.asarray(w2), jnp.asarray(rhs),
+                                 jnp.asarray(ridge), jnp.asarray(yty))
+        a_ref, b_ref = self._ref(y, idx, w2, rhs, ridge, yty)
+        np.testing.assert_allclose(np.asarray(a), a_ref, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bv), b_ref, rtol=1e-4,
+                                   atol=1e-4)
