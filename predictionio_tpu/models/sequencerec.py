@@ -210,6 +210,11 @@ class SeqRecAlgorithmParams(Params):
     #: attention schedule: "flash" (single device), "ring", "ulysses",
     #: or "auto" (ring when the ctx mesh has a seq axis of size > 1)
     schedule: str = "flash"
+    #: attention implementation on the single-device path: "xla"
+    #: (default) or "pallas" (fused flash kernel,
+    #: ops.attention.flash_attention_pallas; EXPERIMENTAL until
+    #: hardware-validated — flash_pallas step in the revalidation queue)
+    flash_impl: str = "xla"
 
 
 def _init_params(
@@ -245,7 +250,8 @@ def _layer_norm(x, g, b):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
 
 
-def forward(params, tokens, n_heads: int, mesh=None, schedule: str = "flash"):
+def forward(params, tokens, n_heads: int, mesh=None, schedule: str = "flash",
+            flash_impl: str = "xla"):
     """Causal LM forward: tokens [B, L] int32 → logits [B, L, V]."""
     b, l = tokens.shape
     d = params["embed"].shape[1]
@@ -270,6 +276,7 @@ def forward(params, tokens, n_heads: int, mesh=None, schedule: str = "flash"):
             mesh=mesh if schedule in ("ring", "ulysses", "auto") else None,
             causal=True,
             schedule=schedule if schedule != "flash" else "auto",
+            impl=flash_impl,
         )
         o = o.transpose(0, 2, 1, 3).reshape(b, l, d)
         h = h + o @ layer["proj"]
@@ -336,7 +343,8 @@ class SeqRecAlgorithm(Algorithm):
 
         def loss_fn(mp, batch):
             inp, tgt = batch[:, :-1], batch[:, 1:]
-            logits = forward(mp, inp, p.n_heads, mesh, p.schedule)
+            logits = forward(mp, inp, p.n_heads, mesh, p.schedule,
+                             flash_impl=p.flash_impl)
             mask = (tgt != pad_id).astype(jnp.float32)
             ll = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
             return (ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
@@ -382,7 +390,10 @@ class SeqRecAlgorithm(Algorithm):
         # every query (the serving-cache move the scoring kernels also make)
         seq = [pad_id] * (model.seq_len - len(recent)) + list(recent)
         tokens = jnp.asarray(np.asarray(seq, np.int32)[None, :], jnp.int32)
-        logits = forward(model.device_params(), tokens, model.n_heads)[0, -1]
+        logits = forward(
+            model.device_params(), tokens, model.n_heads,
+            flash_impl=self.params.flash_impl,
+        )[0, -1]
         # Next-item prediction keeps previously-seen items eligible (Markov
         # semantics: the next state may be a revisit) — only PAD is masked.
         # Top-k on device: no full-catalog sort on the serving hot path.

@@ -34,6 +34,13 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # pallas is TPU/GPU-oriented; keep the module importable anywhere
+    from jax.experimental import pallas as pl
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
 SEQ_AXIS = "seq"
 
 _NEG_BIG = -1e30  # additive mask value (finite: keeps fully-masked rows NaN-free)
@@ -59,6 +66,164 @@ def _attend_block(q, k, v, m, l, o, mask, scale):
         preferred_element_type=jnp.float32,
     )
     return m_new, l_new, o_new
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q, blk_k, lk,
+                  causal, scale, n_kv):
+    """One (batch·head, Q-block) grid step: online softmax over KV blocks.
+
+    Everything lives in VMEM: q block [blk_q, D], full K/V [Lk_pad, D]
+    (fetched once per batch·head — the Q-block grid dim is innermost and
+    their index map is constant in it), score tiles [blk_q, blk_k] that
+    never touch HBM — the O(L²) score matrix is the thing this kernel
+    exists to not materialize.
+    """
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [blk_q, D]
+    d = q.shape[-1]
+    q_pos = i * blk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0
+    )
+
+    def step(j, carry):
+        m, l, o = carry
+        kj = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        vj = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [blk_q, blk_k]
+        k_pos = j * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1
+        )
+        keep = k_pos < lk
+        if causal:
+            keep = keep & (q_pos >= k_pos)
+        s = jnp.where(keep, s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + p.sum(axis=1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((blk_q,), _NEG_BIG, dtype=jnp.float32)
+    l0 = jnp.zeros((blk_q,), dtype=jnp.float32)
+    o0 = jnp.zeros((blk_q, d), dtype=jnp.float32)
+    # causal: KV blocks strictly above this Q block's diagonal contribute
+    # nothing — skip them (the classic flash-attention work saving)
+    hi = (
+        jnp.minimum(((i + 1) * blk_q + blk_k - 1) // blk_k, n_kv)
+        if causal else n_kv
+    )
+    m, l, o = jax.lax.fori_loop(0, hi, step, (m0, l0, o0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "blk_q", "blk_k", "interpret")
+)
+def _flash_pallas_call(q, k, v, causal, blk_q, blk_k, interpret):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    lq_pad = -lq % blk_q
+    lk_pad = -lk % blk_k
+    if lq_pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, lq_pad), (0, 0)))
+    if lk_pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, lk_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, lk_pad), (0, 0)))
+    bh = b * h
+    qr = q.reshape(bh, lq + lq_pad, d)
+    kr = k.reshape(bh, lk + lk_pad, d)
+    vr = v.reshape(bh, lk + lk_pad, d)
+    n_kv = (lk + lk_pad) // blk_k
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, blk_q=blk_q, blk_k=blk_k, lk=lk,
+            causal=causal, scale=1.0 / np.sqrt(d), n_kv=n_kv,
+        ),
+        grid=(bh, (lq + lq_pad) // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bhi, i: (bhi, i, 0)),
+            pl.BlockSpec((1, lk + lk_pad, d), lambda bhi, i: (bhi, 0, 0)),
+            pl.BlockSpec((1, lk + lk_pad, d), lambda bhi, i: (bhi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bhi, i: (bhi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq + lq_pad, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, lq + lq_pad, d)[:, :, :lq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pallas_diff(q, k, v, causal, blk_q, blk_k, interpret):
+    return _flash_pallas_call(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _flash_pallas_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    # flash-style backward: save only q/k/v and recompute attention in
+    # the VJP (the O(L²) score matrix is never a residual) — here the
+    # recompute runs through the XLA online-softmax path, whose autodiff
+    # is the reference math the kernel is equality-tested against
+    return (
+        _flash_pallas_call(q, k, v, causal, blk_q, blk_k, interpret),
+        (q, k, v),
+    )
+
+
+def _flash_pallas_bwd(causal, blk_q, blk_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_pallas_diff.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+def flash_attention_pallas(
+    q: jax.Array,  # [B, H, L, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas flash attention: fused scores+softmax+PV per Q block, causal
+    upper-triangle KV blocks skipped entirely. K/V are VMEM-resident per
+    batch·head, so this single-device kernel targets L up to the VMEM
+    budget (~16k at D=64); beyond that, shard the sequence (ring/Ulysses
+    — which is the framework's long-context answer anyway).
+
+    Differentiable: a custom VJP recomputes attention through the XLA
+    online-softmax path in the backward pass (flash-style — only q/k/v
+    are residuals, never the score matrix), so training through this
+    kernel is supported.
+
+    EXPERIMENTAL: selected via ``attention(..., impl="pallas")`` /
+    ``flash_impl`` in sequencerec params, XLA path remains the default
+    until the Mosaic lowering is hardware-validated (``flash_pallas``
+    step in the revalidation queue). ``interpret=None`` auto-selects the
+    interpreter off-TPU.
+    """
+    if not _HAVE_PALLAS:
+        raise NotImplementedError(
+            "flash_attention_pallas requires pallas; use flash_attention"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lq, lk = q.shape[2], k.shape[2]
+    return _flash_pallas_diff(
+        q, k, v, causal, min(block_q, max(8, lq)), min(block_k, lk),
+        interpret,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_k"))
@@ -214,10 +379,18 @@ def attention(
     axis: str = SEQ_AXIS,
     causal: bool = True,
     schedule: str = "auto",
+    impl: str = "xla",
 ) -> jax.Array:
     """Dispatch: single-device flash when no mesh / 1-device axis; otherwise
-    ring (default) or Ulysses (``schedule="ulysses"``, when heads divide)."""
+    ring (default) or Ulysses (``schedule="ulysses"``, when heads divide).
+    ``impl="pallas"`` selects the fused single-device kernel
+    (:func:`flash_attention_pallas`; experimental, hardware-gated) —
+    sharded schedules keep the XLA inner step for now."""
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown attention impl {impl!r}")
     if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
+        if impl == "pallas":
+            return flash_attention_pallas(q, k, v, causal=causal)
         return flash_attention(q, k, v, causal=causal)
     if schedule == "ulysses":
         return ulysses_attention(q, k, v, mesh, axis, causal)

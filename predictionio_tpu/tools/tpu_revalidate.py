@@ -334,6 +334,7 @@ def main() -> int:
     fused_smoke = run_step("fused_smoke")
     run_step("mesh_pallas")
     run_step("dispatch_bench")
+    run_step("flash_pallas")
     if fused_smoke.get("ok"):
         fused = gated("fused_gather", {"BENCH_FUSED_GATHER": "1"})
         if fused.get("rmse_gate") == "pass" and bf16.get("rmse_gate") == "pass":

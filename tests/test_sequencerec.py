@@ -208,3 +208,37 @@ def test_predicted_result_wire_shape():
     assert encode_result(r) == {
         "itemScores": [{"item": "i1", "score": 0.5}]
     }
+
+
+def test_flash_impl_pallas_trains_equivalently():
+    """flash_impl="pallas" must reproduce the default (XLA) training to
+    float tolerance — the kernel changes blocking, never math."""
+    import numpy as np
+
+    from predictionio_tpu.models.sequencerec import (
+        SeqPreparator,
+        SeqPreparatorParams,
+        SeqRecAlgorithm,
+        SeqRecAlgorithmParams,
+        TrainingData,
+    )
+
+    seqs = [[f"i{(u + j) % 9}" for j in range(12)] for u in range(6)]
+    td = TrainingData(
+        user_ids=[f"u{u}" for u in range(6)], sequences=seqs
+    )
+    pd = SeqPreparator(SeqPreparatorParams(seq_len=8)).prepare(None, td)
+    out = {}
+    for impl in ("xla", "pallas"):
+        model = SeqRecAlgorithm(
+            SeqRecAlgorithmParams(
+                d_model=16, n_heads=2, n_layers=1, steps=3,
+                batch_size=4, seed=5, flash_impl=impl,
+            )
+        ).train(None, pd)
+        out[impl] = model.params
+    for key in ("embed", "pos"):
+        np.testing.assert_allclose(
+            np.asarray(out["xla"][key]), np.asarray(out["pallas"][key]),
+            rtol=1e-3, atol=1e-4,
+        )
