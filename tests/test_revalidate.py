@@ -1,0 +1,89 @@
+"""Unit tests for the TPU revalidation queue's recording logic.
+
+The queue runs unattended in the rare hardware window; its parsing must
+convert every subprocess outcome — good JSON, garbage, crashes,
+timeouts — into an appended record without killing the chain. These
+tests stub ``subprocess.run`` so no device (or bench) is involved.
+"""
+
+import json
+import subprocess
+import types
+
+import pytest
+
+from predictionio_tpu.tools import tpu_revalidate as tr
+
+
+@pytest.fixture(autouse=True)
+def evidence_file(tmp_path, monkeypatch):
+    out = tmp_path / "ev.jsonl"
+    monkeypatch.setattr(tr, "OUT", str(out))
+    return out
+
+
+def _records(path):
+    return [json.loads(l) for l in path.read_text().splitlines() if l]
+
+
+def _stub(monkeypatch, stdout="", stderr="", rc=0, raise_timeout=False):
+    def fake_run(*a, **kw):
+        if raise_timeout:
+            raise subprocess.TimeoutExpired(cmd=a[0], timeout=1)
+        return types.SimpleNamespace(
+            stdout=stdout, stderr=stderr, returncode=rc
+        )
+
+    monkeypatch.setattr(tr.subprocess, "run", fake_run)
+
+
+class TestRunBench:
+    def test_good_json_recorded_with_step(self, monkeypatch, evidence_file):
+        _stub(monkeypatch, stdout='noise\n{"value": 17.8, "holdout_rmse": 0.53}\n')
+        rec = tr.run_bench("baseline_f32", {})
+        assert rec["value"] == 17.8 and rec["step"] == "baseline_f32"
+        assert _records(evidence_file)[0]["step"] == "baseline_f32"
+
+    def test_malformed_json_recorded_not_raised(self, monkeypatch,
+                                                evidence_file):
+        _stub(monkeypatch, stdout='{"truncated": ', rc=1)
+        rec = tr.run_bench("baseline_f32", {})
+        assert "malformed" in rec["error"]
+        assert _records(evidence_file)[0]["rc"] == 1
+
+    def test_timeout_recorded_and_chain_continues(self, monkeypatch,
+                                                  evidence_file):
+        _stub(monkeypatch, raise_timeout=True)
+        rec = tr.run_bench("bf16_gather", {}, timeout_s=1)
+        assert rec["rc"] == -1 and "timed out" in rec["error"]
+
+    def test_fallback_marked_invalid(self, monkeypatch, evidence_file):
+        _stub(monkeypatch,
+              stdout='{"value": 12.0, "fallback": "cpu-fallback"}\n')
+        rec = tr.run_bench("baseline_f32", {})
+        assert "DEVICE FELL BACK" in rec["note"]
+
+
+class TestRunStep:
+    def test_inner_step_name_normalized(self, monkeypatch, evidence_file):
+        # _reval_steps subcommand names differ from their records' own
+        # step names; the file must use ONE name per logical step
+        _stub(monkeypatch,
+              stdout='{"step": "fused_kernel_compiled", "ok": true}\n')
+        rec = tr.run_step("fused_smoke")
+        assert rec["step"] == "fused_smoke"
+        assert rec["inner_step"] == "fused_kernel_compiled"
+        assert rec["ok"] is True
+
+    def test_crash_with_no_json_records_stderr_tail(self, monkeypatch,
+                                                    evidence_file):
+        _stub(monkeypatch, stdout="", stderr="Trace\nRuntimeError: boom",
+              rc=1)
+        rec = tr.run_step("mesh_pallas")
+        assert rec["error"] == "RuntimeError: boom"
+        assert rec["rc"] == 1
+
+    def test_malformed_json_guarded(self, monkeypatch, evidence_file):
+        _stub(monkeypatch, stdout='{"ok": tru')
+        rec = tr.run_step("dispatch_bench")
+        assert "malformed" in rec["error"]
