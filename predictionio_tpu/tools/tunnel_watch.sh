@@ -5,9 +5,13 @@
 # windows because nothing was probing when it recovered. This loop probes
 # every PROBE_INTERVAL_S (default 20 min; 5 min after a fast "failed"),
 # logs EVERY attempt to TUNNEL_WATCH.log, and the moment a probe succeeds
-# runs the full revalidation queue unattended, then exits. The queue
-# script is re-exec'd fresh each time, so edits to tpu_revalidate.py made
-# while this watcher sleeps are picked up automatically.
+# runs the full revalidation queue unattended. A clean queue run (rc=0)
+# ends the watcher; a run aborted or broken by a re-wedge keeps it
+# watching and retries the whole queue on the next window (up to
+# MAX_QUEUE_RUNS attempts — evidence appends across attempts and the
+# report takes the newest record per step). The queue script is
+# re-exec'd fresh each time, so edits to tpu_revalidate.py made while
+# this watcher sleeps are picked up automatically.
 #
 # Usage: nohup bash predictionio_tpu/tools/tunnel_watch.sh \
 #   [engine_dir] [engine_dir_big] &
@@ -18,6 +22,8 @@ ENGINE_DIR_BIG="${2:-}"
 LOG=TUNNEL_WATCH.log
 OK_INTERVAL=1200   # 20 min between timeout probes
 FAIL_INTERVAL=300  # 5 min after a fast "failed" (worth a quicker retry)
+MAX_QUEUE_RUNS=5   # cap full-queue attempts (each appends evidence)
+queue_runs=0
 
 echo "$(date -u +%FT%TZ) watcher start (engine_dir=$ENGINE_DIR)" >> "$LOG"
 while true; do
@@ -39,6 +45,22 @@ while true; do
         echo "$(date -u +%FT%TZ) revalidate rc=2 (re-wedged before start);"\
           " watcher continues" >> "$LOG"
         sleep "$FAIL_INTERVAL"
+        continue
+      fi
+      queue_runs=$((queue_runs + 1))
+      if [ "$rc" != 0 ] && [ "$queue_runs" -lt "$MAX_QUEUE_RUNS" ]; then
+        # a mid-queue wedge (rc=1: baseline failed or fell back) leaves
+        # partial evidence — summarize what landed NOW (this may be the
+        # last window), then keep watching and retry the whole queue
+        if python -m predictionio_tpu.tools.reval_report \
+            > TPU_REVAL_REPORT.md.tmp 2>>"$LOG"; then
+          mv TPU_REVAL_REPORT.md.tmp TPU_REVAL_REPORT.md
+        else
+          rm -f TPU_REVAL_REPORT.md.tmp
+        fi
+        echo "$(date -u +%FT%TZ) revalidate rc=$rc (attempt $queue_runs);"\
+          " watcher continues for another window" >> "$LOG"
+        sleep "$OK_INTERVAL"
         continue
       fi
       # write to a temp file and move only on success: a report crash
