@@ -169,10 +169,28 @@ def synth_ml20m(scale: float, seed: int = 0):
         # tmp name keeps the .npz suffix so np.savez writes it verbatim;
         # atomic rename = concurrent bench runs never see a torn file.
         # Sweep predecessors' orphans first: a bench killed mid-savez
-        # (the tunnel-wedge timeout) leaves a ~400 MB tmp behind.
+        # (the tunnel-wedge timeout) leaves a ~400 MB tmp behind. Only
+        # reap a tmp whose writer pid is gone — a concurrent bench's
+        # live tmp must not vanish out from under its savez.
         import glob
 
         for orphan in glob.glob(f"{cache}.*.tmp.npz"):
+            try:
+                age_s = time.time() - os.path.getmtime(orphan)
+            except OSError:
+                continue  # vanished under us (another reaper won)
+            if age_s < 6 * 3600.0:
+                # young tmp: only reap if its writer pid is gone. Old
+                # tmps are reaped regardless — a recycled pid must not
+                # make a ~400 MB orphan permanent.
+                try:
+                    pid = int(os.path.basename(orphan).split(".")[-3])
+                    os.kill(pid, 0)  # raises if no such process
+                    continue  # writer still alive; leave its tmp alone
+                except (ValueError, IndexError, ProcessLookupError):
+                    pass  # unparseable name or dead writer: orphan
+                except OSError:
+                    continue  # exists but not signalable: assume alive
             try:
                 os.remove(orphan)
             except OSError:
@@ -233,11 +251,10 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
 
     # Warm the compilation cache with the REAL bucket shapes (jit keys on
     # shapes: a smaller sliver would leave the timed run paying XLA compile).
-    # One warm-up iteration compiles every bucket kernel; the timed section
-    # then measures steady-state bucketize + staging + training.
     # 2 warm-up iterations: the first executed iteration runs as two
     # half-programs (staging overlap), later ones as the fused program —
-    # both must be compiled before the timed section
+    # both must be compiled before the timed section; the timed section
+    # then measures steady-state bucketize + staging + training.
     warm_cfg = ALSConfig(
         rank=cfg.rank, iterations=2, lambda_=cfg.lambda_, seed=cfg.seed,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
@@ -388,6 +405,17 @@ def main() -> int:
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     iterations = int(os.environ.get("BENCH_ITERATIONS", "10"))
     fallback = os.environ.get("_PIO_BENCH_CHILD", "")
+
+    # persistent compilation cache: the revalidation queue runs this
+    # script ~8x in fresh subprocesses; without it each leg re-pays the
+    # full XLA compile inside the scarce hardware window
+    sys.path.insert(0, _REPO_ROOT)
+    from predictionio_tpu.utils.jax_cache import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache()
+    if cache_dir:
+        print(f"bench: persistent compilation cache at {cache_dir}",
+              file=sys.stderr)
 
     if not fallback:
         # Bring-up: probe the configured backend before the real workload.

@@ -239,6 +239,9 @@ def main(argv=None) -> int:
     if len(argv) != 1 or argv[0] not in STEPS:
         print(f"usage: _reval_steps {{{'|'.join(STEPS)}}}", file=sys.stderr)
         return 2
+    from ..utils.jax_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     rec = STEPS[argv[0]]()
     print(json.dumps(rec))
     return 0

@@ -166,7 +166,12 @@ def _contention_child(tmp_root: str, writer_id, n_batches: int,
     whatever flock signal exists can surface.
 
     Protocol: prints READY, waits for a line on stdin (start barrier),
-    runs, prints one JSON line with its loop wall-clock."""
+    runs, prints one JSON line with its loop wall-clock.
+
+    Invariant breach, deliberate: appending the SAME prepared batch
+    ``n_batches`` times writes duplicate event ids, which violates the
+    store's fresh-id routing assumption — the bench store directory is
+    write-only throw-away state and must never be opened for reads."""
     import datetime as _dt
 
     from ..storage.event import UTC, Event

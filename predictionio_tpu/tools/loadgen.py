@@ -191,6 +191,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     payloads = _expand_payloads(args.payload)
     server = None
     if args.in_process:
+        # only this mode compiles anything; the HTTP client path must not
+        # pay a jax import at startup (the queue spawns six of them)
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         target, server = _inprocess_target(
             args.engine_dir, batching=not args.no_batching,
             pipeline_depth=args.pipeline_depth,

@@ -62,7 +62,14 @@ def report(steps: dict) -> str:
     out = ["# TPU revalidation report", ""]
 
     out.append("## ALS bench (ML-20M shape, rank 50, 10 iter)")
-    for name in ("baseline_f32", "baseline_f32_r2", "baseline_f32_r3",
+    # repeat legs are named baseline_f32_rN for N=2..--repeats: derive
+    # them from the records present rather than hard-coding N<=3
+    repeat_names = sorted(
+        (n for n in steps
+         if n.startswith("baseline_f32_r") and n[14:].isdigit()),
+        key=lambda n: int(n[14:]),
+    )
+    for name in ("baseline_f32", *repeat_names,
                  "bf16_gather", "sort_gather", "bf16_plus_sort",
                  "fused_gather", "fused_plus_bf16"):
         if name in steps:
@@ -77,14 +84,18 @@ def report(steps: dict) -> str:
 
     out.append("")
     out.append("## Compiled-path verdicts")
-    for name in ("fused_smoke", "mesh_pallas"):
+    for name in ("fused_smoke", "mesh_pallas", "flash_pallas"):
         rec = steps.get(name)
         if rec is None:
             out.append(f"- {name}: — not run")
         elif rec.get("ok"):
+            detail = {
+                k: v for k, v in rec.items()
+                if any(t in k for t in ("rel", "err", "_ms_"))
+            }
             out.append(
                 f"- **{name}**: OK compiled={rec.get('compiled')} "
-                f"({ {k: v for k, v in rec.items() if 'rel' in k} })"
+                f"({detail})"
             )
         else:
             out.append(f"- **{name}**: FAILED — {rec}")
@@ -128,11 +139,10 @@ def report(steps: dict) -> str:
                 )
 
     covered = {
-        "baseline_f32", "baseline_f32_r2", "baseline_f32_r3",
-        "baseline_variance", "bf16_gather", "sort_gather",
+        "baseline_f32", "baseline_variance", "bf16_gather", "sort_gather",
         "bf16_plus_sort", "fused_gather", "fused_plus_bf16",
-        "fused_smoke", "mesh_pallas", "dispatch_bench",
-    } | {
+        "fused_smoke", "mesh_pallas", "flash_pallas", "dispatch_bench",
+    } | set(repeat_names) | {
         f"loadgen_{kind}depth{d}{t}"
         for kind in ("", "inproc_") for d in (1, 2, 4) for t in ("", "_big")
     } | {f"{n}_gate" for n in ("bf16_gather", "sort_gather",

@@ -84,9 +84,13 @@ def make_server(
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     # Platform self-forcing before any backend init (see run_workflow.main).
+    from ..utils.jax_cache import enable_compilation_cache
     from ..utils.platform import apply_env_platform
 
     apply_env_platform()
+    # serving compiles per (batch-shape, depth); the loadgen sweep deploys
+    # this server once per pipeline depth — warm starts matter there
+    enable_compilation_cache()
     args = build_parser().parse_args(argv)
     make_server(args, block=True)
     return 0

@@ -129,9 +129,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # Make the caller's platform choice stick before any backend init —
     # a boot hook may have programmatically overridden JAX_PLATFORMS=cpu
     # (the spark-submit env-propagation analogue, RunWorkflow.scala:37-40).
+    from ..utils.jax_cache import enable_compilation_cache
     from ..utils.platform import apply_env_platform
 
     apply_env_platform()
+    enable_compilation_cache()
     args = build_parser().parse_args(argv)
     instance_id = run(args)
     print(json.dumps({"engineInstanceId": instance_id}))

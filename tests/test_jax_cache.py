@@ -1,0 +1,105 @@
+"""Persistent-compilation-cache wiring (utils/jax_cache.py).
+
+The revalidation queue's subprocess isolation means every device step is
+a fresh process; these tests prove the cache actually carries compiled
+executables across that process boundary — the property the hardware
+window depends on — using the CPU backend (same cache machinery, no
+device needed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A compile heavy enough that a persistent-cache hit is unmistakably
+# cheaper than the miss, run in a child hard-pinned to the CPU backend.
+_CHILD = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from predictionio_tpu.utils.platform import force_cpu_in_process
+force_cpu_in_process()
+from predictionio_tpu.utils.jax_cache import enable_compilation_cache
+cache_dir = enable_compilation_cache()
+import jax
+import jax.numpy as jnp
+
+def f(x):
+    for i in range(12):
+        x = jnp.tanh(x @ x) * (1.0 + 1.0 / (i + 2)) + x
+    return x.sum()
+
+t0 = time.monotonic()
+jax.jit(f).lower(
+    jax.ShapeDtypeStruct((256, 256), jnp.float32)
+).compile()
+print(json.dumps({{"compile_s": time.monotonic() - t0,
+                   "cache_dir": cache_dir}}))
+"""
+
+
+def _run_child(cache_dir: str) -> dict:
+    from predictionio_tpu.utils.platform import force_cpu_env
+
+    env = force_cpu_env()
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["PIO_JAX_CACHE_DIR"] = cache_dir
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_cache_disabled_by_empty_env(monkeypatch):
+    from predictionio_tpu.utils.jax_cache import enable_compilation_cache
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PIO_JAX_CACHE_DIR", "")
+    assert enable_compilation_cache() is None
+    assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+
+
+def test_explicit_jax_env_wins(monkeypatch, tmp_path):
+    from predictionio_tpu.utils.jax_cache import enable_compilation_cache
+
+    theirs = str(tmp_path / "theirs")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", theirs)
+    monkeypatch.setenv("PIO_JAX_CACHE_DIR", str(tmp_path / "ours"))
+    assert enable_compilation_cache() == theirs
+
+
+def test_second_subprocess_hits_cache(tmp_path):
+    """The queue property itself: process 1 populates the cache, process
+    2 (identical program) must add NO new entries and compile much
+    faster. File-set stability is the hard assertion (key stability
+    across processes); the time delta is the VERDICT-requested proof the
+    hit path is actually taken."""
+    cache_dir = str(tmp_path / "cache")
+    first = _run_child(cache_dir)
+    assert first["cache_dir"] == cache_dir
+    entries = {
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(cache_dir) for f in fs
+    }
+    assert entries, "first run wrote no cache entries"
+
+    second = _run_child(cache_dir)
+    entries_after = {
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(cache_dir) for f in fs
+    }
+    assert entries_after == entries, (
+        "second process missed the cache (new entries written)"
+    )
+    # generous margin: a real hit skips XLA optimization entirely, which
+    # dominates this deliberately chunky program's compile
+    assert second["compile_s"] < 0.7 * first["compile_s"], (
+        f"no compile-time win: {first['compile_s']:.2f}s -> "
+        f"{second['compile_s']:.2f}s"
+    )

@@ -87,3 +87,156 @@ class TestRunStep:
         _stub(monkeypatch, stdout='{"ok": tru')
         rec = tr.run_step("dispatch_bench")
         assert "malformed" in rec["error"]
+
+
+class TestRecent:
+    def test_append_stamps_and_recent_finds(self, evidence_file):
+        tr.append({"step": "baseline_f32", "value": 17.0})
+        rec = tr._recent("baseline_f32")
+        assert rec["value"] == 17.0 and "t_unix" in rec
+
+    def test_old_record_not_reused(self, evidence_file):
+        import time
+
+        tr.append({"step": "baseline_f32", "value": 17.0,
+                   "t_unix": time.time() - 7 * 3600})
+        assert tr._recent("baseline_f32") is None
+
+    def test_unstamped_pre_tier_record_ignored(self, evidence_file):
+        evidence_file.write_text('{"step": "baseline_f32", "value": 1}\n')
+        assert tr._recent("baseline_f32") is None
+
+    def test_newest_record_wins(self, evidence_file):
+        tr.append({"step": "fused_smoke", "ok": False})
+        tr.append({"step": "fused_smoke", "ok": True})
+        assert tr._recent("fused_smoke")["ok"] is True
+
+    def test_missing_file_is_none(self, evidence_file):
+        assert tr._recent("anything") is None
+
+    def test_cpu_sourced_record_not_reused(self, evidence_file):
+        # a CPU-env invocation (or mid-window fallback) must never become
+        # the RMSE gate or Mosaic verdict for a real TPU window
+        tr.append({"step": "baseline_f32", "rc": 0, "value": 9.0,
+                   "holdout_rmse": 0.53, "device": "TFRT_CPU_0"})
+        tr.append({"step": "fused_smoke", "rc": 0, "ok": True,
+                   "backend": "cpu"})
+        assert tr._recent("baseline_f32") is None
+        assert tr._recent("fused_smoke") is None
+
+
+class TestTiers:
+    """Tier A runs exactly the golden-window records; tier B reuses
+    fresh tier-A records instead of re-spending device time."""
+
+    @pytest.fixture
+    def harness(self, monkeypatch, evidence_file):
+        calls = []
+
+        def fake_bench(step, env, timeout_s=1800):
+            calls.append(("bench", step))
+            rec = {"step": step, "rc": 0, "value": 17.0,
+                   "holdout_rmse": 0.53, "iteration_s": [1.0, 0.4],
+                   "bucketize_stage_s": 2.0}
+            tr.append(dict(rec))
+            return rec
+
+        def fake_step(step, timeout_s=900):
+            calls.append(("step", step))
+            rec = {"step": step, "rc": 0, "ok": True}
+            tr.append(dict(rec))
+            return rec
+
+        monkeypatch.setattr(tr, "run_bench", fake_bench)
+        monkeypatch.setattr(tr, "run_step", fake_step)
+        monkeypatch.setenv("PIO_JAX_CACHE_DIR", "")  # hermetic
+        monkeypatch.delenv("BENCH_SCALE", raising=False)
+        monkeypatch.delenv("BENCH_ITERATIONS", raising=False)
+        import bench
+
+        monkeypatch.setattr(bench, "probe_device", lambda timeout_s: "ok")
+        return calls
+
+    def _main(self, monkeypatch, argv):
+        import sys as _sys
+
+        monkeypatch.setattr(_sys, "argv", ["tpu_revalidate"] + argv)
+        return tr.main()
+
+    def test_tier_a_runs_only_golden_records(self, harness, monkeypatch):
+        rc = self._main(monkeypatch, ["--tier", "a"])
+        assert rc == 0
+        assert harness == [("bench", "baseline_f32"),
+                           ("step", "fused_smoke"),
+                           ("step", "mesh_pallas")]
+
+    def test_tier_b_reuses_fresh_tier_a_records(self, harness, monkeypatch):
+        tr.append({"step": "baseline_f32", "rc": 0, "value": 17.0,
+                   "holdout_rmse": 0.53, "iteration_s": [1.0, 0.4],
+                   "bucketize_stage_s": 2.0, "scale": 1.0,
+                   "iterations": 10})
+        tr.append({"step": "fused_smoke", "rc": 0, "ok": True})
+        tr.append({"step": "mesh_pallas", "rc": 0, "ok": True})
+        rc = self._main(monkeypatch, ["--tier", "b", "--repeats", "1",
+                                      "--skip-loadgen"])
+        assert rc == 0
+        bench_steps = [s for kind, s in harness if kind == "bench"]
+        step_steps = [s for kind, s in harness if kind == "step"]
+        assert "baseline_f32" not in bench_steps
+        assert set(bench_steps) == {"bf16_gather", "sort_gather",
+                                    "bf16_plus_sort", "fused_gather",
+                                    "fused_plus_bf16"}
+        # fused_smoke/mesh_pallas reused from the file, not re-run
+        assert step_steps == ["dispatch_bench", "flash_pallas"]
+
+    def test_tier_b_rejects_config_mismatched_baseline(self, harness,
+                                                       monkeypatch):
+        # a baseline measured at a different scale/iterations must not
+        # become this run's RMSE gate (review finding)
+        tr.append({"step": "baseline_f32", "rc": 0, "value": 17.0,
+                   "holdout_rmse": 0.53, "iteration_s": [1.0, 0.4],
+                   "bucketize_stage_s": 2.0, "scale": 0.01,
+                   "iterations": 10})
+        rc = self._main(monkeypatch, ["--tier", "b", "--repeats", "1",
+                                      "--skip-loadgen"])
+        assert rc == 0
+        bench_steps = [s for kind, s in harness if kind == "bench"]
+        assert bench_steps[0] == "baseline_f32"  # re-measured, not reused
+
+    def test_tier_b_rc1_when_a_step_times_out(self, harness, monkeypatch):
+        # a window that wedges mid-tier-B must NOT report complete: rc=1
+        # keeps the watcher alive for another window (review finding)
+        def timing_out_step(step, timeout_s=900):
+            rec = {"step": step, "rc": -1, "error": "timed out"}
+            tr.append(dict(rec))
+            return rec
+
+        monkeypatch.setattr(tr, "run_step", timing_out_step)
+        rc = self._main(monkeypatch, ["--tier", "b", "--repeats", "1",
+                                      "--skip-loadgen"])
+        assert rc == 1
+
+    def test_failed_tier_a_step_record_not_reused(self, harness,
+                                                  monkeypatch):
+        # tier A's smoke timed out as the window closed; tier B must give
+        # it a fresh chance, not inherit the failure (review finding)
+        tr.append({"step": "baseline_f32", "rc": 0, "value": 17.0,
+                   "holdout_rmse": 0.53, "iteration_s": [1.0, 0.4],
+                   "bucketize_stage_s": 2.0, "scale": 1.0,
+                   "iterations": 10})
+        tr.append({"step": "fused_smoke", "rc": -1, "error": "timed out"})
+        rc = self._main(monkeypatch, ["--tier", "b", "--repeats", "1",
+                                      "--skip-loadgen"])
+        assert rc == 0
+        step_steps = [s for kind, s in harness if kind == "step"]
+        assert "fused_smoke" in step_steps  # re-run, not reused
+
+    def test_tier_b_standalone_runs_baseline_itself(self, harness,
+                                                    monkeypatch):
+        rc = self._main(monkeypatch, ["--tier", "b", "--repeats", "1",
+                                      "--skip-loadgen"])
+        assert rc == 0
+        bench_steps = [s for kind, s in harness if kind == "bench"]
+        assert bench_steps[0] == "baseline_f32"
+        step_steps = [s for kind, s in harness if kind == "step"]
+        assert "fused_smoke" in step_steps and "mesh_pallas" in step_steps
