@@ -428,14 +428,17 @@ def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
 
         copies(s, slot, "wait")
         g = gbuf[slot]  # [kt, r], y's dtype (f32 or bf16 gathers)
-        w = w2_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)  # [kt]
-        rr = rhs_ref[b, pl.ds(t * kt, kt)].astype(g.dtype)
+        # reshape [kt] -> [kt, 1] in f32, THEN cast: Mosaic's layout
+        # inference rejects the 1-D->2-D shape cast on bf16 vectors
+        # (found by deviceless AOT compile of the bf16-gather variant)
+        w = w2_ref[b, pl.ds(t * kt, kt)][:, None].astype(g.dtype)
+        rr = rhs_ref[b, pl.ds(t * kt, kt)][:, None].astype(g.dtype)
         a_acc = a_acc + jax.lax.dot_general(
-            g * w[:, None], g, (((0,), (0,)), ((), ())),
+            g * w, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         b_acc = b_acc + jnp.sum(
-            (g * rr[:, None]).astype(jnp.float32), axis=0
+            (g * rr).astype(jnp.float32), axis=0
         )
 
         is_last_tile = t == k_tiles - 1
@@ -512,7 +515,15 @@ def gramian_fused(
     (their ``idx`` may be any in-range value; 0 by convention) — the
     gathered row is multiplied by zero, so correctness never depends on
     the index padding. ``R`` must be a multiple of 8 (callers pad the rank
-    once, as the solver path already does); B and K are padded here.
+    once, as the solver path already does); B and K are padded here, and R
+    is lane-padded to 128 internally: Mosaic requires DMA slices to be
+    aligned to the 128-lane tiling (discovered by deviceless AOT compile —
+    a 1×56 row copy does not lower), so the kernel streams aligned 1×128
+    rows of a zero-padded table instead. The padded lanes contribute
+    zeros to A and b, and a 56-wide Gramian already occupies one 128×128
+    MXU tile, so the extra lanes cost DMA bytes only: r_pad·4 = 512 B per
+    row vs the XLA path's ~3·r·4 = 672 B at bench rank — a thinner win
+    than the unpadded 224 B, which is what the hardware A/B prices.
 
     ``interpret=None`` auto-selects interpreter off-TPU. No XLA fallback:
     callers opt in explicitly (flag-gated until hardware-validated) and
@@ -559,13 +570,30 @@ def gramian_fused(
         w2 = jnp.pad(w2, ((0, pb), (0, pk)))
         rhs = jnp.pad(rhs, ((0, pb), (0, pk)))
         ridge = jnp.pad(jnp.asarray(ridge, jnp.float32), (0, pb))
+    if y.dtype == jnp.bfloat16:
+        # Per-row DMA floor (deviceless-AOT finding): Mosaic cannot slice
+        # one sublane of a bf16-tiled VMEM buffer, and the minimum
+        # lane-aligned copy is 128 lanes × 32 bits = 512 B — so bf16
+        # CANNOT reduce this kernel's gathered bytes below the f32 path's
+        # 512 B/row. Upcasting is exact and keeps BENCH_GATHER_DTYPE=bf16
+        # composable with BENCH_FUSED_GATHER=1 (the combined leg then
+        # measures the fused kernel at f32 table width, honestly).
+        y = y.astype(jnp.float32)
+    # lane-pad the factor table so every per-row DMA is a tiling-aligned
+    # 1×r_pad copy (see docstring); the zero lanes are inert in A and b
+    r_pad = _round_up(r, 128)
+    if r_pad != r:
+        y = jnp.pad(y, ((0, 0), (0, r_pad - r)))
     if yty is None:
-        yty = jnp.zeros((r, r), jnp.float32)
+        yty = jnp.zeros((r_pad, r_pad), jnp.float32)
+    elif r_pad != r:
+        yty = jnp.pad(jnp.asarray(yty, jnp.float32),
+                      ((0, r_pad - r), (0, r_pad - r)))
     a, bvec = _gramian_fused_call(
         y, idx, w2, rhs, jnp.asarray(ridge, jnp.float32), yty,
         bt, kt, interpret,
     )
-    return a[:b], bvec[:b]
+    return a[:b, :r, :r], bvec[:b, :r]
 
 
 def top_k_for_users_streaming(

@@ -1,0 +1,152 @@
+"""Deviceless Mosaic validation of every Pallas kernel (VERDICT r4 item 3).
+
+``jax.experimental.topologies.get_topology_desc`` builds a compile-only
+TPU topology from libtpu with NO device attached (works with the
+accelerator tunnel down), and ``jit(fn).lower(avals).compile()`` against
+its devices runs the full XLA:TPU + Mosaic pipeline. These tests convert
+the single worst hardware-day risk — a Mosaic lowering error discovered
+mid-window — into an offline check that runs in the ordinary CPU suite.
+
+The argument-format key (the round-4 probe failed here):
+``chips_per_host_bounds`` must be a TUPLE OF INTS, e.g. ``(1, 1, 1)``;
+string forms are rejected by libtpu with a mangled type error.
+
+On landing day this file's compiles found two real bugs in
+``gramian_fused`` that interpret-mode equality testing could not see:
+a 1×56 row-slice DMA violating the 128-lane tiling, and a 1-D→2-D
+shape cast unsupported for bf16 vectors (see ops/pallas_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from predictionio_tpu.ops.attention import flash_attention_pallas
+from predictionio_tpu.ops.pallas_kernels import (
+    gramian_fused,
+    spd_solve_t,
+    top_k_streaming,
+)
+
+
+@pytest.fixture(scope="module")
+def topo1():
+    from jax.experimental import topologies
+
+    try:
+        return topologies.get_topology_desc(
+            "v5e:1x1", "tpu", chips_per_host_bounds=(1, 1, 1)
+        )
+    except Exception as exc:  # no libtpu in this environment
+        pytest.skip(f"deviceless TPU topology unavailable: {exc}")
+
+
+def _sds(topo, shape, dtype):
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.ShapeDtypeStruct(
+        shape, dtype, sharding=SingleDeviceSharding(topo.devices[0])
+    )
+
+
+def _compile(fn, *avals):
+    compiled = jax.jit(fn).lower(*avals).compile()
+    assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+    return compiled
+
+
+class TestMosaicAOT:
+    def test_spd_solve_single_device(self, topo1):
+        _compile(
+            functools.partial(spd_solve_t, interpret=False),
+            _sds(topo1, (56, 56, 512), jnp.float32),
+            _sds(topo1, (56, 512), jnp.float32),
+        )
+
+    def test_spd_solve_under_shard_map(self):
+        # the exact embedding ops/als.py uses under a mesh: per-device
+        # pallas blocks inside shard_map, compiled for a 4-chip slice
+        from jax import shard_map
+        from jax.experimental import topologies
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:
+            topo4 = topologies.get_topology_desc("v5e:2x2", "tpu")
+        except Exception as exc:
+            pytest.skip(f"deviceless TPU topology unavailable: {exc}")
+        mesh = topologies.make_mesh(topo4, (4,), ("data",))
+        ns = NamedSharding(mesh, P("data"))
+        fn = shard_map(
+            functools.partial(spd_solve_t, interpret=False), mesh=mesh,
+            in_specs=(P("data"), P("data")), out_specs=P("data"),
+            check_vma=False,
+        )
+        compiled = jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((4 * 56, 56, 512), jnp.float32, sharding=ns),
+            jax.ShapeDtypeStruct((4 * 56, 512), jnp.float32, sharding=ns),
+        ).compile()
+        assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+
+    @pytest.mark.parametrize(
+        "n,b,k",
+        [
+            (27_000, 4, 8192),   # bench-realistic wide bucket, SMEM cap
+            (300, 32, 512),      # small table (VMEM-resident y)
+            (200, 2, 32_768),    # K-slice split path
+        ],
+    )
+    def test_gramian_fused_f32(self, topo1, n, b, k):
+        _compile(
+            functools.partial(gramian_fused, interpret=False),
+            _sds(topo1, (n, 56), jnp.float32),
+            _sds(topo1, (b, k), jnp.int32),
+            _sds(topo1, (b, k), jnp.float32),
+            _sds(topo1, (b, k), jnp.float32),
+            _sds(topo1, (b,), jnp.float32),
+        )
+
+    def test_gramian_fused_bf16_table(self, topo1):
+        # bf16 tables upcast inside the kernel entry (per-row DMA floor
+        # is 128 lanes × 32 bits); the flag combination must still lower
+        _compile(
+            functools.partial(gramian_fused, interpret=False),
+            _sds(topo1, (27_000, 56), jnp.bfloat16),
+            _sds(topo1, (4, 8192), jnp.int32),
+            _sds(topo1, (4, 8192), jnp.float32),
+            _sds(topo1, (4, 8192), jnp.float32),
+            _sds(topo1, (4,), jnp.float32),
+        )
+
+    def test_flash_attention_forward(self, topo1):
+        _compile(
+            functools.partial(
+                flash_attention_pallas, causal=True, interpret=False
+            ),
+            _sds(topo1, (2, 8, 1024, 64), jnp.float32),
+            _sds(topo1, (2, 8, 1024, 64), jnp.float32),
+            _sds(topo1, (2, 8, 1024, 64), jnp.float32),
+        )
+
+    def test_flash_attention_grad(self, topo1):
+        def loss(q, k, v):
+            return flash_attention_pallas(
+                q, k, v, causal=True, interpret=False
+            ).sum()
+
+        _compile(
+            jax.grad(loss, argnums=(0, 1, 2)),
+            _sds(topo1, (2, 4, 512, 64), jnp.float32),
+            _sds(topo1, (2, 4, 512, 64), jnp.float32),
+            _sds(topo1, (2, 4, 512, 64), jnp.float32),
+        )
+
+    def test_top_k_streaming(self, topo1):
+        _compile(
+            functools.partial(top_k_streaming, k=10, interpret=False),
+            _sds(topo1, (512, 50), jnp.float32),
+            _sds(topo1, (60_000, 50), jnp.float32),
+        )

@@ -267,28 +267,28 @@ class TestGramianFused:
                                    atol=1e-4)
 
     def test_bf16_gathers(self):
-        """bf16 factor table: gathers move half the bytes; accumulation
-        stays f32 (tolerance reflects bf16 input rounding)."""
+        """bf16 factor table: the kernel upcasts it to f32 at entry —
+        the per-row DMA floor is 128 lanes × 32 bits, so bf16 cannot
+        reduce the fused path's gathered bytes (deviceless-AOT finding;
+        see gramian_fused). Result must match the f32 reference computed
+        from the bf16-quantized table exactly up to accumulation order."""
         from predictionio_tpu.ops.pallas_kernels import gramian_fused
 
         y, idx, w2, rhs, ridge = self._data(16, 64, 200, 24, seed=4)
         y_bf = jnp.asarray(y, jnp.bfloat16)
         a, bv = gramian_fused(y_bf, jnp.asarray(idx), jnp.asarray(w2),
                               jnp.asarray(rhs), jnp.asarray(ridge))
-        # reference with the same bf16 input rounding (w2/rhs are cast to
-        # the gather dtype inside the kernel, mirroring the einsum path):
-        # remaining delta is f32 accumulation order only
+        # reference: bf16 quantization applies to the table ONLY; w2/rhs
+        # stay f32 (the kernel upcasts, so g.dtype is f32)
         y_r = np.asarray(y_bf, np.float32)
-        w2_r = np.asarray(jnp.asarray(w2, jnp.bfloat16), np.float32)
-        rhs_r = np.asarray(jnp.asarray(rhs, jnp.bfloat16), np.float32)
-        a_ref = np.einsum("bkr,bk,bks->brs", y_r[idx], w2_r, y_r[idx])
+        a_ref = np.einsum("bkr,bk,bks->brs", y_r[idx], w2, y_r[idx])
         a_ref += ridge[:, None, None] * np.eye(y.shape[1], dtype=np.float32)
-        b_ref = np.einsum("bkr,bk->br", y_r[idx], rhs_r)
+        b_ref = np.einsum("bkr,bk->br", y_r[idx], rhs)
         assert np.asarray(a).dtype == np.float32
-        np.testing.assert_allclose(np.asarray(a), a_ref, rtol=2e-2,
-                                   atol=2e-2)
-        np.testing.assert_allclose(np.asarray(bv), b_ref, rtol=2e-2,
-                                   atol=2e-2)
+        np.testing.assert_allclose(np.asarray(a), a_ref, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bv), b_ref, rtol=1e-4,
+                                   atol=1e-4)
 
     def test_zero_weight_rows_give_ridge_only(self):
         """Bucket-padding rows (all weights 0, ridge 0) must produce an
