@@ -1,0 +1,201 @@
+"""Offline TPU compile of the exact bench/serving programs (deviceless).
+
+Two jobs, one mechanism — ``jit(fn).lower(avals).compile()`` against a
+compile-only v5e topology (``jax.experimental.topologies``; works with
+the accelerator tunnel down):
+
+1. **Full-program validation.** ``tests/test_mosaic_aot.py`` compiles
+   each Pallas kernel in isolation; this tool compiles the WHOLE
+   bench-shape ALS programs (``_als_half`` + ``_als_iteration`` per
+   lever variant, every bucket, real ML-20M-shaped bucketization) and
+   the serving top-k dispatch at the four catalog sizes the queue's
+   ``dispatch_bench`` step measures. A lowering problem anywhere in the
+   real program surfaces here, offline, instead of mid-window.
+
+2. **Cache pre-warming (experimental).** The compiled executables land
+   in the persistent compilation cache (``utils/jax_cache``). If the
+   real chip computes the same cache key as the deviceless topology
+   (same libtpu, same program, same options), the hardware window skips
+   these compiles entirely; if the key differs, the attempt cost
+   nothing from the window. Either way the compile *times* recorded
+   here bound what the window will pay.
+
+Usage::
+
+    python -m predictionio_tpu.tools.prewarm_cache [--scale 1.0]
+        [--variants f32,bf16,fused,fused_bf16]
+
+Sorting (``sort_gather_indices``) permutes values host-side without
+changing shapes, so it shares the f32 variant's program — no separate
+compile exists to warm.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: ALSConfig kwargs per lever variant; solve_mode is "pallas" because
+#: that is what bench's "auto" resolves to on a TPU backend — the
+#: program compiled here must BE the program the chip runs.
+VARIANTS = {
+    "f32": dict(gather_dtype="f32", fused_gather=False),
+    "bf16": dict(gather_dtype="bf16", fused_gather=False),
+    "fused": dict(gather_dtype="f32", fused_gather=True),
+    "fused_bf16": dict(gather_dtype="bf16", fused_gather=True),
+}
+
+DISPATCH_CATALOGS = (2_700, 27_000, 60_000, 120_000)
+
+
+def _stage_avals(side, sh):
+    """Mirror ``ops.als.stage()``'s chunked device layout as
+    ShapeDtypeStructs (same block rounding, padding and uint16 index
+    narrowing — see ``stage()``), without touching any device.
+    ``tests/test_prewarm.py`` asserts this stays shape-identical to the
+    real ``stage()``."""
+    import jax
+
+    from ..ops import als
+
+    buckets = []
+    for bucket in side.buckets:
+        block = als._block_rows_for(bucket.width)
+        n = bucket.rows.shape[0]
+        n_chunks = max(1, (n + block - 1) // block)
+        idx_dtype = als._idx_dtype(side.n_cols)
+        aval = lambda shape, dt: jax.ShapeDtypeStruct(
+            shape, dt, sharding=sh
+        )
+        buckets.append((
+            aval((n_chunks, block), bucket.rows.dtype),
+            aval((n_chunks, block, bucket.width), idx_dtype),
+            aval((n_chunks, block, bucket.width), bucket.val.dtype),
+            aval((n_chunks, block), bucket.counts.dtype),
+        ))
+    return tuple(buckets)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="prewarm_cache")
+    ap.add_argument("--scale", type=float,
+                    default=float(os.environ.get("BENCH_SCALE", "1.0")))
+    ap.add_argument("--rank", type=int, default=50)
+    ap.add_argument("--variants", default="f32,bf16,fused,fused_bf16")
+    ap.add_argument("--skip-dispatch", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ..utils.jax_cache import enable_compilation_cache
+    from ..utils.platform import force_cpu_in_process
+
+    # This tool is ALWAYS offline: every TPU compile goes through the
+    # deviceless topology client, never the default backend. Pinning the
+    # default backend to CPU keeps any stray jnp op (or backend query
+    # during lowering) from initializing a device plugin that would
+    # block forever against a wedged accelerator tunnel.
+    force_cpu_in_process()
+    cache_dir = enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import SingleDeviceSharding
+
+    from ..ops import als
+    from ..ops.pallas_kernels import top_k_streaming
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    # cache the deterministic dataset like the queue does: a tool meant
+    # for cheap offline iteration must not re-pay a minute of host-side
+    # generation per run
+    os.environ.setdefault("BENCH_SYNTH_CACHE", "/tmp/pio-bench-synth")
+
+    t_all = time.monotonic()
+    try:
+        topo = topologies.get_topology_desc(
+            "v5e:1x1", "tpu", chips_per_host_bounds=(1, 1, 1)
+        )
+    except Exception as exc:
+        print(json.dumps({"step": "prewarm_aot",
+                          "error": f"no deviceless TPU topology: {exc}"}))
+        return 1
+    sh = SingleDeviceSharding(topo.devices[0])
+
+    users, items, ratings, n_users, n_items = bench.synth_ml20m(args.scale)
+    rng = np.random.default_rng(1)
+    tr = rng.random(len(ratings)) >= 0.05  # bench's holdout split
+    by_user = als.bucketize(users[tr], items[tr], ratings[tr],
+                            n_users, n_items, pad_to_blocks=True)
+    by_item = als.bucketize(items[tr], users[tr], ratings[tr],
+                            n_items, n_users, pad_to_blocks=True)
+    ub, ib = _stage_avals(by_user, sh), _stage_avals(by_item, sh)
+    rank = args.rank
+    y_aval = jax.ShapeDtypeStruct((n_items, rank), jnp.float32, sharding=sh)
+    x_aval = jax.ShapeDtypeStruct((n_users, rank), jnp.float32, sharding=sh)
+    scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=sh)
+
+    rec = {"step": "prewarm_aot", "scale": args.scale, "rank": rank,
+           "cache_dir": cache_dir, "programs": {}, "failed": []}
+    for name in [v.strip() for v in args.variants.split(",") if v.strip()]:
+        kw = VARIANTS[name]
+        common = dict(rank=rank, implicit=False, solve_mode="pallas",
+                      mesh=None, **kw)
+        for prog, build in (
+            (f"{name}/half_user", lambda: als._als_half.lower(
+                y_aval, ub, scalar, scalar, n_rows=n_users, **common)),
+            (f"{name}/half_item", lambda: als._als_half.lower(
+                x_aval, ib, scalar, scalar, n_rows=n_items, **common)),
+            (f"{name}/iteration", lambda: als._als_iteration.lower(
+                ub, ib, y_aval, scalar, scalar,
+                n_users=n_users, n_items=n_items, **common)),
+        ):
+            t0 = time.monotonic()
+            try:
+                build().compile()
+                rec["programs"][prog] = round(time.monotonic() - t0, 2)
+            except Exception as exc:
+                rec["failed"].append(
+                    {prog: f"{type(exc).__name__}: {str(exc)[:300]}"}
+                )
+            print(f"[prewarm] {prog}: "
+                  f"{rec['programs'].get(prog, 'FAILED')}s",
+                  file=sys.stderr)
+
+    if not args.skip_dispatch:
+        import functools
+
+        q = jax.ShapeDtypeStruct((512, rank), jnp.float32, sharding=sh)
+        for n_cat in DISPATCH_CATALOGS:
+            cat = jax.ShapeDtypeStruct((n_cat, rank), jnp.float32,
+                                       sharding=sh)
+            t0 = time.monotonic()
+            try:
+                jax.jit(functools.partial(
+                    top_k_streaming, k=10, interpret=False
+                )).lower(q, cat).compile()
+                rec["programs"][f"dispatch/{n_cat}"] = round(
+                    time.monotonic() - t0, 2
+                )
+            except Exception as exc:
+                rec["failed"].append(
+                    {f"dispatch/{n_cat}":
+                     f"{type(exc).__name__}: {str(exc)[:300]}"}
+                )
+
+    rec["total_s"] = round(time.monotonic() - t_all, 1)
+    rec["ok"] = not rec["failed"]
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

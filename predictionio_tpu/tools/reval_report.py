@@ -100,6 +100,35 @@ def report(steps: dict) -> str:
         else:
             out.append(f"- **{name}**: FAILED — {rec}")
 
+    rec = steps.get("implicit_gate")
+    if rec is not None:
+        out.append("")
+        out.append("## Implicit-mode quality gate (precision@10)")
+        if "skipped" in rec:
+            out.append(f"- skipped: {rec['skipped']}")
+        elif "error" in rec:
+            out.append(f"- ERROR: {rec['error']}")
+        else:
+            out.append(
+                f"- f32 {rec.get('p10_f32')} vs lever "
+                f"{rec.get('p10_lever')} (Δ {rec.get('delta')}) — "
+                f"gate={rec.get('gate')}, lever={rec.get('lever')}"
+            )
+
+    rec = steps.get("profile_trace")
+    if rec is not None:
+        out.append("")
+        out.append("## Profiler trace (op-level device timings)")
+        if "error" in rec or "parse_error" in rec:
+            out.append(f"- {rec.get('error') or rec.get('parse_error')} "
+                       f"(trace dir: {rec.get('trace_dir')})")
+        else:
+            for plane, data in (rec.get("planes") or {}).items():
+                out.append(f"- **{plane}** total {data.get('total_ms')} ms")
+                for op, ms in list(data.get("top_ops_ms", {}).items())[:8]:
+                    out.append(f"  - {op}: {ms} ms")
+            out.append(f"- full trace: {rec.get('xplane')}")
+
     rec = steps.get("dispatch_bench")
     if rec and "catalogs" in rec:
         out.append("")
@@ -115,7 +144,7 @@ def report(steps: dict) -> str:
     for tag, title in (("", "Serving loadgen — quickstart catalog"),
                        ("_big", "Serving loadgen — 60k-item catalog")):
         rows = []
-        for depth in (1, 2, 4):
+        for depth in (1, 2, 4, 8):
             h = steps.get(f"loadgen_depth{depth}{tag}")
             p = steps.get(f"loadgen_inproc_depth{depth}{tag}")
             if h or p:
@@ -142,9 +171,10 @@ def report(steps: dict) -> str:
         "baseline_f32", "baseline_variance", "bf16_gather", "sort_gather",
         "bf16_plus_sort", "fused_gather", "fused_plus_bf16",
         "fused_smoke", "mesh_pallas", "flash_pallas", "dispatch_bench",
+        "implicit_gate", "profile_trace",
     } | set(repeat_names) | {
         f"loadgen_{kind}depth{d}{t}"
-        for kind in ("", "inproc_") for d in (1, 2, 4) for t in ("", "_big")
+        for kind in ("", "inproc_") for d in (1, 2, 4, 8) for t in ("", "_big")
     } | {f"{n}_gate" for n in ("bf16_gather", "sort_gather",
                                "bf16_plus_sort", "fused_gather",
                                "fused_plus_bf16")}

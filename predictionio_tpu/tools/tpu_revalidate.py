@@ -19,7 +19,7 @@ and lands in one JSON-lines file:
 6. ``BENCH_FUSED_GATHER=1`` — the fused-kernel A/B (only if the smoke
    step passed); RMSE-gated like the others.
 7. With ``--engine-dir <trained engine project>``: serving loadgen over
-   pipeline depth 1/2/4 — HTTP (deploys on the chip per depth) AND
+   pipeline depth 1/2/4/8 — HTTP (deploys on the chip per depth) AND
    in-process (isolates the stack from the wire). Without the flag the
    sweep is skipped with instructions.
 
@@ -129,15 +129,19 @@ def run_bench(step: str, env_extra: dict, timeout_s: float = 1800) -> dict:
     return rec
 
 
-def run_step(step: str, timeout_s: float = 900) -> dict:
+def run_step(step: str, timeout_s: float = 900,
+             env_extra: dict | None = None) -> dict:
     """Run one ``_reval_steps`` subcommand in a subprocess (a tunnel
-    wedge mid-step must be a recorded timeout, not a dead queue)."""
-    log(f"device step {step}")
+    wedge mid-step must be a recorded timeout, not a dead queue).
+    ``env_extra`` overlays the inherited environment — how the
+    implicit-quality gate receives the lever flags under test."""
+    log(f"device step {step}" + (f" env={env_extra}" if env_extra else ""))
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "predictionio_tpu.tools._reval_steps",
              step],
             cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ, **env_extra) if env_extra else None,
         )
     except subprocess.TimeoutExpired:
         rec = {"step": step, "rc": -1,
@@ -166,6 +170,27 @@ def run_step(step: str, timeout_s: float = 900) -> dict:
     return rec
 
 
+def _engine_env(engine_dir: str) -> dict:
+    """Environment for deploy/loadgen children of ``engine_dir``.
+
+    The quickstart/big-engine recipe keeps each demo's storage in a
+    ``storage/`` sibling of the engine project
+    (``examples/movielens_quickstart/run.sh`` exports
+    ``PIO_FS_BASEDIR=$WORK/storage``). The queue inherits neither shell,
+    so without this the deploys come up against the DEFAULT store and die
+    with "No completed engine instance" — discovered by the round-5
+    end-to-end drive, which is exactly how every loadgen sweep would have
+    failed on hardware day. An explicit PIO_FS_BASEDIR in the caller's
+    environment still wins."""
+    env = dict(os.environ)
+    storage = os.path.join(
+        os.path.dirname(os.path.abspath(engine_dir)), "storage"
+    )
+    if "PIO_FS_BASEDIR" not in os.environ and os.path.isdir(storage):
+        env["PIO_FS_BASEDIR"] = storage
+    return env
+
+
 def _free_port() -> int:
     import socket
 
@@ -183,7 +208,8 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
     one subprocess per depth so the device state is fresh each time.
     Returns the step names that errored (for the exit-code roll-up)."""
     failed = []
-    for depth in (1, 2, 4):
+    env = _engine_env(engine_dir)
+    for depth in (1, 2, 4, 8):
         log(f"in-process loadgen: depth={depth}")
         try:
             proc = subprocess.run(
@@ -193,6 +219,7 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
                  "--concurrency", str(concurrency),
                  "--duration", str(duration_s)],
                 cwd=REPO, capture_output=True, text=True, timeout=600,
+                env=env,
             )
         except subprocess.TimeoutExpired:
             step = f"loadgen_inproc_depth{depth}{tag}"
@@ -227,15 +254,16 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
     import urllib.request
 
     failed = []
+    env = _engine_env(engine_dir)
     pio = os.path.join(REPO, "bin", "pio")
-    for depth in (1, 2, 4):
+    for depth in (1, 2, 4, 8):
         port = _free_port()
         log(f"loadgen sweep: deploying depth={depth} on :{port}")
         rc = subprocess.run(
             [pio, "deploy", "--engine-dir", engine_dir,
              "--port", str(port), "--batch-pipeline-depth", str(depth),
              "--spawn"],
-            cwd=engine_dir, capture_output=True, text=True,
+            cwd=engine_dir, capture_output=True, text=True, env=env,
         ).returncode
         if rc != 0:
             append({"step": f"loadgen_depth{depth}{tag}",
@@ -469,17 +497,48 @@ def main() -> int:
     step_once("mesh_pallas")
     _track(run_step("dispatch_bench"))
     _track(run_step("flash_pallas"))
+    # real profiler trace of the two hot paths: op-level device timings
+    # for the HBM-utilization story (summary lands in the evidence file,
+    # full trace stays under PIO_PROFILE_DIR for TensorBoard)
+    _track(run_step("profile_trace", timeout_s=1200))
+    fused = None
     if fused_smoke.get("ok"):
         fused = gated("fused_gather", {"BENCH_FUSED_GATHER": "1"})
         if fused.get("rmse_gate") == "pass" and bf16.get("rmse_gate") == "pass":
-            # the two traffic levers stack: bf16 halves every gathered
-            # byte the fused kernel streams
+            # composability check, NOT a byte saving: the fused kernel
+            # upcasts bf16 tables (per-row DMA floor is 128 lanes × 32
+            # bits — see gramian_fused), so this leg measures fused at
+            # f32 table width with bf16 gathers everywhere else
             gated("fused_plus_bf16",
                   {"BENCH_FUSED_GATHER": "1", "BENCH_GATHER_DTYPE": "bf16"})
     else:
         append({"step": "fused_gather", "skipped":
                 "fused_smoke failed or did not run — Mosaic lowering "
                 "unvalidated, full-scale A/B withheld"})
+
+    # Implicit-mode quality gate (VERDICT r4 item 5): levers that passed
+    # the EXPLICIT RMSE gate must also clear a ranking-metric gate on the
+    # implicit path before any default flip — explicit evidence alone
+    # cannot certify Hu-Koren confidence weighting.
+    # BENCH_GATHER_DTYPE is ALWAYS explicit here: the step's standalone
+    # default is bf16, which must not leak in when bf16 just FAILED its
+    # explicit gate and only sort/fused are under certification
+    lever_env = {
+        "BENCH_GATHER_DTYPE":
+            "bf16" if bf16.get("rmse_gate") == "pass" else "f32",
+    }
+    if srt.get("rmse_gate") == "pass":
+        lever_env["BENCH_SORT_GATHER"] = "1"
+    if fused is not None and fused.get("rmse_gate") == "pass":
+        lever_env["BENCH_FUSED_GATHER"] = "1"
+    if (lever_env["BENCH_GATHER_DTYPE"] == "bf16"
+            or len(lever_env) > 1):
+        _track(run_step("implicit_gate", timeout_s=1800,
+                        env_extra=lever_env))
+    else:
+        append({"step": "implicit_gate", "skipped":
+                "no lever passed the explicit RMSE gate; nothing to "
+                "certify for implicit mode"})
 
     if args.skip_loadgen:
         pass

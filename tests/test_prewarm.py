@@ -1,0 +1,50 @@
+"""The prewarm tool's aval mirror must match the real ``stage()``.
+
+``prewarm_cache._stage_avals`` reproduces ``ops.als.stage()``'s chunked
+device layout (block rounding, padding, uint16 index narrowing) as
+ShapeDtypeStructs so programs can be AOT-compiled without a device. If
+the two ever drift, the prewarmed programs are not the programs the
+bench runs — the cache warms the wrong keys and the offline validation
+validates the wrong shapes. This test pins them together.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.tools.prewarm_cache import _stage_avals
+
+
+def test_stage_avals_match_real_stage():
+    rng = np.random.default_rng(3)
+    nnz, n_u, n_i = 50_000, 3_000, 700
+    w = 1.0 / np.arange(1, n_u + 1) ** 0.8
+    u = rng.choice(n_u, size=nnz, p=w / w.sum()).astype(np.int64)
+    i = rng.integers(0, n_i, nnz).astype(np.int64)
+    v = rng.integers(1, 6, nnz).astype(np.float32)
+
+    side = als.bucketize(u, i, v, n_u, n_i, pad_to_blocks=True)
+    staged = als.stage(side)
+    avals = _stage_avals(side, None)
+
+    real = als._bucket_tensors(staged)
+    assert len(avals) == len(real)
+    for got, want in zip(avals, real):
+        for g, wt in zip(got, want):
+            assert g.shape == wt.shape, (g.shape, wt.shape)
+            assert g.dtype == wt.dtype, (g.dtype, wt.dtype)
+
+
+def test_stage_avals_uint16_narrowing():
+    # few columns -> stage() narrows idx to uint16; the mirror must too
+    rng = np.random.default_rng(4)
+    u = rng.integers(0, 500, 5_000).astype(np.int64)
+    i = rng.integers(0, 100, 5_000).astype(np.int64)
+    v = np.ones(5_000, np.float32)
+    side = als.bucketize(u, i, v, 500, 100, pad_to_blocks=True)
+    staged = als.stage(side)
+    avals = _stage_avals(side, None)
+    for got, want in zip(avals, als._bucket_tensors(staged)):
+        assert got[1].dtype == np.asarray(want[1]).dtype == np.uint16

@@ -141,9 +141,11 @@ class TestTiers:
             tr.append(dict(rec))
             return rec
 
-        def fake_step(step, timeout_s=900):
+        def fake_step(step, timeout_s=900, env_extra=None):
             calls.append(("step", step))
             rec = {"step": step, "rc": 0, "ok": True}
+            if env_extra:
+                rec["lever"] = dict(env_extra)
             tr.append(dict(rec))
             return rec
 
@@ -186,8 +188,10 @@ class TestTiers:
         assert set(bench_steps) == {"bf16_gather", "sort_gather",
                                     "bf16_plus_sort", "fused_gather",
                                     "fused_plus_bf16"}
-        # fused_smoke/mesh_pallas reused from the file, not re-run
-        assert step_steps == ["dispatch_bench", "flash_pallas"]
+        # fused_smoke/mesh_pallas reused from the file, not re-run;
+        # implicit_gate runs because bf16+sort passed their explicit gates
+        assert step_steps == ["dispatch_bench", "flash_pallas",
+                              "profile_trace", "implicit_gate"]
 
     def test_tier_b_rejects_config_mismatched_baseline(self, harness,
                                                        monkeypatch):
@@ -206,7 +210,7 @@ class TestTiers:
     def test_tier_b_rc1_when_a_step_times_out(self, harness, monkeypatch):
         # a window that wedges mid-tier-B must NOT report complete: rc=1
         # keeps the watcher alive for another window (review finding)
-        def timing_out_step(step, timeout_s=900):
+        def timing_out_step(step, timeout_s=900, env_extra=None):
             rec = {"step": step, "rc": -1, "error": "timed out"}
             tr.append(dict(rec))
             return rec
