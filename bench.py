@@ -207,6 +207,14 @@ def synth_ml20m(scale: float, seed: int = 0):
     return users, items, ratings, n_users, n_items
 
 
+def holdout_mask(nnz: int) -> np.ndarray:
+    """The bench's holdout split (5%, fixed seed). Shared with
+    ``tools/prewarm_cache`` so the AOT-compiled programs keep the EXACT
+    bench bucket shapes — any change here changes the compiled program
+    and must flow to both users."""
+    return np.random.default_rng(1).random(nnz) < 0.05
+
+
 def run_bench(scale: float, iterations: int, fallback: str) -> int:
     import jax
 
@@ -222,8 +230,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     nnz = len(ratings)
 
     # holdout split for the quality gate
-    rng = np.random.default_rng(1)
-    test = rng.random(nnz) < 0.05
+    test = holdout_mask(nnz)
     tr = ~test
 
     solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")

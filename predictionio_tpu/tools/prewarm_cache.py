@@ -55,12 +55,12 @@ VARIANTS = {
 DISPATCH_CATALOGS = (2_700, 27_000, 60_000, 120_000)
 
 
-def _stage_avals(side, sh):
+def _stage_avals(side, sh, row_multiple: int = 1):
     """Mirror ``ops.als.stage()``'s chunked device layout as
-    ShapeDtypeStructs (same block rounding, padding and uint16 index
-    narrowing — see ``stage()``), without touching any device.
-    ``tests/test_prewarm.py`` asserts this stays shape-identical to the
-    real ``stage()``."""
+    ShapeDtypeStructs (same block rounding — including the mesh
+    ``row_multiple`` round-up — padding and uint16 index narrowing; see
+    ``stage()``), without touching any device. ``tests/test_prewarm.py``
+    asserts this stays shape-identical to the real ``stage()``."""
     import jax
 
     from ..ops import als
@@ -68,6 +68,10 @@ def _stage_avals(side, sh):
     buckets = []
     for bucket in side.buckets:
         block = als._block_rows_for(bucket.width)
+        if row_multiple > 1:
+            block = (
+                (block + row_multiple - 1) // row_multiple
+            ) * row_multiple
         n = bucket.rows.shape[0]
         n_chunks = max(1, (n + block - 1) // block)
         idx_dtype = als._idx_dtype(side.n_cols)
@@ -105,11 +109,11 @@ def main(argv=None) -> int:
 
     import jax
     import jax.numpy as jnp
-    from jax.experimental import topologies
     from jax.sharding import SingleDeviceSharding
 
     from ..ops import als
     from ..ops.pallas_kernels import top_k_streaming
+    from ..utils.topology import get_deviceless_topology
 
     sys.path.insert(0, REPO)
     import bench
@@ -121,8 +125,11 @@ def main(argv=None) -> int:
 
     t_all = time.monotonic()
     try:
-        topo = topologies.get_topology_desc(
-            "v5e:1x1", "tpu", chips_per_host_bounds=(1, 1, 1)
+        # generous retry: a watcher probe or test session holding the
+        # libtpu lockfile must delay this tool, not abort it
+        topo = get_deviceless_topology(
+            "v5e:1x1", retries=5, retry_delay_s=20.0,
+            chips_per_host_bounds=(1, 1, 1),
         )
     except Exception as exc:
         print(json.dumps({"step": "prewarm_aot",
@@ -131,8 +138,7 @@ def main(argv=None) -> int:
     sh = SingleDeviceSharding(topo.devices[0])
 
     users, items, ratings, n_users, n_items = bench.synth_ml20m(args.scale)
-    rng = np.random.default_rng(1)
-    tr = rng.random(len(ratings)) >= 0.05  # bench's holdout split
+    tr = ~bench.holdout_mask(len(ratings))  # the bench's exact split
     by_user = als.bucketize(users[tr], items[tr], ratings[tr],
                             n_users, n_items, pad_to_blocks=True)
     by_item = als.bucketize(items[tr], users[tr], ratings[tr],

@@ -34,24 +34,15 @@ from predictionio_tpu.ops.pallas_kernels import (
 
 
 def _topology(name: str, **kwargs):
-    """Topology with one retry: libtpu holds a machine-wide lockfile
-    during plugin init, so a concurrent process (the tunnel watcher's
-    probe, a prewarm run) makes the first attempt fail transiently."""
-    import time
+    """Deviceless topology or skip — the lockfile retry lives in the
+    shared helper (a concurrent watcher probe or prewarm run holds
+    libtpu's machine-wide lockfile transiently)."""
+    from predictionio_tpu.utils.topology import get_deviceless_topology
 
-    from jax.experimental import topologies
-
-    last = None
-    for attempt in (1, 2):
-        try:
-            return topologies.get_topology_desc(name, "tpu", **kwargs)
-        except Exception as exc:  # no libtpu, or lockfile contention
-            last = exc
-            if "lockfile" in str(exc) and attempt == 1:
-                time.sleep(10)
-                continue
-            break
-    pytest.skip(f"deviceless TPU topology unavailable: {last}")
+    try:
+        return get_deviceless_topology(name, **kwargs)
+    except Exception as exc:  # no libtpu, or sustained contention
+        pytest.skip(f"deviceless TPU topology unavailable: {exc}")
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,120 @@
+"""Deviceless AOT compile of the MULTI-CHIP programs for real v5e
+topologies.
+
+``__graft_entry__.dryrun_multichip`` proves the sharded programs execute
+on a virtual CPU mesh; these tests close the other half of the claim:
+the same programs COMPILE for actual TPU hardware topologies — XLA
+collectives over ICI, Mosaic kernels embedded per-device via shard_map —
+using compile-only v5e topologies (2×2 for the distributed-ALS mesh,
+2×4 for the 8-way sequence-parallel ring). No device or tunnel needed;
+see tests/test_mosaic_aot.py for the single-chip kernel equivalents.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import als
+from predictionio_tpu.ops.attention import ring_attention, ulysses_attention
+from predictionio_tpu.tools.prewarm_cache import _stage_avals
+
+from tests.test_mosaic_aot import _topology
+
+
+def _mesh(topo_name, shape, names):
+    from jax.experimental import topologies
+
+    return topologies.make_mesh(_topology(topo_name), shape, names)
+
+
+class TestDistributedALSCompile:
+    """One full sharded ALS iteration on a data×model v5e 2×2 mesh —
+    solve rows over ``data``, factor tables over ``model`` (the
+    production distributed path of ``ops/als.py:als_train``)."""
+
+    @pytest.fixture(scope="class")
+    def problem(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh("v5e:2x2", (2, 2), ("data", "model"))
+        rng = np.random.default_rng(0)
+        rows_u, rows_i, nnz = 64, 32, 2048
+        u = rng.integers(0, rows_u, nnz)
+        i = rng.integers(0, rows_i, nnz)
+        v = rng.normal(3.5, 1.0, nnz).astype(np.float32)
+        bu = als.bucketize(u, i, v, rows_u, rows_i, pad_to_blocks=True)
+        bi = als.bucketize(i, u, v, rows_i, rows_u, pad_to_blocks=True)
+        row_sh = NamedSharding(mesh, P(None, "data"))
+        tbl = NamedSharding(mesh, P("model"))
+        return dict(
+            mesh=mesh,
+            tbl=tbl,
+            ub=_stage_avals(bu, row_sh, row_multiple=2),
+            ib=_stage_avals(bi, row_sh, row_multiple=2),
+            y=jax.ShapeDtypeStruct((rows_i, 8), jnp.float32, sharding=tbl),
+            s=jax.ShapeDtypeStruct((), jnp.float32,
+                                   sharding=NamedSharding(mesh, P())),
+            rows=(rows_u, rows_i),
+        )
+
+    @pytest.mark.parametrize(
+        "solve_mode,fused",
+        [("chunked", False), ("pallas", False), ("pallas", True)],
+        ids=["xla-collectives", "pallas-shard_map", "fused-shard_map"],
+    )
+    def test_sharded_iteration_compiles(self, problem, solve_mode, fused):
+        rows_u, rows_i = problem["rows"]
+        it = als._als_iteration_sharded(problem["tbl"])
+        compiled = it.lower(
+            problem["ub"], problem["ib"], problem["y"],
+            problem["s"], problem["s"],
+            n_users=rows_u, n_items=rows_i, rank=8, implicit=False,
+            solve_mode=solve_mode, gather_dtype="f32",
+            mesh=problem["mesh"] if solve_mode == "pallas" else None,
+            fused_gather=fused,
+        ).compile()
+        assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+
+
+class TestSequenceParallelCompile:
+    """Ring and Ulysses attention — forward and gradient — over an
+    8-chip ``seq`` axis (v5e 2×4): ppermute / all-to-all ride ICI."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh("v5e:2x4", (8,), ("seq",))
+        sh = NamedSharding(mesh, P(None, None, "seq", None))
+        av = jax.ShapeDtypeStruct((2, 8, 8 * 512, 64), jnp.float32,
+                                  sharding=sh)
+        return mesh, av
+
+    @pytest.mark.parametrize("impl", [ring_attention, ulysses_attention],
+                             ids=["ring", "ulysses"])
+    def test_forward_compiles(self, setup, impl):
+        mesh, av = setup
+        f = functools.partial(impl, mesh=mesh, causal=True)
+        compiled = jax.jit(
+            lambda q, k, v: f(q, k, v)
+        ).lower(av, av, av).compile()
+        assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+
+    @pytest.mark.parametrize("impl", [ring_attention, ulysses_attention],
+                             ids=["ring", "ulysses"])
+    def test_grad_compiles(self, setup, impl):
+        mesh, av = setup
+
+        def loss(q, k, v):
+            return impl(
+                q, k, v, mesh=mesh, causal=True
+            ).astype(jnp.float32).sum()
+
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+            av, av, av
+        ).compile()

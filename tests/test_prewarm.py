@@ -36,6 +36,14 @@ def test_stage_avals_match_real_stage():
             assert g.shape == wt.shape, (g.shape, wt.shape)
             assert g.dtype == wt.dtype, (g.dtype, wt.dtype)
 
+    # mesh layout: the data-axis row_multiple round-up must match too
+    # (used by the multichip AOT compiles)
+    staged2 = als.stage(side, row_multiple=4)
+    avals2 = _stage_avals(side, None, row_multiple=4)
+    for got, want in zip(avals2, als._bucket_tensors(staged2)):
+        for g, wt in zip(got, want):
+            assert g.shape == wt.shape, (g.shape, wt.shape)
+
 
 def test_stage_avals_uint16_narrowing():
     # few columns -> stage() narrows idx to uint16; the mirror must too
