@@ -55,6 +55,40 @@ VARIANTS = {
 DISPATCH_CATALOGS = (2_700, 27_000, 60_000, 120_000)
 
 
+def _memory_record(compiled) -> dict:
+    """XLA's OWN numbers for the compiled program — upgrades the
+    hand-computed HBM accounting in PERF.md to compiler-reported data:
+    ``temp_gb`` is the peak scratch the program actually allocates
+    (does the [B, K, R] gathered intermediate materialize?), and
+    ``bytes_accessed_gb``/``flops`` come from the compiler's cost model
+    when it exposes one. Fully best-effort: an analysis gap must never
+    turn a successful (cache-populating) compile into a failure."""
+    rec: dict = {}
+    try:
+        m = compiled.memory_analysis()
+        rec = {
+            "arg_gb": round(m.argument_size_in_bytes / 1e9, 3),
+            "out_gb": round(m.output_size_in_bytes / 1e9, 3),
+            "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
+            "code_mb": round(m.generated_code_size_in_bytes / 1e6, 2),
+        }
+    except Exception:
+        pass
+    try:
+        costs = compiled.cost_analysis()
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else {}
+        if costs.get("bytes accessed") is not None:
+            rec["bytes_accessed_gb"] = round(
+                costs["bytes accessed"] / 1e9, 3
+            )
+        if costs.get("flops") is not None:
+            rec["gflops"] = round(costs["flops"] / 1e9, 2)
+    except Exception:
+        pass  # not all backends expose a cost model
+    return rec
+
+
 def _stage_avals(side, sh, row_multiple: int = 1):
     """Mirror ``ops.als.stage()``'s chunked device layout as
     ShapeDtypeStructs (same block rounding — including the mesh
@@ -150,7 +184,8 @@ def main(argv=None) -> int:
     scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=sh)
 
     rec = {"step": "prewarm_aot", "scale": args.scale, "rank": rank,
-           "cache_dir": cache_dir, "programs": {}, "failed": []}
+           "cache_dir": cache_dir, "programs": {}, "memory": {},
+           "failed": []}
     for name in [v.strip() for v in args.variants.split(",") if v.strip()]:
         kw = VARIANTS[name]
         common = dict(rank=rank, implicit=False, solve_mode="pallas",
@@ -166,14 +201,16 @@ def main(argv=None) -> int:
         ):
             t0 = time.monotonic()
             try:
-                build().compile()
+                compiled = build().compile()
                 rec["programs"][prog] = round(time.monotonic() - t0, 2)
+                rec["memory"][prog] = _memory_record(compiled)
             except Exception as exc:
                 rec["failed"].append(
                     {prog: f"{type(exc).__name__}: {str(exc)[:300]}"}
                 )
             print(f"[prewarm] {prog}: "
-                  f"{rec['programs'].get(prog, 'FAILED')}s",
+                  f"{rec['programs'].get(prog, 'FAILED')}s "
+                  f"{rec['memory'].get(prog, '')}",
                   file=sys.stderr)
 
     if not args.skip_dispatch:
@@ -185,11 +222,14 @@ def main(argv=None) -> int:
                                        sharding=sh)
             t0 = time.monotonic()
             try:
-                jax.jit(functools.partial(
+                compiled = jax.jit(functools.partial(
                     top_k_streaming, k=10, interpret=False
                 )).lower(q, cat).compile()
                 rec["programs"][f"dispatch/{n_cat}"] = round(
                     time.monotonic() - t0, 2
+                )
+                rec["memory"][f"dispatch/{n_cat}"] = _memory_record(
+                    compiled
                 )
             except Exception as exc:
                 rec["failed"].append(
