@@ -24,7 +24,7 @@ from predictionio_tpu.ops.attention import ring_attention, ulysses_attention
 from predictionio_tpu.tools.prewarm_cache import _stage_avals
 
 
-def _mesh(topo_name, shape, names):
+def _mesh(topo_name, shape, names, **topo_kwargs):
     # skip-wrapper duplicated from test_mosaic_aot rather than imported:
     # cross-importing a test module double-executes it under two module
     # identities (tests/ is a namespace package)
@@ -33,7 +33,7 @@ def _mesh(topo_name, shape, names):
     from predictionio_tpu.utils.topology import get_deviceless_topology
 
     try:
-        topo = get_deviceless_topology(topo_name)
+        topo = get_deviceless_topology(topo_name, **topo_kwargs)
     except Exception as exc:
         pytest.skip(f"deviceless TPU topology unavailable: {exc}")
     return topologies.make_mesh(topo, shape, names)
@@ -86,6 +86,59 @@ class TestDistributedALSCompile:
             fused_gather=fused,
         ).compile()
         assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+
+
+class TestMultiSliceCompile:
+    """The multi-HOST analogue: programs spanning TWO v5e slices (4
+    chips each), where cross-slice collectives ride DCN and intra-slice
+    ones ride ICI — the reference's NCCL/MPI-backend scaling story
+    (SURVEY §2.8 collective-communication row), compiled for real
+    topology. ``num_slices`` builds the deviceless 2-slice system."""
+
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        mesh = _mesh("v5e:2x2", (8,), ("data",), num_slices=2)
+        slices = {getattr(d, "slice_index", 0) for d in
+                  mesh.devices.flat}
+        assert slices == {0, 1}, slices
+        return mesh
+
+    def test_als_data_parallel_across_slices(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rng = np.random.default_rng(1)
+        rows_u, rows_i, nnz = 128, 64, 4096
+        u = rng.integers(0, rows_u, nnz)
+        i = rng.integers(0, rows_i, nnz)
+        v = rng.normal(3.5, 1.0, nnz).astype(np.float32)
+        bu = als.bucketize(u, i, v, rows_u, rows_i, pad_to_blocks=True)
+        bi = als.bucketize(i, u, v, rows_i, rows_u, pad_to_blocks=True)
+        row_sh = NamedSharding(mesh8, P(None, "data"))
+        rep = NamedSharding(mesh8, P())
+        it = als._als_iteration_sharded(rep)
+        compiled = it.lower(
+            _stage_avals(bu, row_sh, row_multiple=8),
+            _stage_avals(bi, row_sh, row_multiple=8),
+            jax.ShapeDtypeStruct((rows_i, 8), jnp.float32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
+            n_users=rows_u, n_items=rows_i, rank=8, implicit=False,
+            solve_mode="chunked", gather_dtype="f32", mesh=None,
+            fused_gather=False,
+        ).compile()
+        assert compiled.memory_analysis().generated_code_size_in_bytes > 0
+
+    def test_ring_attention_across_slices(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(mesh8, P(None, None, "data", None))
+        av = jax.ShapeDtypeStruct((1, 4, 8 * 256, 32), jnp.float32,
+                                  sharding=sh)
+        jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, mesh=mesh8, axis="data", causal=True
+            )
+        ).lower(av, av, av).compile()
 
 
 class TestSequenceParallelCompile:
