@@ -427,7 +427,7 @@ def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
             copies(s + 1, (s + 1) % 2, "start")
 
         copies(s, slot, "wait")
-        g = gbuf[slot]  # [kt, r], y's dtype (f32 or bf16 gathers)
+        g = gbuf[slot]  # [kt, r] f32 (bf16 tables upcast at kernel entry)
         # reshape [kt] -> [kt, 1] in f32, THEN cast: Mosaic's layout
         # inference rejects the 1-D->2-D shape cast on bf16 vectors
         # (found by deviceless AOT compile of the bf16-gather variant)

@@ -210,6 +210,7 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
     failed = []
     env = _engine_env(engine_dir)
     for depth in (1, 2, 4, 8):
+        step = f"loadgen_inproc_depth{depth}{tag}"
         log(f"in-process loadgen: depth={depth}")
         try:
             proc = subprocess.run(
@@ -222,7 +223,6 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
                 env=env,
             )
         except subprocess.TimeoutExpired:
-            step = f"loadgen_inproc_depth{depth}{tag}"
             append({"step": step,
                     "error": "timed out (tunnel wedge mid-run?)"})
             failed.append(step)
@@ -237,11 +237,11 @@ def run_inprocess_sweep(engine_dir: str, duration_s: float,
         if rec is None:
             tail = proc.stderr.strip().splitlines()
             rec = {"error": tail[-1] if tail else "no JSON"}
-        rec["step"] = f"loadgen_inproc_depth{depth}{tag}"
+        rec["step"] = step
         rec["rc"] = proc.returncode
         append(rec)
         if proc.returncode != 0 or "error" in rec:
-            failed.append(rec["step"])
+            failed.append(step)
         log(f"  -> depth {depth}: qps={rec.get('qps')} "
             f"p99={rec.get('p99_ms')}ms errors={rec.get('errors')}")
     return failed
@@ -257,6 +257,7 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
     env = _engine_env(engine_dir)
     pio = os.path.join(REPO, "bin", "pio")
     for depth in (1, 2, 4, 8):
+        step = f"loadgen_depth{depth}{tag}"
         port = _free_port()
         log(f"loadgen sweep: deploying depth={depth} on :{port}")
         rc = subprocess.run(
@@ -266,9 +267,8 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
             cwd=engine_dir, capture_output=True, text=True, env=env,
         ).returncode
         if rc != 0:
-            append({"step": f"loadgen_depth{depth}{tag}",
-                    "error": f"deploy failed rc={rc}"})
-            failed.append(f"loadgen_depth{depth}{tag}")
+            append({"step": step, "error": f"deploy failed rc={rc}"})
+            failed.append(step)
             continue
         up = False
         for _ in range(60):
@@ -282,9 +282,8 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
                 time.sleep(1)
         try:
             if not up:
-                append({"step": f"loadgen_depth{depth}{tag}",
-                        "error": "server never came up"})
-                failed.append(f"loadgen_depth{depth}{tag}")
+                append({"step": step, "error": "server never came up"})
+                failed.append(step)
                 continue
             time.sleep(3)  # let the first-query compile settle
             proc = subprocess.run(
@@ -304,16 +303,15 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
                 )
             except ValueError:
                 rec = {"error": f"malformed JSON: {lines[-1][:120]!r}"}
-            rec["step"] = f"loadgen_depth{depth}{tag}"
+            rec["step"] = step
             append(rec)
             if "error" in rec:
-                failed.append(rec["step"])
+                failed.append(step)
             log(f"  -> depth {depth}: qps={rec.get('qps')} "
                 f"p99={rec.get('p99_ms')}ms errors={rec.get('errors')}")
         except subprocess.TimeoutExpired:
-            append({"step": f"loadgen_depth{depth}{tag}",
-                    "error": "loadgen timed out"})
-            failed.append(f"loadgen_depth{depth}{tag}")
+            append({"step": step, "error": "loadgen timed out"})
+            failed.append(step)
         finally:
             subprocess.run(
                 [pio, "undeploy", "--port", str(port)],
@@ -430,13 +428,11 @@ def main() -> int:
         # sweeps are exactly what a short window cannot afford. A step
         # that timed out/errored makes tier A rc=1: the watcher must NOT
         # launch tier B into a tunnel that just wedged mid-step.
-        bad = [
-            rec["step"]
-            for rec in (run_step("fused_smoke"), run_step("mesh_pallas"))
-            if rec.get("rc") != 0 or "error" in rec
-        ]
-        if bad:
-            log(f"tier A done with FAILED steps {bad}; evidence in {OUT}")
+        _track(run_step("fused_smoke"))
+        _track(run_step("mesh_pallas"))
+        if failures:
+            log(f"tier A done with FAILED steps {failures}; "
+                f"evidence in {OUT}")
             return 1
         log(f"tier A complete; evidence in {OUT}")
         return 0
@@ -520,21 +516,21 @@ def main() -> int:
     # the EXPLICIT RMSE gate must also clear a ranking-metric gate on the
     # implicit path before any default flip — explicit evidence alone
     # cannot certify Hu-Koren confidence weighting.
-    # BENCH_GATHER_DTYPE is ALWAYS explicit here: the step's standalone
-    # default is bf16, which must not leak in when bf16 just FAILED its
-    # explicit gate and only sort/fused are under certification
-    lever_env = {
-        "BENCH_GATHER_DTYPE":
-            "bf16" if bf16.get("rmse_gate") == "pass" else "f32",
-    }
+    passed_levers = {}
+    if bf16.get("rmse_gate") == "pass":
+        passed_levers["BENCH_GATHER_DTYPE"] = "bf16"
     if srt.get("rmse_gate") == "pass":
-        lever_env["BENCH_SORT_GATHER"] = "1"
+        passed_levers["BENCH_SORT_GATHER"] = "1"
     if fused is not None and fused.get("rmse_gate") == "pass":
-        lever_env["BENCH_FUSED_GATHER"] = "1"
-    if (lever_env["BENCH_GATHER_DTYPE"] == "bf16"
-            or len(lever_env) > 1):
-        _track(run_step("implicit_gate", timeout_s=1800,
-                        env_extra=lever_env))
+        passed_levers["BENCH_FUSED_GATHER"] = "1"
+    if passed_levers:
+        # gather dtype is ALWAYS explicit: the step's standalone default
+        # is bf16, which must not leak in when bf16 just FAILED its gate
+        # and only sort/fused are under certification
+        _track(run_step(
+            "implicit_gate", timeout_s=1800,
+            env_extra={"BENCH_GATHER_DTYPE": "f32", **passed_levers},
+        ))
     else:
         append({"step": "implicit_gate", "skipped":
                 "no lever passed the explicit RMSE gate; nothing to "
