@@ -39,10 +39,6 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-#: exclusion-compare chunk width inside the kernel (VMEM tile [B, T, C])
-_EXCL_CHUNK = 16
-
-
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -88,17 +84,20 @@ def _topk_kernel(q_ref, items_ref, excl_ref, out_s_ref, out_i_ref, *,
     )
     scores = jnp.where(gidx < n_items, scores, _NEG_INF)
     if n_excl:
-        # Exclusions in fixed-size chunks via fori_loop: program size stays
-        # O(1) in the exclusion-list width (the wrapper pads E to a multiple
-        # of the chunk); [B, T, C] compare tiles stay small in VMEM.
-        chunk = min(_EXCL_CHUNK, n_excl)
-
-        def body(i, sc):
-            ex = excl_ref[:, pl.ds(i * chunk, chunk)]  # [B, C]
-            hit = (gidx[:, :, None] == ex[:, None, :]).any(axis=-1)
+        # One excluded id per fori_loop step: the buffer arrives
+        # TRANSPOSED as [E, B], so each step reads one sublane row
+        # (leading-dim index — always lowerable) and masks with a single
+        # 2-D compare. Mosaic rejects lane-dim slices at unaligned
+        # offsets and compiles 3-D broadcast compares pathologically
+        # slowly (both deviceless-AOT findings), so the earlier
+        # [B, T, C]-chunked formulation is gone; total compare work is
+        # identical (E × [B, T]).
+        def body(e, sc):
+            ex = excl_ref[e]  # [B]
+            hit = gidx == ex[:, None]  # [B, T]
             return jnp.where(hit, _NEG_INF, sc)
 
-        scores = jax.lax.fori_loop(0, n_excl // chunk, body, scores)
+        scores = jax.lax.fori_loop(0, n_excl, body, scores)
 
     cand_s = jnp.concatenate([out_s_ref[:], scores], axis=1)
     cand_i = jnp.concatenate([out_i_ref[:], gidx], axis=1)
@@ -129,7 +128,7 @@ def _topk_streaming_call(query_vectors, item_factors, exclude_idx, k,
         in_specs=[
             pl.BlockSpec((b, r), lambda j: (0, 0)),
             pl.BlockSpec((block_items, r), lambda j: (j, 0)),
-            pl.BlockSpec((b, exclude_idx.shape[1]), lambda j: (0, 0)),
+            pl.BlockSpec(exclude_idx.shape, lambda j: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((b, k), lambda j: (0, 0)),
@@ -208,19 +207,20 @@ def top_k_streaming(
         jnp.asarray(item_factors, jnp.float32), ((0, 0), (0, r_pad - r))
     )
     if exclude_idx is None or exclude_idx.shape[1] == 0:
-        # n_excl=0 → the kernel skips exclusion entirely (the 1-wide filler
-        # column only exists because pallas inputs need a nonzero dim)
-        excl = jnp.full((b_pad, 1), -1, dtype=jnp.int32)
+        # n_excl=0 → the kernel skips exclusion entirely (the 1-row filler
+        # only exists because pallas inputs need a nonzero dim)
+        excl = jnp.full((1, b_pad), -1, dtype=jnp.int32)
         n_excl = 0
     else:
         e = exclude_idx.shape[1]
-        e_pad = _round_up(e, min(_EXCL_CHUNK, e))
+        # transpose to [E, B]: the kernel reads one exclusion row per
+        # loop step via a leading-dim index (see _topk_kernel)
         excl = jnp.pad(
             jnp.asarray(exclude_idx, jnp.int32),
-            ((0, b_pad - b), (0, e_pad - e)),
+            ((0, b_pad - b), (0, 0)),
             constant_values=-1,
-        )
-        n_excl = e_pad
+        ).T
+        n_excl = e
 
     block = min(block_items, _round_up(n_items, 128))
     scores, idx = _topk_streaming_call(

@@ -304,8 +304,9 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
             except ValueError:
                 rec = {"error": f"malformed JSON: {lines[-1][:120]!r}"}
             rec["step"] = step
+            rec["rc"] = proc.returncode
             append(rec)
-            if "error" in rec:
+            if proc.returncode != 0 or "error" in rec:
                 failed.append(step)
             log(f"  -> depth {depth}: qps={rec.get('qps')} "
                 f"p99={rec.get('p99_ms')}ms errors={rec.get('errors')}")

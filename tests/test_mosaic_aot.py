@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from predictionio_tpu.ops.attention import flash_attention_pallas
@@ -152,3 +153,75 @@ class TestMosaicAOT:
             _sds(topo1, (512, 50), jnp.float32),
             _sds(topo1, (60_000, 50), jnp.float32),
         )
+
+    def test_top_k_streaming_with_exclusions(self, topo1):
+        # the similarproduct/ecommerce serving path: seen/blacklisted
+        # items masked inside the kernel — a distinct program from the
+        # plain top-k (extra SMEM block + compare loop)
+        def with_excl(q, items, excl):
+            return top_k_streaming(q, items, 10, exclude_idx=excl,
+                                   interpret=False)
+
+        _compile(
+            with_excl,
+            _sds(topo1, (512, 50), jnp.float32),
+            _sds(topo1, (60_000, 50), jnp.float32),
+            _sds(topo1, (512, 64), jnp.int32),
+        )
+
+    def test_flash_attention_bf16(self, topo1):
+        _compile(
+            functools.partial(
+                flash_attention_pallas, causal=True, interpret=False
+            ),
+            _sds(topo1, (2, 4, 512, 64), jnp.bfloat16),
+            _sds(topo1, (2, 4, 512, 64), jnp.bfloat16),
+            _sds(topo1, (2, 4, 512, 64), jnp.bfloat16),
+        )
+
+    def test_gramian_fused_implicit_yty(self, topo1):
+        # implicit mode (similarproduct's training): the yty base term
+        # rides into the kernel — a distinct program from the explicit
+        # yty=None path the other fused tests cover
+        def with_yty(y, idx, w2, rhs, ridge, yty):
+            return gramian_fused(y, idx, w2, rhs, ridge, yty=yty,
+                                 interpret=False)
+
+        _compile(
+            with_yty,
+            _sds(topo1, (27_000, 56), jnp.float32),
+            _sds(topo1, (4, 8192), jnp.int32),
+            _sds(topo1, (4, 8192), jnp.float32),
+            _sds(topo1, (4, 8192), jnp.float32),
+            _sds(topo1, (4,), jnp.float32),
+            _sds(topo1, (56, 56), jnp.float32),
+        )
+
+    def test_implicit_als_iteration(self, topo1):
+        # the full implicit-mode training program (Hu-Koren confidence
+        # weighting: YᵀY einsums + c−1 gramian weights) at moderate
+        # shapes with the pallas solver — what the implicit_gate queue
+        # step will run on hardware
+        from jax.sharding import SingleDeviceSharding
+
+        from predictionio_tpu.ops import als
+        from predictionio_tpu.tools.prewarm_cache import _stage_avals
+
+        rng = np.random.default_rng(2)
+        n_u, n_i, nnz = 2_000, 500, 40_000
+        u = rng.integers(0, n_u, nnz)
+        i = rng.integers(0, n_i, nnz)
+        v = rng.integers(1, 5, nnz).astype(np.float32)
+        bu = als.bucketize(u, i, v, n_u, n_i, pad_to_blocks=True)
+        bi = als.bucketize(i, u, v, n_i, n_u, pad_to_blocks=True)
+        sh = SingleDeviceSharding(topo1.devices[0])
+        compiled = als._als_iteration.lower(
+            _stage_avals(bu, sh), _stage_avals(bi, sh),
+            jax.ShapeDtypeStruct((n_i, 32), jnp.float32, sharding=sh),
+            jax.ShapeDtypeStruct((), jnp.float32, sharding=sh),
+            jax.ShapeDtypeStruct((), jnp.float32, sharding=sh),
+            n_users=n_u, n_items=n_i, rank=32, implicit=True,
+            solve_mode="pallas", gather_dtype="f32", mesh=None,
+            fused_gather=False,
+        ).compile()
+        assert compiled.memory_analysis().generated_code_size_in_bytes > 0
