@@ -1,0 +1,50 @@
+"""``pio lint``: TPU-hygiene static analysis for the whole package.
+
+Round 5's deviceless AOT sweep (``tests/test_mosaic_aot.py``, commit
+093d7d2) found three real Mosaic lowering bugs that interpret-mode tests
+could never see — unaligned lane slices, a rank-3 broadcast compare that
+compiled pathologically, and sub-128-lane row DMAs — and each cost a
+full compile-debug cycle. The bug classes are mechanical, so this
+package catches them at AST level, before XLA/Mosaic ever runs: the
+"catch it in the graph, not on the device" discipline.
+
+Two rule families (see ``docs/lint.md`` for the full catalog):
+
+- **Family A — Mosaic/Pallas hygiene** (``rules_mosaic``): applied to
+  functions passed to ``pl.pallas_call`` (plus helpers they call) and to
+  block-shape literals anywhere. Rule ids ``mosaic-*``.
+- **Family B — jit-boundary hygiene** (``rules_jit``): applied
+  package-wide. Rule ids ``jit-*``.
+
+Suppression: ``# pio: lint-ok[rule-id] reason`` on the finding's line or
+as a comment-only line directly above. The reason is mandatory — a bare
+suppression is itself a finding (``lint-suppression-missing-reason``),
+and one whose rule ran but matched nothing is stale
+(``lint-unused-suppression``) — so the self-lint gate in
+``tests/test_lint.py`` enforces that every intentional exception in the
+tree carries its one-line justification and stays live.
+"""
+
+from .engine import (
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    lint_file,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,618 @@
+"""Lint engine: file walking, AST context, suppressions, reporters.
+
+Everything here is stdlib-only (``ast`` + ``re``) — the linter must run
+in environments where jax itself cannot import (pre-commit hooks, CI
+images without an accelerator stack), so it never imports the modules it
+analyzes.
+
+The engine's job is mechanics; the rules live in :mod:`rules_mosaic`
+and :mod:`rules_jit`. A rule is a :class:`Rule` subclass whose
+``check(ctx)`` yields :class:`Finding` objects against one
+:class:`FileContext`. The engine then applies suppression comments
+(``# pio: lint-ok[rule-id] reason``) and renders text or JSON.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+#: ``# pio: lint-ok[rule-a, rule-b] free-text reason``
+_SUPPRESS_RE = re.compile(
+    r"#\s*pio:\s*lint-ok\[([A-Za-z0-9_\-, ]+)\]\s*(.*?)\s*$"
+)
+
+#: Attribute accesses on a traced value that are static at trace time —
+#: branching on these inside ``@jit`` is fine.
+STATIC_VALUE_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, pre- or post-suppression."""
+
+    rule_id: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            **(
+                {"suppressReason": self.suppress_reason}
+                if self.suppressed
+                else {}
+            ),
+        }
+
+
+class Rule:
+    """Base class: subclasses set the class attributes and implement
+    ``check``. ``id`` doubles as the suppression token."""
+
+    id: str = ""
+    severity: str = "error"
+    #: one-line "what it catches" (the ``--list-rules`` output)
+    short: str = ""
+    #: the round-5 incident (or rationale) that motivated the rule
+    motivation: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: "FileContext", node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule_id=self.id,
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            severity=self.severity,
+        )
+
+
+@dataclasses.dataclass
+class _Suppression:
+    line: int
+    rule_ids: Set[str]
+    reason: str
+    #: True when the comment is the whole line — only these may cover the
+    #: line below (a trailing suppression covers its own line only, so it
+    #: can never silently absorb a second violation on the next line)
+    comment_only: bool = True
+    used: bool = False
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule needs about one parsed file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    #: posix-style path used for scoping rules to known hot modules
+    posix_path: str
+    #: module-level integer constants (``_SPD_BLK = 128``) — lets the
+    #: tiling rules resolve named block sizes
+    int_constants: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: module-level string-tuple constants (``_HALF_STATICS = ("a",)``)
+    str_tuple_constants: Dict[str, Sequence[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    #: FunctionDefs identified as Pallas kernels (passed to
+    #: ``pl.pallas_call`` directly or via ``functools.partial``, plus
+    #: module functions they call)
+    kernels: List[ast.FunctionDef] = dataclasses.field(default_factory=list)
+    has_pallas_call: bool = False
+    #: per-kernel-name parameter names bound to SMEM blocks (read off the
+    #: ``pallas_call`` in_specs literal) — scalar memory has no lane
+    #: tiling, so the lane-alignment rules exempt these refs
+    smem_params: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    suppressions: List[_Suppression] = dataclasses.field(default_factory=list)
+
+    def kernel_smem_params(self, kernel: ast.FunctionDef) -> Set[str]:
+        return self.smem_params.get(kernel.name, set())
+
+    # -- shared static-evaluation helpers used by the rule modules ------
+
+    def const_int(self, node: ast.AST) -> Optional[int]:
+        """Resolve ``node`` to an int: literal, unary minus, module-level
+        constant name, or a foldable ``a op b`` of those."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            inner = self.const_int(node.operand)
+            return None if inner is None else -inner
+        if isinstance(node, ast.Name):
+            return self.int_constants.get(node.id)
+        if isinstance(node, ast.BinOp):
+            left = self.const_int(node.left)
+            right = self.const_int(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.FloorDiv) and right != 0:
+                return left // right
+        return None
+
+    def provably_multiple(self, node: ast.AST, m: int) -> bool:
+        """True when ``node`` is statically provably a multiple of ``m``:
+        a resolvable int with value % m == 0, a product with a provably-
+        multiple factor, a sum/difference of provable multiples, or a
+        ``_round_up(x, c)`` call with c % m == 0 (the repo's alignment
+        idiom)."""
+        value = self.const_int(node)
+        if value is not None:
+            return value % m == 0
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Mult):
+                return self.provably_multiple(
+                    node.left, m
+                ) or self.provably_multiple(node.right, m)
+            if isinstance(node.op, (ast.Add, ast.Sub)):
+                return self.provably_multiple(
+                    node.left, m
+                ) and self.provably_multiple(node.right, m)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            if name in ("_round_up", "round_up") and len(node.args) == 2:
+                c = self.const_int(node.args[1])
+                return c is not None and c % m == 0
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rule modules
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.fori_loop`` → "jax.lax.fori_loop"; "" when not a plain
+    name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called function: ``pl.pallas_call(...)`` →
+    "pallas_call"."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def is_partial_call(node: ast.Call) -> bool:
+    return call_name(node) in ("partial",)
+
+
+def index_elements(sub: ast.Subscript) -> List[ast.AST]:
+    """The subscript's index as a flat element list (``x[a, b]`` → [a, b];
+    ``x[a]`` → [a])."""
+    idx = sub.slice
+    if isinstance(idx, ast.Tuple):
+        return list(idx.elts)
+    return [idx]
+
+
+def subscript_base_name(sub: ast.Subscript) -> str:
+    """Name the subscript is rooted at, looking through ``.at``:
+    ``y_ref.at[...]`` → "y_ref", ``w2_ref[...]`` → "w2_ref"."""
+    base = sub.value
+    if isinstance(base, ast.Attribute) and base.attr == "at":
+        base = base.value
+    if isinstance(base, ast.Name):
+        return base.id
+    return ""
+
+
+def is_none_constant(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+# ---------------------------------------------------------------------------
+# Context construction
+# ---------------------------------------------------------------------------
+
+
+def _collect_constants(ctx: FileContext) -> None:
+    for stmt in ctx.tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        target = stmt.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = stmt.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int) \
+                and not isinstance(value.value, bool):
+            ctx.int_constants[target.id] = value.value
+        elif isinstance(value, (ast.Tuple, ast.List)) and value.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in value.elts
+        ):
+            ctx.str_tuple_constants[target.id] = tuple(
+                e.value for e in value.elts
+            )
+
+
+def _kernel_name_from_arg(arg: ast.AST) -> str:
+    """First argument of ``pallas_call``: a kernel name, possibly wrapped
+    in ``functools.partial(kernel, ...)``."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Call) and is_partial_call(arg) and arg.args:
+        inner = arg.args[0]
+        if isinstance(inner, ast.Name):
+            return inner.id
+    return ""
+
+
+def _smem_spec_indices(call: ast.Call) -> List[int]:
+    """Positions in the ``pallas_call`` in_specs literal whose BlockSpec
+    names an SMEM memory_space."""
+    in_specs = next(
+        (kw.value for kw in call.keywords if kw.arg == "in_specs"), None
+    )
+    if not isinstance(in_specs, (ast.List, ast.Tuple)):
+        return []
+    out = []
+    for i, spec in enumerate(in_specs.elts):
+        if not (isinstance(spec, ast.Call) and call_name(spec) == "BlockSpec"):
+            continue
+        space = next(
+            (kw.value for kw in spec.keywords if kw.arg == "memory_space"),
+            None,
+        )
+        if space is not None and dotted_name(space).rsplit(".", 1)[-1] == \
+                "SMEM":
+            out.append(i)
+    return out
+
+
+def _collect_kernels(ctx: FileContext) -> None:
+    """Kernels = functions handed to ``pl.pallas_call`` — directly, via a
+    ``functools.partial`` argument, or via a local name bound to such a
+    partial inside a function that makes the ``pallas_call`` — plus, to a
+    fixpoint, module functions that kernels call (helpers like
+    ``_select_topk`` run inside the kernel too)."""
+    module_funcs = {
+        f.name: f for f in ctx.tree.body if isinstance(f, ast.FunctionDef)
+    }
+    names: Set[str] = set()
+    for func in module_funcs.values():
+        calls = [n for n in ast.walk(func) if isinstance(n, ast.Call)]
+        if not any(call_name(c) == "pallas_call" for c in calls):
+            continue
+        ctx.has_pallas_call = True
+        # local `kernel = functools.partial(_kernel_fn, ...)` bindings
+        local_partials: Dict[str, str] = {}
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    is_partial_call(node.value) and node.value.args and \
+                    isinstance(node.value.args[0], ast.Name):
+                local_partials[node.targets[0].id] = node.value.args[0].id
+        for c in calls:
+            if call_name(c) == "pallas_call" and c.args:
+                name = _kernel_name_from_arg(c.args[0])
+                if isinstance(c.args[0], ast.Name):
+                    name = local_partials.get(c.args[0].id, name)
+                if name in module_funcs:
+                    names.add(name)
+                    # map SMEM in_specs positions to kernel param names:
+                    # pallas kernels take (inputs..., outputs...,
+                    # scratch...) positionally
+                    params = [
+                        a.arg for a in module_funcs[name].args.args
+                    ]
+                    smem = {
+                        params[i]
+                        for i in _smem_spec_indices(c)
+                        if i < len(params)
+                    }
+                    if smem:
+                        ctx.smem_params.setdefault(name, set()).update(smem)
+            # a partial over a module function inside a pallas_call-
+            # making function is (in this codebase's idiom) the kernel
+            # being closed over its static params
+            if is_partial_call(c) and c.args and isinstance(
+                c.args[0], ast.Name
+            ) and c.args[0].id in module_funcs:
+                names.add(c.args[0].id)
+    # transitive closure: helpers called from kernel bodies
+    changed = True
+    while changed:
+        changed = False
+        for name in list(names):
+            for node in ast.walk(module_funcs[name]):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name
+                ):
+                    callee = node.func.id
+                    if callee in module_funcs and callee not in names:
+                        names.add(callee)
+                        changed = True
+    ctx.kernels = [module_funcs[n] for n in sorted(names)]
+
+
+def _collect_suppressions(ctx: FileContext) -> None:
+    """Collect suppressions from real COMMENT tokens only: the pattern
+    inside a string literal (test sources, docs quoting the syntax) must
+    never register as a reviewed exception."""
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(ctx.source).readline)
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # unparseable files surface as parse errors elsewhere
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rule_ids = {
+            token.strip() for token in m.group(1).split(",") if token.strip()
+        }
+        ctx.suppressions.append(
+            _Suppression(
+                line=tok.start[0],
+                rule_ids=rule_ids,
+                reason=m.group(2),
+                comment_only=not tok.line[: tok.start[1]].strip(),
+            )
+        )
+
+
+def build_context(path: str, source: Optional[str] = None) -> FileContext:
+    if source is None:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    tree = ast.parse(source, filename=path)
+    ctx = FileContext(
+        path=path,
+        source=source,
+        tree=tree,
+        # absolute, so path-scoped rules (the serving hot-path suffix
+        # match) see the same module identity however the file was named
+        # on the command line (`pio lint serving.py` included)
+        posix_path=os.path.abspath(path).replace(os.sep, "/"),
+    )
+    _collect_constants(ctx)
+    _collect_kernels(ctx)
+    _collect_suppressions(ctx)
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# Running rules + suppression application
+# ---------------------------------------------------------------------------
+
+
+def all_rules() -> List[Rule]:
+    from . import rules_jit, rules_mosaic
+
+    return [*rules_mosaic.RULES, *rules_jit.RULES]
+
+
+@dataclasses.dataclass
+class LintResult:
+    files: int = 0
+    #: unsuppressed findings — what the exit code and the gate count
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    #: suppressed findings, kept for reporting (``--format json``)
+    suppressed: List[Finding] = dataclasses.field(default_factory=list)
+    #: files that failed to parse: (path, error)
+    errors: List[tuple] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def _apply_suppressions(
+    ctx: FileContext,
+    raw: Iterable[Finding],
+    active_rule_ids: Set[str],
+) -> Iterator[Finding]:
+    by_line: Dict[int, List[_Suppression]] = {}
+    for sup in ctx.suppressions:
+        by_line.setdefault(sup.line, []).append(sup)
+    for finding in sorted(raw, key=lambda f: (f.line, f.col, f.rule_id)):
+        matched = None
+        # same line, or a COMMENT-ONLY line directly above: a trailing
+        # suppression covers its own line only, so one reviewed exception
+        # can never silently absorb a second violation on the next line
+        for line in (finding.line, finding.line - 1):
+            for sup in by_line.get(line, ()):
+                if finding.rule_id in sup.rule_ids and (
+                    line == finding.line or sup.comment_only
+                ):
+                    matched = sup
+                    break
+            if matched:
+                break
+        if matched:
+            matched.used = True
+            yield dataclasses.replace(
+                finding, suppressed=True, suppress_reason=matched.reason
+            )
+        else:
+            yield finding
+    # a suppression is a claim someone reviewed the exception; without a
+    # reason the claim is unreviewable — and the self-lint gate requires
+    # every suppression in the tree to justify itself
+    for sup in ctx.suppressions:
+        if not sup.reason:
+            yield Finding(
+                rule_id="lint-suppression-missing-reason",
+                path=ctx.path,
+                line=sup.line,
+                col=1,
+                message=(
+                    "suppression without a reason: follow "
+                    "'# pio: lint-ok[rule-id]' with a one-line "
+                    "justification"
+                ),
+            )
+        # a suppression whose rule ran but found nothing is stale: the
+        # exception it reviewed is gone, and leaving the comment invites
+        # readers to treat it as live. Only judged against rules that
+        # actually ran, so --select can never manufacture staleness.
+        elif not sup.used and sup.rule_ids & active_rule_ids:
+            yield Finding(
+                rule_id="lint-unused-suppression",
+                path=ctx.path,
+                line=sup.line,
+                col=1,
+                message=(
+                    "unused suppression for "
+                    f"{sorted(sup.rule_ids & active_rule_ids)}: no such "
+                    "finding on this line — the exception it reviewed is "
+                    "gone; delete the comment."
+                ),
+            )
+
+
+def lint_file(
+    path: str,
+    rules: Optional[Sequence[Rule]] = None,
+    source: Optional[str] = None,
+) -> List[Finding]:
+    """All findings for one file, suppressed ones included (marked)."""
+    ctx = build_context(path, source=source)
+    rules = list(rules) if rules is not None else all_rules()
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(ctx))
+    return list(_apply_suppressions(ctx, raw, {r.id for r in rules}))
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            # prune hidden dirs (.git, .venv, .tox, ...) and vendored
+            # trees: linting site-packages is never what the caller meant
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".")
+                and d not in ("__pycache__", "_build", "node_modules",
+                              "venv", "env", "site-packages")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    select: Optional[Set[str]] = None,
+) -> LintResult:
+    """Lint files/directories. ``select`` restricts to those rule ids."""
+    rules = list(rules) if rules is not None else all_rules()
+    if select:
+        rules = [r for r in rules if r.id in select]
+    result = LintResult()
+    # a target that does not exist must fail the run: the gate reading
+    # exit 0 / ok=true as "lint-clean" must never get it from a typo'd
+    # path that linted nothing
+    missing = [p for p in paths if not os.path.exists(p)]
+    for p in missing:
+        result.errors.append((p, "no such file or directory"))
+    paths = [p for p in paths if p not in missing]
+    for path in iter_python_files(paths):
+        result.files += 1
+        try:
+            findings = lint_file(path, rules=rules)
+        # SyntaxError: does not parse. ValueError: null bytes, and the
+        # UnicodeDecodeError subclass for non-UTF8 files. OSError: file
+        # vanished/unreadable mid-walk. All must be a recorded parse
+        # error (and a nonzero exit), never a traceback that costs the
+        # watcher its JSON document.
+        except (SyntaxError, ValueError, OSError) as exc:
+            result.errors.append(
+                (path, f"{type(exc).__name__}: {exc}")
+            )
+            continue
+        for f in findings:
+            (result.suppressed if f.suppressed else result.findings).append(f)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Reporters
+# ---------------------------------------------------------------------------
+
+
+def render_text(result: LintResult) -> str:
+    lines = []
+    for path, err in result.errors:
+        lines.append(f"{path}:1:1: [parse-error] {err}")
+    for f in result.findings:
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: [{f.rule_id}] "
+            f"{f.severity}: {f.message}"
+        )
+    lines.append(
+        f"{result.files} files, {len(result.findings)} findings, "
+        f"{len(result.suppressed)} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(
+        {
+            "files": result.files,
+            "findings": [f.as_dict() for f in result.findings],
+            "suppressed": [f.as_dict() for f in result.suppressed],
+            "errors": [
+                {"path": p, "message": m} for p, m in result.errors
+            ],
+            "ok": result.ok,
+        },
+        indent=2,
+    )

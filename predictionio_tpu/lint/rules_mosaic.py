@@ -1,0 +1,394 @@
+"""Family A — Mosaic/Pallas hygiene rules.
+
+These rules run on functions identified as Pallas kernels (passed to
+``pl.pallas_call``, plus module helpers they call — see
+``engine._collect_kernels``) and on block-shape literals anywhere in a
+file. Each rule encodes one bug class the round-5 deviceless AOT sweep
+hit on real kernels (commit 093d7d2, ``ROUND5_NOTES.md``), so the
+messages cite the incident; ``docs/lint.md`` carries the full catalog.
+
+Naming conventions the detectors lean on (this codebase's idiom, stated
+in docs/lint.md): kernel ref parameters end in ``_ref``; VMEM scratch
+operands use other names (``a_s``, ``gbuf``) and are exempt from the
+per-row-read heuristic because a scratch row read is not a DMA.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .engine import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    dotted_name,
+    index_elements,
+    is_none_constant,
+    subscript_base_name,
+)
+
+#: TPU tiling: lane (last) dim granularity and sublane (second-to-last)
+#: granularity for f32. Rules use the f32 floor — stricter dtypes (bf16
+#: sublane 16, int8 32) only tighten it, and the repo's kernels are f32
+#: at the tile boundary.
+LANE = 128
+SUBLANE = 8
+
+
+def _is_pl_ds(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (
+        dotted_name(node.func) in ("pl.ds", "pltpu.ds")
+        or call_name(node) == "ds"
+    )
+
+
+def _fori_body_defs(func: ast.FunctionDef) -> List[ast.FunctionDef]:
+    """FunctionDefs used as ``fori_loop``/``while_loop`` bodies anywhere
+    inside ``func`` (nested defs included)."""
+    defs = {
+        n.name: n
+        for n in ast.walk(func)
+        if isinstance(n, ast.FunctionDef) and n is not func
+    }
+    out = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) not in ("fori_loop", "while_loop"):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id in defs:
+                out.append(defs[arg.id])
+    return out
+
+
+class UnalignedLaneSlice(Rule):
+    """The 093d7d2 bug: the exclusion top-k sliced its ``[B, E]`` buffer
+    at 16-lane offsets, which Mosaic rejects outright; the fused-gather
+    kernel's 1×56 row copies failed the same way. A ``pl.ds`` in the
+    lane (last) position of a kernel ref subscript must be provably
+    128-aligned in both offset and size."""
+
+    id = "mosaic-unaligned-lane-slice"
+    severity = "error"
+    short = (
+        "lane-dim pl.ds slice whose offset/size is not provably a "
+        "multiple of 128"
+    )
+    motivation = (
+        "round 5: exclusion top-k's 16-lane slices did not lower; "
+        "gramian_fused's 1x56 row DMAs did not lower (commit 093d7d2)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for kernel in ctx.kernels:
+            smem = ctx.kernel_smem_params(kernel)
+            for node in ast.walk(kernel):
+                if not isinstance(node, ast.Subscript):
+                    continue
+                base = subscript_base_name(node)
+                if not base.endswith("_ref") or base in smem:
+                    continue
+                elts = index_elements(node)
+                if len(elts) < 2 or not _is_pl_ds(elts[-1]):
+                    # a sole index is the sublane/leading dim (always
+                    # lowerable); only the trailing position rides lanes
+                    continue
+                ds = elts[-1]
+                if len(ds.args) < 2:
+                    continue
+                offset, size = ds.args[0], ds.args[1]
+                bad: List[str] = []
+                if not ctx.provably_multiple(offset, LANE):
+                    bad.append("offset")
+                if not ctx.provably_multiple(size, LANE):
+                    bad.append("size")
+                if bad:
+                    yield self.finding(
+                        ctx,
+                        ds,
+                        f"lane-dim slice of {subscript_base_name(node)!r} "
+                        f"with {' and '.join(bad)} not provably a multiple "
+                        f"of {LANE}: Mosaic rejects unaligned lane slices "
+                        "(round-5 exclusion top-k bug). Restructure so the "
+                        "lane offset/size are 128-aligned (e.g. transpose "
+                        "the buffer and read leading-dim rows).",
+                    )
+
+
+class BlockSpecTiling(Rule):
+    """Block shapes feed the Mosaic tiling directly: a VMEM block whose
+    last dim is not a multiple of 128 (or second-to-last not a multiple
+    of 8) either fails to lower or pays relayout copies. Applies to
+    ``pl.BlockSpec`` shape tuples and ``pltpu.VMEM`` scratch shapes with
+    statically resolvable dims; SMEM blocks are exempt (scalar memory
+    has no lane tiling)."""
+
+    id = "mosaic-blockspec-tiling"
+    severity = "error"
+    short = (
+        "BlockSpec/VMEM block shape with last dim not %128 or "
+        "second-to-last not %8"
+    )
+    motivation = (
+        "same tiling contract the round-5 AOT sweep enforced; the "
+        "streaming top-k pads queries to 8x128 for exactly this reason"
+    )
+
+    def _shape_findings(
+        self, ctx: FileContext, call: ast.Call, shape: ast.Tuple,
+        what: str,
+    ) -> Iterator[Finding]:
+        dims = [ctx.const_int(e) for e in shape.elts]
+        if len(dims) >= 1 and dims[-1] is not None and dims[-1] % LANE:
+            yield self.finding(
+                ctx,
+                call,
+                f"{what} last (lane) dim {dims[-1]} is not a multiple of "
+                f"{LANE}; the block will not tile onto the VPU/MXU "
+                "lanes — pad the array and mask instead.",
+            )
+        if len(dims) >= 2 and dims[-2] is not None and dims[-2] % SUBLANE:
+            yield self.finding(
+                ctx,
+                call,
+                f"{what} second-to-last (sublane) dim {dims[-2]} is not a "
+                f"multiple of {SUBLANE} (f32 tiling); pad to the sublane "
+                "granule.",
+            )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "BlockSpec":
+                memory_space = next(
+                    (
+                        kw.value
+                        for kw in node.keywords
+                        if kw.arg == "memory_space"
+                    ),
+                    None,
+                )
+                if memory_space is not None and dotted_name(
+                    memory_space
+                ).rsplit(".", 1)[-1] in ("SMEM", "ANY", "HBM"):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Tuple):
+                    yield from self._shape_findings(
+                        ctx, node, node.args[0], "BlockSpec block shape"
+                    )
+            elif name == "VMEM" and dotted_name(node.func).startswith(
+                ("pltpu.", "tpu.")
+            ):
+                if node.args and isinstance(node.args[0], ast.Tuple):
+                    yield from self._shape_findings(
+                        ctx, node, node.args[0], "VMEM scratch shape"
+                    )
+
+
+class Rank3BroadcastCompare(Rule):
+    """The second half of the 093d7d2 bug: widening the exclusion compare
+    to an aligned ``[B, T, C]`` rank-3 broadcast made Mosaic compile
+    pathologically (aborted after 15 minutes). Inside kernels, compares
+    must stay rank ≤ 2 — restructure as a loop of 2-D compares."""
+
+    id = "mosaic-rank3-compare"
+    severity = "error"
+    short = "comparison broadcasting to rank >= 3 inside a kernel"
+    motivation = (
+        "round 5: the [B, T, C] exclusion compare compiled for 15+ "
+        "minutes before being aborted (commit 093d7d2)"
+    )
+
+    @staticmethod
+    def _apparent_rank(node: ast.AST) -> Optional[int]:
+        """Result rank of a subscript that uses ``None`` (newaxis)
+        expansion; None when not statically apparent."""
+        if not isinstance(node, ast.Subscript):
+            return None
+        elts = index_elements(node)
+        if not any(is_none_constant(e) for e in elts):
+            return None
+        # every element is a dim of the result except scalar indices;
+        # slices keep a dim, None adds one
+        rank = 0
+        for e in elts:
+            if isinstance(e, ast.Slice) or is_none_constant(e):
+                rank += 1
+        return rank
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for kernel in ctx.kernels:
+            for node in ast.walk(kernel):
+                if not isinstance(node, ast.Compare):
+                    continue
+                operands = [node.left, *node.comparators]
+                for op in operands:
+                    rank = self._apparent_rank(op)
+                    if rank is not None and rank >= 3:
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"comparison operand broadcast to rank {rank} "
+                            "inside a kernel: Mosaic compiles rank-3 "
+                            "broadcast compares pathologically (round-5 "
+                            "exclusion bug — 15 min compile). Loop over "
+                            "one dim with 2-D compares instead.",
+                        )
+                        break
+
+
+class PerRowDMA(Rule):
+    """One DMA (or one ref row read) per loop iteration moves data at
+    well below the 128-lane floor and serializes on issue rate — the
+    known ``gramian_fused`` weakness (PERF.md): its per-row gather is
+    flag-gated until a hardware A/B prices the DMA-issue cost. Flags
+    (a) ``make_async_copy`` with a size-1 sublane slice inside a loop
+    body, and (b) single-row ``*_ref[i]`` reads per iteration."""
+
+    id = "mosaic-per-row-dma"
+    severity = "warning"
+    short = (
+        "per-row DMA or single-row ref read inside a loop body "
+        "(below the 128-lane floor)"
+    )
+    motivation = (
+        "gramian_fused's per-row gather DMAs (PERF.md round-3 weakness; "
+        "round-5 fixed their alignment but the issue-rate risk stands) "
+        "and the exclusion top-k's sequential E-step (ADVICE r5)"
+    )
+
+    @staticmethod
+    def _has_unit_ds(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if _is_pl_ds(sub) and len(sub.args) >= 2:
+                size = sub.args[1]
+                if isinstance(size, ast.Constant) and size.value == 1:
+                    return True
+        return False
+
+    def _loop_bodies(
+        self, func: ast.FunctionDef
+    ) -> List[Tuple[ast.AST, str]]:
+        bodies: List[Tuple[ast.AST, str]] = []
+        for body_def in _fori_body_defs(func):
+            bodies.append((body_def, "fori_loop body"))
+        for node in ast.walk(func):
+            if isinstance(node, (ast.For, ast.While)):
+                bodies.append((node, "Python loop body"))
+        return bodies
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for kernel in ctx.kernels:
+            smem = ctx.kernel_smem_params(kernel)
+            seen: Set[int] = set()
+            for body, kind in self._loop_bodies(kernel):
+                for node in ast.walk(body):
+                    if id(node) in seen:
+                        continue
+                    if isinstance(node, ast.Call) and call_name(node) in (
+                        "make_async_copy", "async_copy",
+                    ):
+                        if self._has_unit_ds(node):
+                            seen.add(id(node))
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"single-row async copy per {kind} "
+                                "iteration: each DMA moves one sublane "
+                                "row (the gramian_fused per-row gather "
+                                "pattern) — batch rows into >= 8-sublane "
+                                "tiles or accept the DMA-issue-rate risk "
+                                "explicitly.",
+                            )
+                    elif isinstance(node, ast.Subscript) and isinstance(
+                        node.ctx, ast.Load
+                    ):
+                        base = subscript_base_name(node)
+                        elts = index_elements(node)
+                        if (
+                            base.endswith("_ref")
+                            and base not in smem
+                            and len(elts) == 1
+                            and not isinstance(elts[0], ast.Slice)
+                            and not _is_pl_ds(elts[0])
+                            and not is_none_constant(elts[0])
+                            and not isinstance(elts[0], ast.Constant)
+                        ):
+                            seen.add(id(node))
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"one row of {base!r} read per {kind} "
+                                "iteration: sequential sub-128-lane "
+                                "traffic (the exclusion top-k E-step "
+                                "shape) — fine only when the trip count "
+                                "is small and bounded.",
+                            )
+
+
+class UnboundedForiTrip(Rule):
+    """A ``fori_loop`` whose trip count is derived from a runtime array
+    dimension recompiles (and re-lowers) per shape and can grow without
+    bound with the data; kernels should loop over static tile counts and
+    let the grid absorb the data-scaled dim."""
+
+    id = "mosaic-unbounded-fori"
+    severity = "warning"
+    short = "fori_loop trip count derived from a runtime array dim"
+    motivation = (
+        "the exclusion E-step's trip count scales with the blacklist "
+        "width; ADVICE r5 flagged the widest widths as unmeasured — "
+        "shape-derived trip counts make that scaling invisible"
+    )
+
+    @staticmethod
+    def _shape_derived_names(func: ast.FunctionDef) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                        names.add(node.targets[0].id)
+                        break
+        return names
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for kernel in ctx.kernels:
+            shape_names = self._shape_derived_names(kernel)
+            for node in ast.walk(kernel):
+                if not isinstance(node, ast.Call) or call_name(node) != \
+                        "fori_loop":
+                    continue
+                if len(node.args) < 2:
+                    continue
+                hi = node.args[1]
+                derived = False
+                for sub in ast.walk(hi):
+                    if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                        derived = True
+                    if isinstance(sub, ast.Name) and sub.id in shape_names:
+                        derived = True
+                if derived:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        "fori_loop trip count derives from a runtime array "
+                        "dim: the loop re-lowers per shape and scales "
+                        "unboundedly with the data — use a static tile "
+                        "count and ride the grid over the data dim.",
+                    )
+
+
+RULES = [
+    UnalignedLaneSlice(),
+    BlockSpecTiling(),
+    Rank3BroadcastCompare(),
+    PerRowDMA(),
+    UnboundedForiTrip(),
+]

@@ -746,8 +746,9 @@ def _solve_side_traced(
                     b_t = jnp.pad(b_t, ((0, 0), (0, pad_b)))
                 x_t = spd_solve_t(a_t, b_t)
             else:
-                from jax import shard_map
                 from jax.sharding import PartitionSpec as P
+
+                from ..parallel.collectives import shard_map
                 from ..parallel.mesh import DATA_AXIS
 
                 n_data = mesh.shape[DATA_AXIS]
@@ -779,8 +780,9 @@ def _solve_side_traced(
                 return body(
                     y_pad, yty_arg, lam, alpha, idx_blk, val_blk, counts_blk
                 )
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
+
+            from ..parallel.collectives import shard_map
             from ..parallel.mesh import DATA_AXIS
 
             n_data = mesh.shape[DATA_AXIS]

@@ -31,8 +31,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import shard_map  # jax-version compat shim
 
 try:  # pallas is TPU/GPU-oriented; keep the module importable anywhere
     from jax.experimental import pallas as pl

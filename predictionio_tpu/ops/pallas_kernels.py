@@ -93,6 +93,7 @@ def _topk_kernel(q_ref, items_ref, excl_ref, out_s_ref, out_i_ref, *,
         # [B, T, C]-chunked formulation is gone; total compare work is
         # identical (E × [B, T]).
         def body(e, sc):
+            # pio: lint-ok[mosaic-per-row-dma] sequential E-step is by design (ADVICE r5): E ≤ 64 and a [B] sublane row per step is the formulation that lowers; the [B,T,C] chunked compare did not
             ex = excl_ref[e]  # [B]
             hit = gidx == ex[:, None]  # [B, T]
             return jnp.where(hit, _NEG_INF, sc)
@@ -404,6 +405,7 @@ def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
         t = s % k_tiles
 
         def one(k, _):
+            # pio: lint-ok[mosaic-per-row-dma] the per-row gather IS this kernel's design; flag-gated (BENCH_FUSED_GATHER=1) until the hardware A/B prices the DMA-issue rate (PERF.md)
             dma = pltpu.make_async_copy(
                 y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
                 gbuf.at[slot, pl.ds(k, 1), :],
@@ -431,7 +433,9 @@ def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
         # reshape [kt] -> [kt, 1] in f32, THEN cast: Mosaic's layout
         # inference rejects the 1-D->2-D shape cast on bf16 vectors
         # (found by deviceless AOT compile of the bf16-gather variant)
+        # pio: lint-ok[mosaic-unaligned-lane-slice] kt is a static param the AST cannot resolve; the wrapper guarantees kt % 128 == 0 (rounded at the gramian_fused entry), so t*kt offsets and kt sizes are lane-aligned
         w = w2_ref[b, pl.ds(t * kt, kt)][:, None].astype(g.dtype)
+        # pio: lint-ok[mosaic-unaligned-lane-slice] same kt %128 wrapper guarantee as the w2 slice above
         rr = rhs_ref[b, pl.ds(t * kt, kt)][:, None].astype(g.dtype)
         a_acc = a_acc + jax.lax.dot_general(
             g * w, g, (((0,), (0,)), ((), ())),
@@ -557,7 +561,13 @@ def gramian_fused(
             a_tot = a_s if a_tot is None else a_tot + a_s
             b_tot = b_s if b_tot is None else b_tot + b_s
         return a_tot, b_tot
-    kt = min(k, _FUSED_K_TILE)
+    # kt must be lane-aligned: the kernel slices w2/rhs at [b, t*kt : +kt]
+    # in the lane dim, and Mosaic rejects unaligned lane slices (the same
+    # deviceless-AOT finding as the 1×56 row DMAs). The AOT sweep only
+    # covers k ≥ 512 shapes where kt == _FUSED_K_TILE; rounding keeps the
+    # guarantee for narrow buckets too (padding contract absorbs the
+    # zero-weighted extra slots).
+    kt = min(_round_up(k, 128), _FUSED_K_TILE)
     k_pad = _round_up(k, kt)
     bt = min(_FUSED_B_TILE, max(1, _FUSED_SMEM_IDX // k_pad))
     b_pad = _round_up(b, bt)

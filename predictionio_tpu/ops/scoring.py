@@ -97,7 +97,7 @@ def top_k_for_vectors(
     return _score_topk(query_vectors, item_factors, k, exclude_mask)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "exclude_self"))
 def top_k_similar_items(
     item_factors: jax.Array,  # [I, R]
     item_idx: jax.Array,  # [B] int32

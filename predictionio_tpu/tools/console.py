@@ -294,6 +294,15 @@ def build_parser() -> argparse.ArgumentParser:
     tp_get.add_argument("template_name")
     tp_get.add_argument("directory")
 
+    ln = sub.add_parser(
+        "lint",
+        help="TPU-hygiene static analysis (Mosaic + jit-boundary rules)",
+        # the lint CLI owns its option surface (tools/lint.py) — forward
+        # everything, -h included, so flags are defined exactly once
+        add_help=False,
+    )
+    ln.add_argument("lint_args", nargs=argparse.REMAINDER)
+
     up = sub.add_parser(
         "upgrade", help="migrate event data between storage backends"
     )
@@ -410,6 +419,18 @@ def main(
     from ..utils.platform import apply_env_platform
 
     import signal
+
+    # `pio lint` forwards verbatim BEFORE argparse: the lint CLI owns its
+    # whole option surface (tools/lint.py), argparse's REMAINDER cannot
+    # capture leading --flags, and pure static analysis needs neither the
+    # storage plane nor a jax import — it must work on an unconfigured
+    # host.
+    head = list(sys.argv[1:] if argv is None else argv)[:1]
+    if head == ["lint"]:
+        from . import lint as lint_mod
+
+        tail = list(sys.argv[2:] if argv is None else argv[1:])
+        return lint_mod.main(tail)
 
     apply_env_platform()
     args = build_parser().parse_args(argv)

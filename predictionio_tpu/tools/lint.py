@@ -1,0 +1,87 @@
+"""``pio lint`` / ``python -m predictionio_tpu.tools.lint`` — run the
+TPU-hygiene static analyzer over files or directories.
+
+Exit code 0 when every finding is suppressed (with a reason), 1
+otherwise — the same contract as the tier-1 gate in
+``tests/test_lint.py``, so CI, the pre-window checklist
+(docs/hardware_day.md) and the watcher all read the same signal.
+``--format json`` emits one machine-readable document on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from ..lint import all_rules, lint_paths, render_json, render_text
+
+#: default lint target: the installed package itself
+PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio lint",
+        description="TPU-hygiene static analysis (Mosaic + jit-boundary "
+        "rules; see docs/lint.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: the "
+        "predictionio_tpu package)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (json is the watcher/CI interface)",
+    )
+    p.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def _emit(text: str) -> None:
+    """Print that dies quietly on a closed pipe (``pio lint | head``):
+    the exit code still carries the gate verdict, and stdout is pointed
+    at devnull so the interpreter's exit flush cannot raise a second
+    traceback."""
+    try:
+        print(text)
+        sys.stdout.flush()
+    except BrokenPipeError:
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+        except OSError:
+            pass
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        _emit("\n".join(
+            f"{rule.id} [{rule.severity}]: {rule.short}"
+            for rule in all_rules()
+        ))
+        return 0
+    paths = args.paths or [PACKAGE_DIR]
+    select = (
+        {token.strip() for token in args.select.split(",") if token.strip()}
+        if args.select
+        else None
+    )
+    result = lint_paths(paths, select=select)
+    _emit(render_json(result) if args.format == "json"
+          else render_text(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -387,6 +387,23 @@ def main() -> int:
             failures.append(rec.get("step"))
         return rec
 
+    def _reused(rec: dict) -> dict:
+        """Tag + report a tier-A record reused instead of re-measured.
+        The evidence file gets an explicit marker under a DISTINCT step
+        name (so ``_recent`` can never mistake the marker for a fresh
+        measurement and chain reuse past the 6 h window), and the
+        in-memory record carries ``reused=True`` so downstream
+        aggregation — the baseline_variance spread — can tell a
+        cross-window leg from one measured in this invocation."""
+        now = time.time()
+        append({
+            "step": "reused_tier_a_record",
+            "of": rec.get("step"),
+            "source_t_unix": rec.get("t_unix"),
+            "age_s": round(now - float(rec.get("t_unix", now)), 1),
+        })
+        return {**rec, "reused": True}
+
     def step_once(step: str) -> dict:
         """Tier B reuses a recent (≤6 h, successful) tier-A record for
         ``step`` rather than re-spending device time; everything else
@@ -397,7 +414,7 @@ def main() -> int:
             if rec is not None and rec.get("rc") == 0:
                 log(f"reusing recent {step} record (t_unix="
                     f"{rec.get('t_unix')})")
-                return rec
+                return _reused(rec)
         return _track(run_step(step))
 
     baseline = None
@@ -414,7 +431,7 @@ def main() -> int:
                 and "fallback" not in rec and "holdout_rmse" in rec
                 and float(rec.get("scale", -1.0)) == want_scale
                 and int(rec.get("iterations", -1)) == want_iters):
-            baseline = rec
+            baseline = _reused(rec)
             log(f"tier B: reusing tier-A baseline "
                 f"({rec.get('value')}s, rmse {rec.get('holdout_rmse')})")
     if baseline is None:
@@ -441,8 +458,12 @@ def main() -> int:
     gate = float(baseline["holdout_rmse"]) + RMSE_GATE_DELTA
 
     # repeat runs: the prior last-good number was a single leg whose first
-    # iteration included compile; record spread + steady-state separately
-    repeats = [baseline]
+    # iteration included compile; record spread + steady-state separately.
+    # The spread is a WITHIN-window statistic — a tier-B baseline reused
+    # from an earlier tier-A window (possibly hours old) would fold
+    # window-to-window drift into it, so only legs measured in this
+    # invocation enter the aggregate.
+    repeats = [] if baseline.get("reused") else [baseline]
     for rep in range(2, max(1, args.repeats) + 1):
         rec = _track(run_bench(f"baseline_f32_r{rep}", dict(base_env)))
         if rec.get("rc") == 0 and "fallback" not in rec:
@@ -456,6 +477,7 @@ def main() -> int:
         append({
             "step": "baseline_variance",
             "runs": len(repeats),
+            "reused_baseline_excluded": bool(baseline.get("reused")),
             "train_s": trains,
             "train_s_spread": round(max(trains) - min(trains), 3),
             "steady_iter_s": [round(s, 4) for s in steadies],

@@ -52,7 +52,8 @@ def enable_compilation_cache(
     safe (idempotent, best-effort) at any point. Returns the cache dir,
     or ``None`` when disabled or unavailable.
     """
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    preexisting = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    cache_dir = preexisting
     if cache_dir is None:
         cache_dir = os.environ.get("PIO_JAX_CACHE_DIR", default_dir)
     if not cache_dir:
@@ -61,20 +62,37 @@ def enable_compilation_cache(
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
         return None
+    # Cache every program: serving-dispatch programs compile in well
+    # under the 1 s default threshold, but they are exactly what the
+    # loadgen sweep's per-depth deploys re-pay inside the window.
+    wanted = (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    )
+    applied: list = []  # (name, previous value) of updates that landed
     try:
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # Cache every program: serving-dispatch programs compile in well
-        # under the 1 s default threshold, but they are exactly what the
-        # loadgen sweep's per-depth deploys re-pay inside the window.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        for name, value in wanted:
+            previous = getattr(jax.config, name, None)
+            jax.config.update(name, value)
+            applied.append((name, previous))
     except Exception:
-        # config failed: make sure we don't half-enable (the env var
-        # would silently turn the cache on in every child while this
-        # process reports it as disabled)
-        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        # Partial failure must not half-enable caching: roll the config
+        # back to its pre-call state so this process never runs with
+        # (say) the cache dir set but the thresholds still defaulted.
+        for name, previous in reversed(applied):
+            try:
+                jax.config.update(name, previous)
+            except Exception:
+                pass
+        # Only this function's own export (below) is ours to undo. A
+        # pre-existing JAX_COMPILATION_CACHE_DIR — the operator's, or a
+        # parent process's successful call — is their state: popping it
+        # would silently disable caching in every child they launch.
+        if preexisting is None:
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
         return None
     # exported only after the in-process config succeeded, so children
     # (deploys, fallback re-execs, queue steps) inherit a working setup

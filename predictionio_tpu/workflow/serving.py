@@ -138,6 +138,7 @@ def encode_result(obj: Any) -> Any:
             return obj.tolist()  # numpy / jax arrays (any shape)
         if hasattr(obj, "item"):
             try:
+                # pio: lint-ok[jit-host-sync-serving] encode_result IS the encode-time sync point the rule defers to — the one place a device scalar must become JSON
                 return obj.item()  # other scalar wrappers
             except (TypeError, ValueError):
                 pass

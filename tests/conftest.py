@@ -32,6 +32,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy compile/AOT/interpret-mode suites excluded from the "
+        "tier-1 time budget (`-m 'not slow'`); run them explicitly with "
+        "`pytest -m slow`",
+    )
+
+
 @pytest.fixture(params=["sqlite", "native", "remote"])
 def event_store(request, tmp_path):
     """Every event-store test runs against the SQLite backend, the native

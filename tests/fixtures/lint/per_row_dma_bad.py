@@ -1,0 +1,41 @@
+"""Round-5 Mosaic bug class 3: the fused-yty implicit-mode pattern
+(``gramian_fused``, PERF.md round-3 weakness). One ``make_async_copy``
+per loop iteration gathers a single factor row — each DMA moves one
+sublane row, well below the 128-lane floor, and serializes on DMA issue
+rate. ``mosaic-per-row-dma`` must flag the copy below (and nothing else
+in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fused_yty_kernel(idx_ref, y_ref, yty_ref, out_ref, gbuf, sem):
+    def one(k, _):
+        dma = pltpu.make_async_copy(  # one row per DMA: BAD
+            y_ref.at[pl.ds(idx_ref[0, k], 1), :],
+            gbuf.at[pl.ds(k, 1), :],
+            sem,
+        )
+        dma.start()
+        dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, 16, one, 0)
+    g = gbuf[:]
+    out_ref[:] = jax.lax.dot_general(
+        g, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + yty_ref[:]
+
+
+def run(idx, y, yty, out_shape, scratch_shapes):
+    return pl.pallas_call(
+        _fused_yty_kernel,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+    )(idx, y, yty)

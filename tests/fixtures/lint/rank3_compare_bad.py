@@ -1,0 +1,26 @@
+"""Round-5 Mosaic bug class 2 (commit 093d7d2): widening the exclusion
+compare to an aligned ``[B, T, C]`` rank-3 broadcast "fixed" the slice
+alignment but made Mosaic compile pathologically — the kernel was
+aborted after 15+ minutes of compile time. ``mosaic-rank3-compare``
+must flag the broadcast compare below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+
+
+def _mask_kernel(scores_ref, excl_ref, out_ref):
+    scores = scores_ref[:]
+    gidx = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    excl = excl_ref[:]
+    hit = gidx[:, :, None] == excl[:, None, :]  # [B, T, C] compare: BAD
+    out_ref[:] = jnp.where(hit.any(axis=2), _NEG_INF, scores)
+
+
+def run(scores, excl, out_shape):
+    return pl.pallas_call(_mask_kernel, out_shape=out_shape)(scores, excl)

@@ -1,0 +1,29 @@
+"""Round-5 Mosaic bug class 1 (commit 093d7d2): the exclusion top-k
+sliced its [B, E] exclusion buffer at 16-lane offsets in the lane dim.
+Mosaic rejects unaligned lane slices outright — the serving query did
+not compile on TPU at all. ``mosaic-unaligned-lane-slice`` must flag the
+``pl.ds`` below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+
+
+def _excl_kernel(scores_ref, excl_ref, out_ref):
+    scores = scores_ref[:]
+
+    def body(c, sc):
+        chunk = excl_ref[:, pl.ds(c * 16, 16)]  # 16-lane slice: BAD
+        hit = sc[:, None] == chunk[:, :1]
+        return jnp.where(hit[:, 0], _NEG_INF, sc)
+
+    out_ref[:] = jax.lax.fori_loop(0, 4, body, scores)
+
+
+def run(scores, excl, out_shape):
+    return pl.pallas_call(_excl_kernel, out_shape=out_shape)(scores, excl)

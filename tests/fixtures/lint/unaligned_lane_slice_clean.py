@@ -1,0 +1,27 @@
+"""Clean twin of ``unaligned_lane_slice_bad.py``: identical structure,
+but the lane-dim slice rides 128-aligned offsets and sizes (the post-fix
+formulation). The linter must report NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+
+
+def _excl_kernel(scores_ref, excl_ref, out_ref):
+    scores = scores_ref[:]
+
+    def body(c, sc):
+        chunk = excl_ref[:, pl.ds(c * 128, 128)]  # lane-aligned: OK
+        hit = sc[:, None] == chunk[:, :1]
+        return jnp.where(hit[:, 0], _NEG_INF, sc)
+
+    out_ref[:] = jax.lax.fori_loop(0, 4, body, scores)
+
+
+def run(scores, excl, out_shape):
+    return pl.pallas_call(_excl_kernel, out_shape=out_shape)(scores, excl)

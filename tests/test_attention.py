@@ -8,6 +8,9 @@ import jax
 import numpy as np
 import pytest
 
+# interpret-mode flash attention at real shapes: minutes on CPU
+pytestmark = pytest.mark.slow
+
 from predictionio_tpu.ops.attention import (
     attention,
     flash_attention,

@@ -1,0 +1,476 @@
+"""``pio lint`` — the TPU-hygiene static analyzer (predictionio_tpu/lint).
+
+Three layers:
+
+1. **Round-5 fixtures** (``tests/fixtures/lint/``): each of the three
+   Mosaic bug classes the round-5 deviceless AOT sweep found (commit
+   093d7d2) is reproduced as a bad fixture that must be flagged by
+   exactly the intended rule at the marked line — and a clean twin that
+   must produce no finding at all (false-positive guard).
+2. **Rule semantics**: inline-source tests for the jit-boundary family
+   and the suppression machinery.
+3. **The self-lint gate**: linting ``predictionio_tpu/`` must yield zero
+   unsuppressed findings, and every suppression must carry a reason —
+   this is the tier-1 gate that keeps future Pallas PRs from
+   reintroducing the round-5 bug classes.
+
+The linter is stdlib-only by design (it must run where jax cannot
+import), so these tests never need a device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from predictionio_tpu.lint import (
+    all_rules,
+    lint_file,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "predictionio_tpu")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _unsuppressed(path: str):
+    return [f for f in lint_file(path) if not f.suppressed]
+
+
+def _marker_line(path: str, marker: str) -> int:
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            if marker in line:
+                return lineno
+    raise AssertionError(f"marker {marker!r} not in {path}")
+
+
+# ---------------------------------------------------------------------------
+# 1. Round-5 Mosaic bug-class fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestRound5Fixtures:
+    """Each bad fixture fires exactly its intended rule, at the marked
+    line; each clean twin is silent."""
+
+    @pytest.mark.parametrize(
+        "fixture,rule_id",
+        [
+            ("unaligned_lane_slice_bad.py", "mosaic-unaligned-lane-slice"),
+            ("rank3_compare_bad.py", "mosaic-rank3-compare"),
+            ("per_row_dma_bad.py", "mosaic-per-row-dma"),
+        ],
+    )
+    def test_bad_fixture_fires_exactly_intended_rule(self, fixture, rule_id):
+        path = os.path.join(FIXTURES, fixture)
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [rule_id], (
+            f"{fixture}: expected exactly one {rule_id} finding, got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+        assert findings[0].line == _marker_line(path, "BAD")
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "unaligned_lane_slice_clean.py",
+            "rank3_compare_clean.py",
+            "per_row_dma_clean.py",
+        ],
+    )
+    def test_clean_twin_has_no_findings(self, fixture):
+        path = os.path.join(FIXTURES, fixture)
+        findings = lint_file(path)
+        assert findings == [], (
+            f"false positive(s) on clean twin {fixture}: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 2. Rule semantics (inline sources)
+# ---------------------------------------------------------------------------
+
+
+def _lint_source(source: str, path: str = "predictionio_tpu/x.py"):
+    return lint_file(path, source=source)
+
+
+class TestJitRules:
+    def test_python_branch_on_traced_arg_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["jit-python-branch"]
+        assert findings[0].line == 4
+
+    def test_branch_on_static_arg_is_clean(self):
+        src = (
+            "import functools, jax\n"
+            "@functools.partial(jax.jit, static_argnames=('flag',))\n"
+            "def f(x, flag):\n"
+            "    if flag:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_branch_on_shape_facet_is_clean(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x.shape[0] > 8:\n"
+            "        return x[:8]\n"
+            "    return x\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_jit_in_loop_fires(self):
+        src = (
+            "import jax\n"
+            "def warm(fns):\n"
+            "    out = []\n"
+            "    for fn in fns:\n"
+            "        out.append(jax.jit(fn))\n"
+            "    return out\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["jit-in-loop"]
+
+    def test_host_sync_scoped_to_hot_path_modules(self):
+        src = (
+            "def respond(result):\n"
+            "    return result.block_until_ready()\n"
+        )
+        hot = _lint_source(src, path="predictionio_tpu/workflow/serving.py")
+        assert [f.rule_id for f in hot] == ["jit-host-sync-serving"]
+        # same source outside the hot path: no finding
+        assert _lint_source(src, path="predictionio_tpu/ops/als.py") == []
+
+    def test_module_level_device_array_fires(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "SCALE = jnp.ones((8, 128))\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["jit-module-device-array"]
+
+    def test_nonhashable_static_default_fires(self):
+        src = (
+            "import functools, jax\n"
+            "@functools.partial(jax.jit, static_argnames=('opts',))\n"
+            "def f(x, opts=[]):\n"
+            "    return x\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["jit-nonhashable-static"]
+
+
+class TestMosaicRuleScoping:
+    def test_blockspec_tiling_fires_on_unaligned_literal(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        _k,\n"
+            "        in_specs=[pl.BlockSpec((8, 56), lambda i: (i, 0))],\n"
+            "    )(x)\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["mosaic-blockspec-tiling"]
+
+    def test_smem_blockspec_exempt(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "from jax.experimental.pallas import tpu as pltpu\n"
+            "def call(x):\n"
+            "    return pl.pallas_call(\n"
+            "        _k,\n"
+            "        in_specs=[pl.BlockSpec((4, 60), lambda i: (i, 0),\n"
+            "                               memory_space=pltpu.SMEM)],\n"
+            "    )(x)\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_non_kernel_function_not_scanned_for_lane_slices(self):
+        # pl.ds-looking code outside any pallas_call kernel: Family A
+        # does not apply (host-side helpers may slice freely)
+        src = (
+            "import jax.numpy as jnp\n"
+            "def host_helper(x_ref):\n"
+            "    return x_ref[:, 3:19]\n"
+        )
+        assert _lint_source(src) == []
+
+
+class TestSuppressions:
+    BAD_KERNEL = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def _k(x_ref, o_ref):\n"
+        "    o_ref[:] = x_ref[:, pl.ds(16, 16)]{comment}\n"
+        "def call(x, out_shape):\n"
+        "    return pl.pallas_call(_k, out_shape=out_shape)(x)\n"
+    )
+
+    def test_suppression_with_reason_suppresses(self):
+        src = self.BAD_KERNEL.format(
+            comment="  # pio: lint-ok[mosaic-unaligned-lane-slice] fixture"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["mosaic-unaligned-lane-slice"]
+        assert findings[0].suppressed
+        assert findings[0].suppress_reason == "fixture"
+
+    def test_suppression_on_line_above_applies(self):
+        src = self.BAD_KERNEL.replace(
+            "    o_ref[:] = x_ref[:, pl.ds(16, 16)]{comment}\n",
+            "    # pio: lint-ok[mosaic-unaligned-lane-slice] one above\n"
+            "    o_ref[:] = x_ref[:, pl.ds(16, 16)]\n",
+        )
+        findings = _lint_source(src)
+        assert [f.suppressed for f in findings] == [True]
+
+    def test_bare_suppression_is_itself_a_finding(self):
+        src = self.BAD_KERNEL.format(
+            comment="  # pio: lint-ok[mosaic-unaligned-lane-slice]"
+        )
+        findings = _lint_source(src)
+        ids = {f.rule_id for f in findings if not f.suppressed}
+        assert "lint-suppression-missing-reason" in ids
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        src = self.BAD_KERNEL.format(
+            comment="  # pio: lint-ok[mosaic-rank3-compare] wrong id"
+        )
+        findings = [f for f in _lint_source(src) if not f.suppressed]
+        assert "mosaic-unaligned-lane-slice" in [f.rule_id for f in findings]
+
+    def test_unused_suppression_is_reported_stale(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "# pio: lint-ok[jit-in-loop] exception long since fixed\n"
+            "def f(x):\n"
+            "    return x\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["lint-unused-suppression"]
+
+    def test_select_cannot_manufacture_staleness(self):
+        # the suppression's rule did not run, so its use is unknowable —
+        # no stale report
+        src = (
+            "# pio: lint-ok[jit-in-loop] exception long since fixed\n"
+            "def f(x):\n"
+            "    return x\n"
+        )
+        from predictionio_tpu.lint import all_rules as _all
+
+        rules = [r for r in _all() if r.id == "jit-python-branch"]
+        findings = lint_file("predictionio_tpu/x.py", rules=rules, source=src)
+        assert findings == []
+
+    def test_trailing_suppression_does_not_cover_next_line(self):
+        # a suppression trailing code on line N covers line N only; the
+        # same-rule violation on line N+1 must still be reported
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _k(x_ref, o_ref):\n"
+            "    a = x_ref[:, pl.ds(16, 16)]  "
+            "# pio: lint-ok[mosaic-unaligned-lane-slice] reviewed\n"
+            "    b = x_ref[:, pl.ds(32, 16)]\n"
+            "    o_ref[:] = a + b\n"
+            "def call(x, out_shape):\n"
+            "    return pl.pallas_call(_k, out_shape=out_shape)(x)\n"
+        )
+        findings = _lint_source(src)
+        unsuppressed = [f for f in findings if not f.suppressed]
+        assert [(f.rule_id, f.line) for f in unsuppressed] == [
+            ("mosaic-unaligned-lane-slice", 6)
+        ]
+
+    def test_pattern_in_string_literal_is_not_a_suppression(self):
+        # the pattern inside a string on the line directly above the
+        # finding — only a real comment may suppress
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _k(x_ref, o_ref):\n"
+            '    doc = "# pio: lint-ok[mosaic-unaligned-lane-slice] ok"\n'
+            "    o_ref[:] = x_ref[:, pl.ds(16, 16)]\n"
+            "def call(x, out_shape):\n"
+            "    return pl.pallas_call(_k, out_shape=out_shape)(x)\n"
+        )
+        unsuppressed = [f for f in _lint_source(src) if not f.suppressed]
+        assert [f.rule_id for f in unsuppressed] == [
+            "mosaic-unaligned-lane-slice"
+        ]
+
+
+# ---------------------------------------------------------------------------
+# 3. CLI contract + the self-lint gate
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*argv: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.lint", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+class TestCLI:
+    def test_exit_nonzero_on_unsuppressed_findings(self):
+        proc = _run_cli(os.path.join(FIXTURES, "rank3_compare_bad.py"))
+        assert proc.returncode == 1
+        assert "mosaic-rank3-compare" in proc.stdout
+
+    def test_exit_zero_on_clean_file(self):
+        proc = _run_cli(os.path.join(FIXTURES, "rank3_compare_clean.py"))
+        assert proc.returncode == 0
+
+    def test_closed_pipe_dies_quietly(self, tmp_path):
+        # `pio lint ... | head` closes stdout early: no traceback may
+        # reach stderr (the old behavior raised BrokenPipeError out of
+        # print at interpreter exit)
+        for i in range(250):
+            (tmp_path / f"f{i}.py").write_text(
+                open(
+                    os.path.join(FIXTURES, "unaligned_lane_slice_bad.py")
+                ).read()
+            )
+        proc = subprocess.run(
+            f"{sys.executable} -m predictionio_tpu.tools.lint "
+            f"{tmp_path} | head -c 100 > /dev/null",
+            shell=True, capture_output=True, text=True, cwd=REPO,
+            timeout=120,
+        )
+        assert "Traceback" not in proc.stderr, proc.stderr[-2000:]
+
+    def test_nonexistent_path_fails_the_gate(self):
+        # a typo'd target must never read as lint-clean
+        proc = _run_cli("no/such/dir_xyz")
+        assert proc.returncode == 1
+        assert "no such file or directory" in proc.stdout
+
+    def test_json_format_is_machine_readable(self):
+        proc = _run_cli(
+            os.path.join(FIXTURES, "per_row_dma_bad.py"), "--format", "json"
+        )
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is False
+        assert [f["rule"] for f in doc["findings"]] == ["mosaic-per-row-dma"]
+        assert doc["findings"][0]["path"].endswith("per_row_dma_bad.py")
+
+    def test_select_restricts_rules(self):
+        proc = _run_cli(
+            os.path.join(FIXTURES, "per_row_dma_bad.py"),
+            "--select", "mosaic-rank3-compare",
+        )
+        assert proc.returncode == 0  # the only finding is a per-row-dma
+
+    def test_list_rules_covers_both_families(self):
+        proc = _run_cli("--list-rules")
+        assert proc.returncode == 0
+        assert "mosaic-unaligned-lane-slice" in proc.stdout
+        assert "jit-python-branch" in proc.stdout
+
+    def test_unreadable_file_is_a_parse_error_not_a_crash(self, tmp_path):
+        # null bytes raise ValueError from ast.parse; the run must record
+        # a parse error and exit 1, not hand the watcher a traceback
+        bad = tmp_path / "nul.py"
+        bad.write_bytes(b"x = 1\x00\n")
+        proc = _run_cli(str(tmp_path))
+        assert proc.returncode == 1
+        assert "parse-error" in proc.stdout
+        assert "Traceback" not in proc.stderr
+
+    def test_hidden_and_vendored_dirs_are_pruned(self, tmp_path):
+        venv = tmp_path / ".venv"
+        venv.mkdir()
+        (venv / "vendored.py").write_text(
+            "import jax.numpy as jnp\nX = jnp.ones((8, 128))\n"
+        )
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        proc = _run_cli(str(tmp_path))
+        assert proc.returncode == 0
+        assert "1 files" in proc.stdout
+
+    def test_hot_path_scoping_survives_relative_invocation(
+        self, tmp_path, monkeypatch
+    ):
+        # the `cd workflow && pio lint serving.py` shape: path-scoped
+        # rules must see the module identity through a bare filename
+        wf = tmp_path / "workflow"
+        wf.mkdir()
+        (wf / "serving.py").write_text(
+            "def respond(r):\n    return r.block_until_ready()\n"
+        )
+        monkeypatch.chdir(wf)
+        findings = lint_file("serving.py")
+        assert [f.rule_id for f in findings] == ["jit-host-sync-serving"]
+
+    def test_console_subcommand_dispatches(self):
+        # `pio lint` rides bin/pio -> tools.console -> tools.lint; the
+        # console path must work without a storage plane or jax import
+        proc = subprocess.run(
+            [sys.executable, "-m", "predictionio_tpu.tools.console",
+             "lint", os.path.join(FIXTURES, "rank3_compare_bad.py")],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "mosaic-rank3-compare" in proc.stdout
+
+
+class TestSelfLintGate:
+    """The tier-1 gate: the package itself must stay lint-clean. A new
+    Pallas PR that reintroduces a round-5 bug class fails here before it
+    ever reaches a compile."""
+
+    def test_package_has_zero_unsuppressed_findings(self):
+        result = lint_paths([PACKAGE])
+        assert result.errors == [], result.errors
+        assert result.findings == [], (
+            "unsuppressed lint findings in the package:\n"
+            + render_text(result)
+        )
+
+    def test_every_suppression_carries_a_reason(self):
+        result = lint_paths([PACKAGE])
+        missing = [f for f in result.suppressed if not f.suppress_reason]
+        assert missing == [], [f.as_dict() for f in missing]
+
+    def test_rule_catalog_is_documented(self):
+        """docs/lint.md is the catalog the suppression workflow points
+        people at — every shipped rule id must appear there."""
+        with open(os.path.join(REPO, "docs", "lint.md")) as fh:
+            doc = fh.read()
+        for rule in all_rules():
+            assert rule.id in doc, f"rule {rule.id} missing from docs/lint.md"
+
+    def test_json_reporter_roundtrips_package_result(self):
+        result = lint_paths([PACKAGE])
+        doc = json.loads(render_json(result))
+        assert doc["ok"] is True
+        assert doc["files"] == result.files
+        assert all(f["suppressed"] for f in doc["suppressed"])

@@ -26,6 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# deviceless AOT compile of every Pallas kernel: minutes of XLA/Mosaic work
+pytestmark = pytest.mark.slow
+
 from predictionio_tpu.ops.attention import flash_attention_pallas
 from predictionio_tpu.ops.pallas_kernels import (
     gramian_fused,
@@ -76,8 +79,9 @@ class TestMosaicAOT:
     def test_spd_solve_under_shard_map(self):
         # the exact embedding ops/als.py uses under a mesh: per-device
         # pallas blocks inside shard_map, compiled for a 4-chip slice
-        from jax import shard_map
         from jax.experimental import topologies
+
+        from predictionio_tpu.parallel.collectives import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         topo4 = _topology("v5e:2x2")

@@ -19,6 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# multi-chip/multi-slice AOT compiles: minutes of XLA/Mosaic work
+pytestmark = pytest.mark.slow
+
 from predictionio_tpu.ops import als
 from predictionio_tpu.ops.attention import ring_attention, ulysses_attention
 from predictionio_tpu.tools.prewarm_cache import _stage_avals

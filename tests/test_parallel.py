@@ -10,6 +10,9 @@ import jax
 import numpy as np
 import pytest
 
+# ~7 min of CPU-mesh collectives + sharded ALS: outside the tier-1 budget
+pytestmark = pytest.mark.slow
+
 from predictionio_tpu.ops.als import ALSConfig, als_train_coo
 from predictionio_tpu.parallel import (
     MeshConfig,

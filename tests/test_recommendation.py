@@ -225,6 +225,7 @@ class TestGatherLeverParams:
     must be reachable from engine.json via ALSAlgorithmParams and
     reproduce the default path's factors."""
 
+    @pytest.mark.slow  # ~90 s: three full trainings; outside tier-1 budget
     def test_levers_reproduce_default_model(self, registry):
         ingest_ratings(registry)
         engine = engine_factory()
