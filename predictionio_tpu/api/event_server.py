@@ -11,6 +11,13 @@ Rebuild of the reference Event Server
 - ``GET /stats.json``            → hourly + lifetime counters (``--stats`` only)
                                                                       (``EventAPI.scala:327-345``)
 
+``POST /events.json`` (and each element of the batch route) accepts an
+optional client-supplied ``idempotencyKey``: duplicate POSTs with the same
+key insert exactly one event (the key derives a deterministic ``eventId``
+and dedup rides the stores' upsert-by-id path) — the contract that makes
+write retries safe for the serving feedback loop and ``storage/remote.py``
+(see ``docs/robustness.md``).
+
 Every route authenticates via the ``accessKey`` query parameter resolved to an
 ``appId`` through the metadata store (``withAccessKey``,
 ``EventAPI.scala:149-164``); missing or unknown keys get
@@ -38,6 +45,7 @@ from ..storage.event import (
     Event,
     EventValidationError,
     format_event_time,
+    idempotency_event_id,
     parse_event_time,
     utcnow,
     validate_event,
@@ -230,6 +238,24 @@ class _EventServiceHandler(JsonHTTPHandler):
     def do_DELETE(self) -> None:  # noqa: N802
         self._route("DELETE")
 
+    @staticmethod
+    def _apply_idempotency_key(obj: dict, app_id: int) -> None:
+        """``idempotencyKey`` (optional, client-supplied, per event): a
+        duplicate POST with the same key must insert exactly once. The
+        key maps to a deterministic ``eventId``, so dedup rides the
+        stores' upsert-by-id semantics — no extra index, and it works
+        identically through the remote storage plane. An explicit
+        ``eventId`` wins (the client already controls identity)."""
+        key = obj.pop("idempotencyKey", None)
+        if key is None:
+            return
+        if not isinstance(key, str) or not key:
+            raise EventValidationError(
+                "idempotencyKey must be a non-empty string"
+            )
+        if not obj.get("eventId"):
+            obj["eventId"] = idempotency_event_id(app_id, key)
+
     # -- routes -----------------------------------------------------------
     def _post_event(self, query: Dict[str, list]) -> None:
         """``EventAPI.scala:229-252``."""
@@ -237,6 +263,8 @@ class _EventServiceHandler(JsonHTTPHandler):
         raw = self._body
         try:
             obj = json.loads(raw.decode("utf-8"))
+            if isinstance(obj, dict):
+                self._apply_idempotency_key(obj, app_id)
             event = Event.from_json_dict(obj)
             validate_event(event)
         except (ValueError, KeyError, EventValidationError) as exc:
@@ -269,6 +297,8 @@ class _EventServiceHandler(JsonHTTPHandler):
         valid: list = []  # (position, event)
         for pos, obj in enumerate(objs):
             try:
+                if isinstance(obj, dict):
+                    self._apply_idempotency_key(obj, app_id)
                 event = Event.from_json_dict(obj)
                 validate_event(event)
                 valid.append((pos, event))

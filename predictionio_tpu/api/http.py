@@ -29,11 +29,16 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True
 
     def respond(
-        self, status: int, payload: Any, content_type: str = "application/json"
+        self,
+        status: int,
+        payload: Any,
+        content_type: str = "application/json",
+        headers: Any = None,
     ) -> None:
         """Send a response. JSON payloads are dumped; raw ``bytes`` (and
         ``str`` only for non-JSON content types, e.g. HTML pages) pass
-        through verbatim."""
+        through verbatim. ``headers`` adds extra response headers (e.g.
+        ``Retry-After`` on a load-shed 503)."""
         if isinstance(payload, bytes):
             body = payload
         elif isinstance(payload, str) and content_type != "application/json":
@@ -43,6 +48,8 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, str(value))
         self.end_headers()
         self.wfile.write(body)
 

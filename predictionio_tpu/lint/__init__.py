@@ -8,13 +8,16 @@ full compile-debug cycle. The bug classes are mechanical, so this
 package catches them at AST level, before XLA/Mosaic ever runs: the
 "catch it in the graph, not on the device" discipline.
 
-Two rule families (see ``docs/lint.md`` for the full catalog):
+Three rule families (see ``docs/lint.md`` for the full catalog):
 
 - **Family A — Mosaic/Pallas hygiene** (``rules_mosaic``): applied to
   functions passed to ``pl.pallas_call`` (plus helpers they call) and to
   block-shape literals anywhere. Rule ids ``mosaic-*``.
 - **Family B — jit-boundary hygiene** (``rules_jit``): applied
   package-wide. Rule ids ``jit-*``.
+- **Family C — robustness hygiene** (``rules_robust``): applied
+  package-wide; guards the ISSUE-2 resilience discipline (timeouts on
+  every network call, jittered retries). Rule ids ``robust-*``.
 
 Suppression: ``# pio: lint-ok[rule-id] reason`` on the finding's line or
 as a comment-only line directly above. The reason is mandatory — a bare

@@ -429,9 +429,9 @@ def build_context(path: str, source: Optional[str] = None) -> FileContext:
 
 
 def all_rules() -> List[Rule]:
-    from . import rules_jit, rules_mosaic
+    from . import rules_jit, rules_mosaic, rules_robust
 
-    return [*rules_mosaic.RULES, *rules_jit.RULES]
+    return [*rules_mosaic.RULES, *rules_jit.RULES, *rules_robust.RULES]
 
 
 @dataclasses.dataclass

@@ -1,0 +1,191 @@
+"""Family C — robustness hygiene rules, applied package-wide.
+
+The resilience layer (``utils/resilience.py``, ISSUE 2) only holds if
+new code keeps its discipline: every network call bounded by a timeout,
+every retry loop jittered. Both failure shapes are mechanical, so — like
+the Mosaic and jit families — they are caught at AST level, before the
+first incident:
+
+- ``robust-no-timeout``: a network call with no explicit timeout is an
+  unbounded hang waiting for a half-dead peer; one stalled dependency
+  then wedges a handler thread (or the whole feedback pool) forever.
+- ``robust-bare-sleep-retry``: a retry loop that sleeps a constant
+  synchronizes every failing client into a thundering herd — the exact
+  pathology full-jitter backoff (``RetryPolicy``) exists to kill.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from .engine import FileContext, Finding, Rule, call_name, dotted_name
+
+#: requests.<verb>(...) — the high-level HTTP client surface
+_REQUESTS_VERBS = frozenset(
+    {"get", "post", "put", "patch", "delete", "head", "options", "request"}
+)
+
+#: (callable name, index of the positional slot that carries the timeout,
+#: or None when the API takes it as keyword-only in practice)
+_TIMEOUT_POSITIONS = {
+    "urlopen": 2,  # urllib.request.urlopen(url, data, timeout)
+    "HTTPConnection": 2,  # http.client.HTTPConnection(host, port, timeout=..)
+    "HTTPSConnection": 2,
+    "create_connection": 1,  # socket.create_connection(address, timeout)
+}
+
+
+def _has_timeout(node: ast.Call, positional_slot: Optional[int]) -> bool:
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return True
+    # a **kwargs splat may carry it — give the benefit of the doubt
+    if any(kw.arg is None for kw in node.keywords):
+        return True
+    return positional_slot is not None and len(node.args) > positional_slot
+
+
+class NoTimeout(Rule):
+    """Network call without an explicit timeout: the stdlib and requests
+    default to *blocking forever*, so a peer that accepts the connection
+    and then stalls holds the calling thread for good."""
+
+    id = "robust-no-timeout"
+    severity = "error"
+    short = (
+        "network call (requests.*/urlopen/HTTPConnection/"
+        "create_connection) without an explicit timeout"
+    )
+    motivation = (
+        "the pre-ISSUE-2 serving path hung indefinitely on a stalled "
+        "Event Server because nothing bounded the socket wait; a "
+        "timeout is the floor of every other resilience primitive"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            name = call_name(node)
+            if (
+                dn.startswith("requests.")
+                and dn.count(".") == 1
+                and name in _REQUESTS_VERBS
+            ):
+                if not _has_timeout(node, None):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{dn}(...) without timeout= blocks forever on a "
+                        "stalled peer — pass an explicit timeout (and "
+                        "consider utils/resilience.RetryPolicy + "
+                        "CircuitBreaker around it).",
+                    )
+                continue
+            if name in _TIMEOUT_POSITIONS and (
+                name == dn  # bare name from a from-import
+                or dn
+                in (
+                    f"urllib.request.{name}",
+                    f"request.{name}",
+                    f"http.client.{name}",
+                    f"client.{name}",
+                    f"socket.{name}",
+                )
+            ):
+                if not _has_timeout(node, _TIMEOUT_POSITIONS[name]):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{dn or name}(...) without an explicit timeout "
+                        "blocks forever on a stalled peer — pass "
+                        "timeout=.",
+                    )
+
+
+def _walk_in_scope(root: ast.AST):
+    """``ast.walk`` that does NOT descend into nested scopes (function /
+    lambda / class definitions): a sleep inside a ``def`` that merely
+    happens to be *defined* within a loop is not part of the loop's
+    retry schedule."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                continue
+            stack.append(child)
+
+
+def _const_number(node: ast.AST, ctx: FileContext) -> bool:
+    """Is ``node`` a compile-time numeric constant (literal, module-level
+    int constant, or unary minus of one)?"""
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ) and not isinstance(node.value, bool):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _const_number(node.operand, ctx)
+    return ctx.const_int(node) is not None
+
+
+class BareSleepRetry(Rule):
+    """A retry loop sleeping a constant (``except: time.sleep(N)`` inside
+    a loop) has no jitter: every client that hit the same failure wakes
+    at the same instant and stampedes the recovering dependency."""
+
+    id = "robust-bare-sleep-retry"
+    severity = "error"
+    short = (
+        "retry loop sleeping a constant inside an except handler "
+        "(no jitter)"
+    )
+    motivation = (
+        "constant-delay retries synchronize a fleet into thundering "
+        "herds; utils/resilience.RetryPolicy gives the full-jitter "
+        "schedule for free (and topology.py's lockfile retry shows the "
+        "pattern)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen = set()  # nested loops share handlers: report each once
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for handler in _walk_in_scope(loop):
+                if (
+                    not isinstance(handler, ast.ExceptHandler)
+                    or id(handler) in seen
+                ):
+                    continue
+                seen.add(id(handler))
+                yield from self._sleeps_in(handler, ctx)
+
+    def _sleeps_in(
+        self, handler: ast.ExceptHandler, ctx: FileContext
+    ) -> Iterator[Finding]:
+        for node in _walk_in_scope(handler):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn not in ("time.sleep", "sleep"):
+                continue
+            if node.args and _const_number(node.args[0], ctx):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"retry loop sleeps a constant ({dn}(...) in an "
+                    "except handler): no jitter means synchronized "
+                    "retry stampedes — use "
+                    "utils/resilience.RetryPolicy's full-jitter "
+                    "backoff.",
+                )
+
+
+RULES: List[Rule] = [NoTimeout(), BareSleepRetry()]

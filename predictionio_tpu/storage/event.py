@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import hashlib
 from typing import Any, Mapping, Optional, Sequence, Union
 
 from .data_map import DataMap
@@ -183,6 +184,24 @@ class Event:
             creation_time=_as_datetime(obj.get("creationTime")) or now,
             event_id=obj.get("eventId"),
         )
+
+
+def idempotency_event_id(app_id: int, key: str) -> str:
+    """Deterministic event id for a client-supplied ``idempotencyKey``.
+
+    The dedup mechanism rides the stores' existing upsert-by-``event_id``
+    semantics (SQLite ``INSERT OR REPLACE``, the native log's
+    last-write-wins replay): same ``(app, key)`` → same id → at most one
+    stored event, however many times the POST is retried. That is what
+    finally makes *writes* safe to retry on the online path — a retried
+    insert with a key can only land on top of itself.
+    """
+    digest = hashlib.sha256(
+        f"{int(app_id)}\x00{key}".encode("utf-8")
+    ).hexdigest()
+    # "idem" prefix keeps these ids visually distinct from the composite
+    # entity-hash/millis/uuid scheme of make_event_id
+    return f"idem{digest[:44]}"
 
 
 def with_event_id(event: Event, event_id: str) -> Event:

@@ -18,6 +18,18 @@ backend (the pluggability contract, ``Storage.scala:176-217``).
 
 Event scans stream as ndjson, so ``find`` over a huge app yields in bounded
 memory on both sides.
+
+Resilience (``docs/robustness.md``): every request honors the ambient
+request :class:`~predictionio_tpu.utils.resilience.Deadline` (socket
+timeout capped to the remaining budget; the budget is forwarded via the
+``X-PIO-Deadline-Ms`` header so the server can short-circuit too), each
+storage netloc gets a :class:`CircuitBreaker` (``PIO_BREAKER_*`` env) so
+a dead storage server fast-fails instead of stacking connect timeouts,
+and writes retry only when they are *provably replayable* — an event
+carrying an ``event_id`` (e.g. minted from an idempotency key) upserts,
+so its POST may take the same one-shot stale-connection retry reads get.
+All wire I/O routes through the fault-injection point ``remote.send``
+(``predictionio_tpu/testing/faults.py``).
 """
 
 from __future__ import annotations
@@ -25,9 +37,18 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import time
 import urllib.parse
 from typing import Iterator, Optional
 
+from ..testing.faults import fault_point
+from ..utils.resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DEADLINE_HEADER,
+    current_deadline,
+)
 from .backends import BackendFamily, SourceConf, register_backend
 from .event import Event
 from .events import EventFilter, EventStore
@@ -63,6 +84,38 @@ class _NetlocPool(threading.local):
 
 
 _pool = _NetlocPool()
+
+
+# -- per-netloc circuit breakers ---------------------------------------------
+#
+# One breaker per storage endpoint, shared by every store/thread talking
+# to it: when the storage server is down, the FIRST few operations pay
+# the connect timeout and every subsequent one fast-fails with a clear
+# "circuit open" error until the cooldown elapses and a probe goes out.
+# The clock is module-level-injectable so breaker timing is testable.
+
+_breakers: dict = {}
+_breakers_lock = threading.Lock()
+_breaker_clock = time.monotonic
+
+
+def _get_breaker(netloc: str) -> CircuitBreaker:
+    with _breakers_lock:
+        breaker = _breakers.get(netloc)
+        if breaker is None:
+            breaker = CircuitBreaker.from_env(netloc, clock=_breaker_clock)
+            _breakers[netloc] = breaker
+        return breaker
+
+
+def reset_resilience(clock=None) -> None:
+    """Forget all breaker state (and optionally swap the breaker clock).
+    Test hook — production processes never need it."""
+    global _breaker_clock
+    with _breakers_lock:
+        _breakers.clear()
+        if clock is not None:
+            _breaker_clock = clock
 
 
 def _conn_is_dead(conn) -> bool:
@@ -150,21 +203,28 @@ def _request(
     body: Optional[bytes] = None,
     timeout: float = 60.0,
     idempotent: Optional[bool] = None,
+    deadline: Optional[Deadline] = None,
 ):
     """``idempotent`` enables the one-shot stale-connection retry and
     unconditional pool reuse. Default: GET/DELETE only. POST call sites
     that are semantically reads (find, columnar scans) or natural upserts
-    (init, model put) opt in.
+    (init, model put, keyed event inserts) opt in.
 
-    Non-idempotent requests (event inserts, bulk writes) get NO retry — a
-    request the server executed before dying would be applied twice. They
-    may still borrow a pooled connection, but only after a liveness probe
-    (``_conn_is_dead``): a socket the server closed while idle shows EOF
-    and is discarded for a fresh connection, so the common stale-keep-alive
-    failure can't hit a write, while high-rate writers keep keep-alive
-    (no per-event TCP handshake). The probe-to-send race window — server
-    closes in the microseconds between — surfaces as a loud
-    RemoteStorageError, never a silent replay."""
+    Non-idempotent requests (unkeyed event inserts, bulk writes) get NO
+    retry — a request the server executed before dying would be applied
+    twice. They may still borrow a pooled connection, but only after a
+    liveness probe (``_conn_is_dead``): a socket the server closed while
+    idle shows EOF and is discarded for a fresh connection, so the common
+    stale-keep-alive failure can't hit a write, while high-rate writers
+    keep keep-alive (no per-event TCP handshake). The probe-to-send race
+    window — server closes in the microseconds between — surfaces as a
+    loud RemoteStorageError, never a silent replay.
+
+    ``deadline`` (default: the ambient request deadline, if any) caps the
+    socket timeout to the remaining budget and is forwarded in the
+    ``X-PIO-Deadline-Ms`` header; an already-expired deadline raises
+    before any socket work. The per-netloc circuit breaker fast-fails
+    every call while the endpoint is known-dead (see module docstring)."""
     parsed = urllib.parse.urlsplit(url)
     if parsed.scheme not in ("http", "https"):
         raise RemoteStorageError(f"unsupported URL scheme in {url!r}")
@@ -179,7 +239,25 @@ def _request(
     netloc = f"{parsed.scheme}://{parsed.netloc}"
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
     headers = {"Content-Type": "application/json"} if body is not None else {}
+    if deadline is None:
+        deadline = current_deadline()
+    breaker = _get_breaker(netloc)
+    try:
+        breaker.before_call()
+    except CircuitOpen as exc:
+        raise RemoteStorageError(
+            f"{method} {url} not attempted: {exc}"
+        ) from exc
+    base_timeout = timeout
     for attempt in (0, 1):
+        # Deadline accounting PER ATTEMPT: the stale-keep-alive retry
+        # must re-check the budget, re-cap its socket timeout to what is
+        # actually left, and forward the CURRENT remaining ms — not the
+        # figures computed before attempt 0 burned part of the budget.
+        if deadline is not None:
+            deadline.check(f"{method} {url}")
+            timeout = deadline.cap_timeout(base_timeout)
+            headers[DEADLINE_HEADER] = deadline.header_value()
         conn = _pool.conns.pop(netloc, None)
         if conn is not None and not idempotent and _conn_is_dead(conn):
             # a write must not meet a stale socket (no retry is allowed);
@@ -205,6 +283,15 @@ def _request(
                 )
                 fresh = True
         try:
+            # fault-injection boundary: an injected refuse/close/reset
+            # takes exactly the except paths a real one would
+            fault_point(
+                "remote.send",
+                method=method,
+                url=url,
+                fresh=fresh,
+                idempotent=idempotent,
+            )
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
         except Exception as exc:
@@ -216,7 +303,7 @@ def _request(
             # connection the server closed while idle fails with a
             # connection-level error. Timeouts and fresh-connection
             # failures must NOT retry — the request may have executed
-            # server-side, and storage writes are not idempotent.
+            # server-side, and unkeyed storage writes are not idempotent.
             stale_reuse = (
                 not fresh
                 and idempotent
@@ -230,10 +317,14 @@ def _request(
                 )
             )
             if not stale_reuse:
+                breaker.record_failure()
                 raise RemoteStorageError(
                     f"{method} {url} unreachable: {exc}"
                 ) from exc
             continue
+        # a response of ANY status proves the endpoint is alive: HTTP
+        # errors are the server talking, not the dependency being down
+        breaker.record_success()
         if resp.status >= 400:
             detail = resp.read().decode("utf-8", "replace")[:500]
             if resp.isclosed() and not getattr(resp, "will_close", False):
@@ -276,7 +367,15 @@ class RemoteEventStore(EventStore):
 
     def insert(self, event: Event, app_id: int) -> str:
         body = json.dumps(event.to_json_dict()).encode()
-        with _request(self._url(app_id), "POST", body, self._timeout) as r:
+        # An event that already carries its id (client-assigned, or
+        # minted from an idempotencyKey upstream) is an UPSERT on the
+        # server: replaying it lands on itself, so the POST may take the
+        # one-shot stale-connection retry. Unkeyed inserts keep NO retry
+        # — a replay would double-insert.
+        with _request(
+            self._url(app_id), "POST", body, self._timeout,
+            idempotent=event.event_id is not None,
+        ) as r:
             return _json(r)["eventId"]
 
     def get(self, event_id: str, app_id: int) -> Optional[Event]:

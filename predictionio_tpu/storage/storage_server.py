@@ -20,7 +20,16 @@ Wire surface (all JSON unless noted):
 * ``POST /metadata/rpc``               ``{"method", "args"}`` → ``{"result"}``
   (whitelisted MetadataStore methods; dataclasses encoded by ``wire.py``)
 * ``PUT|GET|DELETE /models/<id>``      raw model bytes
-* ``GET /health``                      liveness probe
+* ``GET /``                            ``{"status": "alive", ...}`` readiness
+  (Event-Server parity, ``EventAPI.scala:168-175``) with uptime and the
+  backing store classes
+* ``GET /health``                      liveness probe (kept for existing
+  probes; ``GET /`` is the richer twin)
+
+Requests may carry an ``X-PIO-Deadline-Ms`` header (remaining budget in
+milliseconds, set by the ``storage/remote.py`` client when an ambient
+request deadline is live): an already-expired request is answered with
+``504`` before any store work runs.
 
 Run it with ``pio storageserver`` or :func:`create_storage_server`.
 """
@@ -34,6 +43,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..utils.resilience import DEADLINE_HEADER, Deadline
 from .event import Event
 from .events import EventFilter
 from .metadata import MetadataStore
@@ -92,7 +102,17 @@ class _StorageHandler(JsonHTTPHandler):
         path = urlparse(self.path).path.rstrip("/")
         parts = [p for p in path.split("/") if p]
         try:
-            if parts == ["health"]:
+            # Deadline admission: a request whose budget is already gone
+            # must not spend store work producing an answer nobody waits
+            # for (the client gave up remaining_ms ago).
+            deadline = Deadline.from_header(self.headers.get(DEADLINE_HEADER))
+            if deadline is not None and deadline.expired:
+                self.read_body()
+                self.respond(504, {"message": "deadline exceeded"})
+                return
+            if not parts and method == "GET":
+                self.respond(200, self.server.status_json())
+            elif parts == ["health"]:
                 self.respond(200, {"status": "alive"})
             elif parts and parts[0] == "events":
                 self._route_events(method, parts[1:])
@@ -268,6 +288,20 @@ class StorageServer(BackgroundHTTPServer):
         self.events = events
         self.metadata = metadata
         self.models = models
+        self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
+
+    def status_json(self) -> dict:
+        """``GET /`` readiness body — Event-Server ``{"status": "alive"}``
+        parity plus enough identity for a fleet dashboard."""
+        return {
+            "status": "alive",
+            "startTime": self.start_time.isoformat(timespec="milliseconds"),
+            "stores": {
+                "events": type(self.events).__name__,
+                "metadata": type(self.metadata).__name__,
+                "models": type(self.models).__name__,
+            },
+        }
 
 
 def create_storage_server(

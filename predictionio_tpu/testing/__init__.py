@@ -1,0 +1,18 @@
+"""Test-support plane: deterministic fault injection for the online path.
+
+Production modules route their network I/O through
+:func:`predictionio_tpu.testing.faults.fault_point` call sites; this
+package turns those sites into controllable failure points in tests and
+chaos runs while costing one ``None``-check in production.
+"""
+
+from .faults import FaultSpec, activate, deactivate, fault_point, inject, parse
+
+__all__ = [
+    "FaultSpec",
+    "activate",
+    "deactivate",
+    "fault_point",
+    "inject",
+    "parse",
+]

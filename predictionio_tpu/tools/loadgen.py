@@ -14,12 +14,25 @@ Two modes:
   the prediction path (micro-batcher + device dispatch) from HTTP
   overhead, i.e. the ceiling the serving stack itself imposes.
 
+Resilience drive (``docs/robustness.md`` cookbook):
+
+- ``--deadline-ms N`` stamps every request with an ``X-PIO-Deadline-Ms``
+  budget; responses shed by the server (503) and expired-deadline 504s
+  are counted separately from hard errors, so the report shows the
+  *server's* overload behavior instead of burying it in ``errors``.
+- ``--fault SPEC`` (repeatable; ``site=kind[:arg][*times]``, the
+  ``PIO_FAULTS`` syntax) activates the deterministic fault harness in
+  this process — faults fire inside an ``--in-process`` server's I/O.
+  Against a live HTTP server, start *it* with ``PIO_FAULTS=...`` in its
+  environment and use loadgen to observe the degradation; loadgen prints
+  the equivalent env assignment so the two stay in sync.
+
 Usage::
 
     python -m predictionio_tpu.tools.loadgen \
         --url http://localhost:8000/queries.json \
         --payload '{"user": "1", "num": 10}' \
-        --concurrency 32 --duration 10
+        --concurrency 32 --duration 10 --deadline-ms 250
 
 The payload may contain ``{i}`` which each worker substitutes with a
 rotating integer (vary the queried user).
@@ -38,6 +51,10 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+#: response-class counters beyond plain latency samples
+_SHED = 503
+_EXPIRED = 504
+
 
 class _Worker(threading.Thread):
     def __init__(self, target, payloads: Sequence[bytes], stop_at: float):
@@ -47,6 +64,8 @@ class _Worker(threading.Thread):
         self.stop_at = stop_at
         self.latencies: List[float] = []
         self.errors = 0
+        self.shed = 0
+        self.deadline_expired = 0
 
     def run(self) -> None:
         i = 0
@@ -54,41 +73,48 @@ class _Worker(threading.Thread):
             payload = self.payloads[i % len(self.payloads)]
             t0 = time.monotonic()
             try:
-                ok = self.target(payload)
+                status = self.target(payload)
             except Exception:
-                ok = False
+                status = -1
             elapsed = time.monotonic() - t0
-            if ok:
+            if status == 200:
                 self.latencies.append(elapsed)
+            elif status == _SHED:
+                self.shed += 1
+            elif status == _EXPIRED:
+                self.deadline_expired += 1
             else:
                 self.errors += 1
             i += 1
 
 
-def _http_target(url: str):
+def _http_target(url: str, deadline_ms: Optional[float] = None):
     parsed = urlparse(url)
     # One persistent connection PER WORKER THREAD: http.client connections
     # are not thread-safe, and sharing one socket across workers would
     # interleave request/response pairs and corrupt every measurement.
     local = threading.local()
 
-    def send(payload: bytes) -> bool:
+    def send(payload: bytes) -> int:
         conn = getattr(local, "conn", None)
         if conn is None:
             conn = http.client.HTTPConnection(
                 parsed.hostname, parsed.port or 80, timeout=30
             )
             local.conn = conn
+        headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            headers["X-PIO-Deadline-Ms"] = str(int(deadline_ms))
         try:
             conn.request(
                 "POST",
                 parsed.path or "/queries.json",
                 body=payload,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             )
             resp = conn.getresponse()
             resp.read()
-            return resp.status == 200
+            return resp.status
         except Exception:
             local.conn = None  # reconnect next attempt
             try:
@@ -106,8 +132,8 @@ def run_load(
     concurrency: int,
     duration_s: float,
 ) -> dict:
-    """Drive ``target(payload) -> bool`` from ``concurrency`` threads for
-    ``duration_s``; returns {qps, p50_ms, p99_ms, ...}."""
+    """Drive ``target(payload) -> status`` from ``concurrency`` threads
+    for ``duration_s``; returns {qps, p50_ms, p99_ms, shed, ...}."""
     stop_at = time.monotonic() + duration_s
     t0 = time.monotonic()
     workers = [_Worker(target, payloads, stop_at) for _ in range(concurrency)]
@@ -120,10 +146,14 @@ def run_load(
         [np.asarray(w.latencies) for w in workers if w.latencies]
     ) if any(w.latencies for w in workers) else np.zeros(0)
     errors = sum(w.errors for w in workers)
+    shed = sum(w.shed for w in workers)
+    expired = sum(w.deadline_expired for w in workers)
     n = int(lats.size)
     out = {
         "requests": n,
         "errors": errors,
+        "shed": shed,
+        "deadline_expired": expired,
         "wall_s": round(wall, 3),
         "qps": round(n / wall, 1) if wall > 0 else 0.0,
         "concurrency": concurrency,
@@ -143,10 +173,12 @@ def _expand_payloads(template: str, n: int = 256) -> List[bytes]:
 
 
 def _inprocess_target(engine_dir: str, batching: bool,
-                      pipeline_depth: int = 2):
+                      pipeline_depth: int = 2,
+                      deadline_ms: Optional[float] = None):
     """Build a QueryServer (without binding HTTP traffic through sockets)
     and return a callable driving handle_query directly."""
     from ..storage.registry import get_registry
+    from ..utils.resilience import Deadline, DeadlineExceeded
     from ..workflow import loader
     from ..workflow.serving import QueryServer, ServerConfig
     from .register import load_engine_dir
@@ -162,9 +194,17 @@ def _inprocess_target(engine_dir: str, batching: bool,
     )
     server = QueryServer(config, engine, get_registry())
 
-    def send(payload: bytes) -> bool:
-        result, status = server.handle_query(json.loads(payload))
-        return status == 200
+    def send(payload: bytes) -> int:
+        deadline = (
+            Deadline.after_ms(deadline_ms) if deadline_ms is not None else None
+        )
+        try:
+            result, status = server.handle_query(
+                json.loads(payload), deadline
+            )
+        except DeadlineExceeded:
+            return _EXPIRED
+        return status
 
     return send, server
 
@@ -186,7 +226,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="disable micro-batching in --in-process mode")
     p.add_argument("--pipeline-depth", type=int, default=2,
                    help="in-flight batch depth in --in-process mode")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request X-PIO-Deadline-Ms budget; 504s are "
+                        "reported as deadline_expired, not errors")
+    p.add_argument("--fault", action="append", default=[],
+                   metavar="SITE=KIND[:ARG][*N]",
+                   help="activate the deterministic fault harness "
+                        "(predictionio_tpu.testing.faults) in this "
+                        "process; repeatable. For a live HTTP server, "
+                        "start it with PIO_FAULTS set instead.")
     args = p.parse_args(argv)
+
+    if args.fault:
+        from ..testing import faults
+
+        specs = [s for text in args.fault for s in faults.parse(text)]
+        faults.activate(*specs)
+        if not args.in_process:
+            # faults live in the SERVER process; hand the operator the
+            # exact env line to arm a live server identically
+            print(
+                f"# to arm a live server: PIO_FAULTS={';'.join(args.fault)!r}",
+                file=sys.stderr,
+            )
 
     payloads = _expand_payloads(args.payload)
     server = None
@@ -199,9 +261,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         target, server = _inprocess_target(
             args.engine_dir, batching=not args.no_batching,
             pipeline_depth=args.pipeline_depth,
+            deadline_ms=args.deadline_ms,
         )
     else:
-        target = _http_target(args.url)
+        target = _http_target(args.url, deadline_ms=args.deadline_ms)
 
     # warm-up: first queries pay jit compile
     for payload in payloads[:4]:
@@ -213,8 +276,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     result = run_load(target, payloads, args.concurrency, args.duration)
     result["mode"] = "in-process" if args.in_process else "http"
+    if args.deadline_ms is not None:
+        result["deadline_ms"] = args.deadline_ms
+    if args.fault:
+        result["faults"] = args.fault
     if server is not None and server._batcher is not None:
         result["batching"] = server._batcher.stats
+    if server is not None:
+        result["serving_stats"] = server.stats.snapshot()
     print(json.dumps(result))
     return 0
 

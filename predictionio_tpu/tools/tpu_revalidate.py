@@ -279,6 +279,7 @@ def run_loadgen_sweep(engine_dir: str, duration_s: float,
                 up = True
                 break
             except Exception:
+                # pio: lint-ok[robust-bare-sleep-retry] readiness poll of a local spawn at a fixed 1 s cadence (60 s budget); one waiter, so jitter has nothing to spread
                 time.sleep(1)
         try:
             if not up:

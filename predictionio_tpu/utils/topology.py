@@ -4,7 +4,10 @@
 holds a machine-wide lockfile during plugin init — a concurrent device
 probe, prewarm run, or test session makes the first attempt fail
 transiently. Every in-repo user (``tools/prewarm_cache``, the Mosaic
-AOT test modules) goes through this helper so they all share the retry.
+AOT test modules) goes through this helper so they all share the retry
+(full-jittered via the shared :class:`RetryPolicy`: the contenders are
+exactly the processes that would otherwise wake in lockstep and collide
+on the lockfile again).
 
 Argument-format note (cost a whole round to discover):
 ``chips_per_host_bounds`` must be a TUPLE OF INTS, e.g. ``(1, 1, 1)``;
@@ -13,7 +16,7 @@ string forms are rejected by libtpu with a mangled type error.
 
 from __future__ import annotations
 
-import time
+from .resilience import RetryPolicy
 
 
 def get_deviceless_topology(name: str, retries: int = 1,
@@ -24,14 +27,12 @@ def get_deviceless_topology(name: str, retries: int = 1,
     (no libtpu at all) raises immediately."""
     from jax.experimental import topologies
 
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            return topologies.get_topology_desc(name, "tpu", **kwargs)
-        except Exception as exc:
-            last = exc
-            if "lockfile" in str(exc) and attempt < retries:
-                time.sleep(retry_delay_s)
-                continue
-            raise
-    raise last  # unreachable; keeps type-checkers happy
+    policy = RetryPolicy(
+        attempts=retries + 1,
+        base_delay_s=retry_delay_s,
+        max_delay_s=retry_delay_s * 2,
+    )
+    return policy.call(
+        lambda: topologies.get_topology_desc(name, "tpu", **kwargs),
+        should_retry=lambda exc: "lockfile" in str(exc),
+    )

@@ -21,6 +21,17 @@ with ``entityType=pio_pr``, a generated 64-char ``prId``, and properties
 ``{engineInstanceId, query, prediction}`` POSTed to the Event Server; when
 the prediction carries a ``prId`` field the response is stamped with the
 generated id.
+
+Resilience (``docs/robustness.md``): requests carry an optional
+``X-PIO-Deadline-Ms`` budget checked at admission and again before the
+MicroBatcher dispatch (an expired query never wastes a device slot);
+admission is bounded (``PIO_SERVING_MAX_QUEUE`` in-flight queries, then
+``503`` + ``Retry-After`` instead of unbounded thread pile-up); the
+Event-Server feedback and ``--log-url`` POSTs ride a shared
+``RetryPolicy`` (feedback events carry an ``idempotencyKey`` so the
+retries cannot double-insert) behind per-sink ``CircuitBreaker``s; when
+a breaker is open the server keeps answering from the HBM-resident
+last-good model and reports ``degraded: true`` in its status.
 """
 
 from __future__ import annotations
@@ -30,12 +41,14 @@ import datetime as _dt
 import html
 import json
 import logging
+import os
 import random
 import string
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, List, Optional, Sequence, Tuple
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 from urllib.parse import urlparse
 
 import requests
@@ -44,11 +57,27 @@ from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..controller.engine import Engine, EngineParams
 from ..storage import StorageRegistry, utcnow
 from ..storage.metadata import STATUS_COMPLETED, EngineInstance
+from ..testing.faults import fault_point
+from ..utils.resilience import (
+    DEADLINE_HEADER,
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    deadline_scope,
+)
 from .batching import MicroBatcher
 from .context import WorkflowContext
 from .core_workflow import load_models
 
 logger = logging.getLogger(__name__)
+
+#: Default in-flight admission cap (``PIO_SERVING_MAX_QUEUE`` overrides):
+#: enough to keep batch_max-sized micro-batches formable under load,
+#: small enough that a stalled device fails new arrivals in microseconds
+#: instead of stacking handler threads until the process dies.
+DEFAULT_MAX_QUEUE = 128
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +124,12 @@ class ServerConfig:
     #: Remote error log: serving failures POST {message, query} here
     #: (``--log-url``, ``CreateServer.scala:409-420``). None = disabled.
     log_url: Optional[str] = None
+    #: Bounded admission: max queries in flight (handler threads admitted
+    #: past the front door) before new arrivals shed with 503 +
+    #: Retry-After. None = ``PIO_SERVING_MAX_QUEUE`` env (default
+    #: ``DEFAULT_MAX_QUEUE``); 0 disables shedding (unbounded, the
+    #: pre-resilience behavior).
+    max_queue: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +195,68 @@ def _get_pr_id(obj: Any) -> Optional[str]:
 
 def _has_pr_id(obj: Any) -> bool:
     return (isinstance(obj, dict) and "prId" in obj) or hasattr(obj, "pr_id")
+
+
+# ---------------------------------------------------------------------------
+# Serving stats (CreateServer.scala:392-394,567-574, grown with the
+# resilience counters the status page reports)
+# ---------------------------------------------------------------------------
+
+
+class ServingStats:
+    """Thread-safe serving counters.
+
+    Beyond the reference's request count / serving times, every
+    resilience outcome is *counted*, not just logged: shed admissions,
+    expired deadlines, feedback/error-log delivery failures and
+    breaker-skipped deliveries — a fleet monitor reads these off
+    ``GET /`` instead of scraping logs."""
+
+    _COUNTERS = (
+        "shed",
+        "deadline_expired",
+        "feedback_sent",
+        "feedback_failures",
+        "feedback_skipped",
+        "error_log_failures",
+        "error_log_skipped",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.request_count = 0
+        self.last_serving_sec = 0.0
+        self.avg_serving_sec = 0.0
+        for name in self._COUNTERS:
+            setattr(self, name, 0)
+
+    def record_request(self, elapsed_s: float) -> None:
+        with self._lock:
+            self.last_serving_sec = elapsed_s
+            self.avg_serving_sec = (
+                self.avg_serving_sec * self.request_count + elapsed_s
+            ) / (self.request_count + 1)
+            self.request_count += 1
+
+    def inc(self, counter: str) -> None:
+        if counter not in self._COUNTERS:
+            raise ValueError(f"unknown serving counter {counter!r}")
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "requests": self.request_count,
+                "lastServingMs": round(self.last_serving_sec * 1000, 3),
+                "avgServingMs": round(self.avg_serving_sec * 1000, 3),
+            }
+            for name in self._COUNTERS:
+                # camelCase the wire names to match the rest of the API
+                parts = name.split("_")
+                key = parts[0] + "".join(p.title() for p in parts[1:])
+                out[key] = getattr(self, name)
+            return out
 
 
 # ---------------------------------------------------------------------------
@@ -251,9 +348,31 @@ class _QueryHandler(JsonHTTPHandler):
         except ValueError as exc:
             self.respond(400, {"message": str(exc)})
             return
+        # Bounded admission BEFORE any engine work: at the cap the
+        # overload answer is an instant 503 + Retry-After, not another
+        # handler thread piling onto a saturated device (the shed-don't-
+        # queue discipline of the ads-serving paper in PAPERS.md).
+        if not self.server.admit():
+            self.server.stats.inc("shed")
+            self.respond(
+                503,
+                {"message": "server overloaded; shedding load"},
+                headers={"Retry-After": self.server.retry_after_s()},
+            )
+            return
+        deadline = Deadline.from_header(
+            self.headers.get(DEADLINE_HEADER), clock=self.server.clock
+        )
         try:
-            result, status = self.server.handle_query(payload)
+            if deadline is not None:
+                # admission-stage check: a budget that is already gone
+                # spends zero decode/supplement work
+                deadline.check("admission")
+            result, status = self.server.handle_query(payload, deadline)
             self.respond(status, result)
+        except DeadlineExceeded as exc:
+            self.server.stats.inc("deadline_expired")
+            self.respond(504, {"message": str(exc), "stage": exc.stage})
         except QueryDecodeError as exc:
             # the reference remote-logs the bad-query branch too
             # (CreateServer.scala:583-590)
@@ -263,11 +382,23 @@ class _QueryHandler(JsonHTTPHandler):
             logger.exception("Query failed")
             self.server.post_error_log(str(exc), payload)
             self.respond(500, {"message": str(exc)})
+        finally:
+            self.server.release()
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
-        if path == "/":
-            self.respond(200, self.server.status_html(), content_type="text/html")
+        if path == "/" or path == "/status.json":
+            # content negotiation: browsers keep the HTML status page,
+            # monitors GET /status.json (or Accept: application/json)
+            # for the machine-readable twin with breaker states and
+            # shed counters
+            accept = self.headers.get("Accept", "")
+            if path == "/status.json" or "application/json" in accept:
+                self.respond(200, self.server.status_json())
+            else:
+                self.respond(
+                    200, self.server.status_html(), content_type="text/html"
+                )
         elif path == "/reload":
             try:
                 self.server.reload()
@@ -293,6 +424,11 @@ class QueryServer(BackgroundHTTPServer):
         registry: StorageRegistry,
         deployment: Optional[Deployment] = None,
         ctx: Optional[WorkflowContext] = None,
+        clock: Callable[[], float] = time.monotonic,
+        retry_policy: Optional[RetryPolicy] = None,
+        feedback_breaker: Optional[CircuitBreaker] = None,
+        error_log_breaker: Optional[CircuitBreaker] = None,
+        reload_breaker: Optional[CircuitBreaker] = None,
     ):
         self.config = config
         self.engine = engine
@@ -302,6 +438,30 @@ class QueryServer(BackgroundHTTPServer):
         self.deployment = deployment or prepare_deployment(
             engine, registry, config, self.ctx
         )
+        # Resilience plumbing (docs/robustness.md). The clock and policy
+        # objects are injectable so the whole fault suite runs without a
+        # wall-clock sleep; defaults come from the PIO_BREAKER_* env.
+        self.clock = clock
+        self._retry = retry_policy or RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=1.0
+        )
+        self.feedback_breaker = feedback_breaker or CircuitBreaker.from_env(
+            "event-server", clock=clock
+        )
+        self.error_log_breaker = error_log_breaker or CircuitBreaker.from_env(
+            "error-log", clock=clock
+        )
+        self.reload_breaker = reload_breaker or CircuitBreaker.from_env(
+            "reload", clock=clock
+        )
+        if config.max_queue is not None:
+            self._max_queue = config.max_queue
+        else:
+            self._max_queue = int(
+                os.environ.get("PIO_SERVING_MAX_QUEUE", str(DEFAULT_MAX_QUEUE))
+            )
+        self._admission_lock = threading.Lock()
+        self._inflight = 0
         # Bounded async feedback delivery (CreateServer's fire-and-forget
         # future, without unbounded thread growth under load).
         self._feedback_pool = ThreadPoolExecutor(
@@ -322,50 +482,131 @@ class QueryServer(BackgroundHTTPServer):
             if config.batching
             else None
         )
-        # Serving stats (CreateServer.scala:392-394,567-574)
-        self._stats_lock = threading.Lock()
+        # Serving stats (CreateServer.scala:392-394,567-574 + resilience)
+        self.stats = ServingStats()
         self.server_start_time = utcnow()
-        self.request_count = 0
-        self.last_serving_sec = 0.0
-        self.avg_serving_sec = 0.0
         super().__init__((config.ip, config.port), _QueryHandler)
 
+    # Pre-resilience attribute surface, kept for callers/tests that read
+    # the counters straight off the server object.
+    @property
+    def request_count(self) -> int:
+        return self.stats.request_count
+
+    @property
+    def last_serving_sec(self) -> float:
+        return self.stats.last_serving_sec
+
+    @property
+    def avg_serving_sec(self) -> float:
+        return self.stats.avg_serving_sec
+
+    # -- admission (bounded queue → shed, never pile up) -------------------
+    def admit(self) -> bool:
+        if self._max_queue <= 0:  # 0 = unbounded (explicit opt-out)
+            return True
+        with self._admission_lock:
+            if self._inflight >= self._max_queue:
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        if self._max_queue <= 0:
+            return
+        with self._admission_lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def retry_after_s(self) -> int:
+        """Retry-After for a shed request: one worst-case batch drain,
+        floored at 1 s (the resolution HTTP gives us)."""
+        drain = self.stats.avg_serving_sec * 2
+        return max(1, int(drain + 0.999))
+
+    @property
+    def degraded(self) -> bool:
+        """True while any dependency breaker is not closed — the server
+        still answers (from the HBM-resident last-good model), but a
+        fleet monitor should know the feedback/reload plane is impaired."""
+        return any(
+            b.state != CircuitBreaker.CLOSED
+            for b in (
+                self.feedback_breaker,
+                self.error_log_breaker,
+                self.reload_breaker,
+            )
+        )
+
     # -- query path (CreateServer.scala:458-577) --------------------------
-    def handle_query(self, payload: Any) -> Tuple[Any, int]:
+    def handle_query(
+        self, payload: Any, deadline: Optional[Deadline] = None
+    ) -> Tuple[Any, int]:
         started = time.monotonic()
         query_time = utcnow()
         with self._deploy_lock:
             dep = self.deployment
-        try:
-            query = decode_query(dep.algorithms, payload)
-        except (TypeError, AttributeError, KeyError) as exc:
-            raise QueryDecodeError(f"Invalid query: {exc}") from exc
-        query = dep.serving.supplement(query)
-        if self._batcher is not None:
-            predictions = self._batcher.submit((dep, query))
-        else:
-            predictions = self._predict_one(dep, query)
-        prediction = dep.serving.serve(query, predictions)
-        result = encode_result(prediction)
+        with deadline_scope(deadline):
+            try:
+                query = decode_query(dep.algorithms, payload)
+            except (TypeError, AttributeError, KeyError) as exc:
+                raise QueryDecodeError(f"Invalid query: {exc}") from exc
+            query = dep.serving.supplement(query)
+            if deadline is not None:
+                # the load-shed moment that matters most: an expired query
+                # must never occupy a device slot (ISSUE 2 tentpole)
+                deadline.check("dispatch")
+            if self._batcher is not None:
+                try:
+                    predictions = self._batcher.submit(
+                        (dep, query),
+                        timeout=(
+                            deadline.remaining_s()
+                            if deadline is not None
+                            else None
+                        ),
+                    )
+                except FutureTimeoutError:
+                    raise DeadlineExceeded(
+                        "deadline exceeded waiting for batched dispatch",
+                        stage="batch-wait",
+                    ) from None
+            else:
+                predictions = self._predict_one(dep, query)
+            prediction = dep.serving.serve(query, predictions)
+            result = encode_result(prediction)
 
         if self.config.feedback:
             result = self._send_feedback(dep, query_time, query, prediction, result)
 
-        elapsed = time.monotonic() - started
-        with self._stats_lock:
-            self.last_serving_sec = elapsed
-            self.avg_serving_sec = (
-                self.avg_serving_sec * self.request_count + elapsed
-            ) / (self.request_count + 1)
-            self.request_count += 1
+        self.stats.record_request(time.monotonic() - started)
         return result, 200
+
+    def _post_json(self, site: str, url: str, data: Any) -> None:
+        """One retried JSON POST to a sink (the shared delivery path of
+        the feedback and error-log planes). Raises on final failure so
+        the caller's breaker records ONE failure per logical delivery,
+        not one per attempt. Retrying a *write* is safe here because
+        both sinks dedupe: feedback events carry an ``idempotencyKey``
+        and the error log is an append-only diagnostic stream."""
+
+        def attempt() -> None:
+            fault_point(site, url=url)
+            resp = requests.post(url, json=data, timeout=10)
+            if resp.status_code not in (200, 201):
+                raise RuntimeError(
+                    f"{site} POST -> HTTP {resp.status_code}"
+                )
+
+        self._retry.call(attempt)
 
     def post_error_log(self, message: str, payload: Any) -> None:
         """Fire-and-forget POST of a serving failure to ``log_url``
         (``CreateServer.scala:409-420`` — remote error reporting for
         fleet-monitored deployments). Rides the bounded feedback pool so
         an error storm against a slow sink cannot spawn unbounded
-        threads, and never adds a failure of its own to the request."""
+        threads, and never adds a failure of its own to the request; a
+        dead sink trips ``error_log_breaker`` so the storm stops paying
+        connect timeouts entirely."""
         url = self.config.log_url
         if not url:
             return
@@ -376,19 +617,21 @@ class QueryServer(BackgroundHTTPServer):
             instance_id = self.deployment.instance.id
         except Exception:
             instance_id = None
+        data = {
+            "engineInstance": instance_id,
+            "message": message,
+            "query": payload,
+        }
 
         def send() -> None:
             try:
-                requests.post(
-                    url,
-                    json={
-                        "engineInstance": instance_id,
-                        "message": message,
-                        "query": payload,
-                    },
-                    timeout=10,
+                self.error_log_breaker.call(
+                    self._post_json, "serving.error_log", url, data
                 )
+            except CircuitOpen:
+                self.stats.inc("error_log_skipped")
             except Exception:
+                self.stats.inc("error_log_failures")
                 logger.debug("error-log POST to %s failed", url, exc_info=True)
 
         try:
@@ -462,6 +705,11 @@ class QueryServer(BackgroundHTTPServer):
                 "query": encode_result(query),
                 "prediction": encode_result(prediction),
             },
+            # prId is unique per prediction, so it doubles as the event's
+            # idempotency key: the RetryPolicy may replay this POST after
+            # an ambiguous failure and the Event Server still inserts
+            # exactly one event (docs/robustness.md).
+            "idempotencyKey": new_pr_id,
         }
         query_pr_id = _get_pr_id(query)
         if query_pr_id is not None:
@@ -473,19 +721,7 @@ class QueryServer(BackgroundHTTPServer):
             f"?accessKey={self.config.access_key or ''}"
         )
 
-        def post() -> None:
-            try:
-                resp = requests.post(url, json=data, timeout=10)
-                if resp.status_code != 201:
-                    logger.error(
-                        "Feedback event failed. Status code: %s. Data: %s",
-                        resp.status_code,
-                        data,
-                    )
-            except Exception as exc:
-                logger.error("Feedback event failed: %s", exc)
-
-        self._feedback_pool.submit(post)
+        self._feedback_pool.submit(self._deliver_feedback, url, data)
 
         # Stamp the generated prId into the response only for predictions
         # that carry a prId slot (CreateServer.scala:558-565).
@@ -494,6 +730,25 @@ class QueryServer(BackgroundHTTPServer):
             result.pop("pr_id", None)  # replace the stale slot, don't duplicate
             result["prId"] = new_pr_id
         return result
+
+    def _deliver_feedback(self, url: str, data: dict) -> None:
+        """Breaker-guarded, retried feedback delivery (pool thread).
+
+        While the Event Server is down the breaker opens after
+        ``failure_threshold`` deliveries and subsequent feedback is
+        *skipped* (counted, not attempted): queries keep serving from the
+        resident model at full speed instead of each paying a connect
+        timeout — the degraded mode ``GET /`` surfaces."""
+        try:
+            self.feedback_breaker.call(
+                self._post_json, "serving.feedback", url, data
+            )
+            self.stats.inc("feedback_sent")
+        except CircuitOpen:
+            self.stats.inc("feedback_skipped")
+        except Exception as exc:
+            self.stats.inc("feedback_failures")
+            logger.error("Feedback event failed: %s", exc)
 
     # -- lifecycle --------------------------------------------------------
     def server_close(self) -> None:
@@ -505,7 +760,14 @@ class QueryServer(BackgroundHTTPServer):
     def reload(self) -> None:
         """Hot-swap to the latest completed instance
         (``CreateServer.scala:300-321``): the new tables are staged first,
-        then the references swap under the lock."""
+        then the references swap under the lock.
+
+        Failures (storage down, corrupt instance) ride
+        ``reload_breaker``: the resident last-good tables keep serving
+        (degradation is nearly free — they never left HBM), repeated
+        failures open the breaker so reload storms fast-fail, and the
+        status page shows ``degraded: true`` until a probe reload
+        succeeds."""
         cfg = dataclasses.replace(
             self.config,
             engine_instance_id=None,
@@ -513,7 +775,9 @@ class QueryServer(BackgroundHTTPServer):
             engine_version=self.deployment.instance.engine_version,
             engine_variant=self.deployment.instance.engine_variant,
         )
-        fresh = prepare_deployment(self.engine, self.registry, cfg, self.ctx)
+        fresh = self.reload_breaker.call(
+            prepare_deployment, self.engine, self.registry, cfg, self.ctx
+        )
         with self._deploy_lock:
             old = self.deployment.instance.id
             self.deployment = fresh
@@ -522,31 +786,73 @@ class QueryServer(BackgroundHTTPServer):
         )
 
     # -- status page (CreateServer.scala:421-456) -------------------------
+    def status_json(self) -> dict:
+        """Machine-readable status: the HTML page's facts plus breaker
+        states, shed/deadline counters and the degraded flag (``GET
+        /status.json``, or ``GET /`` with ``Accept: application/json``)."""
+        dep = self.deployment
+        out = {
+            "status": "degraded" if self.degraded else "alive",
+            "degraded": self.degraded,
+            "engineInstance": dep.instance.id,
+            "engine": {
+                "id": dep.instance.engine_id,
+                "version": dep.instance.engine_version,
+                "factory": dep.instance.engine_factory,
+            },
+            "startTime": str(self.server_start_time),
+            "feedback": self.config.feedback,
+            "maxQueue": self._max_queue,
+            "stats": self.stats.snapshot(),
+            "breakers": {
+                "eventServer": self.feedback_breaker.snapshot(),
+                "errorLog": self.error_log_breaker.snapshot(),
+                "reload": self.reload_breaker.snapshot(),
+            },
+        }
+        if self._batcher is not None:
+            out["batching"] = self._batcher.stats
+        return out
+
     def status_html(self) -> str:
         dep = self.deployment
-        with self._stats_lock:
-            rows = [
-                ("Engine instance", dep.instance.id),
-                ("Engine", f"{dep.instance.engine_id} {dep.instance.engine_version}"),
-                ("Engine factory", dep.instance.engine_factory),
-                ("Start time", str(self.server_start_time)),
-                ("Algorithms", ", ".join(type(a).__name__ for a in dep.algorithms)),
-                ("Models", ", ".join(type(m).__name__ for m in dep.models)),
-                ("Serving", type(dep.serving).__name__),
-                ("Feedback enabled", str(self.config.feedback)),
-                ("Request count", str(self.request_count)),
-                ("Average serving time", f"{self.avg_serving_sec * 1000:.3f} ms"),
-                ("Last serving time", f"{self.last_serving_sec * 1000:.3f} ms"),
-            ]
-            if self._batcher is not None:
-                bs = self._batcher.stats
-                rows.append(
-                    (
-                        "Micro-batching",
-                        f"{bs['batches']} batches, "
-                        f"avg {bs['avg_batch']:.1f} queries/batch",
+        stats = self.stats.snapshot()
+        rows = [
+            ("Engine instance", dep.instance.id),
+            ("Engine", f"{dep.instance.engine_id} {dep.instance.engine_version}"),
+            ("Engine factory", dep.instance.engine_factory),
+            ("Start time", str(self.server_start_time)),
+            ("Algorithms", ", ".join(type(a).__name__ for a in dep.algorithms)),
+            ("Models", ", ".join(type(m).__name__ for m in dep.models)),
+            ("Serving", type(dep.serving).__name__),
+            ("Feedback enabled", str(self.config.feedback)),
+            ("Request count", str(stats["requests"])),
+            ("Average serving time", f"{stats['avgServingMs']:.3f} ms"),
+            ("Last serving time", f"{stats['lastServingMs']:.3f} ms"),
+            ("Degraded", str(self.degraded)),
+            ("Shed requests", str(stats["shed"])),
+            ("Expired deadlines", str(stats["deadlineExpired"])),
+            (
+                "Breakers",
+                ", ".join(
+                    f"{name}={b.state}"
+                    for name, b in (
+                        ("event-server", self.feedback_breaker),
+                        ("error-log", self.error_log_breaker),
+                        ("reload", self.reload_breaker),
                     )
+                ),
+            ),
+        ]
+        if self._batcher is not None:
+            bs = self._batcher.stats
+            rows.append(
+                (
+                    "Micro-batching",
+                    f"{bs['batches']} batches, "
+                    f"avg {bs['avg_batch']:.1f} queries/batch",
                 )
+            )
         cells = "".join(
             f"<tr><th>{html.escape(k)}</th><td>{html.escape(v)}</td></tr>"
             for k, v in rows

@@ -1,0 +1,20 @@
+"""Robustness bug class 2: a retry loop sleeping a constant.
+
+Every client that hit the same failure wakes at the same instant and
+stampedes the recovering dependency — the thundering-herd shape
+full-jitter backoff exists to kill. ``robust-bare-sleep-retry`` must
+flag the sleep below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import time
+
+
+def fetch_with_retry(fetch):
+    for _attempt in range(5):
+        try:
+            return fetch()
+        except ConnectionError:
+            time.sleep(2.0)  # constant backoff, no jitter: BAD
+    raise RuntimeError("gave up")

@@ -1,0 +1,21 @@
+"""Clean twin of ``bare_sleep_retry_bad.py``: the retry schedule comes
+from the shared full-jitter policy (and a pacing sleep outside any
+except handler stays legal). The linter must report NOTHING for this
+file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import time
+
+from predictionio_tpu.utils.resilience import RetryPolicy
+
+
+def fetch_with_retry(fetch):
+    policy = RetryPolicy(attempts=5, base_delay_s=0.1, max_delay_s=2.0)
+    return policy.call(fetch, should_retry=lambda e: isinstance(e, ConnectionError))
+
+
+def drain(pending):
+    while pending():  # pacing loop, no retry/except: sleeps stay legal
+        time.sleep(0.005)

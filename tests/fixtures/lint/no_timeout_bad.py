@@ -1,0 +1,16 @@
+"""Robustness bug class 1: a network call with no explicit timeout.
+
+The pre-ISSUE-2 serving feedback path was one stalled Event Server away
+from wedging its delivery pool forever, because nothing bounded the
+socket wait. ``robust-no-timeout`` must flag the POST below (and nothing
+else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import requests
+
+
+def deliver_feedback(url, data):
+    resp = requests.post(url, json=data)  # no timeout: BAD
+    return resp.status_code == 201

@@ -1,0 +1,32 @@
+"""Clean twin of ``no_timeout_bad.py``: every network call carries an
+explicit timeout (kwarg or the API's positional timeout slot). The
+linter must report NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import http.client
+import socket
+import urllib.request
+
+import requests
+
+
+def deliver_feedback(url, data):
+    resp = requests.post(url, json=data, timeout=10)  # bounded: OK
+    return resp.status_code == 201
+
+
+def probe(host, port):
+    conn = http.client.HTTPConnection(host, port, timeout=5)
+    conn.request("GET", "/")
+    return conn.getresponse().status
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def raw(addr):
+    return socket.create_connection(addr, 2.0)  # positional timeout slot

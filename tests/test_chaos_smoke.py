@@ -1,0 +1,519 @@
+"""Fast chaos smoke suite for the serving/ingestion resilience layer.
+
+The ISSUE-2 acceptance battery, proven deterministically: every timing-
+sensitive behavior (breaker cooldown, retry schedule) runs on injected
+clocks and no-op sleeps — there is not a single wall-clock sleep in this
+file, so the whole fault suite rides inside the tier-1 budget.
+
+The query-server tests run against a STUB deployment (no training, no
+jax): ``QueryServer`` takes a prebuilt ``Deployment``, so the resilience
+machinery is exercised through real HTTP round trips while the model
+plane is a two-line echo algorithm.
+"""
+
+import json
+import threading
+
+import pytest
+import requests
+
+from predictionio_tpu.api.event_server import EventServer, EventServerConfig
+from predictionio_tpu.storage import (
+    AccessKey,
+    App,
+    MetadataStore,
+    SqliteEventStore,
+)
+from predictionio_tpu.storage.event import idempotency_event_id, utcnow
+from predictionio_tpu.storage.events import EventFilter
+from predictionio_tpu.storage.metadata import STATUS_COMPLETED, EngineInstance
+from predictionio_tpu.testing import faults
+from predictionio_tpu.utils.resilience import (
+    CircuitBreaker,
+    RetryPolicy,
+)
+from predictionio_tpu.workflow.serving import (
+    Deployment,
+    QueryServer,
+    ServerConfig,
+)
+
+from test_resilience import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# Stub model plane
+# ---------------------------------------------------------------------------
+
+
+class EchoAlgo:
+    """predict = identity; batch_predict counts device dispatches."""
+
+    def __init__(self, on_predict=None):
+        self.dispatches = 0
+        self.on_predict = on_predict
+
+    def query_class(self):
+        return None
+
+    def predict(self, model, query):
+        if self.on_predict is not None:
+            self.on_predict()
+        return {"echo": query}
+
+    def batch_predict(self, model, indexed):
+        self.dispatches += 1
+        return [(pos, {"echo": q}) for pos, q in indexed]
+
+
+class PassServing:
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def _deployment(algo):
+    now = utcnow()
+    inst = EngineInstance(
+        id="inst-chaos", status=STATUS_COMPLETED, start_time=now,
+        end_time=now, engine_id="chaos", engine_version="1",
+        engine_variant="engine.json", engine_factory="stub.Factory",
+    )
+    return Deployment(
+        instance=inst, engine_params=None, algorithms=[algo],
+        models=[None], serving=PassServing(),
+    )
+
+
+def _server(algo=None, clock=None, **cfg):
+    """A QueryServer over the stub deployment; retries never sleep."""
+    algo = algo or EchoAlgo()
+    clock = clock or FakeClock()
+    cfg.setdefault("batching", False)
+    config = ServerConfig(ip="127.0.0.1", port=0, **cfg)
+    srv = QueryServer(
+        config,
+        engine=None,
+        registry=None,
+        deployment=_deployment(algo),
+        clock=clock,
+        retry_policy=RetryPolicy(attempts=2, sleep=lambda s: None),
+        feedback_breaker=CircuitBreaker(
+            "event-server", failure_threshold=2, reset_timeout_s=10.0,
+            clock=clock,
+        ),
+        error_log_breaker=CircuitBreaker(
+            "error-log", failure_threshold=2, reset_timeout_s=10.0,
+            clock=clock,
+        ),
+        reload_breaker=CircuitBreaker(
+            "reload", failure_threshold=2, reset_timeout_s=10.0, clock=clock,
+        ),
+    )
+    srv.start_background()
+    return srv, f"http://127.0.0.1:{srv.bound_port}", algo, clock
+
+
+def _close(srv):
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except Exception:
+        pass
+
+
+class _Sink:
+    """Tiny always-201 HTTP sink (a healthy Event Server stand-in)."""
+
+    def __enter__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        hits = self.hits = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                hits.append(self.path)
+                self.send_response(201)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}/events.json"
+        return self
+
+    def __exit__(self, *exc):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Load shedding (bounded admission)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadShedding:
+    def test_overload_sheds_503_with_retry_after(self):
+        entered = threading.Semaphore(0)
+        release = threading.Event()
+
+        def block():
+            entered.release()
+            assert release.wait(timeout=30)
+
+        srv, base, _, _ = _server(algo=EchoAlgo(on_predict=block), max_queue=2)
+        try:
+            results = []
+
+            def post():
+                results.append(
+                    requests.post(f"{base}/queries.json", json={"q": 1},
+                                  timeout=30)
+                )
+
+            workers = [threading.Thread(target=post) for _ in range(2)]
+            for w in workers:
+                w.start()
+            # both requests are INSIDE predict (admitted, occupying the
+            # whole queue) before the third arrives — deterministic
+            assert entered.acquire(timeout=10)
+            assert entered.acquire(timeout=10)
+
+            shed = requests.post(f"{base}/queries.json", json={"q": 3},
+                                 timeout=10)
+            assert shed.status_code == 503
+            assert "Retry-After" in shed.headers
+            assert int(shed.headers["Retry-After"]) >= 1
+
+            release.set()
+            for w in workers:
+                w.join(timeout=30)
+            assert [r.status_code for r in results] == [200, 200]
+            assert srv.stats.shed == 1
+            # admission slots were released: the server accepts again
+            ok = requests.post(f"{base}/queries.json", json={"q": 4},
+                               timeout=10)
+            assert ok.status_code == 200
+        finally:
+            release.set()
+            _close(srv)
+
+    def test_zero_max_queue_disables_shedding(self):
+        srv, base, _, _ = _server(max_queue=0)
+        try:
+            assert requests.post(f"{base}/queries.json", json={},
+                                 timeout=10).status_code == 200
+            assert srv.stats.shed == 0
+        finally:
+            _close(srv)
+
+    def test_env_knob_sets_the_cap(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVING_MAX_QUEUE", "17")
+        srv, _, _, _ = _server()  # max_queue=None → env
+        try:
+            assert srv._max_queue == 17
+        finally:
+            _close(srv)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_expired_deadline_short_circuits_before_device_dispatch(self):
+        algo = EchoAlgo()
+        srv, base, _, _ = _server(algo=algo, batching=True)
+        try:
+            r = requests.post(
+                f"{base}/queries.json", json={"q": 1},
+                headers={"X-PIO-Deadline-Ms": "0"}, timeout=10,
+            )
+            assert r.status_code == 504
+            assert "deadline" in r.json()["message"]
+            assert r.json()["stage"] == "admission"  # caught at the door
+            # the whole point: the expired query never reached the device
+            assert algo.dispatches == 0
+            assert srv.stats.deadline_expired == 1
+
+            # a live budget flows through normally
+            r = requests.post(
+                f"{base}/queries.json", json={"q": 2},
+                headers={"X-PIO-Deadline-Ms": "30000"}, timeout=10,
+            )
+            assert r.status_code == 200
+            assert algo.dispatches == 1
+        finally:
+            _close(srv)
+
+    def test_no_header_means_no_deadline(self):
+        srv, base, _, _ = _server()
+        try:
+            assert requests.post(f"{base}/queries.json", json={},
+                                 timeout=10).status_code == 200
+            assert srv.stats.deadline_expired == 0
+        finally:
+            _close(srv)
+
+    def test_malformed_header_degrades_to_no_deadline(self):
+        srv, base, _, _ = _server()
+        try:
+            r = requests.post(
+                f"{base}/queries.json", json={},
+                headers={"X-PIO-Deadline-Ms": "soon-ish"}, timeout=10,
+            )
+            assert r.status_code == 200
+        finally:
+            _close(srv)
+
+
+# ---------------------------------------------------------------------------
+# Breaker + degraded mode (feedback plane down, serving stays up)
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerAndDegradedMode:
+    def test_breaker_opens_then_recovers_via_half_open_probe(self):
+        srv, base, _, clock = _server(feedback=True)
+        data = {"event": "predict", "idempotencyKey": "k"}
+        try:
+            with faults.inject(faults.FaultSpec("serving.feedback", "refuse")):
+                # threshold=2 deliveries (each internally retried twice)
+                srv._deliver_feedback("http://127.0.0.1:1/events.json", data)
+                srv._deliver_feedback("http://127.0.0.1:1/events.json", data)
+                assert srv.stats.feedback_failures == 2
+                assert srv.feedback_breaker.state == CircuitBreaker.OPEN
+                assert srv.degraded
+
+                # while open: delivery is SKIPPED (no attempt, counted)
+                srv._deliver_feedback("http://127.0.0.1:1/events.json", data)
+                assert srv.stats.feedback_skipped == 1
+                assert srv.stats.feedback_failures == 2
+
+            # cooldown elapses on the injected clock → half-open; the
+            # dependency is back (fault deactivated, healthy sink): the
+            # probe succeeds and closes the circuit
+            clock.advance(10.5)
+            assert srv.feedback_breaker.state == CircuitBreaker.HALF_OPEN
+            with _Sink() as sink:
+                srv._deliver_feedback(sink.url, data)
+            assert srv.feedback_breaker.state == CircuitBreaker.CLOSED
+            assert srv.stats.feedback_sent == 1
+            assert not srv.degraded
+        finally:
+            _close(srv)
+
+    def test_keeps_answering_degraded_while_event_server_down(self):
+        srv, base, _, _ = _server(
+            feedback=True, event_server_ip="127.0.0.1",
+            event_server_port=1, access_key="K",
+        )
+        try:
+            with faults.inject(faults.FaultSpec("serving.feedback", "refuse")):
+                # trip the breaker deterministically (synchronous path)
+                url = "http://127.0.0.1:1/events.json"
+                srv._deliver_feedback(url, {"event": "predict"})
+                srv._deliver_feedback(url, {"event": "predict"})
+                assert srv.feedback_breaker.state == CircuitBreaker.OPEN
+
+                # queries still answer from the resident model
+                r = requests.post(f"{base}/queries.json", json={"q": 9},
+                                  timeout=10)
+                assert r.status_code == 200
+                assert r.json()["echo"] == {"q": 9}
+
+                # ...and the status surfaces say so, on both routes
+                js = requests.get(
+                    f"{base}/", headers={"Accept": "application/json"},
+                    timeout=10,
+                ).json()
+                assert js["degraded"] is True
+                assert js["status"] == "degraded"
+                assert js["breakers"]["eventServer"]["state"] == "open"
+                js2 = requests.get(f"{base}/status.json", timeout=10).json()
+                assert js2["degraded"] is True
+                html = requests.get(f"{base}/", timeout=10)
+                assert "text/html" in html.headers["Content-Type"]
+                assert "Degraded" in html.text
+        finally:
+            _close(srv)
+
+    def test_status_json_counts_shed_and_deadline(self):
+        srv, base, _, _ = _server()
+        try:
+            requests.post(
+                f"{base}/queries.json", json={},
+                headers={"X-PIO-Deadline-Ms": "0"}, timeout=10,
+            )
+            js = requests.get(f"{base}/status.json", timeout=10).json()
+            assert js["stats"]["deadlineExpired"] == 1
+            assert js["stats"]["shed"] == 0
+            assert js["maxQueue"] == srv._max_queue
+            assert set(js["breakers"]) == {"eventServer", "errorLog", "reload"}
+        finally:
+            _close(srv)
+
+    def test_error_log_breaker_stops_an_error_storm(self):
+        srv, base, _, _ = _server(log_url="http://127.0.0.1:1/log")
+        try:
+            with faults.inject(
+                faults.FaultSpec("serving.error_log", "refuse")
+            ):
+                # drive the delivery function synchronously (the pool is
+                # asynchronous in production; determinism wins here)
+                for _ in range(3):
+                    try:
+                        srv.error_log_breaker.call(
+                            srv._post_json, "serving.error_log",
+                            "http://127.0.0.1:1/log", {"m": 1},
+                        )
+                    except Exception:
+                        pass
+                assert srv.error_log_breaker.state == CircuitBreaker.OPEN
+                assert srv.degraded
+        finally:
+            _close(srv)
+
+
+# ---------------------------------------------------------------------------
+# Event Server idempotency keys
+# ---------------------------------------------------------------------------
+
+
+class TestIdempotencyKey:
+    @pytest.fixture()
+    def ev(self):
+        events = SqliteEventStore(":memory:")
+        md = MetadataStore(":memory:")
+        app_id = md.app_insert(App(id=0, name="chaosapp"))
+        md.access_key_insert(AccessKey(key="CK", appid=app_id, events=[]))
+        events.init(app_id)
+        srv = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0), events, md
+        )
+        srv.start_background()
+        yield f"http://127.0.0.1:{srv.bound_port}", events, app_id
+        srv.shutdown()
+        srv.server_close()
+
+    @staticmethod
+    def _event(key=None, **over):
+        data = {
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 5},
+        }
+        if key is not None:
+            data["idempotencyKey"] = key
+        data.update(over)
+        return data
+
+    def test_duplicate_post_same_key_inserts_exactly_once(self, ev):
+        base, events, app_id = ev
+        url = f"{base}/events.json?accessKey=CK"
+        r1 = requests.post(url, json=self._event(key="req-1"), timeout=10)
+        r2 = requests.post(url, json=self._event(key="req-1"), timeout=10)
+        assert r1.status_code == r2.status_code == 201
+        assert r1.json()["eventId"] == r2.json()["eventId"]
+        stored = list(events.find(app_id, EventFilter(event_names=["rate"])))
+        assert len(stored) == 1
+        assert stored[0].event_id == idempotency_event_id(app_id, "req-1")
+
+    def test_different_keys_insert_separately(self, ev):
+        base, events, app_id = ev
+        url = f"{base}/events.json?accessKey=CK"
+        assert requests.post(url, json=self._event(key="a"),
+                             timeout=10).status_code == 201
+        assert requests.post(url, json=self._event(key="b"),
+                             timeout=10).status_code == 201
+        assert len(list(events.find(app_id))) == 2
+
+    def test_key_does_not_leak_into_stored_properties(self, ev):
+        base, events, app_id = ev
+        url = f"{base}/events.json?accessKey=CK"
+        requests.post(url, json=self._event(key="leak-check"), timeout=10)
+        stored = list(events.find(app_id))[0]
+        assert "idempotencyKey" not in stored.properties.to_dict()
+
+    def test_bad_key_is_a_400(self, ev):
+        base, _, _ = ev
+        url = f"{base}/events.json?accessKey=CK"
+        r = requests.post(url, json=self._event(key=""), timeout=10)
+        assert r.status_code == 400
+        r = requests.post(url, json=self._event(key=7), timeout=10)
+        assert r.status_code == 400
+
+    def test_batch_route_dedupes_keyed_events(self, ev):
+        base, events, app_id = ev
+        url = f"{base}/batches/events.json?accessKey=CK"
+        batch = [self._event(key="dup"), self._event(key="dup"),
+                 self._event()]
+        r = requests.post(url, json=batch, timeout=10)
+        assert r.status_code == 200
+        results = r.json()
+        assert [e["status"] for e in results] == [201, 201, 201]
+        assert results[0]["eventId"] == results[1]["eventId"]
+        # two distinct rows: the deduped pair + the unkeyed event
+        assert len(list(events.find(app_id))) == 2
+
+    def test_explicit_event_id_wins_over_key(self, ev):
+        base, events, app_id = ev
+        url = f"{base}/events.json?accessKey=CK"
+        r = requests.post(
+            url, json=self._event(key="k", eventId="explicit-1"), timeout=10
+        )
+        assert r.json()["eventId"] == "explicit-1"
+
+
+# ---------------------------------------------------------------------------
+# Storage server health parity
+# ---------------------------------------------------------------------------
+
+
+class TestStorageServerHealth:
+    @pytest.fixture()
+    def storage(self):
+        from predictionio_tpu.storage.model_store import SqliteModelStore
+        from predictionio_tpu.storage.storage_server import StorageServer
+
+        srv = StorageServer(
+            "127.0.0.1", 0, SqliteEventStore(":memory:"),
+            MetadataStore(":memory:"), SqliteModelStore(":memory:"),
+        )
+        srv.start_background()
+        yield f"http://127.0.0.1:{srv.bound_port}"
+        srv.shutdown()
+        srv.server_close()
+
+    def test_root_returns_alive_like_event_server(self, storage):
+        r = requests.get(f"{storage}/", timeout=10)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["status"] == "alive"
+        assert body["stores"]["events"] == "SqliteEventStore"
+        assert "startTime" in body
+
+    def test_health_route_still_answers(self, storage):
+        assert requests.get(f"{storage}/health", timeout=10).json() == {
+            "status": "alive"
+        }
+
+    def test_expired_deadline_short_circuits_storage_work(self, storage):
+        r = requests.post(
+            f"{storage}/events/1/find", data=b"{}",
+            headers={"X-PIO-Deadline-Ms": "0"}, timeout=10,
+        )
+        assert r.status_code == 504

@@ -95,6 +95,40 @@ class TestRound5Fixtures:
         )
 
 
+class TestRobustFixtures:
+    """Family C (robustness) bad/clean twins, same contract as the
+    round-5 fixtures: the bad file fires exactly its intended rule at
+    the marked line, the clean twin is silent."""
+
+    @pytest.mark.parametrize(
+        "fixture,rule_id",
+        [
+            ("no_timeout_bad.py", "robust-no-timeout"),
+            ("bare_sleep_retry_bad.py", "robust-bare-sleep-retry"),
+        ],
+    )
+    def test_bad_fixture_fires_exactly_intended_rule(self, fixture, rule_id):
+        path = os.path.join(FIXTURES, fixture)
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [rule_id], (
+            f"{fixture}: expected exactly one {rule_id} finding, got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+        assert findings[0].line == _marker_line(path, "BAD")
+
+    @pytest.mark.parametrize(
+        "fixture",
+        ["no_timeout_clean.py", "bare_sleep_retry_clean.py"],
+    )
+    def test_clean_twin_has_no_findings(self, fixture):
+        path = os.path.join(FIXTURES, fixture)
+        findings = lint_file(path)
+        assert findings == [], (
+            f"false positive(s) on clean twin {fixture}: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+
 # ---------------------------------------------------------------------------
 # 2. Rule semantics (inline sources)
 # ---------------------------------------------------------------------------
@@ -179,6 +213,122 @@ class TestJitRules:
         )
         findings = _lint_source(src)
         assert [f.rule_id for f in findings] == ["jit-nonhashable-static"]
+
+
+class TestRobustRules:
+    def test_requests_without_timeout_fires(self):
+        src = (
+            "import requests\n"
+            "def post(url, data):\n"
+            "    return requests.post(url, json=data)\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["robust-no-timeout"]
+
+    def test_requests_with_timeout_is_clean(self):
+        src = (
+            "import requests\n"
+            "def post(url, data):\n"
+            "    return requests.post(url, json=data, timeout=10)\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_kwargs_splat_gets_benefit_of_the_doubt(self):
+        src = (
+            "import requests\n"
+            "def post(url, **kw):\n"
+            "    return requests.post(url, **kw)\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_urlopen_positional_timeout_is_clean(self):
+        src = (
+            "import urllib.request\n"
+            "def get(url):\n"
+            "    return urllib.request.urlopen(url, None, 5).read()\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_urlopen_without_timeout_fires(self):
+        src = (
+            "import urllib.request\n"
+            "def get(url):\n"
+            "    return urllib.request.urlopen(url).read()\n"
+        )
+        assert [f.rule_id for f in _lint_source(src)] == ["robust-no-timeout"]
+
+    def test_http_connection_without_timeout_fires(self):
+        src = (
+            "import http.client\n"
+            "def conn(host):\n"
+            "    return http.client.HTTPConnection(host, 80)\n"
+        )
+        assert [f.rule_id for f in _lint_source(src)] == ["robust-no-timeout"]
+
+    def test_constant_sleep_in_retry_loop_fires(self):
+        src = (
+            "import time\n"
+            "def poll(fn):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return fn()\n"
+            "        except OSError:\n"
+            "            time.sleep(5)\n"
+        )
+        findings = _lint_source(src)
+        assert [f.rule_id for f in findings] == ["robust-bare-sleep-retry"]
+        assert findings[0].line == 7
+
+    def test_variable_delay_sleep_is_clean(self):
+        # a computed (e.g. jittered) delay is exactly the fix — no finding
+        src = (
+            "import random, time\n"
+            "def poll(fn, base):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return fn()\n"
+            "        except OSError:\n"
+            "            time.sleep(random.uniform(0, base))\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_pacing_sleep_outside_except_is_clean(self):
+        src = (
+            "import time\n"
+            "def drain(pending):\n"
+            "    while pending():\n"
+            "        time.sleep(0.005)\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_sleep_in_except_outside_any_loop_is_clean(self):
+        src = (
+            "import time\n"
+            "def once(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except OSError:\n"
+            "        time.sleep(1)\n"
+        )
+        assert _lint_source(src) == []
+
+    def test_one_shot_fallback_defined_inside_a_loop_is_clean(self):
+        # a def nested in a loop body is a NEW scope: its one-shot
+        # except/sleep is not part of the loop's retry schedule
+        src = (
+            "import time\n"
+            "def wire(fns):\n"
+            "    out = []\n"
+            "    for fn in fns:\n"
+            "        def once(fn=fn):\n"
+            "            try:\n"
+            "                return fn()\n"
+            "            except OSError:\n"
+            "                time.sleep(1)\n"
+            "        out.append(once)\n"
+            "    return out\n"
+        )
+        assert _lint_source(src) == []
 
 
 class TestMosaicRuleScoping:

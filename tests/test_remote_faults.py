@@ -1,0 +1,188 @@
+"""``storage/remote.py`` resilience under injected faults.
+
+The stale-connection contract, proven through the deterministic fault
+harness instead of a lying socket server: keep-alive connection closed
+server-side → idempotent reads retry exactly once on a fresh connection;
+writes never retry without an idempotency key; a retried keyed write
+inserts exactly one event. Plus the per-netloc circuit breaker and the
+deadline short-circuit, both on injected clocks.
+"""
+
+import time
+
+import pytest
+
+from predictionio_tpu.storage import MetadataStore, SqliteEventStore
+from predictionio_tpu.storage.event import (
+    Event,
+    idempotency_event_id,
+    with_event_id,
+)
+from predictionio_tpu.storage.events import EventFilter
+from predictionio_tpu.storage.model_store import SqliteModelStore
+from predictionio_tpu.storage.remote import (
+    RemoteEventStore,
+    RemoteStorageError,
+    _pool,
+    _request,
+    reset_resilience,
+)
+from predictionio_tpu.storage.storage_server import StorageServer
+from predictionio_tpu.testing import faults
+from predictionio_tpu.utils.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    deadline_scope,
+)
+
+from test_resilience import FakeClock
+
+APP = 5
+
+
+@pytest.fixture()
+def server():
+    srv = StorageServer(
+        "127.0.0.1", 0, SqliteEventStore(":memory:"),
+        MetadataStore(":memory:"), SqliteModelStore(":memory:"),
+    )
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def store(server):
+    base = f"http://127.0.0.1:{server.bound_port}"
+    # hermetic per test: no pooled connections or breaker state carried
+    # over, and the breaker clock is real again afterwards
+    _pool.conns.clear()
+    reset_resilience(clock=time.monotonic)
+    st = RemoteEventStore(base)
+    st.init(APP)  # also pools a live keep-alive connection
+    yield st, base
+    faults.deactivate()
+    _pool.conns.clear()
+    reset_resilience(clock=time.monotonic)
+
+
+def _event() -> Event:
+    return Event(
+        event="rate", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+    )
+
+
+#: fault: the server closed the pooled keep-alive connection — fires on
+#: REUSED connections only (a fresh connect succeeds), exactly once
+def _stale_close(times=1):
+    return faults.FaultSpec(
+        "remote.send", "close", times=times,
+        when=lambda info: not info.get("fresh", True),
+    )
+
+
+class TestStaleConnectionContract:
+    def test_idempotent_read_retries_exactly_once_on_fresh_conn(self, store):
+        st, base = store
+        assert _pool.conns.get(base), "precondition: a pooled connection"
+        with faults.inject(_stale_close()) as plan:
+            assert st.get("no-such-event", APP) is None  # 404 → None
+            # one injected stale failure, one fresh-connection retry
+            assert plan.fired("remote.send") == 1
+            assert plan.hits("remote.send") == 2
+
+    def test_unkeyed_write_never_retries(self, store):
+        st, base = store
+        assert _pool.conns.get(base)
+        with faults.inject(_stale_close()) as plan:
+            with pytest.raises(RemoteStorageError, match="unreachable"):
+                st.insert(_event(), APP)
+            # the failure surfaced loudly after ONE send attempt: an
+            # unkeyed write must never be replayed
+            assert plan.hits("remote.send") == 1
+        assert list(st.find(APP, EventFilter())) == []
+
+    def test_keyed_write_retries_and_inserts_exactly_once(self, store):
+        st, base = store
+        keyed = with_event_id(_event(), idempotency_event_id(APP, "req-9"))
+        assert _pool.conns.get(base)
+        with faults.inject(_stale_close()) as plan:
+            eid = st.insert(keyed, APP)
+            assert eid == keyed.event_id
+            assert plan.fired("remote.send") == 1
+            assert plan.hits("remote.send") == 2
+        # and a full client-level replay of the same keyed insert still
+        # lands on itself: exactly one stored event
+        st.insert(keyed, APP)
+        stored = list(st.find(APP, EventFilter()))
+        assert len(stored) == 1
+        assert stored[0].event_id == keyed.event_id
+
+
+class TestRemoteBreaker:
+    def test_breaker_opens_fast_fails_and_recovers(self, store, monkeypatch):
+        st, base = store
+        monkeypatch.setenv("PIO_BREAKER_FAILURES", "2")
+        monkeypatch.setenv("PIO_BREAKER_RESET_S", "5")
+        clock = FakeClock()
+        reset_resilience(clock=clock)  # fresh breakers on the fake clock
+        with faults.inject(
+            faults.FaultSpec("remote.send", "refuse")
+        ) as plan:
+            for _ in range(2):
+                with pytest.raises(RemoteStorageError, match="unreachable"):
+                    st.get("x", APP)
+            assert plan.hits("remote.send") == 2
+            # circuit open: the third op fails FAST, no socket attempt
+            with pytest.raises(RemoteStorageError, match="circuit"):
+                st.get("x", APP)
+            assert plan.hits("remote.send") == 2
+        # cooldown elapses on the injected clock; the dependency is back
+        # (faults off): the half-open probe succeeds and the circuit closes
+        clock.advance(5.5)
+        assert st.get("no-such-event", APP) is None
+        assert st.get("no-such-event", APP) is None  # closed: flows freely
+
+    def test_http_error_responses_do_not_trip_the_breaker(
+        self, store, monkeypatch
+    ):
+        st, base = store
+        monkeypatch.setenv("PIO_BREAKER_FAILURES", "2")
+        reset_resilience(clock=time.monotonic)
+        # 404s are the server TALKING — dependency alive, breaker closed
+        for _ in range(5):
+            assert st.get("ghost", APP) is None
+        assert st.get("ghost", APP) is None
+
+
+class TestDeadlinePropagation:
+    def test_expired_ambient_deadline_short_circuits_client_side(self, store):
+        st, base = store
+        clock = FakeClock()
+        d = Deadline.after_ms(10, clock)
+        clock.advance(1.0)
+        with faults.inject(faults.FaultSpec("remote.send", "refuse")) as plan:
+            with deadline_scope(d):
+                with pytest.raises(DeadlineExceeded):
+                    st.get("x", APP)
+            # raised before any socket work: the wire was never touched
+            assert plan.hits("remote.send") == 0
+
+    def test_explicit_deadline_param_reaches_request(self, store):
+        st, base = store
+        clock = FakeClock()
+        d = Deadline.after_ms(0, clock)
+        with pytest.raises(DeadlineExceeded):
+            _request(f"{base}/health", deadline=d)
+
+    def test_live_deadline_header_is_forwarded(self, store):
+        st, base = store
+        # the server sees the header: an expired budget forged AT the
+        # wire level (header forwarded by the client, remaining > 0
+        # locally is impossible to fake) — instead verify end to end that
+        # a generous budget flows through and the request succeeds
+        d = Deadline.after_ms(30000)
+        with deadline_scope(d):
+            assert st.get("nope", APP) is None

@@ -1,0 +1,393 @@
+"""Resilience primitives (``utils/resilience.py``) + fault harness
+(``testing/faults.py``).
+
+Everything here runs on injected clocks / sleeps / rngs: the whole suite
+is deterministic and never waits on wall time — the contract ISSUE 2
+sets for the fault work staying inside the tier-1 budget.
+"""
+
+import random
+
+import pytest
+
+from predictionio_tpu.testing import faults
+from predictionio_tpu.utils.resilience import (
+    DEADLINE_HEADER,
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+
+
+class FakeClock:
+    """Injectable monotonic clock."""
+
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_budget_counts_down_on_the_injected_clock(self):
+        clock = FakeClock()
+        d = Deadline.after_ms(250, clock)
+        assert d.remaining_ms() == pytest.approx(250)
+        clock.advance(0.2)
+        assert d.remaining_ms() == pytest.approx(50)
+        assert not d.expired
+        clock.advance(0.1)
+        assert d.expired
+
+    def test_check_raises_with_stage(self):
+        clock = FakeClock()
+        d = Deadline.after_ms(10, clock)
+        d.check("dispatch")  # within budget: no raise
+        clock.advance(1.0)
+        with pytest.raises(DeadlineExceeded) as exc:
+            d.check("dispatch")
+        assert exc.value.stage == "dispatch"
+
+    def test_header_roundtrip_is_relative(self):
+        clock = FakeClock()
+        d = Deadline.after_ms(500, clock)
+        clock.advance(0.2)
+        # forwarded budget = REMAINING ms, so a receiver with a totally
+        # different clock epoch still gets 300 ms
+        receiver_clock = FakeClock(now=77.0)
+        d2 = Deadline.from_header(d.header_value(), receiver_clock)
+        assert d2.remaining_ms() == pytest.approx(300, abs=1)
+
+    @pytest.mark.parametrize("bad", [None, "", "not-a-number", object()])
+    def test_malformed_header_is_no_deadline(self, bad):
+        assert Deadline.from_header(bad) is None
+
+    def test_negative_header_is_already_expired(self):
+        d = Deadline.from_header("-50", FakeClock())
+        assert d is not None and d.expired
+
+    def test_cap_timeout_floors_above_zero(self):
+        clock = FakeClock()
+        d = Deadline.after_ms(100, clock)
+        assert d.cap_timeout(60.0) == pytest.approx(0.1)
+        assert d.cap_timeout(0.05) == pytest.approx(0.05)
+        clock.advance(5)
+        assert d.cap_timeout(60.0) == 0.001  # never 0: that means non-blocking
+
+    def test_header_name_is_the_wire_contract(self):
+        assert DEADLINE_HEADER == "X-PIO-Deadline-Ms"
+
+    def test_ambient_scope(self):
+        d = Deadline.after_ms(100, FakeClock())
+        assert current_deadline() is None
+        with deadline_scope(d):
+            assert current_deadline() is d
+        assert current_deadline() is None
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        sleeps = []
+        kw.setdefault("rng", random.Random(7))
+        policy = RetryPolicy(sleep=sleeps.append, **kw)
+        return policy, sleeps
+
+    def test_success_first_try_never_sleeps(self):
+        policy, sleeps = self._policy(attempts=3)
+        assert policy.call(lambda: 42) == 42
+        assert sleeps == []
+
+    def test_n_failures_then_ok(self):
+        policy, sleeps = self._policy(attempts=3, base_delay_s=0.1)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        assert len(calls) == 3
+        assert len(sleeps) == 2
+
+    def test_full_jitter_bounds(self):
+        # retry i draws from U(0, min(cap, base * 2^i)) — check the
+        # envelope over the deterministic rng's draws
+        policy, sleeps = self._policy(
+            attempts=6, base_delay_s=0.1, max_delay_s=0.5
+        )
+        with pytest.raises(ValueError):
+            policy.call(self._always_fail)
+        assert len(sleeps) == 5
+        for i, s in enumerate(sleeps):
+            assert 0.0 <= s <= min(0.5, 0.1 * 2**i)
+
+    @staticmethod
+    def _always_fail():
+        raise ValueError("nope")
+
+    def test_gives_up_after_attempts(self):
+        policy, sleeps = self._policy(attempts=4)
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            policy.call(fail)
+        assert len(calls) == 4
+
+    def test_should_retry_predicate_gates_retries(self):
+        policy, _ = self._policy(attempts=5)
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            policy.call(fail, should_retry=lambda e: "transient" in str(e))
+        assert len(calls) == 1  # non-matching error: no retry burned
+
+    def test_deadline_bounds_the_schedule(self):
+        clock = FakeClock()
+        sleeps = []
+
+        def sleeping(s):
+            sleeps.append(s)
+            clock.advance(s)
+
+        policy = RetryPolicy(
+            attempts=10,
+            base_delay_s=0.2,
+            max_delay_s=0.2,
+            rng=random.Random(3),
+            sleep=sleeping,
+            clock=clock,
+        )
+        deadline = Deadline.after_ms(300, clock)
+        calls = []
+
+        def fail():
+            calls.append(1)
+            clock.advance(0.05)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            policy.call(fail, deadline=deadline)
+        # far fewer than 10 attempts: the 300 ms budget can't cover the
+        # whole schedule
+        assert len(calls) < 5
+
+    def test_attempts_must_be_positive(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout_s", 30.0)
+        return CircuitBreaker("dep", clock=clock, **kw), clock
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        breaker, _ = self._breaker()
+        for _ in range(2):
+            breaker.record_failure()
+        assert breaker.state == CircuitBreaker.CLOSED
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpen) as exc:
+            breaker.before_call()
+        assert exc.value.retry_after_s > 0
+
+    def test_success_resets_the_consecutive_count(self):
+        breaker, _ = self._breaker()
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.record_success()
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_success_closes(self):
+        breaker, clock = self._breaker()
+        for _ in range(3):
+            breaker.record_failure()
+        clock.advance(30.0)
+        assert breaker.state == CircuitBreaker.HALF_OPEN
+        breaker.before_call()  # the probe is admitted
+        breaker.record_success()
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_failure_reopens_and_restarts_cooldown(self):
+        breaker, clock = self._breaker()
+        for _ in range(3):
+            breaker.record_failure()
+        clock.advance(30.0)
+        breaker.before_call()
+        breaker.record_failure()  # probe failed: still down
+        assert breaker.state == CircuitBreaker.OPEN
+        clock.advance(29.0)  # cooldown restarted — not elapsed yet
+        with pytest.raises(CircuitOpen):
+            breaker.before_call()
+        clock.advance(1.5)
+        breaker.before_call()  # next probe window
+
+    def test_half_open_admits_bounded_probes(self):
+        breaker, clock = self._breaker(half_open_probes=1)
+        for _ in range(3):
+            breaker.record_failure()
+        clock.advance(31.0)
+        breaker.before_call()  # probe 1 in flight
+        with pytest.raises(CircuitOpen):
+            breaker.before_call()  # probe 2 rejected
+
+    def test_call_wraps_one_logical_operation(self):
+        breaker, _ = self._breaker(failure_threshold=2)
+        with pytest.raises(RuntimeError):
+            breaker.call(self._boom)
+        with pytest.raises(RuntimeError):
+            breaker.call(self._boom)
+        with pytest.raises(CircuitOpen):
+            breaker.call(self._boom)  # open: fn must not even run
+
+    @staticmethod
+    def _boom():
+        raise RuntimeError("dead dependency")
+
+    def test_snapshot_shape(self):
+        breaker, clock = self._breaker()
+        snap = breaker.snapshot()
+        assert snap["state"] == "closed"
+        for _ in range(3):
+            breaker.record_failure()
+        snap = breaker.snapshot()
+        assert snap["state"] == "open"
+        assert snap["openCount"] == 1
+        assert 0 < snap["retryAfterS"] <= 30.0
+
+    def test_from_env_reads_the_knobs(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker.from_env(
+            "x",
+            env={
+                "PIO_BREAKER_FAILURES": "2",
+                "PIO_BREAKER_RESET_S": "7.5",
+                "PIO_BREAKER_HALF_OPEN_PROBES": "3",
+            },
+            clock=clock,
+        )
+        assert breaker.failure_threshold == 2
+        assert breaker.reset_timeout_s == 7.5
+        assert breaker.half_open_probes == 3
+
+
+# ---------------------------------------------------------------------------
+# Fault harness
+# ---------------------------------------------------------------------------
+
+
+class TestFaultHarness:
+    def teardown_method(self):
+        faults.deactivate()
+
+    def test_inactive_harness_is_a_no_op(self):
+        faults.deactivate()
+        faults.fault_point("remote.send", url="http://x")  # must not raise
+
+    def test_refuse_fires_connection_refused(self):
+        with faults.inject(faults.FaultSpec("remote.send", "refuse")):
+            with pytest.raises(ConnectionRefusedError):
+                faults.fault_point("remote.send")
+
+    def test_close_fires_remote_disconnected(self):
+        import http.client
+
+        with faults.inject(faults.FaultSpec("remote.send", "close")):
+            with pytest.raises(http.client.RemoteDisconnected):
+                faults.fault_point("remote.send")
+
+    def test_n_failures_then_ok(self):
+        spec = faults.FaultSpec("s", "refuse", times=2)
+        with faults.inject(spec) as plan:
+            for _ in range(2):
+                with pytest.raises(ConnectionRefusedError):
+                    faults.fault_point("s")
+            faults.fault_point("s")  # budget spent: ok now
+            faults.fault_point("s")
+            assert plan.fired("s") == 2
+            assert plan.hits("s") == 4
+
+    def test_site_filtering(self):
+        with faults.inject(faults.FaultSpec("a", "refuse")):
+            faults.fault_point("b")  # different site: untouched
+            with pytest.raises(ConnectionRefusedError):
+                faults.fault_point("a")
+
+    def test_when_predicate_filters_on_call_info(self):
+        spec = faults.FaultSpec(
+            "s", "close", when=lambda info: not info.get("fresh", True)
+        )
+        with faults.inject(spec):
+            faults.fault_point("s", fresh=True)  # filtered out
+            with pytest.raises(Exception):
+                faults.fault_point("s", fresh=False)
+
+    def test_latency_uses_injected_sleep(self):
+        slept = []
+        with faults.inject(
+            faults.FaultSpec("s", "latency", arg=50.0), sleep=slept.append
+        ):
+            faults.fault_point("s")
+        assert slept == [0.05]
+
+    def test_parse_env_syntax(self):
+        specs = faults.parse(
+            "serving.feedback=refuse*3; remote.send=latency:50"
+        )
+        assert [(s.site, s.kind, s.times, s.arg) for s in specs] == [
+            ("serving.feedback", "refuse", 3, 0.0),
+            ("remote.send", "latency", None, 50.0),
+        ]
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            faults.parse("no-equals-sign")
+        with pytest.raises(ValueError):
+            faults.parse("site=unknown-kind")
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv("PIO_FAULTS", "x=refuse*1")
+        faults._install_from_env()
+        try:
+            with pytest.raises(ConnectionRefusedError):
+                faults.fault_point("x")
+        finally:
+            faults.deactivate()
