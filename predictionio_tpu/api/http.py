@@ -69,6 +69,55 @@ class BackgroundHTTPServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._live_conns: set = set()
+        self._conn_lock = threading.Lock()
+
+    # Track accepted sockets so kill() can sever keep-alive connections:
+    # shutdown() only stops the accept loop — handler threads blocked on
+    # a persistent connection keep answering, which is not what "the
+    # process died" means to a chaos test.
+    def get_request(self):
+        request, client_address = super().get_request()
+        with self._conn_lock:
+            self._live_conns.add(request)
+        return request, client_address
+
+    def shutdown_request(self, request) -> None:
+        with self._conn_lock:
+            self._live_conns.discard(request)
+        super().shutdown_request(request)
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        self._serving = True  # kill() must not shutdown() a never-run loop
+        super().serve_forever(poll_interval)
+
+    def kill(self) -> None:
+        """Hard-stop: stop accepting AND sever every live connection —
+        the in-process analogue of ``kill -9`` on the server process
+        (``tools/loadgen.py --kill-primary-at``, replication chaos
+        tests). In-flight requests see a reset, exactly like a real
+        crash."""
+        if getattr(self, "_serving", False):
+            # shutdown() blocks on an event only serve_forever() sets —
+            # calling it on a server whose loop never ran hangs forever
+            self.shutdown()
+        self.server_close()
+        import socket as _socket
+
+        with self._conn_lock:
+            conns, self._live_conns = list(self._live_conns), set()
+        for request in conns:
+            try:
+                request.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                request.close()
+            except OSError:
+                pass
+
     def handle_error(self, request, client_address) -> None:
         """Client disconnects mid-response (an abandoned streaming scan, a
         killed curl) are normal operation, not stack-trace material."""
@@ -87,7 +136,13 @@ class BackgroundHTTPServer(ThreadingHTTPServer):
         return self.server_address[1]
 
     def start_background(self) -> threading.Thread:
-        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        # tight poll so shutdown() returns in ~50 ms instead of the
+        # stdlib's 500 ms — server-heavy test suites pay that latency
+        # once per server teardown, which adds up to tens of seconds
+        thread = threading.Thread(
+            target=lambda: self.serve_forever(poll_interval=0.05),
+            daemon=True,
+        )
         thread.start()
         return thread
 

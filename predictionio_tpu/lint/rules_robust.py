@@ -12,6 +12,10 @@ first incident:
 - ``robust-bare-sleep-retry``: a retry loop that sleeps a constant
   synchronizes every failing client into a thundering herd — the exact
   pathology full-jitter backoff (``RetryPolicy``) exists to kill.
+- ``robust-rename-no-fsync`` (ISSUE 3): write-then-``os.replace`` with
+  no fsync in the same scope leaves a durable *name* over torn *data*
+  after a power loss — the bug class ``testing/crashsim.py`` proves and
+  ``utils/durability.py`` packages the fix for.
 """
 
 from __future__ import annotations
@@ -188,4 +192,65 @@ class BareSleepRetry(Rule):
                 )
 
 
-RULES: List[Rule] = [NoTimeout(), BareSleepRetry()]
+def _scopes(tree: ast.AST):
+    """Module + every function body as separate analysis scopes (a rename
+    and its fsync belong together only when they share a scope)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class RenameNoFsync(Rule):
+    """``os.replace``/``os.rename`` in a scope that never fsyncs: on
+    many filesystems the rename's metadata can journal before the data
+    blocks of the just-written file, so a power loss leaves the final
+    name pointing at truncated or empty bytes."""
+
+    id = "robust-rename-no-fsync"
+    severity = "error"
+    short = (
+        "os.replace/os.rename without an fsync in the same scope "
+        "(torn data under a durable name after power loss)"
+    )
+    motivation = (
+        "LocalFSModelStore.insert shipped exactly this bug (fixed in "
+        "ISSUE 3, proven by testing/crashsim.py): a crashed model PUT "
+        "could leave a torn blob under the final model name; "
+        "utils/durability.atomic_write_bytes packages the safe sequence"
+    )
+
+    _RENAMES = ("os.replace", "os.rename")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for scope in _scopes(ctx.tree):
+            renames = []
+            has_fsync = False
+            for node in _walk_in_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                name = call_name(node)
+                if dn in self._RENAMES or (
+                    name in ("replace", "rename") and dn == name
+                ):
+                    renames.append((node, dn or name))
+                # any call whose name mentions fsync satisfies the rule:
+                # os.fsync, os.fdatasync, and durability helpers like
+                # fsync_file/fsync_dir/_fsync_dir all count
+                if "fsync" in (name or "") or "fsync" in dn:
+                    has_fsync = True
+            if has_fsync:
+                continue
+            for node, shown in renames:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{shown}(...) with no fsync in scope: the renamed "
+                    "file's data may not be durable when the rename is — "
+                    "fsync the temp file (and the directory) first, or "
+                    "use utils/durability.atomic_write_bytes.",
+                )
+
+
+RULES: List[Rule] = [NoTimeout(), BareSleepRetry(), RenameNoFsync()]

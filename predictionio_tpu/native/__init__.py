@@ -86,7 +86,15 @@ def build_library(name: str, sources=None, extra_flags=()) -> str:
             raise NativeBuildError(
                 f"building {name} failed ({' '.join(cmd)}):\n{proc.stderr}"
             )
+        # fsync the compiler's output before renaming it into place: the
+        # build cache is checked by a stamp file, so a power loss that
+        # tears the .so under its final name would never trigger a
+        # rebuild — every later process would dlopen garbage
+        from ..utils.durability import fsync_dir, fsync_file
+
+        fsync_file(tmp_path)
         os.replace(tmp_path, lib_path)  # atomic: readers see old or new
+        fsync_dir(_BUILD_DIR)
     finally:
         # interrupt / late failure: never leak the pid-suffixed temp
         try:

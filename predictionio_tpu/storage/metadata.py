@@ -183,6 +183,20 @@ class MetadataStore:
             self._conn.commit()
             return int(value)
 
+    def sequence_advance_to(self, name: str, value: int) -> None:
+        """Idempotent replication helper: make ``gen_next(name)`` never
+        re-issue a value ≤ ``value``. Replicas replay logged ``gen_next``
+        results through this (``storage/changefeed.py``) so re-applying a
+        log suffix cannot re-advance the counter."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO pio_sequences (name, value) VALUES (?, ?) "
+                "ON CONFLICT(name) DO UPDATE SET value = "
+                "max(pio_sequences.value, excluded.value)",
+                (name, int(value)),
+            )
+            self._conn.commit()
+
     # -- apps (Apps.scala DAO) --------------------------------------------
     def app_insert(self, app: App) -> Optional[int]:
         with self._lock:

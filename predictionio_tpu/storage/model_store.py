@@ -19,6 +19,8 @@ import urllib.parse
 import zlib
 from typing import Optional
 
+from ..utils.durability import fsync_dir
+
 
 @dataclasses.dataclass(frozen=True)
 class Model:
@@ -52,10 +54,18 @@ class LocalFSModelStore(ModelStore):
         return os.path.join(self._base, f"pio_model_{safe}.bin")
 
     def insert(self, model: Model) -> None:
+        # fsync BEFORE the rename, then fsync the directory: without the
+        # first, the rename's metadata can journal ahead of the data
+        # blocks and a power loss leaves a durable name over a torn blob
+        # (proven by testing/crashsim.py in tests/test_crash_consistency);
+        # without the second, the new dirent itself may not survive.
         tmp = self._path(model.id) + ".tmp"
         with open(tmp, "wb") as fh:
             fh.write(zlib.compress(model.models))
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, self._path(model.id))
+        fsync_dir(self._base)
 
     def get(self, id: str) -> Optional[Model]:
         try:

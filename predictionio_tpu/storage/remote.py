@@ -10,6 +10,8 @@ HConnection, ES ``StorageClient`` holding a ``TransportClient``;
     PIO_STORAGE_SOURCES_<NAME>_TYPE=remote
     PIO_STORAGE_SOURCES_<NAME>_HOST=10.0.0.2     (default 127.0.0.1)
     PIO_STORAGE_SOURCES_<NAME>_PORT=7079
+    PIO_STORAGE_SOURCES_<NAME>_NODES=primary:7079,replica1:7079  (HA)
+    PIO_STORAGE_SOURCES_<NAME>_URL=pio+ha://primary:7079,replica1:7079
 
 This module self-registers the family on import: the registry's
 ``resolve_backend`` imports ``predictionio_tpu.storage.remote`` the first
@@ -30,6 +32,17 @@ carrying an ``event_id`` (e.g. minted from an idempotency key) upserts,
 so its POST may take the same one-shot stale-connection retry reads get.
 All wire I/O routes through the fault-injection point ``remote.send``
 (``predictionio_tpu/testing/faults.py``).
+
+High availability (``docs/storage.md#replication``): a multi-endpoint
+URL — ``pio+ha://primary:7079,replica1:7079,...`` — lists the primary
+first and warm-standby replicas after. Writes always target the
+primary; its ``X-PIO-Seq`` acks feed a process-wide :class:`SeqToken`
+shared by all three stores of the endpoint set. Reads go to the primary
+until its circuit breaker opens, then fail over to the freshest replica
+(ordered by a one-shot ``/replicate/checkpoint`` probe) carrying
+``X-PIO-Min-Seq`` = the last acked seq — a replica that has not yet
+applied the caller's own writes answers 409 and the next one is tried,
+preserving read-your-writes across failover.
 """
 
 from __future__ import annotations
@@ -50,10 +63,15 @@ from ..utils.resilience import (
     current_deadline,
 )
 from .backends import BackendFamily, SourceConf, register_backend
+from .changefeed import MIN_SEQ_HEADER, SEQ_HEADER
 from .event import Event
 from .events import EventFilter, EventStore
 from .model_store import Model, ModelStore
-from .storage_server import DEFAULT_PORT, METADATA_RPC_METHODS
+from .storage_server import (
+    DEFAULT_PORT,
+    METADATA_READ_METHODS,
+    METADATA_RPC_METHODS,
+)
 from .wire import decode, encode
 
 
@@ -109,13 +127,186 @@ def _get_breaker(netloc: str) -> CircuitBreaker:
 
 
 def reset_resilience(clock=None) -> None:
-    """Forget all breaker state (and optionally swap the breaker clock).
-    Test hook — production processes never need it."""
+    """Forget all breaker and seq-token state. ``clock`` installs an
+    injected breaker clock; ``None`` restores the real monotonic clock
+    (so a test that injected a frozen clock cannot leak it into later
+    tests). Test hook — production processes never need it."""
     global _breaker_clock
     with _breakers_lock:
         _breakers.clear()
-        if clock is not None:
-            _breaker_clock = clock
+        _breaker_clock = clock if clock is not None else time.monotonic
+    with _seq_tokens_lock:
+        _seq_tokens.clear()
+
+
+# -- HA endpoint sets + read-your-writes seq tokens ---------------------------
+
+
+class SeqToken:
+    """Monotonic max of the ``X-PIO-Seq`` acks this process has received
+    for one endpoint set — the read-your-writes floor forwarded to
+    replicas as ``X-PIO-Min-Seq``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def note(self, seq: int) -> None:
+        with self._lock:
+            if seq > self._last:
+                self._last = seq
+
+    @property
+    def last(self) -> int:
+        with self._lock:
+            return self._last
+
+
+#: one shared token per endpoint set, so the event/metadata/model stores
+#: of one storage plane see each other's write acks (write a model, read
+#: it back through a replica — still your own write)
+_seq_tokens: dict = {}
+_seq_tokens_lock = threading.Lock()
+
+
+def _get_seq_token(key: str) -> SeqToken:
+    with _seq_tokens_lock:
+        token = _seq_tokens.get(key)
+        if token is None:
+            token = SeqToken()
+            _seq_tokens[key] = token
+        return token
+
+
+def _split_endpoints(base_url: str) -> list:
+    """``pio+ha://a:1,b:2`` → ``["http://a:1", "http://b:2"]``; any other
+    URL is a single-endpoint set."""
+    base_url = base_url.strip()
+    if not base_url.startswith("pio+ha://"):
+        return [base_url.rstrip("/")]
+    urls = []
+    for part in base_url[len("pio+ha://"):].split(","):
+        part = part.strip().rstrip("/")
+        if part:
+            urls.append(part if "://" in part else f"http://{part}")
+    if not urls:
+        raise RemoteStorageError(f"no endpoints in HA URL {base_url!r}")
+    return urls
+
+
+class _HAEndpoints:
+    """One store's view of a (primary, replicas) endpoint set."""
+
+    def __init__(self, base_url: str):
+        urls = _split_endpoints(base_url)
+        self.primary = urls[0]
+        self.replicas = tuple(urls[1:])
+        self.token = _get_seq_token("|".join(urls))
+        self._order_lock = threading.Lock()
+        self._order = None  # freshness-sorted replicas, cached per outage
+
+    def note_response(self, resp) -> None:
+        seq = resp.getheader(SEQ_HEADER)
+        if seq is not None:
+            try:
+                self.token.note(int(seq))
+            except ValueError:
+                pass
+
+    def clear_order(self) -> None:
+        with self._order_lock:
+            self._order = None
+
+    def replica_order(self, timeout: float) -> tuple:
+        """Replicas sorted freshest-first by a one-shot
+        ``/replicate/checkpoint`` probe, cached until the primary answers
+        again (one probe round per outage, not per read)."""
+        with self._order_lock:
+            if self._order is not None:
+                return self._order
+        seqs = []
+        for url in self.replicas:
+            try:
+                with _request(
+                    f"{url}/replicate/checkpoint",
+                    timeout=min(timeout, 5.0),
+                ) as resp:
+                    seqs.append((int(_json(resp).get("seq", -1)), url))
+            except (RemoteStorageError, ValueError):
+                seqs.append((-1, url))
+        order = tuple(url for _, url in sorted(seqs, key=lambda t: -t[0]))
+        with self._order_lock:
+            self._order = order
+        return order
+
+
+def _ha_write(
+    endpoints: _HAEndpoints,
+    path: str,
+    method: str = "POST",
+    body: Optional[bytes] = None,
+    timeout: float = 60.0,
+    idempotent: Optional[bool] = None,
+):
+    """Mutations always target the primary; a successful ack's seq
+    feeds the shared token."""
+    resp = _request(
+        endpoints.primary + path, method, body, timeout, idempotent=idempotent
+    )
+    endpoints.note_response(resp)
+    return resp
+
+
+def _ha_read(
+    endpoints: _HAEndpoints,
+    path: str,
+    method: str = "GET",
+    body: Optional[bytes] = None,
+    timeout: float = 60.0,
+    idempotent: bool = True,
+):
+    """Reads prefer the primary; once its breaker is open (the endpoint
+    is known-dead, PR 2 semantics) they fail over to the freshest replica
+    carrying the read-your-writes floor. A single transient primary
+    failure below the breaker threshold still raises — failover is an
+    outage response, not a retry policy."""
+    if not endpoints.replicas:
+        return _request(
+            endpoints.primary + path, method, body, timeout,
+            idempotent=idempotent,
+        )
+    try:
+        resp = _request(
+            endpoints.primary + path, method, body, timeout,
+            idempotent=idempotent,
+        )
+        endpoints.clear_order()  # healthy again: next outage re-probes
+        return resp
+    except RemoteStorageError as exc:
+        if exc.code is not None:
+            raise  # the server answered; an HTTP error is not an outage
+        breaker = _get_breaker(_netloc(endpoints.primary))
+        if not getattr(exc, "circuit_open", False) and (
+            breaker.state == CircuitBreaker.CLOSED
+        ):
+            raise
+        last_exc = exc
+    min_seq = endpoints.token.last
+    headers = {MIN_SEQ_HEADER: str(min_seq)} if min_seq else None
+    for replica_url in endpoints.replica_order(timeout):
+        try:
+            return _request(
+                replica_url + path, method, body, timeout,
+                idempotent=idempotent, headers=headers,
+            )
+        except RemoteStorageError as exc:
+            last_exc = exc  # behind (409), down, or breaker-open: next
+    raise last_exc
+
+
+def _netloc(url: str) -> str:
+    parsed = urllib.parse.urlsplit(url)
+    return f"{parsed.scheme}://{parsed.netloc}"
 
 
 def _conn_is_dead(conn) -> bool:
@@ -155,9 +346,12 @@ class _PooledResponse:
         self._conn = conn
         self._netloc = netloc
 
-    # the three access patterns used by this module's callers
+    # the access patterns used by this module's callers
     def read(self, *a):
         return self._resp.read(*a)
+
+    def getheader(self, name, default=None):
+        return self._resp.getheader(name, default)
 
     def __iter__(self):
         return iter(self._resp)
@@ -204,6 +398,7 @@ def _request(
     timeout: float = 60.0,
     idempotent: Optional[bool] = None,
     deadline: Optional[Deadline] = None,
+    headers: Optional[dict] = None,
 ):
     """``idempotent`` enables the one-shot stale-connection retry and
     unconditional pool reuse. Default: GET/DELETE only. POST call sites
@@ -238,16 +433,18 @@ def _request(
         idempotent = method in ("GET", "DELETE")
     netloc = f"{parsed.scheme}://{parsed.netloc}"
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
-    headers = {"Content-Type": "application/json"} if body is not None else {}
+    headers = dict(headers or {})
+    if body is not None:
+        headers.setdefault("Content-Type", "application/json")
     if deadline is None:
         deadline = current_deadline()
     breaker = _get_breaker(netloc)
     try:
         breaker.before_call()
     except CircuitOpen as exc:
-        raise RemoteStorageError(
-            f"{method} {url} not attempted: {exc}"
-        ) from exc
+        err = RemoteStorageError(f"{method} {url} not attempted: {exc}")
+        err.circuit_open = True  # the HA read path keys failover on this
+        raise err from exc
     base_timeout = timeout
     for attempt in (0, 1):
         # Deadline accounting PER ATTEMPT: the stale-keep-alive retry
@@ -349,20 +546,20 @@ class RemoteEventStore(EventStore):
     def __init__(self, base_url: str, timeout: float = 60.0):
         # 60 s default mirrors the reference LEvents op timeout
         # (LEvents.scala:35).
-        self._base = base_url.rstrip("/")
+        self._ep = _HAEndpoints(base_url)
         self._timeout = timeout
 
-    def _url(self, app_id: int, suffix: str = "") -> str:
-        return f"{self._base}/events/{app_id}{suffix}"
+    def _path(self, app_id: int, suffix: str = "") -> str:
+        return f"/events/{app_id}{suffix}"
 
     def init(self, app_id: int) -> bool:
-        with _request(self._url(app_id, "/init"), "POST", b"{}",
-                      self._timeout, idempotent=True) as r:
+        with _ha_write(self._ep, self._path(app_id, "/init"), "POST", b"{}",
+                       self._timeout, idempotent=True) as r:
             return bool(_json(r)["ok"])
 
     def remove(self, app_id: int) -> bool:
-        with _request(self._url(app_id, "/remove"), "POST", b"{}",
-                      self._timeout, idempotent=True) as r:
+        with _ha_write(self._ep, self._path(app_id, "/remove"), "POST", b"{}",
+                       self._timeout, idempotent=True) as r:
             return bool(_json(r)["ok"])
 
     def insert(self, event: Event, app_id: int) -> str:
@@ -372,15 +569,18 @@ class RemoteEventStore(EventStore):
         # server: replaying it lands on itself, so the POST may take the
         # one-shot stale-connection retry. Unkeyed inserts keep NO retry
         # — a replay would double-insert.
-        with _request(
-            self._url(app_id), "POST", body, self._timeout,
+        with _ha_write(
+            self._ep, self._path(app_id), "POST", body, self._timeout,
             idempotent=event.event_id is not None,
         ) as r:
             return _json(r)["eventId"]
 
     def get(self, event_id: str, app_id: int) -> Optional[Event]:
         try:
-            with _request(self._url(app_id, f"/{event_id}"), timeout=self._timeout) as r:
+            with _ha_read(
+                self._ep, self._path(app_id, f"/{event_id}"),
+                timeout=self._timeout,
+            ) as r:
                 return Event.from_json_dict(_json(r))
         except RemoteStorageError as exc:
             if exc.code == 404:
@@ -388,8 +588,9 @@ class RemoteEventStore(EventStore):
             raise
 
     def delete(self, event_id: str, app_id: int) -> bool:
-        with _request(
-            self._url(app_id, f"/{event_id}"), "DELETE", timeout=self._timeout
+        with _ha_write(
+            self._ep, self._path(app_id, f"/{event_id}"), "DELETE",
+            timeout=self._timeout,
         ) as r:
             return bool(_json(r)["found"])
 
@@ -397,9 +598,9 @@ class RemoteEventStore(EventStore):
         self, app_id: int, filter: Optional[EventFilter] = None
     ) -> Iterator[Event]:
         body = self._filter_dict(filter or EventFilter())
-        resp = _request(
-            self._url(app_id, "/find"), "POST", json.dumps(body).encode(),
-            self._timeout, idempotent=True,  # pure read
+        resp = _ha_read(
+            self._ep, self._path(app_id, "/find"), "POST",
+            json.dumps(body).encode(), self._timeout,  # pure read
         )
 
         def iterate() -> Iterator[Event]:
@@ -433,9 +634,9 @@ class RemoteEventStore(EventStore):
         import numpy as np
 
         body = json.dumps(self._filter_dict(filter or EventFilter())).encode()
-        with _request(
-            self._url(app_id, "/scan_columnar"), "POST", body,
-            self._timeout, idempotent=True,  # pure read
+        with _ha_read(
+            self._ep, self._path(app_id, "/scan_columnar"), "POST", body,
+            self._timeout,  # pure read
         ) as r:
             cols = _json(r)
         cols["event_time_ms"] = np.asarray(cols["event_time_ms"], dtype=np.int64)
@@ -443,56 +644,56 @@ class RemoteEventStore(EventStore):
 
     def write(self, events, app_id: int) -> None:
         body = json.dumps([e.to_json_dict() for e in events]).encode()
-        with _request(self._url(app_id, "/batch"), "POST", body, self._timeout):
+        with _ha_write(
+            self._ep, self._path(app_id, "/batch"), "POST", body, self._timeout
+        ):
             pass
 
     def write_new(self, events, app_id: int) -> None:
         """Freshness contract forwarded to the server so the backing store
         can take its guaranteed-new batch path."""
         body = json.dumps([e.to_json_dict() for e in events]).encode()
-        with _request(
-            self._url(app_id, "/batch?fresh=1"), "POST", body, self._timeout
+        with _ha_write(
+            self._ep, self._path(app_id, "/batch?fresh=1"), "POST", body,
+            self._timeout,
         ):
             pass
 
 
 #: Pure-read metadata RPCs: pooled keep-alive + stale retry is safe for
-#: these (re-reading is harmless). Mutations (gen_next, inserts, updates,
-#: deletes) get no stale retry — gen_next retried twice burns a sequence
-#: value, an insert retried twice duplicates a row. An explicit allowlist,
-#: like METADATA_RPC_METHODS itself: a future method must be classified
-#: deliberately, never by name pattern.
-_READ_RPC_METHODS = frozenset(
-    {
-        "app_get",
-        "app_get_by_name",
-        "app_get_all",
-        "access_key_get",
-        "access_key_get_by_app",
-        "manifest_get",
-        "engine_instance_get",
-        "engine_instance_get_all",
-        "engine_instance_get_latest_completed",
-        "evaluation_instance_get",
-        "evaluation_instance_get_completed",
-    }
-)
+#: these (re-reading is harmless), and replicas may answer them.
+#: Mutations (gen_next, inserts, updates, deletes) get no stale retry —
+#: gen_next retried twice burns a sequence value, an insert retried
+#: twice duplicates a row. The allowlist itself is pinned server-side
+#: (``storage_server.METADATA_READ_METHODS``) so the client and the
+#: replica write-rejection can never diverge.
+_READ_RPC_METHODS = METADATA_READ_METHODS
 assert _READ_RPC_METHODS <= METADATA_RPC_METHODS
 
 
 class _RemoteRPC:
-    """One metadata RPC method bound to a URL."""
+    """One metadata RPC method bound to an endpoint set."""
 
-    def __init__(self, base: str, method: str, timeout: float):
-        self._base, self._method, self._timeout = base, method, timeout
-        self._idempotent = method in _READ_RPC_METHODS
+    def __init__(self, endpoints, method: str, timeout: float):
+        if isinstance(endpoints, str):  # bare URL accepted for callers
+            endpoints = _HAEndpoints(endpoints)
+        self._ep, self._method, self._timeout = endpoints, method, timeout
+        self._read = method in _READ_RPC_METHODS
 
     def __call__(self, *args):
         body = json.dumps(
             {"method": self._method, "args": [encode(a) for a in args]}
         ).encode()
-        with _request(f"{self._base}/metadata/rpc", "POST", body,
-                      self._timeout, idempotent=self._idempotent) as r:
+        if self._read:
+            resp = _ha_read(
+                self._ep, "/metadata/rpc", "POST", body, self._timeout
+            )
+        else:
+            resp = _ha_write(
+                self._ep, "/metadata/rpc", "POST", body, self._timeout,
+                idempotent=False,
+            )
+        with resp as r:
             return decode(_json(r)["result"])
 
 
@@ -504,9 +705,9 @@ class RemoteMetadataStore:
     """
 
     def __init__(self, base_url: str, timeout: float = 60.0):
-        base = base_url.rstrip("/")
+        endpoints = _HAEndpoints(base_url)
         for method in METADATA_RPC_METHODS:
-            setattr(self, method, _RemoteRPC(base, method, timeout))
+            setattr(self, method, _RemoteRPC(endpoints, method, timeout))
 
     def close(self) -> None:
         pass
@@ -514,20 +715,22 @@ class RemoteMetadataStore:
 
 class RemoteModelStore(ModelStore):
     def __init__(self, base_url: str, timeout: float = 60.0):
-        self._base = base_url.rstrip("/")
+        self._ep = _HAEndpoints(base_url)
         self._timeout = timeout
 
     def insert(self, model: Model) -> None:
         # PUT-by-id is a natural upsert: replaying it is safe
-        with _request(
-            f"{self._base}/models/{model.id}", "PUT", model.models,
+        with _ha_write(
+            self._ep, f"/models/{model.id}", "PUT", model.models,
             self._timeout, idempotent=True,
         ):
             pass
 
     def get(self, id: str) -> Optional[Model]:
         try:
-            with _request(f"{self._base}/models/{id}", timeout=self._timeout) as r:
+            with _ha_read(
+                self._ep, f"/models/{id}", timeout=self._timeout
+            ) as r:
                 return Model(id=id, models=r.read())
         except RemoteStorageError as exc:
             if exc.code == 404:
@@ -535,11 +738,19 @@ class RemoteModelStore(ModelStore):
             raise
 
     def delete(self, id: str) -> None:
-        with _request(f"{self._base}/models/{id}", "DELETE", timeout=self._timeout):
+        with _ha_write(
+            self._ep, f"/models/{id}", "DELETE", timeout=self._timeout
+        ):
             pass
 
 
 def _base_url(conf: SourceConf) -> str:
+    """Resolve a source conf to a (possibly multi-endpoint) base URL:
+    ``URL`` verbatim, ``NODES`` as a ``pio+ha://`` set, else HOST/PORT."""
+    if conf.get("url"):
+        return conf["url"]
+    if conf.get("nodes"):
+        return f"pio+ha://{conf['nodes']}"
     host = conf.get("host", "127.0.0.1")
     port = int(conf.get("port", DEFAULT_PORT))
     return f"http://{host}:{port}"

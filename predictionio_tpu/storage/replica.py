@@ -1,0 +1,390 @@
+"""Warm-standby storage replica: changefeed tailing, read serving,
+promotion.
+
+The availability half of the replication tier (``docs/storage.md``):
+a :class:`StorageReplica` owns its *own* local stores and keeps them
+converged with a primary by tailing ``GET /replicate/changes`` —
+sequence-keyed, idempotent replay (``changefeed.apply_op``), so replays
+after a replica crash are harmless. It serves every read route of the
+storage API (replicas double as read capacity for training scans),
+rejects mutations with ``409`` + a primary hint, and reports lag on
+``GET /status.json``.
+
+Read-your-writes: a read carrying ``X-PIO-Min-Seq`` (the client's last
+acked write seq) is held for up to ``catchup_wait_s`` waiting for the
+tailer to apply that seq, then answered ``409`` with the applied seq —
+wait-or-reject, never a silently stale answer.
+
+Progress durability: ``applied.json`` in ``state_dir`` records the seq
+the local stores have durably applied through, written crash-safely
+(``utils/durability.atomic_write_bytes``) *after* each applied batch. A
+crash between apply and marker write means the marker under-reports —
+the tailer then re-fetches and re-applies a suffix, which idempotent
+replay absorbs.
+
+**Promotion** (warm-standby failover): :meth:`StorageReplica.promote`
+stops the tailer and attaches a fresh changefeed whose numbering
+*continues* from the applied seq (``OpLog(base_seq=applied)``), so
+client seq tokens issued by the old primary stay meaningful against the
+new one. The oplog generation changes — surviving replicas of the dead
+primary must resync rather than silently tail a diverged history.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.durability import atomic_write_bytes
+from .changefeed import Changefeed, apply_op
+from .metadata import MetadataStore
+from .oplog import OpLog
+from .storage_server import StorageServer
+
+logger = logging.getLogger(__name__)
+
+_APPLIED_NAME = "applied.json"
+
+
+class ReplicationError(Exception):
+    """The changefeed cannot be tailed incrementally any further:
+    generation mismatch (primary wiped/replaced) or sequence gap (this
+    replica fell behind a truncated/promoted log). Requires a resync."""
+
+
+class ReplicaTailer:
+    """Pulls the primary's changefeed into local stores.
+
+    Single-threaded by contract: call :meth:`step` from one place (the
+    replica's poll loop, or a test driving it deterministically)."""
+
+    def __init__(
+        self,
+        primary_url: str,
+        events,
+        metadata: MetadataStore,
+        models,
+        state_dir: str,
+        timeout: float = 30.0,
+        batch_limit: int = 500,
+    ):
+        self._primary = primary_url.rstrip("/")
+        self._events = events
+        self._metadata = metadata
+        self._models = models
+        self._state_dir = state_dir
+        self._timeout = timeout
+        self._batch_limit = batch_limit
+        #: serializes the apply phase against promotion: promote() takes
+        #: this lock after stopping the poll loop, so a batch already
+        #: fetched from the dying primary can never apply *after* the
+        #: node started accepting its own writes
+        self.apply_lock = threading.Lock()
+        #: checked (under apply_lock) before applying a fetched batch
+        self.aborted: Callable[[], bool] = lambda: False
+        os.makedirs(state_dir, exist_ok=True)
+        self._applied_path = os.path.join(state_dir, _APPLIED_NAME)
+        self.applied_seq = 0
+        self.generation: Optional[str] = None
+        self.primary_seq: Optional[int] = None  # last observed, for lag
+        self.last_error: Optional[str] = None
+        self._load_applied()
+
+    # -- progress marker --------------------------------------------------
+    def _load_applied(self) -> None:
+        try:
+            with open(self._applied_path) as fh:
+                state = json.load(fh)
+            self.applied_seq = int(state["seq"])
+            self.generation = state.get("generation")
+        except (OSError, ValueError, KeyError):
+            self.applied_seq = 0
+            self.generation = None
+
+    def _persist_applied(self) -> None:
+        atomic_write_bytes(
+            self._applied_path,
+            json.dumps(
+                {"seq": self.applied_seq, "generation": self.generation}
+            ).encode(),
+        )
+
+    # -- tailing ----------------------------------------------------------
+    def _fetch(self) -> dict:
+        from .remote import RemoteStorageError, _json, _request
+
+        url = (
+            f"{self._primary}/replicate/changes"
+            f"?since={self.applied_seq}&limit={self._batch_limit}"
+        )
+        try:
+            with _request(url, timeout=self._timeout) as resp:
+                return _json(resp)
+        except RemoteStorageError as exc:
+            if exc.code == 410:
+                raise ReplicationError(
+                    f"changefeed gap at seq {self.applied_seq}: {exc}"
+                ) from exc
+            raise
+
+    def lag(self) -> Optional[int]:
+        """Ops behind the last observed primary seq (None before the
+        first successful fetch)."""
+        if self.primary_seq is None:
+            return None
+        return max(0, self.primary_seq - self.applied_seq)
+
+    def step(self) -> int:
+        """One fetch+apply round; returns the number of ops applied.
+        Transport errors propagate (the poll loop logs and retries);
+        :class:`ReplicationError` means incremental tailing is over."""
+        batch = self._fetch()
+        with self.apply_lock:
+            if self.aborted():
+                return 0  # promotion won the race: drop the fetched batch
+            generation = batch.get("generation")
+            if self.generation is None:
+                self.generation = generation
+            elif generation != self.generation:
+                raise ReplicationError(
+                    f"primary generation changed ({self.generation} -> "
+                    f"{generation}): store was replaced, resync required"
+                )
+            self.primary_seq = int(batch["lastSeq"])
+            if self.primary_seq < self.applied_seq:
+                # Same generation but the primary's history ENDS before
+                # our applied seq: a post-power-loss restart truncated
+                # records we already consumed from its page cache, and
+                # any seqs it re-mints will carry different ops. Silent
+                # `seq <= applied` skipping would diverge forever — this
+                # must be as loud as a generation change.
+                raise ReplicationError(
+                    f"primary seq {self.primary_seq} behind applied "
+                    f"{self.applied_seq} under generation "
+                    f"{self.generation}: primary history rewound "
+                    "(post-crash truncation), resync required"
+                )
+            applied = 0
+            for entry in batch.get("changes", []):
+                seq = int(entry["seq"])
+                if seq <= self.applied_seq:
+                    continue  # idempotent replay keyed on seq
+                apply_op(
+                    entry["op"], self._events, self._metadata, self._models
+                )
+                self.applied_seq = seq
+                applied += 1
+            if applied:
+                self._persist_applied()
+            elif self.generation is not None and not os.path.exists(
+                self._applied_path
+            ):
+                self._persist_applied()  # pin the generation before op 1
+            return applied
+
+    def catch_up(self, max_rounds: int = 10_000) -> int:
+        """Drain the feed until the replica matches the primary's current
+        seq; returns the final applied seq. Deterministic (no sleeps)."""
+        for _ in range(max_rounds):
+            self.step()
+            if self.primary_seq is not None and self.applied_seq >= self.primary_seq:
+                return self.applied_seq
+        raise ReplicationError(
+            f"no convergence after {max_rounds} rounds "
+            f"(applied {self.applied_seq}, primary {self.primary_seq})"
+        )
+
+
+class StorageReplica(StorageServer):
+    """Read-only storage server converging on a primary's changefeed."""
+
+    accepts_writes = False
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        events,
+        metadata: MetadataStore,
+        models,
+        primary_url: str,
+        state_dir: str,
+        catchup_wait_s: float = 2.0,
+        timeout: float = 30.0,
+    ):
+        super().__init__(host, port, events, metadata, models, changefeed=None)
+        self.primary_url = primary_url.rstrip("/")
+        self.catchup_wait_s = catchup_wait_s
+        self.tailer = ReplicaTailer(
+            self.primary_url, events, metadata, models, state_dir,
+            timeout=timeout,
+        )
+        self.tailer.aborted = lambda: self._stop_polling.is_set()
+        self._applied_cond = threading.Condition()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._stop_polling = threading.Event()
+
+    # -- replication hooks ------------------------------------------------
+    def applied_seq(self) -> int:
+        if self.changefeed is not None:  # promoted
+            return self.changefeed.last_seq
+        return self.tailer.applied_seq
+
+    def wait_for_seq(self, min_seq: int, deadline=None) -> bool:
+        """Bounded wait for the tailer to apply ``min_seq`` (notified per
+        batch). The bound is ``catchup_wait_s`` capped by the request
+        deadline — wait-or-reject, never an unbounded hold."""
+        if self.applied_seq() >= min_seq:
+            return True
+        budget = self.catchup_wait_s
+        if deadline is not None:
+            budget = min(budget, max(0.0, deadline.remaining_s()))
+        end = time.monotonic() + budget
+        with self._applied_cond:
+            while self.applied_seq() < min_seq:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._applied_cond.wait(remaining)
+        return True
+
+    def step(self) -> int:
+        """One deterministic tail round (tests and the poll loop)."""
+        applied = self.tailer.step()
+        if applied:
+            with self._applied_cond:
+                self._applied_cond.notify_all()
+        return applied
+
+    def catch_up(self) -> int:
+        seq = self.tailer.catch_up()
+        with self._applied_cond:
+            self._applied_cond.notify_all()
+        return seq
+
+    # -- background polling ----------------------------------------------
+    def start_tailing(
+        self,
+        poll_interval_s: float = 0.5,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> threading.Thread:
+        """Poll the primary in a daemon thread. Transport errors are
+        logged and retried on the next interval (the primary being down
+        is the replica's *reason to exist*, not a crash); a
+        :class:`ReplicationError` stops tailing and is surfaced in
+        ``/status.json``."""
+
+        def loop() -> None:
+            while not self._stop_polling.is_set():
+                try:
+                    applied = self.step()
+                    self.tailer.last_error = None
+                except ReplicationError as exc:
+                    self.tailer.last_error = str(exc)
+                    logger.error("replica tailing stopped: %s", exc)
+                    return
+                except Exception as exc:
+                    if str(exc) != self.tailer.last_error:
+                        # log on state change only, not once per poll —
+                        # a dead primary for an hour is one line, not 7200
+                        logger.warning("replica tail fetch failed: %s", exc)
+                    self.tailer.last_error = str(exc)
+                    applied = 0
+                if applied == 0:
+                    sleep(poll_interval_s)
+
+        self._poll_thread = threading.Thread(target=loop, daemon=True)
+        self._poll_thread.start()
+        return self._poll_thread
+
+    def stop_tailing(self) -> None:
+        self._stop_polling.set()
+
+    # -- failover ---------------------------------------------------------
+    def promote(self, oplog_dir: Optional[str] = None) -> dict:
+        """Become the primary: stop tailing, attach a fresh changefeed
+        continuing this replica's applied sequence numbering, accept
+        writes. Returns the new role status. Idempotent — promoting an
+        already-promoted replica is a no-op."""
+        if self.accepts_writes:
+            return self.status_json()
+        self.stop_tailing()
+        # Take the apply gate: a batch already fetched from the dying
+        # primary must either finish applying NOW or be dropped (the
+        # tailer re-checks `aborted` under this lock) — never land after
+        # this node starts acking its own writes.
+        with self.tailer.apply_lock:
+            applied = self.tailer.applied_seq
+            if oplog_dir is None:
+                # applied-seq-suffixed dir: re-promotion at a different
+                # seq can never silently reuse a stale sequence history
+                # (OpLog also refuses a base_seq mismatch loudly)
+                oplog_dir = os.path.join(
+                    self.tailer._state_dir, f"oplog-{applied}"
+                )
+            self.changefeed = Changefeed(
+                OpLog(oplog_dir, base_seq=applied),
+                self.events, self.metadata, self.models,
+            )
+            self.accepts_writes = True
+            self.primary_url = None
+        with self._applied_cond:
+            self._applied_cond.notify_all()  # release any waiting reads
+        logger.info("replica promoted to primary at seq %d", applied)
+        return self.status_json()
+
+    def checkpoint_json(self) -> Optional[dict]:
+        """Replicas answer the freshness probe from their applied state
+        (no changefeed exists until promotion)."""
+        if self.changefeed is not None:  # promoted
+            return super().checkpoint_json()
+        return {
+            "seq": self.tailer.applied_seq,
+            "generation": self.tailer.generation,
+            "replica": True,
+        }
+
+    def status_json(self) -> dict:
+        out = super().status_json()
+        if self.accepts_writes:
+            return out  # promoted: plain primary status
+        out["appliedSeq"] = self.tailer.applied_seq
+        out["primary"] = self.primary_url
+        lag = self.tailer.lag()
+        if lag is not None:
+            out["lag"] = lag
+        if self.tailer.last_error:
+            out["lastError"] = self.tailer.last_error
+        return out
+
+
+def create_storage_replica(
+    host: str,
+    port: int,
+    primary_url: str,
+    registry=None,
+    state_dir: Optional[str] = None,
+) -> StorageReplica:
+    """Build a replica fronting ``registry``'s local stores (the ``pio
+    storageserver --replica-of URL`` entry point)."""
+    if registry is None:
+        from .registry import get_registry
+
+        registry = get_registry()
+    if state_dir is None:
+        from .registry import base_dir
+
+        state_dir = os.path.join(base_dir(), "replica_state")
+    return StorageReplica(
+        host,
+        port,
+        registry.get_events(),
+        registry.get_metadata(),
+        registry.get_models(),
+        primary_url,
+        state_dir,
+    )

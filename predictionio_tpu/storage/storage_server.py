@@ -25,6 +25,22 @@ Wire surface (all JSON unless noted):
   backing store classes
 * ``GET /health``                      liveness probe (kept for existing
   probes; ``GET /`` is the richer twin)
+* ``GET /status.json``                 machine-readable status: role
+  (primary/replica), changefeed seq, replication lag on replicas
+* ``GET /replicate/changes``           ``?since=<seq>&limit=N`` — batched
+  changefeed records for replica tailing (``docs/storage.md#replication``)
+* ``GET /replicate/checkpoint``        current seq + store fingerprint
+  (the oplog generation id)
+* ``POST /replicate/promote``          replica only: stop tailing, start
+  accepting writes with a fresh changefeed
+
+When a :class:`~predictionio_tpu.storage.changefeed.Changefeed` is
+attached (``create_storage_server(oplog_dir=...)``, the ``pio
+storageserver`` default), every mutating response carries the assigned
+sequence number in ``X-PIO-Seq`` — the client's read-your-writes token.
+Replica servers reject mutations with ``409`` + a primary hint, and gate
+reads carrying ``X-PIO-Min-Seq`` on their applied seq (wait-or-reject;
+see ``storage/replica.py``).
 
 Requests may carry an ``X-PIO-Deadline-Ms`` header (remaining budget in
 milliseconds, set by the ``storage/remote.py`` client when an ambient
@@ -44,9 +60,11 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..utils.resilience import DEADLINE_HEADER, Deadline
+from .changefeed import MIN_SEQ_HEADER, SEQ_HEADER
 from .event import Event
 from .events import EventFilter
 from .metadata import MetadataStore
+from .oplog import OpLogGap
 from .wire import decode, encode
 
 logger = logging.getLogger(__name__)
@@ -84,6 +102,26 @@ METADATA_RPC_METHODS = frozenset(
     }
 )
 
+#: Pure-read subset of the RPC surface: safe on replicas, safe to retry
+#: on stale keep-alive connections (``remote.py`` aliases this). The
+#: mutating complement lives in ``changefeed.METADATA_MUTATING_METHODS``;
+#: ``tests/test_replication.py`` pins that the two partition the whole.
+METADATA_READ_METHODS = frozenset(
+    {
+        "app_get",
+        "app_get_by_name",
+        "app_get_all",
+        "access_key_get",
+        "access_key_get_by_app",
+        "manifest_get",
+        "engine_instance_get",
+        "engine_instance_get_all",
+        "engine_instance_get_latest_completed",
+        "evaluation_instance_get",
+        "evaluation_instance_get_completed",
+    }
+)
+
 
 def _parse_filter(obj: dict) -> EventFilter:
     kwargs = dict(obj)
@@ -114,6 +152,12 @@ class _StorageHandler(JsonHTTPHandler):
                 self.respond(200, self.server.status_json())
             elif parts == ["health"]:
                 self.respond(200, {"status": "alive"})
+            elif parts == ["status.json"] and method == "GET":
+                self.respond(200, self.server.status_json())
+            elif parts and parts[0] == "replicate":
+                self._route_replicate(method, parts[1:])
+            elif not self._gate_min_seq(deadline):
+                pass  # replica behind the caller's seq token: 409 sent
             elif parts and parts[0] == "events":
                 self._route_events(method, parts[1:])
             elif parts == ["metadata", "rpc"] and method == "POST":
@@ -141,6 +185,92 @@ class _StorageHandler(JsonHTTPHandler):
             except Exception:
                 pass  # client hung up mid-response
 
+    # -- replication plumbing --------------------------------------------
+    def _gate_min_seq(self, deadline: Optional[Deadline]) -> bool:
+        """Read-your-writes gate: a request carrying ``X-PIO-Min-Seq``
+        proceeds only once this server has applied that seq (trivially
+        true on a primary). Returns False after sending the 409."""
+        raw = self.headers.get(MIN_SEQ_HEADER)
+        if raw is None:
+            return True
+        try:
+            min_seq = int(raw)
+        except ValueError:
+            return True  # garbled header degrades to an ungated read
+        if self.server.wait_for_seq(min_seq, deadline):
+            return True
+        self.read_body()
+        self.respond(
+            409,
+            {
+                "message": "replica behind requested seq",
+                "appliedSeq": self.server.applied_seq(),
+                "minSeq": min_seq,
+                "primary": self.server.primary_url,
+            },
+        )
+        return False
+
+    def _reject_writes(self) -> bool:
+        """On a replica, answer a mutating request with 409 + the primary
+        hint. Returns True when the request was consumed."""
+        if self.server.accepts_writes:
+            return False
+        self.read_body()
+        self.respond(
+            409,
+            {
+                "message": "replica: writes must go to the primary",
+                "primary": self.server.primary_url,
+            },
+        )
+        return True
+
+    @staticmethod
+    def _seq_headers(seq) -> Optional[dict]:
+        return {SEQ_HEADER: seq} if seq is not None else None
+
+    def _route_replicate(self, method: str, rest: list) -> None:
+        cf = self.server.changefeed
+        if rest == ["changes"] and method == "GET":
+            if cf is None:
+                self.respond(404, {"message": "changefeed disabled"})
+                return
+            q = parse_qs(urlparse(self.path).query)
+            since = int(q.get("since", ["0"])[0])
+            limit = min(max(1, int(q.get("limit", ["500"])[0])), 1000)
+            try:
+                entries, last_seq = cf.oplog.read_since(since, limit)
+            except OpLogGap as exc:
+                self.respond(410, {"message": str(exc), **cf.oplog.checkpoint()})
+                return
+            self.respond(
+                200,
+                {
+                    "changes": [{"seq": s, "op": o} for s, o in entries],
+                    "lastSeq": last_seq,
+                    "generation": cf.oplog.generation,
+                    "oldestSeq": cf.oplog.oldest_seq,
+                },
+            )
+        elif rest == ["checkpoint"] and method == "GET":
+            ck = self.server.checkpoint_json()
+            if ck is None:
+                self.respond(404, {"message": "changefeed disabled"})
+                return
+            ck["stores"] = self.server.status_json()["stores"]
+            self.respond(200, ck)
+        elif rest == ["promote"] and method == "POST":
+            self.read_body()
+            result = self.server.promote()
+            if result is None:
+                self.respond(409, {"message": "not a replica"})
+            else:
+                self.respond(200, result)
+        else:
+            self.read_body()
+            self.respond(404, {"message": "Not found"})
+
     def do_GET(self) -> None:  # noqa: N802
         self._route("GET")
 
@@ -162,19 +292,36 @@ class _StorageHandler(JsonHTTPHandler):
             return
         app_id = int(parts[0])
         rest = parts[1:]
+        cf = self.server.changefeed
         if method == "POST" and not rest:
+            if self._reject_writes():
+                return
             event = Event.from_json_dict(json.loads(self.read_body()))
-            self.respond(201, {"eventId": store.insert(event, app_id)})
+            if cf is not None:
+                event_id, seq = cf.insert_event(event, app_id)
+                self.respond(
+                    201, {"eventId": event_id}, headers=self._seq_headers(seq)
+                )
+            else:
+                self.respond(201, {"eventId": store.insert(event, app_id)})
         elif method == "POST" and rest == ["batch"]:
+            if self._reject_writes():
+                return
             fresh = parse_qs(urlparse(self.path).query).get("fresh")
+            fresh = bool(fresh and fresh[0] == "1")
             events = [
                 Event.from_json_dict(o) for o in json.loads(self.read_body())
             ]
-            if fresh and fresh[0] == "1":
+            seq = None
+            if cf is not None:
+                seq = cf.write_events(events, app_id, fresh)
+            elif fresh:
                 store.write_new(events, app_id)
             else:
                 store.write(events, app_id)
-            self.respond(200, {"count": len(events)})
+            self.respond(
+                200, {"count": len(events)}, headers=self._seq_headers(seq)
+            )
         elif method == "POST" and rest == ["find"]:
             flt = _parse_filter(json.loads(self.read_body() or b"{}"))
             self._stream_events(store.find(app_id, flt))
@@ -182,11 +329,23 @@ class _StorageHandler(JsonHTTPHandler):
             flt = _parse_filter(json.loads(self.read_body() or b"{}"))
             self._scan_columnar(store, app_id, flt)
         elif method == "POST" and rest == ["init"]:
+            if self._reject_writes():
+                return
             self.read_body()
-            self.respond(200, {"ok": store.init(app_id)})
+            if cf is not None:
+                ok, seq = cf.init_app(app_id)
+                self.respond(200, {"ok": ok}, headers=self._seq_headers(seq))
+            else:
+                self.respond(200, {"ok": store.init(app_id)})
         elif method == "POST" and rest == ["remove"]:
+            if self._reject_writes():
+                return
             self.read_body()
-            self.respond(200, {"ok": store.remove(app_id)})
+            if cf is not None:
+                ok, seq = cf.remove_app(app_id)
+                self.respond(200, {"ok": ok}, headers=self._seq_headers(seq))
+            else:
+                self.respond(200, {"ok": store.remove(app_id)})
         elif method == "GET" and len(rest) == 1:
             event = store.get(rest[0], app_id)
             if event is None:
@@ -194,7 +353,15 @@ class _StorageHandler(JsonHTTPHandler):
             else:
                 self.respond(200, event.to_json_dict())
         elif method == "DELETE" and len(rest) == 1:
-            self.respond(200, {"found": store.delete(rest[0], app_id)})
+            if self._reject_writes():
+                return
+            if cf is not None:
+                found, seq = cf.delete_event(rest[0], app_id)
+                self.respond(
+                    200, {"found": found}, headers=self._seq_headers(seq)
+                )
+            else:
+                self.respond(200, {"found": store.delete(rest[0], app_id)})
         else:
             self.read_body()
             self.respond(404, {"message": "Not found"})
@@ -254,18 +421,42 @@ class _StorageHandler(JsonHTTPHandler):
         if method not in METADATA_RPC_METHODS:
             self.respond(400, {"message": f"Unknown RPC method {method!r}"})
             return
+        if method not in METADATA_READ_METHODS and not self.server.accepts_writes:
+            self.respond(
+                409,
+                {
+                    "message": "replica: writes must go to the primary",
+                    "primary": self.server.primary_url,
+                },
+            )
+            return
         args = [decode(a) for a in req.get("args", [])]
-        result = getattr(self.server.metadata, method)(*args)
-        self.respond(200, {"result": encode(result)})
+        cf = self.server.changefeed
+        if cf is not None:
+            result, seq = cf.metadata_rpc(method, args)
+            self.respond(
+                200, {"result": encode(result)}, headers=self._seq_headers(seq)
+            )
+        else:
+            result = getattr(self.server.metadata, method)(*args)
+            self.respond(200, {"result": encode(result)})
 
     # -- models -----------------------------------------------------------
     def _route_models(self, method: str, model_id: str) -> None:
         from .model_store import Model
 
         store = self.server.models
+        cf = self.server.changefeed
         if method == "PUT":
-            store.insert(Model(id=model_id, models=self.read_body()))
-            self.respond(200, {"ok": True})
+            if self._reject_writes():
+                return
+            model = Model(id=model_id, models=self.read_body())
+            if cf is not None:
+                seq = cf.put_model(model)
+                self.respond(200, {"ok": True}, headers=self._seq_headers(seq))
+            else:
+                store.insert(model)
+                self.respond(200, {"ok": True})
         elif method == "GET":
             model = store.get(model_id)
             if model is None:
@@ -273,28 +464,80 @@ class _StorageHandler(JsonHTTPHandler):
             else:
                 self.respond(200, model.models, content_type="application/octet-stream")
         elif method == "DELETE":
-            store.delete(model_id)
-            self.respond(200, {"ok": True})
+            if self._reject_writes():
+                return
+            if cf is not None:
+                seq = cf.delete_model(model_id)
+                self.respond(200, {"ok": True}, headers=self._seq_headers(seq))
+            else:
+                store.delete(model_id)
+                self.respond(200, {"ok": True})
         else:
             self.read_body()
             self.respond(404, {"message": "Not found"})
 
 
 class StorageServer(BackgroundHTTPServer):
-    """HTTP front for one set of backing stores."""
+    """HTTP front for one set of backing stores.
 
-    def __init__(self, host: str, port: int, events, metadata: MetadataStore, models):
+    With ``changefeed`` attached, the server is a replication *primary*:
+    mutations are sequence-numbered and shipped via ``/replicate/*``.
+    The replica twin lives in ``storage/replica.py``
+    (:class:`StorageReplica` subclasses this and flips
+    ``accepts_writes``)."""
+
+    #: replicas flip this to False and reject mutations with 409
+    accepts_writes = True
+    #: the write endpoint to hint in replica 409s (None on a primary)
+    primary_url: Optional[str] = None
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        events,
+        metadata: MetadataStore,
+        models,
+        changefeed=None,
+    ):
         super().__init__((host, port), _StorageHandler)
         self.events = events
         self.metadata = metadata
         self.models = models
+        self.changefeed = changefeed
         self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
+
+    # -- replication hooks (overridden by StorageReplica) -----------------
+    def applied_seq(self) -> int:
+        """Highest seq this server has applied. A primary's stores are
+        the authoritative state, so it equals the changefeed seq."""
+        return self.changefeed.last_seq if self.changefeed is not None else 0
+
+    def wait_for_seq(self, min_seq: int, deadline=None) -> bool:
+        """Read-your-writes gate. A primary is always caught up with its
+        own writes; replicas override with a bounded wait."""
+        return True
+
+    def promote(self) -> Optional[dict]:
+        """Replica-only; a primary answers ``POST /replicate/promote``
+        with 409 (signalled by None here)."""
+        return None
+
+    def checkpoint_json(self) -> Optional[dict]:
+        """``GET /replicate/checkpoint`` body: seq + store fingerprint.
+        Replicas override to answer from their applied state — the HA
+        client's freshness probe must work on replicas, which have no
+        changefeed until promoted. None → 404."""
+        if self.changefeed is None:
+            return None
+        return self.changefeed.oplog.checkpoint()
 
     def status_json(self) -> dict:
         """``GET /`` readiness body — Event-Server ``{"status": "alive"}``
         parity plus enough identity for a fleet dashboard."""
-        return {
+        out = {
             "status": "alive",
+            "role": "primary" if self.accepts_writes else "replica",
             "startTime": self.start_time.isoformat(timespec="milliseconds"),
             "stores": {
                 "events": type(self.events).__name__,
@@ -302,23 +545,32 @@ class StorageServer(BackgroundHTTPServer):
                 "models": type(self.models).__name__,
             },
         }
+        if self.changefeed is not None:
+            out["seq"] = self.changefeed.last_seq
+            out["generation"] = self.changefeed.oplog.generation
+        return out
 
 
 def create_storage_server(
     host: str = "127.0.0.1",
     port: int = DEFAULT_PORT,
     registry: Optional[object] = None,
+    oplog_dir: Optional[str] = None,
 ) -> StorageServer:
     """Build a storage server fronting ``registry`` (default: the
-    process-wide env-configured registry)."""
+    process-wide env-configured registry). ``oplog_dir`` attaches a
+    changefeed rooted there, making the server a replication primary."""
     if registry is None:
         from .registry import get_registry
 
         registry = get_registry()
-    return StorageServer(
-        host,
-        port,
-        registry.get_events(),
-        registry.get_metadata(),
-        registry.get_models(),
-    )
+    events = registry.get_events()
+    metadata = registry.get_metadata()
+    models = registry.get_models()
+    changefeed = None
+    if oplog_dir is not None:
+        from .changefeed import Changefeed
+        from .oplog import OpLog
+
+        changefeed = Changefeed(OpLog(oplog_dir), events, metadata, models)
+    return StorageServer(host, port, events, metadata, models, changefeed)

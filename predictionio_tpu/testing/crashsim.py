@@ -1,0 +1,460 @@
+"""Deterministic crash-consistency simulator.
+
+The correctness twin of ``testing/faults.py``: faults.py proves the
+online path survives *network* failure; this module proves the storage
+plane survives *process/power* failure. It interposes on the file
+mutations a workload performs under one root directory, then enumerates
+the directory states a crash could have left behind, so a test can
+assert recovery invariants over every one of them ("old value or new
+value, never garbage" — ``tests/test_crash_consistency.py``).
+
+Crash model (deliberately adversarial, strictly deterministic):
+
+1. **Prefix cuts** — the crash happens between any two recorded
+   mutations: states ``ops[0:k]`` for every ``k``. This models a plain
+   process kill (page cache survives, so completed writes persist).
+2. **Unsynced data loss** — for each cut, any *individual* write whose
+   file was never ``fsync``'d between the write and the cut may have
+   lost a suffix of its data (truncated to 0, half, len-1 bytes) while
+   **later metadata ops — including ``os.replace`` — still applied**.
+   This is the power-loss reordering that makes write-then-rename
+   without fsync a torn-blob bug: the rename's metadata journals before
+   the data blocks hit disk (the ``robust-rename-no-fsync`` lint rule's
+   failure mode, ``utils/durability.py``).
+
+States are deduplicated by content, so tests iterate a bounded set.
+Single-victim truncation (one lossy write per state) keeps enumeration
+linear; it is enough to catch every ordering bug a single missing fsync
+can cause.
+
+Usage::
+
+    sim = CrashSim()
+    with sim.record(root):
+        workload(root)              # plain open/os.replace/np.savez/...
+    for state in sim.crash_states():
+        crashed = state.materialize(fresh_dir())
+        assert recovery_invariant(crashed)
+
+Interposition covers Python-level file I/O (``open``/``io.open``,
+``os.replace``/``rename``/``remove``/``mkdir``/``rmdir``/``fsync``/
+``fdatasync``/``os.open``, and ``shutil.rmtree`` which is swapped for a
+recorded re-implementation). Writers that mutate files from C
+(**SQLite**) are invisible to the interposer — for those, use
+**snapshot mode**: call :meth:`CrashSim.mark` at each commit boundary
+and iterate :meth:`snapshot_states`; that asserts old-or-new across
+boundaries, leaning on SQLite's own journal for sub-commit atomicity.
+"""
+
+from __future__ import annotations
+
+import builtins
+import contextlib
+import dataclasses
+import hashlib
+import io
+import os
+import shutil
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+__all__ = ["CrashSim", "CrashState"]
+
+
+@dataclasses.dataclass
+class _Op:
+    kind: str  # write | trunc | fsync | replace | remove | mkdir | rmdir
+    path: str = ""  # root-relative
+    path2: str = ""  # replace destination
+    offset: int = 0
+    data: bytes = b""
+    fid: int = -1  # file identity (stable across rename)
+
+
+@dataclasses.dataclass
+class _Tree:
+    files: Dict[str, bytes]
+    dirs: Set[str]
+
+
+def _snapshot_tree(root: str) -> _Tree:
+    files: Dict[str, bytes] = {}
+    dirs: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        if rel != ".":
+            dirs.add(rel)
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            with io.open(path, "rb") as fh:  # the *real* open when patched
+                files[os.path.relpath(path, root)] = fh.read()
+    return _Tree(files, dirs)
+
+
+class _RecordingFile:
+    """Write-mode file proxy: records each ``write`` as (path, offset,
+    bytes). Binary offsets come from ``tell()`` (seek-safe — zipfile's
+    header backpatching is captured exactly); text mode keeps a byte
+    counter (sequential writers only, which is all the package has)."""
+
+    def __init__(self, sim: "CrashSim", fh, rel: str, fid: int, binary: bool,
+                 append: bool):
+        self._sim = sim
+        self._fh = fh
+        self._rel = rel
+        self._fid = fid
+        self._binary = binary
+        # O_APPEND files report tell()==0 until the first write, and all
+        # writes land at EOF regardless of seeks — track the append
+        # cursor explicitly from the size at open.
+        self._pos = None
+        if append or not binary:
+            try:
+                self._pos = os.fstat(fh.fileno()).st_size if append else 0
+            except (OSError, AttributeError):
+                self._pos = 0
+
+    def write(self, data):
+        if self._binary:
+            encoded = bytes(data)
+            offset = self._pos if self._pos is not None else self._fh.tell()
+        else:
+            encoded = data.encode(self._fh.encoding or "utf-8")
+            offset = self._pos
+        n = self._fh.write(data)
+        if self._pos is not None:
+            self._pos += len(encoded)
+        self._sim._record(
+            _Op("write", self._rel, offset=offset, data=encoded,
+                fid=self._fid)
+        )
+        return n
+
+    def writelines(self, lines) -> None:
+        for line in lines:
+            self.write(line)
+
+    def fileno(self) -> int:
+        fd = self._fh.fileno()
+        self._sim._fd_fids[fd] = self._fid
+        return fd
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        # drop the fd→fid mapping before the kernel recycles the number
+        try:
+            self._sim._fd_fids.pop(self._fh.fileno(), None)
+        except (OSError, ValueError):
+            pass
+        self._fh.close()
+
+    def __getattr__(self, name):
+        return getattr(self._fh, name)
+
+    def __iter__(self):
+        return iter(self._fh)
+
+
+class CrashState:
+    """One reconstructible post-crash directory state."""
+
+    def __init__(
+        self,
+        baseline: _Tree,
+        ops: List[_Op],
+        cut: int,
+        lost: Optional[Dict[int, int]] = None,
+    ):
+        self._baseline = baseline
+        self._ops = ops
+        self.cut = cut
+        self.lost = lost or {}
+
+    def describe(self) -> str:
+        return f"cut={self.cut} lost={self.lost or '{}'}"
+
+    def tree(self) -> _Tree:
+        files = dict(self._baseline.files)
+        dirs = set(self._baseline.dirs)
+        for i, op in enumerate(self._ops[: self.cut]):
+            if op.kind == "write":
+                data = op.data
+                if i in self.lost:
+                    data = data[: self.lost[i]]
+                buf = bytearray(files.get(op.path, b""))
+                if len(buf) < op.offset:
+                    buf.extend(b"\0" * (op.offset - len(buf)))
+                buf[op.offset : op.offset + len(data)] = data
+                files[op.path] = bytes(buf)
+            elif op.kind == "trunc":
+                files[op.path] = b""
+            elif op.kind == "replace":
+                if op.path in files:
+                    files[op.path2] = files.pop(op.path)
+            elif op.kind == "remove":
+                files.pop(op.path, None)
+            elif op.kind == "mkdir":
+                dirs.add(op.path)
+            elif op.kind == "rmdir":
+                dirs.discard(op.path)
+            # fsync: durability marker only, no state change
+        return _Tree(files, dirs)
+
+    def digest(self) -> str:
+        tree = self.tree()
+        h = hashlib.sha256()
+        for path in sorted(tree.files):
+            h.update(path.encode())
+            h.update(b"\0")
+            h.update(hashlib.sha256(tree.files[path]).digest())
+        for d in sorted(tree.dirs):
+            h.update(b"D")
+            h.update(d.encode())
+        return h.hexdigest()
+
+    def materialize(self, target_dir: str) -> str:
+        """Write this state under ``target_dir`` (created, must be empty
+        or absent) and return it."""
+        tree = self.tree()
+        os.makedirs(target_dir, exist_ok=True)
+        for d in sorted(tree.dirs):
+            os.makedirs(os.path.join(target_dir, d), exist_ok=True)
+        for rel, data in tree.files.items():
+            path = os.path.join(target_dir, rel)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with io.open(path, "wb") as fh:
+                fh.write(data)
+        return target_dir
+
+
+class _SnapshotState(CrashState):
+    def __init__(self, tree: _Tree):
+        super().__init__(tree, [], 0)
+
+    def describe(self) -> str:
+        return "snapshot"
+
+
+class CrashSim:
+    """Recorder + crash-state enumerator. One instance, one workload."""
+
+    _PATCHED = (
+        "fsync", "fdatasync", "replace", "rename", "remove", "unlink",
+        "mkdir", "rmdir", "open",
+    )
+
+    def __init__(self):
+        self.ops: List[_Op] = []
+        self._baseline: Optional[_Tree] = None
+        self._root: Optional[str] = None
+        self._fids: Dict[str, int] = {}
+        self._next_fid = 0
+        self._fd_fids: Dict[int, int] = {}
+        self._marks: List[_Tree] = []
+
+    # -- recording machinery ---------------------------------------------
+    def _record(self, op: _Op) -> None:
+        self.ops.append(op)
+
+    def _rel(self, path) -> Optional[str]:
+        try:
+            abspath = os.path.abspath(os.fspath(path))
+        except TypeError:
+            return None
+        root = self._root
+        if root is None or not abspath.startswith(root + os.sep):
+            return None
+        return os.path.relpath(abspath, root)
+
+    def _fid(self, rel: str, fresh: bool = False) -> int:
+        if fresh or rel not in self._fids:
+            self._fids[rel] = self._next_fid
+            self._next_fid += 1
+        return self._fids[rel]
+
+    @contextlib.contextmanager
+    def record(self, root: str) -> Iterator["CrashSim"]:
+        """Interpose on file mutations under ``root`` for the duration.
+        Single-threaded workloads only (the interposition is global)."""
+        self._root = os.path.abspath(root)
+        os.makedirs(self._root, exist_ok=True)
+        self._baseline = _snapshot_tree(self._root)
+        real = {
+            "open": builtins.open,
+            "os_open": os.open,
+            "os_close": os.close,
+            "fsync": os.fsync,
+            "fdatasync": os.fdatasync,
+            "replace": os.replace,
+            "rename": os.rename,
+            "remove": os.remove,
+            "unlink": os.unlink,
+            "mkdir": os.mkdir,
+            "rmdir": os.rmdir,
+            "rmtree": shutil.rmtree,
+        }
+        sim = self
+
+        def patched_open(file, mode="r", *args, **kwargs):
+            rel = sim._rel(file) if not isinstance(file, int) else None
+            writable = any(c in mode for c in "wax+")
+            fh = real["open"](file, mode, *args, **kwargs)
+            if rel is None or not writable:
+                return fh
+            fresh = "w" in mode or "x" in mode
+            fid = sim._fid(rel, fresh=fresh)
+            if fresh:
+                sim._record(_Op("trunc", rel, fid=fid))
+            return _RecordingFile(
+                sim, fh, rel, fid, binary="b" in mode, append="a" in mode
+            )
+
+        def patched_os_open(path, flags, *args, **kwargs):
+            fd = real["os_open"](path, flags, *args, **kwargs)
+            rel = sim._rel(path)
+            if rel is not None:
+                sim._fd_fids[fd] = sim._fid(rel)
+            return fd
+
+        def patched_os_close(fd):
+            sim._fd_fids.pop(fd, None)  # fd numbers recycle
+            real["os_close"](fd)
+
+        def patched_fsync(fd):
+            real["fsync"](fd)
+            fid = sim._fd_fids.get(fd)
+            if fid is not None:
+                sim._record(_Op("fsync", fid=fid))
+
+        def patched_fdatasync(fd):
+            real["fdatasync"](fd)
+            fid = sim._fd_fids.get(fd)
+            if fid is not None:
+                sim._record(_Op("fsync", fid=fid))
+
+        def patched_replace(src, dst, **kwargs):
+            real["replace"](src, dst, **kwargs)
+            rel_src, rel_dst = sim._rel(src), sim._rel(dst)
+            if rel_src is not None and rel_dst is not None:
+                if rel_src in sim._fids:
+                    sim._fids[rel_dst] = sim._fids.pop(rel_src)
+                sim._record(_Op("replace", rel_src, path2=rel_dst))
+
+        def patched_remove(path, **kwargs):
+            real["remove"](path, **kwargs)
+            rel = sim._rel(path)
+            if rel is not None and "dir_fd" not in kwargs:
+                sim._fids.pop(rel, None)
+                sim._record(_Op("remove", rel))
+
+        def patched_mkdir(path, *args, **kwargs):
+            real["mkdir"](path, *args, **kwargs)
+            rel = sim._rel(path)
+            if rel is not None:
+                sim._record(_Op("mkdir", rel))
+
+        def patched_rmdir(path, **kwargs):
+            real["rmdir"](path, **kwargs)
+            rel = sim._rel(path)
+            if rel is not None and "dir_fd" not in kwargs:
+                sim._record(_Op("rmdir", rel))
+
+        def patched_rmtree(path, ignore_errors=False, onerror=None, **kw):
+            # re-implemented over the patched os hooks: the stdlib's
+            # fd-relative fast path would bypass recording entirely
+            try:
+                for dirpath, dirnames, filenames in os.walk(
+                    path, topdown=False
+                ):
+                    for name in sorted(filenames):
+                        patched_remove(os.path.join(dirpath, name))
+                    patched_rmdir(dirpath)
+            except OSError:
+                if not ignore_errors:
+                    raise
+
+        try:
+            builtins.open = patched_open
+            io.open = patched_open
+            os.open = patched_os_open
+            os.close = patched_os_close
+            os.fsync = patched_fsync
+            os.fdatasync = patched_fdatasync
+            os.replace = patched_replace
+            os.rename = patched_replace
+            os.remove = patched_remove
+            os.unlink = patched_remove
+            os.mkdir = patched_mkdir
+            os.rmdir = patched_rmdir
+            shutil.rmtree = patched_rmtree
+            yield self
+        finally:
+            builtins.open = real["open"]
+            io.open = real["open"]
+            os.open = real["os_open"]
+            os.close = real["os_close"]
+            os.fsync = real["fsync"]
+            os.fdatasync = real["fdatasync"]
+            os.replace = real["replace"]
+            os.rename = real["rename"]
+            os.remove = real["remove"]
+            os.unlink = real["unlink"]
+            os.mkdir = real["mkdir"]
+            os.rmdir = real["rmdir"]
+            shutil.rmtree = real["rmtree"]
+
+    # -- enumeration ------------------------------------------------------
+    def _synced_spans(self) -> Dict[int, List[int]]:
+        """fid -> sorted op indices of its fsyncs."""
+        spans: Dict[int, List[int]] = {}
+        for i, op in enumerate(self.ops):
+            if op.kind == "fsync":
+                spans.setdefault(op.fid, []).append(i)
+        return spans
+
+    def crash_states(self) -> List[CrashState]:
+        """Every reconstructible crash state, content-deduplicated."""
+        if self._baseline is None:
+            raise RuntimeError("crash_states() before record()")
+        syncs = self._synced_spans()
+
+        def synced_by(i: int, k: int) -> bool:
+            return any(i < j < k for j in syncs.get(self.ops[i].fid, ()))
+
+        states: List[CrashState] = []
+        seen: Set[str] = set()
+
+        def add(cut: int, lost: Optional[Dict[int, int]] = None) -> None:
+            state = CrashState(self._baseline, self.ops, cut, lost)
+            digest = state.digest()
+            if digest not in seen:
+                seen.add(digest)
+                states.append(state)
+
+        n = len(self.ops)
+        for k in range(n + 1):
+            add(k)
+            for i in range(k):
+                op = self.ops[i]
+                if op.kind != "write" or not op.data:
+                    continue
+                if synced_by(i, k):
+                    continue
+                size = len(op.data)
+                for trunc in sorted({0, size // 2, size - 1}):
+                    if trunc < size:
+                        add(k, {i: trunc})
+        return states
+
+    # -- snapshot mode (opaque writers: SQLite) ---------------------------
+    def mark(self, root: str) -> None:
+        """Snapshot ``root`` at a consistency boundary (e.g. after each
+        commit). For writers whose I/O the interposer cannot see."""
+        self._marks.append(_snapshot_tree(os.path.abspath(root)))
+
+    def snapshot_states(self) -> List[CrashState]:
+        return [_SnapshotState(tree) for tree in self._marks]

@@ -270,6 +270,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ss.add_argument("--ip", default="localhost")
     ss.add_argument("--port", type=int, default=7079)
+    ss.add_argument(
+        "--replica-of", default=None, metavar="URL",
+        help="run as a warm-standby replica tailing URL's changefeed: "
+             "serves reads, rejects writes with 409 + primary hint, "
+             "reports lag on /status.json (docs/storage.md#replication)",
+    )
+    ss.add_argument(
+        "--oplog-dir", default=None,
+        help="changefeed op-log directory (primary mode; default "
+             "$PIO_FS_BASEDIR/oplog)",
+    )
+    ss.add_argument(
+        "--no-changefeed", action="store_true",
+        help="primary mode without a changefeed (no replication, no "
+             "X-PIO-Seq tokens) — the pre-ISSUE-3 behavior",
+    )
+    ss.add_argument(
+        "--poll-interval", type=float, default=0.5,
+        help="replica changefeed poll interval in seconds",
+    )
 
     sub.add_parser("status", help="verify storage backends")
 
@@ -623,10 +643,38 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         return EXIT_OK
 
     if cmd == "storageserver":
+        if args.replica_of:
+            from ..storage.replica import create_storage_replica
+
+            replica = create_storage_replica(
+                args.ip, args.port, args.replica_of, registry
+            )
+            replica.start_tailing(poll_interval_s=args.poll_interval)
+            _emit({
+                "status": "serving", "role": "replica",
+                "port": replica.bound_port, "primary": args.replica_of,
+            })
+            try:
+                replica.serve_forever()
+            except KeyboardInterrupt:
+                replica.stop_tailing()
+                replica.server_close()
+            return EXIT_OK
+
+        from ..storage.registry import base_dir
         from ..storage.storage_server import create_storage_server
 
-        server = create_storage_server(args.ip, args.port, registry)
-        _emit({"status": "serving", "port": server.bound_port})
+        oplog_dir = None
+        if not args.no_changefeed:
+            oplog_dir = args.oplog_dir or os.path.join(base_dir(), "oplog")
+        server = create_storage_server(
+            args.ip, args.port, registry, oplog_dir=oplog_dir
+        )
+        _emit({
+            "status": "serving", "role": "primary",
+            "port": server.bound_port,
+            "changefeed": oplog_dir is not None,
+        })
         try:
             server.serve_forever()
         except KeyboardInterrupt:

@@ -209,6 +209,139 @@ def _inprocess_target(engine_dir: str, batching: bool,
     return send, server
 
 
+def run_storage_chaos(
+    total_ops: int = 200,
+    kill_at: int = 100,
+    state_root: Optional[str] = None,
+) -> dict:
+    """Replication failover chaos scenario (``--kill-primary-at N``).
+
+    Builds an in-process primary (with changefeed) + warm-standby
+    replica + ``pio+ha://`` client, interleaves event writes with
+    read-backs of already-acked events, and at op N **hard-kills** the
+    primary (live connections severed — ``BackgroundHTTPServer.kill``).
+    Reads continue against the replica carrying the last-acked seq
+    token; at the end the replica is promoted and every acked write is
+    verified readable.
+
+    Replication is drained (``catch_up``) immediately before the kill:
+    the scenario proves *failover correctness* — zero failed reads, zero
+    lost acked-and-replicated writes, token semantics intact — not a
+    zero-RPO claim async replication cannot make (docs/storage.md).
+    The breaker threshold is pinned to 1 for the run so the first
+    post-kill read fails over in-call instead of burning the default
+    5-failure budget.
+    """
+    import os
+    import tempfile
+
+    from ..storage import MetadataStore, SqliteEventStore
+    from ..storage import remote
+    from ..storage.changefeed import Changefeed
+    from ..storage.event import Event
+    from ..storage.model_store import SqliteModelStore
+    from ..storage.oplog import OpLog
+    from ..storage.replica import StorageReplica
+    from ..storage.storage_server import StorageServer
+
+    root = state_root or tempfile.mkdtemp(prefix="pio-chaos-")
+    prev_threshold = os.environ.get("PIO_BREAKER_FAILURES")
+    os.environ["PIO_BREAKER_FAILURES"] = "1"
+    remote.reset_resilience()
+    primary = replica = None
+    try:
+        primary = StorageServer(
+            "127.0.0.1", 0,
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+            changefeed=None,
+        )
+        primary.changefeed = Changefeed(
+            OpLog(os.path.join(root, "oplog")),
+            primary.events, primary.metadata, primary.models,
+        )
+        primary.start_background()
+        replica = StorageReplica(
+            "127.0.0.1", 0,
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+            f"http://127.0.0.1:{primary.bound_port}",
+            os.path.join(root, "replica_state"),
+            catchup_wait_s=0.0,
+        )
+        replica.start_background()
+        store = remote.RemoteEventStore(
+            f"pio+ha://127.0.0.1:{primary.bound_port},"
+            f"127.0.0.1:{replica.bound_port}",
+            timeout=10.0,
+        )
+        store.init(1)
+        replica.catch_up()
+
+        acked: List[str] = []
+        failed_reads = reads = 0
+        killed_at = None
+        for i in range(total_ops):
+            if killed_at is None and i >= kill_at:
+                replica.catch_up()  # drain, then die (see docstring)
+                primary.kill()
+                killed_at = i
+            if killed_at is None:
+                acked.append(
+                    store.insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=str(i)), 1,
+                    )
+                )
+                if i % 5 == 0:
+                    replica.catch_up()  # steady-state tailing
+            if acked:
+                reads += 1
+                try:
+                    if store.get(acked[i % len(acked)], 1) is None:
+                        failed_reads += 1
+                except remote.RemoteStorageError:
+                    failed_reads += 1
+        lost = 0
+        for eid in acked:
+            try:
+                if store.get(eid, 1) is None:
+                    lost += 1
+            except remote.RemoteStorageError:
+                lost += 1
+        status = replica.promote(os.path.join(root, "promoted-oplog"))
+        promoted = remote.RemoteEventStore(
+            f"http://127.0.0.1:{replica.bound_port}", timeout=10.0
+        )
+        post_promote_id = promoted.insert(
+            Event(event="rate", entity_type="user", entity_id="post"), 1
+        )
+        return {
+            "mode": "storage-chaos",
+            "ops": total_ops,
+            "killPrimaryAt": kill_at,
+            "ackedWrites": len(acked),
+            "reads": reads,
+            "failedReads": failed_reads,
+            "lostAckedWrites": lost,
+            "promotedSeq": status.get("seq"),
+            "postPromoteWriteOk": promoted.get(post_promote_id, 1)
+            is not None,
+        }
+    finally:
+        if prev_threshold is None:
+            os.environ.pop("PIO_BREAKER_FAILURES", None)
+        else:
+            os.environ["PIO_BREAKER_FAILURES"] = prev_threshold
+        remote.reset_resilience()
+        for server in (primary, replica):
+            if server is not None:
+                try:
+                    server.kill()
+                except Exception:
+                    pass
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..utils.platform import apply_env_platform
 
@@ -235,7 +368,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "(predictionio_tpu.testing.faults) in this "
                         "process; repeatable. For a live HTTP server, "
                         "start it with PIO_FAULTS set instead.")
+    p.add_argument("--kill-primary-at", type=int, default=None, metavar="N",
+                   help="storage-plane chaos scenario: in-process "
+                        "primary+replica, hard-kill the primary at op N, "
+                        "fail reads over to the replica, promote, verify "
+                        "zero failed reads / zero lost acked writes "
+                        "(ignores the query-server flags)")
+    p.add_argument("--ops", type=int, default=None,
+                   help="total ops for --kill-primary-at (default 2N)")
     args = p.parse_args(argv)
+
+    if args.kill_primary_at is not None:
+        result = run_storage_chaos(
+            total_ops=args.ops or 2 * args.kill_primary_at,
+            kill_at=args.kill_primary_at,
+        )
+        print(json.dumps(result))
+        ok = not result["failedReads"] and not result["lostAckedWrites"] \
+            and result["postPromoteWriteOk"]
+        return 0 if ok else 1
 
     if args.fault:
         from ..testing import faults

@@ -1,0 +1,54 @@
+"""Shared file-durability helpers.
+
+Every write-then-rename site in the package must follow the same
+discipline (enforced by the ``robust-rename-no-fsync`` lint rule): flush
+and fsync the temporary file *before* ``os.replace``, then fsync the
+parent directory so the new directory entry itself is durable. Skipping
+the first fsync is the classic torn-blob bug — on many filesystems the
+rename's metadata can be journaled before the file's data blocks are
+written, so a power loss leaves a durable *name* pointing at truncated
+or empty bytes. This module is the single home for that sequence.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_file(path: str) -> None:
+    """fsync an existing file by path (data written by someone else, e.g.
+    a compiler subprocess)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so newly-created/renamed entries are durable
+    (no-op on platforms that disallow opening directories)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-safe whole-file replace: write to a sibling temp file, fsync
+    it, rename over ``path``, fsync the parent directory. After a crash
+    at any point, ``path`` holds either the complete old bytes or the
+    complete new bytes — never a torn mix."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))

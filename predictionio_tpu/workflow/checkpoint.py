@@ -23,23 +23,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.durability import fsync_dir as _fsync_dir
+
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _SEP = "/"
-
-
-def _fsync_dir(path: str) -> None:
-    """fsync a directory so newly-created entries are durable (no-op on
-    platforms that disallow opening directories)."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -83,11 +70,21 @@ def _unflatten_into(like: Any, flat: Dict[str, np.ndarray], prefix: str = "") ->
 
 
 class CheckpointManager:
-    """Save/restore/prune step checkpoints under one run directory."""
+    """Save/restore/prune step checkpoints under one run directory.
 
-    def __init__(self, directory: str, keep: int = 3):
+    Retention: ``keep_last=N`` prunes all but the newest N *complete*
+    steps after each successful save, so long runs cannot fill the disk;
+    ``None`` (the default) keeps everything. ``keep`` is the historical
+    alias for the same knob."""
+
+    def __init__(
+        self,
+        directory: str,
+        keep: Optional[int] = None,
+        keep_last: Optional[int] = None,
+    ):
         self.directory = directory
-        self.keep = keep
+        self.keep = keep_last if keep_last is not None else keep
         os.makedirs(directory, exist_ok=True)
 
     # -- introspection ----------------------------------------------------
@@ -139,8 +136,25 @@ class CheckpointManager:
 
     def _prune(self) -> None:
         steps = self.all_steps()
-        for s in steps[: max(0, len(steps) - self.keep)]:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        doomed = (
+            steps[: max(0, len(steps) - self.keep)]
+            if self.keep is not None
+            else []
+        )
+        for s in doomed:
+            # Crash-safe deletion order: drop the _COMPLETE marker first
+            # (and make the drop durable) so a crash mid-rmtree can never
+            # leave a half-deleted directory that still LOOKS complete —
+            # restore(step) on it would load garbage. Without the marker
+            # the leftovers are just an incomplete dir, swept below on
+            # the next save.
+            d = self._step_dir(s)
+            try:
+                os.remove(os.path.join(d, "_COMPLETE"))
+            except OSError:
+                pass
+            _fsync_dir(d)
+            shutil.rmtree(d, ignore_errors=True)
         # drop incomplete directories (crashed saves)
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)

@@ -1,0 +1,20 @@
+"""Robustness bug class 3: write-then-rename without fsync.
+
+``LocalFSModelStore.insert`` shipped exactly this shape before ISSUE 3:
+the tmp file's data blocks may still be in flight when the rename's
+metadata journals, so a power loss leaves the *final* name holding torn
+bytes — and nothing ever notices, because the name exists.
+``robust-rename-no-fsync`` must flag the replace below (and nothing
+else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import os
+
+
+def save_blob(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, path)  # no fsync before the rename: BAD

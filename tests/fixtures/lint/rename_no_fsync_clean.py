@@ -1,0 +1,42 @@
+"""Clean twin of ``rename_no_fsync_bad.py``: the tmp file is fsync'd
+before the rename and the parent directory after it (the
+``utils/durability.atomic_write_bytes`` sequence), so a crash at any
+point leaves whole-old or whole-new bytes under the final name. The
+linter must report NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import os
+
+
+def fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def save_blob(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())  # data durable BEFORE the name flips
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
+
+
+def save_via_helper(path, data):
+    # a helper whose name carries the fsync contract also satisfies the
+    # rule (the package's durability helpers)
+    write_and_fsync(path + ".tmp", data)
+    os.replace(path + ".tmp", path)
+
+
+def write_and_fsync(path, data):
+    with open(path, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())

@@ -1,0 +1,222 @@
+"""Crash-consistency proofs over the storage plane (ISSUE 3).
+
+``testing/crashsim.py`` interposes on a workload's file mutations and
+enumerates every post-crash directory state its crash model allows
+(prefix cuts, plus single-victim truncation of any write never fsync'd
+before the cut — the power-loss reordering behind write-then-rename
+bugs). Each test asserts one recovery invariant over *every* state:
+
+- model stores: ``get`` returns the whole old blob or the whole new
+  blob, never garbage (the ``LocalFSModelStore.insert`` durability gap
+  this PR fixed — without the fsync-before-rename, a state with a torn
+  blob under the final name exists and this suite fails);
+- checkpoints: ``restore`` always loads a complete step, including when
+  the crash hits mid-prune (markers are dropped before ``rmtree``);
+- the replication op log: reopening truncates any torn tail to a
+  consistent, gap-free prefix.
+
+All deterministic, CPU-only, no wall-clock sleeps — tier-1.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage.model_store import (
+    LocalFSModelStore,
+    Model,
+    SqliteModelStore,
+)
+from predictionio_tpu.storage.oplog import OpLog
+from predictionio_tpu.testing.crashsim import CrashSim
+from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+OLD = b"OLD-" * 64
+NEW = b"NEW-" * 64
+
+
+def _states(sim):
+    states = sim.crash_states()
+    assert len(states) > 2, "crashsim recorded no meaningful ops"
+    return states
+
+
+class TestLocalFSModelStore:
+    def test_overwrite_never_torn(self, tmp_path):
+        root = str(tmp_path / "models")
+        store = LocalFSModelStore(root)
+        store.insert(Model(id="m", models=OLD))
+        sim = CrashSim()
+        with sim.record(root):
+            store.insert(Model(id="m", models=NEW))
+        for i, state in enumerate(_states(sim)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            got = LocalFSModelStore(crashed).get("m")
+            assert got is not None, f"model vanished: {state.describe()}"
+            assert got.models in (OLD, NEW), f"torn blob: {state.describe()}"
+
+    def test_first_insert_all_or_nothing(self, tmp_path):
+        root = str(tmp_path / "models")
+        store = LocalFSModelStore(root)
+        sim = CrashSim()
+        with sim.record(root):
+            store.insert(Model(id="m", models=NEW))
+        for i, state in enumerate(_states(sim)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            got = LocalFSModelStore(crashed).get("m")
+            # absent (crash before the rename) or whole — never torn
+            assert got is None or got.models == NEW, state.describe()
+
+
+class TestSqliteModelStore:
+    def test_commit_boundaries_old_or_new(self, tmp_path):
+        """SQLite writes from C, invisible to the interposer — snapshot
+        mode captures each commit boundary and asserts old-or-new there
+        (sub-commit atomicity is SQLite's own journal's contract)."""
+        root = str(tmp_path / "db")
+        os.makedirs(root)
+        path = os.path.join(root, "models.db")
+        store = SqliteModelStore(path)
+        sim = CrashSim()
+        sim.mark(root)  # empty store
+        store.insert(Model(id="m", models=OLD))
+        sim.mark(root)
+        store.insert(Model(id="m", models=NEW))
+        sim.mark(root)
+        store.delete("m")
+        sim.mark(root)
+        states = sim.snapshot_states()
+        assert len(states) == 4
+        expected = [None, OLD, NEW, None]
+        for i, (state, want) in enumerate(zip(states, expected)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            got = SqliteModelStore(os.path.join(crashed, "models.db")).get("m")
+            assert (got.models if got else None) == want
+
+
+class TestCheckpointCrash:
+    def test_save_over_existing_always_restorable(self, tmp_path):
+        root = str(tmp_path / "ck")
+        cm = CheckpointManager(root)
+        cm.save(1, {"x": np.full(4, 1.0)})
+        sim = CrashSim()
+        with sim.record(root):
+            cm.save(2, {"x": np.full(4, 2.0)})
+        for i, state in enumerate(_states(sim)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            step, tree, _ = CheckpointManager(crashed).restore(like={"x": 0})
+            assert (step, float(tree["x"][0])) in ((1, 1.0), (2, 2.0)), (
+                state.describe()
+            )
+
+    def test_prune_mid_delete_keeps_newest_loadable(self, tmp_path):
+        """The retention satellite's contract: a crash at ANY point of a
+        pruning save (including mid-rmtree of an old step) leaves the
+        newest checkpoint complete and loadable, and never leaves a
+        half-deleted directory that still claims _COMPLETE."""
+        root = str(tmp_path / "ck")
+        cm = CheckpointManager(root, keep_last=2)
+        cm.save(1, {"x": np.full(4, 1.0)})
+        cm.save(2, {"x": np.full(4, 2.0)})
+        sim = CrashSim()
+        with sim.record(root):
+            cm.save(3, {"x": np.full(4, 3.0)})  # prunes step 1
+        for i, state in enumerate(_states(sim)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            mgr = CheckpointManager(crashed)
+            step, tree, _ = mgr.restore(like={"x": 0})
+            assert float(tree["x"][0]) == float(step)
+            # every step listed complete must actually restore
+            for s in mgr.all_steps():
+                s2, t2, _ = mgr.restore(s, like={"x": 0})
+                assert float(t2["x"][0]) == float(s2)
+
+    def test_retention_prunes_and_default_is_unlimited(self, tmp_path):
+        unlimited = CheckpointManager(str(tmp_path / "u"))
+        for s in (1, 2, 3, 4, 5):
+            unlimited.save(s, {"x": np.ones(2)})
+        assert unlimited.all_steps() == [1, 2, 3, 4, 5]
+        bounded = CheckpointManager(str(tmp_path / "b"), keep_last=2)
+        for s in (1, 2, 3, 4, 5):
+            bounded.save(s, {"x": np.ones(2)})
+        assert bounded.all_steps() == [4, 5]
+
+
+class TestOpLogCrash:
+    def test_every_torn_prefix_reopens_consistent(self, tmp_path):
+        root = str(tmp_path / "oplog")
+        sim = CrashSim()
+        with sim.record(root):
+            log = OpLog(root, sync_every=4)
+            for i in range(10):
+                log.append({"i": i})
+            log.close()
+        checked = 0
+        for i, state in enumerate(_states(sim)):
+            crashed = state.materialize(str(tmp_path / f"s{i}"))
+            if not os.path.exists(os.path.join(crashed, "oplog.meta.json")):
+                continue  # crashed before the log was born
+            checked += 1
+            reopened = OpLog(crashed)
+            entries, last = reopened.read_since(0, limit=100)
+            # a consistent dense prefix: seqs 1..last, payloads intact
+            assert [s for s, _ in entries] == list(range(1, last + 1))
+            assert all(op == {"i": s - 1} for s, op in entries)
+            reopened.close()
+        assert checked > 5
+
+    def test_generation_survives_and_seq_resumes(self, tmp_path):
+        log = OpLog(str(tmp_path), sync_every=2)
+        generation = log.generation
+        for i in range(5):
+            log.append({"i": i})
+        log.close()
+        reopened = OpLog(str(tmp_path))
+        assert reopened.generation == generation
+        assert reopened.last_seq == 5
+        assert reopened.append({"i": 5}) == 6
+        reopened.close()
+
+
+class TestCrashSimSelf:
+    """The simulator itself must catch the bug class it exists for."""
+
+    def test_unfsynced_rename_produces_torn_state(self, tmp_path):
+        root = str(tmp_path / "w")
+        os.makedirs(root)
+        final = os.path.join(root, "blob.bin")
+        with open(final, "wb") as fh:
+            fh.write(OLD)
+        sim = CrashSim()
+        with sim.record(root):
+            tmp = final + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(NEW)  # never fsync'd
+            os.replace(tmp, final)
+        torn = 0
+        for i, state in enumerate(sim.crash_states()):
+            data = state.tree().files.get("blob.bin")
+            if data is not None and data not in (OLD, NEW):
+                torn += 1
+        assert torn > 0, (
+            "crash model must generate rename-over-unsynced-data states"
+        )
+
+    def test_fsynced_rename_is_atomic(self, tmp_path):
+        root = str(tmp_path / "w")
+        os.makedirs(root)
+        final = os.path.join(root, "blob.bin")
+        with open(final, "wb") as fh:
+            fh.write(OLD)
+        sim = CrashSim()
+        with sim.record(root):
+            tmp = final + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(NEW)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, final)
+        for state in sim.crash_states():
+            data = state.tree().files.get("blob.bin")
+            assert data in (OLD, NEW), state.describe()

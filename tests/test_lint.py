@@ -105,6 +105,7 @@ class TestRobustFixtures:
         [
             ("no_timeout_bad.py", "robust-no-timeout"),
             ("bare_sleep_retry_bad.py", "robust-bare-sleep-retry"),
+            ("rename_no_fsync_bad.py", "robust-rename-no-fsync"),
         ],
     )
     def test_bad_fixture_fires_exactly_intended_rule(self, fixture, rule_id):
@@ -118,7 +119,8 @@ class TestRobustFixtures:
 
     @pytest.mark.parametrize(
         "fixture",
-        ["no_timeout_clean.py", "bare_sleep_retry_clean.py"],
+        ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
+         "rename_no_fsync_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)

@@ -1,0 +1,488 @@
+"""Replicated storage plane (ISSUE 3): changefeed, replica, failover.
+
+Layers under test:
+
+1. **Changefeed** — every mutating storage-server op gets a dense seq,
+   lands in the durable op log resolved (ids shipped, not re-minted),
+   and the seq rides back in ``X-PIO-Seq``.
+2. **Replica** — ``StorageReplica`` tails the feed idempotently, serves
+   reads, rejects writes with 409 + primary hint, gates reads on
+   ``X-PIO-Min-Seq`` (wait-or-reject), reports lag on ``/status.json``.
+3. **Client failover** — ``pio+ha://`` endpoint sets: writes → primary,
+   read-your-writes seq token threaded through all three stores, reads
+   failing over to the freshest replica once the primary breaker opens.
+4. **The chaos proof** — primary hard-killed mid-run (live connections
+   severed), replica promoted from the changefeed, every previously
+   acked event/metadata/model read served with correct token semantics.
+
+Deterministic: replicas are driven by explicit ``step``/``catch_up``
+(no background polling), breaker thresholds pinned via env, zero
+wall-clock sleeps. Tier-1.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.storage import MetadataStore, SqliteEventStore
+from predictionio_tpu.storage import remote
+from predictionio_tpu.storage.changefeed import (
+    Changefeed,
+    METADATA_MUTATING_METHODS,
+    MIN_SEQ_HEADER,
+    SEQ_HEADER,
+    apply_op,
+)
+from predictionio_tpu.storage.event import Event
+from predictionio_tpu.storage.metadata import App
+from predictionio_tpu.storage.model_store import Model, SqliteModelStore
+from predictionio_tpu.storage.oplog import OpLog, OpLogGap
+from predictionio_tpu.storage.replica import ReplicationError, StorageReplica
+from predictionio_tpu.storage.storage_server import (
+    METADATA_READ_METHODS,
+    METADATA_RPC_METHODS,
+    StorageServer,
+)
+
+
+def _stores():
+    return (
+        SqliteEventStore(":memory:"),
+        MetadataStore(":memory:"),
+        SqliteModelStore(":memory:"),
+    )
+
+
+@pytest.fixture()
+def primary(tmp_path):
+    events, metadata, models = _stores()
+    changefeed = Changefeed(
+        OpLog(str(tmp_path / "oplog")), events, metadata, models
+    )
+    server = StorageServer(
+        "127.0.0.1", 0, events, metadata, models, changefeed=changefeed
+    )
+    server.start_background()
+    yield server
+    try:
+        server.shutdown()
+        server.server_close()
+    except OSError:
+        pass  # already killed by the test
+
+
+@pytest.fixture()
+def primary_url(primary):
+    return f"http://127.0.0.1:{primary.bound_port}"
+
+
+@pytest.fixture()
+def replica(tmp_path, primary_url):
+    events, metadata, models = _stores()
+    server = StorageReplica(
+        "127.0.0.1", 0, events, metadata, models, primary_url,
+        str(tmp_path / "replica_state"), catchup_wait_s=0.0,
+    )
+    server.start_background()
+    yield server
+    try:
+        server.shutdown()
+        server.server_close()
+    except OSError:
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers(monkeypatch):
+    # threshold 1: the first post-kill read trips the breaker and fails
+    # over in-call — no wasted failures, no wall-clock cooldown waits
+    monkeypatch.setenv("PIO_BREAKER_FAILURES", "1")
+    remote.reset_resilience(clock=lambda: 0.0)
+    yield
+    remote.reset_resilience()
+
+
+def _status(url: str) -> dict:
+    with urllib.request.urlopen(f"{url}/status.json") as resp:
+        return json.load(resp)
+
+
+# -- method partition ------------------------------------------------------
+
+
+def test_rpc_methods_partition_into_reads_and_mutations():
+    assert METADATA_READ_METHODS | METADATA_MUTATING_METHODS == METADATA_RPC_METHODS
+    assert not METADATA_READ_METHODS & METADATA_MUTATING_METHODS
+
+
+# -- changefeed recording --------------------------------------------------
+
+
+class TestChangefeed:
+    def test_mutations_are_sequenced_and_resolved(self, primary, primary_url):
+        store = remote.RemoteEventStore(primary_url)
+        store.init(7)
+        eid = store.insert(Event(event="rate", entity_type="u", entity_id="1"), 7)
+        store.write([Event(event="rate", entity_type="u", entity_id=str(i))
+                     for i in range(3)], 7)
+        entries, last = primary.changefeed.oplog.read_since(0)
+        assert [seq for seq, _ in entries] == list(range(1, last + 1))
+        kinds = [op["kind"] for _, op in entries]
+        assert kinds == ["event_init", "event_insert", "event_write"]
+        # resolved: the insert op carries the acked id, batch events all
+        # carry ids (replay must not re-mint random ids)
+        assert entries[1][1]["event"]["eventId"] == eid
+        assert all(d.get("eventId") for d in entries[2][1]["events"])
+
+    def test_noop_mutations_are_not_logged(self, primary, primary_url):
+        md = remote.RemoteMetadataStore(primary_url)
+        app_id = md.app_insert(App(id=0, name="a"))
+        before = primary.changefeed.last_seq
+        assert md.app_insert(App(id=0, name="a")) is None  # duplicate
+        assert md.app_delete(app_id + 99) is False  # no row
+        assert primary.changefeed.last_seq == before
+
+    def test_seq_header_on_writes(self, primary_url):
+        body = json.dumps(
+            {"event": "rate", "entityType": "u", "entityId": "1"}
+        ).encode()
+        with remote._request(f"{primary_url}/events/1", "POST", body) as resp:
+            assert int(resp.getheader(SEQ_HEADER)) >= 1
+
+    def test_gen_next_replays_idempotently(self, tmp_path):
+        events, metadata, models = _stores()
+        cf = Changefeed(OpLog(str(tmp_path / "log")), events, metadata, models)
+        for _ in range(3):
+            cf.metadata_rpc("gen_next", ["ids"])
+        entries, _ = cf.oplog.read_since(0)
+        r_events, r_md, r_models = _stores()
+        for _, op in entries:
+            apply_op(op, r_events, r_md, r_models)
+        # re-apply the whole suffix: the advance-to semantics absorb it
+        for _, op in entries:
+            apply_op(op, r_events, r_md, r_models)
+        assert r_md.gen_next("ids") == 4
+
+
+# -- replica behavior ------------------------------------------------------
+
+
+class TestReplica:
+    def test_tails_all_three_stores(self, primary, primary_url, replica):
+        es = remote.RemoteEventStore(primary_url)
+        md = remote.RemoteMetadataStore(primary_url)
+        ms = remote.RemoteModelStore(primary_url)
+        es.init(1)
+        eid = es.insert(Event(event="rate", entity_type="u", entity_id="1"), 1)
+        app_id = md.app_insert(App(id=0, name="rep-app"))
+        ms.insert(Model(id="m1", models=b"blob"))
+        replica.catch_up()
+        assert replica.applied_seq() == primary.changefeed.last_seq
+        # read the replica's local stores through its own HTTP surface
+        rurl = f"http://127.0.0.1:{replica.bound_port}"
+        r_es = remote.RemoteEventStore(rurl)
+        r_md = remote.RemoteMetadataStore(rurl)
+        r_ms = remote.RemoteModelStore(rurl)
+        assert r_es.get(eid, 1).event == "rate"
+        assert r_md.app_get(app_id).name == "rep-app"
+        assert r_ms.get("m1").models == b"blob"
+
+    def test_replay_is_idempotent_after_progress_loss(
+        self, primary, primary_url, replica
+    ):
+        es = remote.RemoteEventStore(primary_url)
+        es.init(1)
+        for i in range(5):
+            es.insert(Event(event="rate", entity_type="u", entity_id=str(i)), 1)
+        replica.catch_up()
+        # simulate the crash window: progress marker lost, stores kept
+        replica.tailer.applied_seq = 0
+        replica.catch_up()  # re-applies everything
+        flt_events = list(replica.events.find(1))
+        assert len(flt_events) == 5  # upsert replay: no duplicates
+
+    def test_rejects_writes_with_primary_hint(
+        self, primary, primary_url, replica
+    ):
+        rurl = f"http://127.0.0.1:{replica.bound_port}"
+        store = remote.RemoteEventStore(rurl)
+        with pytest.raises(remote.RemoteStorageError) as err:
+            store.insert(Event(event="x", entity_type="u", entity_id="1"), 1)
+        assert err.value.code == 409
+        assert primary_url in str(err.value)
+        md = remote.RemoteMetadataStore(rurl)
+        with pytest.raises(remote.RemoteStorageError) as err:
+            md.app_insert(App(id=0, name="nope"))
+        assert err.value.code == 409
+
+    def test_min_seq_gate_wait_or_reject(self, primary, primary_url, replica):
+        es = remote.RemoteEventStore(primary_url)
+        es.init(1)
+        eid = es.insert(Event(event="rate", entity_type="u", entity_id="1"), 1)
+        acked = primary.changefeed.last_seq
+        replica.catch_up()
+        rurl = f"http://127.0.0.1:{replica.bound_port}"
+        # satisfied token: served
+        with remote._request(
+            f"{rurl}/events/1/{eid}", headers={MIN_SEQ_HEADER: str(acked)}
+        ) as resp:
+            assert json.loads(resp.read())["eventId"] == eid
+        # future token: 409 with the applied seq and primary hint
+        with pytest.raises(remote.RemoteStorageError) as err:
+            remote._request(
+                f"{rurl}/events/1/{eid}",
+                headers={MIN_SEQ_HEADER: str(acked + 10)},
+            )
+        assert err.value.code == 409
+
+    def test_status_reports_lag(self, primary, primary_url, replica):
+        es = remote.RemoteEventStore(primary_url)
+        es.init(1)
+        for i in range(3):
+            es.insert(Event(event="rate", entity_type="u", entity_id=str(i)), 1)
+        replica.step()  # observes primary seq while applying
+        status = _status(f"http://127.0.0.1:{replica.bound_port}")
+        assert status["role"] == "replica"
+        assert status["appliedSeq"] == primary.changefeed.last_seq
+        assert status["lag"] == 0
+        assert _status(primary_url)["role"] == "primary"
+
+    def test_generation_mismatch_stops_tailing(
+        self, tmp_path, primary, primary_url, replica
+    ):
+        remote.RemoteEventStore(primary_url).init(1)
+        replica.catch_up()
+        # primary store replaced: new changefeed, new generation
+        events, metadata, models = _stores()
+        primary.changefeed = Changefeed(
+            OpLog(str(tmp_path / "oplog2")), events, metadata, models
+        )
+        primary.events, primary.metadata, primary.models = (
+            events, metadata, models,
+        )
+        remote.RemoteEventStore(primary_url).init(1)
+        with pytest.raises(ReplicationError):
+            replica.catch_up()
+
+    def test_oplog_gap_is_loud(self, tmp_path):
+        log = OpLog(str(tmp_path), base_seq=50)
+        with pytest.raises(OpLogGap):
+            log.read_since(10)
+
+    def test_checkpoint_probe_answers_on_replica(
+        self, primary, primary_url, replica
+    ):
+        """The HA client's freshness probe hits /replicate/checkpoint on
+        REPLICAS — they must answer from applied state, not 404 (a 404
+        would silently degrade failover to listed order)."""
+        es = remote.RemoteEventStore(primary_url)
+        es.init(1)
+        replica.catch_up()
+        rurl = f"http://127.0.0.1:{replica.bound_port}"
+        with remote._request(f"{rurl}/replicate/checkpoint") as resp:
+            ck = json.loads(resp.read())
+        assert ck["seq"] == replica.applied_seq() == 1
+        assert ck["generation"] == primary.changefeed.oplog.generation
+
+    def test_primary_seq_rewind_is_loud(self, tmp_path, primary, primary_url, replica):
+        """A primary whose history ends BEFORE the replica's applied seq
+        (post-power-loss truncation under the same generation) must stop
+        tailing with ReplicationError, never silently diverge."""
+        es = remote.RemoteEventStore(primary_url)
+        es.init(1)
+        for i in range(4):
+            es.insert(Event(event="rate", entity_type="u", entity_id=str(i)), 1)
+        replica.catch_up()
+        # rebuild the primary's oplog at the same generation, shorter
+        generation = primary.changefeed.oplog.generation
+        short = OpLog(str(tmp_path / "rewound"))
+        short.generation = generation
+        short.append({"kind": "event_init", "app": 1})
+        primary.changefeed = Changefeed(
+            short, primary.events, primary.metadata, primary.models
+        )
+        with pytest.raises(ReplicationError, match="rewound"):
+            replica.step()
+
+
+# -- client failover -------------------------------------------------------
+
+
+class TestFailover:
+    def _ha_store(self, primary, replica, timeout=10.0):
+        return remote.RemoteEventStore(
+            f"pio+ha://127.0.0.1:{primary.bound_port},"
+            f"127.0.0.1:{replica.bound_port}",
+            timeout=timeout,
+        )
+
+    def test_writes_ack_the_seq_token(self, primary, replica):
+        store = self._ha_store(primary, replica)
+        store.init(1)
+        store.insert(Event(event="rate", entity_type="u", entity_id="1"), 1)
+        assert store._ep.token.last == primary.changefeed.last_seq
+
+    def test_seq_token_shared_across_store_kinds(self, primary, replica):
+        ha = (
+            f"pio+ha://127.0.0.1:{primary.bound_port},"
+            f"127.0.0.1:{replica.bound_port}"
+        )
+        es = remote.RemoteEventStore(ha)
+        ms = remote.RemoteModelStore(ha)
+        es.init(1)
+        ms.insert(Model(id="m", models=b"x"))
+        assert es._ep.token.last == ms._ep.token.last == 2
+
+    def test_chaos_kill_primary_promote_replica(self, primary, replica):
+        """The acceptance-criteria chaos proof: primary hard-killed
+        mid-stream, every previously-acked event/metadata/model read is
+        served by the (then promoted) replica with correct seq-token
+        semantics."""
+        ha = (
+            f"pio+ha://127.0.0.1:{primary.bound_port},"
+            f"127.0.0.1:{replica.bound_port}"
+        )
+        es = remote.RemoteEventStore(ha, timeout=10.0)
+        md = remote.RemoteMetadataStore(ha, timeout=10.0)
+        ms = remote.RemoteModelStore(ha, timeout=10.0)
+        es.init(1)
+        acked_ids = [
+            es.insert(Event(event="rate", entity_type="u",
+                            entity_id=str(i)), 1)
+            for i in range(10)
+        ]
+        app_id = md.app_insert(App(id=0, name="chaos-app"))
+        ms.insert(Model(id="m1", models=b"weights"))
+        acked_seq = es._ep.token.last
+        assert acked_seq == primary.changefeed.last_seq
+        replica.catch_up()
+
+        primary.kill()  # hard kill: live connections severed
+
+        # every acked read is served via failover, carrying the token
+        for eid in acked_ids:
+            got = es.get(eid, 1)
+            assert got is not None and got.event_id == eid
+        assert md.app_get(app_id).name == "chaos-app"
+        assert ms.get("m1").models == b"weights"
+        # correctness of the gate itself: a token beyond anything acked
+        # is rejected, not silently served stale
+        rurl = f"http://127.0.0.1:{replica.bound_port}"
+        with pytest.raises(remote.RemoteStorageError) as err:
+            remote._request(
+                f"{rurl}/events/1/{acked_ids[0]}",
+                headers={MIN_SEQ_HEADER: str(acked_seq + 1)},
+            )
+        assert err.value.code == 409
+
+        # promote: numbering continues, writes flow again
+        status = replica.promote()
+        assert status["role"] == "primary"
+        assert status["seq"] == acked_seq
+        promoted = remote.RemoteEventStore(rurl, timeout=10.0)
+        new_id = promoted.insert(
+            Event(event="rate", entity_type="u", entity_id="post"), 1
+        )
+        assert promoted.get(new_id, 1) is not None
+        assert replica.changefeed.last_seq == acked_seq + 1
+        # the promoted node satisfies the old token on its own now
+        with remote._request(
+            f"{rurl}/events/1/{new_id}",
+            headers={MIN_SEQ_HEADER: str(acked_seq + 1)},
+        ) as resp:
+            assert json.loads(resp.read())["eventId"] == new_id
+
+    def test_tokenless_client_fails_over_to_freshest(
+        self, tmp_path, primary, primary_url, replica
+    ):
+        """A client with NO acked writes (seq token 0, so no min-seq
+        header protects it) must still reach the caught-up replica:
+        the checkpoint-probe ordering alone has to pick freshest-first,
+        even with the lagging replica listed before it."""
+        events, metadata, models = _stores()
+        stale = StorageReplica(
+            "127.0.0.1", 0, events, metadata, models, primary_url,
+            str(tmp_path / "stale2_state"), catchup_wait_s=0.0,
+        )
+        stale.start_background()
+        try:
+            writer = remote.RemoteEventStore(primary_url)
+            writer.init(1)
+            eid = writer.insert(
+                Event(event="rate", entity_type="u", entity_id="1"), 1
+            )
+            replica.catch_up()  # fresh one caught up; stale stays at 0
+            # reader process analogue: fresh endpoints object, token 0
+            reader = remote.RemoteEventStore(
+                f"pio+ha://127.0.0.1:{primary.bound_port},"
+                f"127.0.0.1:{stale.bound_port},"
+                f"127.0.0.1:{replica.bound_port}",
+                timeout=10.0,
+            )
+            assert reader._ep.token.last == 0
+            primary.kill()
+            got = reader.get(eid, 1)
+            assert got is not None and got.event_id == eid
+        finally:
+            stale.kill()
+
+    def test_behind_replica_skipped_for_fresher_one(
+        self, tmp_path, primary, primary_url, replica
+    ):
+        """Two replicas, one lagging: failover must pick the fresh one
+        (checkpoint probe ordering + min-seq rejection both protect)."""
+        events, metadata, models = _stores()
+        stale = StorageReplica(
+            "127.0.0.1", 0, events, metadata, models, primary_url,
+            str(tmp_path / "stale_state"), catchup_wait_s=0.0,
+        )
+        stale.start_background()
+        try:
+            ha = (
+                f"pio+ha://127.0.0.1:{primary.bound_port},"
+                f"127.0.0.1:{stale.bound_port},"
+                f"127.0.0.1:{replica.bound_port}"
+            )
+            es = remote.RemoteEventStore(ha, timeout=10.0)
+            es.init(1)
+            eid = es.insert(
+                Event(event="rate", entity_type="u", entity_id="1"), 1
+            )
+            replica.catch_up()  # fresh replica caught up; stale did not
+            primary.kill()
+            got = es.get(eid, 1)
+            assert got is not None and got.event_id == eid
+        finally:
+            stale.shutdown()
+            stale.server_close()
+
+    def test_loadgen_chaos_scenario(self, tmp_path):
+        from predictionio_tpu.tools.loadgen import run_storage_chaos
+
+        report = run_storage_chaos(
+            total_ops=40, kill_at=20, state_root=str(tmp_path / "chaos")
+        )
+        assert report["failedReads"] == 0
+        assert report["lostAckedWrites"] == 0
+        assert report["postPromoteWriteOk"] is True
+        assert report["ackedWrites"] == 20
+
+
+# -- HA URL parsing --------------------------------------------------------
+
+
+class TestHAConfig:
+    def test_split_endpoints(self):
+        urls = remote._split_endpoints("pio+ha://a:1, b:2 ,http://c:3/")
+        assert urls == ["http://a:1", "http://b:2", "http://c:3"]
+        assert remote._split_endpoints("http://x:9") == ["http://x:9"]
+
+    def test_base_url_conf_forms(self):
+        assert remote._base_url({"url": "pio+ha://a:1,b:2"}) == "pio+ha://a:1,b:2"
+        assert remote._base_url({"nodes": "a:1,b:2"}) == "pio+ha://a:1,b:2"
+        assert remote._base_url({"host": "h", "port": "99"}) == "http://h:99"
+
+    def test_empty_ha_url_rejected(self):
+        with pytest.raises(remote.RemoteStorageError):
+            remote._split_endpoints("pio+ha://")
