@@ -41,6 +41,7 @@ from urllib.parse import parse_qs, urlparse
 
 from .http import BackgroundHTTPServer, JsonHTTPHandler
 
+from ..obs.trace import TRACE_HEADER
 from ..storage.event import (
     Event,
     EventValidationError,
@@ -191,6 +192,18 @@ class _EventServiceHandler(JsonHTTPHandler):
             raise _HTTPError(401, {"message": "Invalid accessKey."})
         return ak.appid
 
+    @staticmethod
+    def _route_label(method: str, path: str) -> str:
+        """Collapse a request path to its route template — the bounded
+        label the latency histogram is keyed on (a per-event-id label
+        would be a cardinality explosion; see docs/observability.md)."""
+        if path.startswith("/events/") and path.endswith(".json"):
+            return f"{method} /events/<id>.json"
+        if path in ("/", "/events.json", "/batches/events.json",
+                    "/stats.json"):
+            return f"{method} {path}"
+        return "other"
+
     # -- dispatch ---------------------------------------------------------
     def _route(self, method: str) -> None:
         parsed = urlparse(self.path)
@@ -199,35 +212,53 @@ class _EventServiceHandler(JsonHTTPHandler):
         # Drain the request body up front: on keep-alive connections an error
         # response sent before the body is read would desync the next request.
         self._body = self.read_body()
+        if method == "GET" and self.serve_obs(path):
+            return  # /metrics + /traces.json (docs/observability.md)
+        route = self._route_label(method, path)
+        started = self.server.metrics.clock()
         try:
-            if path == "/" and method == "GET":
-                self._respond(200, {"status": "alive"})
-            elif path == "/events.json" and method == "POST":
-                self._post_event(query)
-            elif path == "/batches/events.json" and method == "POST":
-                self._post_event_batch(query)
-            elif path == "/events.json" and method == "GET":
-                self._find_events(query)
-            elif (
-                path.startswith("/events/")
-                and path.endswith(".json")
-                and method in ("GET", "DELETE")
+            # admission span: joins the caller's X-PIO-Trace (the serving
+            # feedback loop forwards its request's id here)
+            with self.server.tracer.server_span(
+                route, header_value=self.headers.get(TRACE_HEADER)
             ):
-                event_id = path[len("/events/") : -len(".json")]
-                app_id = self._auth(query)
-                if method == "GET":
-                    self._get_event(event_id, app_id)
-                else:
-                    self._delete_event(event_id, app_id)
-            elif path == "/stats.json" and method == "GET":
-                self._get_stats(query)
-            else:
-                self._respond(404, {"message": "Not Found"})
+                self._dispatch(method, path, query)
         except _HTTPError as err:
             self._respond(err.status, err.body)
         except Exception as exc:  # route-level catch-all (rejectionHandler)
             logger.exception("Event server error on %s %s", method, path)
             self._respond(500, {"message": str(exc)})
+        finally:
+            self.server.metrics.histogram(
+                "pio_http_request_seconds",
+                "Event Server request latency by route",
+                labelnames=("route",),
+            ).observe(self.server.metrics.clock() - started, route=route)
+
+    def _dispatch(self, method: str, path: str, query: Dict[str, list]) -> None:
+        if path == "/" and method == "GET":
+            self._respond(200, {"status": "alive"})
+        elif path == "/events.json" and method == "POST":
+            self._post_event(query)
+        elif path == "/batches/events.json" and method == "POST":
+            self._post_event_batch(query)
+        elif path == "/events.json" and method == "GET":
+            self._find_events(query)
+        elif (
+            path.startswith("/events/")
+            and path.endswith(".json")
+            and method in ("GET", "DELETE")
+        ):
+            event_id = path[len("/events/") : -len(".json")]
+            app_id = self._auth(query)
+            if method == "GET":
+                self._get_event(event_id, app_id)
+            else:
+                self._delete_event(event_id, app_id)
+        elif path == "/stats.json" and method == "GET":
+            self._get_stats(query)
+        else:
+            self._respond(404, {"message": "Not Found"})
 
     def do_GET(self) -> None:  # noqa: N802
         self._route("GET")
@@ -406,7 +437,13 @@ class EventServer(BackgroundHTTPServer):
         self.stats_tracker: Optional[StatsTracker] = (
             StatsTracker() if config.stats else None
         )
-        super().__init__((config.ip, config.port), _EventServiceHandler)
+        from ..obs.trace import Tracer
+
+        super().__init__(
+            (config.ip, config.port),
+            _EventServiceHandler,
+            tracer=Tracer("event-server"),
+        )
 
 
 def create_event_server(

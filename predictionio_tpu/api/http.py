@@ -13,13 +13,23 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Optional
+
+from ..obs import expo
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import Tracer
 
 logger = logging.getLogger(__name__)
 
 
 class JsonHTTPHandler(BaseHTTPRequestHandler):
-    """Request handler base: JSON responses, body draining, quiet logs."""
+    """Request handler base: JSON responses, body draining, quiet logs.
+
+    Observability (``docs/observability.md``): every response status is
+    counted into the owning server's metrics registry, and
+    :meth:`serve_obs` answers the two diagnostic routes all servers
+    share — ``GET /metrics`` (Prometheus text) and ``GET /traces.json``
+    (the span ring buffer)."""
 
     protocol_version = "HTTP/1.1"
     # Keep-alive request/response with Nagle on hits the classic
@@ -45,6 +55,14 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
             body = payload.encode("utf-8")
         else:
             body = json.dumps(payload).encode("utf-8")
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            # HTTP status codes are a small closed set — a safe label
+            metrics.counter(
+                "pio_http_responses_total",
+                "Responses by HTTP status",
+                labelnames=("status",),
+            ).inc(1, status=status)
         self.send_response(status)
         self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
         self.send_header("Content-Length", str(len(body)))
@@ -52,6 +70,30 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
             self.send_header(key, str(value))
         self.end_headers()
         self.wfile.write(body)
+
+    def serve_obs(self, path: str) -> bool:
+        """Answer ``GET /metrics`` / ``GET /traces.json`` from the owning
+        server's registry and tracer; False when ``path`` is neither (or
+        the server opted out by nulling the attributes)."""
+        if path == "/metrics":
+            metrics = getattr(self.server, "metrics", None)
+            if metrics is not None:
+                self.respond(
+                    200, expo.render(metrics), content_type=expo.CONTENT_TYPE
+                )
+                return True
+        elif path == "/traces.json":
+            tracer = getattr(self.server, "tracer", None)
+            if tracer is not None:
+                self.respond(
+                    200,
+                    {
+                        "service": tracer.service,
+                        "spans": tracer.store.dump(),
+                    },
+                )
+                return True
+        return False
 
     def read_body(self) -> bytes:
         """Drain the request body. Must happen before any error response on a
@@ -65,11 +107,32 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
 
 
 class BackgroundHTTPServer(ThreadingHTTPServer):
-    """Threaded server with ephemeral-port introspection and background run."""
+    """Threaded server with ephemeral-port introspection and background run.
+
+    Every instance owns a :class:`MetricsRegistry` and a :class:`Tracer`
+    (service-named after the concrete class) so ``GET /metrics`` and
+    ``GET /traces.json`` work on all servers without per-server wiring;
+    subclasses pass their own (e.g. with an injected clock) via the
+    ``metrics``/``tracer`` kwargs."""
 
     daemon_threads = True
 
-    def __init__(self, *args, **kwargs):
+    def __init__(
+        self,
+        *args,
+        metrics: Optional[MetricsRegistry] = None,
+        tracer: Optional[Tracer] = None,
+        **kwargs,
+    ):
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.tracer = (
+            tracer if tracer is not None else Tracer(type(self).__name__)
+        )
+        # the canonical liveness sample: a fresh server's exposition is
+        # never empty, and scrapers key "up" on it
+        self.metrics.gauge(
+            "pio_up", "1 while the server process is serving"
+        ).set(1)
         super().__init__(*args, **kwargs)
         self._live_conns: set = set()
         self._conn_lock = threading.Lock()

@@ -429,9 +429,14 @@ def build_context(path: str, source: Optional[str] = None) -> FileContext:
 
 
 def all_rules() -> List[Rule]:
-    from . import rules_jit, rules_mosaic, rules_robust
+    from . import rules_jit, rules_mosaic, rules_obs, rules_robust
 
-    return [*rules_mosaic.RULES, *rules_jit.RULES, *rules_robust.RULES]
+    return [
+        *rules_mosaic.RULES,
+        *rules_jit.RULES,
+        *rules_robust.RULES,
+        *rules_obs.RULES,
+    ]
 
 
 @dataclasses.dataclass

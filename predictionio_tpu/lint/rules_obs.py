@@ -1,0 +1,125 @@
+"""Family D — observability hygiene rules, applied package-wide.
+
+The metrics plane (``predictionio_tpu/obs``, ISSUE 4) bounds label
+cardinality at runtime (over-cap label sets collapse into
+``{label="_overflow"}``), but the *bug* — a label value interpolated
+from unbounded request data (user ids, event ids, raw paths, query
+strings) — is mechanical and visible at AST level, so it is caught
+before it ships, like the Mosaic and robustness families:
+
+- ``obs-unbounded-label``: a keyword argument to a metric observation
+  (``inc``/``dec``/``set``/``observe``/``labels``, or the values of a
+  ``gauge_callback(labels={...})`` literal) built by string
+  interpolation — f-string, ``.format``, ``%``, concatenation, or
+  ``str(...)`` — is almost always a per-request value. Every distinct
+  value is a new time series the scraper stores forever; interpolation
+  is how unbounded sets get in. Use a closed vocabulary (route
+  templates, outcome kinds, dependency names) and put the variable part
+  in a *span tag* (ring-buffered, not a time series) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from .engine import FileContext, Finding, Rule
+
+#: metric-observation methods whose keyword arguments are label values
+_OBS_METHODS = frozenset({"inc", "dec", "set", "observe", "labels"})
+
+#: keyword names on those methods that are NOT labels
+_NON_LABEL_KWARGS = frozenset({"amount", "value"})
+
+
+def _is_interpolated(node: ast.AST) -> bool:
+    """Is ``node`` a string built at runtime from embedded values?"""
+    if isinstance(node, ast.JoinedStr):
+        # an f-string with at least one substitution (a plain f"text"
+        # with no braces is just a constant)
+        return any(
+            isinstance(part, ast.FormattedValue) for part in node.values
+        )
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "format":
+            return True
+        if isinstance(fn, ast.Name) and fn.id == "str" and node.args:
+            return True
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        # "prefix-" + x  /  "user-%s" % x: interpolation when either side
+        # is (or contains) a string literal
+        return _has_str_constant(node.left) or _has_str_constant(node.right)
+    return False
+
+
+def _has_str_constant(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            return True
+        if isinstance(sub, ast.JoinedStr):
+            return True
+    return False
+
+
+class UnboundedLabel(Rule):
+    """A metric label value assembled by string interpolation: every
+    distinct value is a permanent new time series — request-derived
+    values blow the cardinality bound and land in ``_overflow``, taking
+    the signal with them."""
+
+    id = "obs-unbounded-label"
+    severity = "error"
+    short = (
+        "metric label value interpolated from runtime data (f-string/"
+        "format/%/concat/str()) — unbounded cardinality"
+    )
+    motivation = (
+        "a label value is a time series key the scraper stores forever; "
+        "obs/metrics.py caps a metric's label sets and folds the excess "
+        "into {label=\"_overflow\"}, so an interpolated request value "
+        "doesn't just leak memory — it silently destroys the metric. "
+        "Label with a closed vocabulary (route template, outcome kind, "
+        "dependency name); put per-request detail in span tags."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr in _OBS_METHODS:
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                        continue
+                    if _is_interpolated(kw.value):
+                        yield self.finding(
+                            ctx,
+                            kw.value,
+                            f"label {kw.arg!r} is interpolated from "
+                            "runtime data: each distinct value is a new "
+                            "time series — use a closed label "
+                            "vocabulary and put the variable part in a "
+                            "span tag.",
+                        )
+            elif fn.attr == "gauge_callback":
+                labels = next(
+                    (kw.value for kw in node.keywords if kw.arg == "labels"),
+                    None,
+                )
+                if isinstance(labels, ast.Dict):
+                    for value in labels.values:
+                        if value is not None and _is_interpolated(value):
+                            yield self.finding(
+                                ctx,
+                                value,
+                                "gauge_callback label value is "
+                                "interpolated from runtime data — use a "
+                                "closed label vocabulary.",
+                            )
+
+
+RULES: List[Rule] = [UnboundedLabel()]

@@ -1,0 +1,52 @@
+"""Observability plane: metrics registry, Prometheus exposition, tracing.
+
+The fleet-visibility subsystem (``docs/observability.md``): every server
+process owns a :class:`MetricsRegistry` (counters / gauges / log-scale
+histograms with bounded label cardinality) exposed as Prometheus text on
+``GET /metrics``, and a :class:`Tracer` recording ``X-PIO-Trace``-keyed
+spans into a ring buffer dumped via ``GET /traces.json``. ``pio top``
+scrapes a node list into one fleet table; ``pio trace <id>`` stitches a
+single request's spans across processes.
+
+Stdlib-only and device-free, like ``utils/resilience.py`` — importable
+from every server and client path.
+"""
+
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    OVERFLOW_VALUE,
+    percentile_from_buckets,
+)
+from .trace import (
+    TRACE_HEADER,
+    SpanContext,
+    SpanStore,
+    Tracer,
+    current_context,
+    new_trace_id,
+)
+from .expo import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from .expo import parse_text, render
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "OVERFLOW_VALUE",
+    "percentile_from_buckets",
+    "TRACE_HEADER",
+    "SpanContext",
+    "SpanStore",
+    "Tracer",
+    "current_context",
+    "new_trace_id",
+    "PROMETHEUS_CONTENT_TYPE",
+    "render",
+    "parse_text",
+]

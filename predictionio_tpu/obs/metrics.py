@@ -1,0 +1,401 @@
+"""Thread-safe metrics registry: counters, gauges, log-scale histograms.
+
+The observability plane's data model (``docs/observability.md``). Every
+server process owns one :class:`MetricsRegistry`; instruments are
+created idempotently by name, carry a fixed *label-name* schema, and
+accept label *values* per observation. The design constraints, in order:
+
+- **stdlib-only and device-free** — like ``utils/resilience.py``, this
+  must import from the Event Server and storage client paths where jax
+  may not exist.
+- **bounded cardinality** — a label set is a time series the scraper
+  must store forever; a label value interpolated from request data
+  (user ids, query strings) grows without bound and takes the whole
+  metrics plane down with it. The registry enforces a hard per-metric
+  cap (``max_label_sets``): past it, new label sets collapse into one
+  ``{label="_overflow"}`` series — the explosion is *visible* (the
+  overflow series grows) instead of fatal. The ``obs-unbounded-label``
+  lint rule catches the bug class at AST level before it ships.
+- **injectable clocks** — nothing here reads a wall clock except
+  through the constructor-supplied callable, so histogram/ gauge tests
+  run with zero wall-clock sleeps (the ISSUE-2 discipline).
+- **fixed log-scale histogram buckets** — tail latency spans four
+  orders of magnitude between a warm cache hit and a cold XLA compile;
+  power-of-two buckets give constant relative error across the whole
+  range at a fixed, mergeable series count (the Prometheus model, not
+  a quantile sketch: scrapers can sum bucket counters across a fleet).
+
+Exposition lives in :mod:`predictionio_tpu.obs.expo`; this module knows
+nothing about wire formats.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "OVERFLOW_VALUE",
+    "DEFAULT_BUCKETS",
+    "percentile_from_buckets",
+]
+
+#: the label value every over-cap label set collapses into
+OVERFLOW_VALUE = "_overflow"
+
+#: Default histogram buckets (seconds): powers of two from 0.5 ms to
+#: ~65 s. 18 buckets cover a sub-millisecond cache hit and a cold-start
+#: XLA compile in the same instrument at ~2x relative error.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    0.0005 * (2.0 ** i) for i in range(18)
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def percentile_from_buckets(
+    uppers: Sequence[float], cumulative: Sequence[int], q: float
+) -> float:
+    """Estimate the ``q`` (0..1) percentile from cumulative bucket counts
+    (Prometheus ``histogram_quantile`` semantics: linear interpolation
+    inside the first bucket whose cumulative count reaches rank).
+
+    ``uppers`` are the finite upper bounds; ``cumulative[i]`` counts
+    observations ``<= uppers[i]``; a final element of ``cumulative`` one
+    longer than ``uppers`` is the +Inf (total) count. Returns 0.0 with
+    no observations; observations beyond the last finite bound clamp to
+    it (the estimate cannot exceed what the buckets can resolve)."""
+    total = cumulative[-1] if cumulative else 0
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_bound = 0.0
+    prev_count = 0
+    for upper, count in zip(uppers, cumulative):
+        if count >= rank:
+            in_bucket = count - prev_count
+            if in_bucket <= 0 or math.isinf(upper):
+                return prev_bound
+            frac = (rank - prev_count) / in_bucket
+            return prev_bound + (upper - prev_bound) * frac
+        prev_bound, prev_count = upper, count
+    return uppers[-1] if uppers else 0.0
+
+
+class _Instrument:
+    """Base: child series keyed by label-value tuples, under one lock."""
+
+    kind = ""
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        max_label_sets: int,
+    ):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._max_label_sets = max_label_sets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            # the unlabelled series exists from creation (a counter that
+            # never fired still exposes 0 — absence is ambiguous)
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[ln]) for ln in self.labelnames)
+
+    def _child(self, labels: Dict[str, object]):
+        """Get-or-create the series for one label-value set, applying the
+        cardinality bound (caller does NOT hold the lock)."""
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if (
+                    self.labelnames
+                    and len(self._children) >= self._max_label_sets
+                ):
+                    # collapse, don't drop: the overflow series keeps the
+                    # totals honest and its growth IS the alarm
+                    key = tuple(OVERFLOW_VALUE for _ in self.labelnames)
+                    child = self._children.get(key)
+                    if child is None:
+                        child = self._new_child()
+                        self._children[key] = child
+                else:
+                    child = self._new_child()
+                    self._children[key] = child
+            return child
+
+    def series(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def clear(self) -> None:
+        """Drop every series — for re-exported state whose label sets
+        can change (a ``/reload`` swapping the deployed instance must
+        not leave the old instance's series behind). The unlabelled
+        series is re-created at zero."""
+        with self._lock:
+            self._children.clear()
+            if not self.labelnames:
+                self._children[()] = self._new_child()
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+
+    def value(self, **labels) -> float:
+        child = self._child(labels)
+        with self._lock:
+            return child.value
+
+
+class _GaugeChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Gauge(_Instrument):
+    """Point-in-time value; may also be backed by a collect-time callback
+    (:meth:`MetricsRegistry.gauge_callback`)."""
+
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float, **labels) -> None:
+        child = self._child(labels)
+        with self._lock:
+            child.value = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        child = self._child(labels)
+        with self._lock:
+            return child.value
+
+
+class _HistogramChild:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1 = the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram (cumulative exposition, per-bucket storage).
+
+    ``buckets`` are the finite upper bounds, strictly increasing; the
+    +Inf bucket is implicit. Defaults to the log-scale
+    :data:`DEFAULT_BUCKETS`."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str],
+        max_label_sets: int,
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        bounds = tuple(buckets) if buckets is not None else DEFAULT_BUCKETS
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError(
+                f"{name}: buckets must be non-empty and strictly increasing"
+            )
+        self.buckets = bounds
+        super().__init__(name, help, labelnames, max_label_sets)
+
+    def _new_child(self):
+        return _HistogramChild(len(self.buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        child = self._child(labels)
+        # bisect over a ~18-entry tuple: the linear scan is cache-friendly
+        # and the upper bound is fixed, so no log-vs-linear cliff
+        idx = len(self.buckets)
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                idx = i
+                break
+        with self._lock:
+            child.counts[idx] += 1
+            child.sum += value
+            child.count += 1
+
+    def snapshot(self, **labels) -> Dict[str, object]:
+        """Cumulative view of one series: ``{"buckets": [(le, n), ...],
+        "sum": s, "count": n}`` (the exposition shape, pre-format)."""
+        child = self._child(labels)
+        with self._lock:
+            counts = list(child.counts)
+            total_sum, total = child.sum, child.count
+        cumulative = []
+        running = 0
+        for bound, n in zip(self.buckets, counts[:-1]):
+            running += n
+            cumulative.append((bound, running))
+        cumulative.append((math.inf, total))
+        return {"buckets": cumulative, "sum": total_sum, "count": total}
+
+    def percentile(self, q: float, **labels) -> float:
+        snap = self.snapshot(**labels)
+        uppers = [b for b, _ in snap["buckets"]]
+        cums = [n for _, n in snap["buckets"]]
+        return percentile_from_buckets(uppers, cums, q)
+
+
+class MetricsRegistry:
+    """One process/server's instrument set.
+
+    Instruments are created idempotently: ``counter(name)`` twice
+    returns the same object; a name re-used with a different kind or
+    label schema raises (silent divergence would corrupt exposition).
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        max_label_sets: int = 64,
+    ):
+        self.clock = clock
+        self.max_label_sets = max_label_sets
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+        self._callbacks: List[Tuple[Gauge, Dict[str, str], Callable]] = []
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            existing = self._instruments.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        "kind or label schema"
+                    )
+                # bucket bounds are schema too: a second site observing
+                # against different bounds would silently land in +Inf
+                want = kwargs.get("buckets")
+                if want is not None and tuple(want) != existing.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} re-registered with different "
+                        "buckets"
+                    )
+                return existing
+            inst = cls(name, help, labelnames, self.max_label_sets, **kwargs)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Optional[Sequence[float]] = None,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def gauge_callback(
+        self,
+        name: str,
+        fn: Callable[[], float],
+        help: str = "",
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Gauge:
+        """A gauge whose value is *pulled* at collect time — the zero-
+        maintenance way to export existing state (breaker states, queue
+        depths, replication lag) without littering set() calls through
+        the owning code. ``fn`` must be cheap and non-blocking; a raise
+        freezes the series at its last value (a broken callback must not
+        take down ``/metrics``)."""
+        labels = dict(labels or {})
+        gauge = self.gauge(name, help=help, labelnames=sorted(labels))
+        with self._lock:
+            self._callbacks.append((gauge, labels, fn))
+        return gauge
+
+    def collect(self) -> List[_Instrument]:
+        """All instruments, callback gauges refreshed, stable name order."""
+        with self._lock:
+            callbacks = list(self._callbacks)
+            instruments = sorted(self._instruments.items())
+        for gauge, labels, fn in callbacks:
+            try:
+                gauge.set(float(fn()), **labels)
+            except Exception:
+                pass  # last value stands; exposition must never 500
+        return [inst for _, inst in instruments]

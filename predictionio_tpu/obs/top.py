@@ -1,0 +1,274 @@
+"""``pio top`` / ``pio trace``: fleet-wide scrape-and-render CLIs.
+
+``pio top`` pulls ``GET /metrics`` from a node list and renders one
+screenful of fleet state — the operator's first question ("is anything
+shedding / lagging / degraded?") answered without opening a dashboard.
+``pio trace <id>`` pulls ``GET /traces.json`` from the same node list
+and stitches every process's spans for one ``X-PIO-Trace`` id into a
+single start-time-ordered timeline.
+
+Both are read-only scrapers over the observability plane's two wire
+surfaces (``docs/observability.md``) — they need no storage conf, no
+jax, and work against any mix of query / event / storage / dashboard
+nodes (a node that lacks a given metric just shows ``-``).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .expo import parse_text
+from .metrics import percentile_from_buckets
+
+#: default node list: one of each server on localhost (query, event,
+#: storage) — the quickstart topology
+DEFAULT_NODES = "localhost:8000,localhost:7070,localhost:7079"
+
+
+def _split_nodes(spec: str) -> List[str]:
+    return [n.strip() for n in spec.split(",") if n.strip()]
+
+
+def _fetch(node: str, path: str, timeout: float = 5.0) -> Optional[str]:
+    """One GET against ``host:port`` → body, or None for anything short
+    of a 200 — a dead node, a garbled node spec, a non-HTTP peer. One
+    bad fleet member must render as DOWN, never crash the whole table."""
+    host, _, port = node.partition(":")
+    try:
+        conn = http.client.HTTPConnection(
+            host, int(port or 80), timeout=timeout
+        )
+    except (ValueError, OSError):  # 'host:abc', empty host, ...
+        return None
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+        return body if resp.status == 200 else None
+    except (OSError, http.client.HTTPException, ValueError):
+        return None
+    finally:
+        conn.close()
+
+
+def fetch_metrics(node: str, timeout: float = 5.0) -> Optional[Dict]:
+    """``GET /metrics`` on ``host:port`` → parsed samples (None when the
+    node is down). Shared by ``pio top`` and ``loadgen``."""
+    body = _fetch(node, "/metrics", timeout=timeout)
+    return None if body is None else parse_text(body)
+
+
+def merge_histogram_buckets(
+    samples: Optional[Sequence[Tuple[Dict[str, str], float]]],
+) -> Optional[Tuple[List[float], List[int]]]:
+    """Scraped ``<name>_bucket`` samples (all label sets summed) →
+    ``(bounds, cumulative)`` ready for :func:`percentile_from_buckets`;
+    None without usable buckets."""
+    if not samples:
+        return None
+    merged: Dict[float, float] = {}
+    for labels, value in samples:
+        le = labels.get("le")
+        if le is None:
+            continue
+        try:
+            bound = float("inf") if le == "+Inf" else float(le)
+        except ValueError:
+            continue
+        merged[bound] = merged.get(bound, 0.0) + value
+    bounds = sorted(merged)
+    if not bounds:
+        return None
+    return bounds, [int(merged[b]) for b in bounds]
+
+
+# -- pio top ----------------------------------------------------------------
+
+
+def _series_sum(
+    metrics: Dict[str, List[Tuple[Dict[str, str], float]]],
+    name: str,
+    **match: str,
+) -> Optional[float]:
+    samples = metrics.get(name)
+    if samples is None:
+        return None
+    total, found = 0.0, False
+    for labels, value in samples:
+        if all(labels.get(k) == v for k, v in match.items()):
+            total += value
+            found = True
+    return total if found else None
+
+
+def _hist_percentile(
+    metrics: Dict[str, List[Tuple[Dict[str, str], float]]],
+    name: str,
+    q: float,
+) -> Optional[float]:
+    """Percentile estimate from a scraped histogram's ``_bucket`` series
+    (all label sets merged — fleet-table altitude)."""
+    hist = merge_histogram_buckets(metrics.get(f"{name}_bucket"))
+    if hist is None:
+        return None
+    bounds, cums = hist
+    return percentile_from_buckets(bounds, cums, q)
+
+
+def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
+    """One fleet-table row: scrape + digest a node's exposition."""
+    m = fetch_metrics(node, timeout=timeout)
+    if m is None:
+        return {"node": node, "up": False}
+    row: Dict[str, object] = {"node": node, "up": True}
+    row["requests"] = _series_sum(m, "pio_serving_request_seconds_count")
+    if row["requests"] is None:  # non-serving nodes: total HTTP responses
+        row["requests"] = _series_sum(m, "pio_http_responses_total")
+    for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+        p = _hist_percentile(m, "pio_serving_request_seconds", q)
+        if p is None:
+            p = _hist_percentile(m, "pio_storage_op_seconds", q)
+        if p is None:
+            p = _hist_percentile(m, "pio_http_request_seconds", q)
+        row[key] = None if p is None else p * 1000.0
+    row["shed"] = _series_sum(m, "pio_serving_events_total", kind="shed")
+    breakers = m.get("pio_breaker_state")
+    row["breakers_open"] = (
+        None
+        if breakers is None
+        else sum(1 for _labels, v in breakers if v > 0)
+    )
+    row["batch_avg"] = None
+    submitted = _series_sum(m, "pio_batch_items_total")
+    batches = _series_sum(m, "pio_batch_flush_total")
+    if submitted is not None and batches:
+        row["batch_avg"] = submitted / batches
+    row["lag"] = _series_sum(m, "pio_replication_lag_ops")
+    row["seq"] = _series_sum(m, "pio_changefeed_seq")
+    row["train_s"] = _series_sum(m, "pio_train_phase_seconds")
+    return row
+
+
+_COLUMNS = (
+    ("NODE", "node", "{}"),
+    ("UP", "up", "{}"),
+    ("REQS", "requests", "{:.0f}"),
+    ("P50MS", "p50_ms", "{:.2f}"),
+    ("P99MS", "p99_ms", "{:.2f}"),
+    ("SHED", "shed", "{:.0f}"),
+    ("BRKOPEN", "breakers_open", "{}"),
+    ("BATCH", "batch_avg", "{:.1f}"),
+    ("LAG", "lag", "{:.0f}"),
+    ("SEQ", "seq", "{:.0f}"),
+    ("TRAIN_S", "train_s", "{:.2f}"),
+)
+
+
+def render_table(rows: Sequence[Dict[str, object]]) -> str:
+    table: List[List[str]] = [[title for title, _, _ in _COLUMNS]]
+    for row in rows:
+        cells = []
+        for _title, key, fmt in _COLUMNS:
+            value = row.get(key)
+            if value is None:
+                cells.append("-")
+            elif isinstance(value, bool):
+                cells.append("up" if value else "DOWN")
+            else:
+                cells.append(fmt.format(value))
+        table.append(cells)
+    widths = [max(len(r[i]) for r in table) for i in range(len(_COLUMNS))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table
+    )
+
+
+def run_top(
+    nodes: str = DEFAULT_NODES, timeout: float = 5.0, as_json: bool = False
+) -> int:
+    rows = [node_row(n, timeout=timeout) for n in _split_nodes(nodes)]
+    if as_json:
+        print(json.dumps(rows, default=str))
+    else:
+        print(render_table(rows))
+    return 0 if any(r.get("up") for r in rows) else 1
+
+
+# -- pio trace --------------------------------------------------------------
+
+
+def collect_trace(
+    trace_id: str, nodes: str = DEFAULT_NODES, timeout: float = 5.0
+) -> List[dict]:
+    """All spans for ``trace_id`` across the node list, start-ordered."""
+    spans: List[dict] = []
+    for node in _split_nodes(nodes):
+        body = _fetch(node, "/traces.json", timeout=timeout)
+        if body is None:
+            continue
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            continue
+        for span in doc.get("spans", []):
+            if span.get("traceId") == trace_id:
+                span = dict(span)
+                span.setdefault("node", node)
+                spans.append(span)
+    spans.sort(key=lambda s: (s.get("startMs", 0), s.get("spanId", "")))
+    return spans
+
+
+def render_trace(trace_id: str, spans: Sequence[dict]) -> str:
+    if not spans:
+        return f"trace {trace_id}: no spans found"
+    t0 = min(s.get("startMs", 0) for s in spans)
+    lines = [f"trace {trace_id}: {len(spans)} spans"]
+    for s in spans:
+        offset = s.get("startMs", 0) - t0
+        err = f"  ERROR={s['error']}" if s.get("error") else ""
+        tags = s.get("tags")
+        tag_str = (
+            "  " + " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            if tags
+            else ""
+        )
+        lines.append(
+            f"  +{offset:9.3f}ms  {s.get('durationMs', 0):9.3f}ms  "
+            f"{s.get('service', '?'):<14} {s.get('name', '?')}"
+            f"{tag_str}{err}"
+        )
+    return "\n".join(lines)
+
+
+def run_trace(
+    trace_id: str,
+    nodes: str = DEFAULT_NODES,
+    timeout: float = 5.0,
+    as_json: bool = False,
+) -> int:
+    spans = collect_trace(trace_id, nodes, timeout=timeout)
+    if as_json:
+        print(json.dumps(spans))
+    else:
+        print(render_trace(trace_id, spans))
+    return 0 if spans else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="pio top")
+    p.add_argument("--nodes", default=DEFAULT_NODES)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+    return run_top(args.nodes, timeout=args.timeout, as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

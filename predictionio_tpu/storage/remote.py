@@ -54,6 +54,7 @@ import time
 import urllib.parse
 from typing import Iterator, Optional
 
+from ..obs.trace import TRACE_HEADER, current_context
 from ..testing.faults import fault_point
 from ..utils.resilience import (
     CircuitBreaker,
@@ -392,6 +393,39 @@ class _PooledResponse:
 
 
 def _request(
+    url: str,
+    method: str = "GET",
+    body: Optional[bytes] = None,
+    timeout: float = 60.0,
+    idempotent: Optional[bool] = None,
+    deadline: Optional[Deadline] = None,
+    headers: Optional[dict] = None,
+):
+    """Traced front of :func:`_request_impl` (``docs/observability.md``):
+    when the calling thread carries an ambient span context (it is
+    serving a traced request), the trace id is forwarded in
+    ``X-PIO-Trace`` — so the storage server's admission span joins the
+    same trace — and a client span is recorded around the call. The
+    span covers up to response headers; a streamed body (``find``)
+    continues past it. Replica failover probes and failed-over reads go
+    through here too, so an outage's probe round is visible in the
+    trace."""
+    ctx = current_context()
+    if ctx is None:
+        return _request_impl(
+            url, method, body, timeout, idempotent, deadline, headers
+        )
+    headers = dict(headers or {})
+    headers.setdefault(TRACE_HEADER, ctx.trace_id)
+    with ctx.tracer.span(
+        f"storage.{method}", tags={"url": url}, parent=ctx
+    ):
+        return _request_impl(
+            url, method, body, timeout, idempotent, deadline, headers
+        )
+
+
+def _request_impl(
     url: str,
     method: str = "GET",
     body: Optional[bytes] = None,

@@ -203,6 +203,7 @@ class StorageReplica(StorageServer):
     """Read-only storage server converging on a primary's changefeed."""
 
     accepts_writes = False
+    service_name = "storage-replica"
 
     def __init__(
         self,
@@ -227,6 +228,24 @@ class StorageReplica(StorageServer):
         self._applied_cond = threading.Condition()
         self._poll_thread: Optional[threading.Thread] = None
         self._stop_polling = threading.Event()
+        # Replication lag in ops, pulled at scrape time: the fleet alarm
+        # for a stalling tailer. A promoted replica is the primary — by
+        # definition caught up with itself — so the gauge pins to 0 after
+        # failover (the loadgen chaos scenario asserts exactly this).
+        self.metrics.gauge_callback(
+            "pio_replication_lag_ops",
+            self.replication_lag,
+            "Ops behind the last observed primary seq (0 = caught up)",
+        )
+
+    def replication_lag(self) -> int:
+        """Current lag in ops; 0 when promoted or before the first fetch
+        (no observation is indistinguishable from caught-up — the tailer
+        error string in ``/status.json`` disambiguates)."""
+        if self.accepts_writes:
+            return 0
+        lag = self.tailer.lag()
+        return 0 if lag is None else lag
 
     # -- replication hooks ------------------------------------------------
     def applied_seq(self) -> int:

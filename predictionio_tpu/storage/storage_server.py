@@ -52,6 +52,7 @@ Run it with ``pio storageserver`` or :func:`create_storage_server`.
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import json
 import logging
@@ -59,6 +60,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..obs.trace import TRACE_HEADER, Tracer
 from ..utils.resilience import DEADLINE_HEADER, Deadline
 from .changefeed import MIN_SEQ_HEADER, SEQ_HEADER
 from .event import Event
@@ -134,6 +136,31 @@ def _parse_filter(obj: dict) -> EventFilter:
 class _StorageHandler(JsonHTTPHandler):
     server: "StorageServer"
 
+    # -- observability ----------------------------------------------------
+    @contextlib.contextmanager
+    def _obs_scope(self, method: str, op: str):
+        """Admission span (joins the caller's ``X-PIO-Trace``) + op
+        latency histogram around one data-plane route. ``op`` is the
+        coarse route family (events/metadata/models/replicate) — the
+        bounded label; never an app or record id."""
+        server = self.server
+        started = server.metrics.clock()
+        try:
+            with server.tracer.server_span(
+                f"{method} /{op}",
+                header_value=self.headers.get(TRACE_HEADER),
+                tags={"op": op},
+            ):
+                yield
+        finally:
+            server.metrics.histogram(
+                "pio_storage_op_seconds",
+                "Storage server op latency by route family",
+                labelnames=("method", "op"),
+            ).observe(
+                server.metrics.clock() - started, method=method, op=op
+            )
+
     # -- routing ----------------------------------------------------------
     def _route(self, method: str) -> None:
         self._headers_sent = False  # reset per request (keep-alive reuse)
@@ -154,16 +181,22 @@ class _StorageHandler(JsonHTTPHandler):
                 self.respond(200, {"status": "alive"})
             elif parts == ["status.json"] and method == "GET":
                 self.respond(200, self.server.status_json())
+            elif method == "GET" and parts in (["metrics"], ["traces.json"]):
+                self.serve_obs("/" + parts[0])  # docs/observability.md
             elif parts and parts[0] == "replicate":
-                self._route_replicate(method, parts[1:])
+                with self._obs_scope(method, "replicate"):
+                    self._route_replicate(method, parts[1:])
             elif not self._gate_min_seq(deadline):
                 pass  # replica behind the caller's seq token: 409 sent
             elif parts and parts[0] == "events":
-                self._route_events(method, parts[1:])
+                with self._obs_scope(method, "events"):
+                    self._route_events(method, parts[1:])
             elif parts == ["metadata", "rpc"] and method == "POST":
-                self._metadata_rpc()
+                with self._obs_scope(method, "metadata"):
+                    self._metadata_rpc()
             elif parts and parts[0] == "models" and len(parts) == 2:
-                self._route_models(method, parts[1])
+                with self._obs_scope(method, "models"):
+                    self._route_models(method, parts[1])
             else:
                 self.read_body()
                 self.respond(404, {"message": "Not found"})
@@ -490,6 +523,8 @@ class StorageServer(BackgroundHTTPServer):
     accepts_writes = True
     #: the write endpoint to hint in replica 409s (None on a primary)
     primary_url: Optional[str] = None
+    #: tracer service name ("storage-replica" on replicas)
+    service_name = "storage-server"
 
     def __init__(
         self,
@@ -500,12 +535,26 @@ class StorageServer(BackgroundHTTPServer):
         models,
         changefeed=None,
     ):
-        super().__init__((host, port), _StorageHandler)
+        super().__init__(
+            (host, port), _StorageHandler, tracer=Tracer(self.service_name)
+        )
         self.events = events
         self.metadata = metadata
         self.models = models
         self.changefeed = changefeed
         self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
+        # The changefeed seq is the append *counter* of the mutation log:
+        # a scraper's rate() over it IS the append rate, and comparing it
+        # across primary and replicas is the fleet's lag view. Pulled at
+        # collect time so attaching a changefeed post-construction (the
+        # loadgen chaos harness does) needs no re-wiring.
+        self.metrics.gauge_callback(
+            "pio_changefeed_seq",
+            lambda: (
+                self.changefeed.last_seq if self.changefeed is not None else 0
+            ),
+            "Last sequence number appended to the changefeed op log",
+        )
 
     # -- replication hooks (overridden by StorageReplica) -----------------
     def applied_seq(self) -> int:

@@ -323,6 +323,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ln.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    top = sub.add_parser(
+        "top",
+        help="fleet table: scrape GET /metrics from a node list "
+        "(docs/observability.md)",
+    )
+    top.add_argument(
+        "--nodes", default=None, metavar="HOST:PORT,...",
+        help="nodes to scrape (default: localhost query/event/storage "
+        "ports)",
+    )
+    top.add_argument("--json", action="store_true",
+                     help="emit rows as JSON instead of the table")
+    top.add_argument("--timeout", type=float, default=5.0)
+
+    tr = sub.add_parser(
+        "trace",
+        help="stitch one X-PIO-Trace id's spans across a node list "
+        "(GET /traces.json)",
+    )
+    tr.add_argument("trace_id")
+    tr.add_argument(
+        "--nodes", default=None, metavar="HOST:PORT,...",
+        help="nodes to query (default: localhost query/event/storage "
+        "ports)",
+    )
+    tr.add_argument("--json", action="store_true",
+                    help="emit raw spans as JSON")
+    tr.add_argument("--timeout", type=float, default=5.0)
+
     up = sub.add_parser(
         "upgrade", help="migrate event data between storage backends"
     )
@@ -680,6 +709,25 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         except KeyboardInterrupt:
             server.server_close()
         return EXIT_OK
+
+    if cmd == "top":
+        from ..obs.top import DEFAULT_NODES, run_top
+
+        return run_top(
+            args.nodes or DEFAULT_NODES,
+            timeout=args.timeout,
+            as_json=args.json,
+        )
+
+    if cmd == "trace":
+        from ..obs.top import DEFAULT_NODES, run_trace
+
+        return run_trace(
+            args.trace_id,
+            args.nodes or DEFAULT_NODES,
+            timeout=args.timeout,
+            as_json=args.json,
+        )
 
     if cmd == "status":
         result = status(registry)

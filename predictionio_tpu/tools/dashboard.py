@@ -60,6 +60,63 @@ def render_index(instances) -> str:
     )
 
 
+def render_train_runs(instances) -> str:
+    """``GET /train_runs``: engine (training) instances with the
+    per-phase timings the workflow persisted into the instance record
+    (``utils/profiling.phases_from_env``, docs/observability.md) — the
+    training-time twin of the evaluations listing."""
+    from ..utils.profiling import phases_from_env
+
+    rows = []
+    for inst in sorted(instances, key=lambda i: i.start_time, reverse=True):
+        phases = phases_from_env(inst.env)
+        phase_text = (
+            ", ".join(f"{k}={v:.3f}s" for k, v in sorted(phases.items()))
+            or "-"
+        )
+        rows.append(
+            "<tr>"
+            f"<td>{html.escape(inst.id)}</td>"
+            f"<td>{html.escape(inst.status)}</td>"
+            f"<td>{html.escape(inst.engine_id)} "
+            f"{html.escape(inst.engine_version)}</td>"
+            f"<td>{_fmt_time(inst.start_time)}</td>"
+            f"<td>{_fmt_time(inst.end_time)}</td>"
+            f"<td>{html.escape(phase_text)}</td>"
+            "</tr>"
+        )
+    return (
+        "<!DOCTYPE html><html><head><title>Train runs</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+        "<h1>Train runs</h1>"
+        "<table><tr><th>ID</th><th>Status</th><th>Engine</th>"
+        "<th>Start</th><th>End</th><th>Train phases</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+def train_runs_json(instances) -> list:
+    """Machine-readable twin of ``/train_runs``."""
+    from ..utils.profiling import phases_from_env
+
+    return [
+        {
+            "id": inst.id,
+            "status": inst.status,
+            "engineId": inst.engine_id,
+            "engineVersion": inst.engine_version,
+            "startTime": str(inst.start_time),
+            "endTime": str(inst.end_time),
+            "trainPhases": phases_from_env(inst.env),
+        }
+        for inst in sorted(
+            instances, key=lambda i: i.start_time, reverse=True
+        )
+    ]
+
+
 class _DashboardHandler(JsonHTTPHandler):
     server: "DashboardServer"
 
@@ -70,10 +127,28 @@ class _DashboardHandler(JsonHTTPHandler):
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
+        if self.serve_obs(path):  # /metrics + /traces.json
+            return
         md = self.server.registry.get_metadata()
         if path == "/":
             instances = md.evaluation_instance_get_completed()
             self.respond(200, render_index(instances), content_type="text/html")
+            return
+        # /train_runs, NOT /engine_instances: the pre-existing
+        # /engine_instances/<id>/evaluator_results.* detail routes name
+        # EVALUATION instances (reference parity, Dashboard.scala) — the
+        # training listing must not squat on that prefix
+        if path == "/train_runs":
+            self.respond(
+                200,
+                render_train_runs(md.engine_instance_get_all()),
+                content_type="text/html",
+            )
+            return
+        if path == "/train_runs.json":
+            self.respond(
+                200, train_runs_json(md.engine_instance_get_all())
+            )
             return
         parts = [p for p in path.split("/") if p]
         if len(parts) == 3 and parts[0] == "engine_instances":

@@ -166,6 +166,52 @@ def run_load(
     return out
 
 
+def _scrape_raw(url: str, timeout: float = 5.0) -> Optional[dict]:
+    """``GET /metrics`` on the target's host:port → parsed samples
+    (the shared ``obs.top`` scraper: one dead/garbled endpoint reports
+    as None, never a traceback mid-run)."""
+    from ..obs.top import fetch_metrics
+
+    parsed = urlparse(url)
+    return fetch_metrics(
+        f"{parsed.hostname}:{parsed.port or 80}", timeout=timeout
+    )
+
+
+def scrape_server_metrics(url: str, timeout: float = 5.0) -> Optional[dict]:
+    """``--scrape-metrics``: pull ``GET /metrics`` from the target and
+    digest the *server-side* view of the run — histogram percentiles and
+    shed/expired counters. Reported next to loadgen's client-side
+    percentiles: the difference between the two IS the network + HTTP
+    stack, and the server's p99 survives even when client sampling is
+    thin (docs/observability.md)."""
+    raw = _scrape_raw(url, timeout=timeout)
+    return None if raw is None else digest_serving_metrics(raw)
+
+
+def digest_serving_metrics(metrics: dict) -> dict:
+    """Exposition samples → the loadgen report's ``server`` section."""
+    from ..obs.metrics import percentile_from_buckets
+    from ..obs.top import merge_histogram_buckets
+
+    out: dict = {}
+    hist = merge_histogram_buckets(
+        metrics.get("pio_serving_request_seconds_bucket")
+    )
+    if hist is not None:
+        bounds, cums = hist
+        out["requests"] = cums[-1] if cums else 0
+        for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
+            out[key] = round(
+                percentile_from_buckets(bounds, cums, q) * 1000, 3
+            )
+    for kind in ("shed", "deadline_expired", "retries"):
+        for labels, value in metrics.get("pio_serving_events_total", []):
+            if labels.get("kind") == kind:
+                out[kind] = int(value)
+    return out
+
+
 def _expand_payloads(template: str, n: int = 256) -> List[bytes]:
     if "{i}" in template:
         return [template.replace("{i}", str(i)).encode() for i in range(n)]
@@ -316,6 +362,16 @@ def run_storage_chaos(
         post_promote_id = promoted.insert(
             Event(event="rate", entity_type="user", entity_id="post"), 1
         )
+        # Observability acceptance: the replication-lag gauge must read 0
+        # after promotion — measured through the real /metrics exposition
+        # of the (now-primary) replica, not by poking its internals.
+        lag_after = None
+        scraped = _scrape_raw(
+            f"http://127.0.0.1:{replica.bound_port}/", timeout=10.0
+        )
+        if scraped is not None:
+            lags = [v for _l, v in scraped.get("pio_replication_lag_ops", [])]
+            lag_after = lags[0] if lags else None
         return {
             "mode": "storage-chaos",
             "ops": total_ops,
@@ -327,6 +383,7 @@ def run_storage_chaos(
             "promotedSeq": status.get("seq"),
             "postPromoteWriteOk": promoted.get(post_promote_id, 1)
             is not None,
+            "replicationLagAfterPromote": lag_after,
         }
     finally:
         if prev_threshold is None:
@@ -362,6 +419,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="per-request X-PIO-Deadline-Ms budget; 504s are "
                         "reported as deadline_expired, not errors")
+    p.add_argument("--scrape-metrics", action="store_true",
+                   help="after the run, GET /metrics from the target and "
+                        "report server-side percentiles next to the "
+                        "client-side ones (docs/observability.md)")
     p.add_argument("--fault", action="append", default=[],
                    metavar="SITE=KIND[:ARG][*N]",
                    help="activate the deterministic fault harness "
@@ -385,7 +446,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         print(json.dumps(result))
         ok = not result["failedReads"] and not result["lostAckedWrites"] \
-            and result["postPromoteWriteOk"]
+            and result["postPromoteWriteOk"] \
+            and result["replicationLagAfterPromote"] == 0
         return 0 if ok else 1
 
     if args.fault:
@@ -435,6 +497,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result["batching"] = server._batcher.stats
     if server is not None:
         result["serving_stats"] = server.stats.snapshot()
+    if args.scrape_metrics:
+        if server is not None:
+            # in-process: the "server side" is this process's registry
+            from ..obs.expo import render
+            from ..obs.expo import parse_text as _parse
+
+            result["server"] = digest_serving_metrics(
+                _parse(render(server.metrics))
+            )
+        else:
+            server_view = scrape_server_metrics(args.url)
+            if server_view is None:
+                print("# --scrape-metrics: GET /metrics failed",
+                      file=sys.stderr)
+            else:
+                result["server"] = server_view
     print(json.dumps(result))
     return 0
 

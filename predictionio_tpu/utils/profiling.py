@@ -57,6 +57,46 @@ class StepTimer:
         return "; ".join(parts) or "(no phases recorded)"
 
 
+# -- persisted phase summaries (docs/observability.md) ----------------------
+#
+# A StepTimer dies with its process; the training workflow persists its
+# summary into the completed engine instance's env map under this key so
+# per-phase timings survive to the serving/status plane (the query
+# server re-exports them as pio_train_phase_seconds gauges, and the
+# dashboard's /engine_instances listing renders them).
+
+TRAIN_PHASES_ENV_KEY = "PIO_TRAIN_PHASES"
+
+
+def phases_to_env(summary: Dict[str, Dict[str, float]]) -> str:
+    """``StepTimer.summary()`` → the compact JSON stored in the engine
+    instance env (phase → total seconds)."""
+    import json
+
+    return json.dumps(
+        {name: round(s["total_s"], 6) for name, s in sorted(summary.items())}
+    )
+
+
+def phases_from_env(env: Optional[Dict[str, str]]) -> Dict[str, float]:
+    """Inverse of :func:`phases_to_env`; {} on absence or garbage (an old
+    instance record must not break the status page)."""
+    import json
+
+    raw = (env or {}).get(TRAIN_PHASES_ENV_KEY)
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+        return {
+            str(k): float(v)
+            for k, v in parsed.items()
+            if isinstance(v, (int, float))
+        }
+    except (ValueError, AttributeError):
+        return {}
+
+
 @contextlib.contextmanager
 def device_trace(logdir: Optional[str]) -> Iterator[None]:
     """``jax.profiler.trace`` wrapper: no-op when ``logdir`` is falsy or the

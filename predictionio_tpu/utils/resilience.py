@@ -195,6 +195,11 @@ class RetryPolicy:
     ``rng``, ``sleep`` and ``clock`` are injectable: tests pin the rng
     and capture sleeps, so every schedule asserts deterministically with
     zero wall-clock cost.
+
+    ``on_retry`` (optional) fires once per retry actually taken, with
+    the 0-based retry index — the observability hook the serving metrics
+    use to count retries without wrapping every call site
+    (``docs/observability.md``). It must not raise.
     """
 
     def __init__(
@@ -206,6 +211,7 @@ class RetryPolicy:
         rng: Optional[random.Random] = None,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
+        on_retry: Optional[Callable[[int], None]] = None,
     ):
         if attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {attempts}")
@@ -216,6 +222,7 @@ class RetryPolicy:
         self._rng = rng or random.Random()
         self._sleep = sleep
         self._clock = clock
+        self._on_retry = on_retry
 
     def delay_for(self, retry_index: int) -> float:
         """The (jittered) delay before retry ``retry_index`` (0-based)."""
@@ -249,6 +256,8 @@ class RetryPolicy:
                 delay = self.delay_for(attempt)
                 if deadline is not None and deadline.remaining_s() <= delay:
                     raise  # the budget can't cover the backoff: fail now
+                if self._on_retry is not None:
+                    self._on_retry(attempt)
                 self._sleep(delay)
         raise last  # pragma: no cover — loop always returns or raises
 
@@ -281,6 +290,10 @@ class CircuitBreaker:
     CLOSED = "closed"
     OPEN = "open"
     HALF_OPEN = "half-open"
+
+    #: numeric encoding for the metrics plane: a breaker-state *gauge*
+    #: must be orderable (alert on > 0) — 0 closed, 1 half-open, 2 open
+    STATE_VALUES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
     def __init__(
         self,
@@ -402,6 +415,18 @@ class CircuitBreaker:
             ):
                 return self.HALF_OPEN
             return self._state
+
+    @property
+    def state_value(self) -> int:
+        """:attr:`state` as its gauge encoding (0/1/2)."""
+        return self.STATE_VALUES[self.state]
+
+    @property
+    def open_count(self) -> int:
+        """Lifetime closed→open transitions (monotonic — exposed as the
+        ``pio_breaker_opens`` gauge)."""
+        with self._lock:
+            return self._open_count
 
     def snapshot(self) -> dict:
         """Status-page JSON shape."""

@@ -36,7 +36,10 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import Tracer, current_context
 
 __all__ = ["MicroBatcher"]
 
@@ -57,6 +60,17 @@ class MicroBatcher:
     ``pipeline_depth`` is the number of batches allowed in flight at once
     (>=1). Depth 1 reproduces the strictly serial round-2 behavior; depth
     >=2 overlaps device round trips and is the default.
+
+    Observability (``docs/observability.md``): with a ``metrics``
+    registry attached, every flush records batch size, the flush reason
+    (``full`` / ``wait`` / ``close``) and per-item queue wait, and the
+    live queue depth is exported as a gauge — the signals that say
+    whether the aggregator is forming real batches or just adding
+    ``max_wait_ms`` of latency. With a ``tracer`` attached, each item
+    whose submitting thread carried a span context gets two child spans:
+    ``batch.queue-wait`` (submit → dispatch) and ``batch.device`` (the
+    processor call) — the queue-time-vs-device-time split that explains
+    a slow query. ``clock`` is injectable for sleep-free tests.
     """
 
     def __init__(
@@ -67,6 +81,9 @@ class MicroBatcher:
         name: str = "microbatch",
         default_timeout_s: float = 120.0,
         pipeline_depth: int = 2,
+        metrics: Optional[MetricsRegistry] = None,
+        tracer: Optional[Tracer] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -79,10 +96,43 @@ class MicroBatcher:
         self._max_wait_s = max(0.0, max_wait_ms) / 1000.0
         self._default_timeout_s = default_timeout_s
         self._pipeline_depth = pipeline_depth
+        self._clock = clock
+        self._tracer = tracer
+        self._obs_size = self._obs_wait = self._obs_flush = None
+        self._obs_items = self._obs_failures = None
+        if metrics is not None:
+            self._obs_size = metrics.histogram(
+                "pio_batch_size",
+                "Queries per dispatched micro-batch",
+                buckets=[2.0 ** i for i in range(11)],  # 1..1024
+            )
+            self._obs_wait = metrics.histogram(
+                "pio_batch_queue_wait_seconds",
+                "Per-item wait between submit and batch dispatch",
+            )
+            self._obs_flush = metrics.counter(
+                "pio_batch_flush_total",
+                "Batch flushes by trigger",
+                labelnames=("reason",),
+            )
+            self._obs_items = metrics.counter(
+                "pio_batch_items_total", "Items dispatched through batches"
+            )
+            self._obs_failures = metrics.counter(
+                "pio_batch_failures_total",
+                "Batches whose processor raised (all items failed)",
+            )
+            metrics.gauge_callback(
+                "pio_batch_queue_depth",
+                lambda: len(self._items),
+                "Items waiting for the next batch",
+            )
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._items: List[Any] = []
         self._futures: List[Future] = []
+        #: parallel to _items: (enqueue_ts, submitter SpanContext or None)
+        self._meta: List[Tuple[float, Any]] = []
         self._closed = False
         self._batches = 0
         self._submitted = 0
@@ -111,11 +161,16 @@ class MicroBatcher:
         """Block until the batched processor has handled ``item``; returns
         its index-aligned result (or raises that item's exception)."""
         fut: Future = Future()
+        # capture the submitter's trace context OUTSIDE the lock: the
+        # dispatcher/worker threads that emit this item's spans have no
+        # access to the submitting thread's contextvars
+        span_ctx = current_context() if self._tracer is not None else None
         with self._nonempty:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._items.append(item)
             self._futures.append(fut)
+            self._meta.append((self._clock(), span_ctx))
             self._submitted += 1
             self._nonempty.notify()
         return fut.result(
@@ -125,12 +180,13 @@ class MicroBatcher:
     # -- dispatcher -------------------------------------------------------
     def _take_batch(self) -> tuple:
         """Wait for at least one item, linger up to max_wait for more (or
-        until the batch is full), then drain. Returns ([], []) on close."""
+        until the batch is full), then drain. Returns ((), (), (), "")
+        on close."""
         with self._nonempty:
             while not self._items and not self._closed:
                 self._nonempty.wait(0.1)
             if self._closed and not self._items:
-                return (), ()
+                return (), (), (), ""
             if self._max_wait_s > 0:
                 deadline = time.monotonic() + self._max_wait_s
                 while len(self._items) < self._max_batch:
@@ -138,11 +194,21 @@ class MicroBatcher:
                     if remaining <= 0:
                         break
                     self._nonempty.wait(remaining)
+            # flush reason, for the metrics plane: a fleet of "wait"
+            # flushes at size 1 means batching is pure added latency
+            if len(self._items) >= self._max_batch:
+                reason = "full"
+            elif self._closed:
+                reason = "close"
+            else:
+                reason = "wait"
             items = self._items[: self._max_batch]
             futures = self._futures[: self._max_batch]
+            metas = self._meta[: self._max_batch]
             del self._items[: self._max_batch]
             del self._futures[: self._max_batch]
-            return items, futures
+            del self._meta[: self._max_batch]
+            return items, futures, metas, reason
 
     def _run(self) -> None:
         while True:
@@ -151,7 +217,7 @@ class MicroBatcher:
             # (device round trips in flight) arrivals keep topping up the
             # next batch to max_batch instead of dispatching undersized.
             self._slots.acquire()
-            items, futures = self._take_batch()
+            items, futures, metas, reason = self._take_batch()
             if not items:
                 self._slots.release()
                 if self._closed:
@@ -160,7 +226,7 @@ class MicroBatcher:
             with self._lock:
                 self._inflight += 1
                 self._inflight_hwm = max(self._inflight_hwm, self._inflight)
-            self._work.put((items, futures))
+            self._work.put((items, futures, metas, reason))
 
     def _worker(self) -> None:
         while True:
@@ -169,9 +235,53 @@ class MicroBatcher:
                 return
             self._execute(*task)
 
-    def _execute(self, items: Sequence[Any], futures: Sequence[Future]) -> None:
+    def _record_obs(
+        self,
+        metas: Sequence[Tuple[float, Any]],
+        reason: str,
+        dispatch_ts: float,
+        device_s: float,
+        batch_size: int,
+    ) -> None:
+        """Metrics + spans for one executed batch (see class docstring)."""
+        if self._obs_size is not None:
+            self._obs_size.observe(batch_size)
+            self._obs_flush.inc(1, reason=reason)
+            self._obs_items.inc(batch_size)
+        for enqueue_ts, span_ctx in metas:
+            wait_s = max(0.0, dispatch_ts - enqueue_ts)
+            if self._obs_wait is not None:
+                self._obs_wait.observe(wait_s)
+            if self._tracer is not None and span_ctx is not None:
+                wall = self._tracer.wall()
+                tags = {"batch_size": batch_size, "flush": reason}
+                self._tracer.record(
+                    "batch.queue-wait",
+                    self._tracer.child_context(span_ctx),
+                    span_ctx.span_id,
+                    start_wall=wall - wait_s - device_s,
+                    duration_s=wait_s,
+                    tags=tags,
+                )
+                self._tracer.record(
+                    "batch.device",
+                    self._tracer.child_context(span_ctx),
+                    span_ctx.span_id,
+                    start_wall=wall - device_s,
+                    duration_s=device_s,
+                    tags=tags,
+                )
+
+    def _execute(
+        self,
+        items: Sequence[Any],
+        futures: Sequence[Future],
+        metas: Sequence[Tuple[float, Any]] = (),
+        reason: str = "",
+    ) -> None:
         """Run one batch on an executor thread and fan results out. Runs
         concurrently with up to ``pipeline_depth - 1`` sibling batches."""
+        dispatch_ts = self._clock()
         try:
             try:
                 results = self._process(items)
@@ -181,6 +291,8 @@ class MicroBatcher:
                         f"for {len(items)} items"
                     )
             except Exception as exc:
+                if self._obs_failures is not None:
+                    self._obs_failures.inc(1)
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(exc)
@@ -195,6 +307,21 @@ class MicroBatcher:
                 else:
                     fut.set_result(result)
         finally:
+            # Metrics/spans for every executed batch, FAILED ones
+            # included — an erroring device is exactly when the batch
+            # signals matter, so a raise must not zero the flush counts.
+            # Swallowed on error: observability must never wedge the
+            # pipeline slot or kill the worker thread.
+            try:
+                self._record_obs(
+                    metas,
+                    reason,
+                    dispatch_ts,
+                    self._clock() - dispatch_ts,
+                    len(items),
+                )
+            except Exception:
+                pass
             with self._lock:
                 self._inflight -= 1
             self._slots.release()
@@ -226,6 +353,7 @@ class MicroBatcher:
                     fut.set_exception(RuntimeError("MicroBatcher closed"))
             self._items.clear()
             self._futures.clear()
+            self._meta.clear()
 
     @property
     def stats(self) -> dict:

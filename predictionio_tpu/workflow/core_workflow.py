@@ -95,9 +95,18 @@ def run_train(
         )
         stored = md.engine_instance_get(instance_id)
         assert stored is not None
+        # Persist the per-phase wall-clock summary with the completed
+        # record: the StepTimer dies with this process, but the timings
+        # belong to the instance — the query server re-exports them as
+        # pio_train_phase_seconds gauges and the dashboard lists them
+        # (docs/observability.md).
+        from ..utils.profiling import TRAIN_PHASES_ENV_KEY, phases_to_env
+
+        env = dict(stored.env)
+        env[TRAIN_PHASES_ENV_KEY] = phases_to_env(ctx.timer.summary())
         md.engine_instance_update(
             dataclasses.replace(
-                stored, status=STATUS_COMPLETED, end_time=utcnow()
+                stored, status=STATUS_COMPLETED, end_time=utcnow(), env=env
             )
         )
         logger.info("Training completed; engine instance %s", instance_id)

@@ -55,6 +55,8 @@ import requests
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..controller.engine import Engine, EngineParams
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import TRACE_HEADER, SpanContext, Tracer, current_context
 from ..storage import StorageRegistry, utcnow
 from ..storage.metadata import STATUS_COMPLETED, EngineInstance
 from ..testing.faults import fault_point
@@ -204,17 +206,25 @@ def _has_pr_id(obj: Any) -> bool:
 
 
 class ServingStats:
-    """Thread-safe serving counters.
+    """Thread-safe serving counters, backed by the obs metrics plane.
 
     Beyond the reference's request count / serving times, every
     resilience outcome is *counted*, not just logged: shed admissions,
-    expired deadlines, feedback/error-log delivery failures and
+    expired deadlines, retries, feedback/error-log delivery failures and
     breaker-skipped deliveries — a fleet monitor reads these off
-    ``GET /`` instead of scraping logs."""
+    ``GET /`` instead of scraping logs.
+
+    Request latency feeds a log-scale registry histogram
+    (``pio_serving_request_seconds``), so :meth:`snapshot` reports
+    p50/p95/p99 — last/avg alone are blind to exactly the tail behavior
+    that matters at millions of users (a 2x p99 regression moves the
+    average by noise). Every pre-existing camelCase wire key is
+    preserved; the percentiles are additive."""
 
     _COUNTERS = (
         "shed",
         "deadline_expired",
+        "retries",
         "feedback_sent",
         "feedback_failures",
         "feedback_skipped",
@@ -222,7 +232,19 @@ class ServingStats:
         "error_log_skipped",
     )
 
-    def __init__(self):
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        # standalone construction (tests, loadgen) gets a private
+        # registry; servers pass theirs so /metrics sees the same series
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._hist = self.metrics.histogram(
+            "pio_serving_request_seconds",
+            "End-to-end /queries.json latency",
+        )
+        self._events = self.metrics.counter(
+            "pio_serving_events_total",
+            "Serving resilience outcomes",
+            labelnames=("kind",),
+        )
         self._lock = threading.Lock()
         self.request_count = 0
         self.last_serving_sec = 0.0
@@ -237,12 +259,17 @@ class ServingStats:
                 self.avg_serving_sec * self.request_count + elapsed_s
             ) / (self.request_count + 1)
             self.request_count += 1
+        self._hist.observe(elapsed_s)
 
     def inc(self, counter: str) -> None:
         if counter not in self._COUNTERS:
             raise ValueError(f"unknown serving counter {counter!r}")
         with self._lock:
             setattr(self, counter, getattr(self, counter) + 1)
+        self._events.inc(1, kind=counter)  # kind is a closed set: safe label
+
+    def percentile_ms(self, q: float) -> float:
+        return round(self._hist.percentile(q) * 1000.0, 3)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -256,7 +283,12 @@ class ServingStats:
                 parts = name.split("_")
                 key = parts[0] + "".join(p.title() for p in parts[1:])
                 out[key] = getattr(self, name)
-            return out
+        # histogram-estimated tail latency (outside the lock: the
+        # histogram has its own)
+        out["p50Ms"] = self.percentile_ms(0.50)
+        out["p95Ms"] = self.percentile_ms(0.95)
+        out["p99Ms"] = self.percentile_ms(0.99)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -363,30 +395,41 @@ class _QueryHandler(JsonHTTPHandler):
         deadline = Deadline.from_header(
             self.headers.get(DEADLINE_HEADER), clock=self.server.clock
         )
+        span = None
         try:
             if deadline is not None:
                 # admission-stage check: a budget that is already gone
                 # spends zero decode/supplement work
                 deadline.check("admission")
-            result, status = self.server.handle_query(payload, deadline)
-            self.respond(status, result)
+            # Admission span: joins the client's X-PIO-Trace id (or roots
+            # a fresh trace) and becomes ambient for the request, so the
+            # engine's supplement/serve storage calls and the batcher
+            # spans all land in the same trace (docs/observability.md).
+            with self.server.tracer.server_span(
+                "POST /queries.json",
+                header_value=self.headers.get(TRACE_HEADER),
+            ) as span:
+                result, status = self.server.handle_query(payload, deadline)
+            self.respond(status, result, headers={TRACE_HEADER: span.trace_id})
         except DeadlineExceeded as exc:
             self.server.stats.inc("deadline_expired")
             self.respond(504, {"message": str(exc), "stage": exc.stage})
         except QueryDecodeError as exc:
             # the reference remote-logs the bad-query branch too
             # (CreateServer.scala:583-590)
-            self.server.post_error_log(str(exc), payload)
+            self.server.post_error_log(str(exc), payload, trace_ctx=span)
             self.respond(400, {"message": str(exc)})
         except Exception as exc:
             logger.exception("Query failed")
-            self.server.post_error_log(str(exc), payload)
+            self.server.post_error_log(str(exc), payload, trace_ctx=span)
             self.respond(500, {"message": str(exc)})
         finally:
             self.server.release()
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
+        if self.serve_obs(path):  # /metrics + /traces.json
+            return
         if path == "/" or path == "/status.json":
             # content negotiation: browsers keep the HTML status page,
             # monitors GET /status.json (or Accept: application/json)
@@ -442,8 +485,15 @@ class QueryServer(BackgroundHTTPServer):
         # objects are injectable so the whole fault suite runs without a
         # wall-clock sleep; defaults come from the PIO_BREAKER_* env.
         self.clock = clock
+        # Observability plane (docs/observability.md): one registry +
+        # tracer per server process, exposed on /metrics + /traces.json.
+        metrics = MetricsRegistry(clock=clock)
+        self.stats = ServingStats(metrics)
         self._retry = retry_policy or RetryPolicy(
-            attempts=3, base_delay_s=0.05, max_delay_s=1.0
+            attempts=3,
+            base_delay_s=0.05,
+            max_delay_s=1.0,
+            on_retry=lambda _i: self.stats.inc("retries"),
         )
         self.feedback_breaker = feedback_breaker or CircuitBreaker.from_env(
             "event-server", clock=clock
@@ -471,6 +521,7 @@ class QueryServer(BackgroundHTTPServer):
         # The deployment travels WITH each queued item, so a /reload
         # mid-batch is safe: in-flight queries finish on the model they
         # arrived under.
+        tracer = Tracer("query-server", clock=clock)
         self._batcher: Optional[MicroBatcher] = (
             MicroBatcher(
                 self._predict_batch,
@@ -478,14 +529,42 @@ class QueryServer(BackgroundHTTPServer):
                 max_wait_ms=config.batch_wait_ms,
                 name="predict-batch",
                 pipeline_depth=config.batch_pipeline_depth,
+                metrics=metrics,
+                tracer=tracer,
+                clock=clock,
             )
             if config.batching
             else None
         )
         # Serving stats (CreateServer.scala:392-394,567-574 + resilience)
-        self.stats = ServingStats()
         self.server_start_time = utcnow()
-        super().__init__((config.ip, config.port), _QueryHandler)
+        # breaker states + lifetime opens, pulled at scrape time
+        for dep, breaker in (
+            ("event-server", self.feedback_breaker),
+            ("error-log", self.error_log_breaker),
+            ("reload", self.reload_breaker),
+        ):
+            metrics.gauge_callback(
+                "pio_breaker_state",
+                (lambda b=breaker: b.state_value),
+                "Breaker state (0 closed, 1 half-open, 2 open)",
+                labels={"dep": dep},
+            )
+            # monotonic, but exposed as a gauge (the callback pull
+            # model) — so no `_total` suffix, like pio_changefeed_seq
+            metrics.gauge_callback(
+                "pio_breaker_opens",
+                (lambda b=breaker: b.open_count),
+                "Lifetime breaker open transitions",
+                labels={"dep": dep},
+            )
+        super().__init__(
+            (config.ip, config.port),
+            _QueryHandler,
+            metrics=metrics,
+            tracer=tracer,
+        )
+        self._export_train_phases()
 
     # Pre-resilience attribute surface, kept for callers/tests that read
     # the counters straight off the server object.
@@ -581,25 +660,48 @@ class QueryServer(BackgroundHTTPServer):
         self.stats.record_request(time.monotonic() - started)
         return result, 200
 
-    def _post_json(self, site: str, url: str, data: Any) -> None:
+    def _post_json(
+        self,
+        site: str,
+        url: str,
+        data: Any,
+        trace_ctx: Optional[SpanContext] = None,
+    ) -> None:
         """One retried JSON POST to a sink (the shared delivery path of
         the feedback and error-log planes). Raises on final failure so
         the caller's breaker records ONE failure per logical delivery,
         not one per attempt. Retrying a *write* is safe here because
         both sinks dedupe: feedback events carry an ``idempotencyKey``
-        and the error log is an append-only diagnostic stream."""
+        and the error log is an append-only diagnostic stream.
+
+        ``trace_ctx`` is the originating request's span context, captured
+        *before* the hop onto the feedback pool thread (contextvars do
+        not follow): the delivery records a child span and forwards the
+        trace id so the Event Server's spans join the same trace."""
+        headers = {}
+        if trace_ctx is not None:
+            headers[TRACE_HEADER] = trace_ctx.trace_id
 
         def attempt() -> None:
             fault_point(site, url=url)
-            resp = requests.post(url, json=data, timeout=10)
+            resp = requests.post(url, json=data, timeout=10, headers=headers)
             if resp.status_code not in (200, 201):
                 raise RuntimeError(
                     f"{site} POST -> HTTP {resp.status_code}"
                 )
 
-        self._retry.call(attempt)
+        if trace_ctx is None:
+            self._retry.call(attempt)
+            return
+        with self.tracer.span(site, parent=trace_ctx):
+            self._retry.call(attempt)
 
-    def post_error_log(self, message: str, payload: Any) -> None:
+    def post_error_log(
+        self,
+        message: str,
+        payload: Any,
+        trace_ctx: Optional[SpanContext] = None,
+    ) -> None:
         """Fire-and-forget POST of a serving failure to ``log_url``
         (``CreateServer.scala:409-420`` — remote error reporting for
         fleet-monitored deployments). Rides the bounded feedback pool so
@@ -622,11 +724,14 @@ class QueryServer(BackgroundHTTPServer):
             "message": message,
             "query": payload,
         }
+        if trace_ctx is None:
+            trace_ctx = current_context()  # captured before the thread hop
 
         def send() -> None:
             try:
                 self.error_log_breaker.call(
-                    self._post_json, "serving.error_log", url, data
+                    self._post_json, "serving.error_log", url, data,
+                    trace_ctx=trace_ctx,
                 )
             except CircuitOpen:
                 self.stats.inc("error_log_skipped")
@@ -721,7 +826,9 @@ class QueryServer(BackgroundHTTPServer):
             f"?accessKey={self.config.access_key or ''}"
         )
 
-        self._feedback_pool.submit(self._deliver_feedback, url, data)
+        self._feedback_pool.submit(
+            self._deliver_feedback, url, data, current_context()
+        )
 
         # Stamp the generated prId into the response only for predictions
         # that carry a prId slot (CreateServer.scala:558-565).
@@ -731,7 +838,12 @@ class QueryServer(BackgroundHTTPServer):
             result["prId"] = new_pr_id
         return result
 
-    def _deliver_feedback(self, url: str, data: dict) -> None:
+    def _deliver_feedback(
+        self,
+        url: str,
+        data: dict,
+        trace_ctx: Optional[SpanContext] = None,
+    ) -> None:
         """Breaker-guarded, retried feedback delivery (pool thread).
 
         While the Event Server is down the breaker opens after
@@ -741,7 +853,8 @@ class QueryServer(BackgroundHTTPServer):
         timeout — the degraded mode ``GET /`` surfaces."""
         try:
             self.feedback_breaker.call(
-                self._post_json, "serving.feedback", url, data
+                self._post_json, "serving.feedback", url, data,
+                trace_ctx=trace_ctx,
             )
             self.stats.inc("feedback_sent")
         except CircuitOpen:
@@ -781,9 +894,30 @@ class QueryServer(BackgroundHTTPServer):
         with self._deploy_lock:
             old = self.deployment.instance.id
             self.deployment = fresh
+        self._export_train_phases()
         logger.info(
             "Reloaded: engine instance %s -> %s", old, fresh.instance.id
         )
+
+    def _export_train_phases(self) -> None:
+        """Re-export the deployed instance's persisted training phase
+        timings as gauges (``pio top`` reads them off ``/metrics``).
+        Phase names are read/prepare/train[i] — bounded by algo count.
+        The previous export is cleared first: after a ``/reload`` the
+        series must describe the instance actually deployed, not linger
+        from the one it replaced (including when the new record carries
+        no phases at all)."""
+        from ..utils.profiling import phases_from_env
+
+        phases = phases_from_env(self.deployment.instance.env)
+        gauge = self.metrics.gauge(
+            "pio_train_phase_seconds",
+            "Wall-clock of each training phase of the deployed instance",
+            labelnames=("phase",),
+        )
+        gauge.clear()
+        for name, seconds in phases.items():
+            gauge.set(seconds, phase=name)
 
     # -- status page (CreateServer.scala:421-456) -------------------------
     def status_json(self) -> dict:
@@ -812,6 +946,11 @@ class QueryServer(BackgroundHTTPServer):
         }
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
+        from ..utils.profiling import phases_from_env
+
+        phases = phases_from_env(dep.instance.env)
+        if phases:
+            out["trainPhases"] = phases
         return out
 
     def status_html(self) -> str:

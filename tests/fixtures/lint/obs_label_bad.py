@@ -1,0 +1,15 @@
+"""Observability bug class: a metric label interpolated from request
+data.
+
+Every distinct label value is a new time series the scraper stores
+forever; a per-user value grows without bound until the registry's
+cardinality cap folds it into ``{user="_overflow"}`` — the metric is
+destroyed either way. ``obs-unbounded-label`` must flag the ``inc``
+below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+
+def record_request(counter, user_id):
+    counter.inc(1, user=f"user-{user_id}")  # unbounded label: BAD

@@ -1,0 +1,33 @@
+"""Clean twin of ``obs_label_bad.py``: label values come from a closed
+vocabulary (route templates, outcome kinds, dependency names) and the
+per-request detail rides a span tag, not a label. The linter must
+report NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+_ROUTES = {"/queries.json": "POST /queries.json"}
+
+
+def record_request(counter, tracer, span_ctx, path, user_id):
+    # bounded: the label is a route *template* from a fixed mapping
+    counter.inc(1, route=_ROUTES.get(path, "other"))
+    # the unbounded value goes in a span tag — ring-buffered, not a
+    # permanent time series (f-strings outside label positions are fine)
+    tracer.record(
+        f"request user-{user_id}",
+        span_ctx,
+        None,
+        start_wall=0.0,
+        duration_s=0.0,
+        tags={"user": user_id},
+    )
+
+
+def breaker_gauge(registry, breaker):
+    # constant label values on a callback gauge: bounded
+    registry.gauge_callback(
+        "pio_breaker_state",
+        lambda: breaker.state_value,
+        labels={"dep": "event-server"},
+    )
