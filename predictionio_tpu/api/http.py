@@ -38,6 +38,14 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
     # 44 ms → 0.3 ms with TCP_NODELAY.
     disable_nagle_algorithm = True
 
+    #: Extra labels every ``pio_http_responses_total`` sample of this
+    #: handler class carries (label *names* are schema, pinned per
+    #: registry — so a subclass must declare the full closed set here
+    #: and may override per-request *values* via ``self.response_labels``).
+    #: The query server adds ``{"variant": "-"}`` so canary/shadow
+    #: traffic is attributable per variant (docs/rollouts.md).
+    response_label_defaults: dict = {}
+
     def respond(
         self,
         status: int,
@@ -57,12 +65,16 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
             body = json.dumps(payload).encode("utf-8")
         metrics = getattr(self.server, "metrics", None)
         if metrics is not None:
-            # HTTP status codes are a small closed set — a safe label
+            # HTTP status codes are a small closed set — a safe label;
+            # ditto the declared extras (variant is a two-value vocabulary)
+            labels = dict(self.response_label_defaults)
+            labels.update(getattr(self, "response_labels", None) or {})
+            labels["status"] = status
             metrics.counter(
                 "pio_http_responses_total",
                 "Responses by HTTP status",
-                labelnames=("status",),
-            ).inc(1, status=status)
+                labelnames=tuple(sorted(labels)),
+            ).inc(1, **labels)
         self.send_response(status)
         self.send_header("Content-Type", f"{content_type}; charset=UTF-8")
         self.send_header("Content-Length", str(len(body)))

@@ -1,0 +1,37 @@
+"""Rollout plane: shadow serving, canary splits, metric-gated promotion.
+
+The deployment-lifecycle subsystem (``docs/rollouts.md``): a candidate
+``EngineInstance`` goes trained → SHADOW → CANARY → LIVE through a
+durable :class:`~predictionio_tpu.storage.metadata.RolloutPlan` state
+machine, with auto-rollback at any stage when the promotion gates
+(error-rate delta, p99 delta, shadow divergence — evaluated over
+sliding windows of the obs-plane metrics) fail.
+
+- :mod:`.plan` — gate config, deterministic sticky splits, divergence
+- :mod:`.controller` — sliding windows + promote/hold/rollback verdicts
+- :mod:`.manager` — the query server's lifecycle driver
+"""
+
+from .controller import RolloutController, VariantWindow
+from .manager import RolloutError, RolloutManager
+from .plan import (
+    BASELINE,
+    CANDIDATE,
+    GateConfig,
+    prediction_divergence,
+    sticky_key,
+    variant_for_key,
+)
+
+__all__ = [
+    "BASELINE",
+    "CANDIDATE",
+    "GateConfig",
+    "RolloutController",
+    "RolloutError",
+    "RolloutManager",
+    "VariantWindow",
+    "prediction_divergence",
+    "sticky_key",
+    "variant_for_key",
+]

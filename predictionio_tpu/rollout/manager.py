@@ -1,0 +1,665 @@
+"""RolloutManager: the query server's deployment-lifecycle state machine.
+
+One manager per :class:`~predictionio_tpu.workflow.serving.QueryServer`
+drives a candidate ``EngineInstance`` from trained to fully live
+(``docs/rollouts.md``):
+
+- **SHADOW** — the candidate is resident alongside the baseline; every
+  served query is asynchronously duplicated to it on a bounded pool
+  (results discarded, latency/error/prediction-divergence recorded per
+  variant). Clients only ever see baseline answers.
+- **CANARY** — a deterministic sticky share of traffic (hashed entity
+  key, ``RolloutPlan.salt`` + ``percent``) is *served* by the
+  candidate; a candidate failure falls back to the baseline inside the
+  same request, so a sick canary costs latency, never a client error.
+- **LIVE** — the candidate becomes ``server.deployment``; the retired
+  baseline's model references are dropped so its device buffers are
+  reclaimable.
+- **ROLLED_BACK / ABORTED** — the candidate is retired, the baseline
+  keeps 100% of traffic, and the terminal state (with the gate verdict
+  as ``reason``) is durably recorded.
+
+Transitions are decided by the
+:class:`~predictionio_tpu.rollout.controller.RolloutController` after
+every recorded sample and persisted through the metadata store — which
+means they replicate through the PR-3 changefeed like any other
+metadata mutation, and a server restarted mid-rollout resumes the same
+plan (same salt → same sticky split) from
+``rollout_plan_get_active``. A metadata outage during an automatic
+transition never blocks serving: the in-memory state machine advances
+and the write is retried on subsequent observations until it lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import secrets
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Deque, Optional
+
+from ..storage import utcnow
+from ..storage.event import to_millis
+from ..storage.metadata import (
+    ROLLOUT_ABORTED,
+    ROLLOUT_CANARY,
+    ROLLOUT_LIVE,
+    ROLLOUT_ROLLED_BACK,
+    ROLLOUT_SHADOW,
+    RolloutPlan,
+)
+from .controller import PROMOTE, ROLLBACK, RolloutController
+from .plan import (
+    BASELINE,
+    CANDIDATE,
+    GateConfig,
+    plan_to_json,
+    prediction_divergence,
+    sticky_key,
+    variant_for_key,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RolloutError", "RolloutManager"]
+
+#: pio_rollout_stage gauge vocabulary (docs/rollouts.md)
+_STAGE_CODES = {
+    None: 0,
+    ROLLOUT_SHADOW: 1,
+    ROLLOUT_CANARY: 2,
+    ROLLOUT_LIVE: 3,
+    ROLLOUT_ROLLED_BACK: 4,
+    ROLLOUT_ABORTED: 5,
+}
+
+#: divergence lives in [0, 1]: fixed linear-ish log buckets
+_DIVERGENCE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+#: shadow duplicates in flight before new ones are dropped (counted as
+#: kind="shadow_dropped") — shadow evaluation is sampling, not a queue
+#: that may grow without bound when the candidate is slow
+_SHADOW_PENDING_CAP = 32
+
+
+class RolloutError(ValueError):
+    """Operator-visible lifecycle misuse (no active plan, plan already
+    active, unknown candidate, ...) → HTTP 409 on the rollout routes."""
+
+
+class RolloutManager:
+    """Owns one query server's rollout state: the durable plan, the
+    resident candidate deployment, the gate controller, and the shadow
+    duplication pool."""
+
+    def __init__(self, server):
+        self.server = server
+        self.clock = server.clock
+        self._lock = threading.RLock()
+        self.plan: Optional[RolloutPlan] = None
+        self.candidate_dep = None
+        self.controller: Optional[RolloutController] = None
+        #: set when a transition's metadata write failed; retried on the
+        #: next observation until it lands (serving never blocks on it)
+        self._persist_pending = False
+        self._shadow_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="shadow"
+        )
+        self._shadow_pending = 0
+        self._shadow_futures: Deque = deque(maxlen=256)
+
+        metrics = server.metrics
+        self._hist = metrics.histogram(
+            "pio_rollout_request_seconds",
+            "Per-variant serving latency while a rollout is active",
+            labelnames=("variant",),
+        )
+        self._events = metrics.counter(
+            "pio_rollout_events_total",
+            "Rollout serving outcomes by variant",
+            labelnames=("variant", "kind"),
+        )
+        self._div_hist = metrics.histogram(
+            "pio_rollout_divergence",
+            "Shadow candidate-vs-baseline prediction divergence",
+            buckets=_DIVERGENCE_BUCKETS,
+        )
+        self._transitions = metrics.counter(
+            "pio_rollout_transitions_total",
+            "Rollout plan state transitions",
+            labelnames=("to",),
+        )
+        metrics.gauge_callback(
+            "pio_rollout_stage",
+            self._stage_code,
+            "Rollout stage (0 none, 1 shadow, 2 canary, 3 live, "
+            "4 rolled-back, 5 aborted)",
+        )
+        metrics.gauge_callback(
+            "pio_rollout_percent",
+            self._live_percent,
+            "Traffic share the candidate currently serves",
+        )
+
+    # -- introspection ----------------------------------------------------
+    def _stage_code(self) -> int:
+        plan = self.plan
+        return _STAGE_CODES.get(plan.stage if plan else None, 0)
+
+    def _live_percent(self) -> float:
+        plan = self.plan
+        if plan is None:
+            return 0.0
+        if plan.stage == ROLLOUT_CANARY:
+            return float(plan.percent)
+        return 100.0 if plan.stage == ROLLOUT_LIVE else 0.0
+
+    @property
+    def active(self) -> bool:
+        plan = self.plan
+        return plan is not None and plan.stage in (
+            ROLLOUT_SHADOW,
+            ROLLOUT_CANARY,
+        )
+
+    @property
+    def stage(self) -> Optional[str]:
+        plan = self.plan
+        return plan.stage if plan else None
+
+    def _md(self):
+        return self.server.registry.get_metadata()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(
+        self,
+        candidate_instance_id: Optional[str] = None,
+        percent: Optional[float] = None,
+        gates: Optional[dict] = None,
+    ) -> dict:
+        """Open a new plan in SHADOW: load the candidate resident next
+        to the baseline and persist the plan durably before the first
+        duplicated query."""
+        from ..workflow.serving import prepare_deployment
+
+        with self._lock:
+            if self.active:
+                raise RolloutError(
+                    f"rollout {self.plan.id} is already active "
+                    f"(stage {self.plan.stage}); promote or abort it first"
+                )
+            baseline = self.server.deployment.instance
+        md = self._md()
+        if candidate_instance_id:
+            inst = md.engine_instance_get(candidate_instance_id)
+            if inst is None:
+                raise RolloutError(
+                    f"engine instance {candidate_instance_id!r} not found"
+                )
+        else:
+            # positional args: this call must survive the metadata RPC
+            # wire, which ships {method, args} with no kwargs channel
+            # (storage/remote.py _RemoteRPC)
+            inst = md.engine_instance_get_latest_completed(
+                baseline.engine_id,
+                baseline.engine_version,
+                baseline.engine_variant,
+            )
+            if inst is None or inst.id == baseline.id:
+                raise RolloutError(
+                    "no completed candidate newer than the deployed "
+                    f"baseline {baseline.id}; train first or pass an "
+                    "explicit instanceId"
+                )
+        gate_cfg = GateConfig.from_dict(gates or {})
+        if percent is not None:
+            gate_cfg = dataclasses.replace(
+                gate_cfg, canary_percent=float(percent)
+            )
+        p = gate_cfg.canary_percent
+        if not (0.0 < p <= 100.0):  # NaN fails both comparisons too
+            raise RolloutError(
+                f"canary percent must be in (0, 100], got {p!r} — a NaN or "
+                "out-of-range split would 500 every canary query"
+            )
+        cfg = dataclasses.replace(
+            self.server.config, engine_instance_id=inst.id
+        )
+        # Model load OUTSIDE the lock: status()/observe() share it, and
+        # a minutes-long HBM upload must not hang every health probe.
+        candidate_dep = prepare_deployment(
+            self.server.engine, self.server.registry, cfg, self.server.ctx
+        )
+        with self._lock:
+            if self.active:  # lost a race with a concurrent start
+                raise RolloutError(
+                    f"rollout {self.plan.id} is already active "
+                    f"(stage {self.plan.stage}); promote or abort it first"
+                )
+            baseline = self.server.deployment.instance
+            now = utcnow()
+            plan = RolloutPlan(
+                id="",
+                stage=ROLLOUT_SHADOW,
+                engine_id=baseline.engine_id,
+                engine_version=baseline.engine_version,
+                engine_variant=baseline.engine_variant,
+                baseline_instance_id=baseline.id,
+                candidate_instance_id=inst.id,
+                percent=gate_cfg.canary_percent,
+                salt=secrets.token_hex(8),
+                created_time=now,
+                updated_time=now,
+                gates=gate_cfg.to_dict(),
+                history=[self._history_entry(ROLLOUT_SHADOW, "rollout started")],
+            )
+            pid = md.rollout_plan_upsert(plan)
+            self.plan = dataclasses.replace(plan, id=pid)
+            self.candidate_dep = candidate_dep
+            self.controller = RolloutController(gate_cfg, clock=self.clock)
+            self._persist_pending = False
+            self._transitions.inc(1, to=ROLLOUT_SHADOW)
+            logger.info(
+                "rollout %s: candidate %s shadowing baseline %s",
+                pid, inst.id, baseline.id,
+            )
+            return self.status()
+
+    def resume(self) -> None:
+        """Crash-consistent restart: re-resolve the active plan from
+        metadata and rebuild the exact same routing function (same salt,
+        same percent → same sticky split). Called from QueryServer
+        construction; a missing/broken plan degrades to plain baseline
+        serving, never a failed boot."""
+        from ..workflow.serving import prepare_deployment
+
+        with self._lock:
+            deployed = self.server.deployment.instance
+            md = self._md()
+            plan = md.rollout_plan_get_active(
+                deployed.engine_id,
+                deployed.engine_version,
+                deployed.engine_variant,
+            )
+            if plan is None:
+                self._quarantine_rolled_back(md, deployed)
+                return
+            candidate_dep = None
+            if deployed.id == plan.candidate_instance_id:
+                # The candidate is the *latest completed* instance, so a
+                # restarted server loaded it as its default deployment.
+                # Mid-rollout that is wrong side of the split: reload the
+                # plan's baseline and keep the candidate as candidate. An
+                # unloadable baseline closes the plan loudly — leaving it
+                # ACTIVE while the candidate serves 100% unwatched would
+                # be the worst of both worlds.
+                try:
+                    cfg = dataclasses.replace(
+                        self.server.config,
+                        engine_instance_id=plan.baseline_instance_id,
+                    )
+                    baseline_dep = prepare_deployment(
+                        self.server.engine, self.server.registry, cfg,
+                        self.server.ctx,
+                    )
+                except Exception as exc:
+                    self._persist_terminal(
+                        plan,
+                        ROLLOUT_ABORTED,
+                        f"baseline unloadable on resume: {exc}; the "
+                        "candidate remains deployed",
+                    )
+                    return
+                with self.server._deploy_lock:
+                    # the displaced deployment IS the candidate, already
+                    # loaded — reuse it instead of paying a second model
+                    # load (and doubling peak memory) on every
+                    # mid-rollout restart
+                    candidate_dep = self.server.deployment
+                    self.server.deployment = baseline_dep
+                self.server._export_train_phases()
+            elif deployed.id != plan.baseline_instance_id:
+                # A third instance got deployed out-of-band: the plan no
+                # longer describes this server's traffic — finish it.
+                self._persist_terminal(
+                    plan,
+                    ROLLOUT_ABORTED,
+                    f"superseded by deployed instance {deployed.id}",
+                )
+                return
+            if candidate_dep is None:
+                try:
+                    cfg = dataclasses.replace(
+                        self.server.config,
+                        engine_instance_id=plan.candidate_instance_id,
+                    )
+                    candidate_dep = prepare_deployment(
+                        self.server.engine, self.server.registry, cfg,
+                        self.server.ctx,
+                    )
+                except Exception as exc:
+                    self._persist_terminal(
+                        plan,
+                        ROLLOUT_ABORTED,
+                        f"candidate unloadable on resume: {exc}",
+                    )
+                    return
+            gate_cfg = (
+                GateConfig.from_dict(plan.gates) if plan.gates else GateConfig()
+            )
+            self.plan = plan
+            self.candidate_dep = candidate_dep
+            self.controller = RolloutController(gate_cfg, clock=self.clock)
+            logger.info(
+                "rollout %s resumed at stage %s (candidate %s)",
+                plan.id, plan.stage, plan.candidate_instance_id,
+            )
+
+    def _quarantine_rolled_back(self, md, deployed) -> None:
+        """No active plan, but the instance this server just loaded (the
+        *latest completed* one) may be the candidate a finished plan
+        rolled back — redeploying it by default would undo the rollback
+        on the next restart. Swap back to that plan's baseline; an
+        explicit ``--engine-instance-id`` deploy still wins (operators
+        can override quarantine deliberately)."""
+        from ..workflow.serving import prepare_deployment
+
+        if self.server.config.engine_instance_id:
+            return  # explicitly pinned: respect the operator
+        latest = md.rollout_plan_get_latest(
+            deployed.engine_id, deployed.engine_version, deployed.engine_variant
+        )
+        if (
+            latest is None
+            or latest.stage not in (ROLLOUT_ROLLED_BACK, ROLLOUT_ABORTED)
+            or deployed.id != latest.candidate_instance_id
+        ):
+            return
+        try:
+            cfg = dataclasses.replace(
+                self.server.config,
+                engine_instance_id=latest.baseline_instance_id,
+            )
+            baseline_dep = prepare_deployment(
+                self.server.engine, self.server.registry, cfg, self.server.ctx
+            )
+        except Exception:
+            # The quarantine could not be enforced — the rolled-back
+            # candidate stays deployed. Surface the terminal plan so the
+            # status page shows the situation instead of "no rollout".
+            self.plan = latest
+            logger.exception(
+                "rollout %s: quarantine failed — baseline %s unloadable; "
+                "the %s candidate %s remains deployed",
+                latest.id, latest.baseline_instance_id, latest.stage,
+                latest.candidate_instance_id,
+            )
+            return
+        with self.server._deploy_lock:
+            self.server.deployment = baseline_dep
+        self.server._export_train_phases()
+        self.plan = latest  # terminal plan surfaces in status pages
+        logger.warning(
+            "rollout %s: candidate %s is quarantined (%s); serving its "
+            "baseline %s instead of the latest completed instance",
+            latest.id, latest.candidate_instance_id, latest.stage,
+            latest.baseline_instance_id,
+        )
+
+    def promote(self, reason: str = "manual promote") -> dict:
+        """Operator override: advance one stage regardless of gates."""
+        with self._lock:
+            if not self.active:
+                raise RolloutError("no active rollout to promote")
+            self._advance_stage(reason)
+            return self.status()
+
+    def abort(self, reason: str = "manual abort") -> dict:
+        """Operator override: retire the candidate, baseline takes 100%."""
+        with self._lock:
+            if not self.active:
+                raise RolloutError("no active rollout to abort")
+            self._retire_candidate(ROLLOUT_ABORTED, reason)
+            return self.status()
+
+    def close(self) -> None:
+        self._shadow_pool.shutdown(wait=False)
+
+    # -- request-path hooks (QueryServer.handle_query) --------------------
+    def variant_for(self, payload: Any) -> str:
+        """Sticky variant assignment for one query. Only the CANARY
+        stage routes real traffic to the candidate."""
+        plan = self.plan
+        if plan is None or plan.stage != ROLLOUT_CANARY:
+            return BASELINE
+        if self.candidate_dep is None:
+            return BASELINE
+        return variant_for_key(plan.salt, sticky_key(payload), plan.percent)
+
+    def candidate_deployment(self):
+        return self.candidate_dep
+
+    def observe(self, variant: str, latency_s: float, ok: bool) -> None:
+        """Record one served request and re-evaluate the gates."""
+        with self._lock:
+            if not self.active or self.controller is None:
+                return
+            self.controller.record(variant == CANDIDATE, latency_s, ok)
+            self._hist.observe(latency_s, variant=variant)
+            self._events.inc(1, variant=variant, kind="ok" if ok else "error")
+            self._maybe_advance()
+
+    def retry_pending_persist(self) -> None:
+        """Land a transition whose metadata write failed. Called once
+        per served request (lock-free fast path when nothing is
+        pending), because a *terminal* transition has no subsequent
+        observe() to ride — without this, a rollback decided during a
+        metadata outage would never become durable and a restarted
+        server would resume the rolled-back plan."""
+        if not self._persist_pending:
+            return
+        with self._lock:
+            if self._persist_pending and self.plan is not None:
+                self._try_persist(self.plan)
+
+    def submit_shadow(self, payload: Any, baseline_result: Any):
+        """Duplicate one query to the resident candidate (SHADOW stage):
+        async on the bounded pool, result discarded, outcome recorded.
+        Returns the Future (tests drain it) or None when dropped."""
+        with self._lock:
+            if (
+                not self.active
+                or self.plan.stage != ROLLOUT_SHADOW
+                or self.candidate_dep is None
+            ):
+                return None
+            if self._shadow_pending >= _SHADOW_PENDING_CAP:
+                self._events.inc(1, variant=CANDIDATE, kind="shadow_dropped")
+                return None
+            self._shadow_pending += 1
+            dep = self.candidate_dep
+        try:
+            future = self._shadow_pool.submit(
+                self._run_shadow, dep, payload, baseline_result
+            )
+        except RuntimeError:  # pool shut down mid-stop
+            with self._lock:
+                self._shadow_pending -= 1
+            return None
+        self._shadow_futures.append(future)
+        return future
+
+    def drain_shadow(self, timeout_s: float = 30.0) -> None:
+        """Wait for every outstanding shadow duplicate (deterministic
+        tests and the loadgen chaos scenario; never called on the
+        request path)."""
+        while self._shadow_futures:
+            self._shadow_futures.popleft().result(timeout=timeout_s)
+
+    def _run_shadow(self, dep, payload, baseline_result) -> None:
+        t0 = self.clock()
+        divergence: Optional[float] = None
+        ok = False
+        try:
+            from ..workflow.serving import encode_result
+
+            _query, prediction = self.server._serve_one(
+                dep, payload, None, CANDIDATE
+            )
+            divergence = prediction_divergence(
+                baseline_result, encode_result(prediction)
+            )
+            ok = True
+        except Exception:
+            logger.debug("shadow candidate query failed", exc_info=True)
+        finally:
+            elapsed = max(0.0, self.clock() - t0)
+            with self._lock:
+                self._shadow_pending -= 1
+                if self.active and self.plan.stage == ROLLOUT_SHADOW:
+                    self.controller.record(True, elapsed, ok)
+                    self._hist.observe(elapsed, variant=CANDIDATE)
+                    self._events.inc(
+                        1,
+                        variant=CANDIDATE,
+                        kind="shadow_ok" if ok else "shadow_error",
+                    )
+                    if divergence is not None:
+                        self.controller.record_divergence(divergence)
+                        self._div_hist.observe(divergence)
+                    self._maybe_advance()
+
+    # -- state machine ----------------------------------------------------
+    def _maybe_advance(self) -> None:
+        """Gate check after each sample (lock held)."""
+        if self._persist_pending:
+            self._try_persist(self.plan)
+        if not self.active or self.controller is None:
+            return
+        verdict, reason = self.controller.evaluate(self.plan.stage)
+        if verdict == PROMOTE:
+            self._advance_stage(reason)
+        elif verdict == ROLLBACK:
+            self._retire_candidate(ROLLOUT_ROLLED_BACK, reason)
+
+    def _advance_stage(self, reason: str) -> None:
+        """SHADOW → CANARY → LIVE (lock held)."""
+        if self.plan.stage == ROLLOUT_SHADOW:
+            self._set_stage(ROLLOUT_CANARY, reason)
+            self.controller.enter_stage()
+            logger.info(
+                "rollout %s: candidate %s takes %.1f%% of traffic (%s)",
+                self.plan.id, self.plan.candidate_instance_id,
+                self.plan.percent, reason,
+            )
+            return
+        # CANARY → LIVE: the candidate becomes THE deployment; the
+        # retired baseline's last reference goes with the swap, so its
+        # model buffers are reclaimable (in-flight queries finish on the
+        # deployment they were routed to — they hold their own ref).
+        candidate_dep = self.candidate_dep
+        self.server._adopt_deployment(candidate_dep)
+        self.candidate_dep = None
+        self.controller = None
+        self._set_stage(ROLLOUT_LIVE, reason)
+        logger.info(
+            "rollout %s: candidate %s is live, baseline %s retired (%s)",
+            self.plan.id, self.plan.candidate_instance_id,
+            self.plan.baseline_instance_id, reason,
+        )
+
+    def _retire_candidate(self, stage: str, reason: str) -> None:
+        """Rollback/abort (lock held): drop the candidate, keep serving
+        the resident baseline — the transition is a reference swap away
+        from 100% baseline, never a client-visible event."""
+        self.candidate_dep = None
+        self.controller = None
+        self._set_stage(stage, reason)
+        logger.warning(
+            "rollout %s: candidate %s retired -> %s (%s)",
+            self.plan.id, self.plan.candidate_instance_id, stage, reason,
+        )
+
+    @staticmethod
+    def _history_entry(stage: str, reason: str) -> dict:
+        return {"stage": stage, "atMs": to_millis(utcnow()), "reason": reason}
+
+    def _set_stage(self, stage: str, reason: str) -> None:
+        self.plan = dataclasses.replace(
+            self.plan,
+            stage=stage,
+            updated_time=utcnow(),
+            history=list(self.plan.history)
+            + [self._history_entry(stage, reason)],
+        )
+        self._transitions.inc(1, to=stage)
+        self._try_persist(self.plan)
+
+    def _try_persist(self, plan: RolloutPlan) -> None:
+        """Durably record ``plan``; a storage outage defers (retried on
+        every subsequent observation) instead of failing the request
+        that happened to trigger the transition."""
+        try:
+            self._md().rollout_plan_upsert(plan)
+            self._persist_pending = False
+        except Exception as exc:
+            self._persist_pending = True
+            logger.warning(
+                "rollout %s: could not persist stage %s (%s); will retry",
+                plan.id, plan.stage, exc,
+            )
+
+    def _persist_terminal(self, plan: RolloutPlan, stage: str, reason: str) -> None:
+        """Finish a plan this manager is NOT adopting (resume-time
+        supersede/abort paths)."""
+        finished = dataclasses.replace(
+            plan,
+            stage=stage,
+            updated_time=utcnow(),
+            history=list(plan.history) + [self._history_entry(stage, reason)],
+        )
+        self.plan = finished
+        self._transitions.inc(1, to=stage)
+        self._try_persist(finished)
+        logger.warning("rollout %s: %s (%s)", plan.id, stage, reason)
+
+    # -- status -----------------------------------------------------------
+    def status(self) -> dict:
+        """The ``GET /rollout.json`` / ``pio rollout status`` body."""
+        with self._lock:
+            plan = self.plan
+            out: dict = {"active": self.active}
+            if plan is None:
+                return out
+            out["plan"] = plan_to_json(plan)
+            if self.active and self.controller is not None:
+                verdict, reason = self.controller.evaluate(plan.stage)
+                mean_div = self.controller.mean_divergence()
+                out["windows"] = {
+                    "baseline": {
+                        "samples": self.controller.baseline.count(),
+                        "errorRate": round(
+                            self.controller.baseline.error_rate(), 6
+                        ),
+                        "p99Ms": round(
+                            self.controller.baseline.p99() * 1000, 3
+                        ),
+                    },
+                    "candidate": {
+                        "samples": self.controller.candidate.count(),
+                        "errorRate": round(
+                            self.controller.candidate.error_rate(), 6
+                        ),
+                        "p99Ms": round(
+                            self.controller.candidate.p99() * 1000, 3
+                        ),
+                    },
+                }
+                if mean_div is not None:
+                    out["windows"]["meanDivergence"] = round(mean_div, 6)
+                out["decision"] = {"verdict": verdict, "reason": reason}
+            return out

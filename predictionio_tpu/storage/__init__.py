@@ -33,6 +33,7 @@ from .metadata import (
     EngineManifest,
     EvaluationInstance,
     MetadataStore,
+    RolloutPlan,
     new_engine_instance,
 )
 from .model_store import LocalFSModelStore, Model, ModelStore, SqliteModelStore
@@ -61,6 +62,7 @@ __all__ = [
     "Model",
     "ModelStore",
     "PropertyMap",
+    "RolloutPlan",
     "STATUS_COMPLETED",
     "STATUS_EVALCOMPLETED",
     "STATUS_EVALUATING",

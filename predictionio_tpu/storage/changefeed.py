@@ -63,6 +63,7 @@ METADATA_MUTATING_METHODS = frozenset(
         "engine_instance_delete",
         "evaluation_instance_insert",
         "evaluation_instance_update",
+        "rollout_plan_upsert",
     }
 )
 
@@ -196,7 +197,11 @@ class Changefeed:
                 return None  # IntegrityError path: no state change
             field = "id" if method == "app_insert" else "key"
             return [dataclasses.replace(args[0], **{field: result})] + args[1:]
-        if method in ("engine_instance_insert", "evaluation_instance_insert"):
+        if method in (
+            "engine_instance_insert",
+            "evaluation_instance_insert",
+            "rollout_plan_upsert",
+        ):
             return [dataclasses.replace(args[0], id=result)] + args[1:]
         if result is False:
             return None  # update/delete that matched no row

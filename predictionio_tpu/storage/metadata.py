@@ -35,6 +35,17 @@ STATUS_COMPLETED = "COMPLETED"
 STATUS_EVALUATING = "EVALUATING"
 STATUS_EVALCOMPLETED = "EVALCOMPLETED"
 
+# RolloutPlan lifecycle stages (docs/rollouts.md). SHADOW and CANARY are
+# the in-flight stages a restarted query server resumes; the terminal
+# stages are the durable outcome the fleet audits after the fact.
+ROLLOUT_SHADOW = "SHADOW"
+ROLLOUT_CANARY = "CANARY"
+ROLLOUT_LIVE = "LIVE"
+ROLLOUT_ROLLED_BACK = "ROLLED_BACK"
+ROLLOUT_ABORTED = "ABORTED"
+ROLLOUT_ACTIVE_STAGES = (ROLLOUT_SHADOW, ROLLOUT_CANARY)
+ROLLOUT_TERMINAL_STAGES = (ROLLOUT_LIVE, ROLLOUT_ROLLED_BACK, ROLLOUT_ABORTED)
+
 
 @dataclasses.dataclass(frozen=True)
 class App:
@@ -84,6 +95,34 @@ class EngineInstance:
     preparator_params: str = ""
     algorithms_params: str = ""
     serving_params: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutPlan:
+    """Durable record of one staged deploy (``docs/rollouts.md``).
+
+    The rollout plane's source of truth: a query server restarted
+    mid-canary re-resolves the active plan for its engine tuple and
+    resumes the same sticky split (``salt`` + ``percent`` are the whole
+    routing function, so the assignment survives process death and the
+    HA read-failover path). ``gates`` holds the resolved
+    :class:`~predictionio_tpu.rollout.plan.GateConfig` values;
+    ``history`` appends one ``{"stage", "atMs", "reason"}`` entry per
+    transition — the audit trail the dashboard renders."""
+
+    id: str
+    stage: str
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    baseline_instance_id: str
+    candidate_instance_id: str
+    percent: float
+    salt: str
+    created_time: _dt.datetime
+    updated_time: _dt.datetime
+    gates: Dict[str, float] = dataclasses.field(default_factory=dict)
+    history: List[dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,6 +177,16 @@ CREATE TABLE IF NOT EXISTS pio_evaluation_instances (
   evaluator_results_json TEXT NOT NULL DEFAULT '');
 CREATE TABLE IF NOT EXISTS pio_sequences (
   name TEXT PRIMARY KEY, value INTEGER NOT NULL);
+CREATE TABLE IF NOT EXISTS pio_rollout_plans (
+  id TEXT PRIMARY KEY, stage TEXT NOT NULL,
+  engine_id TEXT NOT NULL, engine_version TEXT NOT NULL,
+  engine_variant TEXT NOT NULL,
+  baseline_instance_id TEXT NOT NULL,
+  candidate_instance_id TEXT NOT NULL,
+  percent REAL NOT NULL, salt TEXT NOT NULL,
+  created_ms INTEGER NOT NULL, updated_ms INTEGER NOT NULL,
+  gates TEXT NOT NULL DEFAULT '{}',
+  history TEXT NOT NULL DEFAULT '[]');
 """
 
 
@@ -418,6 +467,111 @@ class MetadataStore:
             )
             self._conn.commit()
             return cur.rowcount > 0
+
+    # -- rollout plans (docs/rollouts.md) ----------------------------------
+    def rollout_plan_upsert(self, plan: RolloutPlan) -> str:
+        """Insert-or-replace one plan; mints ``RO-...`` ids for blank
+        ones. Every state transition goes through here, so replication
+        (``storage/changefeed.py``) ships each transition like any other
+        metadata mutation.
+
+        Ids are random, not sequential: a sequence counter does not
+        replicate through the changefeed (replayed upserts carry their
+        resolved id), so after a replica promotion a counter-minted id
+        would collide with a replicated plan and ``INSERT OR REPLACE``
+        would silently destroy its audit history. Ordering comes from
+        ``updated_ms``, not the id."""
+        pid = plan.id or f"RO-{secrets.token_hex(6)}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO pio_rollout_plans "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    pid,
+                    plan.stage,
+                    plan.engine_id,
+                    plan.engine_version,
+                    plan.engine_variant,
+                    plan.baseline_instance_id,
+                    plan.candidate_instance_id,
+                    float(plan.percent),
+                    plan.salt,
+                    _ms(plan.created_time),
+                    _ms(plan.updated_time),
+                    json.dumps(plan.gates),
+                    json.dumps(list(plan.history)),
+                ),
+            )
+            self._conn.commit()
+        return pid
+
+    def _row_to_rollout_plan(self, row) -> RolloutPlan:
+        return RolloutPlan(
+            id=row[0],
+            stage=row[1],
+            engine_id=row[2],
+            engine_version=row[3],
+            engine_variant=row[4],
+            baseline_instance_id=row[5],
+            candidate_instance_id=row[6],
+            percent=row[7],
+            salt=row[8],
+            created_time=_from_ms(row[9]),
+            updated_time=_from_ms(row[10]),
+            gates=json.loads(row[11]),
+            history=json.loads(row[12]),
+        )
+
+    def rollout_plan_get(self, id: str) -> Optional[RolloutPlan]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM pio_rollout_plans WHERE id = ?", (id,)
+            ).fetchone()
+        return self._row_to_rollout_plan(row) if row else None
+
+    def rollout_plan_get_all(self) -> List[RolloutPlan]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM pio_rollout_plans "
+                "ORDER BY updated_ms DESC, id DESC"
+            ).fetchall()
+        return [self._row_to_rollout_plan(r) for r in rows]
+
+    def rollout_plan_get_latest(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[RolloutPlan]:
+        """Most recently updated plan for one engine tuple, any stage —
+        how a restarting server learns a ROLLED_BACK candidate must not
+        be implicitly redeployed as the latest-completed instance."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM pio_rollout_plans WHERE "
+                "engine_id = ? AND engine_version = ? AND engine_variant = ? "
+                "ORDER BY updated_ms DESC LIMIT 1",
+                (engine_id, engine_version, engine_variant),
+            ).fetchone()
+        return self._row_to_rollout_plan(row) if row else None
+
+    def rollout_plan_get_active(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[RolloutPlan]:
+        """The in-flight (SHADOW/CANARY) plan for one engine tuple —
+        what a restarted query server resumes. ``start`` refuses to open
+        a second plan while one is active, so at most one row matches."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM pio_rollout_plans WHERE stage IN (?, ?) AND "
+                "engine_id = ? AND engine_version = ? AND engine_variant = ? "
+                "ORDER BY updated_ms DESC LIMIT 1",
+                (
+                    ROLLOUT_SHADOW,
+                    ROLLOUT_CANARY,
+                    engine_id,
+                    engine_version,
+                    engine_variant,
+                ),
+            ).fetchone()
+        return self._row_to_rollout_plan(row) if row else None
 
     # -- evaluation instances ----------------------------------------------
     def evaluation_instance_insert(self, inst: EvaluationInstance) -> str:

@@ -101,6 +101,11 @@ METADATA_RPC_METHODS = frozenset(
         "evaluation_instance_get",
         "evaluation_instance_get_completed",
         "evaluation_instance_update",
+        "rollout_plan_upsert",
+        "rollout_plan_get",
+        "rollout_plan_get_all",
+        "rollout_plan_get_active",
+        "rollout_plan_get_latest",
     }
 )
 
@@ -121,6 +126,10 @@ METADATA_READ_METHODS = frozenset(
         "engine_instance_get_latest_completed",
         "evaluation_instance_get",
         "evaluation_instance_get_completed",
+        "rollout_plan_get",
+        "rollout_plan_get_all",
+        "rollout_plan_get_active",
+        "rollout_plan_get_latest",
     }
 )
 

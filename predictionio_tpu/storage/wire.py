@@ -22,11 +22,19 @@ from .metadata import (
     EngineInstance,
     EngineManifest,
     EvaluationInstance,
+    RolloutPlan,
 )
 
 _RECORD_TYPES: Dict[str, Type] = {
     cls.__name__: cls
-    for cls in (App, AccessKey, EngineManifest, EngineInstance, EvaluationInstance)
+    for cls in (
+        App,
+        AccessKey,
+        EngineManifest,
+        EngineInstance,
+        EvaluationInstance,
+        RolloutPlan,
+    )
 }
 
 

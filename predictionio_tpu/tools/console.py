@@ -184,6 +184,67 @@ def status(registry: StorageRegistry) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# rollout console (docs/rollouts.md) — thin HTTP client over the query
+# server's /rollout routes, like undeploy over /stop
+# ---------------------------------------------------------------------------
+
+
+def _rollout_request(
+    ip: str, port: int, method: str, path: str, body: Optional[dict] = None
+) -> dict:
+    url = f"http://{ip}:{port}{path}"
+    data = json.dumps(body).encode("utf-8") if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as exc:
+        raw = exc.read().decode("utf-8", "replace")
+        try:
+            message = json.loads(raw).get("message", raw)
+        except ValueError:
+            message = raw
+        raise RuntimeError(
+            f"query server answered {exc.code}: {message}"
+        ) from exc
+    except (urllib.error.URLError, OSError) as exc:
+        raise RuntimeError(f"no query server at {url}: {exc}") from exc
+
+
+def rollout_command(args: argparse.Namespace) -> dict:
+    """``pio rollout start|status|promote|abort``."""
+    sub = args.rollout_command
+    if sub == "start":
+        gates = {}
+        for item in args.gate:
+            key, sep, value = item.partition("=")
+            if not sep:
+                raise ValueError(f"bad --gate {item!r}: expected KEY=VALUE")
+            gates[key.strip()] = float(value)
+        body: dict = {}
+        if args.instance_id:
+            body["instanceId"] = args.instance_id
+        if args.percent is not None:
+            body["percent"] = args.percent
+        if gates:
+            body["gates"] = gates
+        return _rollout_request(args.ip, args.port, "POST", "/rollout/start", body)
+    if sub == "status":
+        return _rollout_request(args.ip, args.port, "GET", "/rollout.json")
+    if sub == "promote":
+        return _rollout_request(
+            args.ip, args.port, "POST", "/rollout/promote",
+            {"reason": args.reason},
+        )
+    return _rollout_request(
+        args.ip, args.port, "POST", "/rollout/abort", {"reason": args.reason}
+    )
+
+
+# ---------------------------------------------------------------------------
 # CLI grammar + dispatch
 # ---------------------------------------------------------------------------
 
@@ -254,6 +315,45 @@ def build_parser() -> argparse.ArgumentParser:
     ud = sub.add_parser("undeploy", help="stop a running query server")
     ud.add_argument("--ip", default="localhost")
     ud.add_argument("--port", type=int, default=8000)
+
+    ro = sub.add_parser(
+        "rollout",
+        help="staged deploys against a running query server: shadow -> "
+        "canary -> live with metric gates (docs/rollouts.md)",
+    )
+    ro_sub = ro.add_subparsers(dest="rollout_command", required=True)
+    ro_start = ro_sub.add_parser(
+        "start", help="load a candidate instance and enter SHADOW"
+    )
+    ro_start.add_argument(
+        "--instance-id", default=None,
+        help="candidate engine instance (default: latest COMPLETED newer "
+        "than the deployed baseline)",
+    )
+    ro_start.add_argument(
+        "--percent", type=float, default=None,
+        help="canary traffic share (default 10)",
+    )
+    ro_start.add_argument(
+        "--gate", action="append", default=[], metavar="KEY=VALUE",
+        help="gate override, repeatable (window_s, min_samples, "
+        "max_error_rate_delta, max_p99_latency_ratio, max_divergence, "
+        "shadow_hold_s, canary_hold_s, canary_percent)",
+    )
+    ro_sub.add_parser("status", help="active plan, windows, gate verdict")
+    ro_prom = ro_sub.add_parser(
+        "promote", help="advance one stage regardless of gates"
+    )
+    ro_prom.add_argument("--reason", default="manual promote")
+    ro_abort = ro_sub.add_parser(
+        "abort", help="retire the candidate; baseline takes 100%%"
+    )
+    ro_abort.add_argument("--reason", default="manual abort")
+    for sp in (ro_start, ro_prom, ro_abort) + tuple(
+        [ro_sub.choices["status"]]
+    ):
+        sp.add_argument("--ip", default="localhost")
+        sp.add_argument("--port", type=int, default=8000)
 
     es = sub.add_parser("eventserver", help="run the event REST server")
     es.add_argument("--ip", default="localhost")
@@ -651,6 +751,10 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
 
     if cmd == "undeploy":
         _emit(undeploy(args.ip, args.port))
+        return EXIT_OK
+
+    if cmd == "rollout":
+        _emit(rollout_command(args))
         return EXIT_OK
 
     if cmd == "eventserver":

@@ -97,6 +97,47 @@ def render_train_runs(instances) -> str:
     )
 
 
+def render_rollouts(plans) -> str:
+    """``GET /rollouts``: every RolloutPlan newest-first — the staged
+    deploys' audit trail (stage, split, gate verdicts that drove each
+    transition; ``docs/rollouts.md``)."""
+    rows = []
+    for plan in plans:
+        last = plan.history[-1] if plan.history else {}
+        rows.append(
+            "<tr>"
+            f"<td>{html.escape(plan.id)}</td>"
+            f"<td>{html.escape(plan.stage)}</td>"
+            f"<td>{html.escape(plan.engine_id)} "
+            f"{html.escape(plan.engine_version)}</td>"
+            f"<td>{html.escape(plan.baseline_instance_id)}</td>"
+            f"<td>{html.escape(plan.candidate_instance_id)}</td>"
+            f"<td>{plan.percent:g}%</td>"
+            f"<td>{_fmt_time(plan.updated_time)}</td>"
+            f"<td>{html.escape(str(last.get('reason', '-')))}</td>"
+            "</tr>"
+        )
+    return (
+        "<!DOCTYPE html><html><head><title>Rollouts</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+        "<h1>Rollouts</h1>"
+        "<table><tr><th>ID</th><th>Stage</th><th>Engine</th>"
+        "<th>Baseline</th><th>Candidate</th><th>Canary %</th>"
+        "<th>Updated</th><th>Last transition</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+def rollouts_json(plans) -> list:
+    """Machine-readable twin of ``/rollouts`` — the same wire shape the
+    query server's ``/rollout.json`` uses (``rollout/plan.py``)."""
+    from ..rollout.plan import plan_to_json
+
+    return [plan_to_json(plan) for plan in plans]
+
+
 def train_runs_json(instances) -> list:
     """Machine-readable twin of ``/train_runs``."""
     from ..utils.profiling import phases_from_env
@@ -149,6 +190,16 @@ class _DashboardHandler(JsonHTTPHandler):
             self.respond(
                 200, train_runs_json(md.engine_instance_get_all())
             )
+            return
+        if path == "/rollouts":
+            self.respond(
+                200,
+                render_rollouts(md.rollout_plan_get_all()),
+                content_type="text/html",
+            )
+            return
+        if path == "/rollouts.json":
+            self.respond(200, rollouts_json(md.rollout_plan_get_all()))
             return
         parts = [p for p in path.split("/") if p]
         if len(parts) == 3 and parts[0] == "engine_instances":

@@ -399,6 +399,133 @@ def run_storage_chaos(
                     pass
 
 
+def run_rollout_chaos(
+    engine=None,
+    registry=None,
+    engine_dir: str = ".",
+    baseline_instance_id: Optional[str] = None,
+    candidate_instance_id: Optional[str] = None,
+    payload_template: str = '{"user": "{i}", "num": 10}',
+    queries_per_phase: int = 40,
+    percent: float = 50.0,
+    gates: Optional[dict] = None,
+    clock=None,
+) -> dict:
+    """Rollout chaos scenario (``--rollout``, docs/rollouts.md).
+
+    Builds an in-process query server, starts a rollout (candidate in
+    SHADOW next to the baseline), drives traffic, promotes to CANARY,
+    then arms the deterministic fault harness at ``serving.candidate``
+    so every candidate-routed prediction fails — and asserts the
+    acceptance contract: the plan **auto-rolls back** on the error-rate
+    gate, **zero** requests fail client-side (canary containment serves
+    every faulted request from the baseline), the baseline takes 100% of
+    subsequent traffic, and the terminal ``ROLLED_BACK`` state is
+    durably recorded in metadata.
+
+    Deterministic by construction: stage changes ride explicit promote
+    + gate-driven rollback (no hold-timer waits), faults come from
+    ``testing/faults``, and shadow duplicates are drained, so the tier-1
+    wiring (``tests/test_rollout.py``) needs no wall-clock sleeps.
+    """
+    import time as _time
+
+    from ..storage.registry import get_registry
+    from ..testing import faults
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    if engine is None:
+        from ..workflow import loader
+        from .register import load_engine_dir
+
+        ed = load_engine_dir(engine_dir)
+        engine = loader.get_engine(ed.engine_factory, search_dir=ed.path)
+    registry = registry or get_registry()
+
+    payloads = [json.loads(p) for p in _expand_payloads(payload_template, 256)]
+    config = ServerConfig(
+        ip="127.0.0.1", port=0, batching=False,
+        engine_instance_id=baseline_instance_id,
+    )
+    server = QueryServer(
+        config, engine, registry, clock=clock or _time.monotonic
+    )
+    gate_cfg = {
+        "min_samples": 10,
+        "window_s": 100_000.0,
+        "shadow_hold_s": 100_000.0,     # stages advance by explicit promote
+        "canary_hold_s": 100_000.0,
+        "max_divergence": 1.0,          # divergence gate has its own tests
+        # the drill proves the ERROR gate; real wall-clock latencies in
+        # tiny windows would let scheduler jitter trip the p99 gate first
+        "max_p99_latency_ratio": 1_000.0,
+        **(gates or {}),
+    }
+    report: dict = {"mode": "rollout-chaos", "clientFailures": 0}
+    try:
+        candidate = (
+            candidate_instance_id or server.deployment.instance.id
+        )
+        status = server.rollout.start(
+            candidate_instance_id=candidate, percent=percent, gates=gate_cfg
+        )
+        report["planId"] = status["plan"]["id"]
+
+        def drive(n: int) -> dict:
+            counts = {"baseline": 0, "candidate": 0, "-": 0}
+            for i in range(n):
+                info: dict = {}
+                try:
+                    _result, http_status = server.handle_query(
+                        payloads[i % len(payloads)], info=info
+                    )
+                    if http_status != 200:
+                        report["clientFailures"] += 1
+                except Exception:
+                    report["clientFailures"] += 1
+                counts[info.get("variant", "-")] = (
+                    counts.get(info.get("variant", "-"), 0) + 1
+                )
+            return counts
+
+        drive(queries_per_phase)                     # shadow traffic
+        server.rollout.drain_shadow()
+        ctl = server.rollout.controller
+        report["shadowSamples"] = ctl.candidate.count()
+        report["meanDivergence"] = ctl.mean_divergence()
+
+        server.rollout.promote("chaos drill: shadow -> canary")
+        report["canaryStage"] = server.rollout.stage
+
+        # candidate dies mid-canary: every candidate-routed request must
+        # still answer 200 (from the baseline) and the error gate must
+        # roll the plan back on its own
+        with faults.inject(
+            faults.FaultSpec(site="serving.candidate", kind="refuse")
+        ) as plan:
+            canary_counts = drive(queries_per_phase)
+            report["candidateFaultsFired"] = plan.fired("serving.candidate")
+        report["canaryCounts"] = canary_counts
+        report["finalStage"] = server.rollout.stage
+        report["rolledBack"] = server.rollout.stage == "ROLLED_BACK"
+
+        post_counts = drive(queries_per_phase)       # after rollback
+        report["postRollbackCandidateServed"] = post_counts.get("candidate", 0)
+
+        durable = registry.get_metadata().rollout_plan_get(report["planId"])
+        report["durableStage"] = durable.stage if durable else None
+        report["ok"] = bool(
+            report["rolledBack"]
+            and report["clientFailures"] == 0
+            and report["postRollbackCandidateServed"] == 0
+            and report["durableStage"] == "ROLLED_BACK"
+            and report["candidateFaultsFired"] > 0
+        )
+        return report
+    finally:
+        server.server_close()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..utils.platform import apply_env_platform
 
@@ -429,6 +556,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "(predictionio_tpu.testing.faults) in this "
                         "process; repeatable. For a live HTTP server, "
                         "start it with PIO_FAULTS set instead.")
+    p.add_argument("--rollout", action="store_true",
+                   help="rollout chaos scenario (docs/rollouts.md): "
+                        "in-process server from --engine-dir, start "
+                        "shadow, promote to canary, fail the candidate, "
+                        "assert auto-rollback with zero client-visible "
+                        "failures and a durable ROLLED_BACK plan")
     p.add_argument("--kill-primary-at", type=int, default=None, metavar="N",
                    help="storage-plane chaos scenario: in-process "
                         "primary+replica, hard-kill the primary at op N, "
@@ -438,6 +571,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--ops", type=int, default=None,
                    help="total ops for --kill-primary-at (default 2N)")
     args = p.parse_args(argv)
+
+    if args.rollout:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_rollout_chaos(
+            engine_dir=args.engine_dir, payload_template=args.payload
+        )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
 
     if args.kill_primary_at is not None:
         result = run_storage_chaos(

@@ -57,8 +57,14 @@ from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..controller.engine import Engine, EngineParams
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import TRACE_HEADER, SpanContext, Tracer, current_context
+from ..rollout.manager import RolloutError, RolloutManager
+from ..rollout.plan import BASELINE, CANDIDATE
 from ..storage import StorageRegistry, utcnow
-from ..storage.metadata import STATUS_COMPLETED, EngineInstance
+from ..storage.metadata import (
+    ROLLOUT_SHADOW,
+    STATUS_COMPLETED,
+    EngineInstance,
+)
 from ..testing.faults import fault_point
 from ..utils.resilience import (
     DEADLINE_HEADER,
@@ -325,10 +331,12 @@ def prepare_deployment(
                 f"Engine instance {config.engine_instance_id} not found"
             )
     else:
+        # positional args: survives the metadata RPC wire ({method, args},
+        # no kwargs channel) so deploy works on remote/HA storage
         instance = md.engine_instance_get_latest_completed(
-            engine_id=config.engine_id or "default",
-            engine_version=config.engine_version or "1",
-            engine_variant=config.engine_variant,
+            config.engine_id or "default",
+            config.engine_version or "1",
+            config.engine_variant,
         )
         if instance is None:
             raise RuntimeError(
@@ -369,12 +377,29 @@ class QueryDecodeError(ValueError):
 class _QueryHandler(JsonHTTPHandler):
     server: "QueryServer"
 
+    #: every response of this server carries a variant label (closed
+    #: {-, baseline, candidate} vocabulary; "-" = no rollout involved)
+    #: so canary/shadow traffic is attributable on the shared
+    #: ``pio_http_responses_total`` series (docs/rollouts.md)
+    response_label_defaults = {"variant": "-"}
+
     def do_POST(self) -> None:  # noqa: N802
+        self.response_labels = None  # handler instances persist per-connection
         raw = self.read_body()
         path = urlparse(self.path).path
-        if path != "/queries.json":
+        if path == "/queries.json":
+            self._handle_queries(raw)
+        elif path == "/reload":
+            # reload is a state-changing op: POST is the proper verb
+            # (GET kept below for CreateServer parity, deprecated —
+            # docs/serving.md)
+            self._handle_reload()
+        elif path in ("/rollout/start", "/rollout/promote", "/rollout/abort"):
+            self._handle_rollout(path, raw)
+        else:
             self.respond(404, {"message": "Not Found"})
-            return
+
+    def _handle_queries(self, raw: bytes) -> None:
         try:
             payload = json.loads(raw.decode("utf-8")) if raw else {}
         except ValueError as exc:
@@ -396,6 +421,10 @@ class _QueryHandler(JsonHTTPHandler):
             self.headers.get(DEADLINE_HEADER), clock=self.server.clock
         )
         span = None
+        # Mutable out-channel for the serving variant: handle_query fills
+        # it, the admission span records it as a tag (the dict is read at
+        # span close), and the response counter labels it.
+        info: dict = {"variant": "-"}
         try:
             if deadline is not None:
                 # admission-stage check: a budget that is already gone
@@ -408,25 +437,85 @@ class _QueryHandler(JsonHTTPHandler):
             with self.server.tracer.server_span(
                 "POST /queries.json",
                 header_value=self.headers.get(TRACE_HEADER),
+                tags=info,
             ) as span:
-                result, status = self.server.handle_query(payload, deadline)
+                result, status = self.server.handle_query(
+                    payload, deadline, info=info
+                )
+            self.response_labels = {"variant": info["variant"]}
             self.respond(status, result, headers={TRACE_HEADER: span.trace_id})
         except DeadlineExceeded as exc:
+            self.response_labels = {"variant": info["variant"]}
             self.server.stats.inc("deadline_expired")
             self.respond(504, {"message": str(exc), "stage": exc.stage})
         except QueryDecodeError as exc:
             # the reference remote-logs the bad-query branch too
             # (CreateServer.scala:583-590)
+            self.response_labels = {"variant": info["variant"]}
             self.server.post_error_log(str(exc), payload, trace_ctx=span)
             self.respond(400, {"message": str(exc)})
         except Exception as exc:
             logger.exception("Query failed")
+            self.response_labels = {"variant": info["variant"]}
             self.server.post_error_log(str(exc), payload, trace_ctx=span)
             self.respond(500, {"message": str(exc)})
         finally:
             self.server.release()
 
+    def _handle_reload(self) -> None:
+        rollout = self.server.rollout
+        if rollout is not None and rollout.active:
+            self.respond(
+                409,
+                {
+                    "message": (
+                        f"rollout {rollout.plan.id} in progress "
+                        f"(stage {rollout.stage}); promote or abort it "
+                        "before reloading"
+                    ),
+                },
+            )
+            return
+        try:
+            self.server.reload()
+            self.respond(200, {"message": "Reloaded"})
+        except Exception as exc:
+            logger.exception("Reload failed")
+            self.respond(500, {"message": str(exc)})
+
+    def _handle_rollout(self, path: str, raw: bytes) -> None:
+        """``POST /rollout/start|promote|abort`` (docs/rollouts.md)."""
+        rollout = self.server.rollout
+        try:
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+        except ValueError as exc:
+            self.respond(400, {"message": str(exc)})
+            return
+        if not isinstance(body, dict):
+            self.respond(400, {"message": "expected a JSON object body"})
+            return
+        try:
+            if path == "/rollout/start":
+                out = rollout.start(
+                    candidate_instance_id=body.get("instanceId"),
+                    percent=body.get("percent"),
+                    gates=body.get("gates"),
+                )
+            elif path == "/rollout/promote":
+                out = rollout.promote(body.get("reason", "manual promote"))
+            else:
+                out = rollout.abort(body.get("reason", "manual abort"))
+            self.respond(200, out)
+        except RolloutError as exc:
+            self.respond(409, {"message": str(exc)})
+        except ValueError as exc:  # e.g. an unknown gate option
+            self.respond(400, {"message": str(exc)})
+        except Exception as exc:
+            logger.exception("rollout %s failed", path)
+            self.respond(500, {"message": str(exc)})
+
     def do_GET(self) -> None:  # noqa: N802
+        self.response_labels = None  # handler instances persist per-connection
         path = urlparse(self.path).path
         if self.serve_obs(path):  # /metrics + /traces.json
             return
@@ -442,13 +531,12 @@ class _QueryHandler(JsonHTTPHandler):
                 self.respond(
                     200, self.server.status_html(), content_type="text/html"
                 )
+        elif path == "/rollout.json":
+            self.respond(200, self.server.rollout.status())
         elif path == "/reload":
-            try:
-                self.server.reload()
-                self.respond(200, {"message": "Reloaded"})
-            except Exception as exc:
-                logger.exception("Reload failed")
-                self.respond(500, {"message": str(exc)})
+            # deprecated spelling (state change behind a GET), kept for
+            # PredictionIO CreateServer parity — use POST /reload
+            self._handle_reload()
         elif path == "/stop":
             self.respond(200, {"message": "Shutting down"})
             self.server.stop_async()
@@ -565,6 +653,18 @@ class QueryServer(BackgroundHTTPServer):
             tracer=tracer,
         )
         self._export_train_phases()
+        # Rollout plane (docs/rollouts.md): the manager owns any staged
+        # deploy of this engine. resume() re-resolves an active plan
+        # from metadata, so a server restarted mid-canary keeps the
+        # exact same sticky split; a broken plan degrades to plain
+        # baseline serving, never a failed boot.
+        self.rollout = RolloutManager(self)
+        try:
+            self.rollout.resume()
+        except Exception:
+            logger.exception(
+                "rollout resume failed; serving the baseline only"
+            )
 
     # Pre-resilience attribute surface, kept for callers/tests that read
     # the counters straight off the server object.
@@ -618,12 +718,119 @@ class QueryServer(BackgroundHTTPServer):
 
     # -- query path (CreateServer.scala:458-577) --------------------------
     def handle_query(
-        self, payload: Any, deadline: Optional[Deadline] = None
+        self,
+        payload: Any,
+        deadline: Optional[Deadline] = None,
+        info: Optional[dict] = None,
     ) -> Tuple[Any, int]:
+        """One query end to end. ``info`` (when given) is filled with the
+        serving ``variant`` (and ``fallback`` on candidate containment)
+        — the handler forwards it into span tags and response labels."""
         started = time.monotonic()
         query_time = utcnow()
-        with self._deploy_lock:
-            dep = self.deployment
+        rollout = self.rollout
+        if rollout is not None:
+            # land any transition whose metadata write failed — terminal
+            # transitions have no later observe() to ride
+            rollout.retry_pending_persist()
+        rollout_active = rollout is not None and rollout.active
+        variant = BASELINE
+        variant_started = started
+        dep = None
+        if rollout_active:
+            # Deterministic sticky split (docs/rollouts.md): CANARY
+            # routes the plan's percent of entity keys to the candidate;
+            # SHADOW always serves baseline (the duplicate is async).
+            variant = rollout.variant_for(payload)
+            if variant == CANDIDATE:
+                dep = rollout.candidate_deployment()
+                if dep is None:  # rollback won a race: serve baseline
+                    variant = BASELINE
+        if dep is None:
+            with self._deploy_lock:
+                dep = self.deployment
+        if info is not None and rollout_active:
+            info["variant"] = variant
+        try:
+            query, prediction = self._serve_one(dep, payload, deadline, variant)
+        except DeadlineExceeded as exc:
+            # An exhausted budget cannot be re-served from the baseline,
+            # but a serving variant that burns client deadlines must feed
+            # its error window, or a too-slow canary never rolls back.
+            # Only the batch-wait stage is the variant's doing — a budget
+            # already gone at admission/dispatch is the client's. Both
+            # variants record, so the delta gate stays a *delta*.
+            if rollout_active and exc.stage == "batch-wait":
+                rollout.observe(variant, time.monotonic() - started, ok=False)
+            raise
+        except Exception:
+            if variant != CANDIDATE:
+                # Baseline failures count too: errors the whole fleet is
+                # suffering (shared dependency down, malformed client
+                # traffic) must raise BOTH windows' error rates, or the
+                # delta gate degenerates into an absolute candidate
+                # threshold and rolls back a healthy canary.
+                if rollout_active:
+                    rollout.observe(
+                        BASELINE, time.monotonic() - started, ok=False
+                    )
+                raise
+            # Canary containment: a sick candidate is a *rollout* signal
+            # (counted against its error gate), never a client error —
+            # the same request is re-served from the resident baseline.
+            # QueryDecodeError included: a query the candidate's
+            # algorithms cannot decode is a candidate defect.
+            rollout.observe(CANDIDATE, time.monotonic() - started, ok=False)
+            logger.exception(
+                "candidate %s failed; serving baseline", dep.instance.id
+            )
+            variant = BASELINE
+            variant_started = time.monotonic()  # gate windows see only
+            # the baseline's own work, not the failed candidate attempt
+            if info is not None:
+                info["variant"] = variant
+                info["fallback"] = True
+            with self._deploy_lock:
+                dep = self.deployment
+            try:
+                query, prediction = self._serve_one(
+                    dep, payload, deadline, variant
+                )
+            except Exception:
+                if rollout_active:  # the fallback itself failed: baseline's
+                    rollout.observe(
+                        BASELINE, time.monotonic() - variant_started, ok=False
+                    )
+                raise
+        result = encode_result(prediction)
+
+        # Shadow duplication BEFORE the feedback prId stamp: divergence
+        # must compare model outputs, not the per-request id noise.
+        if rollout_active and rollout.stage == ROLLOUT_SHADOW:
+            rollout.submit_shadow(payload, result)
+
+        if self.config.feedback:
+            result = self._send_feedback(
+                dep, query_time, query, prediction, result, variant
+            )
+
+        now = time.monotonic()
+        if rollout_active:
+            rollout.observe(variant, now - variant_started, ok=True)
+        self.stats.record_request(now - started)
+        return result, 200
+
+    def _serve_one(
+        self,
+        dep: Deployment,
+        payload: Any,
+        deadline: Optional[Deadline],
+        variant: str,
+    ) -> Tuple[Any, Any]:
+        """Decode → supplement → (batched) predict → combine against ONE
+        deployment; the shared path under the live request, the canary
+        fallback retry, and a shadow duplicate. Returns
+        ``(query, prediction)``."""
         with deadline_scope(deadline):
             try:
                 query = decode_query(dep.algorithms, payload)
@@ -634,6 +841,11 @@ class QueryServer(BackgroundHTTPServer):
                 # the load-shed moment that matters most: an expired query
                 # must never occupy a device slot (ISSUE 2 tentpole)
                 deadline.check("dispatch")
+            if variant == CANDIDATE:
+                # chaos hook: the loadgen --rollout scenario fails the
+                # candidate exactly here, proving auto-rollback with
+                # zero client-visible failures (docs/rollouts.md)
+                fault_point("serving.candidate", instance=dep.instance.id)
             if self._batcher is not None:
                 try:
                     predictions = self._batcher.submit(
@@ -652,13 +864,7 @@ class QueryServer(BackgroundHTTPServer):
             else:
                 predictions = self._predict_one(dep, query)
             prediction = dep.serving.serve(query, predictions)
-            result = encode_result(prediction)
-
-        if self.config.feedback:
-            result = self._send_feedback(dep, query_time, query, prediction, result)
-
-        self.stats.record_request(time.monotonic() - started)
-        return result, 200
+        return query, prediction
 
     def _post_json(
         self,
@@ -795,9 +1001,12 @@ class QueryServer(BackgroundHTTPServer):
         query: Any,
         prediction: Any,
         result: Any,
+        variant: str = BASELINE,
     ) -> Any:
         """Async ``predict`` event to the Event Server
-        (``CreateServer.scala:505-565``)."""
+        (``CreateServer.scala:505-565``). The event carries the serving
+        ``variant`` so offline evaluation can score canary vs. baseline
+        straight from the event store (docs/rollouts.md)."""
         existing = _get_pr_id(prediction)
         new_pr_id = existing if existing else _gen_pr_id()
         data = {
@@ -809,6 +1018,7 @@ class QueryServer(BackgroundHTTPServer):
                 "engineInstanceId": dep.instance.id,
                 "query": encode_result(query),
                 "prediction": encode_result(prediction),
+                "variant": variant,
             },
             # prId is unique per prediction, so it doubles as the event's
             # idempotency key: the RetryPolicy may replay this POST after
@@ -868,12 +1078,35 @@ class QueryServer(BackgroundHTTPServer):
         if self._batcher is not None:
             self._batcher.close()  # fail queued requests fast, join thread
         self._feedback_pool.shutdown(wait=False)
+        if getattr(self, "rollout", None) is not None:
+            self.rollout.close()
         super().server_close()
+
+    def _adopt_deployment(self, dep: Deployment) -> None:
+        """Install ``dep`` as THE serving deployment (rollout go-live,
+        docs/rollouts.md). The retired deployment's last server-side
+        reference dies with the swap, so its model buffers are
+        reclaimable; in-flight queries finish on the deployment they
+        were routed to (they hold their own reference through the
+        micro-batch items)."""
+        with self._deploy_lock:
+            old = self.deployment.instance.id
+            self.deployment = dep
+        self._export_train_phases()
+        logger.info(
+            "Deployment swapped: engine instance %s -> %s",
+            old, dep.instance.id,
+        )
 
     def reload(self) -> None:
         """Hot-swap to the latest completed instance
         (``CreateServer.scala:300-321``): the new tables are staged first,
         then the references swap under the lock.
+
+        Refused while a rollout is in flight: the latest completed
+        instance IS the rollout's candidate, and loading it as the
+        baseline would corrupt the split — promote or abort instead
+        (docs/rollouts.md).
 
         Failures (storage down, corrupt instance) ride
         ``reload_breaker``: the resident last-good tables keep serving
@@ -881,6 +1114,12 @@ class QueryServer(BackgroundHTTPServer):
         failures open the breaker so reload storms fast-fail, and the
         status page shows ``degraded: true`` until a probe reload
         succeeds."""
+        rollout = getattr(self, "rollout", None)
+        if rollout is not None and rollout.active:
+            raise RuntimeError(
+                f"rollout {rollout.plan.id} in progress (stage "
+                f"{rollout.stage}); promote or abort it before reloading"
+            )
         cfg = dataclasses.replace(
             self.config,
             engine_instance_id=None,
@@ -946,6 +1185,8 @@ class QueryServer(BackgroundHTTPServer):
         }
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
+        if getattr(self, "rollout", None) is not None:
+            out["rollout"] = self.rollout.status()
         from ..utils.profiling import phases_from_env
 
         phases = phases_from_env(dep.instance.env)
@@ -969,6 +1210,15 @@ class QueryServer(BackgroundHTTPServer):
             ("Average serving time", f"{stats['avgServingMs']:.3f} ms"),
             ("Last serving time", f"{stats['lastServingMs']:.3f} ms"),
             ("Degraded", str(self.degraded)),
+            (
+                "Rollout",
+                (
+                    f"{self.rollout.plan.id} stage={self.rollout.stage}"
+                    if getattr(self, "rollout", None) is not None
+                    and self.rollout.plan is not None
+                    else "none"
+                ),
+            ),
             ("Shed requests", str(stats["shed"])),
             ("Expired deadlines", str(stats["deadlineExpired"])),
             (

@@ -197,6 +197,25 @@ def test_reload_under_traffic(server):
     assert ok[0] > 20  # real traffic flowed throughout
 
 
+def test_repeated_reloads_drop_retired_model_references(server):
+    """Swapping a ``Deployment`` must drop every server-side reference
+    to the retired models so device buffers are reclaimable — a leak
+    here grows resident HBM by one model table per retrain forever
+    (docs/rollouts.md teardown contract)."""
+    import gc
+    import weakref
+
+    base, srv, registry, engine = server
+    retired = []
+    for _ in range(3):
+        retired.append(weakref.ref(srv.deployment.models[0]))
+        _train(registry, engine, algo_ids=(11, 13))
+        r = requests.post(f"{base}/reload")
+        assert r.status_code == 200
+    gc.collect()
+    assert [ref() for ref in retired] == [None, None, None]
+
+
 def test_stop_shuts_down(server):
     base, srv, _, _ = server
     r = requests.get(f"{base}/stop")

@@ -1,0 +1,917 @@
+"""Rollout plane tests (``predictionio_tpu/rollout``, docs/rollouts.md).
+
+Covers the ISSUE-5 acceptance contract end to end, on injected clocks
+with zero wall-clock sleeps on the decision paths:
+
+- deterministic sticky splits (pure function; stable across process
+  restarts and across the HA metadata read-failover path);
+- gate evaluation (error-rate delta, p99 ratio, shadow divergence,
+  hold timers) on a fake clock;
+- the durable ``RolloutPlan`` DAO + replication through the changefeed;
+- the full state machine: shadow → canary(10%) → live when gates pass,
+  auto-rollback from canary when the candidate fails (zero
+  client-visible failures), terminal state durable across a server
+  restart, rolled-back candidates quarantined from implicit redeploy;
+- deployment teardown: retired deployments drop their model references
+  (no resident-model leak across swaps);
+- the serving surface: POST /reload (GET kept, deprecated), /rollout
+  routes, variant-tagged feedback events, the dashboard /rollouts page,
+  and the loadgen --rollout chaos scenario.
+"""
+
+import gc
+import json
+import weakref
+
+import pytest
+import requests
+
+from predictionio_tpu.controller import WorkflowParams
+from predictionio_tpu.rollout.controller import RolloutController
+from predictionio_tpu.rollout.plan import (
+    BASELINE,
+    CANDIDATE,
+    GateConfig,
+    prediction_divergence,
+    sticky_key,
+    variant_for_key,
+)
+from predictionio_tpu.storage import (
+    MetadataStore,
+    RolloutPlan,
+    SqliteEventStore,
+    StorageRegistry,
+    utcnow,
+)
+from predictionio_tpu.storage.changefeed import Changefeed, apply_op
+from predictionio_tpu.storage.metadata import (
+    ROLLOUT_CANARY,
+    ROLLOUT_LIVE,
+    ROLLOUT_ROLLED_BACK,
+    ROLLOUT_SHADOW,
+)
+from predictionio_tpu.storage.model_store import SqliteModelStore
+from predictionio_tpu.storage.oplog import OpLog
+from predictionio_tpu.testing import faults
+from predictionio_tpu.workflow.core_workflow import run_train
+from predictionio_tpu.workflow.serving import QueryServer, ServerConfig
+
+from sample_engine import reset_all_counts
+from test_engine import make_engine, make_params
+
+
+class FakeClock:
+    """Injectable monotonic clock."""
+
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_all_counts()
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+
+
+def _train(registry, engine, algo_id=11):
+    return run_train(
+        engine,
+        make_params(algo_ids=(algo_id,)),
+        registry,
+        engine_id="default",
+        engine_version="1",
+        workflow_params=WorkflowParams(batch="rollout-test"),
+    )
+
+
+def _server(registry, engine, clock, instance_id=None, **config_kw):
+    return QueryServer(
+        ServerConfig(
+            ip="127.0.0.1",
+            port=0,
+            batching=False,
+            engine_instance_id=instance_id,
+            **config_kw,
+        ),
+        engine,
+        registry,
+        clock=clock,
+    )
+
+
+#: Tight gates that converge in a handful of queries. The latency gate
+#: is effectively disabled: these e2e tests record REAL wall-clock
+#: latencies into tiny windows, and scheduler jitter on a loaded test
+#: host can push one variant's p99 past any honest ratio — the gate's
+#: logic is pinned deterministically in TestRolloutController instead.
+def _gates(**overrides):
+    g = {
+        "min_samples": 5,
+        "window_s": 100_000.0,
+        "shadow_hold_s": 10.0,
+        "canary_hold_s": 10.0,
+        "max_divergence": 1.0,
+        "max_p99_latency_ratio": 1_000.0,
+    }
+    g.update(overrides)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# sticky split + divergence (pure functions)
+# ---------------------------------------------------------------------------
+
+
+class TestStickySplit:
+    def test_deterministic_and_percent_bounded(self):
+        keys = [f"user={i}" for i in range(2000)]
+        first = {k: variant_for_key("salt-a", k, 10.0) for k in keys}
+        second = {k: variant_for_key("salt-a", k, 10.0) for k in keys}
+        assert first == second  # pure function: restart-stable for free
+        share = sum(1 for v in first.values() if v == CANDIDATE) / len(keys)
+        assert 0.05 < share < 0.15  # ~10% of keys
+
+    def test_percent_edges(self):
+        assert variant_for_key("s", "k", 0) == BASELINE
+        assert variant_for_key("s", "k", 100) == CANDIDATE
+
+    def test_salt_rotates_the_sampled_subset(self):
+        keys = [f"user={i}" for i in range(500)]
+        a = {k for k in keys if variant_for_key("salt-a", k, 20.0) == CANDIDATE}
+        b = {k for k in keys if variant_for_key("salt-b", k, 20.0) == CANDIDATE}
+        assert a != b  # consecutive rollouts don't reuse one cohort
+
+    def test_sticky_key_prefers_entity_fields(self):
+        assert sticky_key({"user": "7", "num": 10}) == "user=7"
+        assert sticky_key({"entityId": 3}) == "entityId=3"
+        # no conventional field: canonicalized payload, still deterministic
+        assert sticky_key({"z": 1, "a": 2}) == sticky_key({"a": 2, "z": 1})
+
+
+class TestDivergence:
+    def test_identical_is_zero(self):
+        result = {"items": [{"item": "a", "score": 1.5}], "n": 3}
+        assert prediction_divergence(result, result) == 0.0
+
+    def test_disjoint_is_one(self):
+        assert prediction_divergence({"a": 1}, {"b": 2}) == 1.0
+
+    def test_numeric_relative_distance(self):
+        d = prediction_divergence({"score": 1.0}, {"score": 3.0})
+        assert d == pytest.approx(2.0 / 4.0)
+
+    def test_rank_shift_counts(self):
+        a = {"items": ["x", "y"]}
+        b = {"items": ["y", "x"]}
+        assert prediction_divergence(a, b) == 1.0
+        assert prediction_divergence(a, a) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# gate controller (injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutController:
+    def _ctl(self, clock, **gates):
+        return RolloutController(GateConfig.from_dict(_gates(**gates)), clock)
+
+    def test_holds_until_samples_then_hold_timer(self):
+        clock = FakeClock()
+        ctl = self._ctl(clock)
+        verdict, reason = ctl.evaluate(ROLLOUT_SHADOW)
+        assert verdict == "hold" and "samples" in reason
+        for _ in range(5):
+            ctl.record(True, 0.01, ok=True)
+            ctl.record(False, 0.01, ok=True)
+        verdict, reason = ctl.evaluate(ROLLOUT_SHADOW)
+        assert verdict == "hold" and "holding" in reason
+        clock.advance(11)
+        verdict, _ = ctl.evaluate(ROLLOUT_SHADOW)
+        assert verdict == "promote"
+
+    def test_error_gate_rolls_back_before_hold_elapses(self):
+        ctl = self._ctl(FakeClock())
+        for _ in range(10):
+            ctl.record(False, 0.01, ok=True)
+            ctl.record(True, 0.01, ok=False)  # candidate hard-failing
+        verdict, reason = ctl.evaluate(ROLLOUT_CANARY)
+        assert verdict == "rollback" and "error-rate" in reason
+
+    def test_latency_gate(self):
+        clock = FakeClock()
+        ctl = self._ctl(clock, max_p99_latency_ratio=2.0)
+        for _ in range(20):
+            ctl.record(False, 0.010, ok=True)
+            ctl.record(True, 0.100, ok=True)  # 10x the baseline p99
+        verdict, reason = ctl.evaluate(ROLLOUT_CANARY)
+        assert verdict == "rollback" and "p99" in reason
+
+    def test_divergence_gate_shadow_only(self):
+        clock = FakeClock()
+        ctl = self._ctl(clock, max_divergence=0.25)
+        for _ in range(10):
+            ctl.record(False, 0.01, ok=True)
+            ctl.record(True, 0.01, ok=True)
+            ctl.record_divergence(0.9)
+        verdict, reason = ctl.evaluate(ROLLOUT_SHADOW)
+        assert verdict == "rollback" and "divergence" in reason
+        # the same windows in CANARY: divergence no longer gates
+        clock.advance(11)
+        verdict, _ = ctl.evaluate(ROLLOUT_CANARY)
+        assert verdict == "promote"
+
+    def test_window_expires_old_samples(self):
+        clock = FakeClock()
+        ctl = self._ctl(clock, window_s=60.0)
+        for _ in range(10):
+            ctl.record(True, 0.01, ok=False)
+        assert ctl.candidate.count() == 10
+        clock.advance(61)
+        assert ctl.candidate.count() == 0
+
+    def test_gate_config_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown gate option"):
+            GateConfig.from_dict({"max_errors": 1})
+
+
+# ---------------------------------------------------------------------------
+# durable plan DAO + changefeed replication
+# ---------------------------------------------------------------------------
+
+
+def _plan(**kw):
+    now = utcnow()
+    defaults = dict(
+        id="",
+        stage=ROLLOUT_SHADOW,
+        engine_id="default",
+        engine_version="1",
+        engine_variant="engine.json",
+        baseline_instance_id="EI-base",
+        candidate_instance_id="EI-cand",
+        percent=10.0,
+        salt="abc123",
+        created_time=now,
+        updated_time=now,
+        gates={"min_samples": 5.0},
+        history=[{"stage": ROLLOUT_SHADOW, "atMs": 1, "reason": "start"}],
+    )
+    defaults.update(kw)
+    return RolloutPlan(**defaults)
+
+
+class TestRolloutPlanDAO:
+    def test_roundtrip_and_active_selection(self, metadata_store):
+        md = metadata_store
+        pid = md.rollout_plan_upsert(_plan())
+        assert pid.startswith("RO-")
+        got = md.rollout_plan_get(pid)
+        assert got.salt == "abc123"
+        assert got.gates == {"min_samples": 5.0}
+        assert got.history[0]["reason"] == "start"
+        active = md.rollout_plan_get_active("default", "1", "engine.json")
+        assert active is not None and active.id == pid
+        # terminal stages are not "active" but remain the latest
+        md.rollout_plan_upsert(
+            _plan(id=pid, stage=ROLLOUT_ROLLED_BACK)
+        )
+        assert md.rollout_plan_get_active("default", "1", "engine.json") is None
+        latest = md.rollout_plan_get_latest("default", "1", "engine.json")
+        assert latest.id == pid and latest.stage == ROLLOUT_ROLLED_BACK
+        assert [p.id for p in md.rollout_plan_get_all()] == [pid]
+
+    def test_upsert_replicates_through_changefeed(self, tmp_path):
+        src = (
+            SqliteEventStore(":memory:"),
+            MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        cf = Changefeed(OpLog(str(tmp_path / "oplog")), *src)
+        pid, seq = cf.metadata_rpc("rollout_plan_upsert", [_plan()])
+        assert seq is not None  # every transition ships a change
+        # replay the feed into a fresh replica store: the logged op
+        # carries the RESOLVED id, so replay converges byte-for-byte
+        dst = (
+            SqliteEventStore(":memory:"),
+            MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        entries, _last = cf.oplog.read_since(0, 100)
+        for _seq, op in entries:
+            apply_op(op, *dst)
+        replica_plan = dst[1].rollout_plan_get(pid)
+        assert replica_plan is not None
+        assert replica_plan.salt == "abc123"
+        assert replica_plan.stage == ROLLOUT_SHADOW
+
+
+class TestStickyAcrossFailover:
+    def test_same_split_via_ha_metadata_after_primary_death(
+        self, tmp_path, monkeypatch
+    ):
+        """Satellite: the sticky split survives the HA read-failover
+        path — a plan read from a failed-over replica yields the exact
+        assignments the primary's copy did."""
+        from predictionio_tpu.storage import remote
+        from predictionio_tpu.storage.replica import StorageReplica
+        from predictionio_tpu.storage.storage_server import StorageServer
+
+        monkeypatch.setenv("PIO_BREAKER_FAILURES", "1")
+        remote.reset_resilience()
+        primary = StorageServer(
+            "127.0.0.1", 0,
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+            changefeed=None,
+        )
+        primary.changefeed = Changefeed(
+            OpLog(str(tmp_path / "oplog")),
+            primary.events, primary.metadata, primary.models,
+        )
+        primary.start_background()
+        replica = StorageReplica(
+            "127.0.0.1", 0,
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+            f"http://127.0.0.1:{primary.bound_port}",
+            str(tmp_path / "replica_state"),
+            catchup_wait_s=0.0,
+        )
+        replica.start_background()
+        try:
+            md = remote.RemoteMetadataStore(
+                f"pio+ha://127.0.0.1:{primary.bound_port},"
+                f"127.0.0.1:{replica.bound_port}"
+            )
+            pid = md.rollout_plan_upsert(_plan(stage=ROLLOUT_CANARY))
+            replica.catch_up()
+            plan_before = md.rollout_plan_get_active(
+                "default", "1", "engine.json"
+            )
+            keys = [f"user={i}" for i in range(200)]
+            before = {
+                k: variant_for_key(plan_before.salt, k, plan_before.percent)
+                for k in keys
+            }
+            primary.kill()
+            plan_after = md.rollout_plan_get_active(
+                "default", "1", "engine.json"
+            )  # served by the replica now
+            assert plan_after.id == pid
+            assert plan_after.salt == plan_before.salt
+            after = {
+                k: variant_for_key(plan_after.salt, k, plan_after.percent)
+                for k in keys
+            }
+            assert after == before
+        finally:
+            remote.reset_resilience()
+            for server in (primary, replica):
+                try:
+                    server.kill()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# the state machine end to end (sample engine, injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutE2E:
+    def _drive(self, server, n, start=0):
+        """n queries over distinct sticky keys; returns variant counts.
+        Every request must answer 200 (the zero-client-failures
+        invariant holds through every stage transition)."""
+        counts: dict = {}
+        for i in range(start, start + n):
+            info: dict = {}
+            _result, status = server.handle_query({"id": i}, info=info)
+            assert status == 200
+            counts[info.get("variant", "-")] = (
+                counts.get(info.get("variant", "-"), 0) + 1
+            )
+        return counts
+
+    def test_shadow_canary_live_when_gates_pass(self, registry):
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        clock = FakeClock()
+        srv = _server(registry, engine, clock, instance_id=base_id)
+        try:
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=10, gates=_gates()
+            )
+            assert srv.rollout.stage == ROLLOUT_SHADOW
+            # shadow: clients see baseline only; duplicates hit candidate
+            counts = self._drive(srv, 10)
+            srv.rollout.drain_shadow()
+            assert counts == {"baseline": 10}
+            assert srv.rollout.controller.candidate.count() >= 5
+            assert srv.rollout.controller.mean_divergence() is not None
+            clock.advance(11)  # past shadow_hold_s
+            self._drive(srv, 1, start=100)
+            srv.rollout.drain_shadow()
+            assert srv.rollout.stage == ROLLOUT_CANARY
+            # canary: ~10% of distinct keys served by the candidate
+            counts = self._drive(srv, 300, start=1000)
+            assert counts.get("candidate", 0) >= 5
+            assert counts["baseline"] > counts.get("candidate", 0)
+            clock.advance(11)  # past canary_hold_s
+            self._drive(srv, 5, start=5000)
+            assert srv.rollout.stage == ROLLOUT_LIVE
+            assert srv.deployment.instance.id == cand_id
+            # terminal state durable + visible after a server restart
+            plan = registry.get_metadata().rollout_plan_get_all()[0]
+            assert plan.stage == ROLLOUT_LIVE
+            assert [h["stage"] for h in plan.history] == [
+                ROLLOUT_SHADOW, ROLLOUT_CANARY, ROLLOUT_LIVE,
+            ]
+            srv2 = _server(registry, engine, FakeClock())
+            try:
+                assert srv2.deployment.instance.id == cand_id
+                assert not srv2.rollout.active
+            finally:
+                srv2.server_close()
+        finally:
+            srv.server_close()
+
+    def test_restart_mid_canary_resumes_same_sticky_split(self, registry):
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        clock = FakeClock()
+        srv = _server(registry, engine, clock, instance_id=base_id)
+        try:
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=50, gates=_gates()
+            )
+            self._drive(srv, 6)
+            srv.rollout.drain_shadow()
+            clock.advance(11)
+            self._drive(srv, 1, start=50)
+            srv.rollout.drain_shadow()
+            assert srv.rollout.stage == ROLLOUT_CANARY
+            # "restart": a fresh server against the same metadata. It
+            # would naturally load cand_id (latest completed) — resume
+            # must reinstate baseline vs candidate and the same split.
+            srv2 = _server(registry, engine, FakeClock())
+            try:
+                assert srv2.rollout.stage == ROLLOUT_CANARY
+                assert srv2.deployment.instance.id == base_id
+                assert (
+                    srv2.rollout.candidate_dep.instance.id == cand_id
+                )
+                assert srv2.rollout.plan.salt == srv.rollout.plan.salt
+                for i in range(100):
+                    payload = {"id": i}
+                    assert srv.rollout.variant_for(payload) == (
+                        srv2.rollout.variant_for(payload)
+                    )
+            finally:
+                srv2.server_close()
+        finally:
+            srv.server_close()
+
+    def test_failing_candidate_auto_rolls_back_with_zero_client_failures(
+        self, registry
+    ):
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        clock = FakeClock()
+        srv = _server(registry, engine, clock, instance_id=base_id)
+        try:
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=50,
+                gates=_gates(canary_hold_s=100_000.0),
+            )
+            self._drive(srv, 6)
+            srv.rollout.drain_shadow()
+            clock.advance(11)
+            self._drive(srv, 1, start=50)
+            srv.rollout.drain_shadow()
+            assert srv.rollout.stage == ROLLOUT_CANARY
+            # candidate dies mid-canary: every request still answers 200
+            # (asserted inside _drive) and the error gate rolls back
+            with faults.inject(
+                faults.FaultSpec(site="serving.candidate", kind="refuse")
+            ) as plan:
+                self._drive(srv, 100, start=1000)
+                assert plan.fired("serving.candidate") > 0
+            assert srv.rollout.stage == ROLLOUT_ROLLED_BACK
+            # baseline serves 100% of subsequent queries
+            counts = self._drive(srv, 50, start=9000)
+            assert counts == {"-": 50}
+            assert srv.deployment.instance.id == base_id
+            # terminal state durably recorded, visible after restart —
+            # and the rolled-back candidate is quarantined from being
+            # implicitly redeployed as latest-completed
+            durable = registry.get_metadata().rollout_plan_get_all()[0]
+            assert durable.stage == ROLLOUT_ROLLED_BACK
+            assert "error-rate" in durable.history[-1]["reason"]
+            srv2 = _server(registry, engine, FakeClock())
+            try:
+                assert not srv2.rollout.active
+                assert srv2.deployment.instance.id == base_id
+                assert srv2.rollout.plan.stage == ROLLOUT_ROLLED_BACK
+            finally:
+                srv2.server_close()
+        finally:
+            srv.server_close()
+
+    def test_terminal_persist_retried_after_metadata_outage(
+        self, registry, monkeypatch
+    ):
+        """A transition decided during a metadata outage must still
+        become durable: terminal stages have no later observe() to ride,
+        so handle_query retries the pending write."""
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock(), instance_id=base_id)
+        try:
+            srv.rollout.start(candidate_instance_id=cand_id, gates=_gates())
+            md = registry.get_metadata()
+            real_upsert = md.rollout_plan_upsert
+            outage = {"on": True}
+
+            def flaky(plan):
+                if outage["on"]:
+                    raise RuntimeError("metadata down")
+                return real_upsert(plan)
+
+            monkeypatch.setattr(md, "rollout_plan_upsert", flaky)
+            srv.rollout.abort("during outage")  # persist fails, deferred
+            assert md.rollout_plan_get_all()[0].stage == ROLLOUT_SHADOW
+            outage["on"] = False
+            _result, status = srv.handle_query({"id": 1})  # retry lands it
+            assert status == 200
+            assert md.rollout_plan_get_all()[0].stage == "ABORTED"
+        finally:
+            srv.server_close()
+
+    def test_resume_with_unloadable_baseline_closes_the_plan(self, registry):
+        """Restart mid-rollout with the plan's baseline gone: the plan
+        must finish ABORTED (loudly, durably) instead of staying active
+        while the candidate serves 100% unwatched."""
+        engine = make_engine()
+        cand_id = _train(registry, engine, algo_id=13)
+        md = registry.get_metadata()
+        md.rollout_plan_upsert(
+            _plan(
+                stage=ROLLOUT_CANARY,
+                baseline_instance_id="EI-missing",
+                candidate_instance_id=cand_id,
+            )
+        )
+        srv = _server(registry, engine, FakeClock())
+        try:
+            assert not srv.rollout.active
+            assert srv.rollout.plan.stage == "ABORTED"
+            assert "baseline unloadable" in srv.rollout.plan.history[-1]["reason"]
+            assert srv.deployment.instance.id == cand_id
+            durable = md.rollout_plan_get_all()[0]
+            assert durable.stage == "ABORTED"
+        finally:
+            srv.server_close()
+
+    def test_client_deadline_expiry_not_charged_to_candidate(self, registry):
+        """A budget that was already gone at dispatch is the client's
+        fault — candidate-routed expiries at that stage must not feed
+        the candidate's error gate."""
+        from predictionio_tpu.utils.resilience import (
+            Deadline,
+            DeadlineExceeded,
+        )
+
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        clock = FakeClock()
+        srv = _server(registry, engine, clock, instance_id=base_id)
+        try:
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=100, gates=_gates()
+            )
+            srv.rollout.promote("straight to canary")
+            before = srv.rollout.controller.candidate.count()
+            expired = Deadline.after_ms(1, clock)
+            clock.advance(1.0)
+            with pytest.raises(DeadlineExceeded):
+                srv.handle_query({"id": 1}, deadline=expired)
+            assert srv.rollout.controller.candidate.count() == before
+        finally:
+            srv.server_close()
+
+    def test_fleet_wide_errors_do_not_trip_the_delta_gate(self, registry):
+        """Errors the whole fleet is suffering (a shared dependency
+        down) must raise BOTH windows' error rates — the delta gate is a
+        comparison against the live baseline, not an absolute candidate
+        threshold, so a healthy canary survives bad weather."""
+        import unittest.mock as mock
+
+        from sample_engine import Serving0
+
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock(), instance_id=base_id)
+        try:
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=50,
+                gates=_gates(canary_hold_s=100_000.0),
+            )
+            srv.rollout.promote("straight to canary")
+            with mock.patch.object(
+                Serving0, "serve", side_effect=RuntimeError("dep down")
+            ):
+                for i in range(60):
+                    with pytest.raises(RuntimeError, match="dep down"):
+                        srv.handle_query({"id": i})
+            ctl = srv.rollout.controller
+            assert ctl.baseline.error_rate() > 0.5
+            assert ctl.candidate.error_rate() > 0.5
+            # equal misery on both sides: the delta gate must NOT fire
+            assert srv.rollout.stage == ROLLOUT_CANARY
+        finally:
+            srv.server_close()
+
+    def test_start_rejects_out_of_range_percent(self, registry):
+        """A NaN or out-of-range split would 500 every canary query
+        (variant_for_key round()) — refuse it at start."""
+        from predictionio_tpu.rollout.manager import RolloutError
+
+        engine = make_engine()
+        _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock())
+        try:
+            for bad in (0, -5, 150, float("nan")):
+                with pytest.raises(RolloutError, match="percent"):
+                    srv.rollout.start(
+                        candidate_instance_id=cand_id, percent=bad,
+                        gates=_gates(),
+                    )
+            assert not srv.rollout.active
+        finally:
+            srv.server_close()
+
+    def test_reload_refused_while_rollout_active(self, registry):
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock(), instance_id=base_id)
+        try:
+            srv.rollout.start(candidate_instance_id=cand_id, gates=_gates())
+            with pytest.raises(RuntimeError, match="promote or abort"):
+                srv.reload()
+            srv.rollout.abort("test cleanup")
+            srv.reload()  # fine again once the plan is terminal
+        finally:
+            srv.server_close()
+
+    def test_live_swap_and_rollback_drop_model_references(self, registry):
+        """Satellite: retiring a deployment (go-live retiring the
+        baseline; rollback retiring the candidate) must drop every
+        server-side reference to its prepared models so device buffers
+        are reclaimable."""
+        engine = make_engine()
+        _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock())
+        try:
+            # rollback path: candidate models released
+            srv.rollout.start(candidate_instance_id=cand_id, gates=_gates())
+            cand_ref = weakref.ref(srv.rollout.candidate_dep.models[0])
+            srv.rollout.abort("teardown test")
+            gc.collect()
+            assert cand_ref() is None
+            # go-live path: baseline models released
+            srv.rollout.start(candidate_instance_id=cand_id, gates=_gates())
+            base_ref = weakref.ref(srv.deployment.models[0])
+            srv.rollout.promote("to canary")
+            srv.rollout.promote("to live")
+            gc.collect()
+            assert base_ref() is None
+            assert srv.deployment.instance.id == cand_id
+        finally:
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutHTTP:
+    @pytest.fixture()
+    def live(self, registry):
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(registry, engine, FakeClock(), instance_id=base_id)
+        srv.start_background()
+        yield f"http://127.0.0.1:{srv.bound_port}", srv, registry, engine, cand_id
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except Exception:
+            pass
+
+    def test_post_reload_accepted(self, live):
+        base, srv, registry, engine, _cand = live
+        new_id = _train(registry, engine, algo_id=17)
+        r = requests.post(f"{base}/reload")
+        assert r.status_code == 200
+        assert srv.deployment.instance.id == new_id
+        # deprecated GET spelling still answers (CreateServer parity)
+        r = requests.get(f"{base}/reload")
+        assert r.status_code == 200
+
+    def test_rollout_routes(self, live):
+        base, srv, _registry, _engine, cand_id = live
+        r = requests.post(
+            f"{base}/rollout/start",
+            json={"instanceId": cand_id, "percent": 20, "gates": _gates()},
+        )
+        assert r.status_code == 200
+        body = r.json()
+        assert body["active"] and body["plan"]["stage"] == ROLLOUT_SHADOW
+        assert body["plan"]["percent"] == 20
+        # double-start → 409
+        r = requests.post(f"{base}/rollout/start", json={})
+        assert r.status_code == 409
+        # reload blocked mid-rollout → 409
+        assert requests.post(f"{base}/reload").status_code == 409
+        assert requests.get(f"{base}/rollout.json").json()["active"]
+        assert requests.get(f"{base}/status.json").json()["rollout"]["active"]
+        r = requests.post(f"{base}/rollout/promote", json={"reason": "t"})
+        assert r.status_code == 200
+        assert r.json()["plan"]["stage"] == ROLLOUT_CANARY
+        r = requests.post(f"{base}/rollout/abort", json={"reason": "done"})
+        assert r.status_code == 200
+        assert r.json()["plan"]["stage"] == "ABORTED"
+        # nothing active anymore → 409
+        r = requests.post(f"{base}/rollout/promote", json={})
+        assert r.status_code == 409
+
+    def test_bad_gate_option_is_400(self, live):
+        base, _srv, _registry, _engine, cand_id = live
+        r = requests.post(
+            f"{base}/rollout/start",
+            json={"instanceId": cand_id, "gates": {"nope": 1}},
+        )
+        assert r.status_code == 400
+
+    def test_response_counter_carries_variant_label(self, live):
+        base, srv, _registry, _engine, _cand = live
+        requests.post(f"{base}/queries.json", json={"id": 1})
+        from predictionio_tpu.obs.expo import render
+
+        text = render(srv.metrics)
+        assert 'pio_http_responses_total{status="200",variant="-"}' in text
+
+
+class TestFeedbackVariant:
+    def test_feedback_event_tagged_with_serving_variant(self, registry):
+        """Satellite: pio_pr prediction-record events carry the variant
+        so offline evaluation can score canary vs. baseline from the
+        event store."""
+        import time as _time
+
+        from predictionio_tpu.api import EventServer, EventServerConfig
+        from predictionio_tpu.storage import AccessKey, App, EventFilter
+
+        md = registry.get_metadata()
+        app_id = md.app_insert(App(id=0, name="fbapp"))
+        md.access_key_insert(AccessKey(key="FBKEY", appid=app_id, events=[]))
+        registry.get_events().init(app_id)
+        ev_srv = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0, stats=False),
+            registry.get_events(),
+            md,
+        )
+        ev_srv.start_background()
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        srv = _server(
+            registry, engine, FakeClock(), instance_id=base_id,
+            feedback=True, event_server_ip="127.0.0.1",
+            event_server_port=ev_srv.bound_port, access_key="FBKEY",
+        )
+        try:
+            # percent=100: every key routes to the candidate in CANARY
+            srv.rollout.start(
+                candidate_instance_id=cand_id, percent=100, gates=_gates()
+            )
+            srv.rollout.promote("straight to canary")
+            info: dict = {}
+            _result, status = srv.handle_query({"id": 5}, info=info)
+            assert status == 200 and info["variant"] == CANDIDATE
+            deadline = _time.time() + 10
+            events = []
+            while _time.time() < deadline and not events:
+                events = list(
+                    registry.get_events().find(
+                        app_id, EventFilter(event_names=["predict"])
+                    )
+                )
+                _time.sleep(0.05)
+            assert len(events) == 1
+            assert events[0].properties.get("variant") == CANDIDATE
+            assert events[0].properties.get("engineInstanceId") == cand_id
+        finally:
+            srv.server_close()
+            ev_srv.shutdown()
+            ev_srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario + dashboard
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutChaos:
+    def test_loadgen_rollout_chaos_scenario(self, registry):
+        """Satellite: the --rollout chaos drill as a tier-1 test —
+        shadow → promote to canary → candidate faults → auto-rollback,
+        zero client-visible failures, durable terminal state. Injected
+        clock, no wall-clock sleeps."""
+        from predictionio_tpu.tools.loadgen import run_rollout_chaos
+
+        engine = make_engine()
+        base_id = _train(registry, engine, algo_id=11)
+        cand_id = _train(registry, engine, algo_id=13)
+        report = run_rollout_chaos(
+            engine=engine,
+            registry=registry,
+            baseline_instance_id=base_id,
+            candidate_instance_id=cand_id,
+            payload_template='{"id": {i}}',
+            clock=FakeClock(),
+        )
+        assert report["ok"], report
+        assert report["clientFailures"] == 0
+        assert report["candidateFaultsFired"] > 0
+        assert report["finalStage"] == ROLLOUT_ROLLED_BACK
+        assert report["durableStage"] == ROLLOUT_ROLLED_BACK
+        assert report["postRollbackCandidateServed"] == 0
+        assert report["shadowSamples"] > 0
+
+
+class TestRolloutCLI:
+    def test_rollout_help_renders(self):
+        """argparse %-interpolates help text: a stray literal ``%``
+        crashes ``pio rollout -h`` with ValueError instead of usage."""
+        from predictionio_tpu.tools.console import build_parser
+
+        parser = build_parser()
+        for argv in (["rollout", "--help"], ["rollout", "abort", "--help"]):
+            with pytest.raises(SystemExit) as excinfo:
+                parser.parse_args(argv)
+            assert excinfo.value.code == 0
+
+
+class TestDashboardRollouts:
+    def test_rollouts_page_and_json(self, registry):
+        from predictionio_tpu.tools.dashboard import (
+            DashboardConfig,
+            DashboardServer,
+        )
+
+        md = registry.get_metadata()
+        pid = md.rollout_plan_upsert(_plan(stage=ROLLOUT_CANARY))
+        server = DashboardServer(
+            DashboardConfig(ip="127.0.0.1", port=0), registry
+        )
+        server.start_background()
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            html_page = requests.get(f"{base}/rollouts")
+            assert html_page.status_code == 200
+            assert pid in html_page.text
+            assert ROLLOUT_CANARY in html_page.text
+            rows = requests.get(f"{base}/rollouts.json").json()
+            assert rows[0]["id"] == pid
+            assert rows[0]["stage"] == ROLLOUT_CANARY
+            assert rows[0]["history"][0]["reason"] == "start"
+        finally:
+            server.shutdown()
+            server.server_close()
