@@ -8,7 +8,7 @@ full compile-debug cycle. The bug classes are mechanical, so this
 package catches them at AST level, before XLA/Mosaic ever runs: the
 "catch it in the graph, not on the device" discipline.
 
-Three rule families (see ``docs/lint.md`` for the full catalog):
+Six rule families (see ``docs/lint.md`` for the full catalog):
 
 - **Family A — Mosaic/Pallas hygiene** (``rules_mosaic``): applied to
   functions passed to ``pl.pallas_call`` (plus helpers they call) and to
@@ -18,6 +18,17 @@ Three rule families (see ``docs/lint.md`` for the full catalog):
 - **Family C — robustness hygiene** (``rules_robust``): applied
   package-wide; guards the ISSUE-2 resilience discipline (timeouts on
   every network call, jittered retries). Rule ids ``robust-*``.
+- **Family D — observability hygiene** (``rules_obs``): applied
+  package-wide; guards the ISSUE-4 metric-cardinality discipline.
+  Rule ids ``obs-*``.
+- **Family E — concurrency / lock discipline** (``rules_conc``,
+  ISSUE 6): applied package-wide; per-class inference of lock-guarded
+  state and cross-thread entry points over the threaded control plane
+  (shadow pools, tailers, scrape callbacks). Rule ids ``conc-*``.
+- **Family F — SPMD / multi-host consistency** (``rules_spmd``,
+  ISSUE 6): applied package-wide; guards the distributed-training arc
+  against host-divergent collectives, axis-name/spec drift, unordered
+  operand construction, and host-dependent RNG. Rule ids ``spmd-*``.
 
 Suppression: ``# pio: lint-ok[rule-id] reason`` on the finding's line or
 as a comment-only line directly above. The reason is mandatory — a bare

@@ -34,6 +34,19 @@ STATIC_VALUE_ATTRS = frozenset(
     {"shape", "ndim", "dtype", "size", "aval", "sharding"}
 )
 
+#: threading primitive constructors → the lock "kind" the concurrency
+#: rules reason about. Semaphores and Events are hand-off primitives —
+#: acquired on one thread, released on another by design — so the
+#: with/finally discipline rules exempt them.
+LOCK_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+    "Event": "event",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -93,6 +106,41 @@ class Rule:
 
 
 @dataclasses.dataclass
+class ClassScope:
+    """Per-class lock/attribute facts the concurrency rules (family E)
+    reason over: which ``self.*`` attributes are threading primitives,
+    which methods exist, and which attributes some method writes while
+    lexically inside a ``with self.<lock>:`` block."""
+
+    node: ast.ClassDef
+    name: str
+    #: ``self.X = threading.Lock()`` style assignments anywhere in the
+    #: class: attr name → kind ("lock" | "rlock" | "condition" |
+    #: "semaphore" | "event")
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: direct methods by name
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict
+    )
+    #: attrs assigned/augassigned under ``with self.<lock>`` (lock,
+    #: rlock or condition kind) in at least one method — the class's
+    #: lock-guarded state, as inferred from its own locking discipline
+    guarded_writes: Set[str] = dataclasses.field(default_factory=set)
+    #: True when the class subclasses ``threading.Thread`` (its ``run``
+    #: method executes on the spawned thread)
+    is_thread_subclass: bool = False
+
+    def mutex_attrs(self) -> Set[str]:
+        """Lock attrs that provide mutual exclusion (not hand-off
+        primitives)."""
+        return {
+            name
+            for name, kind in self.lock_attrs.items()
+            if kind in ("lock", "rlock", "condition")
+        }
+
+
+@dataclasses.dataclass
 class _Suppression:
     line: int
     rule_ids: Set[str]
@@ -130,6 +178,16 @@ class FileContext:
     #: tiling, so the lane-alignment rules exempt these refs
     smem_params: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
     suppressions: List[_Suppression] = dataclasses.field(default_factory=list)
+    #: class-scope lock/attribute facts (family E inputs)
+    classes: List[ClassScope] = dataclasses.field(default_factory=list)
+    #: module-level names bound to a threading primitive
+    #: (``_LOCK = threading.Lock()``): name → kind
+    module_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: module-level names bound to a mutable container literal/ctor
+    #: (``_REGISTRY = {}``): name → container kind ("dict"/"list"/"set")
+    module_mutables: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: module-level names bound to ``contextvars.ContextVar(...)``
+    module_contextvars: Set[str] = dataclasses.field(default_factory=set)
 
     def kernel_smem_params(self, kernel: ast.FunctionDef) -> Set[str]:
         return self.smem_params.get(kernel.name, set())
@@ -374,6 +432,206 @@ def _collect_kernels(ctx: FileContext) -> None:
     ctx.kernels = [module_funcs[n] for n in sorted(names)]
 
 
+def lock_kind_of(node: ast.AST) -> str:
+    """"lock"/"rlock"/... when ``node`` constructs a threading primitive
+    (``threading.Lock()`` or a bare ``Lock()`` from-import); "" otherwise."""
+    if not isinstance(node, ast.Call):
+        return ""
+    dn = dotted_name(node.func)
+    tail = dn.rsplit(".", 1)[-1]
+    if tail not in LOCK_KINDS:
+        return ""
+    if dn == tail or dn == f"threading.{tail}":
+        return LOCK_KINDS[tail]
+    return ""
+
+
+def walk_in_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested scopes (function /
+    lambda / class definitions) — the concurrency rules analyze one
+    execution scope at a time."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                continue
+            stack.append(child)
+
+
+#: attribute methods that mutate their receiver — used both to infer
+#: lock-guarded state (``self._items.append(x)`` under a lock) and to
+#: spot request-time mutation of module-level registries
+MUTATOR_METHODS = frozenset(
+    {
+        "append", "appendleft", "add", "update", "setdefault", "pop",
+        "popleft", "popitem", "remove", "discard", "clear", "extend",
+        "insert",
+    }
+)
+
+
+def _self_attr(node: ast.AST) -> str:
+    """``self.X`` → "X"; "" for anything else."""
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _mutated_self_attrs(stmt: ast.AST) -> Set[str]:
+    """self attrs this single statement writes: assignment/augassign
+    targets (including ``self.X[k] = v``), ``del self.X[...]``, and
+    mutator-method calls (``self.X.append(...)``)."""
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for t in targets:
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        attr = _self_attr(t)
+        if attr:
+            out.add(attr)
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        fn = stmt.value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS:
+            attr = _self_attr(fn.value)
+            if attr:
+                out.add(attr)
+    return out
+
+
+def _with_holds_self_mutex(stmt: ast.With, mutexes: Set[str]) -> bool:
+    return any(
+        _self_attr(item.context_expr) in mutexes for item in stmt.items
+    )
+
+
+def _collect_guarded_writes(cls: ClassScope) -> None:
+    mutexes = cls.mutex_attrs()
+    if not mutexes:
+        return
+
+    def visit(node: ast.AST, under: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                # a nested class has its own `self`: its writes belong
+                # to ITS ClassScope, never this one
+                continue
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                # a nested scope runs later: an enclosing `with` does not
+                # span its execution — restart the lock state inside it
+                visit(child, False)
+                continue
+            held = under or (
+                isinstance(child, ast.With)
+                and _with_holds_self_mutex(child, mutexes)
+            )
+            if held:
+                cls.guarded_writes |= _mutated_self_attrs(child)
+            visit(child, held)
+
+    visit(cls.node, False)
+
+
+def _walk_skip_nested_classes(root: ast.ClassDef) -> Iterator[ast.AST]:
+    """Walk a class body without descending into nested ClassDefs: a
+    nested class has its own ``self``, so its assignments must not be
+    attributed to the enclosing class (it gets its own ClassScope)."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            stack.append(child)
+
+
+def _collect_classes(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cls = ClassScope(
+            node=node,
+            name=node.name,
+            is_thread_subclass=any(
+                dotted_name(base) in ("threading.Thread", "Thread")
+                for base in node.bases
+            ),
+        )
+        for stmt in node.body:
+            if isinstance(stmt, ast.FunctionDef):
+                cls.methods[stmt.name] = stmt
+        for sub in _walk_skip_nested_classes(node):
+            if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                continue
+            attr = _self_attr(sub.targets[0])
+            if not attr:
+                continue
+            kind = lock_kind_of(sub.value)
+            if kind:
+                cls.lock_attrs[attr] = kind
+        _collect_guarded_writes(cls)
+        ctx.classes.append(cls)
+
+
+#: mutable-container constructors for module-registry tracking
+_MUTABLE_CTORS = {
+    "dict": "dict", "list": "list", "set": "set", "defaultdict": "dict",
+    "OrderedDict": "dict", "deque": "deque", "Counter": "dict",
+}
+
+
+def _collect_module_state(ctx: FileContext) -> None:
+    for stmt in ctx.tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        if isinstance(stmt, ast.Assign):
+            if len(stmt.targets) != 1 or not isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                continue
+            name, value = stmt.targets[0].id, stmt.value
+        else:
+            if not isinstance(stmt.target, ast.Name) or stmt.value is None:
+                continue
+            name, value = stmt.target.id, stmt.value
+        kind = lock_kind_of(value)
+        if kind:
+            ctx.module_locks[name] = kind
+            continue
+        if isinstance(value, ast.Call):
+            dn = dotted_name(value.func)
+            tail = dn.rsplit(".", 1)[-1]
+            if tail == "ContextVar":
+                ctx.module_contextvars.add(name)
+                continue
+            if tail in _MUTABLE_CTORS:
+                ctx.module_mutables[name] = _MUTABLE_CTORS[tail]
+                continue
+        if isinstance(value, ast.Dict):
+            ctx.module_mutables[name] = "dict"
+        elif isinstance(value, ast.List):
+            ctx.module_mutables[name] = "list"
+        elif isinstance(value, ast.Set):
+            ctx.module_mutables[name] = "set"
+
+
 def _collect_suppressions(ctx: FileContext) -> None:
     """Collect suppressions from real COMMENT tokens only: the pattern
     inside a string literal (test sources, docs quoting the syntax) must
@@ -419,6 +677,8 @@ def build_context(path: str, source: Optional[str] = None) -> FileContext:
     )
     _collect_constants(ctx)
     _collect_kernels(ctx)
+    _collect_classes(ctx)
+    _collect_module_state(ctx)
     _collect_suppressions(ctx)
     return ctx
 
@@ -429,13 +689,22 @@ def build_context(path: str, source: Optional[str] = None) -> FileContext:
 
 
 def all_rules() -> List[Rule]:
-    from . import rules_jit, rules_mosaic, rules_obs, rules_robust
+    from . import (
+        rules_conc,
+        rules_jit,
+        rules_mosaic,
+        rules_obs,
+        rules_robust,
+        rules_spmd,
+    )
 
     return [
         *rules_mosaic.RULES,
         *rules_jit.RULES,
         *rules_robust.RULES,
         *rules_obs.RULES,
+        *rules_conc.RULES,
+        *rules_spmd.RULES,
     ]
 
 
@@ -446,6 +715,9 @@ class LintResult:
     findings: List[Finding] = dataclasses.field(default_factory=list)
     #: suppressed findings, kept for reporting (``--format json``)
     suppressed: List[Finding] = dataclasses.field(default_factory=list)
+    #: findings absorbed by an adopted baseline (``--baseline``): legacy
+    #: debt that is acknowledged but not yet fixed — reported, not fatal
+    baselined: List[Finding] = dataclasses.field(default_factory=list)
     #: files that failed to parse: (path, error)
     errors: List[tuple] = dataclasses.field(default_factory=list)
 
@@ -588,6 +860,65 @@ def lint_paths(
 
 
 # ---------------------------------------------------------------------------
+# Baseline (adopt/ratchet legacy findings)
+# ---------------------------------------------------------------------------
+
+
+def _baseline_key(path: str, rule_id: str) -> tuple:
+    """Baseline bucket key. Paths are normalized relative to the current
+    directory so a baseline recorded by CI matches a local run; keying on
+    (path, rule) rather than (path, rule, line) keeps the baseline stable
+    under unrelated edits that shift line numbers."""
+    norm = os.path.relpath(os.path.abspath(path)).replace(os.sep, "/")
+    return (norm, rule_id)
+
+
+def load_baseline(path: str) -> Dict[tuple, int]:
+    """Parse a baseline file into per-(path, rule) allowances. Accepts a
+    full ``--format json`` document (its ``findings`` array) or a bare
+    list of finding objects — so ``pio lint --format json > baseline.json``
+    is the whole adoption workflow. Raises ValueError on anything else."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        doc = doc.get("findings")
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"baseline {path}: expected a findings list or a "
+            "`pio lint --format json` document"
+        )
+    counts: Dict[tuple, int] = {}
+    for entry in doc:
+        if not isinstance(entry, dict) or "rule" not in entry or \
+                "path" not in entry:
+            raise ValueError(
+                f"baseline {path}: entries need 'rule' and 'path' keys"
+            )
+        key = _baseline_key(str(entry["path"]), str(entry["rule"]))
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def apply_baseline(result: LintResult, counts: Dict[tuple, int]) -> None:
+    """Move findings covered by the baseline into ``result.baselined``.
+
+    Ratchet semantics per (path, rule) bucket: up to the baselined count
+    is absorbed (oldest lines first — deterministic); anything beyond it
+    is NEW debt and stays a failing finding. Buckets the current run no
+    longer produces simply go unused — the baseline only ever shrinks."""
+    remaining = dict(counts)
+    kept: List[Finding] = []
+    for f in sorted(result.findings, key=lambda f: (f.path, f.line, f.col)):
+        key = _baseline_key(f.path, f.rule_id)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            result.baselined.append(f)
+        else:
+            kept.append(f)
+    result.findings = kept
+
+
+# ---------------------------------------------------------------------------
 # Reporters
 # ---------------------------------------------------------------------------
 
@@ -601,10 +932,13 @@ def render_text(result: LintResult) -> str:
             f"{f.path}:{f.line}:{f.col}: [{f.rule_id}] "
             f"{f.severity}: {f.message}"
         )
-    lines.append(
+    summary = (
         f"{result.files} files, {len(result.findings)} findings, "
         f"{len(result.suppressed)} suppressed"
     )
+    if result.baselined:
+        summary += f", {len(result.baselined)} baselined"
+    lines.append(summary)
     return "\n".join(lines)
 
 
@@ -614,6 +948,7 @@ def render_json(result: LintResult) -> str:
             "files": result.files,
             "findings": [f.as_dict() for f in result.findings],
             "suppressed": [f.as_dict() for f in result.suppressed],
+            "baselined": [f.as_dict() for f in result.baselined],
             "errors": [
                 {"path": p, "message": m} for p, m in result.errors
             ],

@@ -1,0 +1,734 @@
+"""Family E — lock-discipline / race hygiene rules, applied package-wide.
+
+PRs 2-5 made the control plane genuinely multi-threaded: shadow pools
+(``rollout/manager.py``), replica tailers (``storage/replica.py``),
+breaker registries (``storage/remote.py``), micro-batch dispatchers
+(``workflow/batching.py``), metrics scrape threads (``obs/metrics.py``).
+The bug classes that code shape carries — an attribute guarded by a lock
+in one method and read bare from another thread, a lock leaked on an
+exception path, a blocking call made while holding a hot lock, two locks
+taken in opposite orders — are mechanical and visible at AST level, so
+like the Mosaic/jit/robust/obs families they are caught before the
+first stuck scrape or deadlocked drain:
+
+- ``conc-unguarded-attr``: per-class inference — an attribute some
+  method writes under ``with self._lock:`` is this class's lock-guarded
+  state; accessing it bare from a cross-thread entry point (a
+  ``threading.Thread``/``Timer`` target, an executor ``submit``, a
+  ``gauge_callback``) is a data race.
+- ``conc-acquire-no-with``: ``lock.acquire()`` outside a ``with`` and
+  without a ``finally: release()`` leaks the lock on the first
+  exception — every later acquirer hangs forever.
+- ``conc-blocking-under-lock``: a blocking call (sleep, HTTP, fsync,
+  ``Future.result``, ``thread.join``, subprocess) made while holding a
+  lock turns that lock into a convoy: every thread needing it waits out
+  the I/O.
+- ``conc-lock-order``: ``with A: ... with B:`` in one place and
+  ``with B: ... with A:`` in another is a textbook deadlock.
+- ``conc-module-mutable``: a module-level dict/list/set mutated inside
+  a function without a module-level lock held — request-time mutation
+  of an import-time registry races every server thread.
+- ``conc-contextvar-thread-hop``: contextvars do not cross threads; a
+  thread-entry function reading an ambient contextvar
+  (``current_context()``/``current_deadline()``/``<var>.get()``) sees
+  the *worker's* empty context, not the request's. Capture at submit
+  time and pass explicitly (the ``obs/trace.py`` discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .engine import (
+    ClassScope,
+    FileContext,
+    Finding,
+    Rule,
+    _self_attr,
+    call_name,
+    dotted_name,
+)
+
+#: entry-point call shapes that hand a callable to another thread
+_ENTRY_THREAD_CTORS = frozenset({"Thread"})
+
+
+def _parent_map(ctx: FileContext) -> Dict[ast.AST, ast.AST]:
+    """Child → parent for the whole tree, computed once per file and
+    stashed on the context (four family-E rules need it; rebuilding per
+    rule made the package sweep measurably slower)."""
+    cached = getattr(ctx, "_conc_parents", None)
+    if cached is None:
+        cached = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                cached[child] = node
+        ctx._conc_parents = cached
+    return cached
+
+
+def _enclosing(node: ast.AST, parents: Dict[ast.AST, ast.AST], kinds):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, kinds):
+        cur = parents.get(cur)
+    return cur
+
+
+def _class_scope_of(
+    node: ast.AST, ctx: FileContext, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ClassScope]:
+    cls_node = node if isinstance(node, ast.ClassDef) else _enclosing(
+        node, parents, ast.ClassDef
+    )
+    for cs in ctx.classes:
+        if cs.node is cls_node:
+            return cs
+    return None
+
+
+def _resolve_callable(
+    site: ast.Call,
+    value: ast.AST,
+    ctx: FileContext,
+    parents: Dict[ast.AST, ast.AST],
+) -> Optional[ast.AST]:
+    """The function/lambda node a callable reference points at, when it
+    is visible in this file: a lambda literal, ``self._method``, a
+    nested ``def`` in the enclosing function, or a module-level def."""
+    if isinstance(value, ast.Lambda):
+        return value
+    attr = _self_attr(value)
+    if attr:
+        cs = _class_scope_of(site, ctx, parents)
+        if cs is not None:
+            return cs.methods.get(attr)
+        return None
+    if isinstance(value, ast.Name):
+        fn = _enclosing(
+            site, parents, (ast.FunctionDef, ast.AsyncFunctionDef)
+        )
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.FunctionDef) and \
+                        node.name == value.id:
+                    return node
+        for node in ctx.tree.body:
+            if isinstance(node, ast.FunctionDef) and node.name == value.id:
+                return node
+    return None
+
+
+def thread_entries(
+    ctx: FileContext, parents: Dict[ast.AST, ast.AST]
+) -> List[Tuple[ast.AST, str]]:
+    """Functions/lambdas in this file that execute on another thread:
+    ``Thread(target=f)`` / ``Timer(delay, f)`` targets, ``pool.submit(f,
+    ...)`` submissions, ``gauge_callback(name, f)`` scrape callbacks,
+    and ``run`` methods of ``threading.Thread`` subclasses. Returns
+    (node, how) pairs, deduplicated."""
+    out: List[Tuple[ast.AST, str]] = []
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST], how: str) -> None:
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            out.append((node, how))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        refs: List[Tuple[ast.AST, str]] = []
+        if name in _ENTRY_THREAD_CTORS:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    refs.append((kw.value, "Thread target"))
+        elif name == "Timer":
+            if len(node.args) >= 2:
+                refs.append((node.args[1], "Timer callback"))
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    refs.append((kw.value, "Timer callback"))
+        elif name == "submit":
+            if node.args:
+                refs.append((node.args[0], "executor submission"))
+        elif name == "gauge_callback":
+            if len(node.args) >= 2:
+                refs.append((node.args[1], "scrape-time gauge callback"))
+            for kw in node.keywords:
+                if kw.arg == "fn":
+                    refs.append((kw.value, "scrape-time gauge callback"))
+        for value, how in refs:
+            add(_resolve_callable(node, value, ctx, parents), how)
+    for cs in ctx.classes:
+        if cs.is_thread_subclass and "run" in cs.methods:
+            add(cs.methods["run"], "threading.Thread subclass run()")
+    return out
+
+
+def _preceding_sibling(
+    stmt: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    """The statement directly before ``stmt`` in its parent block, or
+    None when it opens the block."""
+    parent = parents.get(stmt)
+    if parent is None:
+        return None
+    for field in ("body", "orelse", "finalbody"):
+        seq = getattr(parent, field, None)
+        if isinstance(seq, list) and stmt in seq:
+            idx = seq.index(stmt)
+            return seq[idx - 1] if idx > 0 else None
+    return None
+
+
+def _iter_scope_with_lockstate(
+    root: ast.AST, holds
+) -> Iterator[Tuple[ast.AST, Set[str]]]:
+    """Yield (node, frozenset-of-held-lock-names) for every node in
+    ``root``'s scope. Nested function/class bodies are visited too, but
+    their lock state restarts empty: an enclosing ``with`` wraps their
+    *definition*, not their execution."""
+
+    def visit(node: ast.AST, held: Set[str]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                yield from visit(child, set())
+                continue
+            now = held
+            if isinstance(child, ast.With):
+                got = holds(child)
+                if got:
+                    now = held | got
+            yield child, now
+            yield from visit(child, now)
+
+    yield from visit(root, set())
+
+
+class UnguardedAttr(Rule):
+    """An attribute this class writes under one of its own locks,
+    accessed without any of them from a function that runs on another
+    thread. The lock-guarded write is the class declaring "this state
+    is shared"; the bare cross-thread access is the race."""
+
+    id = "conc-unguarded-attr"
+    severity = "error"
+    short = (
+        "lock-guarded attribute accessed bare in a thread target / "
+        "timer / submit / gauge callback"
+    )
+    motivation = (
+        "the PR-4/PR-5 control plane reads state from scrape threads "
+        "and pool workers; an attr written under self._lock in one "
+        "method and read bare on those threads is a torn-read race "
+        "that only fires under production concurrency"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: no class in this file has lock-guarded state
+        if not any(cs.guarded_writes for cs in ctx.classes):
+            return
+        parents = _parent_map(ctx)
+        for entry, how in thread_entries(ctx, parents):
+            cs = _class_scope_of(entry, ctx, parents)
+            if cs is None or not cs.guarded_writes:
+                continue
+            mutexes = cs.mutex_attrs()
+
+            def holds(w: ast.With) -> Set[str]:
+                return {
+                    _self_attr(item.context_expr)
+                    for item in w.items
+                    if _self_attr(item.context_expr) in mutexes
+                }
+
+            reported: Set[str] = set()
+            for node, held in _iter_scope_with_lockstate(entry, holds):
+                if held or not isinstance(node, ast.Attribute):
+                    continue
+                attr = _self_attr(node)
+                if (
+                    attr
+                    and attr in cs.guarded_writes
+                    and attr not in reported
+                ):
+                    reported.add(attr)
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"self.{attr} is written under a lock elsewhere "
+                        f"in {cs.name} but accessed without one in a "
+                        f"{how} — guard the access (or snapshot the "
+                        "value under the lock before the thread hop).",
+                    )
+
+
+class AcquireNoWith(Rule):
+    """``lock.acquire()`` without ``with`` or a ``finally: release()``:
+    the first exception between acquire and release leaks the lock and
+    every later acquirer blocks forever. Semaphores/Events are exempt —
+    cross-thread hand-off (acquire here, release on the worker) is what
+    they are for."""
+
+    id = "conc-acquire-no-with"
+    severity = "error"
+    short = (
+        "lock.acquire() outside `with` and without a finally-release "
+        "(lock leak on exception)"
+    )
+    motivation = (
+        "a leaked lock is a whole-process hang with a clean stack "
+        "trace pointing nowhere; `with lock:` makes the leak "
+        "impossible to write"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ".acquire(" not in ctx.source:  # cheap bail
+            return
+        parents = _parent_map(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute) or fn.attr != "acquire":
+                continue
+            base = dotted_name(fn.value)
+            if not base:
+                continue  # chained/derived receivers: not a plain lock ref
+            if self._is_handoff_primitive(base, node, ctx, parents):
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue  # `with pool.acquire() as x:` — scoped by the with
+            scope = _enclosing(
+                node, parents, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) or ctx.tree
+            if self._released_in_finally(scope, base, node, parents):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"{base}.acquire() without `with` or a finally-release: "
+                "an exception before release() leaks the lock and hangs "
+                f"every later acquirer — use `with {base}:`.",
+            )
+
+    @staticmethod
+    def _is_handoff_primitive(
+        base: str,
+        node: ast.AST,
+        ctx: FileContext,
+        parents: Dict[ast.AST, ast.AST],
+    ) -> bool:
+        attr = base[len("self."):] if base.startswith("self.") else ""
+        if attr:
+            cs = _class_scope_of(node, ctx, parents)
+            if cs is not None and cs.lock_attrs.get(attr) in (
+                "semaphore", "event"
+            ):
+                return True
+        return ctx.module_locks.get(base) in ("semaphore", "event")
+
+    @staticmethod
+    def _released_in_finally(
+        scope: ast.AST,
+        base: str,
+        acquire: ast.AST,
+        parents: Dict[ast.AST, ast.AST],
+    ) -> bool:
+        """True only when a try/finally that releases ``base`` actually
+        *covers* the acquire: the acquire is inside the try body, or is
+        the statement immediately before the try (the classic
+        ``lock.acquire()`` / ``try: ... finally: release()`` idiom). A
+        finally elsewhere in the function protects nothing between the
+        acquire and itself — the leak the rule exists to catch."""
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            releases = any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "release"
+                and dotted_name(sub.func.value) == base
+                for stmt in node.finalbody
+                for sub in ast.walk(stmt)
+            )
+            if not releases:
+                continue
+            if any(
+                sub is acquire
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            ):
+                return True
+            prev = _preceding_sibling(node, parents)
+            if prev is not None and any(
+                sub is acquire for sub in ast.walk(prev)
+            ):
+                return True
+        return False
+
+
+#: dotted names of calls that block on I/O or another thread
+_BLOCKING_DOTTED = frozenset(
+    {
+        "time.sleep", "sleep",
+        "urlopen", "urllib.request.urlopen", "request.urlopen",
+        "socket.create_connection", "create_connection",
+        "subprocess.run", "subprocess.call", "subprocess.check_call",
+        "subprocess.check_output",
+    }
+)
+
+_REQUESTS_VERBS = frozenset(
+    {"get", "post", "put", "patch", "delete", "head", "options", "request"}
+)
+
+
+def _is_blocking_call(node: ast.Call) -> str:
+    """A human-readable name when ``node`` is a blocking call; ""
+    otherwise."""
+    dn = dotted_name(node.func)
+    name = call_name(node)
+    if dn in _BLOCKING_DOTTED:
+        return dn
+    if dn.startswith("requests.") and name in _REQUESTS_VERBS:
+        return dn
+    if "fsync" in name or "fdatasync" in name:
+        return dn or name
+    if isinstance(node.func, ast.Attribute):
+        if name == "result":  # Future.result() — waits on another thread
+            return f"{dotted_name(node.func.value) or '<expr>'}.result"
+        if name == "join" and not node.args and not node.keywords:
+            # thread.join(); str.join always takes an argument
+            return f"{dotted_name(node.func.value) or '<expr>'}.join"
+    return ""
+
+
+class BlockingUnderLock(Rule):
+    """A blocking call made while holding a lock convoys every thread
+    that needs the lock behind the I/O: a slow peer or disk turns a
+    microsecond critical section into a seconds-long global stall (and,
+    for scrape-path locks, freezes ``/metrics`` with it)."""
+
+    id = "conc-blocking-under-lock"
+    severity = "error"
+    short = (
+        "blocking call (sleep/HTTP/fsync/result/join/subprocess) while "
+        "holding a lock"
+    )
+    motivation = (
+        "rollout/metadata persistence and replica apply paths hold "
+        "locks that the serving and scrape threads also need; one "
+        "blocking call under them stalls every request in the process"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: no known locks anywhere in this file
+        if not ctx.module_locks and not any(
+            cs.lock_attrs for cs in ctx.classes
+        ):
+            return
+        parents = _parent_map(ctx)
+
+        def holds(w: ast.With) -> Set[str]:
+            got: Set[str] = set()
+            for item in w.items:
+                expr = item.context_expr
+                attr = _self_attr(expr)
+                if attr:
+                    cs = _class_scope_of(w, ctx, parents)
+                    if cs is not None and cs.lock_attrs.get(attr) in (
+                        "lock", "rlock", "condition"
+                    ):
+                        got.add(f"self.{attr}")
+                elif isinstance(expr, ast.Name) and ctx.module_locks.get(
+                    expr.id
+                ) in ("lock", "rlock", "condition"):
+                    got.add(expr.id)
+            return got
+
+        for node, held in _iter_scope_with_lockstate(ctx.tree, holds):
+            if not held or not isinstance(node, ast.Call):
+                continue
+            shown = _is_blocking_call(node)
+            if shown:
+                locks = ", ".join(sorted(held))
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{shown}(...) while holding {locks}: every thread "
+                    "needing the lock waits out this call — move the "
+                    "blocking work outside the critical section (snapshot "
+                    "state under the lock, do I/O after).",
+                )
+
+
+class LockOrder(Rule):
+    """Two locks taken in opposite nesting orders in the same file: one
+    thread holding A waiting for B while another holds B waiting for A
+    is a deadlock that needs exactly one bad interleaving."""
+
+    id = "conc-lock-order"
+    severity = "error"
+    short = (
+        "inconsistent multi-lock acquisition order (A→B here, B→A "
+        "elsewhere): deadlock"
+    )
+    motivation = (
+        "the rollout manager nests the server deploy lock inside its "
+        "own; the moment any code path nests them the other way the "
+        "query server deadlocks under load — pin one global order"
+    )
+
+    _LOCKISH = ("lock", "mutex", "cond", "sem")
+
+    def _lock_name(self, expr: ast.AST, ctx: FileContext) -> str:
+        dn = dotted_name(expr)
+        if not dn:
+            return ""
+        if isinstance(expr, ast.Name):
+            if ctx.module_locks.get(dn) in ("lock", "rlock", "condition"):
+                return dn
+            return ""
+        tail = dn.rsplit(".", 1)[-1].lower()
+        if any(tok in tail for tok in self._LOCKISH):
+            return dn
+        return ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: the pair analysis only matters where with-blocks
+        # on lock-looking names exist at all
+        lowered = ctx.source.lower()
+        if "with " not in lowered or not any(
+            tok in lowered for tok in self._LOCKISH
+        ):
+            return
+        #: ordered pair -> first witnessing inner `with` node
+        pairs: Dict[Tuple[str, str], ast.AST] = {}
+
+        def visit(node: ast.AST, held: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                     ast.ClassDef),
+                ):
+                    visit(child, [])
+                    continue
+                now = held
+                if isinstance(child, ast.With):
+                    names = [
+                        n
+                        for item in child.items
+                        for n in [self._lock_name(item.context_expr, ctx)]
+                        if n
+                    ]
+                    if names:
+                        now = held + names
+                        # `with A, B:` acquires left to right, so the
+                        # items of ONE with statement order just like
+                        # nested withs do
+                        for i, inner in enumerate(names):
+                            for outer in held + names[:i]:
+                                if outer != inner:
+                                    pairs.setdefault(
+                                        (outer, inner), child
+                                    )
+                visit(child, now)
+
+        visit(ctx.tree, [])
+        reported: Set[frozenset] = set()
+        for (outer, inner), node in sorted(
+            pairs.items(), key=lambda kv: kv[1].lineno
+        ):
+            if (inner, outer) not in pairs:
+                continue
+            key = frozenset((outer, inner))
+            if key in reported:
+                continue
+            reported.add(key)
+            other = pairs[(inner, outer)]
+            # report at the LATER occurrence: the first nesting in file
+            # order establishes the convention, the reversed one breaks it
+            first, second = sorted((node, other), key=lambda n: n.lineno)
+            yield self.finding(
+                ctx,
+                second,
+                f"locks {outer!r} and {inner!r} are nested in both "
+                f"orders in this file (the other order is at line "
+                f"{first.lineno}): two threads taking them oppositely "
+                "deadlock — pin one acquisition order.",
+            )
+
+
+class ModuleMutable(Rule):
+    """A module-level mutable registry mutated inside a function without
+    a module-level lock held: import-time registries are fine, but a
+    request-time mutation races every server thread that reads them."""
+
+    id = "conc-module-mutable"
+    severity = "error"
+    short = (
+        "module-level dict/list/set mutated at call time without a "
+        "module-level lock held"
+    )
+    motivation = (
+        "the breaker/seq-token registries in storage/remote.py get "
+        "this right (one module lock around every mutation); a new "
+        "registry that skips the lock corrupts itself under the "
+        "threaded servers"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.module_mutables:
+            return
+        guards = {
+            name
+            for name, kind in ctx.module_locks.items()
+            if kind in ("lock", "rlock", "condition")
+        }
+
+        def holds(w: ast.With) -> Set[str]:
+            return {
+                item.context_expr.id
+                for item in w.items
+                if isinstance(item.context_expr, ast.Name)
+                and item.context_expr.id in guards
+            }
+
+        # the scope iterator descends into nested defs (restarting lock
+        # state), and this loop visits nested defs directly too — dedupe
+        # by node so a mutation inside `def outer(): def inner(): ...`
+        # is reported once, not once per enclosing function
+        reported: Set[int] = set()
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node, held in _iter_scope_with_lockstate(func, holds):
+                if held or id(node) in reported:
+                    continue
+                name = self._mutated_module_name(node, ctx)
+                if name:
+                    reported.add(id(node))
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"module-level {name!r} mutated at call time "
+                        "without a module lock held: concurrent server "
+                        "threads race the registry — guard mutations "
+                        "with one module-level threading.Lock.",
+                    )
+
+    @staticmethod
+    def _mutated_module_name(node: ast.AST, ctx: FileContext) -> str:
+        def module_name(expr: ast.AST) -> str:
+            if isinstance(expr, ast.Subscript):
+                expr = expr.value
+            if isinstance(expr, ast.Name) and expr.id in ctx.module_mutables:
+                return expr.id
+            return ""
+
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = module_name(t)
+                    if name:
+                        return name
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Subscript
+        ):
+            return module_name(node.target)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = module_name(t)
+                    if name:
+                        return name
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            from .engine import MUTATOR_METHODS
+
+            if node.func.attr in MUTATOR_METHODS:
+                base = node.func.value
+                if isinstance(base, ast.Name) and \
+                        base.id in ctx.module_mutables:
+                    return base.id
+        return ""
+
+
+#: functions that read ambient per-request context (deadline/trace)
+_AMBIENT_GETTERS = frozenset({"current_context", "current_deadline"})
+
+
+class ContextvarThreadHop(Rule):
+    """Contextvars do not cross thread boundaries: a thread-entry
+    function reading an ambient contextvar gets the worker's empty
+    context, silently dropping the request's deadline/trace. Capture the
+    value at submit time and pass it explicitly — the discipline
+    ``obs/trace.py`` and ``utils/resilience.py`` document and the PR-4
+    batcher/feedback paths implement."""
+
+    id = "conc-contextvar-thread-hop"
+    severity = "error"
+    short = (
+        "ambient contextvar read (current_context()/<var>.get()) "
+        "inside a cross-thread entry function"
+    )
+    motivation = (
+        "the PR-4 trace plane lost spans exactly this way until every "
+        "thread hop captured its SpanContext at submit time; the rule "
+        "pins that discipline for future pools"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: no ambient getters or contextvars in this file
+        if not ctx.module_contextvars and not any(
+            getter in ctx.source for getter in _AMBIENT_GETTERS
+        ):
+            return
+        parents = _parent_map(ctx)
+        for entry, how in thread_entries(ctx, parents):
+            for node in ast.walk(entry):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in _AMBIENT_GETTERS:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{name}() inside a {how} reads the worker "
+                        "thread's empty context — capture the value "
+                        "before the thread hop and pass it explicitly.",
+                    )
+                elif (
+                    name == "get"
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ctx.module_contextvars
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"contextvar {node.func.value.id!r}.get() inside "
+                        f"a {how}: contextvars do not follow thread "
+                        "hops — capture at submit time and pass "
+                        "explicitly.",
+                    )
+
+
+RULES: List[Rule] = [
+    UnguardedAttr(),
+    AcquireNoWith(),
+    BlockingUnderLock(),
+    LockOrder(),
+    ModuleMutable(),
+    ContextvarThreadHop(),
+]

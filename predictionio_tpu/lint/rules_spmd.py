@@ -1,0 +1,659 @@
+"""Family F — SPMD / multi-host consistency rules, applied package-wide.
+
+The ROADMAP's next arc is pod-scale distributed training (ALX-style
+sharded ALS): every host runs the *same* program and the collectives
+only line up if the programs really are the same. The divergence bug
+classes are mechanical — a collective issued under host-dependent
+control flow deadlocks the pod; an ``axis_name`` that doesn't match the
+enclosing mesh axes fails at trace time on hardware you only get for a
+day; hash-ordered iteration feeding device placement gives every host a
+different operand order — so they are caught at AST level, like the
+Mosaic rules, before a pod ever runs:
+
+- ``spmd-collective-host-branch``: a collective (``psum``,
+  ``all_gather``, ...) inside an ``if jax.process_index() == 0:``-style
+  branch runs on *some* hosts only; the others block in the collective
+  until the heartbeat kills the job.
+- ``spmd-axis-name-mismatch``: a collective's literal ``axis_name``
+  must name an axis of the enclosing ``shard_map``/``pmap`` mesh;
+  anything else is an unbound-axis trace error on device day.
+- ``spmd-spec-rank-mismatch``: for a rank-preserving mapped body,
+  ``in_specs``/``out_specs`` literals of different ranks describe an
+  impossible sharding and die in shard_map's pytree/rank checks.
+- ``spmd-shard-map-arity``: ``in_specs`` entries must match the mapped
+  function's positional arity — a drifted spec list silently shards the
+  wrong operand before it fails.
+- ``spmd-unordered-collective-operand``: iterating a ``set`` to build
+  device operands (``device_put``/``make_array_from_single_device_arrays``
+  /collectives) is hash-order — different processes can disagree on the
+  order. Sort first.
+- ``spmd-host-dependent-rng``: ``PRNGKey(time/pid/urandom...)`` seeds
+  diverge across hosts and runs; inside a sharded function a
+  ``process_index()``-dependent seed makes the "same" program sample
+  different randomness per host.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from .engine import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    dotted_name,
+    walk_in_scope,
+)
+
+#: collective primitive → positional index of its axis-name argument
+_COLLECTIVES: Dict[str, int] = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "psum_scatter": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "axis_index": 0,
+}
+
+#: collectives that preserve operand rank (the spec-rank rule's scope);
+#: ``all_gather`` only with ``tiled=True``
+_RANK_PRESERVING = frozenset(
+    {"psum", "pmean", "pmax", "pmin", "psum_scatter", "ppermute"}
+)
+
+
+def _is_collective(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name not in _COLLECTIVES:
+        return False
+    dn = dotted_name(node.func)
+    return dn in (name, f"lax.{name}", f"jax.lax.{name}")
+
+
+def _collective_axis_arg(node: ast.Call) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    pos = _COLLECTIVES[call_name(node)]
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _is_host_divergent_call(node: ast.AST) -> bool:
+    """``jax.process_index()`` / ``host_id()``-shaped calls — values that
+    differ between the processes of one SPMD job."""
+    if not isinstance(node, ast.Call):
+        return False
+    tail = dotted_name(node.func).rsplit(".", 1)[-1]
+    return tail in ("process_index", "host_id")
+
+
+def _scopes(tree: ast.AST):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class CollectiveHostBranch(Rule):
+    """A collective under host-divergent control flow runs on a strict
+    subset of the job's processes; the rest block in their matching
+    collective (or skip it and desynchronize the program counter) until
+    the coordination service kills the job — the failure mode behind
+    hung pods that look healthy from every dashboard."""
+
+    id = "spmd-collective-host-branch"
+    severity = "error"
+    short = (
+        "collective (psum/all_gather/...) inside an "
+        "`if process_index() ...` branch — some hosts never issue it"
+    )
+    motivation = (
+        "the seed peer-death failure is exactly a pod blocking in a "
+        "collective its peer never reached; host-divergent control "
+        "flow writes that hang on purpose"
+    )
+
+    #: cheap source-text bail markers (no marker → no possible finding)
+    _MARKERS = ("process_index", "host_id", "process_info")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(m in ctx.source for m in self._MARKERS) or not any(
+            c in ctx.source for c in _COLLECTIVES
+        ):
+            return
+        for scope in _scopes(ctx.tree):
+            divergent_names = self._divergent_names(scope)
+            for stmt in walk_in_scope(scope):
+                if not isinstance(stmt, (ast.If, ast.While)):
+                    continue
+                if not self._test_is_divergent(stmt.test, divergent_names):
+                    continue
+                for sub in walk_in_scope(stmt):
+                    if isinstance(sub, ast.Call) and _is_collective(sub):
+                        yield self.finding(
+                            ctx,
+                            sub,
+                            f"{dotted_name(sub.func)}(...) under "
+                            "host-divergent control flow (the branch "
+                            "tests process_index/host_id): hosts that "
+                            "skip the branch never join the collective "
+                            "and the pod hangs — issue the collective "
+                            "unconditionally and branch on the result.",
+                        )
+
+    @staticmethod
+    def _divergent_names(scope: ast.AST) -> Set[str]:
+        """Names assigned (possibly tuple-unpacked) from a
+        process_index/host_id/process_info call in this scope."""
+        out: Set[str] = set()
+        for node in walk_in_scope(scope):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            tail = dotted_name(node.value.func).rsplit(".", 1)[-1]
+            if tail in ("process_index", "host_id"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif tail == "process_info":
+                # rank, world = process_info(): only the rank diverges
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and t.elts and \
+                            isinstance(t.elts[0], ast.Name):
+                        out.add(t.elts[0].id)
+        return out
+
+    @staticmethod
+    def _test_is_divergent(test: ast.AST, names: Set[str]) -> bool:
+        for node in ast.walk(test):
+            if _is_host_divergent_call(node):
+                return True
+            if isinstance(node, ast.Name) and node.id in names:
+                return True
+        return False
+
+
+def _resolve_mapped_fn(
+    call: ast.Call, ctx: FileContext
+) -> Optional[ast.AST]:
+    """The function/lambda a shard_map/pmap call maps — one resolution
+    semantics shared by every family-F rule (first matching def in tree
+    order), so no two rules can judge different bodies for one call."""
+    if not call.args:
+        return None
+    fn = call.args[0]
+    if isinstance(fn, ast.Lambda):
+        return fn
+    if isinstance(fn, ast.Name):
+        for sub in ast.walk(ctx.tree):
+            if isinstance(sub, ast.FunctionDef) and sub.name == fn.id:
+                return sub
+    return None
+
+
+def _mapped_functions(ctx: FileContext) -> List[ast.AST]:
+    """Function/lambda nodes passed as the mapped body to shard_map or
+    pmap anywhere in the file."""
+    out: List[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and call_name(node) in (
+            "shard_map", "pmap"
+        ):
+            fn = _resolve_mapped_fn(node, ctx)
+            if fn is not None:
+                out.append(fn)
+    return out
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _mesh_literal_axes(
+    node: ast.AST, scope: ast.AST, _depth: int = 0
+) -> Set[str]:
+    """Axis names from a ``Mesh(devices, ("a", "b"))`` literal — the
+    node itself, or one ``Name`` hop to its assignment in the SAME
+    scope (cross-scope lookups would collide on common names like
+    ``mesh``). Empty when not statically resolvable, or when the scope
+    assigns the name two different literal axis sets (ambiguous)."""
+    if isinstance(node, ast.Call) and call_name(node) == "Mesh":
+        candidates = list(node.args[1:2]) + [
+            kw.value for kw in node.keywords if kw.arg == "axis_names"
+        ]
+        for arg in candidates:
+            if isinstance(arg, (ast.Tuple, ast.List)) and arg.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in arg.elts
+            ):
+                return {e.value for e in arg.elts}
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return {arg.value}
+    if isinstance(node, ast.Name) and _depth < 1:
+        found: List[frozenset] = []
+        for sub in walk_in_scope(scope):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)
+                and sub.targets[0].id == node.id
+            ):
+                got = _mesh_literal_axes(sub.value, scope, _depth + 1)
+                if got:
+                    found.append(frozenset(got))
+        if len(set(found)) == 1:
+            return set(found[0])
+    return set()
+
+
+def _declared_axis_names(
+    call: ast.Call, scope: ast.AST
+) -> Set[str]:
+    """The COMPLETE axis universe a shard_map/pmap call binds, when it
+    is statically provable — pmap's literal ``axis_name``, or a
+    shard_map ``mesh=`` resolving to a ``Mesh(..., ("a", "b"))``
+    literal in the same scope. ``in_specs``/``out_specs`` are
+    deliberately NOT evidence: specs need not name every mesh axis, so
+    judging against them flags perfectly legal replicated-axis
+    collectives."""
+    if call_name(call) == "pmap":
+        axis_name = _kw(call, "axis_name")
+        if isinstance(axis_name, ast.Constant) and isinstance(
+            axis_name.value, str
+        ):
+            return {axis_name.value}
+        return set()
+    mesh = _kw(call, "mesh")
+    if mesh is None:
+        return set()
+    return _mesh_literal_axes(mesh, scope)
+
+
+class AxisNameMismatch(Rule):
+    """A collective inside a mapped body naming an axis the enclosing
+    shard_map/pmap does not bind is an unbound-axis error at trace time
+    — cheap at your desk, expensive on a hardware day. Judged only
+    against a provably complete axis universe (a ``Mesh`` literal or
+    pmap's ``axis_name``); meshes built dynamically pass."""
+
+    id = "spmd-axis-name-mismatch"
+    severity = "error"
+    short = (
+        "collective axis_name literal not among the enclosing "
+        "shard_map/pmap mesh axes (Mesh literal / pmap axis_name)"
+    )
+    motivation = (
+        "axis names are stringly-typed: a rename that misses one "
+        "psum compiles nowhere, and the trace error surfaces only "
+        "when the sharded path actually runs (on the TPU day)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "shard_map" not in ctx.source and "pmap" not in ctx.source:
+            return
+        for scope in _scopes(ctx.tree):
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(
+        self, ctx: FileContext, scope: ast.AST
+    ) -> Iterator[Finding]:
+        for node in walk_in_scope(scope):
+            if not isinstance(node, ast.Call) or call_name(node) not in (
+                "shard_map", "pmap"
+            ):
+                continue
+            declared = _declared_axis_names(node, scope)
+            if not declared:
+                continue  # axis universe not statically known
+            fn = _resolve_mapped_fn(node, ctx)
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call) or not _is_collective(sub):
+                    continue
+                axis = _collective_axis_arg(sub)
+                if isinstance(axis, ast.Constant) and isinstance(
+                    axis.value, str
+                ) and axis.value not in declared:
+                    yield self.finding(
+                        ctx,
+                        sub,
+                        f"{dotted_name(sub.func)}(..., "
+                        f"{axis.value!r}) names an axis the enclosing "
+                        f"shard_map/pmap does not bind "
+                        f"({sorted(declared)}): unbound axis_name — "
+                        "trace-time failure on the sharded path.",
+                    )
+
+
+def _spec_ranks(value: ast.AST) -> Optional[List[int]]:
+    """Ranks of P(...)/PartitionSpec(...) literals in an in_specs/
+    out_specs value. None when any entry is not a starless P literal
+    (unknowable statically)."""
+    specs: List[ast.AST]
+    if isinstance(value, (ast.Tuple, ast.List)):
+        specs = list(value.elts)
+    else:
+        specs = [value]
+    ranks: List[int] = []
+    for spec in specs:
+        if not (
+            isinstance(spec, ast.Call)
+            and call_name(spec) in ("P", "PartitionSpec")
+            and not spec.keywords
+            and all(not isinstance(a, ast.Starred) for a in spec.args)
+        ):
+            return None
+        ranks.append(len(spec.args))
+    return ranks
+
+
+class SpecRankMismatch(Rule):
+    """For a rank-preserving mapped body (a lambda that is just a
+    psum/ppermute/... or a tiled all_gather), the in_specs and
+    out_specs literals must agree on rank; a mismatch is an impossible
+    sharding that dies inside shard_map's checks at trace time."""
+
+    id = "spmd-spec-rank-mismatch"
+    severity = "error"
+    short = (
+        "shard_map in_specs/out_specs literal ranks disagree for a "
+        "rank-preserving collective body"
+    )
+    motivation = (
+        "spec literals drift when an array gains a dimension; the "
+        "error XLA finally raises names pytree internals, not the "
+        "spec that went stale"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "shard_map" not in ctx.source:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    call_name(node) != "shard_map":
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Lambda):
+                continue
+            body = node.args[0].body
+            if not (
+                isinstance(body, ast.Call)
+                and _is_collective(body)
+                and self._rank_preserving(body)
+            ):
+                continue
+            in_specs = _kw(node, "in_specs")
+            out_specs = _kw(node, "out_specs")
+            if in_specs is None or out_specs is None:
+                continue
+            in_ranks = _spec_ranks(in_specs)
+            out_ranks = _spec_ranks(out_specs)
+            if in_ranks is None or out_ranks is None:
+                continue
+            all_ranks = set(in_ranks) | set(out_ranks)
+            if len(all_ranks) > 1:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"in_specs ranks {in_ranks} vs out_specs ranks "
+                    f"{out_ranks} for a rank-preserving "
+                    f"{call_name(body)} body: the specs describe "
+                    "arrays of different ranks — one of them is stale.",
+                )
+
+    @staticmethod
+    def _rank_preserving(body: ast.Call) -> bool:
+        name = call_name(body)
+        if name in _RANK_PRESERVING:
+            return True
+        if name == "all_gather":
+            tiled = next(
+                (kw.value for kw in body.keywords if kw.arg == "tiled"),
+                None,
+            )
+            return isinstance(tiled, ast.Constant) and tiled.value is True
+        return False
+
+
+class ShardMapArity(Rule):
+    """``in_specs`` is positional: a tuple literal whose length differs
+    from the mapped function's positional arity shards the wrong
+    operands (or fails in pytree matching) — the kind of drift a
+    refactor that adds one argument leaves behind."""
+
+    id = "spmd-shard-map-arity"
+    severity = "error"
+    short = (
+        "shard_map in_specs tuple length differs from the mapped "
+        "function's positional arity"
+    )
+    motivation = (
+        "adding an operand to a mapped solve without extending "
+        "in_specs is a silent mis-sharding until the shape check "
+        "finally trips far from the cause"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "shard_map" not in ctx.source:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    call_name(node) != "shard_map":
+                continue
+            in_specs = _kw(node, "in_specs")
+            if not isinstance(in_specs, (ast.Tuple, ast.List)):
+                continue
+            fn = _resolve_mapped_fn(node, ctx)
+            if fn is None:
+                continue
+            args = fn.args
+            if args.vararg is not None:
+                continue  # *args: arity not statically known
+            n_params = len(args.posonlyargs) + len(args.args)
+            # defaulted params are optional operands: a spec count
+            # anywhere in [required, total] is a legal call shape
+            n_required = n_params - len(args.defaults)
+            n_specs = len(in_specs.elts)
+            if not (n_required <= n_specs <= n_params):
+                fn_name = getattr(fn, "name", "<lambda>")
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"in_specs has {n_specs} entries but mapped "
+                    f"function {fn_name!r} takes "
+                    f"{n_required}-{n_params} positional arguments — "
+                    "the specs and the operands have drifted apart.",
+                )
+
+
+#: calls that place data on devices in operand order
+_DEVICE_FEEDERS = frozenset(
+    {"device_put", "make_array_from_single_device_arrays"}
+)
+
+
+def _is_set_expr(
+    node: ast.AST, scope: ast.AST, _seen: Optional[Set[str]] = None
+) -> bool:
+    """Is ``node`` (a loop/comprehension iterable) a hash-ordered set —
+    a set literal/comprehension, a set()/frozenset() call, or a name
+    assigned one of those in this scope?"""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and call_name(node) in (
+        "set", "frozenset"
+    ) and dotted_name(node.func) in ("set", "frozenset"):
+        return True
+    if isinstance(node, ast.Name):
+        seen = _seen if _seen is not None else set()
+        if node.id in seen:
+            return False  # self-referential assignment: give up
+        seen.add(node.id)
+        for sub in ast.walk(scope):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)
+                and sub.targets[0].id == node.id
+                and _is_set_expr(sub.value, scope, seen)
+            ):
+                return True
+    return False
+
+
+class UnorderedCollectiveOperand(Rule):
+    """Set iteration order is hash order: two processes building device
+    operands from "the same" set can disagree on element order, so the
+    collectives see permuted operands — cross-host nondeterminism that
+    no single-host test reproduces. Iterate ``sorted(...)`` instead."""
+
+    id = "spmd-unordered-collective-operand"
+    severity = "error"
+    short = (
+        "set iteration feeding device_put / collective operands "
+        "(hash order differs across processes)"
+    )
+    motivation = (
+        "per-host operand order IS program semantics under SPMD; a "
+        "set-ordered device_put loop is the distributed twin of the "
+        "round-5 nondeterministic-gather bug"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(f in ctx.source for f in _DEVICE_FEEDERS) and not any(
+            c in ctx.source for c in _COLLECTIVES
+        ):
+            return
+        for scope in _scopes(ctx.tree):
+            for node in walk_in_scope(scope):
+                body: List[ast.AST]
+                if isinstance(node, ast.For):
+                    if not _is_set_expr(node.iter, scope):
+                        continue
+                    body = list(node.body)
+                elif isinstance(
+                    node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)
+                ):
+                    if not any(
+                        _is_set_expr(gen.iter, scope)
+                        for gen in node.generators
+                    ):
+                        continue
+                    body = [node.elt]
+                else:
+                    continue
+                for part in body:
+                    for sub in ast.walk(part):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        if call_name(sub) in _DEVICE_FEEDERS or \
+                                _is_collective(sub):
+                            yield self.finding(
+                                ctx,
+                                sub,
+                                f"{call_name(sub)}(...) fed from set "
+                                "iteration: hash order differs across "
+                                "processes, so hosts disagree on "
+                                "operand order — iterate "
+                                "sorted(<set>) instead.",
+                            )
+
+
+#: dotted call names whose value differs per host/run
+_NONDETERMINISTIC_SEEDS = frozenset(
+    {
+        "time.time", "time.time_ns", "time.monotonic",
+        "os.getpid", "getpid", "os.urandom", "urandom",
+        "uuid.uuid4", "uuid4", "secrets.token_hex", "secrets.token_bytes",
+        "secrets.randbits", "getrandbits",
+    }
+)
+
+
+class HostDependentRng(Rule):
+    """RNG seeds derived from wall clocks/pids diverge across hosts and
+    runs; inside a sharded (shard_map/pmap-mapped) function a
+    ``process_index()``-derived seed makes each host sample different
+    randomness in a program that must be identical everywhere."""
+
+    id = "spmd-host-dependent-rng"
+    severity = "error"
+    short = (
+        "PRNGKey seeded from time/pid/urandom (anywhere) or "
+        "process_index (inside a sharded function)"
+    )
+    motivation = (
+        "ALX-style sharded ALS initializes factor shards from RNG; a "
+        "host-divergent seed silently trains a different model per "
+        "host and the first symptom is an accuracy regression"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "PRNGKey" not in ctx.source and "random.key" not in ctx.source:
+            return
+        mapped = _mapped_functions(ctx)
+
+        def inside_mapped(node: ast.AST) -> bool:
+            return any(
+                any(sub is node for sub in ast.walk(fn)) for fn in mapped
+            )
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            dn = dotted_name(node.func)
+            if not (
+                name == "PRNGKey" or dn.endswith("random.key")
+            ):
+                continue
+            seed = node.args[0] if node.args else _kw(node, "seed")
+            if seed is None:
+                continue
+            for sub in ast.walk(seed):
+                if not isinstance(sub, ast.Call):
+                    continue
+                sub_dn = dotted_name(sub.func)
+                if sub_dn in _NONDETERMINISTIC_SEEDS:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"PRNGKey seeded from {sub_dn}(...): the seed "
+                        "differs per host and per run — derive seeds "
+                        "from configuration (and fold in a *rank* only "
+                        "deliberately, outside sharded bodies).",
+                    )
+                    break
+                if _is_host_divergent_call(sub) and inside_mapped(node):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        "PRNGKey seeded from process_index() inside a "
+                        "sharded function: each host samples different "
+                        "randomness in a program that must be "
+                        "identical everywhere — seed outside the "
+                        "mapped body and shard the key explicitly.",
+                    )
+                    break
+
+
+RULES: List[Rule] = [
+    CollectiveHostBranch(),
+    AxisNameMismatch(),
+    SpecRankMismatch(),
+    ShardMapArity(),
+    UnorderedCollectiveOperand(),
+    HostDependentRng(),
+]

@@ -28,6 +28,7 @@ else stays inside a slice on ICI.
 
 from __future__ import annotations
 
+import inspect
 import os
 from typing import Dict, Optional, Tuple
 
@@ -41,6 +42,15 @@ def initialize_from_env(env: Optional[Dict[str, str]] = None) -> bool:
 
     Returns True when running multi-process (after initialization).
     Idempotent: repeated calls are safe.
+
+    ``PIO_DIST_HEARTBEAT_S`` is forwarded as
+    ``heartbeat_timeout_seconds`` only on jax versions whose
+    ``jax.distributed.initialize`` accepts it — the kwarg came and went
+    across releases, and passing it blindly made *every* multi-process
+    start raise ``TypeError`` before a single collective ran (the root
+    cause of both distributed seed-test failures, ROUND6_NOTES.md).
+    Where unsupported, peer-death detection falls back to the
+    coordination service's own timeouts.
     """
     e = env if env is not None else os.environ
     coordinator = e.get("PIO_DIST_COORDINATOR")
@@ -50,12 +60,20 @@ def initialize_from_env(env: Optional[Dict[str, str]] = None) -> bool:
         return True
     num = int(e.get("PIO_DIST_NUM_PROCESSES", "1"))
     pid = int(e.get("PIO_DIST_PROCESS_ID", "0"))
-    jax.distributed.initialize(
+    kwargs = dict(
         coordinator_address=coordinator,
         num_processes=num,
         process_id=pid,
-        heartbeat_timeout_seconds=int(e.get("PIO_DIST_HEARTBEAT_S", "100")),
     )
+    try:
+        params = inspect.signature(jax.distributed.initialize).parameters
+    except (TypeError, ValueError):  # C accelerated / exotic wrappers
+        params = {}
+    if "heartbeat_timeout_seconds" in params:
+        kwargs["heartbeat_timeout_seconds"] = int(
+            e.get("PIO_DIST_HEARTBEAT_S", "100")
+        )
+    jax.distributed.initialize(**kwargs)
     initialize_from_env._initialized = True
     return True
 

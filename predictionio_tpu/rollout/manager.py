@@ -146,17 +146,23 @@ class RolloutManager:
         )
 
     # -- introspection ----------------------------------------------------
+    # Scrape-thread gauge callbacks: these run on the /metrics handler
+    # thread, so they take the manager lock like every other cross-thread
+    # reader (conc-unguarded-attr). The critical section is two attribute
+    # reads — a scrape can never convoy behind it.
     def _stage_code(self) -> int:
-        plan = self.plan
-        return _STAGE_CODES.get(plan.stage if plan else None, 0)
+        with self._lock:
+            plan = self.plan
+            return _STAGE_CODES.get(plan.stage if plan else None, 0)
 
     def _live_percent(self) -> float:
-        plan = self.plan
-        if plan is None:
-            return 0.0
-        if plan.stage == ROLLOUT_CANARY:
-            return float(plan.percent)
-        return 100.0 if plan.stage == ROLLOUT_LIVE else 0.0
+        with self._lock:
+            plan = self.plan
+            if plan is None:
+                return 0.0
+            if plan.stage == ROLLOUT_CANARY:
+                return float(plan.percent)
+            return 100.0 if plan.stage == ROLLOUT_LIVE else 0.0
 
     @property
     def active(self) -> bool:
@@ -490,15 +496,23 @@ class RolloutManager:
             with self._lock:
                 self._shadow_pending -= 1
             return None
-        self._shadow_futures.append(future)
+        with self._lock:
+            self._shadow_futures.append(future)
         return future
 
     def drain_shadow(self, timeout_s: float = 30.0) -> None:
         """Wait for every outstanding shadow duplicate (deterministic
         tests and the loadgen chaos scenario; never called on the
-        request path)."""
-        while self._shadow_futures:
-            self._shadow_futures.popleft().result(timeout=timeout_s)
+        request path). The deque is popped under the manager lock —
+        concurrent drains (or a drain racing submit_shadow) must never
+        pop the same future twice or IndexError on an emptied deque —
+        while the blocking result() wait happens outside it."""
+        while True:
+            with self._lock:
+                if not self._shadow_futures:
+                    return
+                future = self._shadow_futures.popleft()
+            future.result(timeout=timeout_s)
 
     def _run_shadow(self, dep, payload, baseline_result) -> None:
         t0 = self.clock()

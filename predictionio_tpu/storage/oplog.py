@@ -210,6 +210,7 @@ class OpLog:
             self._size += len(record)
             self._unsynced += 1
             if self._unsynced >= self._sync_every:
+                # pio: lint-ok[conc-blocking-under-lock] the fsync IS the critical section: acks must not reorder against appends, so durability happens under the same lock
                 os.fsync(self._fh.fileno())
                 self._unsynced = 0
             return seq
@@ -217,12 +218,14 @@ class OpLog:
     def sync(self) -> None:
         with self._lock:
             if self._fh is not None:
+                # pio: lint-ok[conc-blocking-under-lock] durability barrier: a concurrent append must not land between the fsync and the cadence reset
                 os.fsync(self._fh.fileno())
                 self._unsynced = 0
 
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
+                # pio: lint-ok[conc-blocking-under-lock] final durability barrier before the handle dies; nothing else can need this lock afterwards
                 os.fsync(self._fh.fileno())
                 self._fh.close()
                 self._fh = None

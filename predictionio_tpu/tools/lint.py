@@ -1,31 +1,47 @@
 """``pio lint`` / ``python -m predictionio_tpu.tools.lint`` — run the
 TPU-hygiene static analyzer over files or directories.
 
-Exit code 0 when every finding is suppressed (with a reason), 1
-otherwise — the same contract as the tier-1 gate in
-``tests/test_lint.py``, so CI, the pre-window checklist
-(docs/hardware_day.md) and the watcher all read the same signal.
+Exit codes are pinned (the contract the tier-1 gate, CI and the
+pre-window checklist all read):
+
+- ``0`` — clean: every finding suppressed (with a reason) or baselined
+- ``1`` — unsuppressed findings remain
+- ``2`` — engine error: a file failed to parse, a target path does not
+  exist, git could not enumerate ``--changed`` files, or the
+  ``--baseline`` file is unreadable — the run proved *nothing*, which
+  must never be mistaken for "clean" OR for "has findings"
+
 ``--format json`` emits one machine-readable document on stdout.
+``--changed`` lints only files git reports as modified/added/untracked
+(diff-scoped pre-commit runs); ``--baseline FILE`` adopts legacy
+findings recorded by an earlier ``--format json`` run and ratchets:
+baselined debt is absorbed, anything new still fails.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 from ..lint import all_rules, lint_paths, render_json, render_text
+from ..lint.engine import apply_baseline, load_baseline
 
 #: default lint target: the installed package itself
 PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_ENGINE_ERROR = 2
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pio lint",
-        description="TPU-hygiene static analysis (Mosaic + jit-boundary "
-        "rules; see docs/lint.md)",
+        description="TPU-hygiene static analysis (Mosaic/jit/robust/obs/"
+        "conc/spmd rules; see docs/lint.md)",
     )
     p.add_argument(
         "paths", nargs="*", default=None,
@@ -39,6 +55,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--select", default=None,
         help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="lint only files git reports changed (working tree + index "
+        "+ untracked) under the target paths — the pre-commit scope",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="adopt legacy findings recorded by an earlier "
+        "`pio lint --format json > FILE` run: baselined findings are "
+        "absorbed (reported, not fatal), new ones still fail — the "
+        "ratchet never loosens",
     )
     p.add_argument(
         "--list-rules", action="store_true",
@@ -63,6 +91,71 @@ def _emit(text: str) -> None:
             pass
 
 
+def changed_files(paths: Sequence[str]) -> List[str]:
+    """Python files git reports as changed (unstaged, staged, or
+    untracked) that live under one of ``paths``. Raises RuntimeError
+    when git cannot answer — the caller maps that to exit 2, because a
+    silent empty set would read as "nothing to lint: clean".
+
+    Git runs against the repository *containing the first target path*,
+    not the process cwd (``pio lint --changed /other/repo`` must see
+    that repo's status), and reads ``--porcelain -z`` so file names
+    with spaces/non-ASCII arrive verbatim instead of C-quoted (a
+    quoted name would fail the existence check and silently vanish
+    from the scope)."""
+    roots = [os.path.abspath(p) for p in paths]
+    anchor = roots[0]
+    git_cwd = anchor if os.path.isdir(anchor) else (
+        os.path.dirname(anchor) or "."
+    )
+
+    def _git(*args: str) -> subprocess.CompletedProcess:
+        try:
+            return subprocess.run(
+                ["git", *args], capture_output=True, text=True,
+                timeout=30, cwd=git_cwd,
+            )
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            raise RuntimeError(f"git {args[0]} failed: {exc}")
+
+    proc = _git("status", "--porcelain", "-z", "--untracked-files=all")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git status failed: {proc.stderr.strip() or proc.returncode}"
+        )
+    top_proc = _git("rev-parse", "--show-toplevel")
+    if top_proc.returncode != 0:
+        raise RuntimeError(
+            "git rev-parse failed: "
+            f"{top_proc.stderr.strip() or top_proc.returncode}"
+        )
+    top = top_proc.stdout.strip()
+    out: List[str] = []
+    entries = proc.stdout.split("\0")
+    i = 0
+    while i < len(entries):
+        entry = entries[i]
+        i += 1
+        if len(entry) < 4:
+            continue
+        status, path = entry[:2], entry[3:]
+        if status[0] in ("R", "C"):
+            i += 1  # -z renames/copies: the NEXT entry is the OLD path
+        if status.strip() == "D":
+            continue  # deleted: nothing to lint
+        if not path.endswith(".py"):
+            continue
+        abspath = os.path.abspath(os.path.join(top, path))
+        if not os.path.exists(abspath):
+            continue
+        if any(
+            abspath == root or abspath.startswith(root + os.sep)
+            for root in roots
+        ):
+            out.append(abspath)
+    return sorted(out)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
@@ -70,17 +163,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             f"{rule.id} [{rule.severity}]: {rule.short}"
             for rule in all_rules()
         ))
-        return 0
+        return EXIT_CLEAN
     paths = args.paths or [PACKAGE_DIR]
+    # validate the baseline BEFORE any early return: a typo'd baseline
+    # path must be exit 2 even on a day when --changed finds nothing —
+    # otherwise CI reads "clean" until the first changed file exposes
+    # the broken configuration
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            _emit(f"error: --baseline: {exc}")
+            return EXIT_ENGINE_ERROR
+    if args.changed:
+        try:
+            paths = changed_files(paths)
+        except RuntimeError as exc:
+            _emit(f"error: --changed: {exc}")
+            return EXIT_ENGINE_ERROR
+        if not paths:
+            # the empty-scope happy path must still honor --format json:
+            # a CI consumer piping into a JSON parser hits this on every
+            # clean run
+            if args.format == "json":
+                _emit(render_json(lint_paths([])))
+            else:
+                _emit(
+                    "0 files, 0 findings, 0 suppressed (no changed files)"
+                )
+            return EXIT_CLEAN
     select = (
         {token.strip() for token in args.select.split(",") if token.strip()}
         if args.select
         else None
     )
     result = lint_paths(paths, select=select)
+    if baseline is not None:
+        apply_baseline(result, baseline)
     _emit(render_json(result) if args.format == "json"
           else render_text(result))
-    return 0 if result.ok else 1
+    if result.errors:
+        return EXIT_ENGINE_ERROR
+    return EXIT_CLEAN if not result.findings else EXIT_FINDINGS
 
 
 if __name__ == "__main__":

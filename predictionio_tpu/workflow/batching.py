@@ -122,11 +122,6 @@ class MicroBatcher:
                 "pio_batch_failures_total",
                 "Batches whose processor raised (all items failed)",
             )
-            metrics.gauge_callback(
-                "pio_batch_queue_depth",
-                lambda: len(self._items),
-                "Items waiting for the next batch",
-            )
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._items: List[Any] = []
@@ -134,6 +129,15 @@ class MicroBatcher:
         #: parallel to _items: (enqueue_ts, submitter SpanContext or None)
         self._meta: List[Tuple[float, Any]] = []
         self._closed = False
+        if metrics is not None:
+            # registered only now: the registry is shared, so a scrape
+            # can fire the callback the instant it registers — the lock
+            # and the queue it reads must already exist
+            metrics.gauge_callback(
+                "pio_batch_queue_depth",
+                self._queue_depth,
+                "Items waiting for the next batch",
+            )
         self._batches = 0
         self._submitted = 0
         self._inflight_hwm = 0  # high-water mark of concurrent batches
@@ -155,6 +159,12 @@ class MicroBatcher:
             target=self._run, name=name, daemon=True
         )
         self._dispatcher.start()
+
+    def _queue_depth(self) -> int:
+        """Scrape-thread gauge callback: reads the queue under the same
+        lock the request/dispatcher threads mutate it under."""
+        with self._lock:
+            return len(self._items)
 
     # -- client side ------------------------------------------------------
     def submit(self, item: Any, timeout: Optional[float] = None) -> Any:
@@ -220,7 +230,9 @@ class MicroBatcher:
             items, futures, metas, reason = self._take_batch()
             if not items:
                 self._slots.release()
-                if self._closed:
+                with self._lock:
+                    closed = self._closed
+                if closed:
                     return
                 continue
             with self._lock:

@@ -1,0 +1,11 @@
+"""Family E fixture: lock.acquire() leaked on the exception path."""
+
+import threading
+
+_LOCK = threading.Lock()
+
+
+def update(registry, key, value):
+    _LOCK.acquire()  # BAD: an exception below leaks the lock forever
+    registry[key] = value
+    _LOCK.release()

@@ -1,0 +1,25 @@
+"""Clean twin: finally-release, `with`, and the semaphore hand-off
+exemption (acquired here, released by the worker — that is what a
+semaphore is for)."""
+
+import threading
+
+_LOCK = threading.Lock()
+_SLOTS = threading.Semaphore(2)
+
+
+def update(registry, key, value):
+    _LOCK.acquire()
+    try:
+        registry[key] = value
+    finally:
+        _LOCK.release()
+
+
+def read(registry, key):
+    with _LOCK:
+        return registry.get(key)
+
+
+def take_slot():
+    _SLOTS.acquire()

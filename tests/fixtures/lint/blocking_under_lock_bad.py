@@ -1,0 +1,15 @@
+"""Family E fixture: sleeping while holding the registry lock."""
+
+import threading
+import time
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def refresh(self, debounce_s):
+        with self._lock:
+            time.sleep(debounce_s)  # BAD: every reader waits out the sleep
+            self._state["refreshed"] = True

@@ -1,0 +1,15 @@
+"""Clean twin: the blocking work happens outside the critical section."""
+
+import threading
+import time
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def refresh(self, debounce_s):
+        time.sleep(debounce_s)
+        with self._lock:
+            self._state["refreshed"] = True

@@ -1,0 +1,9 @@
+"""Family F fixture: a collective only some hosts ever issue."""
+
+import jax
+
+
+def global_norm(x, axis):
+    if jax.process_index() == 0:
+        return jax.lax.psum(x, axis)  # BAD: other hosts hang in their psum
+    return x

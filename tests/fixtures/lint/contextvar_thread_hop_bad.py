@@ -1,0 +1,13 @@
+"""Family E fixture: ambient contextvar read after the thread hop."""
+
+import contextvars
+
+_REQUEST = contextvars.ContextVar("request", default=None)
+
+
+def handle(pool, payload):
+    def deliver():
+        ctx = _REQUEST.get()  # BAD: the worker thread's context is empty
+        return (ctx, payload)
+
+    return pool.submit(deliver)

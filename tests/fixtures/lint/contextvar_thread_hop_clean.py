@@ -1,0 +1,14 @@
+"""Clean twin: the context is captured at submit time and passed in."""
+
+import contextvars
+
+_REQUEST = contextvars.ContextVar("request", default=None)
+
+
+def handle(pool, payload):
+    ctx = _REQUEST.get()  # captured on the request thread
+
+    def deliver():
+        return (ctx, payload)
+
+    return pool.submit(deliver)

@@ -1,0 +1,10 @@
+"""Family F fixture: RNG seeded from the wall clock."""
+
+import time
+
+import jax
+
+
+def init_factors(shape):
+    key = jax.random.PRNGKey(int(time.time()))  # BAD: differs per host/run
+    return jax.random.normal(key, shape)

@@ -1,0 +1,8 @@
+"""Clean twin: the seed comes from configuration."""
+
+import jax
+
+
+def init_factors(shape, seed):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.normal(key, shape)

@@ -1,0 +1,18 @@
+"""Family E fixture: two locks nested in opposite orders."""
+
+import threading
+
+_ROUTES = threading.Lock()
+_MODELS = threading.Lock()
+
+
+def swap_model(routes, models):
+    with _ROUTES:
+        with _MODELS:
+            models.update(routes)
+
+
+def reroute(routes, models):
+    with _MODELS:
+        with _ROUTES:  # BAD: reversed nesting deadlocks against swap_model
+            routes.update(models)

@@ -1,0 +1,18 @@
+"""Clean twin: one pinned acquisition order everywhere."""
+
+import threading
+
+_ROUTES = threading.Lock()
+_MODELS = threading.Lock()
+
+
+def swap_model(routes, models):
+    with _ROUTES:
+        with _MODELS:
+            models.update(routes)
+
+
+def reroute(routes, models):
+    with _ROUTES:
+        with _MODELS:
+            routes.update(models)

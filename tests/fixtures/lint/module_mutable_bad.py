@@ -1,0 +1,15 @@
+"""Family E fixture: module-level registry mutated at request time."""
+
+import threading
+
+_HANDLERS = {}
+_LOCK = threading.Lock()
+
+
+def register(name, handler):
+    _HANDLERS[name] = handler  # BAD: server threads race the registry
+
+
+def lookup(name):
+    with _LOCK:
+        return _HANDLERS.get(name)

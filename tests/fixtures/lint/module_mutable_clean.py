@@ -1,0 +1,16 @@
+"""Clean twin: every mutation holds the module lock."""
+
+import threading
+
+_HANDLERS = {}
+_LOCK = threading.Lock()
+
+
+def register(name, handler):
+    with _LOCK:
+        _HANDLERS[name] = handler
+
+
+def lookup(name):
+    with _LOCK:
+        return _HANDLERS.get(name)

@@ -1,0 +1,18 @@
+"""Family E fixture: lock-guarded attr read bare on the scrape thread."""
+
+import threading
+
+
+class ShadowPool:
+    def __init__(self, metrics):
+        self._lock = threading.Lock()
+        self._pending = 0
+        metrics.gauge_callback("pool_pending", self._depth, "queue depth")
+
+    def submit(self, item):
+        with self._lock:
+            self._pending += 1
+        return item
+
+    def _depth(self):
+        return self._pending  # BAD: guarded attr, bare read on scrape thread

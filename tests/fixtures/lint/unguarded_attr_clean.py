@@ -1,0 +1,19 @@
+"""Clean twin: the scrape-thread read takes the same lock."""
+
+import threading
+
+
+class ShadowPool:
+    def __init__(self, metrics):
+        self._lock = threading.Lock()
+        self._pending = 0
+        metrics.gauge_callback("pool_pending", self._depth, "queue depth")
+
+    def submit(self, item):
+        with self._lock:
+            self._pending += 1
+        return item
+
+    def _depth(self):
+        with self._lock:
+            return self._pending

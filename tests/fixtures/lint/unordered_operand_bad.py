@@ -1,0 +1,10 @@
+"""Family F fixture: hash-ordered set iteration feeding device placement."""
+
+import jax
+
+
+def place_shards(shards):
+    out = []
+    for s in set(shards):
+        out.append(jax.device_put(s))  # BAD: hosts disagree on the order
+    return out

@@ -289,6 +289,47 @@ class TestPipelinedDispatch:
             release.set()
 
 
+class TestQueueDepthGauge:
+    """Regression tests for the ISSUE-6 ``conc-unguarded-attr`` sweep
+    finding: the queue-depth gauge callback read ``self._items`` from
+    the scrape thread without the batcher lock."""
+
+    def test_gauge_is_registered_and_reads_zero_when_idle(self):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        mb = MicroBatcher(
+            lambda items: list(items), max_wait_ms=0.0, metrics=metrics
+        )
+        try:
+            metrics.collect()  # refresh callback gauges
+            assert metrics.gauge("pio_batch_queue_depth").value() == 0.0
+        finally:
+            mb.close()
+
+    def test_queue_depth_reads_under_the_batcher_lock(self):
+        mb = MicroBatcher(lambda items: list(items), max_wait_ms=0.0)
+        try:
+            got = []
+            mb._lock.acquire()
+            try:
+                t = threading.Thread(
+                    target=lambda: got.append(mb._queue_depth())
+                )
+                t.start()
+                t.join(timeout=0.05)
+                assert t.is_alive(), (
+                    "queue-depth callback returned while the batcher "
+                    "lock was held — it reads _items without the lock"
+                )
+            finally:
+                mb._lock.release()
+            t.join(timeout=30)
+            assert got == [0]
+        finally:
+            mb.close()
+
+
 class TestBatchedServing:
     def test_batched_and_unbatched_agree(self, registry):
         from predictionio_tpu.workflow.serving import QueryServer, ServerConfig

@@ -179,7 +179,11 @@ def test_peer_death_is_loud_and_resume_continues(tmp_path):
     for t in threads:
         t.start()
 
-    # watch rank 0's stdout; kill rank 1 once training has made progress
+    # watch rank 0's stdout; kill rank 1 once training has made progress.
+    # Fail FAST when the children die before ever reaching STEP_3 (e.g.
+    # a jax.distributed.initialize API error): polling a dead process
+    # until the deadline would burn minutes of the tier-1 870 s budget
+    # on a failure that was fully diagnosed in the first second.
     killed_at = None
     deadline = time.monotonic() + 120
     try:
@@ -187,14 +191,16 @@ def test_peer_death_is_loud_and_resume_continues(tmp_path):
             try:
                 line = out_q.get(timeout=1.0)
             except queue_mod.Empty:
+                if procs[0].poll() is not None and out_q.empty():
+                    break  # rank 0 already dead: no STEP_3 is coming
                 continue
             if line.startswith("STEP_3"):
                 procs[1].kill()
                 killed_at = 3
                 break
         assert killed_at == 3, (
-            f"never reached STEP_3 within deadline: {sinks[0]['out'][-20:]} "
-            f"stderr: {sinks[0]['err'][-10:]}"
+            f"never reached STEP_3 (rank0 rc={procs[0].poll()}): "
+            f"{sinks[0]['out'][-20:]} stderr: {sinks[0]['err'][-10:]}"
         )
 
         # 1) loud failure: rank 0 must EXIT NONZERO within the bound
@@ -209,8 +215,13 @@ def test_peer_death_is_loud_and_resume_continues(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        procs[1].wait()
-        procs[0].wait()
+        for p in procs:
+            try:
+                # bounded reap: a kill that somehow doesn't stick must
+                # fail this test, not wedge the whole tier-1 run
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
     for t in threads:
         t.join(timeout=10)
     err0 = "\n".join(sinks[0]["err"])
@@ -231,7 +242,9 @@ def test_peer_death_is_loud_and_resume_continues(tmp_path):
         env=env,
         capture_output=True,
         text=True,
-        timeout=300,
+        # single-process, 12 steps: 180 s is 10x generous; the old 300 s
+        # budget let one hung resume eat a third of the tier-1 window
+        timeout=180,
     )
     assert out.returncode == 0, out.stderr[-3000:]
     resumed = [

@@ -100,12 +100,20 @@ def test_two_process_psum(tmp_path):
             )
         )
     outs = []
+    # 120 s covers a cold two-process jax init with margin; a hang past
+    # it is the failure being diagnosed, and the kill below bounds the
+    # damage to one timeout instead of wedging the tier-1 budget
     for rank, proc in enumerate(procs):
         try:
-            out, err = proc.communicate(timeout=150)
+            out, err = proc.communicate(timeout=120)
         except subprocess.TimeoutExpired:
             for p in procs:
                 p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
             pytest.fail(f"rank {rank} timed out")
         outs.append((proc.returncode, out, err))
     for rank, (rc, out, err) in enumerate(outs):

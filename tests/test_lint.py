@@ -131,6 +131,91 @@ class TestRobustFixtures:
         )
 
 
+#: family E/F fixture slug → the one rule its bad twin must trip
+_CONC_FIXTURES = [
+    ("unguarded_attr", "conc-unguarded-attr"),
+    ("acquire_no_with", "conc-acquire-no-with"),
+    ("blocking_under_lock", "conc-blocking-under-lock"),
+    ("lock_order", "conc-lock-order"),
+    ("module_mutable", "conc-module-mutable"),
+    ("contextvar_thread_hop", "conc-contextvar-thread-hop"),
+]
+
+_SPMD_FIXTURES = [
+    ("collective_host_branch", "spmd-collective-host-branch"),
+    ("axis_name_mismatch", "spmd-axis-name-mismatch"),
+    ("spec_rank_mismatch", "spmd-spec-rank-mismatch"),
+    ("shard_map_arity", "spmd-shard-map-arity"),
+    ("unordered_operand", "spmd-unordered-collective-operand"),
+    ("host_dependent_rng", "spmd-host-dependent-rng"),
+]
+
+
+class TestConcSpmdFixtures:
+    """Family E (concurrency) and family F (SPMD) bad/clean twins, same
+    contract as the other families: the bad twin fires exactly its
+    intended rule at the marked line, the clean twin is silent under the
+    FULL rule set (no cross-family false positives)."""
+
+    @pytest.mark.parametrize("slug,rule_id", _CONC_FIXTURES + _SPMD_FIXTURES)
+    def test_bad_fixture_fires_exactly_intended_rule(self, slug, rule_id):
+        path = os.path.join(FIXTURES, f"{slug}_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [rule_id], (
+            f"{slug}: expected exactly one {rule_id} finding, got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+        assert findings[0].line == _marker_line(path, "BAD")
+
+    @pytest.mark.parametrize(
+        "slug", [s for s, _ in _CONC_FIXTURES + _SPMD_FIXTURES]
+    )
+    def test_clean_twin_has_no_findings(self, slug):
+        path = os.path.join(FIXTURES, f"{slug}_clean.py")
+        findings = lint_file(path)
+        assert findings == [], (
+            f"false positive(s) on clean twin {slug}: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    @pytest.mark.parametrize(
+        "slug,rule_id",
+        [_CONC_FIXTURES[0], _SPMD_FIXTURES[0]],
+        ids=["conc", "spmd"],
+    )
+    def test_suppression_without_reason_is_a_finding(self, slug, rule_id):
+        """Per-family: a bare suppression on a family E/F finding is
+        itself a finding — the reason stays mandatory for the new
+        families."""
+        path = os.path.join(FIXTURES, f"{slug}_bad.py")
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+        marker = _marker_line(path, "BAD") - 1
+        code = lines[marker].split("#")[0].rstrip()
+        lines[marker] = f"{code}  # pio: lint-ok[{rule_id}]"
+        findings = lint_file(path, source="\n".join(lines) + "\n")
+        unsuppressed = {f.rule_id for f in findings if not f.suppressed}
+        assert "lint-suppression-missing-reason" in unsuppressed
+        suppressed = [f for f in findings if f.suppressed]
+        assert [f.rule_id for f in suppressed] == [rule_id]
+
+    @pytest.mark.parametrize(
+        "slug,rule_id",
+        [_CONC_FIXTURES[0], _SPMD_FIXTURES[0]],
+        ids=["conc", "spmd"],
+    )
+    def test_suppression_with_reason_suppresses(self, slug, rule_id):
+        path = os.path.join(FIXTURES, f"{slug}_bad.py")
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+        marker = _marker_line(path, "BAD") - 1
+        code = lines[marker].split("#")[0].rstrip()
+        lines[marker] = f"{code}  # pio: lint-ok[{rule_id}] reviewed"
+        findings = lint_file(path, source="\n".join(lines) + "\n")
+        assert [f.rule_id for f in findings if not f.suppressed] == []
+        assert [f.rule_id for f in findings if f.suppressed] == [rule_id]
+
+
 # ---------------------------------------------------------------------------
 # 2. Rule semantics (inline sources)
 # ---------------------------------------------------------------------------
@@ -518,10 +603,12 @@ class TestCLI:
         )
         assert "Traceback" not in proc.stderr, proc.stderr[-2000:]
 
-    def test_nonexistent_path_fails_the_gate(self):
-        # a typo'd target must never read as lint-clean
+    def test_nonexistent_path_is_an_engine_error(self):
+        # a typo'd target must never read as lint-clean — and it is an
+        # ENGINE error (exit 2), not a finding (exit 1): the run proved
+        # nothing
         proc = _run_cli("no/such/dir_xyz")
-        assert proc.returncode == 1
+        assert proc.returncode == 2
         assert "no such file or directory" in proc.stdout
 
     def test_json_format_is_machine_readable(self):
@@ -541,19 +628,22 @@ class TestCLI:
         )
         assert proc.returncode == 0  # the only finding is a per-row-dma
 
-    def test_list_rules_covers_both_families(self):
+    def test_list_rules_covers_all_families(self):
         proc = _run_cli("--list-rules")
         assert proc.returncode == 0
         assert "mosaic-unaligned-lane-slice" in proc.stdout
         assert "jit-python-branch" in proc.stdout
+        assert "conc-unguarded-attr" in proc.stdout
+        assert "spmd-collective-host-branch" in proc.stdout
 
     def test_unreadable_file_is_a_parse_error_not_a_crash(self, tmp_path):
         # null bytes raise ValueError from ast.parse; the run must record
-        # a parse error and exit 1, not hand the watcher a traceback
+        # a parse error and exit 2 (engine error), not hand the watcher
+        # a traceback
         bad = tmp_path / "nul.py"
         bad.write_bytes(b"x = 1\x00\n")
         proc = _run_cli(str(tmp_path))
-        assert proc.returncode == 1
+        assert proc.returncode == 2
         assert "parse-error" in proc.stdout
         assert "Traceback" not in proc.stderr
 
@@ -594,23 +684,195 @@ class TestCLI:
         assert "mosaic-rank3-compare" in proc.stdout
 
 
+class TestChangedAndBaseline:
+    """``pio lint --changed`` (git-diff-scoped) and ``--baseline``
+    (adopt/ratchet), plus the pinned exit-code contract: 0 clean,
+    1 findings, 2 engine error.
+
+    These call ``tools.lint.main`` in-process (exit code = return
+    value, output via capsys): the subprocess transport is already
+    covered by TestCLI, and a fresh interpreter per case would cost
+    the tier-1 budget ~20 s for no extra coverage."""
+
+    BAD = os.path.join(FIXTURES, "rank3_compare_bad.py")
+    CLEAN = os.path.join(FIXTURES, "rank3_compare_clean.py")
+
+    def _run(self, capsys, *argv):
+        from predictionio_tpu.tools import lint as lint_cli
+
+        rc = lint_cli.main(list(argv))
+        return rc, capsys.readouterr().out
+
+    def test_exit_codes_pinned(self, tmp_path, capsys):
+        assert self._run(capsys, self.CLEAN)[0] == 0
+        assert self._run(capsys, self.BAD)[0] == 1
+        nul = tmp_path / "nul.py"
+        nul.write_bytes(b"x\x00\n")
+        assert self._run(capsys, str(nul))[0] == 2
+
+    def _git(self, cwd, *args):
+        return subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True,
+            timeout=30,
+        )
+
+    def _make_repo(self, tmp_path):
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        assert self._git(repo, "init", "-q").returncode == 0
+        self._git(repo, "config", "user.email", "t@example.com")
+        self._git(repo, "config", "user.name", "t")
+        return repo
+
+    def test_changed_lints_only_git_modified_files(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        repo = self._make_repo(tmp_path)
+        monkeypatch.chdir(repo)
+        # a committed file WITH a violation: out of scope for --changed
+        (repo / "legacy.py").write_text(open(self.BAD).read())
+        self._git(repo, "add", "legacy.py")
+        assert self._git(repo, "commit", "-qm", "seed").returncode == 0
+        rc, out = self._run(capsys, "--changed", str(repo))
+        assert rc == 0, out
+        assert "no changed files" in out
+        # an uncommitted (untracked) violation IS in scope
+        (repo / "fresh.py").write_text(open(self.BAD).read())
+        rc, out = self._run(capsys, "--changed", str(repo))
+        assert rc == 1, out
+        assert "fresh.py" in out
+        assert "legacy.py" not in out
+        assert "1 files" in out
+        # a modified tracked file joins the scope too
+        (repo / "legacy.py").write_text(
+            open(self.BAD).read() + "\nX = 1\n"
+        )
+        _rc, out = self._run(capsys, "--changed", str(repo))
+        assert "2 files" in out
+
+    def test_changed_outside_a_git_repo_is_an_engine_error(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        # a silent empty set would read as "clean" — it must be exit 2
+        lone = tmp_path / "lone"
+        lone.mkdir()
+        monkeypatch.chdir(lone)
+        monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(tmp_path))
+        rc, out = self._run(capsys, "--changed", str(lone))
+        assert rc == 2, out
+        assert "--changed" in out
+
+    def test_baseline_adopts_then_ratchets(self, tmp_path, capsys):
+        # adopt: record today's findings; the same run is then clean
+        rc, recorded = self._run(capsys, self.BAD, "--format", "json")
+        assert rc == 1
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(recorded)
+        rc, out = self._run(capsys, self.BAD, "--baseline", str(baseline))
+        assert rc == 0, out
+        assert "1 baselined" in out
+        doc = json.loads(recorded)
+        assert [f["rule"] for f in doc["findings"]] == [
+            "mosaic-rank3-compare"
+        ]
+        # different path: the baseline keys on (path, rule), so the
+        # same content elsewhere is NEW debt, not adopted
+        grown = tmp_path / "grown.py"
+        grown.write_text(open(self.BAD).read())
+        rc, _out = self._run(
+            capsys, str(grown), "--baseline", str(baseline)
+        )
+        assert rc == 1
+
+    def test_baseline_same_path_absorbs_only_the_recorded_count(
+        self, tmp_path, capsys
+    ):
+        bad_src = open(self.BAD).read()
+        target = tmp_path / "mod.py"
+        target.write_text(bad_src)
+        _rc, recorded = self._run(capsys, str(target), "--format", "json")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(recorded)
+        # same content: adopted clean
+        assert self._run(
+            capsys, str(target), "--baseline", str(baseline)
+        )[0] == 0
+        # duplicate the kernel under new names -> more findings of the
+        # same rule in the same file than the baseline recorded: fails
+        clone = bad_src.replace("_mask_kernel", "_mask_kernel2").replace(
+            "def run(", "def run2("
+        )
+        target.write_text(bad_src + "\n\n" + clone)
+        rc, out = self._run(
+            capsys, str(target), "--baseline", str(baseline)
+        )
+        assert rc == 1, out
+
+    def test_baseline_unreadable_is_an_engine_error(self, tmp_path, capsys):
+        missing = tmp_path / "nope.json"
+        assert self._run(
+            capsys, self.CLEAN, "--baseline", str(missing)
+        )[0] == 2
+        bad_json = tmp_path / "bad.json"
+        bad_json.write_text("{\"not\": \"findings\"}")
+        assert self._run(
+            capsys, self.CLEAN, "--baseline", str(bad_json)
+        )[0] == 2
+
+    def test_baselined_findings_are_reported_in_json(
+        self, tmp_path, capsys
+    ):
+        _rc, recorded = self._run(capsys, self.BAD, "--format", "json")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(recorded)
+        rc, out = self._run(
+            capsys, self.BAD, "--baseline", str(baseline),
+            "--format", "json",
+        )
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["findings"] == []
+        assert [f["rule"] for f in doc["baselined"]] == [
+            "mosaic-rank3-compare"
+        ]
+
+
+@pytest.fixture(scope="module")
+def package_result():
+    """ONE package sweep shared by every gate assertion: the sweep is
+    the expensive part (~15 s over 100+ files), the assertions are
+    free — three tests each doing their own sweep cost the tier-1
+    budget ~30 s for identical coverage."""
+    return lint_paths([PACKAGE])
+
+
 class TestSelfLintGate:
     """The tier-1 gate: the package itself must stay lint-clean. A new
     Pallas PR that reintroduces a round-5 bug class fails here before it
     ever reaches a compile."""
 
-    def test_package_has_zero_unsuppressed_findings(self):
-        result = lint_paths([PACKAGE])
+    def test_package_has_zero_unsuppressed_findings(self, package_result):
+        result = package_result
         assert result.errors == [], result.errors
         assert result.findings == [], (
             "unsuppressed lint findings in the package:\n"
             + render_text(result)
         )
 
-    def test_every_suppression_carries_a_reason(self):
-        result = lint_paths([PACKAGE])
+    def test_every_suppression_carries_a_reason(self, package_result):
+        result = package_result
         missing = [f for f in result.suppressed if not f.suppress_reason]
         assert missing == [], [f.as_dict() for f in missing]
+
+    def test_families_e_and_f_are_in_the_gate(self):
+        """The self-lint gate runs ``all_rules()``; every conc-*/spmd-*
+        rule must be registered there (a family that quietly drops out
+        of the default set stops gating anything)."""
+        ids = {r.id for r in all_rules()}
+        for _slug, rule_id in _CONC_FIXTURES + _SPMD_FIXTURES:
+            assert rule_id in ids, f"{rule_id} missing from all_rules()"
+        assert sum(1 for i in ids if i.startswith("conc-")) >= 6
+        assert sum(1 for i in ids if i.startswith("spmd-")) >= 6
 
     def test_rule_catalog_is_documented(self):
         """docs/lint.md is the catalog the suppression workflow points
@@ -620,8 +882,8 @@ class TestSelfLintGate:
         for rule in all_rules():
             assert rule.id in doc, f"rule {rule.id} missing from docs/lint.md"
 
-    def test_json_reporter_roundtrips_package_result(self):
-        result = lint_paths([PACKAGE])
+    def test_json_reporter_roundtrips_package_result(self, package_result):
+        result = package_result
         doc = json.loads(render_json(result))
         assert doc["ok"] is True
         assert doc["files"] == result.files

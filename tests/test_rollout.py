@@ -135,6 +135,86 @@ def _gates(**overrides):
 # ---------------------------------------------------------------------------
 
 
+class TestShadowPoolConcurrency:
+    """Regression tests for the ISSUE-6 ``conc-*`` sweep findings in the
+    rollout manager: the shadow-futures deque was appended/popped
+    outside the manager lock (concurrent drains could IndexError or
+    double-pop), and the scrape-thread gauge callbacks read ``self.plan``
+    without it."""
+
+    def _manager(self):
+        import time as _time
+
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+        from predictionio_tpu.rollout.manager import RolloutManager
+
+        class _Stub:
+            pass
+
+        server = _Stub()
+        server.clock = _time.monotonic
+        server.metrics = MetricsRegistry()
+        return RolloutManager(server)
+
+    def test_concurrent_drains_never_double_pop_or_indexerror(self):
+        import threading
+        from concurrent.futures import Future
+
+        mgr = self._manager()
+        try:
+            errors = []
+            for _round in range(8):
+                for _ in range(200):  # deque maxlen is 256: stay under it
+                    fut = Future()
+                    fut.set_result(None)
+                    mgr._shadow_futures.append(fut)
+
+                def drain():
+                    try:
+                        mgr.drain_shadow(timeout_s=5)
+                    except Exception as exc:  # IndexError pre-fix
+                        errors.append(exc)
+
+                threads = [
+                    threading.Thread(target=drain) for _ in range(4)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30)
+                assert not mgr._shadow_futures
+            assert errors == [], errors
+        finally:
+            mgr.close()
+
+    def test_gauge_callbacks_read_under_the_manager_lock(self):
+        import threading
+
+        mgr = self._manager()
+        try:
+            got = []
+            mgr._lock.acquire()
+            try:
+                t = threading.Thread(
+                    target=lambda: got.append(
+                        (mgr._stage_code(), mgr._live_percent())
+                    )
+                )
+                t.start()
+                t.join(timeout=0.05)
+                # the scrape-thread callbacks must be blocked on the lock
+                assert t.is_alive(), (
+                    "gauge callback returned while the manager lock was "
+                    "held — it reads rollout state without the lock"
+                )
+            finally:
+                mgr._lock.release()
+            t.join(timeout=30)
+            assert got == [(0, 0.0)]  # no active plan
+        finally:
+            mgr.close()
+
+
 class TestStickySplit:
     def test_deterministic_and_percent_bounded(self):
         keys = [f"user={i}" for i in range(2000)]
