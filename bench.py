@@ -362,6 +362,24 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         and jax.devices()[0].platform == "tpu"  # stable API, not str repr
     ):
         _save_last_good(record)
+    # Closed-loop freshness (docs/continuous.md): the tiny in-process
+    # feedback-stream scenario gives every BENCH round a measured
+    # event-ingest → model-live number next to the train time. Opt out
+    # with BENCH_FEEDBACK_STREAM=0; a failure here never fails the bench.
+    if os.environ.get("BENCH_FEEDBACK_STREAM") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_feedback_stream
+
+            fs = run_feedback_stream(total_events=40, burst=20)
+            record["continuousFreshness"] = {
+                "freshnessS": fs.get("freshnessS"),
+                "events": fs.get("events"),
+                "cycles": fs.get("cycles"),
+                "mode": (fs.get("lastCycle") or {}).get("mode"),
+                "ok": fs.get("ok"),
+            }
+        except Exception as exc:  # the headline metric must still report
+            record["continuousFreshness"] = {"error": str(exc)}
     print(json.dumps(record))
     return 0
 

@@ -1,0 +1,34 @@
+"""Continuous-learning plane: changefeed-driven fold-in training with
+automatic rollout submission (``docs/continuous.md``).
+
+Three cooperating parts close the loop the ROADMAP calls "continuous
+learning from the feedback stream":
+
+- :mod:`~predictionio_tpu.continuous.watcher` — tails the PR-3
+  changefeed from a durably persisted cursor and accumulates a delta
+  batch of fresh rating/feedback events;
+- :mod:`~predictionio_tpu.continuous.foldin` — the ALX-style incremental
+  step: solve only new/changed factor rows against fixed counterpart
+  factors, with policy thresholds that escalate to a full retrain;
+- :mod:`~predictionio_tpu.continuous.controller` — the policy state
+  machine that turns deltas into candidate models and auto-submits them
+  through the rollout plane's shadow→canary→live gates.
+"""
+
+from .controller import ContinuousConfig, ContinuousController
+from .foldin import FOLD_IN, FULL_RETRAIN, FoldInPolicy, decide_mode
+from .watcher import DeltaBatch, FeedGap, FeedWatcher, LocalFeed, RemoteFeed
+
+__all__ = [
+    "ContinuousConfig",
+    "ContinuousController",
+    "DeltaBatch",
+    "FeedGap",
+    "FeedWatcher",
+    "FoldInPolicy",
+    "FOLD_IN",
+    "FULL_RETRAIN",
+    "LocalFeed",
+    "RemoteFeed",
+    "decide_mode",
+]

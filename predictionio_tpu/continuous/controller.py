@@ -1,0 +1,879 @@
+"""Continuous-training controller: delta → candidate → rollout, no human.
+
+The policy state machine of the continuous-learning plane
+(``docs/continuous.md``), the architecture of *Scalable Machine Learning
+Training Infrastructure for Online Ads Recommendation and Auction
+Scoring Modeling at Google* (PAPERS.md): ingestion → continuous train →
+validated push in a steady loop.
+
+One controller rides inside a
+:class:`~predictionio_tpu.workflow.serving.QueryServer`:
+
+1. **Watch** — :class:`~predictionio_tpu.continuous.watcher.FeedWatcher`
+   tails the changefeed from its durable cursor; a cycle triggers when
+   the pending delta reaches ``min_events`` or its oldest event exceeds
+   ``max_staleness_s``.
+2. **Train** — :func:`~predictionio_tpu.continuous.foldin.decide_mode`
+   picks ALS fold-in (solve only changed rows) or a full retrain
+   (delta/new-entity fraction past policy, post-fold RMSE drift, feed
+   gap, or a quarantined previous fold). Either way the candidate goes
+   through the existing train/persist path and lands as a COMPLETED
+   engine instance.
+3. **Score** — the candidate is replayed offline against the live
+   baseline over the most recent variant-tagged ``pio_pr`` feedback
+   events (PR 5); a candidate whose predictions diverge past
+   ``max_offline_divergence`` is quarantined before it ever sees
+   traffic.
+4. **Submit & monitor** — the candidate auto-submits to
+   :meth:`RolloutManager.start` and the controller watches the
+   shadow→canary→live progression. A busy rollout backs off on the
+   shared :class:`~predictionio_tpu.utils.resilience.RetryPolicy`
+   schedule; a gate rollback quarantines the candidate, forces the next
+   cycle to a full retrain, and starts a cooldown. Going LIVE commits
+   the cursor and records end-to-end freshness (oldest folded event →
+   model live).
+
+Everything decision-shaped runs on injected clocks; the background
+thread is just ``tick()`` on an interval. Restart resume: the durable
+cursor plus ``continuous_state.json`` (in-flight candidate, quarantine
+set) let a restarted server pick up exactly where it stopped — the
+rollout itself resumes through the PR-5 plan record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from ..storage.metadata import (
+    ROLLOUT_ABORTED,
+    ROLLOUT_LIVE,
+    ROLLOUT_ROLLED_BACK,
+)
+from ..utils.durability import atomic_write_bytes
+from ..utils.resilience import RetryPolicy
+from .foldin import FOLD_IN, FULL_RETRAIN, FoldInPolicy, decide_mode
+from .watcher import FeedGap, FeedWatcher, RemoteFeed
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ContinuousConfig", "ContinuousController", "STATE_NAME"]
+
+STATE_NAME = "continuous_state.json"
+
+#: controller states (status()["state"])
+WATCHING = "WATCHING"
+SUBMIT_PENDING = "SUBMIT_PENDING"
+MONITORING = "MONITORING"
+COOLDOWN = "COOLDOWN"
+PAUSED = "PAUSED"
+
+
+def _default_event_values() -> Dict[str, object]:
+    # the recommendation template's rate/buy rules (workflow/infeed.py)
+    return {"rate": "rating", "buy": 4.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousConfig:
+    """Policy knobs of one continuous-learning loop
+    (``docs/continuous.md#policy-knobs``)."""
+
+    #: app whose feedback stream feeds the loop
+    app_id: int = 1
+    #: event name → value rule (property name or fixed float), the same
+    #: shape the training infeed consumes
+    event_values: Mapping[str, object] = dataclasses.field(
+        default_factory=_default_event_values
+    )
+    #: storage primary to tail over ``GET /replicate/changes``; None =
+    #: the caller passes an explicit feed object (in-process oplog)
+    feed_url: Optional[str] = None
+    #: cursor/state directory (default ``$PIO_FS_BASEDIR/continuous``)
+    state_dir: Optional[str] = None
+    #: delta size that triggers a training cycle
+    min_events: int = 10
+    #: trigger even below ``min_events`` once the oldest pending event is
+    #: this stale (freshness floor)
+    max_staleness_s: float = 300.0
+    #: background tick cadence
+    poll_interval_s: float = 1.0
+    #: fold-vs-retrain escalation thresholds
+    policy: FoldInPolicy = dataclasses.field(default_factory=FoldInPolicy)
+    #: forwarded to ``RolloutManager.start``
+    rollout_percent: Optional[float] = None
+    rollout_gates: Optional[Mapping[str, object]] = None
+    #: recent ``pio_pr`` feedback events replayed for offline scoring
+    score_window: int = 200
+    #: minimum scored samples before the offline gate can veto
+    min_score_samples: int = 5
+    #: mean candidate-vs-served-baseline divergence above which the
+    #: candidate is quarantined without ever being submitted
+    max_offline_divergence: float = 0.75
+    #: cooldown after a rollback/quarantine before the next cycle
+    quarantine_backoff_s: float = 300.0
+    #: start the background tick thread with the server
+    autostart: bool = True
+
+
+class ContinuousController:
+    """One query server's continuous-learning loop (docs/continuous.md)."""
+
+    def __init__(
+        self,
+        server,
+        config: ContinuousConfig,
+        feed=None,
+        clock: Optional[Callable[[], float]] = None,
+        wall: Callable[[], float] = time.time,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
+        self.server = server
+        self.config = config
+        self.clock = clock or server.clock
+        self.wall = wall
+        self._retry = retry_policy or RetryPolicy(
+            attempts=1, base_delay_s=1.0, max_delay_s=60.0
+        )
+        if feed is None:
+            if not config.feed_url:
+                raise ValueError(
+                    "continuous learning needs a changefeed: pass feed_url "
+                    "(a storage primary's URL) or an explicit feed object"
+                )
+            feed = RemoteFeed(config.feed_url)
+        state_dir = config.state_dir
+        if state_dir is None:
+            from ..storage.registry import base_dir
+
+            # the SERVER's storage env, not os.environ: a test/embedded
+            # registry rooted elsewhere must keep its cursor there too
+            reg_env = getattr(server.registry, "_env", None)
+            state_dir = os.path.join(base_dir(reg_env), "continuous")
+        self._state_dir = state_dir
+        self._state_path = os.path.join(state_dir, STATE_NAME)
+        self.watcher = FeedWatcher(
+            feed, config.app_id, config.event_values, state_dir
+        )
+        self._lock = threading.Lock()
+        self._ticking = False  # single-tick gate (flag, not a held lock:
+        # a tick trains models — nothing may block behind it)
+        self._paused = False
+        self._force_full = False
+        self._feed_gap = False  # a gap retrain must RESYNC (not commit)
+        # the cursor at LIVE, or the gap re-fires on every later poll
+        self._trigger = False
+        self._candidate: Optional[dict] = None  # {"instanceId", "uptoSeq",
+        # "oldestMs", "mode", "submitted", "createdS"}
+        self._quarantined: List[str] = []
+        self._cooldown_until = 0.0
+        self._next_submit_s = 0.0
+        self._submit_attempts = 0
+        self._last_cycle: Optional[dict] = None
+        self._last_freshness_s: Optional[float] = None
+        self._last_error: Optional[str] = None
+        self._cycles = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._load_state()
+
+        metrics = server.metrics
+        self._folds = metrics.counter(
+            "pio_continuous_folds_total",
+            "Continuous-training cycle outcomes",
+            labelnames=("kind",),
+        )
+        metrics.gauge_callback(
+            "pio_continuous_feed_lag_ops",
+            self.watcher.feed_lag,
+            "Changefeed ops between the continuous cursor and the feed head",
+        )
+        metrics.gauge_callback(
+            "pio_continuous_candidate_age_seconds",
+            self._candidate_age_s,
+            "Age of the in-flight continuous candidate (0 = none)",
+        )
+
+    # -- durable state ----------------------------------------------------
+    def _load_state(self) -> None:
+        try:
+            with open(self._state_path) as fh:
+                state = json.load(fh)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            self._candidate = state.get("candidate")
+            self._quarantined = list(state.get("quarantined", []))
+            self._last_freshness_s = state.get("lastFreshnessS")
+            self._last_cycle = state.get("lastCycle")
+
+    def _persist_state(self) -> None:
+        """Crash-safe controller state (call with ``_lock`` held)."""
+        atomic_write_bytes(
+            self._state_path,
+            json.dumps(
+                {
+                    "candidate": self._candidate,
+                    "quarantined": self._quarantined,
+                    "lastFreshnessS": self._last_freshness_s,
+                    "lastCycle": self._last_cycle,
+                }
+            ).encode(),
+        )
+
+    # -- gauge callbacks (scrape threads: lock every shared read) ---------
+    def _candidate_age_s(self) -> float:
+        with self._lock:
+            cand = self._candidate
+            if cand is None or "createdS" not in cand:
+                return 0.0
+            return max(0.0, self.clock() - float(cand["createdS"]))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        """Run the background tick loop (idempotent)."""
+        # the Event is its own synchronizer — touched outside the
+        # controller lock so the loop thread's bare .wait() stays
+        # consistent with every other access
+        self._stop.clear()
+        with self._lock:
+            self._paused = False
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="continuous", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.poll_interval_s):
+            try:
+                self.tick()
+            except Exception:  # the loop must survive anything
+                logger.exception("continuous tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def pause(self) -> dict:
+        with self._lock:
+            self._paused = True
+        return self.status()
+
+    def resume_watching(self) -> dict:
+        with self._lock:
+            self._paused = False
+        return self.status()
+
+    def trigger(self, full: bool = False) -> dict:
+        """Force a cycle on the next tick regardless of thresholds
+        (``pio continuous trigger``)."""
+        with self._lock:
+            self._trigger = True
+            self._force_full = self._force_full or full
+            self._cooldown_until = 0.0
+            self._next_submit_s = 0.0
+        return self.status()
+
+    # -- the tick ---------------------------------------------------------
+    def tick(self) -> dict:
+        """One deterministic controller step (the background loop and the
+        tests both drive this). Never raises on feed/train/storage
+        trouble — failures land in ``status()["lastError"]``."""
+        with self._lock:
+            if self._ticking:
+                return self.status()
+            self._ticking = True
+        try:
+            self._tick_inner()
+        finally:
+            with self._lock:
+                self._ticking = False
+        return self.status()
+
+    def _tick_inner(self) -> None:
+        with self._lock:
+            if self._paused:
+                return
+        now = self.clock()
+        try:
+            self.watcher.poll()
+            with self._lock:
+                self._last_error = None
+        except FeedGap as exc:
+            # the delta stream is incomplete: only a full retrain (which
+            # reads the whole event store) can cover what the feed lost
+            with self._lock:
+                self._force_full = True
+                self._feed_gap = True
+                self._trigger = True
+                self._last_error = f"feed gap: {exc}"
+            logger.warning("continuous: %s — escalating to full retrain", exc)
+        except Exception as exc:
+            with self._lock:
+                self._last_error = f"feed poll failed: {exc}"
+            return
+
+        if self._check_rollout(now):
+            return  # a candidate is still in flight: one cycle at a time
+
+        with self._lock:
+            if now < self._cooldown_until:
+                return
+            pending = self.watcher.pending_count()
+            oldest_ms = self.watcher.oldest_pending_ms()
+            stale_s = (
+                max(0.0, self.wall() * 1000.0 - oldest_ms) / 1000.0
+                if oldest_ms
+                else 0.0
+            )
+            due = (
+                self._trigger
+                or pending >= self.config.min_events
+                or (pending > 0 and stale_s >= self.config.max_staleness_s)
+            )
+            if not due:
+                return
+            self._trigger = False
+            force_full = self._force_full
+        self._run_cycle(force_full)
+
+    # -- rollout monitoring ----------------------------------------------
+    def _check_rollout(self, now: float) -> bool:
+        """Advance the in-flight candidate's lifecycle. Returns True while
+        a candidate still occupies the loop."""
+        with self._lock:
+            cand = self._candidate
+        if cand is None:
+            return False
+        if not cand.get("submitted"):
+            # whether the submit landed or backed off, this candidate
+            # still claims the loop — the pending delta it was built
+            # from stays uncommitted until the rollout finishes, and a
+            # same-tick second cycle would re-train that same delta
+            self._try_submit(cand, now)
+            return True
+        rollout = self.server.rollout
+        plan = rollout.plan if rollout is not None else None
+        if plan is None or plan.candidate_instance_id != cand["instanceId"]:
+            # replaced/aborted out-of-band (operator started their own
+            # rollout, or the plan vanished): drop the claim, keep the
+            # delta — it folds into the next candidate
+            with self._lock:
+                self._candidate = None
+                self._persist_state()
+            logger.warning(
+                "continuous: candidate %s lost its rollout (plan %s); "
+                "the pending delta stays queued",
+                cand["instanceId"], plan.id if plan else None,
+            )
+            return False
+        if plan.stage == ROLLOUT_LIVE:
+            freshness_s = None
+            if cand.get("oldestMs"):
+                freshness_s = max(
+                    0.0, self.wall() * 1000.0 - cand["oldestMs"]
+                ) / 1000.0
+            if cand.get("resync"):
+                # a gap retrain covered the feed's lost history from the
+                # store itself: jump the cursor to the head (a plain
+                # commit would leave position/generation stale and the
+                # gap would re-fire on every later poll)
+                try:
+                    self.watcher.resync()
+                    with self._lock:
+                        self._feed_gap = False
+                        self._force_full = False
+                except Exception as exc:
+                    # the feed is still unreachable: keep the gap flag;
+                    # the next successful cycle retries the resync
+                    with self._lock:
+                        self._last_error = f"resync failed: {exc}"
+            else:
+                self.watcher.commit(int(cand["uptoSeq"]))
+            with self._lock:
+                self._candidate = None
+                self._last_freshness_s = freshness_s
+                self._submit_attempts = 0
+                if self._last_cycle is not None:
+                    self._last_cycle["outcome"] = "live"
+                    self._last_cycle["freshnessS"] = freshness_s
+                self._persist_state()
+            self._folds.inc(1, kind="promoted")
+            logger.info(
+                "continuous: candidate %s is LIVE (freshness %.3fs)",
+                cand["instanceId"], freshness_s or -1.0,
+            )
+            return False
+        if plan.stage in (ROLLOUT_ROLLED_BACK, ROLLOUT_ABORTED):
+            with self._lock:
+                if cand["instanceId"] not in self._quarantined:
+                    self._quarantined.append(cand["instanceId"])
+                self._candidate = None
+                # a fold the gates rejected means the incremental step
+                # cannot be trusted on this delta: next cycle retrains
+                self._force_full = True
+                self._cooldown_until = (
+                    now + self.config.quarantine_backoff_s
+                )
+                if self._last_cycle is not None:
+                    self._last_cycle["outcome"] = plan.stage.lower()
+                self._persist_state()
+            self._folds.inc(1, kind="quarantined")
+            logger.warning(
+                "continuous: candidate %s was %s by the rollout gates; "
+                "quarantined, cooling down %.0fs, next cycle is a full "
+                "retrain",
+                cand["instanceId"], plan.stage,
+                self.config.quarantine_backoff_s,
+            )
+            return False
+        return True  # SHADOW/CANARY: keep monitoring
+
+    def _try_submit(self, cand: dict, now: float) -> bool:
+        """Submit a produced-but-unsubmitted candidate. Returns True on
+        success; on a busy rollout, schedules a jittered retry."""
+        with self._lock:
+            if now < self._next_submit_s:
+                return False
+        from ..rollout.manager import RolloutError
+
+        try:
+            self.server.rollout.start(
+                candidate_instance_id=cand["instanceId"],
+                percent=self.config.rollout_percent,
+                gates=(
+                    dict(self.config.rollout_gates)
+                    if self.config.rollout_gates
+                    else None
+                ),
+                reason="continuous controller auto-submit",
+            )
+        except RolloutError as exc:
+            # another rollout is in flight (operator- or us-before-crash):
+            # back off on the shared full-jitter schedule
+            with self._lock:
+                delay = self._retry.delay_for(min(self._submit_attempts, 6))
+                self._submit_attempts += 1
+                self._next_submit_s = now + max(
+                    delay, self.config.poll_interval_s
+                )
+                self._last_error = f"rollout busy: {exc}"
+            return False
+        except Exception as exc:
+            with self._lock:
+                self._last_error = f"rollout start failed: {exc}"
+                self._quarantined.append(cand["instanceId"])
+                self._candidate = None
+                self._cooldown_until = now + self.config.quarantine_backoff_s
+                self._persist_state()
+            self._folds.inc(1, kind="quarantined")
+            logger.exception(
+                "continuous: submitting candidate %s failed", cand["instanceId"]
+            )
+            return False
+        with self._lock:
+            cand["submitted"] = True
+            self._candidate = cand
+            self._submit_attempts = 0
+            self._persist_state()
+        logger.info(
+            "continuous: candidate %s submitted to the rollout plane",
+            cand["instanceId"],
+        )
+        return True
+
+    # -- one training cycle ----------------------------------------------
+    def _run_cycle(self, force_full: bool) -> None:
+        now = self.clock()
+        batch = self.watcher.take_batch()
+        if batch is None and not force_full:
+            return
+        dep = self.server.deployment
+        pd = None
+        if not force_full and batch is not None:
+            # the prepared data is needed for the fold anyway; reading it
+            # before the decision makes the delta fraction exact instead
+            # of an entity-count proxy (full retrain re-reads internally
+            # — that's the existing path, unchanged)
+            pd = self._read_prepared(dep)
+        mode, reason = self._decide(dep, batch, force_full, pd)
+        cycle: dict = {
+            "mode": mode,
+            "reason": reason,
+            "deltaEvents": len(batch.events) if batch else 0,
+            "atS": round(now, 3),
+        }
+        try:
+            if mode == FOLD_IN:
+                instance_id, fold_stats = self._fold_in_candidate(
+                    dep, batch, pd
+                )
+                if fold_stats is not None:
+                    cycle["foldIn"] = fold_stats
+                if instance_id is None:  # drift escalation inside the fold
+                    mode = FULL_RETRAIN
+                    cycle["mode"] = mode
+                    cycle["reason"] = (
+                        f"fold-in RMSE drift "
+                        f"{fold_stats['rmseDrift'] if fold_stats else '?'} "
+                        f"exceeded policy "
+                        f"{self.config.policy.max_rmse_drift}: escalated"
+                    )
+                    self._folds.inc(1, kind="escalated")
+            if mode == FULL_RETRAIN:
+                instance_id = self._full_retrain_candidate(dep)
+        except Exception as exc:
+            with self._lock:
+                self._last_error = f"{mode} failed: {exc}"
+                cycle["outcome"] = "error"
+                cycle["error"] = str(exc)
+                self._last_cycle = cycle
+                self._persist_state()
+            logger.exception("continuous: %s cycle failed", mode)
+            return
+        self._folds.inc(1, kind=mode)
+        with self._lock:
+            self._cycles += 1
+            self._force_full = False
+
+        # offline scoring against the live baseline's served predictions
+        score = self._offline_score(instance_id)
+        cycle["offlineScore"] = score
+        if not score.get("ok", True):
+            with self._lock:
+                self._quarantined.append(instance_id)
+                # like a gate rollback: the candidate this delta produced
+                # cannot be trusted, so the next cycle must NOT re-fold
+                # the same delta into a byte-identical candidate (an
+                # infinite quarantine loop) — it retrains fully instead
+                self._force_full = True
+                self._cooldown_until = (
+                    self.clock() + self.config.quarantine_backoff_s
+                )
+                cycle["outcome"] = "offline_quarantined"
+                self._last_cycle = cycle
+                self._persist_state()
+            self._folds.inc(1, kind="quarantined")
+            logger.warning(
+                "continuous: candidate %s failed offline scoring (%s); "
+                "quarantined before submission",
+                instance_id, score.get("reason"),
+            )
+            return
+
+        with self._lock:
+            needs_resync = self._feed_gap
+        cand = {
+            "instanceId": instance_id,
+            "uptoSeq": batch.upto_seq if batch else self.watcher.position,
+            "oldestMs": batch.oldest_event_ms if batch else None,
+            "mode": mode,
+            "submitted": False,
+            "createdS": now,
+            "resync": needs_resync,
+        }
+        with self._lock:
+            self._candidate = cand
+            cycle["candidateInstanceId"] = instance_id
+            cycle["outcome"] = "submitted"
+            self._last_cycle = cycle
+            self._persist_state()
+        self._try_submit(cand, self.clock())
+
+    def _read_prepared(self, dep):
+        """Read + prepare the current training data through the engine's
+        own components (the fold path's data access). None when the
+        engine cannot fold anyway or the read fails (→ full retrain)."""
+        if not dep.algorithms or not all(
+            hasattr(a, "fold_in")
+            and getattr(a, "fold_in_supported", True)
+            for a in dep.algorithms
+        ):
+            return None
+        try:
+            engine = self.server.engine
+            ctx = self.server.ctx
+            ep = dep.engine_params
+            data_source = engine._data_source(ep)
+            preparator = engine._preparator(ep)
+            return preparator.prepare(ctx, data_source.read_training(ctx))
+        except Exception as exc:
+            logger.warning(
+                "continuous: reading data for fold-in failed (%s); "
+                "deciding without it", exc,
+            )
+            return None
+
+    def _decide(self, dep, batch, force_full: bool, pd) -> Tuple[str, str]:
+        if force_full:
+            return FULL_RETRAIN, "escalation forced (feed gap or quarantine)"
+        if batch is None:
+            return FULL_RETRAIN, "no delta batch (explicit trigger)"
+        fold_available = pd is not None
+        known = new = total = 0
+        if fold_available:
+            try:
+                model = dep.models[0]
+                known = len(model.user_map) + len(model.item_map)
+                new = sum(
+                    1 for u in batch.user_ids if model.user_map.get(u) is None
+                ) + sum(
+                    1 for i in batch.item_ids if model.item_map.get(i) is None
+                )
+                # exact corpus size when the prepared data exposes its
+                # interaction array; entity count as the lower-bound proxy
+                # otherwise
+                ratings = getattr(pd, "ratings", None)
+                total = len(ratings) if ratings is not None else known
+            except (AttributeError, TypeError):
+                fold_available = False
+        return decide_mode(
+            self.config.policy,
+            total_events=max(total, len(batch.events)),
+            delta_events=len(batch.events),
+            known_entities=known,
+            new_entities=new,
+            fold_in_available=fold_available,
+        )
+
+    def _fold_in_candidate(
+        self, dep, batch, pd
+    ) -> Tuple[Optional[str], Optional[dict]]:
+        """Produce a fold-in candidate from the prepared data through
+        the existing persist path. Returns ``(instance_id, stats)``;
+        ``(None, stats)`` when RMSE drift demands escalation."""
+        ctx = self.server.ctx
+        with self.server.tracer.span("continuous.fold"):
+            models = []
+            stats_json: Optional[dict] = None
+            for algo, model in zip(dep.algorithms, dep.models):
+                folded, stats = algo.fold_in(
+                    ctx, model, pd, batch.user_ids, batch.item_ids,
+                    policy=self.config.policy,
+                )
+                if stats.rmse_drift > self.config.policy.max_rmse_drift:
+                    return None, stats.to_json()
+                stats_json = stats.to_json()
+                models.append(folded)
+            instance_id = self._persist_candidate(dep, models, FOLD_IN)
+        return instance_id, stats_json
+
+    def _full_retrain_candidate(self, dep) -> str:
+        """The existing train/persist path, parameter-identical to the
+        deployed baseline."""
+        from ..controller.engine import WorkflowParams
+        from ..workflow.core_workflow import run_train
+
+        inst = dep.instance
+        with self.server.tracer.span("continuous.retrain"):
+            return run_train(
+                self.server.engine,
+                dep.engine_params,
+                self.server.registry,
+                engine_id=inst.engine_id,
+                engine_version=inst.engine_version,
+                engine_variant=inst.engine_variant,
+                engine_factory=inst.engine_factory,
+                workflow_params=WorkflowParams(batch="continuous-retrain"),
+                # run_train stops its ctx when done — give it its own
+                # instead of the server's serving context
+            )
+
+    def _persist_candidate(self, dep, models, mode: str) -> str:
+        """Fold-in persist: the same instance-record + model-blob path a
+        full ``run_train`` walks (``workflow/core_workflow.py``), so a
+        fold-in candidate is indistinguishable downstream — deployable,
+        rollout-eligible, listed by the dashboard."""
+        import pickle
+
+        from ..storage import (
+            STATUS_COMPLETED,
+            Model,
+            new_engine_instance,
+            utcnow,
+        )
+        from ..workflow.context import pio_env_vars
+
+        inst = dep.instance
+        md = self.server.registry.get_metadata()
+        env = pio_env_vars()
+        env["PIO_CONTINUOUS"] = mode
+        record = new_engine_instance(
+            engine_id=inst.engine_id,
+            engine_version=inst.engine_version,
+            engine_variant=inst.engine_variant,
+            engine_factory=inst.engine_factory,
+            batch=f"continuous-{mode}",
+            env=env,
+            **{
+                k: getattr(inst, k)
+                for k in (
+                    "data_source_params",
+                    "preparator_params",
+                    "algorithms_params",
+                    "serving_params",
+                )
+            },
+        )
+        instance_id = md.engine_instance_insert(record)
+        persisted = self.server.engine.make_serializable_models(
+            self.server.ctx, dep.engine_params, instance_id, models
+        )
+        self.server.registry.get_models().insert(
+            Model(id=instance_id, models=pickle.dumps(persisted))
+        )
+        stored = md.engine_instance_get(instance_id)
+        md.engine_instance_update(
+            dataclasses.replace(
+                stored, status=STATUS_COMPLETED, end_time=utcnow()
+            )
+        )
+        return instance_id
+
+    # -- offline scoring ---------------------------------------------------
+    def _offline_score(self, instance_id: str) -> dict:
+        """Replay recent variant-tagged ``pio_pr`` feedback queries
+        through the candidate and compare against the predictions the
+        live baseline actually served (``docs/continuous.md#offline-
+        scoring``). No feedback yet → the gate abstains (the rollout's
+        own shadow stage still guards)."""
+        from ..rollout.plan import BASELINE, prediction_divergence
+        from ..storage.events import EventFilter
+        from ..workflow.serving import (
+            ServerConfig,
+            decode_query,
+            encode_result,
+            prepare_deployment,
+        )
+
+        out: dict = {"samples": 0, "ok": True}
+        with self.server.tracer.span("continuous.score"):
+            try:
+                events = list(
+                    self.server.registry.get_events().find(
+                        self.config.app_id,
+                        EventFilter(
+                            entity_type="pio_pr",
+                            event_names=["predict"],
+                            limit=self.config.score_window,
+                            reversed=True,
+                        ),
+                    )
+                )
+            except Exception as exc:
+                out["reason"] = f"feedback read failed: {exc}"
+                return out  # abstain: scoring must not block the loop
+            if not events:
+                out["reason"] = "no feedback events to score against"
+                return out
+            try:
+                cfg = dataclasses.replace(
+                    self.server.config, engine_instance_id=instance_id
+                )
+                cand_dep = prepare_deployment(
+                    self.server.engine, self.server.registry, cfg,
+                    self.server.ctx,
+                )
+            except Exception as exc:
+                out["ok"] = False
+                out["reason"] = f"candidate unloadable: {exc}"
+                return out
+            divergences: List[float] = []
+            for event in events:
+                props = event.properties.to_dict()
+                if props.get("variant", BASELINE) != BASELINE:
+                    continue  # score against what the BASELINE served
+                payload = props.get("query")
+                served = props.get("prediction")
+                if payload is None or served is None:
+                    continue
+                try:
+                    query = decode_query(cand_dep.algorithms, payload)
+                    predictions = [
+                        algo.predict(model, query)
+                        for algo, model in zip(
+                            cand_dep.algorithms, cand_dep.models
+                        )
+                    ]
+                    replayed = cand_dep.serving.serve(query, predictions)
+                    divergences.append(
+                        prediction_divergence(
+                            served, encode_result(replayed)
+                        )
+                    )
+                except Exception:
+                    divergences.append(1.0)  # an unservable query is a
+                    # maximal divergence, not a scoring crash
+            out["samples"] = len(divergences)
+            if divergences:
+                mean_div = sum(divergences) / len(divergences)
+                out["meanDivergence"] = round(mean_div, 6)
+                if (
+                    len(divergences) >= self.config.min_score_samples
+                    and mean_div > self.config.max_offline_divergence
+                ):
+                    out["ok"] = False
+                    out["reason"] = (
+                        f"mean offline divergence {mean_div:.4f} exceeds "
+                        f"{self.config.max_offline_divergence:.4f} over "
+                        f"{len(divergences)} replayed queries"
+                    )
+            return out
+
+    # -- status -----------------------------------------------------------
+    def state(self) -> str:
+        with self._lock:
+            if self._paused:
+                return PAUSED
+            if self._candidate is not None:
+                return (
+                    MONITORING
+                    if self._candidate.get("submitted")
+                    else SUBMIT_PENDING
+                )
+            if self.clock() < self._cooldown_until:
+                return COOLDOWN
+            return WATCHING
+
+    def status(self) -> dict:
+        """The ``GET /continuous.json`` / ``pio continuous status`` body."""
+        state = self.state()
+        # watcher reads take the watcher's own lock; keep them outside
+        # the controller lock (one lock at a time, no ordering to get
+        # wrong)
+        feed_lag = self.watcher.feed_lag()
+        pending = self.watcher.pending_count()
+        with self._lock:
+            out: dict = {
+                "enabled": True,
+                "state": state,
+                "appId": self.config.app_id,
+                "cursorSeq": self.watcher.cursor_seq,
+                "position": self.watcher.position,
+                "feedLagOps": feed_lag,
+                "pendingEvents": pending,
+                "cycles": self._cycles,
+                "quarantined": list(self._quarantined),
+            }
+            if self._candidate is not None:
+                out["candidate"] = dict(self._candidate)
+            if self._last_cycle is not None:
+                out["lastCycle"] = dict(self._last_cycle)
+            if self._last_freshness_s is not None:
+                out["lastFreshnessS"] = round(self._last_freshness_s, 3)
+            if self._last_error:
+                out["lastError"] = self._last_error
+            if self.clock() < self._cooldown_until:
+                out["cooldownRemainingS"] = round(
+                    self._cooldown_until - self.clock(), 3
+                )
+        return out

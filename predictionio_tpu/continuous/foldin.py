@@ -1,0 +1,286 @@
+"""ALS fold-in: incremental factor updates for new/changed rows.
+
+The cheap step of the continuous-training loop (*ALX: Large Scale Matrix
+Factorization on TPUs*, PAPERS.md): instead of re-running the full
+alternating iteration over every row, solve **only the rows the fresh
+delta touched** — each one an independent regularized least-squares
+system against the *fixed* counterpart factor table, exactly the
+per-row normal equations the full trainer builds
+(:func:`~predictionio_tpu.ops.als._system_explicit`):
+
+    A_u = Gᵀ G + λ n_u I,   b_u = Gᵀ r_u,   x_u = A_u⁻¹ b_u
+
+Rows nobody touched keep their factors **bit-identical** — the no-op
+guarantee the zero-delta test pins. New users/items get appended rows
+(seeded like :func:`~predictionio_tpu.ops.als.init_factors`) and a
+couple of restricted alternations (``fold_iterations``) resolve the
+new-user-rated-new-item coupling.
+
+Fold-in is an approximation: it holds every untouched row fixed, so its
+quality degrades as the delta grows. :class:`FoldInPolicy` pins when the
+approximation is no longer trustworthy — delta fraction, new-entity
+fraction, or post-fold RMSE drift past policy limits escalates to a full
+retrain (:data:`FULL_RETRAIN`). Everything here is host math + jittable
+solves: CPU-testable, device-agnostic, no storage access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.als import _cho_solve, _system_explicit, init_factors
+from ..ops.scoring import pad_pow2
+
+__all__ = [
+    "FOLD_IN",
+    "FULL_RETRAIN",
+    "FoldInPolicy",
+    "FoldInStats",
+    "decide_mode",
+    "fold_in_factors",
+    "solve_rows",
+]
+
+#: mode verdicts of :func:`decide_mode`
+FOLD_IN = "fold_in"
+FULL_RETRAIN = "full_retrain"
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldInPolicy:
+    """When the incremental step is trustworthy (``docs/continuous.md``).
+
+    Every threshold escalates to :data:`FULL_RETRAIN` when crossed —
+    fold-in must never silently degrade model quality in the steady
+    no-human loop."""
+
+    #: delta events / total training events above which the "hold
+    #: everything else fixed" approximation is no longer local
+    max_delta_fraction: float = 0.2
+    #: (new users + new items) / (known users + known items) above which
+    #: the fixed counterpart tables no longer span the data
+    max_new_entity_fraction: float = 0.2
+    #: post-fold full-data RMSE may exceed the pre-fold RMSE by at most
+    #: this *fraction* (relative drift); beyond it the fold is judged to
+    #: have damaged the model and the controller escalates
+    max_rmse_drift: float = 0.1
+    #: restricted alternations over the changed rows (2 resolves the
+    #: new-user × new-item coupling; 1 is pure one-shot fold-in)
+    fold_iterations: int = 2
+    #: widest per-row system staged at once; rows with more ratings than
+    #: this are solved on their most recent ``max_row_width`` entries
+    max_row_width: int = 2048
+
+    def __post_init__(self):
+        if self.fold_iterations < 1:
+            raise ValueError(
+                f"fold_iterations must be >= 1, got {self.fold_iterations}"
+            )
+
+
+def decide_mode(
+    policy: FoldInPolicy,
+    *,
+    total_events: int,
+    delta_events: int,
+    known_entities: int,
+    new_entities: int,
+    fold_in_available: bool = True,
+) -> Tuple[str, str]:
+    """One (mode, reason) decision for a pending delta."""
+    if not fold_in_available:
+        return FULL_RETRAIN, "engine has no fold_in entry point"
+    if total_events <= 0 or known_entities <= 0:
+        return FULL_RETRAIN, "no trained baseline data to fold into"
+    delta_frac = delta_events / max(1, total_events)
+    if delta_frac > policy.max_delta_fraction:
+        return FULL_RETRAIN, (
+            f"delta fraction {delta_frac:.3f} exceeds "
+            f"{policy.max_delta_fraction:.3f} "
+            f"({delta_events}/{total_events} events)"
+        )
+    new_frac = new_entities / max(1, known_entities)
+    if new_frac > policy.max_new_entity_fraction:
+        return FULL_RETRAIN, (
+            f"new-entity fraction {new_frac:.3f} exceeds "
+            f"{policy.max_new_entity_fraction:.3f} "
+            f"({new_entities} new / {known_entities} known)"
+        )
+    return FOLD_IN, (
+        f"delta {delta_events}/{total_events} events, "
+        f"{new_entities} new entities: within fold-in policy"
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("rank",))
+def solve_rows(
+    counter: jax.Array,
+    idx: jax.Array,
+    val: jax.Array,
+    mask: jax.Array,
+    lam: jax.Array,
+    rank: int,
+) -> jax.Array:
+    """Batched per-row regularized least squares against fixed counterpart
+    factors: ``counter`` [N, R], ``idx``/``val``/``mask`` [B, K] → [B, R].
+
+    The same normal equations as one half of a full ALS iteration
+    (``ops/als.py``), jit-compiled per (B, K) shape — callers pad both to
+    powers of two so the program set stays O(log²)."""
+    a, b = _system_explicit(counter, idx, val, mask.astype(counter.dtype), lam, rank)
+    return _cho_solve(a, b)
+
+
+@dataclasses.dataclass
+class FoldInStats:
+    """What one fold did — the controller's policy/obs input."""
+
+    folded_users: int
+    folded_items: int
+    new_users: int
+    new_items: int
+    rmse_before: float
+    rmse_after: float
+
+    @property
+    def rmse_drift(self) -> float:
+        """Relative full-data RMSE drift (positive = fold made it worse)."""
+        if self.rmse_before <= 0.0:
+            return 0.0
+        return (self.rmse_after - self.rmse_before) / self.rmse_before
+
+    def to_json(self) -> dict:
+        return {
+            "foldedUsers": self.folded_users,
+            "foldedItems": self.folded_items,
+            "newUsers": self.new_users,
+            "newItems": self.new_items,
+            "rmseBefore": round(self.rmse_before, 6),
+            "rmseAfter": round(self.rmse_after, 6),
+            "rmseDrift": round(self.rmse_drift, 6),
+        }
+
+
+def _row_systems(
+    row_ids: np.ndarray,
+    col_ids: np.ndarray,
+    vals: np.ndarray,
+    rows: np.ndarray,
+    max_width: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Padded per-row systems for ``rows`` out of COO data keyed by
+    ``row_ids``: returns (rows_kept, idx [B, K], val [B, K], mask [B, K])
+    with B and K padded to powers of two, or None when no requested row
+    has any rating (nothing to solve)."""
+    order = np.argsort(row_ids, kind="stable")
+    sorted_rows = row_ids[order]
+    starts = np.searchsorted(sorted_rows, rows, side="left")
+    ends = np.searchsorted(sorted_rows, rows, side="right")
+    counts = ends - starts
+    keep = counts > 0  # a row with zero ratings has a singular system:
+    # leave its factors untouched instead of solving λ·0·I x = 0
+    rows, starts, ends = rows[keep], starts[keep], ends[keep]
+    if len(rows) == 0:
+        return None
+    counts = np.minimum(ends - starts, max_width)
+    width = int(min(pad_pow2(int(counts.max()), lo=8), max_width))
+    b_pad = pad_pow2(len(rows))
+    idx = np.zeros((b_pad, width), dtype=np.int32)
+    val = np.zeros((b_pad, width), dtype=np.float32)
+    mask = np.zeros((b_pad, width), dtype=np.float32)
+    for r in range(len(rows)):
+        # keep the NEWEST `count` ratings when a row overflows the width
+        # (the stable sort preserves arrival order within a row, so the
+        # tail of its slice is the most recent feedback)
+        sel = order[ends[r] - counts[r]: ends[r]]
+        idx[r, : len(sel)] = col_ids[sel]
+        val[r, : len(sel)] = vals[sel]
+        mask[r, : len(sel)] = 1.0
+    return rows, idx, val, mask
+
+
+def fold_in_factors(
+    user_factors: np.ndarray,
+    item_factors: np.ndarray,
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    changed_users: Sequence[int],
+    changed_items: Sequence[int],
+    lambda_: float,
+    policy: FoldInPolicy = FoldInPolicy(),
+) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
+    """Fold changed rows into copies of the factor tables.
+
+    ``users``/``items``/``ratings`` are the FULL current training COO in
+    the (already extended) index space of the factor tables —
+    fold-in re-solves each changed row against **all** of its ratings
+    (solving only a row's delta ratings would discard its history), but
+    only the changed rows. Rows whose index ≥ the incoming table length
+    are new: callers pass tables already extended (e.g. by
+    :meth:`~predictionio_tpu.models.recommendation.ALSAlgorithm.fold_in`)
+    with seeded rows for new entities.
+
+    Returns ``(user_factors, item_factors, counts)`` — fresh arrays;
+    untouched rows are byte-identical to the inputs.
+    """
+    rank = user_factors.shape[1]
+    uf = np.array(user_factors, dtype=np.float32, copy=True)
+    itf = np.array(item_factors, dtype=np.float32, copy=True)
+    cu = np.asarray(sorted(set(int(u) for u in changed_users)), dtype=np.int32)
+    ci = np.asarray(sorted(set(int(i) for i in changed_items)), dtype=np.int32)
+    lam = jnp.float32(lambda_)
+    counts = {"solved_users": 0, "solved_items": 0}
+    for _ in range(policy.fold_iterations):
+        if len(ci):
+            sys_i = _row_systems(items, users, ratings, ci, policy.max_row_width)
+            if sys_i is not None:
+                rows, idx, val, mask = sys_i
+                solved = np.asarray(
+                    solve_rows(jnp.asarray(uf), jnp.asarray(idx),
+                               jnp.asarray(val), jnp.asarray(mask), lam, rank)
+                )
+                itf[rows] = solved[: len(rows)]
+                counts["solved_items"] = len(rows)
+        if len(cu):
+            sys_u = _row_systems(users, items, ratings, cu, policy.max_row_width)
+            if sys_u is not None:
+                rows, idx, val, mask = sys_u
+                solved = np.asarray(
+                    solve_rows(jnp.asarray(itf), jnp.asarray(idx),
+                               jnp.asarray(val), jnp.asarray(mask), lam, rank)
+                )
+                uf[rows] = solved[: len(rows)]
+                counts["solved_users"] = len(rows)
+    return uf, itf, counts
+
+
+def seeded_rows(n_new: int, rank: int, seed: int, offset: int) -> np.ndarray:
+    """Initial factors for appended rows: the same distribution family as
+    :func:`~predictionio_tpu.ops.als.init_factors`, keyed off the row
+    offset so re-folding after more growth never re-mints earlier rows'
+    seeds."""
+    if n_new <= 0:
+        return np.zeros((0, rank), dtype=np.float32)
+    return np.asarray(init_factors(n_new, rank, seed + offset))
+
+
+def extend_bimap_indexing(
+    known: Dict[str, int], incoming_ids: Sequence[str]
+) -> Tuple[Dict[str, int], int]:
+    """Append unseen ids to a forward map in arrival order, preserving
+    every existing index (the stable-index contract untouched factor rows
+    rely on). Returns ``(combined_map, n_new)``."""
+    combined = dict(known)
+    n = len(combined)
+    for key in incoming_ids:
+        if key not in combined:
+            combined[key] = n
+            n += 1
+    return combined, n - len(known)

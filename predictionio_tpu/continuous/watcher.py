@@ -1,0 +1,349 @@
+"""Feed watcher: changefeed → rating-delta accumulation with a durable
+cursor.
+
+The ingestion edge of the continuous-learning plane
+(``docs/continuous.md``): tails the PR-3 changefeed — the in-process
+:class:`~predictionio_tpu.storage.oplog.OpLog` op stream via
+:class:`LocalFeed`, or a storage server's ``GET /replicate/changes``
+route via :class:`RemoteFeed` — filters the feedback/rating ops of one
+app through the engine's value rules (the same rate/buy rules the
+training infeed uses, ``workflow/infeed.py``), and accumulates a
+:class:`DeltaBatch` with freshness accounting.
+
+Cursor discipline (the restart-resumes-exact contract, mirroring the
+replica's ``applied.json``): the watcher reads forward from an
+in-memory *position* but only advances the **durable cursor**
+(``continuous_cursor.json``, written crash-safely) when the controller
+*commits* a consumed batch — i.e. after the delta actually became a live
+model. A restart anywhere in between re-reads the uncommitted suffix;
+re-folding the same events is convergent, so replay is harmless, and no
+acked feedback is ever skipped.
+
+A :class:`FeedGap` (sequence gap — the feed no longer holds our cursor —
+or a generation change — the primary store was replaced) means the delta
+stream is no longer complete: incremental folding must stop and the
+controller escalates to a full retrain (which reads the whole event
+store, covering whatever the feed dropped) before :meth:`FeedWatcher.
+resync` jumps the cursor to the feed head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..storage.event import parse_event_time, to_millis
+from ..storage.oplog import OpLog, OpLogGap
+from ..utils.durability import atomic_write_bytes
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CURSOR_NAME",
+    "DeltaBatch",
+    "DeltaEvent",
+    "FeedGap",
+    "FeedWatcher",
+    "LocalFeed",
+    "RemoteFeed",
+]
+
+CURSOR_NAME = "continuous_cursor.json"
+
+
+class FeedGap(Exception):
+    """Incremental tailing cannot continue (seq gap or generation
+    change): the pending delta is incomplete — full retrain, then
+    :meth:`FeedWatcher.resync`."""
+
+
+class LocalFeed:
+    """Changefeed source over an in-process :class:`OpLog` (the query
+    server sharing a host — and an oplog directory — with its storage
+    primary, or a test driving everything in one process)."""
+
+    def __init__(self, oplog: OpLog):
+        self._oplog = oplog
+
+    def fetch(self, since: int, limit: int) -> dict:
+        try:
+            entries, last_seq = self._oplog.read_since(since, limit)
+        except OpLogGap as exc:
+            raise FeedGap(str(exc)) from exc
+        return {
+            "changes": [{"seq": s, "op": o} for s, o in entries],
+            "lastSeq": last_seq,
+            "generation": self._oplog.generation,
+        }
+
+    def checkpoint(self) -> dict:
+        return self._oplog.checkpoint()
+
+
+class RemoteFeed:
+    """Changefeed source over a storage server's replication routes
+    (``GET /replicate/changes`` / ``/replicate/checkpoint``) — the same
+    wire a warm-standby replica tails (``storage/replica.py``)."""
+
+    def __init__(self, primary_url: str, timeout: float = 10.0):
+        self._primary = primary_url.rstrip("/")
+        self._timeout = timeout
+
+    def fetch(self, since: int, limit: int) -> dict:
+        from ..storage.remote import RemoteStorageError, _json, _request
+
+        url = (
+            f"{self._primary}/replicate/changes"
+            f"?since={since}&limit={limit}"
+        )
+        try:
+            with _request(url, timeout=self._timeout) as resp:
+                return _json(resp)
+        except RemoteStorageError as exc:
+            if exc.code == 410:  # the log no longer holds our cursor
+                raise FeedGap(str(exc)) from exc
+            raise
+
+    def checkpoint(self) -> dict:
+        from ..storage.remote import _json, _request
+
+        url = f"{self._primary}/replicate/checkpoint"
+        with _request(url, timeout=self._timeout) as resp:
+            return _json(resp)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaEvent:
+    """One extracted rating/feedback interaction."""
+
+    seq: int
+    user: str
+    item: str
+    value: float
+    event_time_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaBatch:
+    """A consumed slice of the pending delta: commit ``upto_seq`` once
+    (and only once) the slice became a live model."""
+
+    events: List[DeltaEvent]
+    upto_seq: int
+    oldest_event_ms: Optional[int]
+
+    @property
+    def user_ids(self) -> List[str]:
+        return sorted({e.user for e in self.events})
+
+    @property
+    def item_ids(self) -> List[str]:
+        return sorted({e.item for e in self.events})
+
+
+class FeedWatcher:
+    """Accumulates one app's rating delta off the changefeed.
+
+    Thread contract: :meth:`poll` runs from one place at a time (the
+    controller's tick); the cheap readers (:meth:`feed_lag`,
+    :meth:`pending_count`, :meth:`oldest_pending_ms`) are safe from any
+    thread — scrape-thread gauge callbacks included — because all shared
+    state mutates under ``_lock`` while the feed fetch itself happens
+    outside it (a slow primary must never block a metrics scrape)."""
+
+    def __init__(
+        self,
+        feed,
+        app_id: int,
+        event_values: Dict[str, object],
+        state_dir: str,
+        batch_limit: int = 500,
+        max_pending: int = 250_000,
+    ):
+        self._feed = feed
+        self._app_id = int(app_id)
+        self._event_values = dict(event_values)
+        self._batch_limit = batch_limit
+        self._max_pending = max_pending
+        self._lock = threading.Lock()
+        os.makedirs(state_dir, exist_ok=True)
+        self._cursor_path = os.path.join(state_dir, CURSOR_NAME)
+        self.cursor_seq = 0  # durable: last COMMITTED seq
+        self.generation: Optional[str] = None
+        self._load_cursor()
+        #: in-memory read position (>= cursor_seq); re-derived from the
+        #: durable cursor on restart, so an uncommitted suffix re-reads
+        self.position = self.cursor_seq
+        self.last_seq = self.cursor_seq  # feed head, as last observed
+        self._pending: List[DeltaEvent] = []
+        self.skipped_events = 0  # malformed/undecodable, counted not fatal
+
+    # -- durable cursor ---------------------------------------------------
+    def _load_cursor(self) -> None:
+        try:
+            with open(self._cursor_path) as fh:
+                state = json.load(fh)
+            self.cursor_seq = int(state["seq"])
+            self.generation = state.get("generation")
+        except (OSError, ValueError, KeyError):
+            self.cursor_seq = 0
+            self.generation = None
+
+    def _persist_cursor(self) -> None:
+        atomic_write_bytes(
+            self._cursor_path,
+            json.dumps(
+                {"seq": self.cursor_seq, "generation": self.generation}
+            ).encode(),
+        )
+
+    # -- op extraction ----------------------------------------------------
+    def _extract(self, seq: int, op: dict, out: List[DeltaEvent]) -> None:
+        kind = op.get("kind")
+        if kind == "event_insert":
+            if op.get("app") == self._app_id:
+                self._extract_event(seq, op.get("event") or {}, out)
+        elif kind == "event_write":
+            if op.get("app") == self._app_id:
+                for obj in op.get("events") or []:
+                    self._extract_event(seq, obj, out)
+        # deletes/metadata/models are invisible to fold-in by design: a
+        # deleted rating only leaves the model at the next full retrain
+        # (docs/continuous.md#failure-modes)
+
+    def _extract_event(self, seq: int, obj: dict, out: List[DeltaEvent]) -> None:
+        rule = self._event_values.get(obj.get("event"))
+        if rule is None:
+            return
+        user = obj.get("entityId")
+        item = obj.get("targetEntityId")
+        if not user or not item:
+            return
+        try:
+            if isinstance(rule, str):
+                value = float((obj.get("properties") or {})[rule])
+            else:
+                value = float(rule)
+            when = obj.get("eventTime")
+            event_time_ms = to_millis(parse_event_time(when)) if when else 0
+        except (KeyError, TypeError, ValueError):
+            # a poison event must not wedge the loop forever; the full
+            # retrain path reads through the store's own validation
+            self.skipped_events += 1
+            logger.debug("continuous: skipping undecodable event at seq %d", seq)
+            return
+        out.append(
+            DeltaEvent(
+                seq=seq, user=str(user), item=str(item), value=value,
+                event_time_ms=event_time_ms,
+            )
+        )
+
+    # -- tailing ----------------------------------------------------------
+    def poll(self, max_rounds: int = 50) -> int:
+        """Read the feed forward from ``position``, filtering matches into
+        the pending delta. Returns how many delta events were added.
+        Raises :class:`FeedGap` when incremental tailing is over."""
+        added = 0
+        for _ in range(max_rounds):
+            with self._lock:
+                since = self.position
+                if len(self._pending) >= self._max_pending:
+                    # bounded accumulation: beyond this the delta is no
+                    # longer "incremental" anyway — the policy escalates
+                    # on delta fraction; stop reading ahead rather than
+                    # hold unbounded memory (feed_lag keeps growing, the
+                    # obs signal that the loop is saturated)
+                    return added
+            batch = self._feed.fetch(since, self._batch_limit)  # no lock held
+            generation = batch.get("generation")
+            changes = batch.get("changes", [])
+            fresh: List[DeltaEvent] = []
+            top = since
+            for entry in changes:
+                seq = int(entry["seq"])
+                if seq <= since:
+                    continue
+                top = max(top, seq)
+                self._extract(seq, entry.get("op") or {}, fresh)
+            with self._lock:
+                if self.generation is None:
+                    self.generation = generation
+                elif generation is not None and generation != self.generation:
+                    raise FeedGap(
+                        f"feed generation changed ({self.generation} -> "
+                        f"{generation}): primary store replaced"
+                    )
+                self._pending.extend(fresh)
+                self.position = max(self.position, top)
+                self.last_seq = max(
+                    self.position, int(batch.get("lastSeq", self.last_seq))
+                )
+                added += len(fresh)
+                caught_up = not changes or self.position >= self.last_seq
+            if caught_up:
+                break
+        return added
+
+    # -- introspection (gauge-callback safe) ------------------------------
+    def feed_lag(self) -> int:
+        """Ops between the read position and the feed head (the
+        ``pio_continuous_feed_lag_ops`` gauge)."""
+        with self._lock:
+            return max(0, self.last_seq - self.position)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def oldest_pending_ms(self) -> Optional[int]:
+        """Event time of the oldest unfolded delta event (freshness
+        accounting: model-live lag is measured from here)."""
+        with self._lock:
+            if not self._pending:
+                return None
+            return min(e.event_time_ms for e in self._pending)
+
+    # -- consumption ------------------------------------------------------
+    def take_batch(self) -> Optional[DeltaBatch]:
+        """Snapshot the pending delta for one training cycle. The pending
+        buffer is NOT cleared — :meth:`commit` clears it once the batch
+        became a live model, so a failed/rolled-back cycle re-folds."""
+        with self._lock:
+            if not self._pending:
+                return None
+            events = list(self._pending)
+            return DeltaBatch(
+                events=events,
+                upto_seq=max(self.position, events[-1].seq),
+                oldest_event_ms=min(e.event_time_ms for e in events),
+            )
+
+    def commit(self, upto_seq: int) -> None:
+        """Durably advance the cursor through ``upto_seq`` and drop the
+        consumed delta. Call exactly when the batch's model went live."""
+        with self._lock:
+            self._pending = [e for e in self._pending if e.seq > upto_seq]
+            self.cursor_seq = max(self.cursor_seq, int(upto_seq))
+            self._persist_cursor()
+
+    def resync(self) -> None:
+        """Post-gap recovery: jump the cursor to the feed head and drop
+        the (incomplete) pending delta. Only call after a full retrain
+        has covered the missed history."""
+        ck = self._feed.checkpoint()
+        with self._lock:
+            self._pending = []
+            self.cursor_seq = int(ck.get("seq", 0))
+            self.position = self.cursor_seq
+            self.last_seq = self.cursor_seq
+            self.generation = ck.get("generation")
+            self._persist_cursor()
+        logger.warning(
+            "continuous: feed resynced to seq %d (generation %s)",
+            self.cursor_seq, self.generation,
+        )

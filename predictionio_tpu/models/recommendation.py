@@ -311,6 +311,102 @@ class ALSAlgorithm(Algorithm):
             item_map=pd.item_map,
         )
 
+    @property
+    def fold_in_supported(self) -> bool:
+        """Fold-in solves the EXPLICIT normal equations; an
+        implicit-prefs model (Hu-Koren confidence weighting,
+        ``_system_implicit``) would get mathematically wrong row updates
+        — the continuous controller escalates those engines to a full
+        retrain instead (docs/continuous.md)."""
+        return not self.params.implicit_prefs
+
+    def fold_in(
+        self,
+        ctx,
+        model: ALSModel,
+        pd: PreparedData,
+        changed_user_ids: Sequence[str],
+        changed_item_ids: Sequence[str],
+        policy=None,
+    ):
+        """ALX-style incremental update (``docs/continuous.md``): re-solve
+        only the changed/new user and item rows against fixed counterpart
+        factors, over the full current data ``pd``. Existing entities keep
+        their indices (untouched rows stay byte-identical); new entities
+        get appended, seeded rows. Returns ``(ALSModel, FoldInStats)``.
+        """
+        from ..continuous.foldin import (
+            FoldInPolicy,
+            FoldInStats,
+            extend_bimap_indexing,
+            fold_in_factors,
+            seeded_rows,
+        )
+        from ..ops.als import ALSFactors, rmse
+
+        if not self.fold_in_supported:
+            raise ValueError(
+                "fold_in solves explicit normal equations; "
+                "implicit_prefs=True models must retrain fully"
+            )
+        policy = policy or FoldInPolicy()
+        p = self.params
+        rank = model.user_factors.shape[1]
+        old_u, old_i = len(model.user_map), len(model.item_map)
+        # pd's maps are freshly built in arrival order — append the ids
+        # the baseline has never seen, preserving every existing index
+        pd_u_ids = [pd.user_map.inverse[i] for i in range(len(pd.user_map))]
+        pd_i_ids = [pd.item_map.inverse[i] for i in range(len(pd.item_map))]
+        comb_u, new_u = extend_bimap_indexing(model.user_map.to_dict(), pd_u_ids)
+        comb_i, new_i = extend_bimap_indexing(model.item_map.to_dict(), pd_i_ids)
+        # translate pd's index space into the combined space via id strings
+        t_u = np.asarray([comb_u[k] for k in pd_u_ids], dtype=np.int32)
+        t_i = np.asarray([comb_i[k] for k in pd_i_ids], dtype=np.int32)
+        users = t_u[pd.users]
+        items = t_i[pd.items]
+        uf = np.concatenate(
+            [
+                np.asarray(model.user_factors, dtype=np.float32),
+                seeded_rows(new_u, rank, p.seed, offset=old_u),
+            ]
+        )
+        itf = np.concatenate(
+            [
+                np.asarray(model.item_factors, dtype=np.float32),
+                seeded_rows(new_i, rank, p.seed + 1, offset=old_i),
+            ]
+        )
+        changed_u = sorted(
+            {comb_u[k] for k in changed_user_ids if k in comb_u}
+            | set(range(old_u, old_u + new_u))
+        )
+        changed_i = sorted(
+            {comb_i[k] for k in changed_item_ids if k in comb_i}
+            | set(range(old_i, old_i + new_i))
+        )
+        before = rmse(ALSFactors(uf, itf, rank), users, items, pd.ratings)
+        uf, itf, counts = fold_in_factors(
+            uf, itf, users, items, pd.ratings,
+            changed_u, changed_i, p.lambda_, policy=policy,
+        )
+        after = rmse(ALSFactors(uf, itf, rank), users, items, pd.ratings)
+        folded = ALSModel(
+            rank=model.rank,
+            user_factors=uf,
+            item_factors=itf,
+            user_map=BiMap(comb_u),
+            item_map=BiMap(comb_i),
+        )
+        stats = FoldInStats(
+            folded_users=counts["solved_users"],
+            folded_items=counts["solved_items"],
+            new_users=new_u,
+            new_items=new_i,
+            rmse_before=before,
+            rmse_after=after,
+        )
+        return folded, stats
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         results = self.batch_predict(model, [(0, query)])
         return results[0][1]

@@ -186,10 +186,13 @@ class RolloutManager:
         candidate_instance_id: Optional[str] = None,
         percent: Optional[float] = None,
         gates: Optional[dict] = None,
+        reason: str = "rollout started",
     ) -> dict:
         """Open a new plan in SHADOW: load the candidate resident next
         to the baseline and persist the plan durably before the first
-        duplicated query."""
+        duplicated query. ``reason`` lands in the plan history — the
+        audit line distinguishing an operator start from the continuous
+        controller's auto-submit (docs/continuous.md)."""
         from ..workflow.serving import prepare_deployment
 
         with self._lock:
@@ -261,7 +264,7 @@ class RolloutManager:
                 created_time=now,
                 updated_time=now,
                 gates=gate_cfg.to_dict(),
-                history=[self._history_entry(ROLLOUT_SHADOW, "rollout started")],
+                history=[self._history_entry(ROLLOUT_SHADOW, reason)],
             )
             pid = md.rollout_plan_upsert(plan)
             self.plan = dataclasses.replace(plan, id=pid)

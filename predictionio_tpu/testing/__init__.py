@@ -6,9 +6,11 @@ package turns those sites into controllable failure points in tests and
 chaos runs while costing one ``None``-check in production.
 """
 
+from .clock import FakeClock
 from .faults import FaultSpec, activate, deactivate, fault_point, inject, parse
 
 __all__ = [
+    "FakeClock",
     "FaultSpec",
     "activate",
     "deactivate",

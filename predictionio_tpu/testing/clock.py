@@ -1,0 +1,24 @@
+"""Injectable fake monotonic clock for deterministic drives.
+
+Every plane with time-based decisions (resilience, rollout gates, the
+continuous controller) takes an injected ``clock`` callable; this is the
+one shared advanceable implementation — tests and the deterministic
+loadgen scenarios use it instead of each growing a private copy.
+"""
+
+from __future__ import annotations
+
+__all__ = ["FakeClock"]
+
+
+class FakeClock:
+    """A monotonic clock that only moves when told to."""
+
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds

@@ -214,6 +214,20 @@ def _rollout_request(
         raise RuntimeError(f"no query server at {url}: {exc}") from exc
 
 
+def continuous_command(args: argparse.Namespace) -> dict:
+    """``pio continuous start|status|pause|trigger`` — thin HTTP client
+    over the query server's /continuous routes (docs/continuous.md)."""
+    sub = args.continuous_command
+    if sub == "status":
+        return _rollout_request(args.ip, args.port, "GET", "/continuous.json")
+    body: dict = {}
+    if sub == "trigger" and args.full:
+        body["full"] = True
+    return _rollout_request(
+        args.ip, args.port, "POST", f"/continuous/{sub}", body
+    )
+
+
 def rollout_command(args: argparse.Namespace) -> dict:
     """``pio rollout start|status|promote|abort``."""
     sub = args.rollout_command
@@ -310,6 +324,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batch size cap (size to catalog and depth)")
     dp.add_argument("--batch-pipeline-depth", type=int, default=None,
                     help="batches in flight at once (default 2)")
+    dp.add_argument("--continuous-app", type=int, default=None,
+                    metavar="APP_ID",
+                    help="attach the continuous-learning loop for this app "
+                    "(docs/continuous.md)")
+    dp.add_argument("--continuous-feed", default=None, metavar="URL",
+                    help="storage primary to tail for the continuous loop")
     dp.add_argument("--spawn", action="store_true")
 
     ud = sub.add_parser("undeploy", help="stop a running query server")
@@ -352,6 +372,33 @@ def build_parser() -> argparse.ArgumentParser:
     for sp in (ro_start, ro_prom, ro_abort) + tuple(
         [ro_sub.choices["status"]]
     ):
+        sp.add_argument("--ip", default="localhost")
+        sp.add_argument("--port", type=int, default=8000)
+
+    co = sub.add_parser(
+        "continuous",
+        help="continuous-learning loop on a running query server: "
+        "changefeed-driven fold-in training with automatic rollout "
+        "submission (docs/continuous.md)",
+    )
+    co_sub = co.add_subparsers(dest="continuous_command", required=True)
+    co_start = co_sub.add_parser(
+        "start", help="(re)start the background watch/train loop"
+    )
+    co_sub.add_parser(
+        "status", help="cursor, feed lag, pending delta, last cycle"
+    )
+    co_pause = co_sub.add_parser(
+        "pause", help="stop triggering cycles (the cursor keeps its place)"
+    )
+    co_trig = co_sub.add_parser(
+        "trigger", help="force a training cycle on the next tick"
+    )
+    co_trig.add_argument(
+        "--full", action="store_true",
+        help="force a full retrain instead of fold-in",
+    )
+    for sp in (co_start, co_pause, co_trig, co_sub.choices["status"]):
         sp.add_argument("--ip", default="localhost")
         sp.add_argument("--port", type=int, default=8000)
 
@@ -743,6 +790,10 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         if args.batch_pipeline_depth is not None:
             srv_argv += ["--batch-pipeline-depth",
                          str(args.batch_pipeline_depth)]
+        if args.continuous_app is not None:
+            srv_argv += ["--continuous-app", str(args.continuous_app)]
+        if args.continuous_feed:
+            srv_argv += ["--continuous-feed", args.continuous_feed]
         if args.spawn:
             return _spawn_detached("predictionio_tpu.tools.run_server", srv_argv)
         srv_args = run_server.build_parser().parse_args(srv_argv)
@@ -755,6 +806,10 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
 
     if cmd == "rollout":
         _emit(rollout_command(args))
+        return EXIT_OK
+
+    if cmd == "continuous":
+        _emit(continuous_command(args))
         return EXIT_OK
 
     if cmd == "eventserver":

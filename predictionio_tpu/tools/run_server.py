@@ -41,8 +41,52 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-pipeline-depth", type=int, default=None,
                    help="batches in flight at once (default 2; raise when "
                         "the host-to-device round trip dwarfs device time)")
+    p.add_argument("--continuous-app", type=int, default=None, metavar="APP_ID",
+                   help="attach the continuous-learning loop for this app: "
+                        "changefeed-driven fold-in training with automatic "
+                        "rollout submission (docs/continuous.md)")
+    p.add_argument("--continuous-feed", default=None, metavar="URL",
+                   help="storage primary whose GET /replicate/changes the "
+                        "loop tails (default: $PIO_STORAGE_SOURCES_*_URL "
+                        "when the registry is remote)")
+    p.add_argument("--continuous-min-events", type=int, default=10,
+                   help="delta size that triggers a training cycle")
+    p.add_argument("--continuous-staleness-s", type=float, default=300.0,
+                   help="trigger below min-events once the oldest pending "
+                        "event is this stale (freshness floor)")
     p.add_argument("--verbose", action="store_true")
     return p
+
+
+def _continuous_config(args: argparse.Namespace, registry):
+    """Build a ContinuousConfig from the CLI surface (None = disabled)."""
+    if getattr(args, "continuous_app", None) is None:
+        return None
+    from ..continuous.controller import ContinuousConfig
+
+    feed_url = getattr(args, "continuous_feed", None)
+    if not feed_url:
+        # derive the primary from a remote-registry env: the loop tails
+        # the same storage server every other plane already talks to
+        env = registry._env if registry is not None else {}
+        for key, value in env.items():
+            if key.startswith("PIO_STORAGE_SOURCES_") and key.endswith("_URL"):
+                feed_url = value.split(",")[0]
+                if feed_url.startswith("pio+ha://"):
+                    feed_url = "http://" + feed_url[len("pio+ha://"):]
+                break
+    if not feed_url:
+        raise SystemExit(
+            "--continuous-app needs a changefeed source: pass "
+            "--continuous-feed URL (the storage primary) or configure a "
+            "remote storage registry (docs/continuous.md)"
+        )
+    return ContinuousConfig(
+        app_id=args.continuous_app,
+        feed_url=feed_url,
+        min_events=args.continuous_min_events,
+        max_staleness_s=args.continuous_staleness_s,
+    )
 
 
 def make_server(
@@ -68,6 +112,7 @@ def make_server(
         access_key=args.accesskey,
         batch=args.batch,
         log_url=args.log_url,
+        continuous=_continuous_config(args, registry),
         # frozen dataclass: only override the defaults when flags were given
         **{
             k: v

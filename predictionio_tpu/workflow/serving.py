@@ -138,6 +138,11 @@ class ServerConfig:
     #: ``DEFAULT_MAX_QUEUE``); 0 disables shedding (unbounded, the
     #: pre-resilience behavior).
     max_queue: Optional[int] = None
+    #: Continuous-learning loop: a ``ContinuousConfig``
+    #: (``predictionio_tpu/continuous``) attaches a changefeed-driven
+    #: fold-in controller to this server — candidates auto-submit
+    #: through the rollout plane (docs/continuous.md). None = disabled.
+    continuous: Optional[Any] = None
 
 
 # ---------------------------------------------------------------------------
@@ -396,6 +401,12 @@ class _QueryHandler(JsonHTTPHandler):
             self._handle_reload()
         elif path in ("/rollout/start", "/rollout/promote", "/rollout/abort"):
             self._handle_rollout(path, raw)
+        elif path in (
+            "/continuous/start",
+            "/continuous/pause",
+            "/continuous/trigger",
+        ):
+            self._handle_continuous(path, raw)
         else:
             self.respond(404, {"message": "Not Found"})
 
@@ -514,6 +525,41 @@ class _QueryHandler(JsonHTTPHandler):
             logger.exception("rollout %s failed", path)
             self.respond(500, {"message": str(exc)})
 
+    def _handle_continuous(self, path: str, raw: bytes) -> None:
+        """``POST /continuous/start|pause|trigger`` (docs/continuous.md)."""
+        continuous = self.server.continuous
+        if continuous is None:
+            self.respond(
+                409,
+                {
+                    "message": (
+                        "no continuous controller attached; deploy with "
+                        "--continuous-app (docs/continuous.md)"
+                    ),
+                },
+            )
+            return
+        try:
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+        except ValueError as exc:
+            self.respond(400, {"message": str(exc)})
+            return
+        if not isinstance(body, dict):
+            self.respond(400, {"message": "expected a JSON object body"})
+            return
+        try:
+            if path == "/continuous/start":
+                continuous.start()
+                out = continuous.status()
+            elif path == "/continuous/pause":
+                out = continuous.pause()
+            else:
+                out = continuous.trigger(full=bool(body.get("full")))
+            self.respond(200, out)
+        except Exception as exc:
+            logger.exception("continuous %s failed", path)
+            self.respond(500, {"message": str(exc)})
+
     def do_GET(self) -> None:  # noqa: N802
         self.response_labels = None  # handler instances persist per-connection
         path = urlparse(self.path).path
@@ -533,6 +579,12 @@ class _QueryHandler(JsonHTTPHandler):
                 )
         elif path == "/rollout.json":
             self.respond(200, self.server.rollout.status())
+        elif path == "/continuous.json":
+            continuous = self.server.continuous
+            if continuous is None:
+                self.respond(200, {"enabled": False})
+            else:
+                self.respond(200, continuous.status())
         elif path == "/reload":
             # deprecated spelling (state change behind a GET), kept for
             # PredictionIO CreateServer parity — use POST /reload
@@ -665,6 +717,24 @@ class QueryServer(BackgroundHTTPServer):
             logger.exception(
                 "rollout resume failed; serving the baseline only"
             )
+        # Continuous-learning plane (docs/continuous.md): the controller
+        # resumes its durable cursor and any in-flight candidate on
+        # construction; a broken loop degrades to plain serving, never a
+        # failed boot (the loop is an optimization, the server is not).
+        self.continuous = None
+        if config.continuous is not None:
+            try:
+                from ..continuous.controller import ContinuousController
+
+                self.continuous = ContinuousController(self, config.continuous)
+                if config.continuous.autostart:
+                    self.continuous.start()
+            except Exception:
+                self.continuous = None
+                logger.exception(
+                    "continuous controller failed to attach; serving "
+                    "without the continuous-learning loop"
+                )
 
     # Pre-resilience attribute surface, kept for callers/tests that read
     # the counters straight off the server object.
@@ -1078,6 +1148,8 @@ class QueryServer(BackgroundHTTPServer):
         if self._batcher is not None:
             self._batcher.close()  # fail queued requests fast, join thread
         self._feedback_pool.shutdown(wait=False)
+        if getattr(self, "continuous", None) is not None:
+            self.continuous.stop()
         if getattr(self, "rollout", None) is not None:
             self.rollout.close()
         super().server_close()
@@ -1187,6 +1259,8 @@ class QueryServer(BackgroundHTTPServer):
             out["batching"] = self._batcher.stats
         if getattr(self, "rollout", None) is not None:
             out["rollout"] = self.rollout.status()
+        if getattr(self, "continuous", None) is not None:
+            out["continuous"] = self.continuous.status()
         from ..utils.profiling import phases_from_env
 
         phases = phases_from_env(dep.instance.env)

@@ -283,7 +283,16 @@ class TestPallasModeGuards:
 
 class TestSortGatherIndices:
     """Within-row index sorting (gather locality) must be invisible to the
-    math: the Gramian sum over K is permutation-invariant."""
+    math: the Gramian sum over K is permutation-invariant *in exact
+    arithmetic*. In float32 the sort reorders the einsum accumulation, so
+    factors agree only to reassociation rounding — ~1e-5 per solve,
+    amplified through the alternating iterations (ROUND7_NOTES.md pins
+    the analysis; the seed's atol=1e-5 over 3 iterations sat exactly on
+    that noise floor). The contract worth pinning is two-part: the
+    *multiset* of (idx, val) pairs per row is exactly preserved
+    (bit-level, below) and training quality is unchanged — factors equal
+    to a documented reassociation tolerance and training RMSE equal to
+    1e-3."""
 
     def test_sorted_buckets_preserve_rows_and_padding(self):
         from predictionio_tpu.ops.als import bucketize, sort_bucket_indices
@@ -330,7 +339,7 @@ class TestSortGatherIndices:
             )
 
     def test_training_result_unchanged(self):
-        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
+        from predictionio_tpu.ops.als import ALSConfig, als_train_coo, rmse
 
         rng = np.random.default_rng(6)
         nnz, n_u, n_i = 20_000, 500, 200
@@ -346,11 +355,19 @@ class TestSortGatherIndices:
             ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=0,
                       sort_gather_indices=True),
         )
+        # Factor parity to the f32 reassociation tolerance: the sort
+        # reorders each row's einsum accumulation, so per-solve rounding
+        # is ~1e-5 and three alternating iterations amplify it through
+        # the Cholesky solves (ROUND7_NOTES.md). The old atol=1e-5 bound
+        # asserted bitwise-ish equality that f32 cannot promise.
         np.testing.assert_allclose(
             np.asarray(base.user_factors),
             np.asarray(sorted_run.user_factors),
-            rtol=1e-4, atol=1e-5,
+            rtol=1e-3, atol=1e-4,
         )
+        # ...and the bound that actually matters for an A/B: training
+        # quality is unchanged.
+        assert abs(rmse(base, u, i, v) - rmse(sorted_run, u, i, v)) < 1e-3
 
 
 class TestGatherDtype:

@@ -1,0 +1,914 @@
+"""Continuous-learning plane tests (``predictionio_tpu/continuous``,
+docs/continuous.md).
+
+Covers the ISSUE-7 acceptance contract on injected clocks with zero
+wall-clock sleeps on any decision path:
+
+- fold-in math: a held-out slice of users folds back in to within the
+  documented tolerance of a full retrain (RMSE ratio <= 1.25), untouched
+  rows stay byte-identical, zero delta is a no-op;
+- escalation policy: delta fraction / new-entity fraction / RMSE drift
+  all force a full retrain;
+- the feed watcher: changefeed filtering, durable-cursor resume,
+  FeedGap on sequence gaps and generation changes, resync;
+- the controller state machine end to end on the cheap sample engine:
+  delta -> candidate -> auto-submit -> monitor -> LIVE commit, rollout
+  busy backoff, gate-rollback quarantine + forced full retrain, offline
+  scoring quarantine, pause/trigger, the /continuous HTTP surface and
+  the `pio continuous` CLI;
+- the ALS closed loop: feedback events posted to the event server
+  produce an auto-promoted live model with no manual step and zero
+  client-visible failures (the loadgen --feedback-stream scenario), and
+  a restart mid-cycle resumes the persisted cursor AND the in-flight
+  rollout instead of replaying either.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.continuous.controller import (
+    ContinuousConfig,
+    ContinuousController,
+)
+from predictionio_tpu.continuous.foldin import (
+    FOLD_IN,
+    FULL_RETRAIN,
+    FoldInPolicy,
+    decide_mode,
+    fold_in_factors,
+    seeded_rows,
+)
+from predictionio_tpu.continuous.watcher import (
+    FeedGap,
+    FeedWatcher,
+    LocalFeed,
+)
+from predictionio_tpu.controller import WorkflowParams
+from predictionio_tpu.storage import DataMap, Event, StorageRegistry
+from predictionio_tpu.storage.changefeed import Changefeed
+from predictionio_tpu.storage.metadata import (
+    ROLLOUT_CANARY,
+    ROLLOUT_LIVE,
+    ROLLOUT_ROLLED_BACK,
+    ROLLOUT_SHADOW,
+)
+from predictionio_tpu.storage.oplog import OpLog
+from predictionio_tpu.testing import faults
+from predictionio_tpu.workflow.core_workflow import run_train
+from predictionio_tpu.workflow.serving import QueryServer, ServerConfig
+
+from predictionio_tpu.testing.clock import FakeClock
+
+from sample_engine import reset_all_counts
+from test_engine import make_engine, make_params
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    reset_all_counts()
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+
+
+# ---------------------------------------------------------------------------
+# escalation policy (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestDecideMode:
+    def test_within_policy_folds(self):
+        mode, reason = decide_mode(
+            FoldInPolicy(),
+            total_events=1000, delta_events=50,
+            known_entities=200, new_entities=10,
+        )
+        assert mode == FOLD_IN
+        assert "within fold-in policy" in reason
+
+    def test_delta_fraction_escalates(self):
+        mode, reason = decide_mode(
+            FoldInPolicy(max_delta_fraction=0.1),
+            total_events=100, delta_events=50,
+            known_entities=200, new_entities=0,
+        )
+        assert mode == FULL_RETRAIN
+        assert "delta fraction" in reason
+
+    def test_new_entity_fraction_escalates(self):
+        mode, reason = decide_mode(
+            FoldInPolicy(max_new_entity_fraction=0.1),
+            total_events=1000, delta_events=10,
+            known_entities=100, new_entities=50,
+        )
+        assert mode == FULL_RETRAIN
+        assert "new-entity fraction" in reason
+
+    def test_unavailable_or_empty_baseline_escalates(self):
+        assert decide_mode(
+            FoldInPolicy(), total_events=10, delta_events=1,
+            known_entities=10, new_entities=0, fold_in_available=False,
+        )[0] == FULL_RETRAIN
+        assert decide_mode(
+            FoldInPolicy(), total_events=0, delta_events=1,
+            known_entities=0, new_entities=1,
+        )[0] == FULL_RETRAIN
+
+
+# ---------------------------------------------------------------------------
+# fold-in math
+# ---------------------------------------------------------------------------
+
+
+def _synth_matrix(seed=0, n_u=60, n_i=40, rank=6, nnz=3000):
+    rng = np.random.default_rng(seed)
+    gu = rng.normal(size=(n_u, rank)).astype(np.float32)
+    gi = rng.normal(size=(n_i, rank)).astype(np.float32)
+    u = rng.integers(0, n_u, nnz).astype(np.int32)
+    i = rng.integers(0, n_i, nnz).astype(np.int32)
+    v = (gu[u] * gi[i]).sum(-1).astype(np.float32)
+    return u, i, v, n_u, n_i, rank
+
+
+class TestFoldInMath:
+    #: documented tolerance (docs/continuous.md#fold-in): fold-in RMSE on
+    #: the full matrix stays within 1.25x the full-retrain RMSE
+    RMSE_RATIO = 1.25
+
+    def test_heldout_users_converge_to_full_retrain(self):
+        from predictionio_tpu.ops.als import (
+            ALSConfig, ALSFactors, als_train_coo, rmse,
+        )
+
+        u, i, v, n_u, n_i, rank = _synth_matrix()
+        held = u >= n_u - 10  # every rating of the last 10 users
+        cfg = ALSConfig(rank=rank, iterations=8, lambda_=0.05, seed=0)
+        base = als_train_coo(u[~held], i[~held], v[~held], n_u - 10, n_i, cfg)
+        full = als_train_coo(u, i, v, n_u, n_i, cfg)
+
+        uf = np.concatenate([
+            np.asarray(base.user_factors),
+            seeded_rows(10, rank, 0, offset=n_u - 10),
+        ])
+        itf = np.asarray(base.item_factors)
+        changed_u = list(range(n_u - 10, n_u))
+        changed_i = sorted(set(i[held].tolist()))
+        uf2, itf2, counts = fold_in_factors(
+            uf, itf, u, i, v, changed_u, changed_i, lambda_=0.05,
+            policy=FoldInPolicy(fold_iterations=2),
+        )
+        assert counts["solved_users"] == 10
+        r_full = rmse(full, u, i, v)
+        r_fold = rmse(ALSFactors(uf2, itf2, rank), u, i, v)
+        assert r_fold <= r_full * self.RMSE_RATIO + 0.05
+        # untouched user rows are BYTE-identical (the no-op guarantee
+        # that makes fold-in an incremental step, not a retrain)
+        untouched = np.setdiff1d(np.arange(n_u - 10), changed_u)
+        np.testing.assert_array_equal(uf2[untouched], uf[untouched])
+
+    def test_zero_delta_is_identity(self):
+        u, i, v, n_u, n_i, rank = _synth_matrix(seed=3, nnz=800)
+        uf = np.random.default_rng(1).normal(size=(n_u, rank)).astype(np.float32)
+        itf = np.random.default_rng(2).normal(size=(n_i, rank)).astype(np.float32)
+        uf2, itf2, counts = fold_in_factors(
+            uf, itf, u, i, v, [], [], lambda_=0.05,
+        )
+        np.testing.assert_array_equal(uf2, uf)
+        np.testing.assert_array_equal(itf2, itf)
+        assert counts == {"solved_users": 0, "solved_items": 0}
+
+    def test_als_algorithm_fold_in_zero_events_identical_factors(self):
+        """ALSAlgorithm.fold_in with an empty changed set returns a model
+        whose factors are identical (same maps, same rows)."""
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm, ALSAlgorithmParams, ALSModel, PreparedData,
+        )
+        from predictionio_tpu.storage import BiMap
+
+        u, i, v, n_u, n_i, rank = _synth_matrix(seed=5, n_u=12, n_i=8, nnz=200)
+        user_map = BiMap({f"u{k}": k for k in range(n_u)})
+        item_map = BiMap({f"i{k}": k for k in range(n_i)})
+        rng = np.random.default_rng(0)
+        model = ALSModel(
+            rank=rank,
+            user_factors=rng.normal(size=(n_u, rank)).astype(np.float32),
+            item_factors=rng.normal(size=(n_i, rank)).astype(np.float32),
+            user_map=user_map,
+            item_map=item_map,
+        )
+        pd = PreparedData(
+            user_map=user_map, item_map=item_map, users=u, items=i, ratings=v
+        )
+        algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
+        folded, stats = algo.fold_in(None, model, pd, [], [])
+        np.testing.assert_array_equal(folded.user_factors, model.user_factors)
+        np.testing.assert_array_equal(folded.item_factors, model.item_factors)
+        assert folded.user_map == model.user_map
+        assert stats.new_users == 0 and stats.new_items == 0
+
+    def test_implicit_prefs_model_cannot_fold_in(self):
+        """Fold-in solves the EXPLICIT normal equations; an
+        implicit-prefs ALS must refuse (the controller then escalates to
+        a full retrain instead of folding with the wrong objective)."""
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm, ALSAlgorithmParams, ALSModel, PreparedData,
+        )
+        from predictionio_tpu.storage import BiMap
+
+        implicit = ALSAlgorithm(ALSAlgorithmParams(implicit_prefs=True))
+        assert implicit.fold_in_supported is False
+        assert ALSAlgorithm(ALSAlgorithmParams()).fold_in_supported is True
+        rank = 4
+        m = BiMap({"u0": 0})
+        model = ALSModel(
+            rank=rank,
+            user_factors=np.zeros((1, rank), dtype=np.float32),
+            item_factors=np.zeros((1, rank), dtype=np.float32),
+            user_map=m, item_map=BiMap({"i0": 0}),
+        )
+        pd = PreparedData(
+            user_map=model.user_map, item_map=model.item_map,
+            users=np.array([0], dtype=np.int32),
+            items=np.array([0], dtype=np.int32),
+            ratings=np.array([1.0], dtype=np.float32),
+        )
+        with pytest.raises(ValueError, match="implicit"):
+            implicit.fold_in(None, model, pd, [], [])
+
+    def test_fold_in_new_entities_extend_maps_stably(self):
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm, ALSAlgorithmParams, ALSModel, PreparedData,
+        )
+        from predictionio_tpu.storage import BiMap
+
+        rank = 4
+        base_users = {f"u{k}": k for k in range(5)}
+        base_items = {f"i{k}": k for k in range(4)}
+        rng = np.random.default_rng(0)
+        model = ALSModel(
+            rank=rank,
+            user_factors=rng.normal(size=(5, rank)).astype(np.float32),
+            item_factors=rng.normal(size=(4, rank)).astype(np.float32),
+            user_map=BiMap(base_users),
+            item_map=BiMap(base_items),
+        )
+        # fresh data read whose maps arrived in a DIFFERENT order and
+        # include one new user
+        pd_users = {"u3": 0, "u0": 1, "u9": 2}
+        pd_items = {"i1": 0, "i0": 1}
+        pd = PreparedData(
+            user_map=BiMap(pd_users),
+            item_map=BiMap(pd_items),
+            users=np.array([0, 1, 2, 2], dtype=np.int32),
+            items=np.array([0, 1, 0, 1], dtype=np.int32),
+            ratings=np.array([5, 4, 3, 2], dtype=np.float32),
+        )
+        algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
+        folded, stats = algo.fold_in(None, model, pd, ["u9"], [])
+        # existing ids keep their indices; the new user appended at the end
+        assert folded.user_map["u0"] == 0 and folded.user_map["u3"] == 3
+        assert folded.user_map["u9"] == 5
+        assert stats.new_users == 1
+        # untouched rows byte-identical
+        np.testing.assert_array_equal(
+            folded.user_factors[:5][[0, 1, 2, 4]],
+            model.user_factors[[0, 1, 2, 4]],
+        )
+        # the new user's row was actually solved (not left at its seed)
+        assert not np.array_equal(
+            folded.user_factors[5], seeded_rows(1, rank, algo.params.seed, 5)[0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# feed watcher
+# ---------------------------------------------------------------------------
+
+
+def _rate(user, item, rating, name="rate"):
+    return Event(
+        event=name, entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": rating} if name == "rate" else {}),
+    )
+
+
+class TestFeedWatcher:
+    def _feed(self, registry, tmp_path):
+        cf = Changefeed(
+            OpLog(str(tmp_path / "oplog")),
+            registry.get_events(), registry.get_metadata(),
+            registry.get_models(),
+        )
+        registry.get_events().init(1)
+        registry.get_events().init(2)
+        return cf, LocalFeed(cf.oplog)
+
+    def test_filters_app_and_event_names(self, registry, tmp_path):
+        cf, feed = self._feed(registry, tmp_path)
+        w = FeedWatcher(
+            feed, 1, {"rate": "rating", "buy": 4.0}, str(tmp_path / "st")
+        )
+        cf.insert_event(_rate("u1", "i1", 5.0), 1)
+        cf.insert_event(_rate("u2", "i2", 3.0), 2)  # other app
+        cf.insert_event(_rate("u3", "i3", 0, name="view"), 1)  # unwatched
+        cf.insert_event(_rate("u4", "i4", 0, name="buy"), 1)  # fixed value
+        cf.write_events([_rate("u5", "i5", 2.0)], 1, fresh=True)
+        assert w.poll() == 3
+        batch = w.take_batch()
+        assert [(e.user, e.item, e.value) for e in batch.events] == [
+            ("u1", "i1", 5.0), ("u4", "i4", 4.0), ("u5", "i5", 2.0),
+        ]
+        assert w.feed_lag() == 0
+        assert batch.upto_seq == cf.last_seq
+
+    def test_commit_is_durable_and_restart_resumes_exact(
+        self, registry, tmp_path
+    ):
+        cf, feed = self._feed(registry, tmp_path)
+        state = str(tmp_path / "st")
+        w = FeedWatcher(feed, 1, {"rate": "rating"}, state)
+        cf.insert_event(_rate("u1", "i1", 5.0), 1)
+        cf.insert_event(_rate("u2", "i1", 4.0), 1)
+        w.poll()
+        batch = w.take_batch()
+        assert len(batch.events) == 2
+        # crash BEFORE commit: a new watcher re-reads the whole suffix
+        w2 = FeedWatcher(feed, 1, {"rate": "rating"}, state)
+        assert w2.cursor_seq == 0
+        assert w2.poll() == 2
+        # commit, then restart: the suffix is consumed exactly once
+        w2.commit(batch.upto_seq)
+        assert w2.pending_count() == 0
+        w3 = FeedWatcher(feed, 1, {"rate": "rating"}, state)
+        assert w3.cursor_seq == batch.upto_seq
+        assert w3.poll() == 0
+        cf.insert_event(_rate("u3", "i2", 1.0), 1)
+        assert w3.poll() == 1  # only the new event, never a replay
+
+    def test_poison_event_skipped_not_fatal(self, registry, tmp_path):
+        cf, feed = self._feed(registry, tmp_path)
+        w = FeedWatcher(feed, 1, {"rate": "rating"}, str(tmp_path / "st"))
+        cf.insert_event(_rate("u1", "i1", 5.0), 1)
+        cf.insert_event(  # "rate" without the required rating property
+            Event(event="rate", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i2",
+                  properties=DataMap({})), 1,
+        )
+        assert w.poll() == 1
+        assert w.skipped_events == 1
+
+    def test_sequence_gap_raises_feedgap_and_resync_recovers(
+        self, registry, tmp_path
+    ):
+        # a log that starts at base_seq 5 cannot serve a cursor at 0
+        oplog = OpLog(str(tmp_path / "oplog"), base_seq=5)
+        feed = LocalFeed(oplog)
+        w = FeedWatcher(feed, 1, {"rate": "rating"}, str(tmp_path / "st"))
+        with pytest.raises(FeedGap):
+            w.poll()
+        w.resync()
+        assert w.cursor_seq == 5
+        assert w.poll() == 0  # tailing works again from the head
+
+    def test_generation_change_raises_feedgap(self, registry, tmp_path):
+        cf, feed = self._feed(registry, tmp_path)
+        w = FeedWatcher(feed, 1, {"rate": "rating"}, str(tmp_path / "st"))
+        cf.insert_event(_rate("u1", "i1", 5.0), 1)
+        assert w.poll() == 1
+        # the primary store is wiped and replaced: fresh oplog, new
+        # generation, same URL
+        feed2 = LocalFeed(OpLog(str(tmp_path / "oplog2")))
+        w._feed = feed2
+        with pytest.raises(FeedGap, match="generation"):
+            w.poll()
+
+
+# ---------------------------------------------------------------------------
+# controller state machine (sample engine: no device math, ms-cheap)
+# ---------------------------------------------------------------------------
+
+
+def _gates(**overrides):
+    g = {
+        "min_samples": 5,
+        "window_s": 100_000.0,
+        "shadow_hold_s": 10.0,
+        "canary_hold_s": 10.0,
+        "max_divergence": 1.0,
+        "max_p99_latency_ratio": 1_000.0,
+    }
+    g.update(overrides)
+    return g
+
+
+class _Loop:
+    """One assembled continuous loop over the sample engine."""
+
+    def __init__(self, registry, tmp_path, **cfg_kw):
+        self.registry = registry
+        self.engine = make_engine()
+        self.baseline_id = run_train(
+            self.engine, make_params(algo_ids=(11,)), registry,
+            workflow_params=WorkflowParams(batch="continuous-test"),
+        )
+        registry.get_events().init(1)
+        self.changefeed = Changefeed(
+            OpLog(str(tmp_path / "oplog")),
+            registry.get_events(), registry.get_metadata(),
+            registry.get_models(),
+        )
+        self.clock = FakeClock()
+        self.server = QueryServer(
+            ServerConfig(
+                ip="127.0.0.1", port=0, batching=False,
+                engine_instance_id=self.baseline_id,
+            ),
+            self.engine, registry, clock=self.clock,
+        )
+        defaults = dict(
+            app_id=1,
+            min_events=3,
+            max_staleness_s=1e9,
+            rollout_gates=_gates(),
+            quarantine_backoff_s=60.0,
+            score_window=50,
+            state_dir=str(tmp_path / "cstate"),
+        )
+        defaults.update(cfg_kw)
+        self.ctl = ContinuousController(
+            self.server,
+            ContinuousConfig(**defaults),
+            feed=LocalFeed(self.changefeed.oplog),
+            clock=self.clock,
+        )
+        self.server.continuous = self.ctl  # status embeds + routes
+
+    def post(self, n, start=0):
+        for k in range(start, start + n):
+            self.changefeed.insert_event(_rate(f"u{k}", f"i{k % 3}", 4.0), 1)
+
+    def drive(self, n, start=0):
+        for k in range(start, start + n):
+            _result, status = self.server.handle_query({"id": k})
+            assert status == 200
+        self.server.rollout.drain_shadow()
+
+    def promote_to_live(self):
+        """Feed the gates until the in-flight candidate goes LIVE."""
+        for _round in range(6):
+            if not self.server.rollout.active:
+                break
+            self.drive(8, start=1000 + _round * 100)
+            self.clock.advance(11.0)
+            self.drive(2, start=2000 + _round * 100)
+            self.server.rollout.drain_shadow()
+        self.ctl.tick()
+
+    def close(self):
+        self.server.server_close()
+
+
+class TestContinuousController:
+    def test_no_delta_no_candidate(self, registry, tmp_path):
+        loop = _Loop(registry, tmp_path)
+        try:
+            status = loop.ctl.tick()
+            assert status["state"] == "WATCHING"
+            assert status["cycles"] == 0
+            assert "candidate" not in status
+            assert not loop.server.rollout.active
+        finally:
+            loop.close()
+
+    def test_delta_below_min_events_waits(self, registry, tmp_path):
+        loop = _Loop(registry, tmp_path, min_events=5)
+        try:
+            loop.post(3)
+            status = loop.ctl.tick()
+            assert status["cycles"] == 0
+            assert status["pendingEvents"] == 3
+        finally:
+            loop.close()
+
+    def test_full_cycle_auto_submits_and_commits_on_live(
+        self, registry, tmp_path
+    ):
+        loop = _Loop(registry, tmp_path)
+        try:
+            loop.post(4)
+            status = loop.ctl.tick()
+            # sample engine has no fold_in -> full retrain through the
+            # existing run_train path
+            assert status["lastCycle"]["mode"] == FULL_RETRAIN
+            assert status["state"] == "MONITORING"
+            cand_id = status["candidate"]["instanceId"]
+            assert cand_id != loop.baseline_id
+            plan = loop.server.rollout.plan
+            assert plan.stage == ROLLOUT_SHADOW
+            assert plan.candidate_instance_id == cand_id
+            assert plan.history[0]["reason"] == (
+                "continuous controller auto-submit"
+            )
+            assert status["cursorSeq"] == 0  # nothing committed yet
+            loop.promote_to_live()
+            status = loop.ctl.status()
+            assert loop.server.rollout.plan.stage == ROLLOUT_LIVE
+            assert loop.server.deployment.instance.id == cand_id
+            assert status["state"] == "WATCHING"
+            assert status["cursorSeq"] == loop.changefeed.last_seq
+            assert status["lastCycle"]["outcome"] == "live"
+            assert status["lastFreshnessS"] is not None
+            # metrics: the loop's outcomes are counted
+            assert loop.ctl._folds.value(kind=FULL_RETRAIN) == 1
+            assert loop.ctl._folds.value(kind="promoted") == 1
+        finally:
+            loop.close()
+
+    def test_busy_rollout_backs_off_then_submits(self, registry, tmp_path):
+        loop = _Loop(registry, tmp_path)
+        try:
+            # an operator rollout is already in flight
+            op_cand = run_train(
+                loop.engine, make_params(algo_ids=(13,)), registry,
+                workflow_params=WorkflowParams(batch="operator"),
+            )
+            loop.server.rollout.start(
+                candidate_instance_id=op_cand, gates=_gates()
+            )
+            loop.post(4)
+            status = loop.ctl.tick()
+            assert status["state"] == "SUBMIT_PENDING"
+            assert "rollout busy" in status["lastError"]
+            # still pending while the operator's rollout runs
+            loop.ctl.tick()
+            assert loop.server.rollout.plan.candidate_instance_id == op_cand
+            loop.server.rollout.abort("operator done")
+            loop.clock.advance(120.0)  # past the backoff delay
+            status = loop.ctl.tick()
+            assert status["state"] == "MONITORING"
+            assert loop.server.rollout.plan.candidate_instance_id == (
+                status["candidate"]["instanceId"]
+            )
+        finally:
+            loop.close()
+
+    def test_gate_rollback_quarantines_and_forces_full_retrain(
+        self, registry, tmp_path
+    ):
+        loop = _Loop(
+            registry, tmp_path,
+            rollout_gates=_gates(canary_hold_s=100_000.0),
+        )
+        try:
+            loop.post(4)
+            status = loop.ctl.tick()
+            cand_id = status["candidate"]["instanceId"]
+            loop.drive(6)
+            loop.clock.advance(11.0)
+            loop.drive(1, start=50)
+            self_stage = loop.server.rollout.stage
+            assert self_stage == ROLLOUT_CANARY
+            # the candidate dies in canary; the error gate rolls back
+            with faults.inject(
+                faults.FaultSpec(site="serving.candidate", kind="refuse")
+            ):
+                loop.drive(100, start=100)
+            assert loop.server.rollout.stage == ROLLOUT_ROLLED_BACK
+            status = loop.ctl.tick()
+            assert cand_id in status["quarantined"]
+            assert status["state"] == "COOLDOWN"
+            assert status["lastCycle"]["outcome"] == "rolled_back"
+            # cooldown holds the loop even with a fresh delta
+            loop.post(5, start=100)
+            status = loop.ctl.tick()
+            assert status["cycles"] == 1
+            # ...and after the cooldown the next cycle is a FULL retrain
+            loop.clock.advance(61.0)
+            status = loop.ctl.tick()
+            assert status["cycles"] == 2
+            assert status["lastCycle"]["mode"] == FULL_RETRAIN
+            assert "forced" in status["lastCycle"]["reason"]
+        finally:
+            loop.close()
+
+    def test_offline_divergence_quarantines_before_submission(
+        self, registry, tmp_path
+    ):
+        loop = _Loop(registry, tmp_path, max_offline_divergence=0.5,
+                     min_score_samples=3)
+        try:
+            # feedback whose SERVED predictions look nothing like what the
+            # candidate will produce -> divergence ~1.0 over every replay
+            store = registry.get_events()
+            for k in range(6):
+                store.insert(
+                    Event(
+                        event="predict", entity_type="pio_pr",
+                        entity_id=f"pr{k}",
+                        properties=DataMap({
+                            "engineInstanceId": loop.baseline_id,
+                            "query": {"id": k},
+                            "prediction": {"totally": "different"},
+                            "variant": "baseline",
+                        }),
+                    ), 1,
+                )
+            loop.post(4)
+            status = loop.ctl.tick()
+            assert status["lastCycle"]["outcome"] == "offline_quarantined"
+            assert not loop.server.rollout.active
+            assert status["quarantined"]
+            score = status["lastCycle"]["offlineScore"]
+            assert score["samples"] == 6
+            assert score["meanDivergence"] > 0.5
+            # the rejected candidate's delta must NOT simply re-fold into
+            # a byte-identical candidate after the cooldown: the next
+            # cycle is a forced full retrain (quarantine livelock guard)
+            loop.clock.advance(61.0)
+            status = loop.ctl.tick()
+            assert status["cycles"] == 2
+            assert status["lastCycle"]["mode"] == FULL_RETRAIN
+            assert "forced" in status["lastCycle"]["reason"]
+        finally:
+            loop.close()
+
+    def test_pause_and_trigger(self, registry, tmp_path):
+        loop = _Loop(registry, tmp_path, min_events=1000)
+        try:
+            loop.ctl.pause()
+            loop.post(5)
+            status = loop.ctl.tick()
+            assert status["state"] == "PAUSED"
+            assert status["cycles"] == 0
+            loop.ctl.resume_watching()
+            status = loop.ctl.tick()
+            assert status["cycles"] == 0  # below min_events
+            loop.ctl.trigger()
+            status = loop.ctl.tick()
+            assert status["cycles"] == 1  # trigger overrides the threshold
+        finally:
+            loop.close()
+
+    def test_http_surface_and_status_embed(self, registry, tmp_path):
+        import requests
+
+        loop = _Loop(registry, tmp_path, min_events=1000)
+        try:
+            loop.server.start_background()
+            base = f"http://127.0.0.1:{loop.server.bound_port}"
+            r = requests.get(f"{base}/continuous.json", timeout=10)
+            assert r.status_code == 200
+            assert r.json()["enabled"] is True
+            assert r.json()["state"] == "WATCHING"
+            r = requests.post(f"{base}/continuous/pause", timeout=10)
+            assert r.json()["state"] == "PAUSED"
+            r = requests.post(
+                f"{base}/continuous/start", json={}, timeout=10
+            )
+            assert r.json()["state"] == "WATCHING"
+            r = requests.post(
+                f"{base}/continuous/trigger", json={"full": True}, timeout=10
+            )
+            assert r.status_code == 200
+            status = requests.get(f"{base}/status.json", timeout=10).json()
+            assert status["continuous"]["enabled"] is True
+        finally:
+            loop.ctl.stop()
+            loop.close()
+
+    def test_routes_409_without_controller(self, registry):
+        import requests
+
+        engine = make_engine()
+        run_train(engine, make_params(algo_ids=(11,)), registry,
+                  workflow_params=WorkflowParams(batch="plain"))
+        srv = QueryServer(
+            ServerConfig(ip="127.0.0.1", port=0, batching=False),
+            engine, registry, clock=FakeClock(),
+        )
+        try:
+            srv.start_background()
+            base = f"http://127.0.0.1:{srv.bound_port}"
+            r = requests.get(f"{base}/continuous.json", timeout=10)
+            assert r.json() == {"enabled": False}
+            r = requests.post(f"{base}/continuous/trigger", timeout=10)
+            assert r.status_code == 409
+        finally:
+            srv.server_close()
+
+    def test_cli_status_and_pause(self, registry, tmp_path, capsys):
+        from predictionio_tpu.tools.console import main as console_main
+
+        loop = _Loop(registry, tmp_path, min_events=1000)
+        try:
+            loop.server.start_background()
+            port = str(loop.server.bound_port)
+            assert console_main(
+                ["continuous", "status", "--ip", "127.0.0.1", "--port", port],
+                registry=registry,
+            ) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["state"] == "WATCHING"
+            assert console_main(
+                ["continuous", "pause", "--ip", "127.0.0.1", "--port", port],
+                registry=registry,
+            ) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["state"] == "PAUSED"
+        finally:
+            loop.close()
+
+    def test_feed_gap_forces_retrain_then_resyncs_at_live(
+        self, registry, tmp_path
+    ):
+        """A feed gap (here: the primary replaced — new generation) must
+        produce ONE covering full retrain whose LIVE resyncs the cursor
+        to the new feed's head — not an endless gap→retrain loop."""
+        loop = _Loop(registry, tmp_path)
+        try:
+            loop.post(2)  # below min_events: just moves the read position
+            loop.ctl.tick()
+            fresh_oplog = OpLog(str(tmp_path / "oplog2"))
+            loop.ctl.watcher._feed = LocalFeed(fresh_oplog)
+            status = loop.ctl.tick()  # FeedGap -> forced retrain cycle
+            assert status["lastCycle"]["mode"] == FULL_RETRAIN
+            assert status["candidate"]["resync"] is True
+            loop.promote_to_live()
+            status = loop.ctl.status()
+            assert status["lastCycle"]["outcome"] == "live"
+            # the cursor jumped to the NEW feed's identity/head...
+            assert loop.ctl.watcher.generation == fresh_oplog.generation
+            assert status["pendingEvents"] == 0
+            # ...and tailing works again: no gap, fresh events arrive
+            cf2 = Changefeed(
+                fresh_oplog, registry.get_events(),
+                registry.get_metadata(), registry.get_models(),
+            )
+            cf2.insert_event(_rate("u77", "i1", 5.0), 1)
+            status = loop.ctl.tick()
+            assert "feed gap" not in (status.get("lastError") or "")
+            assert status["pendingEvents"] == 1
+        finally:
+            loop.close()
+
+    def test_restart_mid_cycle_resumes_cursor_and_rollout(
+        self, registry, tmp_path
+    ):
+        """The restart acceptance proof: a controller killed with a
+        candidate mid-rollout comes back (a) monitoring the SAME rollout
+        (not submitting a second candidate), (b) with the durable cursor
+        still uncommitted (the delta replays into nothing — the candidate
+        already carries it), and the eventual LIVE commits exactly once."""
+        loop = _Loop(registry, tmp_path)
+        try:
+            loop.post(4)
+            status = loop.ctl.tick()
+            cand_id = status["candidate"]["instanceId"]
+            assert loop.server.rollout.stage == ROLLOUT_SHADOW
+            n_instances = len(
+                registry.get_metadata().engine_instance_get_all()
+            )
+        finally:
+            loop.close()
+        # --- restart: fresh server + controller over the same durable state
+        clock2 = FakeClock()
+        engine2 = make_engine()
+        srv2 = QueryServer(
+            ServerConfig(ip="127.0.0.1", port=0, batching=False),
+            engine2, registry, clock=clock2,
+        )
+        try:
+            # the rollout plane resumed the in-flight plan on its own
+            assert srv2.rollout.stage == ROLLOUT_SHADOW
+            assert srv2.rollout.plan.candidate_instance_id == cand_id
+            ctl2 = ContinuousController(
+                srv2,
+                ContinuousConfig(
+                    app_id=1, min_events=3, max_staleness_s=1e9,
+                    rollout_gates=_gates(),
+                    state_dir=str(tmp_path / "cstate"),
+                ),
+                feed=LocalFeed(loop.changefeed.oplog),
+                clock=clock2,
+            )
+            srv2.continuous = ctl2
+            status = ctl2.tick()
+            # resumed, not replayed: same candidate, no new training run
+            assert status["state"] == "MONITORING"
+            assert status["candidate"]["instanceId"] == cand_id
+            assert status["cursorSeq"] == 0
+            assert len(
+                registry.get_metadata().engine_instance_get_all()
+            ) == n_instances
+            # drive the resumed rollout to LIVE; the cursor commits now
+            for _round in range(6):
+                if not srv2.rollout.active:
+                    break
+                for k in range(8):
+                    _r, code = srv2.handle_query({"id": 1000 + k})
+                    assert code == 200
+                srv2.rollout.drain_shadow()
+                clock2.advance(11.0)
+                _r, code = srv2.handle_query({"id": 2000 + _round})
+                assert code == 200
+                srv2.rollout.drain_shadow()
+            status = ctl2.tick()
+            assert srv2.rollout.plan.stage == ROLLOUT_LIVE
+            assert srv2.deployment.instance.id == cand_id
+            assert status["cursorSeq"] == loop.changefeed.last_seq
+            assert status["lastCycle"]["outcome"] == "live"
+        finally:
+            srv2.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the ALS closed loop (events -> event server -> changefeed -> fold-in ->
+# shadow -> canary -> live), via the loadgen scenario
+# ---------------------------------------------------------------------------
+
+
+class TestClosedLoopE2E:
+    def test_feedback_stream_scenario_promotes_fold_in_candidate(
+        self, tmp_path
+    ):
+        from predictionio_tpu.tools.loadgen import run_feedback_stream
+
+        report = run_feedback_stream(base_dir=str(tmp_path))
+        assert report["ok"], report
+        assert report["clientFailures"] == 0
+        assert report["freshnessS"] is not None
+        assert report["lastCycle"]["mode"] == FOLD_IN
+        assert report["lastCycle"]["outcome"] == "live"
+        # the fold actually moved the model toward the fresh feedback
+        assert report["lastCycle"]["foldIn"]["newUsers"] > 0
+
+    def test_als_delta_fraction_escalates_to_full_retrain(
+        self, registry, tmp_path, monkeypatch
+    ):
+        """Acceptance: crossing a fold-in policy threshold triggers a
+        full retrain on the REAL ALS engine (not just decide_mode)."""
+        import predictionio_tpu.storage.registry as regmod
+
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithmParams, RecDataSourceParams, engine_factory,
+        )
+
+        monkeypatch.setattr(regmod, "_default_registry", registry)
+        store = registry.get_events()
+        store.init(1)
+        seed = [
+            _rate(f"u{u}", f"i{i}", 4.0)
+            for u in range(6) for i in range(4)
+        ]
+        store.write(seed, 1)
+        engine = engine_factory()
+        ep = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=1)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
+            ],
+        )
+        run_train(engine, ep, registry,
+                  workflow_params=WorkflowParams(batch="als-base"))
+        changefeed = Changefeed(
+            OpLog(str(tmp_path / "oplog")),
+            store, registry.get_metadata(), registry.get_models(),
+        )
+        clock = FakeClock()
+        srv = QueryServer(
+            ServerConfig(ip="127.0.0.1", port=0, batching=False),
+            engine, registry, clock=clock,
+        )
+        try:
+            ctl = ContinuousController(
+                srv,
+                ContinuousConfig(
+                    app_id=1, min_events=3, max_staleness_s=1e9,
+                    rollout_gates=_gates(),
+                    # a delta this large vs the 24-event corpus crosses
+                    # any honest fraction threshold
+                    policy=FoldInPolicy(max_delta_fraction=0.05),
+                    state_dir=str(tmp_path / "cstate"),
+                ),
+                feed=LocalFeed(changefeed.oplog),
+                clock=clock,
+            )
+            srv.continuous = ctl
+            for k in range(8):
+                changefeed.insert_event(_rate(f"nu{k}", f"i{k % 4}", 5.0), 1)
+            status = ctl.tick()
+            assert status["lastCycle"]["mode"] == FULL_RETRAIN
+            assert "delta fraction" in status["lastCycle"]["reason"]
+            assert status["state"] == "MONITORING"  # still auto-submitted
+        finally:
+            srv.server_close()
