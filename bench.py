@@ -42,16 +42,13 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
-#: v5e peak is 197 TFLOP/s bf16 (MXU); the solves here run f32, so treat
-#: ~half of that as the attainable ceiling for the MFU estimate.
-_V5E_PEAK_F32 = 98.5e12
-
 #: North-star wall-clock target (BASELINE.md): ML-20M rank-50 in < 60 s.
 _BASELINE_S = 60.0
 
-#: v5e HBM bandwidth (819 GB/s) for the bandwidth-utilization estimate —
-#: the gather-bound solve's honest efficiency number.
-_V5E_HBM_BPS = 819e9
+# The v5e reference peaks (98.5 TFLOP/s attainable f32, 819 GB/s HBM)
+# live in predictionio_tpu.obs.profile.DEVICE_PEAKS — one home shared
+# with `pio profile`'s roofline columns, so the two reports can never
+# disagree about the same run.
 
 #: Version of the synth_ml20m generation recipe — part of the cache key;
 #: bump on ANY change to the sampling/ground-truth/noise code.
@@ -215,9 +212,30 @@ def holdout_mask(nnz: int) -> np.ndarray:
     return np.random.default_rng(1).random(nnz) < 0.05
 
 
+def _append_ledger(record: dict) -> None:
+    """Durable perf-ledger append (``BENCH_LEDGER=path`` opts in —
+    docs/performance.md#perf-ledger). Strictly additive: stdout stays
+    the one-JSON-line contract, and a ledger failure never fails the
+    bench."""
+    path = os.environ.get("BENCH_LEDGER")
+    if not path:
+        return
+    try:
+        from predictionio_tpu.obs import perfledger
+
+        perfledger.append_record(
+            path,
+            perfledger.bench_to_record(record),
+        )
+    except Exception as exc:
+        print(f"bench: ledger append failed (ignored): {exc}",
+              file=sys.stderr)
+
+
 def run_bench(scale: float, iterations: int, fallback: str) -> int:
     import jax
 
+    from predictionio_tpu.obs.profile import default_telemetry
     from predictionio_tpu.ops.als import (
         ALSConfig,
         als_train,
@@ -225,6 +243,8 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         rmse,
         stage,
     )
+
+    jit_before = default_telemetry().snapshot()
 
     users, items, ratings, n_users, n_items = synth_ml20m(scale)
     nnz = len(ratings)
@@ -313,9 +333,12 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     # steady state: the first iteration absorbs the async staging transfer
     steady = iter_s[1:] if len(iter_s) > 1 else iter_s
     avg_iter = float(np.mean(steady)) if steady else 0.0
-    tflops_per_s = (flops / avg_iter / 1e12) if avg_iter else 0.0
-    mfu = (flops / avg_iter / _V5E_PEAK_F32) if avg_iter else 0.0
-    hbm_util = (hbm_bytes / avg_iter / _V5E_HBM_BPS) if avg_iter else 0.0
+    from predictionio_tpu.obs.profile import roofline
+
+    rf = roofline(flops, hbm_bytes, avg_iter)
+    tflops_per_s = rf["tflops_per_s"]
+    mfu = rf["mfu"]
+    hbm_util = rf["hbm_util"]
 
     record = {
         "metric": "ml20m_als_rank50_train_s",
@@ -339,6 +362,10 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "gather_dtype": gather_dtype,
         "sort_gather": sort_gather,
         "fused_gather": fused_gather,
+        # compile/retrace accounting for THIS process (warmup included):
+        # a bench round whose timed section quietly recompiled is not
+        # measuring steady state, and this field says so
+        "jit": default_telemetry().delta_since(jit_before),
     }
     if fallback:
         # A fallback run measures a shrunken workload on the wrong device:
@@ -354,6 +381,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
     if holdout > 0.62:
         record["vs_baseline"] = 0.0
         record["error"] = f"holdout RMSE {holdout:.4f} failed quality gate"
+        _append_ledger(record)
         print(json.dumps(record))
         return 1
     if (
@@ -380,6 +408,7 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:  # the headline metric must still report
             record["continuousFreshness"] = {"error": str(exc)}
+    _append_ledger(record)
     print(json.dumps(record))
     return 0
 
@@ -462,17 +491,15 @@ def main() -> int:
         traceback.print_exc(file=sys.stderr)
         if not fallback:
             return _fallback_to_cpu(scale)
-        print(
-            json.dumps(
-                {
-                    "metric": "ml20m_als_rank50_train_s",
-                    "value": -1.0,
-                    "unit": "s",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(exc).__name__}: {exc}",
-                }
-            )
-        )
+        failed = {
+            "metric": "ml20m_als_rank50_train_s",
+            "value": -1.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+        _append_ledger(failed)
+        print(json.dumps(failed))
         return 1
 
 

@@ -137,6 +137,15 @@ def solve_rows(
     return _cho_solve(a, b)
 
 
+# jit boundary telemetry (docs/observability.md#profiling): fold-in runs
+# inside the continuous controller's tick — a retrace storm here (e.g. a
+# pow2-padding regression in _row_systems) silently eats the freshness
+# budget; the counter makes it a /metrics fact instead
+from ..obs.profile import default_telemetry as _default_telemetry
+
+solve_rows = _default_telemetry().wrap("fold_in.solve_rows", solve_rows)
+
+
 @dataclasses.dataclass
 class FoldInStats:
     """What one fold did — the controller's policy/obs input."""

@@ -16,14 +16,23 @@ before it ships, like the Mosaic and robustness families:
   is how unbounded sets get in. Use a closed vocabulary (route
   templates, outcome kinds, dependency names) and put the variable part
   in a *span tag* (ring-buffered, not a time series) instead.
+- ``perf-unfenced-timing`` (ISSUE 8): ``time.monotonic()`` /
+  ``time.perf_counter()`` bracketing a call to a jitted function with
+  no ``block_until_ready`` (or another forcing call) before the stop
+  read. JAX dispatch is asynchronous — the stop fires when the call
+  *returned*, not when the device finished, so the "measurement" is the
+  dispatch overhead plus whatever the runtime happened to overlap. The
+  number then drives real decisions (BENCH records, lever A/Bs) while
+  measuring nothing. Where dispatch time IS the intended measurement,
+  suppress with a reason.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-from .engine import FileContext, Finding, Rule
+from .engine import FileContext, Finding, Rule, call_name, dotted_name
 
 #: metric-observation methods whose keyword arguments are label values
 _OBS_METHODS = frozenset({"inc", "dec", "set", "observe", "labels"})
@@ -122,4 +131,249 @@ class UnboundedLabel(Rule):
                             )
 
 
-RULES: List[Rule] = [UnboundedLabel()]
+# -- perf-unfenced-timing ---------------------------------------------------
+
+#: a timing-read call: time.monotonic() / time.perf_counter(), however
+#: the module was imported (``import time as _time`` is common here)
+_CLOCK_TAILS = ("monotonic", "perf_counter")
+
+#: calls that force device completion (or materialize to host) before
+#: the stop read — any of these between the last jitted call and the
+#: stop makes the measurement honest
+_FENCE_CALL_NAMES = frozenset(
+    {"block_until_ready", "device_get", "asarray", "item"}
+)
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    return dn in _CLOCK_TAILS or any(
+        dn.endswith("." + tail) for tail in _CLOCK_TAILS
+    )
+
+
+def _is_fence_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return name in _FENCE_CALL_NAMES
+
+
+def _walk_same_scope(root: ast.AST):
+    """``ast.walk`` that does not descend into nested function/class
+    scopes: a jitted call inside a nested ``def`` is not executed
+    between this scope's start and stop reads."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _jit_value_kind(node: ast.AST) -> Optional[str]:
+    """Classify an assignment RHS / decorator: "jit" for
+    ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)`` (optionally
+    immediately applied), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn in ("jax.jit", "jit"):
+        return "jit"
+    if dn in ("functools.partial", "partial") and any(
+        dotted_name(arg) in ("jax.jit", "jit") for arg in node.args
+    ):
+        return "jit"
+    # partial(jax.jit, ...)(body) / jax.jit(...)(body)-style application
+    if isinstance(node.func, ast.Call) and _jit_value_kind(node.func):
+        return "jit"
+    return None
+
+
+def _scope_assigns(scope: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """Single-Name-target assignments lexically in ``scope`` (nested
+    function/class bodies excluded — their locals are not this scope's)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in _walk_same_scope(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                out.append((target.id, node.value))
+    return out
+
+
+def _resolve_jitted(
+    assigns: List[Tuple[str, ast.AST]],
+    base: Set[str],
+    factories: Set[str],
+) -> Set[str]:
+    """Settle which names ``assigns`` leave bound to jitted callables,
+    starting from ``base`` (enclosing-scope jitted names). A non-jit
+    assignment SHADOWS: ``f = make_reader()`` in a function must erase a
+    module-level jitted ``f`` for that function's scope — timing the
+    local is honest host timing, not an unfenced dispatch."""
+    jitted = set(base)
+    # two passes settle alias-of-alias and factory-result chains without
+    # order sensitivity (module constants often precede their use)
+    for _ in range(2):
+        for name, value in assigns:
+            if _jit_value_kind(value):
+                jitted.add(name)
+            elif isinstance(value, ast.Name) and value.id in jitted:
+                jitted.add(name)
+            elif (
+                isinstance(value, ast.Call)
+                and dotted_name(value.func) in factories
+            ):
+                jitted.add(name)
+            else:
+                jitted.discard(name)
+    return jitted
+
+
+def _collect_module_jitted(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """Module-level jitted names + jit-returning factory names: direct
+    ``jax.jit`` results, decorated defs, results of factory functions
+    that ``return jax.jit(...)``, and one-hop aliases of any of those."""
+    jitted: Set[str] = set()
+    factories: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_jit_value_kind(dec) for dec in node.decorator_list):
+                jitted.add(node.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and _jit_value_kind(
+                    sub.value
+                ):
+                    factories.add(node.name)
+                    break
+    jitted = _resolve_jitted(_scope_assigns(tree), jitted, factories)
+    return jitted, factories
+
+
+def _is_jitted_call(node: ast.Call, jitted: Set[str]) -> bool:
+    dn = dotted_name(node.func)
+    if dn in jitted or call_name(node) in jitted:
+        return True
+    # calls routed through a wrapper (JitTelemetry.call(name, fn, ...))
+    # still dispatch the jitted positional argument
+    return any(
+        isinstance(arg, ast.Name) and arg.id in jitted
+        for arg in node.args
+    )
+
+
+class UnfencedTiming(Rule):
+    """A monotonic/perf_counter bracket around a jitted call with no
+    ``block_until_ready`` (or other forcing read) before the stop: jax
+    dispatch is async, so the clock measures dispatch, not the device —
+    the number is a lie that then drives perf decisions."""
+
+    id = "perf-unfenced-timing"
+    severity = "error"
+    short = (
+        "time.monotonic()/perf_counter() bracketing a jitted call with "
+        "no block_until_ready before the stop (async dispatch — the "
+        "measurement is a lie)"
+    )
+    motivation = (
+        "ISSUE 8: BENCH numbers and lever A/Bs are evidence; an "
+        "unfenced bracket around an async dispatch records dispatch "
+        "overhead as if it were device time. ops/als.py fences every "
+        "iteration timing (jax.block_until_ready) — new timing code "
+        "must too, or suppress with a reason where dispatch time is "
+        "the intended measurement."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        module_jitted, factories = _collect_module_jitted(ctx.tree)
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        for scope in scopes:
+            # per-scope name resolution: a function's own ``f = ...``
+            # binding wins over a module-level jitted ``f`` (no cross-
+            # scope pooling — an unrelated same-named host callable in
+            # another function must not trip the rule)
+            if scope is ctx.tree:
+                jitted = module_jitted
+            else:
+                base = set(module_jitted)
+                args = scope.args
+                for arg in (
+                    list(args.posonlyargs) + list(args.args)
+                    + list(args.kwonlyargs)
+                    + [a for a in (args.vararg, args.kwarg) if a]
+                ):
+                    base.discard(arg.arg)  # parameters shadow too
+                jitted = _resolve_jitted(
+                    _scope_assigns(scope), base, factories
+                )
+            if not jitted:
+                continue
+            yield from self._check_scope(ctx, scope, jitted)
+
+    def _check_scope(
+        self, ctx: FileContext, scope: ast.AST, jitted: Set[str]
+    ) -> Iterator[Finding]:
+        starts: Dict[str, List[int]] = {}
+        jit_lines: List[int] = []
+        fence_lines: List[int] = []
+        stops: List[Tuple[int, str, ast.AST]] = []
+        for node in _walk_same_scope(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_clock_call(node.value)
+            ):
+                starts.setdefault(node.targets[0].id, []).append(
+                    node.lineno
+                )
+            elif isinstance(node, ast.Call):
+                if _is_fence_call(node):
+                    fence_lines.append(node.lineno)
+                elif _is_jitted_call(node, jitted):
+                    jit_lines.append(node.lineno)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if _is_clock_call(node.left) and isinstance(
+                    node.right, ast.Name
+                ):
+                    stops.append((node.lineno, node.right.id, node))
+        for stop_line, var, stop_node in stops:
+            candidates = [
+                line for line in starts.get(var, ()) if line < stop_line
+            ]
+            if not candidates:
+                continue
+            start_line = max(candidates)
+            in_bracket = [
+                line
+                for line in jit_lines
+                if start_line < line <= stop_line
+            ]
+            if not in_bracket:
+                continue
+            last_jit = max(in_bracket)
+            if any(
+                last_jit <= line <= stop_line for line in fence_lines
+            ):
+                continue
+            yield self.finding(
+                ctx,
+                stop_node,
+                f"timing stop reads {var!r} after a jitted call with no "
+                "block_until_ready in between: async dispatch means this "
+                "measures dispatch, not device time — fence the result "
+                "(jax.block_until_ready / np.asarray) before the stop, "
+                "or suppress with a reason if dispatch time is the "
+                "point.",
+            )
+
+
+RULES: List[Rule] = [UnboundedLabel(), UnfencedTiming()]

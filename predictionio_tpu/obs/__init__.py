@@ -10,6 +10,11 @@ single request's spans across processes.
 
 Stdlib-only and device-free, like ``utils/resilience.py`` — importable
 from every server and client path.
+
+Performance observability (ISSUE 8) rides on top: ``obs/profile.py``
+(jit compile/retrace telemetry, :class:`PhaseProfiler` device-fenced
+phase timings with roofline estimates) and ``obs/perfledger.py`` (the
+durable perf ledger behind ``pio perf diff|trend``).
 """
 
 from .metrics import (
@@ -31,6 +36,14 @@ from .trace import (
 )
 from .expo import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from .expo import parse_text, render
+from .profile import (
+    JitTelemetry,
+    PhaseProfiler,
+    default_telemetry,
+    profiling_enabled,
+    render_profile_report,
+    roofline,
+)
 
 __all__ = [
     "Counter",
@@ -49,4 +62,10 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE",
     "render",
     "parse_text",
+    "JitTelemetry",
+    "PhaseProfiler",
+    "default_telemetry",
+    "profiling_enabled",
+    "render_profile_report",
+    "roofline",
 ]

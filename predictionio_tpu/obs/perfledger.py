@@ -1,0 +1,329 @@
+"""Durable performance ledger + regression gates (``pio perf``).
+
+BENCH went five rounds without moving and nothing noticed, because each
+round's number lived in its own ``BENCH_r0N.json`` and no tool ever put
+two of them side by side. The ledger is the fix (the TensorFlow/ads-
+infrastructure papers' "regression tracking is load-bearing
+infrastructure" discipline, PAPERS.md):
+
+- every ``bench.py`` run (``BENCH_LEDGER=path``) and training run
+  (``PIO_PERF_LEDGER=path``) appends ONE schema-versioned JSON line —
+  value, device, scale, lever flags, RMSE, phases — to an append-only
+  JSONL file;
+- ``pio perf diff`` loads the ledger plus the checked-in
+  ``BENCH_r0*.json`` history, groups records that are honestly
+  comparable (same metric, device class, scale and lever flags — a CPU
+  fallback number must never gate a TPU number), and flags any latest
+  value that is worse than the median of its predecessors beyond a
+  noise band; exit 1 is the CI regression signal;
+- ``pio perf trend`` renders the full trajectory so the kernel arc
+  (sort-gather, fused gather, bf16) has a history it is accountable to.
+
+Records are dicts, the file is line-delimited JSON, corrupt lines are
+skipped on load (an append torn by a crash must not eat the history),
+and appends fsync — the ledger is evidence, not a cache.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "append_record",
+    "bench_to_record",
+    "comparable_key",
+    "detect_regressions",
+    "load_bench_history",
+    "load_ledger",
+    "make_record",
+    "render_trend",
+]
+
+SCHEMA_VERSION = 1
+
+#: env naming the ledger file training runs append to (bench.py has its
+#: own ``BENCH_LEDGER`` knob so the revalidation queue opts in without
+#: touching the stdout contract)
+LEDGER_ENV = "PIO_PERF_LEDGER"
+
+#: Flag a latest value this much worse than the median of its
+#: predecessors. The checked-in CPU-fallback history wobbles ~10%
+#: run-to-run on a contended host (BENCH_r02–r05: 12.36–13.71 s), so
+#: the default band sits above that noise and below the 20% injected-
+#: regression bar the tier-1 self-test drives.
+DEFAULT_NOISE_BAND = 0.15
+
+#: comparisons need at least this many predecessor records — one prior
+#: point is an anecdote, not a baseline
+MIN_HISTORY = 2
+
+_BENCH_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def make_record(
+    source: str,
+    metric: str,
+    value: float,
+    unit: str = "s",
+    device: Optional[str] = None,
+    scale: Optional[float] = None,
+    levers: Optional[Dict[str, object]] = None,
+    rmse: Optional[float] = None,
+    vs_baseline: Optional[float] = None,
+    phases: Optional[Dict[str, float]] = None,
+    extra: Optional[dict] = None,
+    recorded_at: Optional[float] = None,
+) -> dict:
+    """One schema-versioned ledger record. ``unit == "s"`` means lower
+    is better (the only unit the regression gate compares)."""
+    record: dict = {
+        "schema": SCHEMA_VERSION,
+        "source": source,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+    }
+    if recorded_at is not None:
+        record["recorded_at_unix"] = float(recorded_at)
+    if device is not None:
+        record["device"] = device
+    if scale is not None:
+        record["scale"] = scale
+    if levers:
+        record["levers"] = dict(levers)
+    if rmse is not None:
+        record["rmse"] = rmse
+    if vs_baseline is not None:
+        record["vs_baseline"] = vs_baseline
+    if phases:
+        record["phases"] = dict(phases)
+    if extra:
+        record["extra"] = dict(extra)
+    return record
+
+
+def bench_to_record(bench: dict, source: str = "bench") -> dict:
+    """Normalize one ``bench.py`` stdout record into the ledger schema.
+    Lever flags travel under ``levers`` so :func:`comparable_key` has a
+    single place to read them from, old and new records alike."""
+    return make_record(
+        source=source,
+        metric=str(bench.get("metric", "unknown")),
+        value=float(bench.get("value", -1.0)),
+        unit=str(bench.get("unit", "s")),
+        device=bench.get("device"),
+        scale=bench.get("scale"),
+        levers={
+            "solve_mode": bench.get("solve_mode", "auto"),
+            "gather_dtype": bench.get("gather_dtype", "f32"),
+            "sort_gather": bool(bench.get("sort_gather", False)),
+            "fused_gather": bool(bench.get("fused_gather", False)),
+            "fallback": bench.get("fallback", ""),
+        },
+        rmse=bench.get("holdout_rmse"),
+        vs_baseline=bench.get("vs_baseline"),
+        phases=bench.get("bucketize_stage_phases_s"),
+        extra={
+            key: bench[key]
+            for key in ("iterations", "nnz", "error", "jit")
+            if key in bench
+        },
+    )
+
+
+def append_record(path: str, record: dict) -> None:
+    """Append one record as a JSON line, fsynced — the ledger is the
+    durable evidence trail, a torn tail must cost at most one line."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    line = json.dumps(record, sort_keys=True) + "\n"
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(line)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def load_ledger(path: str) -> List[dict]:
+    """Every parseable record in file order; unparseable lines (a torn
+    append, hand-editing damage) are skipped, never fatal."""
+    records: List[dict] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict) and "value" in parsed:
+                    records.append(parsed)
+    except OSError:
+        return []
+    return records
+
+
+def load_bench_history(history_dir: str) -> List[dict]:
+    """The checked-in ``BENCH_r0*.json`` driver records, normalized and
+    ordered by round. A round whose bench failed outright (``parsed``
+    null — the r01 bring-up failure) contributes nothing."""
+    records: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(history_dir, "BENCH_r*.json"))):
+        match = _BENCH_ROUND_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        records.append(
+            bench_to_record(parsed, source=f"bench_r{int(match.group(1)):02d}")
+        )
+    return records
+
+
+def _device_class(device: Optional[str]) -> str:
+    text = (device or "").lower()
+    if "tpu" in text:
+        return "tpu"
+    if "cpu" in text:
+        return "cpu"
+    if "gpu" in text or "cuda" in text:
+        return "gpu"
+    return text or "unknown"
+
+
+def comparable_key(record: dict) -> Tuple:
+    """Records sharing this key measure the same thing and may gate each
+    other: metric, device *class* (chip generations differ less than a
+    CPU fallback differs from any chip), scale, and every lever flag."""
+    levers = record.get("levers") or {}
+    return (
+        record.get("metric"),
+        _device_class(record.get("device")),
+        record.get("scale"),
+        levers.get("solve_mode", "auto"),
+        levers.get("gather_dtype", "f32"),
+        bool(levers.get("sort_gather", False)),
+        bool(levers.get("fused_gather", False)),
+    )
+
+
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    return (
+        ordered[mid]
+        if n % 2
+        else (ordered[mid - 1] + ordered[mid]) / 2.0
+    )
+
+
+def detect_regressions(
+    records: List[dict],
+    noise_band: float = DEFAULT_NOISE_BAND,
+    min_history: int = MIN_HISTORY,
+) -> List[dict]:
+    """Per comparable group (records in given = chronological order):
+    compare the latest value against the median of its predecessors.
+    Lower-is-better (``unit == "s"`` only; other units are trend-only).
+    Returns one verdict dict per flagged group — empty means clean."""
+    groups: Dict[Tuple, List[dict]] = {}
+    for record in records:
+        if record.get("unit", "s") != "s":
+            continue
+        value = record.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue  # failed runs (value -1) gate nothing
+        if record.get("error") or (record.get("extra") or {}).get("error"):
+            # a quality-gate failure carries a real (positive) wall time
+            # but measured an invalid run — it must neither be gated nor
+            # pollute the baseline median
+            continue
+        groups.setdefault(comparable_key(record), []).append(record)
+    flagged: List[dict] = []
+    for key, group in groups.items():
+        if len(group) < min_history + 1:
+            continue
+        latest = group[-1]
+        prior = [float(r["value"]) for r in group[:-1]]
+        baseline = _median(prior)
+        if baseline <= 0:
+            continue
+        ratio = float(latest["value"]) / baseline
+        if ratio > 1.0 + noise_band:
+            flagged.append(
+                {
+                    "key": {
+                        "metric": key[0],
+                        "device_class": key[1],
+                        "scale": key[2],
+                        "solve_mode": key[3],
+                        "gather_dtype": key[4],
+                        "sort_gather": key[5],
+                        "fused_gather": key[6],
+                    },
+                    "latest": float(latest["value"]),
+                    "latest_source": latest.get("source"),
+                    "baseline_median": round(baseline, 4),
+                    "ratio": round(ratio, 4),
+                    "noise_band": noise_band,
+                    "history": len(prior),
+                }
+            )
+    return flagged
+
+
+def render_trend(records: List[dict]) -> str:
+    """The full trajectory, grouped by comparable key, chronological
+    within each group — the ``pio perf trend`` table."""
+    if not records:
+        return "(no performance records)"
+    groups: Dict[Tuple, List[dict]] = {}
+    for record in records:
+        groups.setdefault(comparable_key(record), []).append(record)
+    lines: List[str] = []
+    for key in sorted(groups, key=str):
+        metric, device_class, scale = key[0], key[1], key[2]
+        levers = (
+            f"solve={key[3]} gather={key[4]}"
+            + (" sort" if key[5] else "")
+            + (" fused" if key[6] else "")
+        )
+        lines.append(
+            f"{metric} [{device_class} scale={scale} {levers}]"
+        )
+        for record in groups[key]:
+            # a foreign/hand-edited line may carry non-numeric fields;
+            # the trend must render around it, never traceback
+            value = record.get("value", 0.0)
+            if not isinstance(value, (int, float)):
+                continue
+            rmse = record.get("rmse")
+            vs = record.get("vs_baseline")
+            lines.append(
+                f"  {record.get('source', '?'):<14}"
+                f"{value:>10.3f} {record.get('unit', 's')}"
+                + (
+                    f"  vs_baseline={vs:g}"
+                    if isinstance(vs, (int, float))
+                    else ""
+                )
+                + (
+                    f"  rmse={rmse:g}"
+                    if isinstance(rmse, (int, float))
+                    else ""
+                )
+            )
+    return "\n".join(lines)

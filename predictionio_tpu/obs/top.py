@@ -149,6 +149,17 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
     row["lag"] = _series_sum(m, "pio_replication_lag_ops")
     row["seq"] = _series_sum(m, "pio_changefeed_seq")
     row["train_s"] = _series_sum(m, "pio_train_phase_seconds")
+    # continuous-learning freshness (docs/continuous.md): how far the
+    # model lags the feedback stream, fleet-wide at a glance
+    row["feed_lag"] = _series_sum(m, "pio_continuous_feed_lag_ops")
+    row["cand_age"] = _series_sum(
+        m, "pio_continuous_candidate_age_seconds"
+    )
+    # jit telemetry (docs/observability.md#profiling): compiles are
+    # expected at warmup; a non-zero RETRACE column on a steady-state
+    # server is the shape-bucketing regression alarm
+    row["jit_compiles"] = _series_sum(m, "pio_jit_compiles_total")
+    row["jit_retraces"] = _series_sum(m, "pio_jit_retraces_total")
     return row
 
 
@@ -164,22 +175,38 @@ _COLUMNS = (
     ("LAG", "lag", "{:.0f}"),
     ("SEQ", "seq", "{:.0f}"),
     ("TRAIN_S", "train_s", "{:.2f}"),
+    ("FEEDLAG", "feed_lag", "{:.0f}"),
+    ("CANDAGE", "cand_age", "{:.0f}"),
+    ("JITC", "jit_compiles", "{:.0f}"),
+    ("RETRACE", "jit_retraces", "{:.0f}"),
 )
+
+#: public alias for other fleet renderers (the dashboard's /fleet panel)
+FLEET_COLUMNS = _COLUMNS
+
+
+def format_cell(value: object, fmt: str) -> str:
+    """One fleet-table cell, shared by every renderer of
+    :data:`FLEET_COLUMNS` (``pio top`` and the dashboard's ``/fleet``
+    panel must show the same row the same way)."""
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "up" if value else "DOWN"
+    return fmt.format(value)
+
+
+def format_row(row: Dict[str, object]) -> List[str]:
+    """A scraped node row → one cell per :data:`FLEET_COLUMNS` entry."""
+    return [
+        format_cell(row.get(key), fmt) for _title, key, fmt in _COLUMNS
+    ]
 
 
 def render_table(rows: Sequence[Dict[str, object]]) -> str:
     table: List[List[str]] = [[title for title, _, _ in _COLUMNS]]
     for row in rows:
-        cells = []
-        for _title, key, fmt in _COLUMNS:
-            value = row.get(key)
-            if value is None:
-                cells.append("-")
-            elif isinstance(value, bool):
-                cells.append("up" if value else "DOWN")
-            else:
-                cells.append(fmt.format(value))
-        table.append(cells)
+        table.append(format_row(row))
     widths = [max(len(r[i]) for r in table) for i in range(len(_COLUMNS))]
     return "\n".join(
         "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()

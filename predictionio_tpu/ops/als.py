@@ -1127,6 +1127,13 @@ def als_train(
         mesh=mesh if solve_mode == "pallas" else None,
         fused_gather=cfg.fused_gather,
     )
+    # jit boundary telemetry (docs/observability.md#profiling): a solve
+    # call that compiles is counted (and, past the first, counted as a
+    # retrace) — the signal that distinguishes "the solver is slow" from
+    # "the solver keeps recompiling"
+    from ..obs.profile import default_telemetry
+
+    _telemetry = default_telemetry()
     for i in range(start, cfg.iterations):
         t_iter = _time.monotonic()
         if i == start:
@@ -1134,10 +1141,17 @@ def als_train(
             # solve needs only the user-side buckets, so it starts as
             # soon as they land while the item-side transfer is still in
             # flight (same math — the fused body is these two calls)
-            x = half(y, ub, lam, alpha, n_rows=by_user.n_rows, **common)
-            y = half(x, ib, lam, alpha, n_rows=by_item.n_rows, **common)
+            x = _telemetry.call(
+                "als_half", half, y, ub, lam, alpha,
+                n_rows=by_user.n_rows, **common,
+            )
+            y = _telemetry.call(
+                "als_half", half, x, ib, lam, alpha,
+                n_rows=by_item.n_rows, **common,
+            )
         else:
-            x, y = iteration(
+            x, y = _telemetry.call(
+                "als_iteration", iteration,
                 ub, ib, y, lam, alpha,
                 n_users=by_user.n_rows,
                 n_items=by_item.n_rows,

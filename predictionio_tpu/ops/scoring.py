@@ -130,3 +130,26 @@ def standardize(scores: jax.Array) -> jax.Array:
     mean = jnp.mean(scores)
     std = jnp.std(scores)
     return (scores - mean) / jnp.maximum(std, 1e-12)
+
+
+# -- jit boundary telemetry (docs/observability.md#profiling) ---------------
+#
+# The serving dispatch is where a retrace hurts most: an unexpected
+# shape reaching one of these kernels costs a fresh XLA compile inside a
+# live request's latency budget (pad_pow2 exists to prevent exactly
+# that). Routing every call through the process jit telemetry makes a
+# pad_pow2 regression visible as pio_jit_retraces_total{fn=...} on the
+# query server's /metrics instead of as an unexplained p99 cliff. The
+# wrappers forward attributes, so `.lower()`-style AOT use keeps working.
+from ..obs.profile import default_telemetry as _default_telemetry
+
+top_k_for_users = _default_telemetry().wrap(
+    "serving.topk_users", top_k_for_users
+)
+top_k_for_vectors = _default_telemetry().wrap(
+    "serving.topk_vectors", top_k_for_vectors
+)
+top_k_similar_items = _default_telemetry().wrap(
+    "serving.topk_similar", top_k_similar_items
+)
+standardize = _default_telemetry().wrap("serving.standardize", standardize)

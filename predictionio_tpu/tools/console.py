@@ -410,6 +410,10 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("dashboard", help="run the evaluation dashboard")
     db.add_argument("--ip", default="localhost")
     db.add_argument("--port", type=int, default=9000)
+    db.add_argument(
+        "--nodes", default="", metavar="HOST:PORT,...",
+        help="fleet nodes the /fleet panel scrapes",
+    )
 
     ss = sub.add_parser(
         "storageserver",
@@ -483,6 +487,24 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--json", action="store_true",
                      help="emit rows as JSON instead of the table")
     top.add_argument("--timeout", type=float, default=5.0)
+
+    pf = sub.add_parser(
+        "profile",
+        help="compile/retrace + phase/roofline report: smoke train, "
+        "live node, or completed instance "
+        "(docs/observability.md#profiling)",
+        # the profile CLI owns its option surface (tools/perf.py)
+        add_help=False,
+    )
+    pf.add_argument("profile_args", nargs=argparse.REMAINDER)
+
+    pp = sub.add_parser(
+        "perf",
+        help="durable perf ledger: `perf diff` regression gate, "
+        "`perf trend` trajectory (docs/performance.md#perf-ledger)",
+        add_help=False,
+    )
+    pp.add_argument("perf_args", nargs=argparse.REMAINDER)
 
     tr = sub.add_parser(
         "trace",
@@ -627,6 +649,23 @@ def main(
 
         tail = list(sys.argv[2:] if argv is None else argv[1:])
         return lint_mod.main(tail)
+    if head in (["profile"], ["perf"]):
+        # same REMAINDER limitation as lint: these CLIs own their whole
+        # option surface (tools/perf.py), so forward verbatim. `perf`
+        # needs neither storage nor jax; `profile --train-smoke` imports
+        # jax itself, after the platform env is applied below.
+        from . import perf as perf_mod
+
+        tail = list(sys.argv[2:] if argv is None else argv[1:])
+        if head == ["perf"]:
+            return perf_mod.run_perf(
+                perf_mod.build_perf_parser().parse_args(tail)
+            )
+        apply_env_platform()
+        return perf_mod.run_profile(
+            perf_mod.build_profile_parser().parse_args(tail),
+            registry=registry,
+        )
 
     apply_env_platform()
     args = build_parser().parse_args(argv)
@@ -826,7 +865,9 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         from .dashboard import DashboardConfig, create_dashboard
 
         create_dashboard(
-            DashboardConfig(ip=args.ip, port=args.port), registry, block=True
+            DashboardConfig(ip=args.ip, port=args.port, nodes=args.nodes),
+            registry,
+            block=True,
         )
         return EXIT_OK
 

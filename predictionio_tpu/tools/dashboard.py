@@ -25,6 +25,12 @@ DEFAULT_PORT = 9000  # Dashboard.scala default
 class DashboardConfig:
     ip: str = "localhost"
     port: int = DEFAULT_PORT
+    #: node list the /fleet panel scrapes (GET /metrics per node); the
+    #: quickstart topology by default — override with --nodes
+    nodes: str = ""
+    #: per-node scrape timeout for /fleet (the page must render even
+    #: with half the fleet down)
+    scrape_timeout_s: float = 2.0
 
 
 def _fmt_time(dt) -> str:
@@ -158,6 +164,34 @@ def train_runs_json(instances) -> list:
     ]
 
 
+def render_fleet(rows) -> str:
+    """``GET /fleet``: the ``pio top`` table as a dashboard panel —
+    per-node serving latency, shed/breaker state, replication lag,
+    continuous-learning freshness (FEEDLAG / CANDAGE, docs/continuous.md)
+    and jit compile/retrace counts (docs/observability.md#profiling)."""
+    from ..obs.top import FLEET_COLUMNS, format_row
+
+    header = "".join(
+        f"<th>{html.escape(title)}</th>" for title, _, _ in FLEET_COLUMNS
+    )
+    body = [
+        "<tr>"
+        + "".join(f"<td>{html.escape(c)}</td>" for c in format_row(row))
+        + "</tr>"
+        for row in rows
+    ]
+    return (
+        "<!DOCTYPE html><html><head><title>Fleet</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+        "<h1>Fleet</h1>"
+        f"<table><tr>{header}</tr>" + "".join(body) + "</table>"
+        "<p>FEEDLAG/CANDAGE: continuous-learning freshness; "
+        "JITC/RETRACE: jit compiles / new-signature retraces.</p>"
+        "</body></html>"
+    )
+
+
 class _DashboardHandler(JsonHTTPHandler):
     server: "DashboardServer"
 
@@ -201,6 +235,15 @@ class _DashboardHandler(JsonHTTPHandler):
         if path == "/rollouts.json":
             self.respond(200, rollouts_json(md.rollout_plan_get_all()))
             return
+        if path in ("/fleet", "/fleet.json"):
+            rows = self.server.fleet_rows()
+            if path == "/fleet.json":
+                self.respond(200, rows)
+            else:
+                self.respond(
+                    200, render_fleet(rows), content_type="text/html"
+                )
+            return
         parts = [p for p in path.split("/") if p]
         if len(parts) == 3 and parts[0] == "engine_instances":
             inst = md.evaluation_instance_get(parts[1])
@@ -228,6 +271,32 @@ class DashboardServer(BackgroundHTTPServer):
         self.registry = registry
         super().__init__((config.ip, config.port), _DashboardHandler)
 
+    def fleet_rows(self) -> list:
+        """Scrape the configured node list for the /fleet panel (a dead
+        node renders DOWN). Nodes are scraped concurrently, so the page
+        answers in ~one ``scrape_timeout_s`` even with the whole fleet
+        down — not nodes × timeout."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..obs.top import DEFAULT_NODES, node_row
+
+        nodes = [
+            node.strip()
+            for node in (self.config.nodes or DEFAULT_NODES).split(",")
+            if node.strip()
+        ]
+        if not nodes:
+            return []
+        with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as pool:
+            return list(
+                pool.map(
+                    lambda node: node_row(
+                        node, timeout=self.config.scrape_timeout_s
+                    ),
+                    nodes,
+                )
+            )
+
 
 def create_dashboard(
     config: DashboardConfig = DashboardConfig(),
@@ -253,8 +322,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="dashboard")
     p.add_argument("--ip", default="localhost")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument(
+        "--nodes", default="", metavar="HOST:PORT,...",
+        help="fleet nodes the /fleet panel scrapes "
+        "(default: the localhost quickstart topology)",
+    )
     args = p.parse_args(argv)
-    create_dashboard(DashboardConfig(ip=args.ip, port=args.port), block=True)
+    create_dashboard(
+        DashboardConfig(ip=args.ip, port=args.port, nodes=args.nodes),
+        block=True,
+    )
     return 0
 
 

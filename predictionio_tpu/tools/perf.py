@@ -1,0 +1,354 @@
+"""``pio profile`` / ``pio perf`` — the performance-observability CLIs.
+
+``pio profile`` renders the one-screen compile/phase/roofline report
+(``obs/profile.render_profile_report``) from one of three sources:
+
+- ``--train-smoke`` — run a tiny in-process ALS train (synthetic data,
+  CPU-friendly scale) with the :class:`~predictionio_tpu.obs.profile.
+  PhaseProfiler` and jit telemetry live, and report per-phase wall /
+  device time, compile and retrace counts, and roofline estimates.
+  The zero-hardware smoke proof that the whole profiling stack works;
+  also the quickest way to see what a code change did to compile
+  behavior.
+- ``--node HOST:PORT`` — scrape a live server's ``/metrics`` and report
+  its ``pio_jit_*`` families plus the deployed instance's persisted
+  train phases. Works against any server, query server first among
+  them.
+- ``--instance ID`` (default: the latest completed instance) — read the
+  ``PIO_TRAIN_PHASES`` / ``PIO_TRAIN_PROFILE`` env entries the training
+  workflow persisted into the engine-instance record.
+
+``pio perf diff`` / ``pio perf trend`` drive the durable perf ledger
+(``obs/perfledger.py``): ``diff`` exits 1 when the latest comparable
+record regressed beyond the noise band (the CI gate), ``trend`` renders
+the whole trajectory. Both read the checked-in ``BENCH_r0*.json``
+history plus an optional ledger file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from ..obs import perfledger
+from ..obs.profile import (
+    PhaseProfiler,
+    default_telemetry,
+    render_profile_report,
+)
+
+#: default location of the checked-in BENCH history and the repo ledger:
+#: the repository root (the parent of the installed package)
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_ERROR = 2
+
+
+# -- pio profile ------------------------------------------------------------
+
+
+def run_smoke_train(
+    iterations: int = 2,
+    rank: int = 8,
+    n_users: int = 384,
+    n_items: int = 128,
+    nnz: int = 4000,
+) -> dict:
+    """A tiny in-process ALS train with profiling on: returns the report
+    inputs (``phases``/``jit``/``cache``/``device``). Small enough for a
+    laptop CPU in seconds; the shapes still walk the real bucketize →
+    stage → solve path, so the compile counters count real programs."""
+    import numpy as np
+
+    from ..ops import als
+
+    telemetry = default_telemetry()
+    telemetry.attach_monitoring()
+    jit_before = telemetry.snapshot()
+    prof = PhaseProfiler(enabled=True)
+
+    rng = np.random.default_rng(7)
+    users = rng.integers(0, n_users, size=nnz).astype(np.int64)
+    items = rng.integers(0, n_items, size=nnz).astype(np.int64)
+    ratings = rng.normal(3.5, 1.0, size=nnz).astype(np.float32)
+
+    with prof.phase("bucketize"):
+        by_user = als.bucketize(
+            users, items, ratings, n_users, n_items, pad_to_blocks=True
+        )
+        by_item = als.bucketize(
+            items, users, ratings, n_items, n_users, pad_to_blocks=True
+        )
+    cfg = als.ALSConfig(
+        rank=rank, iterations=iterations, lambda_=0.05, seed=0,
+        solve_mode="chunked",
+    )
+    profile: dict = {}
+    with prof.phase("train") as ph:
+        factors = als.als_train(by_user, by_item, cfg, profile=profile)
+        ph.fence((factors.user_factors, factors.item_factors))
+    # adopt the fenced per-iteration timings als_train measured, with
+    # its FLOP/byte estimates, so the roofline columns carry real data
+    flops = profile.get("flops_per_iteration", 0.0)
+    hbm = profile.get("hbm_bytes_per_iteration", 0.0)
+    for seconds in profile.get("iteration_s", []):
+        prof.record(
+            "train.iteration", wall_s=seconds, flops=flops, hbm_bytes=hbm
+        )
+    if "stage_s" in profile:
+        prof.record("stage", wall_s=profile["stage_s"])
+
+    import jax
+
+    delta = telemetry.delta_since(jit_before)
+    return {
+        "phases": prof.summary(),
+        "jit": delta["fns"],
+        "cache": delta["cache"],
+        "device": str(jax.devices()[0]),
+    }
+
+
+def _report_from_metrics(parsed: dict) -> dict:
+    """Scraped ``/metrics`` samples → report inputs. Tolerant of absent
+    families (a node that never compiled simply has no jit section)."""
+    jit: dict = {}
+    for labels, value in parsed.get("pio_jit_compiles_total", []):
+        fn = labels.get("fn")
+        if fn:
+            jit.setdefault(fn, {})["compiles"] = value
+    for labels, value in parsed.get("pio_jit_retraces_total", []):
+        fn = labels.get("fn")
+        if fn:
+            jit.setdefault(fn, {})["retraces"] = value
+    for labels, value in parsed.get("pio_jit_compile_seconds_sum", []):
+        fn = labels.get("fn")
+        if fn:
+            jit.setdefault(fn, {})["compile_s"] = value
+
+    def _scalar(name: str) -> float:
+        samples = parsed.get(name)
+        return samples[0][1] if samples else 0.0
+
+    cache = {
+        "hits": _scalar("pio_jit_cache_hits"),
+        "misses": _scalar("pio_jit_cache_misses"),
+        "backend_compiles": _scalar(
+            "pio_jit_backend_compile_seconds_count"
+        ),
+        "backend_compile_s": _scalar("pio_jit_backend_compile_seconds_sum"),
+    }
+    phases = {}
+    for labels, value in parsed.get("pio_train_phase_seconds", []):
+        phase = labels.get("phase")
+        if phase:
+            phases[phase] = {"count": 1, "wall_s": value, "device_s": value}
+    return {"phases": phases, "jit": jit, "cache": cache}
+
+
+def _report_from_instance(instance) -> dict:
+    from ..utils.profiling import phases_from_env, profile_from_env
+
+    phases = {
+        name: {"count": 1, "wall_s": seconds, "device_s": seconds}
+        for name, seconds in phases_from_env(instance.env).items()
+    }
+    profile = profile_from_env(instance.env)
+    return {
+        "phases": phases,
+        "jit": profile.get("fns", {}),
+        "cache": profile.get("cache") or None,
+        "train_wall_s": profile.get("train_wall_s"),
+    }
+
+
+def run_profile(args: argparse.Namespace, registry=None) -> int:
+    if args.train_smoke:
+        data = run_smoke_train(
+            iterations=args.iterations, rank=args.rank
+        )
+        title = "smoke train"
+    elif args.node:
+        from ..obs.top import fetch_metrics
+
+        parsed = fetch_metrics(args.node, timeout=args.timeout)
+        if parsed is None:
+            print(f"error: no /metrics at {args.node}", file=sys.stderr)
+            return EXIT_ERROR
+        data = _report_from_metrics(parsed)
+        title = f"node {args.node}"
+    else:
+        if registry is None:
+            from ..storage import get_registry
+
+            registry = get_registry()
+        md = registry.get_metadata()
+        from ..storage import STATUS_COMPLETED
+
+        if args.instance:
+            instance = md.engine_instance_get(args.instance)
+        else:
+            instances = [
+                inst
+                for inst in md.engine_instance_get_all()
+                if inst.status == STATUS_COMPLETED
+            ]
+            instances.sort(key=lambda inst: inst.start_time)
+            instance = instances[-1] if instances else None
+        if instance is None:
+            print(
+                "error: no completed engine instance to profile "
+                "(train first, or use --train-smoke / --node)",
+                file=sys.stderr,
+            )
+            return EXIT_ERROR
+        data = _report_from_instance(instance)
+        title = f"engine instance {instance.id}"
+        wall = data.get("train_wall_s")
+        if isinstance(wall, (int, float)):
+            title += f" (train wall {wall:.3f}s)"
+    if args.json:
+        print(json.dumps(data, sort_keys=True))
+        return EXIT_OK
+    print(
+        render_profile_report(
+            title,
+            phases=data.get("phases"),
+            jit=data.get("jit"),
+            cache=data.get("cache"),
+            device=data.get("device"),
+        )
+    )
+    return EXIT_OK
+
+
+# -- pio perf ---------------------------------------------------------------
+
+
+def _load_records(args: argparse.Namespace) -> list:
+    """History + ledger, chronological: the checked-in BENCH rounds are
+    the oldest evidence, ledger appends follow in file order."""
+    records = perfledger.load_bench_history(args.history_dir)
+    ledger_path = args.ledger
+    if ledger_path is None:
+        default = os.path.join(args.history_dir, "PERF_LEDGER.jsonl")
+        ledger_path = default if os.path.exists(default) else None
+    if ledger_path:
+        records.extend(perfledger.load_ledger(ledger_path))
+    return records
+
+
+def run_perf(args: argparse.Namespace) -> int:
+    records = _load_records(args)
+    if args.perf_command == "trend":
+        if args.json:
+            print(json.dumps(records))
+        else:
+            print(perfledger.render_trend(records))
+        return EXIT_OK
+    # diff: the regression gate
+    if not records:
+        print(
+            "error: no performance records found (no BENCH_r*.json under "
+            f"{args.history_dir} and no ledger)",
+            file=sys.stderr,
+        )
+        return EXIT_ERROR
+    flagged = perfledger.detect_regressions(
+        records, noise_band=args.noise_band
+    )
+    if args.json:
+        print(json.dumps({"regressions": flagged, "records": len(records)}))
+    elif flagged:
+        for item in flagged:
+            key = item["key"]
+            print(
+                f"REGRESSION {key['metric']} [{key['device_class']} "
+                f"scale={key['scale']}]: latest {item['latest']:.3f}s "
+                f"({item['latest_source']}) vs median "
+                f"{item['baseline_median']:.3f}s over {item['history']} "
+                f"runs — {item['ratio']:.2f}x, band "
+                f"{1.0 + item['noise_band']:.2f}x"
+            )
+    else:
+        print(
+            f"no regressions across {len(records)} records "
+            f"(noise band {args.noise_band:.0%})"
+        )
+    return EXIT_REGRESSION if flagged else EXIT_OK
+
+
+# -- CLI glue ---------------------------------------------------------------
+
+
+def build_profile_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio profile",
+        description="compile/retrace + phase/roofline report "
+        "(docs/observability.md#profiling)",
+    )
+    p.add_argument(
+        "--train-smoke", action="store_true",
+        help="run a tiny in-process ALS train with profiling on",
+    )
+    p.add_argument(
+        "--node", default=None, metavar="HOST:PORT",
+        help="scrape a live server's /metrics instead",
+    )
+    p.add_argument(
+        "--instance", default=None,
+        help="report a completed engine instance (default: latest)",
+    )
+    p.add_argument("--iterations", type=int, default=2,
+                   help="smoke-train iterations")
+    p.add_argument("--rank", type=int, default=8, help="smoke-train rank")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--json", action="store_true")
+    return p
+
+
+def build_perf_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio perf",
+        description="durable perf ledger: regression gate + trajectory "
+        "(docs/performance.md#perf-ledger)",
+    )
+    sub = p.add_subparsers(dest="perf_command", required=True)
+    for name in ("diff", "trend"):
+        sp = sub.add_parser(name)
+        sp.add_argument(
+            "--ledger", default=None, metavar="FILE",
+            help="perf ledger JSONL (default: PERF_LEDGER.jsonl next to "
+            "the BENCH history, if present)",
+        )
+        sp.add_argument(
+            "--history-dir", default=REPO_ROOT, metavar="DIR",
+            help="directory holding the checked-in BENCH_r0*.json rounds",
+        )
+        sp.add_argument("--json", action="store_true")
+        if name == "diff":
+            sp.add_argument(
+                "--noise-band", type=float,
+                default=perfledger.DEFAULT_NOISE_BAND,
+                help="flag only regressions beyond this fraction "
+                "(default %(default)s)",
+            )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("diff", "trend"):
+        return run_perf(build_perf_parser().parse_args(argv))
+    return run_profile(build_profile_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

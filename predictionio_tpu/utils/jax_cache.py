@@ -97,4 +97,15 @@ def enable_compilation_cache(
     # exported only after the in-process config succeeded, so children
     # (deploys, fallback re-execs, queue steps) inherit a working setup
     os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    # Cache observability (docs/observability.md#profiling): every
+    # process that enables the cache also starts counting its hits and
+    # misses (jax.monitoring events) into the process jit telemetry, so
+    # /metrics and `pio profile` can answer "did the cache actually save
+    # the window?" with numbers instead of vibes.
+    try:
+        from ..obs.profile import default_telemetry
+
+        default_telemetry().attach_monitoring()
+    except Exception:
+        pass  # telemetry is an observer; it must never fail cache setup
     return cache_dir

@@ -97,6 +97,39 @@ def phases_from_env(env: Optional[Dict[str, str]]) -> Dict[str, float]:
         return {}
 
 
+# -- persisted compile/retrace profile (docs/observability.md#profiling) ----
+#
+# Same mechanism as PIO_TRAIN_PHASES, richer payload: run_train persists
+# the jit-telemetry delta of the run (per-fn compiles/retraces/compile
+# seconds + compilation-cache hits/misses) so `pio profile` can report a
+# COMPLETED instance's compile behavior long after the process died.
+
+TRAIN_PROFILE_ENV_KEY = "PIO_TRAIN_PROFILE"
+
+
+def profile_to_env(snapshot: Dict) -> str:
+    """JSON-safe profile snapshot (``JitTelemetry.delta_since`` shape,
+    optionally with a ``phases`` key) → the instance-env string."""
+    import json
+
+    return json.dumps(snapshot, sort_keys=True)
+
+
+def profile_from_env(env: Optional[Dict[str, str]]) -> Dict:
+    """Inverse of :func:`profile_to_env`; {} on absence or garbage (an
+    old instance record must not break `pio profile`)."""
+    import json
+
+    raw = (env or {}).get(TRAIN_PROFILE_ENV_KEY)
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+        return parsed if isinstance(parsed, dict) else {}
+    except ValueError:
+        return {}
+
+
 @contextlib.contextmanager
 def device_trace(logdir: Optional[str]) -> Iterator[None]:
     """``jax.profiler.trace`` wrapper: no-op when ``logdir`` is falsy or the

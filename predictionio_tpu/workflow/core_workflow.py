@@ -82,10 +82,17 @@ def run_train(
         )
         derived_checkpoint_dir = True
     try:
+        from ..obs.profile import default_telemetry
         from ..utils.profiling import device_trace
 
+        telemetry = default_telemetry()
+        jit_before = telemetry.snapshot()
+        import time as _time
+
+        train_t0 = _time.monotonic()
         with device_trace(os.environ.get("PIO_PROFILE_DIR")):
             models = engine.train(ctx, engine_params, workflow_params)
+        train_wall_s = _time.monotonic() - train_t0
         logger.info("train phases: %s", ctx.timer.format_summary())
         persisted = engine.make_serializable_models(
             ctx, engine_params, instance_id, models
@@ -100,14 +107,29 @@ def run_train(
         # belong to the instance — the query server re-exports them as
         # pio_train_phase_seconds gauges and the dashboard lists them
         # (docs/observability.md).
-        from ..utils.profiling import TRAIN_PHASES_ENV_KEY, phases_to_env
+        from ..utils.profiling import (
+            TRAIN_PHASES_ENV_KEY,
+            TRAIN_PROFILE_ENV_KEY,
+            phases_to_env,
+            profile_to_env,
+        )
 
         env = dict(stored.env)
         env[TRAIN_PHASES_ENV_KEY] = phases_to_env(ctx.timer.summary())
+        # Compile/retrace profile of THIS run (delta, not process totals:
+        # a long-lived embedding process may train many instances), so
+        # `pio profile` can report a completed instance's compile
+        # behavior after the training process is gone.
+        jit_delta = telemetry.delta_since(jit_before)
+        jit_delta["train_wall_s"] = round(train_wall_s, 3)
+        env[TRAIN_PROFILE_ENV_KEY] = profile_to_env(jit_delta)
         md.engine_instance_update(
             dataclasses.replace(
                 stored, status=STATUS_COMPLETED, end_time=utcnow(), env=env
             )
+        )
+        _append_perf_ledger(
+            instance_id, train_wall_s, ctx.timer.summary(), jit_delta
         )
         logger.info("Training completed; engine instance %s", instance_id)
         if derived_checkpoint_dir:
@@ -122,6 +144,46 @@ def run_train(
         raise
     finally:
         ctx.stop()
+
+
+def _append_perf_ledger(
+    instance_id: str,
+    train_wall_s: float,
+    phase_summary: dict,
+    jit_delta: dict,
+) -> None:
+    """Opt-in durable perf record for this training run
+    (``PIO_PERF_LEDGER=path``, docs/performance.md#perf-ledger).
+    Best-effort: ledger trouble must never fail a finished train."""
+    path = os.environ.get("PIO_PERF_LEDGER")
+    if not path:
+        return
+    try:
+        from ..obs import perfledger
+
+        device = None
+        try:
+            import jax
+
+            device = str(jax.devices()[0])
+        except Exception:
+            pass
+        perfledger.append_record(
+            path,
+            perfledger.make_record(
+                source="train",
+                metric="train_wall_s",
+                value=train_wall_s,
+                device=device,
+                phases={
+                    name: round(s["total_s"], 4)
+                    for name, s in phase_summary.items()
+                },
+                extra={"instanceId": instance_id, "jit": jit_delta},
+            ),
+        )
+    except Exception:
+        logger.exception("perf-ledger append failed (ignored)")
 
 
 def load_models(registry: StorageRegistry, instance_id: str) -> List[Any]:

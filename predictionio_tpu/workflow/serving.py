@@ -629,6 +629,15 @@ class QueryServer(BackgroundHTTPServer):
         # tracer per server process, exposed on /metrics + /traces.json.
         metrics = MetricsRegistry(clock=clock)
         self.stats = ServingStats(metrics)
+        # Jit boundary telemetry (docs/observability.md#profiling): the
+        # process telemetry mirrors onto this registry so /metrics shows
+        # pio_jit_compiles_total / pio_jit_retraces_total — bind() replays
+        # totals, so the deploy-time serving compiles that happened
+        # before this registry existed are not lost.
+        from ..obs.profile import default_telemetry
+
+        default_telemetry().bind(metrics)
+        default_telemetry().attach_monitoring()
         self._retry = retry_policy or RetryPolicy(
             attempts=3,
             base_delay_s=0.05,

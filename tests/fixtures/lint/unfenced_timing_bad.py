@@ -1,0 +1,22 @@
+"""Perf bug class: timing a jitted call without fencing the result.
+
+JAX dispatch is asynchronous — ``solve(x)`` returns the moment the work
+is *enqueued*, so the stop read below measures dispatch overhead, not
+device time, and the resulting "measurement" feeds perf decisions while
+measuring nothing. ``perf-unfenced-timing`` must flag the stop read
+below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import time
+
+import jax
+
+solve = jax.jit(lambda x: x * 2.0)
+
+
+def measure(x):
+    t0 = time.monotonic()
+    y = solve(x)
+    return y, time.monotonic() - t0  # unfenced stop: BAD

@@ -1,0 +1,34 @@
+"""Clean twin of ``unfenced_timing_bad.py``: every timing bracket around
+a jitted call fences with ``block_until_ready`` (or materializes via
+``np.asarray``) before the stop read, and timing a plain host function
+needs no fence at all. The linter must report NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+solve = jax.jit(lambda x: x * 2.0)
+
+
+def measure_fenced(x):
+    t0 = time.monotonic()
+    y = solve(x)
+    jax.block_until_ready(y)
+    return y, time.monotonic() - t0
+
+
+def measure_materialized(x):
+    t0 = time.perf_counter()
+    y = np.asarray(solve(x))
+    return y, time.perf_counter() - t0
+
+
+def measure_host_work(records):
+    # no jitted call in the bracket: plain host timing is fine unfenced
+    t0 = time.monotonic()
+    total = sum(len(r) for r in records)
+    return total, time.monotonic() - t0

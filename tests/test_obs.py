@@ -573,6 +573,42 @@ class TestTraceEndToEnd:
                 "storage-replica",
             }
             assert tid2 in render_trace(tid2, stitched)
+
+            # -- jit telemetry rides the same exposition (ISSUE 8): the
+            # process telemetry is bound to this server's registry, so a
+            # compile observed anywhere in-process surfaces as series on
+            # the query server's /metrics. Driven with a fake jitted fn
+            # so the assertion is deterministic under any cache warmth.
+            from predictionio_tpu.obs.profile import default_telemetry
+
+            class _FakeJit:
+                def __init__(self):
+                    self._sigs = set()
+
+                def _cache_size(self):
+                    return len(self._sigs)
+
+                def __call__(self, sig):
+                    self._sigs.add(sig)
+                    return sig
+
+            fake = _FakeJit()
+            default_telemetry().call("obs_e2e.fn", fake, "a")
+            default_telemetry().call("obs_e2e.fn", fake, "b")
+            text = requests.get(f"{base}/metrics").text
+            parsed = _assert_valid_exposition(text)
+            compiles = {
+                labels.get("fn"): value
+                for labels, value in parsed["pio_jit_compiles_total"]
+            }
+            assert compiles["obs_e2e.fn"] == 2.0
+            retraces = {
+                labels.get("fn"): value
+                for labels, value in parsed["pio_jit_retraces_total"]
+            }
+            assert retraces["obs_e2e.fn"] == 1.0
+            assert "pio_jit_compile_seconds_bucket" in parsed
+            assert "pio_jit_cache_hits" in parsed
         finally:
             if server is not None:
                 server.shutdown()
