@@ -227,6 +227,11 @@ def _append_ledger(record: dict) -> None:
             path,
             perfledger.bench_to_record(record),
         )
+        # serving-fleet numbers (loadgen --replicas) gate alongside the
+        # train time: p99 as a lower-is-better "s" record, QPS as a
+        # trend-only record (docs/fleet.md, docs/performance.md)
+        for fleet_record in perfledger.fleet_records(record):
+            perfledger.append_record(path, fleet_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -408,6 +413,28 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:  # the headline metric must still report
             record["continuousFreshness"] = {"error": str(exc)}
+    # Serving-fleet trajectory (docs/fleet.md): a small in-process
+    # router + replicas drive gives every BENCH round a servedQPS /
+    # servedP99Ms number next to train time — the serving-scale metric
+    # the ROADMAP asked for. Opt out with BENCH_FLEET=0; a failure here
+    # never fails the bench.
+    if os.environ.get("BENCH_FLEET") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_fleet_chaos
+
+            fleet = run_fleet_chaos(
+                replicas=2, kill_backend_at=None, queries=96
+            )
+            record["servingFleet"] = {
+                "replicas": fleet.get("replicas"),
+                "sharded": fleet.get("sharded"),
+                "servedQPS": fleet.get("servedQPS"),
+                "servedP50Ms": fleet.get("servedP50Ms"),
+                "servedP99Ms": fleet.get("servedP99Ms"),
+                "ok": fleet.get("ok"),
+            }
+        except Exception as exc:  # the headline metric must still report
+            record["servingFleet"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

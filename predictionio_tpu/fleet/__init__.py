@@ -1,0 +1,30 @@
+"""Serving fleet: the horizontal story for the query tier.
+
+One query server process was the ceiling through PR 8; this package is
+the router tier that fronts N of them (``docs/fleet.md``):
+
+- :mod:`~predictionio_tpu.fleet.router` — ``pio router``: consistent
+  replica affinity and fleet-wide canary stickiness (both riding the
+  pure ``rollout/plan.py`` SHA-256 bucket split), per-app admission
+  quotas, breaker-guarded backend health with retry-on-another-replica,
+  and the sharded-model scatter/gather serving mode.
+- :mod:`~predictionio_tpu.fleet.merge` — exact global top-k from
+  per-shard top-k candidates (k-way merge on score, ties broken by item
+  id for determinism).
+
+Like the rollout plane's :mod:`~predictionio_tpu.rollout.plan`, the
+routing arithmetic is pure; the router server itself is stdlib + the
+shared resilience/obs planes — no jax import anywhere in the package,
+so a router node needs no accelerator runtime.
+"""
+
+from .merge import merge_item_scores, merge_predictions
+from .router import RouterConfig, RouterServer, create_router
+
+__all__ = [
+    "RouterConfig",
+    "RouterServer",
+    "create_router",
+    "merge_item_scores",
+    "merge_predictions",
+]

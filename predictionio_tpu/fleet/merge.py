@@ -1,0 +1,84 @@
+"""Exact top-k merge over per-shard candidate lists.
+
+The sharded serving mode partitions the item-factor matrix across query
+servers (``docs/fleet.md``); each shard answers a query with its *local*
+top-k. Because every item lives on exactly one shard and scores are
+computed against the full user factors, the global top-k is a subset of
+the union of local top-ks — so merging the per-shard lists reproduces
+the unsharded answer *exactly*, not approximately (the serving-side
+analogue of the sharded-embedding gather in Tensor Casting / the
+sharded-factor layout in ALX, PAPERS.md).
+
+Determinism contract: merge order is ``(-score, item_id)`` — score
+descending, ties broken by item id ascending — so any router replica
+merging the same shard answers produces byte-identical output. Pure,
+stdlib-only module (the ``rollout/plan.py`` discipline): testable in
+isolation, provably stable across restarts.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["merge_item_scores", "merge_predictions"]
+
+
+def _sort_key(entry: Dict[str, Any]):
+    # score descending, then item id ascending: a total order, so equal
+    # scores cannot flap between merges or router replicas
+    return (-float(entry.get("score", 0.0)), str(entry.get("item", "")))
+
+
+def merge_item_scores(
+    shard_lists: Sequence[Sequence[Dict[str, Any]]],
+    k: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """K-way merge of per-shard ``[{"item", "score"}, ...]`` lists into
+    the exact global top-``k`` (all entries when ``k`` is None).
+
+    Each shard list is first ordered by the merge key (shards already
+    return descending scores, but the merge must not *depend* on it —
+    a misbehaving shard degrades to a sort, never to a wrong answer),
+    then consumed through a heap so the common case is O(total · log S).
+    """
+    runs = [sorted(entries, key=_sort_key) for entries in shard_lists if entries]
+    merged = heapq.merge(*runs, key=_sort_key)
+    if k is None:
+        return list(merged)
+    out: List[Dict[str, Any]] = []
+    for entry in merged:
+        out.append(entry)
+        if len(out) >= k:
+            break
+    return out
+
+
+def merge_predictions(
+    shard_results: Sequence[Any], k: Optional[int] = None
+) -> Any:
+    """Merge per-shard *encoded* prediction bodies (the ``/queries.json``
+    response JSON) into one.
+
+    Recognizes the templates' shared ``{"itemScores": [...]}`` wire
+    shape (``models/wire.py``) and merges those lists exactly; any other
+    shape cannot be sharded meaningfully, so the first shard's answer
+    passes through unchanged — with a loud ``ValueError`` when shards
+    *disagree* on non-mergeable bodies (silently picking one would turn
+    a misconfigured fleet into quietly wrong answers)."""
+    results = [r for r in shard_results if r is not None]
+    if not results:
+        return None
+    if all(isinstance(r, dict) and "itemScores" in r for r in results):
+        merged = dict(results[0])
+        merged["itemScores"] = merge_item_scores(
+            [r["itemScores"] for r in results], k
+        )
+        return merged
+    first = results[0]
+    if any(r != first for r in results[1:]):
+        raise ValueError(
+            "shard responses disagree and carry no itemScores list to "
+            "merge; this engine's result shape cannot be served sharded"
+        )
+    return first

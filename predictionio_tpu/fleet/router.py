@@ -1,0 +1,778 @@
+"""``pio router``: the L7 tier that fronts N query servers.
+
+One query server tops out at one process; the ROADMAP north star is
+heavy traffic from millions of users. The router is the horizontal
+story (``docs/fleet.md``):
+
+- **Consistent routing, zero coordination.** Replica affinity rides the
+  same pure SHA-256 ``salt|key → bucket`` split the canary plane uses
+  (:func:`~predictionio_tpu.rollout.plan.bucket_for_key`): the same
+  entity key lands on the same backend from *any* router replica, and
+  canary variant assignment needs no router participation at all — each
+  query server computes it from the replicated ``RolloutPlan`` with the
+  same pure function, so a request retried on another replica gets the
+  byte-identical variant. The router *verifies* that invariant per
+  request (``pio_router_variant_mismatch_total`` — it reads the active
+  plan through the replicated ``rollout_plan_get_active`` and compares
+  its own assignment against the backend's ``X-PIO-Variant`` echo).
+- **Per-app admission quotas.** The PR-2 bounded-admission discipline,
+  one level up: each app (the ``X-PIO-App`` header) gets an in-flight
+  cap at the router, so one tenant's surge sheds with 503 + Retry-After
+  instead of starving the fleet.
+- **Breaker-guarded health + retry-on-another-replica.** One
+  :class:`~predictionio_tpu.utils.resilience.CircuitBreaker` per
+  backend; a dead or shedding backend fails the read over to the next
+  replica *inside the same request* (no backoff sleeps — the retry
+  target is a different process), with the deadline budget split across
+  the remaining attempts so the schedule always fits the client's
+  budget.
+- **Sharded-model scatter/gather.** With ``sharded=True`` each backend
+  holds one partition of the item factors (``ServerConfig.shard_index``
+  / ``shard_count``); the router fans a query out to every shard
+  concurrently and k-way-merges the local top-ks into the exact global
+  top-k (:mod:`~predictionio_tpu.fleet.merge`).
+
+No jax anywhere: a router node is pure stdlib + the shared resilience
+and obs planes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..obs.trace import TRACE_HEADER, Tracer
+from ..rollout.plan import (
+    BASELINE,
+    VARIANT_HEADER,
+    bucket_for_key,
+    sticky_key,
+    variant_for_key,
+)
+from ..utils.resilience import (
+    DEADLINE_HEADER,
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+)
+from .merge import merge_predictions
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "APP_HEADER",
+    "VARIANT_HEADER",
+    "RouterBadRequest",
+    "RouterConfig",
+    "RouterServer",
+    "create_router",
+]
+
+
+class RouterBadRequest(ValueError):
+    """The client's request body is malformed → 400 (never retried)."""
+
+
+class FleetOverloaded(RuntimeError):
+    """Every replica shed the read (per-backend 503s): fleet-wide
+    backpressure, not a routing failure. Surfaces to the client as
+    503 + Retry-After — a well-behaved client must back off, exactly as
+    it would against a single shedding server; a generic 502 here would
+    make clients retry immediately into the overload."""
+
+    def __init__(self, message: str, retry_after_s: int = 1):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+#: app identity a quota is keyed on; absent header = the "-" default app
+APP_HEADER = "X-PIO-App"
+
+#: rollout stages in which a plan routes/labels traffic (mirrors
+#: storage.metadata ROLLOUT_SHADOW/ROLLOUT_CANARY without importing the
+#: storage plane into the hot path)
+_ACTIVE_STAGES = ("SHADOW", "CANARY")
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """``pio router`` surface (docs/fleet.md, docs/cli.md)."""
+
+    ip: str = "localhost"
+    port: int = 8700
+    #: backend query servers, ``host:port`` each. In sharded mode the
+    #: POSITION is the shard index — backend i must serve shard i of
+    #: len(backends).
+    backends: Tuple[str, ...] = ()
+    #: replicated (False): any backend answers any query, affinity by
+    #: bucket, failover to the next replica. Sharded (True): every
+    #: backend holds one item-factor partition; queries fan out to all
+    #: and merge.
+    sharded: bool = False
+    #: per-app in-flight caps ({app: max}); apps not listed fall back to
+    #: ``default_quota`` (0 = unbounded)
+    quotas: Dict[str, int] = dataclasses.field(default_factory=dict)
+    default_quota: int = 0
+    #: per-leg socket timeout (always capped by the request deadline)
+    timeout_s: float = 10.0
+    #: max distinct replicas tried per read (replicated mode);
+    #: 0 = every configured backend
+    max_attempts: int = 0
+    #: salt for the replica-affinity bucket — any value shared by all
+    #: router replicas keeps them consistent; it is deliberately NOT the
+    #: rollout salt, so starting a canary never reshuffles which backend
+    #: a user's requests land on
+    routing_salt: str = "pio-router"
+    #: top-k used for the sharded merge when the query carries no "num"
+    #: field: must match the engine's query-class default (the bundled
+    #: templates all default to 10), or the merged answer's length
+    #: diverges from the unsharded server's — each shard fills the
+    #: default independently and the router cannot see it
+    default_num: int = 10
+    #: engine identity whose active RolloutPlan the router mirrors for
+    #: the variant-consistency check (None = first backend's instance,
+    #: discovered lazily; the check is skipped without a registry)
+    engine_id: Optional[str] = None
+    engine_version: Optional[str] = None
+    engine_variant: str = "engine.json"
+    #: seconds an active-plan read is cached before re-reading metadata
+    plan_refresh_s: float = 2.0
+
+
+class _RouterHandler(JsonHTTPHandler):
+    server: "RouterServer"
+
+    def do_POST(self) -> None:  # noqa: N802
+        raw = self.read_body()
+        path = urlparse(self.path).path
+        if path != "/queries.json":
+            self.respond(404, {"message": "Not Found"})
+            return
+        app = (self.headers.get(APP_HEADER) or "-").strip() or "-"
+        if not self.server.admit(app):
+            self.server.count_request("shed")
+            self.server.count_shed(app)
+            self.respond(
+                503,
+                {"message": f"app {app!r} over its router quota"},
+                headers={"Retry-After": 1},
+            )
+            return
+        deadline = Deadline.from_header(
+            self.headers.get(DEADLINE_HEADER), clock=self.server.clock
+        )
+        started = self.server.clock()
+        try:
+            if deadline is not None:
+                deadline.check("router-admission")
+            with self.server.tracer.server_span(
+                "POST /queries.json",
+                header_value=self.headers.get(TRACE_HEADER),
+                tags={"router": "1"},
+            ) as span:
+                status, body, variant = self.server.route_query(
+                    raw, deadline, trace_id=span.trace_id
+                )
+            headers = {TRACE_HEADER: span.trace_id}
+            if variant is not None:
+                headers[VARIANT_HEADER] = variant
+            self.server.count_request("ok" if status == 200 else "error")
+            self.respond(status, body, headers=headers)
+        except DeadlineExceeded as exc:
+            self.server.count_request("deadline")
+            self.respond(504, {"message": str(exc), "stage": exc.stage})
+        except RouterBadRequest as exc:
+            self.server.count_request("bad_request")
+            self.respond(400, {"message": str(exc)})
+        except FleetOverloaded as exc:
+            # fleet-wide backpressure relays as a shed, never a 502:
+            # clients that honor Retry-After must keep backing off
+            self.server.count_request("shed")
+            self.server.count_shed(app)
+            self.respond(
+                503,
+                {"message": str(exc)},
+                headers={"Retry-After": exc.retry_after_s},
+            )
+        except Exception as exc:
+            logger.exception("router query failed")
+            self.server.count_request("error")
+            self.respond(502, {"message": str(exc)})
+        finally:
+            self.server.observe_latency(self.server.clock() - started)
+            self.server.release(app)
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        if self.serve_obs(path):  # /metrics + /traces.json
+            return
+        if path in ("/", "/status.json", "/router.json"):
+            self.respond(200, self.server.status_json())
+        elif path == "/stop":
+            self.respond(200, {"message": "Shutting down"})
+            self.server.stop_async()
+        else:
+            self.respond(404, {"message": "Not Found"})
+
+
+class RouterServer(BackgroundHTTPServer):
+    """The router process: stateless but for quota counters, breaker
+    state and the cached plan read — everything a replica needs to agree
+    with its peers is a pure function of (config, replicated plan)."""
+
+    def __init__(
+        self,
+        config: RouterConfig,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not config.backends:
+            raise ValueError("router needs at least one backend (host:port)")
+        self.config = config
+        self.registry = registry
+        self.clock = clock
+        self.backends: Tuple[str, ...] = tuple(config.backends)
+        # one breaker per backend: health is judged per process, and an
+        # open breaker takes the backend out of the rotation until its
+        # cooldown admits a probe
+        self.breakers: Dict[str, CircuitBreaker] = {
+            b: CircuitBreaker.from_env(f"backend-{b}", clock=clock)
+            for b in self.backends
+        }
+        #: guards the mutable tables below (quota in-flight counts, the
+        #: cached plan, the lazily-discovered engine identity); every
+        #: cross-thread reader — handler threads, gauge callbacks —
+        #: takes it, and nothing blocking runs under it
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+        self._plan: Optional[Any] = None
+        self._plan_read_at: Optional[float] = None
+        self._engine_key: Optional[Tuple[str, str, str]] = (
+            (config.engine_id, config.engine_version or "1", config.engine_variant)
+            if config.engine_id
+            else None
+        )
+        # per-(worker thread, backend) persistent connections: handler
+        # and fan-out threads each keep their own socket per backend, so
+        # keep-alive reuse never interleaves two requests on one socket
+        self._conns = threading.local()
+
+        metrics_clock = clock
+        from ..obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry(clock=metrics_clock)
+        self._requests = metrics.counter(
+            "pio_router_requests_total",
+            "Routed requests by outcome",
+            labelnames=("outcome",),
+        )
+        self._retries = metrics.counter(
+            "pio_router_retries_total",
+            "Reads retried on another replica, by failed backend",
+            labelnames=("backend",),
+        )
+        self._shed = metrics.counter(
+            "pio_router_shed_total",
+            "Requests shed at the router quota, by app",
+            labelnames=("app",),
+        )
+        self._backend_events = metrics.counter(
+            "pio_router_backend_events_total",
+            "Per-backend leg outcomes",
+            labelnames=("backend", "kind"),
+        )
+        self._hist = metrics.histogram(
+            "pio_router_request_seconds",
+            "End-to-end routed request latency",
+        )
+        self._variant_mismatch = metrics.counter(
+            "pio_router_variant_mismatch_total",
+            "Requests whose backend variant disagreed with the router's "
+            "own pure-function assignment (must stay 0)",
+        )
+        metrics.gauge_callback(
+            "pio_router_backends_up",
+            self._backends_up,
+            "Backends whose breaker currently admits traffic",
+        )
+        metrics.gauge(
+            "pio_router_sharded", "1 when serving in sharded-model mode"
+        ).set(1 if config.sharded else 0)
+        super().__init__(
+            (config.ip, config.port),
+            _RouterHandler,
+            metrics=metrics,
+            tracer=Tracer("router", clock=clock),
+        )
+
+    # -- admission (per-app quotas) ---------------------------------------
+    def quota_for(self, app: str) -> int:
+        return self.config.quotas.get(app, self.config.default_quota)
+
+    def admit(self, app: str) -> bool:
+        quota = self.quota_for(app)
+        with self._lock:
+            inflight = self._inflight.get(app, 0)
+            if quota > 0 and inflight >= quota:
+                return False
+            self._inflight[app] = inflight + 1
+            return True
+
+    def release(self, app: str) -> None:
+        with self._lock:
+            remaining = max(0, self._inflight.get(app, 0) - 1)
+            if remaining:
+                self._inflight[app] = remaining
+            else:
+                # drop drained apps: X-PIO-App is client-controlled, and
+                # a table keyed by every value ever seen would grow
+                # without bound on this long-lived front tier (the shed
+                # counter is safe — the metrics registry caps label
+                # cardinality into "_overflow")
+                self._inflight.pop(app, None)
+
+    # -- metrics hooks (handler-facing; the registry is thread-safe) ------
+    def count_request(self, outcome: str) -> None:
+        self._requests.inc(1, outcome=outcome)
+
+    def count_shed(self, app: str) -> None:
+        self._shed.inc(1, app=app)
+
+    def observe_latency(self, elapsed_s: float) -> None:
+        self._hist.observe(max(0.0, elapsed_s))
+
+    def _backends_up(self) -> int:
+        return sum(
+            1
+            for b in self.breakers.values()
+            if b.state != CircuitBreaker.OPEN
+        )
+
+    # -- fleet-consistent plan view ---------------------------------------
+    def active_plan(self):
+        """The engine's active RolloutPlan via the replicated
+        ``rollout_plan_get_active`` read, cached ``plan_refresh_s``.
+        Any failure (no registry, metadata outage, unknown engine)
+        degrades to None — the consistency check is an alarm, never a
+        serving dependency."""
+        if self.registry is None:
+            return None
+        with self._lock:
+            fresh = (
+                self._plan_read_at is not None
+                and self.clock() - self._plan_read_at
+                < self.config.plan_refresh_s
+            )
+            if fresh:
+                return self._plan
+            engine_key = self._engine_key
+        plan = None
+        try:
+            md = self.registry.get_metadata()
+            if engine_key is None:
+                engine_key = self._discover_engine_key(md)
+            if engine_key is not None:
+                plan = md.rollout_plan_get_active(*engine_key)
+        except Exception:
+            logger.debug("router plan read failed", exc_info=True)
+            plan = None
+        with self._lock:
+            self._plan = plan
+            self._plan_read_at = self.clock()
+            if engine_key is not None:
+                self._engine_key = engine_key
+        return plan
+
+    def _discover_engine_key(self, md) -> Optional[Tuple[str, str, str]]:
+        """Without an explicit --engine-id, mirror whatever engine the
+        fleet's latest completed instance belongs to."""
+        try:
+            instances = md.engine_instance_get_all()
+        except Exception:
+            return None
+        completed = [i for i in instances if i.status == "COMPLETED"]
+        if not completed:
+            return None
+        latest = max(completed, key=lambda i: i.start_time)
+        return (latest.engine_id, latest.engine_version, latest.engine_variant)
+
+    def variant_preview(self, payload: Any) -> Optional[str]:
+        """The router's own (pure-function) variant assignment for this
+        payload under the active plan — what any query server must also
+        compute. None when no plan is active/readable."""
+        plan = self.active_plan()
+        if plan is None or plan.stage not in _ACTIVE_STAGES:
+            return None
+        if plan.stage != "CANARY":
+            return BASELINE
+        return variant_for_key(plan.salt, sticky_key(payload), plan.percent)
+
+    # -- routing ----------------------------------------------------------
+    def route_query(
+        self,
+        raw: bytes,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str] = None,
+    ) -> Tuple[int, Any, Optional[str]]:
+        """One client request end to end → ``(status, body, variant)``.
+        Raises DeadlineExceeded/ValueError for the handler's 504/400."""
+        try:
+            payload = json.loads(raw.decode("utf-8")) if raw else {}
+        except ValueError as exc:
+            raise RouterBadRequest(f"invalid query JSON: {exc}") from exc
+        if self.config.sharded:
+            status, body, variant = self._route_sharded(
+                raw, payload, deadline, trace_id
+            )
+        else:
+            status, body, variant = self._route_replicated(
+                raw, payload, deadline, trace_id
+            )
+        if status == 200:
+            self._check_variant(payload, variant)
+        return status, body, variant
+
+    def _check_variant(self, payload: Any, served: Optional[str]) -> None:
+        expected = self.variant_preview(payload)
+        if expected is None or served in (None, "", "-"):
+            return  # no active plan, or a backend predating the header
+        if served != expected:
+            self._variant_mismatch.inc(1)
+            logger.warning(
+                "variant mismatch: router computed %s, backend served %s "
+                "(sticky split drifted — check plan replication)",
+                expected, served,
+            )
+
+    def _ordered_replicas(self, payload: Any) -> List[str]:
+        """Affinity-first rotation: the sticky bucket picks the home
+        replica, failover walks the rest in ring order. Pure function of
+        (routing_salt, key, backend list) — every router replica
+        produces the same order."""
+        start = bucket_for_key(
+            self.config.routing_salt, sticky_key(payload)
+        ) % len(self.backends)
+        ring = self.backends[start:] + self.backends[:start]
+        admitting = [
+            b for b in ring
+            if self.breakers[b].state != CircuitBreaker.OPEN
+        ]
+        # every breaker open: trying the ring beats a guaranteed 502 (and
+        # before_call below re-checks each breaker's cooldown properly)
+        return admitting or list(ring)
+
+    def _route_replicated(
+        self,
+        raw: bytes,
+        payload: Any,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+    ) -> Tuple[int, Any, Optional[str]]:
+        replicas = self._ordered_replicas(payload)
+        if self.config.max_attempts > 0:
+            replicas = replicas[: self.config.max_attempts]
+        last_error: Optional[str] = None
+        all_shed = bool(replicas)
+        for i, backend in enumerate(replicas):
+            if deadline is not None:
+                deadline.check("router-retry")
+            attempts_left = len(replicas) - i
+            breaker = self.breakers[backend]
+            try:
+                breaker.before_call()
+            except CircuitOpen:
+                self._backend_events.inc(1, backend=backend, kind="open_skip")
+                all_shed = False
+                continue
+            try:
+                status, body, headers = self._leg(
+                    backend, raw, deadline, attempts_left, trace_id
+                )
+            except Exception as exc:
+                breaker.record_failure()
+                self._backend_events.inc(1, backend=backend, kind="error")
+                if i + 1 < len(replicas):
+                    self._retries.inc(1, backend=backend)
+                last_error = f"{backend}: {exc}"
+                all_shed = False
+                continue
+            if status == 503 or (status >= 500 and status != 504):
+                # a shedding or erroring backend: the read belongs on
+                # another replica (bounded-admission discipline says the
+                # *fleet* answers even when one member cannot). 504 is
+                # excluded: an expired deadline is the CLIENT's budget,
+                # not backend sickness — it must neither trip the
+                # breaker nor burn a failover leg it cannot afford.
+                breaker.record_failure()
+                self._backend_events.inc(1, backend=backend, kind="error")
+                if i + 1 < len(replicas):
+                    self._retries.inc(1, backend=backend)
+                last_error = f"{backend}: HTTP {status}"
+                if status != 503:
+                    all_shed = False
+                continue
+            breaker.record_success()
+            self._backend_events.inc(1, backend=backend, kind="ok")
+            return status, body, headers.get(VARIANT_HEADER.lower())
+        if all_shed:
+            # every replica answered 503: fleet-wide backpressure, not a
+            # routing failure — relay the shed so clients back off
+            raise FleetOverloaded(
+                f"all {len(replicas)} replicas are shedding load"
+            )
+        raise RuntimeError(
+            f"no backend could serve the read (tried {len(replicas)}): "
+            f"{last_error or 'all breakers open'}"
+        )
+
+    def _route_sharded(
+        self,
+        raw: bytes,
+        payload: Any,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+    ) -> Tuple[int, Any, Optional[str]]:
+        """Scatter to every shard, gather, merge exactly. All legs run
+        concurrently, each under the full remaining budget (they are
+        parallel — splitting it would punish fan-out width). Legs get
+        per-request threads, not a shared pool: a pool sized to the
+        shard count would serialize concurrent client requests behind
+        each other's slowest leg (head-of-line blocking — one backend
+        stalling to its socket timeout would inflate every queued
+        request). ThreadingHTTPServer already spawns per connection;
+        N short-lived leg threads per request is the same discipline."""
+        results: List = [None] * len(self.backends)
+
+        def run_leg(idx: int, backend: str) -> None:
+            try:
+                results[idx] = self._shard_leg(
+                    backend, raw, deadline, trace_id
+                )
+            finally:
+                # ephemeral thread: its thread-local conns die with it —
+                # close deterministically instead of leaking the socket
+                # to GC (TIME_WAIT/fd churn under sustained fan-out)
+                self._close_thread_conns()
+
+        threads = [
+            threading.Thread(
+                target=run_leg, args=(i, b), daemon=True,
+                name=f"router-leg-{i}",
+            )
+            for i, b in enumerate(self.backends)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        bodies: List[Any] = []
+        variant: Optional[str] = None
+        errors: List[str] = []
+        for backend, (ok, value, leg_variant) in zip(
+            self.backends, results
+        ):
+            if ok:
+                bodies.append(value)
+                if variant is None:
+                    variant = leg_variant
+            else:
+                errors.append(f"{backend}: {value}")
+        if errors:
+            # a missing shard makes an exact merge impossible: fail the
+            # read loudly instead of returning a silently truncated
+            # catalog (docs/fleet.md#failure-modes)
+            raise RuntimeError(
+                f"{len(errors)}/{len(self.backends)} shards failed: "
+                + "; ".join(errors)
+            )
+        k = payload.get("num") if isinstance(payload, dict) else None
+        if not isinstance(k, int):
+            # the engine's query class filled its default on every shard
+            # (each returned up to default_num); merging untruncated
+            # would hand the client shard_count × the unsharded count
+            k = self.config.default_num
+        merged = merge_predictions(bodies, k)
+        return 200, merged, variant
+
+    def _shard_leg(
+        self,
+        backend: str,
+        raw: bytes,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+    ) -> Tuple[bool, Any, Optional[str]]:
+        """One shard fan-out leg (pool thread) → (ok, body|error, variant)."""
+        breaker = self.breakers[backend]
+        try:
+            breaker.before_call()
+        except CircuitOpen as exc:
+            self._backend_events.inc(1, backend=backend, kind="open_skip")
+            return False, str(exc), None
+        try:
+            status, body, headers = self._leg(
+                backend, raw, deadline, 1, trace_id
+            )
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+        except Exception as exc:
+            breaker.record_failure()
+            self._backend_events.inc(1, backend=backend, kind="error")
+            return False, str(exc), None
+        breaker.record_success()
+        self._backend_events.inc(1, backend=backend, kind="ok")
+        return True, body, headers.get(VARIANT_HEADER.lower())
+
+    # -- one backend leg --------------------------------------------------
+    def _leg_timeout(
+        self, deadline: Optional[Deadline], attempts_left: int
+    ) -> float:
+        """Budget split across the retry schedule: with ``attempts_left``
+        sequential tries remaining, this leg may spend at most an even
+        share of what's left — so a hung first replica can never eat the
+        whole budget and leave the failover zero time."""
+        timeout = self.config.timeout_s
+        if deadline is not None:
+            share = deadline.remaining_s() / max(1, attempts_left)
+            timeout = max(0.001, min(timeout, share))
+        return timeout
+
+    def _leg(
+        self,
+        backend: str,
+        raw: bytes,
+        deadline: Optional[Deadline],
+        attempts_left: int,
+        trace_id: Optional[str],
+    ) -> Tuple[int, Any, Dict[str, str]]:
+        """One HTTP POST to one backend → (status, parsed body, headers).
+        Propagates the trace id and the *remaining* deadline budget."""
+        timeout = self._leg_timeout(deadline, attempts_left)
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            headers[TRACE_HEADER] = trace_id
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = deadline.header_value()
+        leg_tags: Dict[str, object] = {"backend": backend}
+        with self.tracer.span("router.backend", tags=leg_tags):
+            conn = self._conn(backend, timeout)
+            conn.timeout = timeout
+            if conn.sock is not None:  # reused keep-alive socket
+                conn.sock.settimeout(timeout)
+            try:
+                conn.request("POST", "/queries.json", body=raw, headers=headers)
+                resp = conn.getresponse()
+                body_bytes = resp.read()
+                resp_headers = {
+                    k.lower(): v for k, v in resp.getheaders()
+                }
+                status = resp.status
+            except Exception:
+                self._drop_conn(backend)
+                raise
+            leg_tags["status"] = status  # recorded at span close
+        try:
+            body = json.loads(body_bytes.decode("utf-8")) if body_bytes else {}
+        except ValueError:
+            body = {"message": body_bytes.decode("utf-8", "replace")}
+        return status, body, resp_headers
+
+    def _conn(self, backend: str, timeout: float) -> http.client.HTTPConnection:
+        pool = getattr(self._conns, "pool", None)
+        if pool is None:
+            pool = self._conns.pool = {}
+        conn = pool.get(backend)
+        if conn is None:
+            host, _, port = backend.partition(":")
+            conn = http.client.HTTPConnection(
+                host, int(port or 80), timeout=timeout
+            )
+            pool[backend] = conn
+        return conn
+
+    def _drop_conn(self, backend: str) -> None:
+        pool = getattr(self._conns, "pool", None)
+        if pool is None:
+            return
+        conn = pool.pop(backend, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _close_thread_conns(self) -> None:
+        """Close every connection this thread pooled (per-request
+        fan-out threads call it on exit; long-lived handler threads
+        keep theirs for keep-alive reuse)."""
+        pool = getattr(self._conns, "pool", None)
+        if not pool:
+            return
+        for conn in pool.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        pool.clear()
+
+    # -- status -----------------------------------------------------------
+    def status_json(self) -> dict:
+        with self._lock:
+            inflight = {
+                app: n for app, n in self._inflight.items() if n > 0
+            }
+            plan = self._plan
+        out: dict = {
+            "role": "router",
+            "sharded": self.config.sharded,
+            "backends": [
+                {
+                    "backend": b,
+                    "breaker": self.breakers[b].snapshot(),
+                }
+                for b in self.backends
+            ],
+            "backendsUp": self._backends_up(),
+            "quotas": dict(self.config.quotas),
+            "defaultQuota": self.config.default_quota,
+            "inflight": inflight,
+        }
+        if plan is not None:
+            out["rolloutPlan"] = {
+                "id": plan.id,
+                "stage": plan.stage,
+                "percent": plan.percent,
+                "salt": plan.salt,
+            }
+        return out
+
+
+
+def create_router(
+    config: RouterConfig,
+    registry=None,
+    block: bool = True,
+) -> RouterServer:
+    """``pio router`` entry point (docs/cli.md)."""
+    server = RouterServer(config, registry=registry)
+    logger.info(
+        "router: %s mode, %d backends, on %s:%d",
+        "sharded" if config.sharded else "replicated",
+        len(config.backends),
+        config.ip,
+        server.bound_port,
+    )
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+    else:
+        server.start_background()
+    return server

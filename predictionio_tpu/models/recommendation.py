@@ -407,6 +407,32 @@ class ALSAlgorithm(Algorithm):
         )
         return folded, stats
 
+    def shard_model(
+        self, model: ALSModel, shard_index: int, shard_count: int
+    ) -> ALSModel:
+        """One item-factor partition for sharded serving
+        (``docs/fleet.md``; the serving-side analogue of ALX's sharded
+        factor layout). Item row ``i`` lives on shard ``i % shard_count``
+        — round-robin, so power-law-popular head items spread across
+        shards instead of piling onto shard 0. User factors stay whole
+        (queries score a full user row against the local partition), the
+        item map is rebuilt over the kept rows, and the union of all
+        shards' local top-ks provably contains the global top-k the
+        router merge reconstructs exactly."""
+        keep = np.arange(
+            shard_index, model.item_factors.shape[0], shard_count
+        )
+        inv = model.item_map.inverse
+        return ALSModel(
+            rank=model.rank,
+            user_factors=model.user_factors,
+            item_factors=np.ascontiguousarray(model.item_factors[keep]),
+            user_map=model.user_map,
+            item_map=BiMap(
+                {inv[int(old)]: new for new, old in enumerate(keep)}
+            ),
+        )
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         results = self.batch_predict(model, [(0, query)])
         return results[0][1]

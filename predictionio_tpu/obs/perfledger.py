@@ -38,6 +38,7 @@ __all__ = [
     "bench_to_record",
     "comparable_key",
     "detect_regressions",
+    "fleet_records",
     "load_bench_history",
     "load_ledger",
     "make_record",
@@ -130,10 +131,77 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
         phases=bench.get("bucketize_stage_phases_s"),
         extra={
             key: bench[key]
-            for key in ("iterations", "nnz", "error", "jit")
+            for key in ("iterations", "nnz", "error", "jit", "servingFleet")
             if key in bench
         },
     )
+
+
+def fleet_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The serving-fleet numbers a bench run attached
+    (``bench["servingFleet"]``, from ``loadgen --replicas`` —
+    docs/fleet.md) as their own ledger records, so serving scale gates
+    alongside train time:
+
+    - ``fleet_served_p50_s`` — seconds, lower-better → gated by
+      ``pio perf diff`` at a per-record 0.25 band: the median of the
+      drive is statistically stable, but it is still wall-clock from an
+      in-process fleet sharing a possibly-contended CI box (the same
+      reason the jax-cache compile-ratio assertion was retired), so the
+      bar sits above scheduler weather and below a real 1.3×+ slowdown;
+    - ``fleet_served_p99_s`` — seconds, lower-better, gated at a WIDER
+      band (0.5): the p99 of a ~100-request in-process drive is one
+      scheduler hiccup away from 2×, so only a serving collapse (an
+      accidental sleep, a lock convoy) should fire the gate, not
+      CI-box weather;
+    - ``fleet_served_qps`` — higher-better, so it rides as a trend-only
+      record (the gate only ever compares ``unit == "s"``).
+
+    The replica count travels as ``scale``: a 3-replica run must never
+    gate a 2-replica run. A failed fleet drive (``ok`` false) records
+    nothing — its latencies measured a broken fleet, not the code."""
+    fleet = bench.get("servingFleet")
+    if not isinstance(fleet, dict) or not fleet.get("ok"):
+        return []
+    out: List[dict] = []
+    # sharded drives are a different workload (scatter/gather to every
+    # backend per query) — their latency must never gate a replicated
+    # drive's, so the fleet shape lives in the METRIC NAME, like the
+    # replica count lives in scale
+    prefix = (
+        "fleet_sharded_served" if fleet.get("sharded") else "fleet_served"
+    )
+    for key, metric, band in (
+        ("servedP50Ms", f"{prefix}_p50_s", 0.25),
+        ("servedP99Ms", f"{prefix}_p99_s", 0.5),
+    ):
+        value_ms = fleet.get(key)
+        if isinstance(value_ms, (int, float)) and value_ms > 0:
+            record = make_record(
+                source=source,
+                metric=metric,
+                value=float(value_ms) / 1000.0,
+                unit="s",
+                device=bench.get("device"),
+                scale=fleet.get("replicas"),
+                extra={"sharded": bool(fleet.get("sharded"))},
+            )
+            record["noise_band"] = band
+            out.append(record)
+    qps = fleet.get("servedQPS")
+    if isinstance(qps, (int, float)) and qps > 0:
+        out.append(
+            make_record(
+                source=source,
+                metric=f"{prefix}_qps",
+                value=float(qps),
+                unit="qps",
+                device=bench.get("device"),
+                scale=fleet.get("replicas"),
+                extra={"sharded": bool(fleet.get("sharded"))},
+            )
+        )
+    return out
 
 
 def append_record(path: str, record: dict) -> None:
@@ -238,7 +306,11 @@ def detect_regressions(
     """Per comparable group (records in given = chronological order):
     compare the latest value against the median of its predecessors.
     Lower-is-better (``unit == "s"`` only; other units are trend-only).
-    Returns one verdict dict per flagged group — empty means clean."""
+    A record may carry its own ``noise_band`` (a noisier measurement —
+    the fleet drive's small-sample p99); the group's effective band is
+    the WIDER of it and the caller's, so a noisy metric can never be
+    held to a tighter bar than its producer declared. Returns one
+    verdict dict per flagged group — empty means clean."""
     groups: Dict[Tuple, List[dict]] = {}
     for record in records:
         if record.get("unit", "s") != "s":
@@ -261,8 +333,15 @@ def detect_regressions(
         baseline = _median(prior)
         if baseline <= 0:
             continue
+        try:
+            declared = max(
+                float(r.get("noise_band", 0.0) or 0.0) for r in group
+            )
+        except (TypeError, ValueError):
+            declared = 0.0  # a hand-edited band never breaks the gate
+        band = max(noise_band, declared)
         ratio = float(latest["value"]) / baseline
-        if ratio > 1.0 + noise_band:
+        if ratio > 1.0 + band:
             flagged.append(
                 {
                     "key": {
@@ -278,7 +357,7 @@ def detect_regressions(
                     "latest_source": latest.get("source"),
                     "baseline_median": round(baseline, 4),
                     "ratio": round(ratio, 4),
-                    "noise_band": noise_band,
+                    "noise_band": band,
                     "history": len(prior),
                 }
             )

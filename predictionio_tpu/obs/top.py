@@ -125,16 +125,22 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
         return {"node": node, "up": False}
     row: Dict[str, object] = {"node": node, "up": True}
     row["requests"] = _series_sum(m, "pio_serving_request_seconds_count")
+    if row["requests"] is None:  # router nodes: end-to-end routed reqs
+        row["requests"] = _series_sum(m, "pio_router_request_seconds_count")
     if row["requests"] is None:  # non-serving nodes: total HTTP responses
         row["requests"] = _series_sum(m, "pio_http_responses_total")
     for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
         p = _hist_percentile(m, "pio_serving_request_seconds", q)
+        if p is None:
+            p = _hist_percentile(m, "pio_router_request_seconds", q)
         if p is None:
             p = _hist_percentile(m, "pio_storage_op_seconds", q)
         if p is None:
             p = _hist_percentile(m, "pio_http_request_seconds", q)
         row[key] = None if p is None else p * 1000.0
     row["shed"] = _series_sum(m, "pio_serving_events_total", kind="shed")
+    if row["shed"] is None:  # router nodes shed at their per-app quotas
+        row["shed"] = _series_sum(m, "pio_router_shed_total")
     breakers = m.get("pio_breaker_state")
     row["breakers_open"] = (
         None
@@ -160,6 +166,10 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
     # server is the shape-bucketing regression alarm
     row["jit_compiles"] = _series_sum(m, "pio_jit_compiles_total")
     row["jit_retraces"] = _series_sum(m, "pio_jit_retraces_total")
+    # router tier (docs/fleet.md): healthy-backend count, plus reads the
+    # router had to retry on another replica — the fleet-failover pulse
+    row["backends_up"] = _series_sum(m, "pio_router_backends_up")
+    row["router_retries"] = _series_sum(m, "pio_router_retries_total")
     return row
 
 
@@ -179,6 +189,8 @@ _COLUMNS = (
     ("CANDAGE", "cand_age", "{:.0f}"),
     ("JITC", "jit_compiles", "{:.0f}"),
     ("RETRACE", "jit_retraces", "{:.0f}"),
+    ("BACKENDS", "backends_up", "{:.0f}"),
+    ("RTRETRY", "router_retries", "{:.0f}"),
 )
 
 #: public alias for other fleet renderers (the dashboard's /fleet panel)

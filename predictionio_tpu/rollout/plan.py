@@ -37,7 +37,10 @@ from typing import Any, Dict, Iterator, Tuple
 __all__ = [
     "BASELINE",
     "CANDIDATE",
+    "NUM_BUCKETS",
+    "VARIANT_HEADER",
     "GateConfig",
+    "bucket_for_key",
     "plan_to_json",
     "prediction_divergence",
     "sticky_key",
@@ -48,8 +51,16 @@ __all__ = [
 BASELINE = "baseline"
 CANDIDATE = "candidate"
 
+#: response header carrying the variant a query was SERVED by ("-" when
+#: no rollout is involved). One home for the literal: the query server
+#: stamps it, the router tier's fleet-consistency check reads it — a
+#: divergent copy on either side would silently disarm the check
+#: (docs/fleet.md).
+VARIANT_HEADER = "X-PIO-Variant"
+
 #: split resolution: percent maps to buckets out of 10,000 (0.01% steps)
-_BUCKETS = 10_000
+NUM_BUCKETS = 10_000
+_BUCKETS = NUM_BUCKETS
 
 #: payload fields tried (in order) as the sticky entity key before
 #: falling back to the whole canonicalized payload
@@ -150,6 +161,20 @@ def sticky_key(payload: Any) -> str:
         return str(payload)
 
 
+def bucket_for_key(salt: str, key: str) -> int:
+    """The fleet's one hash: SHA-256 over ``salt|key`` into one of
+    :data:`NUM_BUCKETS` buckets. Pure function of its two string inputs
+    — no process state, no randomness — so every consumer (the canary
+    split below, the router tier's replica affinity,
+    ``docs/fleet.md``) computes the *same* bucket everywhere, with no
+    coordination: any router replica and any query server agree on an
+    assignment by construction. The golden-vector test in
+    ``tests/test_rollout.py`` pins exact outputs — changing this
+    function silently would flap every sticky assignment fleet-wide."""
+    digest = hashlib.sha256(f"{salt}|{key}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big") % _BUCKETS
+
+
 def variant_for_key(salt: str, key: str, percent: float) -> str:
     """Deterministic sticky assignment: candidate iff the key's hash
     bucket (of 10,000) falls under ``percent``. The salt is minted once
@@ -159,8 +184,7 @@ def variant_for_key(salt: str, key: str, percent: float) -> str:
         return BASELINE
     if percent >= 100:
         return CANDIDATE
-    digest = hashlib.sha256(f"{salt}|{key}".encode("utf-8")).digest()
-    bucket = int.from_bytes(digest[:8], "big") % _BUCKETS
+    bucket = bucket_for_key(salt, key)
     return CANDIDATE if bucket < round(percent * (_BUCKETS / 100.0)) else BASELINE
 
 

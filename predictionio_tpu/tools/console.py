@@ -324,6 +324,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batch size cap (size to catalog and depth)")
     dp.add_argument("--batch-pipeline-depth", type=int, default=None,
                     help="batches in flight at once (default 2)")
+    dp.add_argument("--shard-index", type=int, default=None, metavar="I",
+                    help="serve item-factor shard I of --shard-count "
+                    "behind a `pio router --sharded` tier (docs/fleet.md)")
+    dp.add_argument("--shard-count", type=int, default=None, metavar="N",
+                    help="total item-factor shards (1 = unsharded)")
     dp.add_argument("--continuous-app", type=int, default=None,
                     metavar="APP_ID",
                     help="attach the continuous-learning loop for this app "
@@ -401,6 +406,44 @@ def build_parser() -> argparse.ArgumentParser:
     for sp in (co_start, co_pause, co_trig, co_sub.choices["status"]):
         sp.add_argument("--ip", default="localhost")
         sp.add_argument("--port", type=int, default=8000)
+
+    rt = sub.add_parser(
+        "router",
+        help="serving-fleet router tier: fronts N query servers with "
+        "consistent routing, per-app quotas, replica failover and "
+        "sharded-model top-k merge (docs/fleet.md)",
+    )
+    rt.add_argument("--ip", default="localhost")
+    rt.add_argument("--port", type=int, default=8700)
+    rt.add_argument(
+        "--backends", required=True, metavar="HOST:PORT,...",
+        help="query servers to front; in --sharded mode position i must "
+        "serve shard i of N",
+    )
+    rt.add_argument(
+        "--sharded", action="store_true",
+        help="scatter/gather mode: each backend holds one item-factor "
+        "partition, answers merge into the exact global top-k",
+    )
+    rt.add_argument(
+        "--quota", action="append", default=[], metavar="APP=N",
+        help="per-app in-flight cap (X-PIO-App header), repeatable",
+    )
+    rt.add_argument(
+        "--default-quota", type=int, default=0,
+        help="in-flight cap for apps without an explicit --quota "
+        "(0 = unbounded)",
+    )
+    rt.add_argument("--timeout", type=float, default=10.0,
+                    help="per-backend-leg socket timeout (seconds)")
+    rt.add_argument(
+        "--engine-id", default=None,
+        help="engine whose active rollout plan the variant-consistency "
+        "check mirrors (default: discovered from the latest completed "
+        "instance)",
+    )
+    rt.add_argument("--engine-version", default=None)
+    rt.add_argument("--engine-variant", default="engine.json")
 
     es = sub.add_parser("eventserver", help="run the event REST server")
     es.add_argument("--ip", default="localhost")
@@ -680,7 +723,7 @@ def main(
     # (tests, embedding apps) must not inherit a process-killing SIGPIPE.
     prev = None
     if args.command not in (
-        "eventserver", "dashboard", "storageserver", "deploy",
+        "eventserver", "dashboard", "storageserver", "deploy", "router",
     ):
         try:
             cur = signal.getsignal(signal.SIGPIPE)
@@ -829,6 +872,10 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         if args.batch_pipeline_depth is not None:
             srv_argv += ["--batch-pipeline-depth",
                          str(args.batch_pipeline_depth)]
+        if args.shard_index is not None:
+            srv_argv += ["--shard-index", str(args.shard_index)]
+        if args.shard_count is not None:
+            srv_argv += ["--shard-count", str(args.shard_count)]
         if args.continuous_app is not None:
             srv_argv += ["--continuous-app", str(args.continuous_app)]
         if args.continuous_feed:
@@ -849,6 +896,38 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
 
     if cmd == "continuous":
         _emit(continuous_command(args))
+        return EXIT_OK
+
+    if cmd == "router":
+        from ..fleet.router import RouterConfig, create_router
+
+        backends = tuple(
+            b.strip() for b in args.backends.split(",") if b.strip()
+        )
+        quotas = {}
+        for item in args.quota:
+            app, sep, value = item.partition("=")
+            if not sep:
+                raise ValueError(f"bad --quota {item!r}: expected APP=N")
+            try:
+                quotas[app.strip()] = int(value)
+            except ValueError:
+                raise ValueError(
+                    f"bad --quota {item!r}: N must be an integer"
+                ) from None
+        config = RouterConfig(
+            ip=args.ip,
+            port=args.port,
+            backends=backends,
+            sharded=args.sharded,
+            quotas=quotas,
+            default_quota=args.default_quota,
+            timeout_s=args.timeout,
+            engine_id=args.engine_id,
+            engine_version=args.engine_version,
+            engine_variant=args.engine_variant,
+        )
+        create_router(config, registry=registry, block=True)
         return EXIT_OK
 
     if cmd == "eventserver":

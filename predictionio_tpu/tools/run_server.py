@@ -41,6 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-pipeline-depth", type=int, default=None,
                    help="batches in flight at once (default 2; raise when "
                         "the host-to-device round trip dwarfs device time)")
+    p.add_argument("--shard-index", type=int, default=0, metavar="I",
+                   help="serve item-factor partition I of --shard-count "
+                        "behind a `pio router --sharded` tier "
+                        "(docs/fleet.md)")
+    p.add_argument("--shard-count", type=int, default=1, metavar="N",
+                   help="total shards the item factors partition into "
+                        "(1 = unsharded)")
     p.add_argument("--continuous-app", type=int, default=None, metavar="APP_ID",
                    help="attach the continuous-learning loop for this app: "
                         "changefeed-driven fold-in training with automatic "
@@ -112,6 +119,8 @@ def make_server(
         access_key=args.accesskey,
         batch=args.batch,
         log_url=args.log_url,
+        shard_index=getattr(args, "shard_index", 0),
+        shard_count=getattr(args, "shard_count", 1),
         continuous=_continuous_config(args, registry),
         # frozen dataclass: only override the defaults when flags were given
         **{

@@ -58,7 +58,7 @@ from ..controller.engine import Engine, EngineParams
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import TRACE_HEADER, SpanContext, Tracer, current_context
 from ..rollout.manager import RolloutError, RolloutManager
-from ..rollout.plan import BASELINE, CANDIDATE
+from ..rollout.plan import BASELINE, CANDIDATE, VARIANT_HEADER
 from ..storage import StorageRegistry, utcnow
 from ..storage.metadata import (
     ROLLOUT_SHADOW,
@@ -143,6 +143,18 @@ class ServerConfig:
     #: fold-in controller to this server — candidates auto-submit
     #: through the rollout plane (docs/continuous.md). None = disabled.
     continuous: Optional[Any] = None
+    #: Sharded-model serving (docs/fleet.md): with ``shard_count > 1``
+    #: this server holds only partition ``shard_index`` of the item
+    #: factors (item row ``i`` lives on shard ``i % shard_count``) and
+    #: answers with its *local* top-k; a ``pio router --sharded`` tier
+    #: fans queries out to every shard and k-way-merges the answers into
+    #: the exact global top-k. Every algorithm in the engine must
+    #: implement ``shard_model`` — deploy fails loudly otherwise. The
+    #: shard spec rides ``dataclasses.replace`` into rollout candidate
+    #: deployments, so a canary on a sharded fleet is sharded
+    #: identically.
+    shard_index: int = 0
+    shard_count: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -360,6 +372,8 @@ def prepare_deployment(
     live_models = engine.prepare_deploy(ctx, engine_params, instance.id, persisted)
     algorithms = engine._algorithms(engine_params)
     serving = engine._serving(engine_params)
+    if config.shard_count > 1:
+        live_models = _shard_models(algorithms, live_models, config)
     return Deployment(
         instance=instance,
         engine_params=engine_params,
@@ -367,6 +381,32 @@ def prepare_deployment(
         models=live_models,
         serving=serving,
     )
+
+
+def _shard_models(
+    algorithms: Sequence[Any], models: List[Any], config: ServerConfig
+) -> List[Any]:
+    """Replace each live model with its ``shard_index``-of-``shard_count``
+    partition (docs/fleet.md). Every algorithm must opt in via a
+    ``shard_model(model, shard_index, shard_count)`` method: a server
+    that silently held the full catalog on a sharded fleet would make
+    the router's merged top-k wrong (duplicated items), so a
+    non-shardable algorithm fails the deploy, not the first query."""
+    if not (0 <= config.shard_index < config.shard_count):
+        raise ValueError(
+            f"shard_index {config.shard_index} out of range for "
+            f"shard_count {config.shard_count}"
+        )
+    sharded: List[Any] = []
+    for algo, model in zip(algorithms, models):
+        shard = getattr(algo, "shard_model", None)
+        if shard is None:
+            raise ValueError(
+                f"{type(algo).__name__} does not implement shard_model; "
+                "this engine cannot serve in sharded mode (docs/fleet.md)"
+            )
+        sharded.append(shard(model, config.shard_index, config.shard_count))
+    return sharded
 
 
 # ---------------------------------------------------------------------------
@@ -454,7 +494,19 @@ class _QueryHandler(JsonHTTPHandler):
                     payload, deadline, info=info
                 )
             self.response_labels = {"variant": info["variant"]}
-            self.respond(status, result, headers={TRACE_HEADER: span.trace_id})
+            # VARIANT_HEADER echoes the serving variant to the client —
+            # the router tier's fleet-consistency check compares it
+            # against its own pure-function assignment (docs/fleet.md),
+            # and a chaos drill can assert stickiness across a backend
+            # kill without scraping metrics.
+            self.respond(
+                status,
+                result,
+                headers={
+                    TRACE_HEADER: span.trace_id,
+                    VARIANT_HEADER: info["variant"],
+                },
+            )
         except DeadlineExceeded as exc:
             self.response_labels = {"variant": info["variant"]}
             self.server.stats.inc("deadline_expired")
@@ -579,6 +631,10 @@ class _QueryHandler(JsonHTTPHandler):
                 )
         elif path == "/rollout.json":
             self.respond(200, self.server.rollout.status())
+        elif path == "/shard.json":
+            # shard metadata for the router tier / fleet tooling
+            # (docs/fleet.md): which partition this server holds
+            self.respond(200, self.server.shard_json())
         elif path == "/continuous.json":
             continuous = self.server.continuous
             if continuous is None:
@@ -1239,6 +1295,32 @@ class QueryServer(BackgroundHTTPServer):
         for name, seconds in phases.items():
             gauge.set(seconds, phase=name)
 
+    def shard_json(self) -> dict:
+        """``GET /shard.json``: which item-factor partition this server
+        holds (docs/fleet.md). ``items`` counts rows per model where the
+        model exposes an ``item_factors`` table (the recommender
+        templates); other models report None — the route is metadata,
+        not a capability probe."""
+        with self._deploy_lock:
+            dep = self.deployment
+        return {
+            "sharded": self.config.shard_count > 1,
+            "shardIndex": self.config.shard_index,
+            "shardCount": self.config.shard_count,
+            "engineInstance": dep.instance.id,
+            "models": [
+                {
+                    "type": type(m).__name__,
+                    "items": (
+                        len(m.item_factors)
+                        if getattr(m, "item_factors", None) is not None
+                        else None
+                    ),
+                }
+                for m in dep.models
+            ],
+        }
+
     # -- status page (CreateServer.scala:421-456) -------------------------
     def status_json(self) -> dict:
         """Machine-readable status: the HTML page's facts plus breaker
@@ -1264,6 +1346,11 @@ class QueryServer(BackgroundHTTPServer):
                 "reload": self.reload_breaker.snapshot(),
             },
         }
+        if self.config.shard_count > 1:
+            out["shard"] = {
+                "index": self.config.shard_index,
+                "count": self.config.shard_count,
+            }
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
         if getattr(self, "rollout", None) is not None:

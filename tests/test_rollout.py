@@ -241,6 +241,58 @@ class TestStickySplit:
         assert sticky_key({"z": 1, "a": 2}) == sticky_key({"a": 2, "z": 1})
 
 
+class TestBucketGoldenVectors:
+    """Exact bucket ids for fixed (salt, key) pairs.
+
+    EVERY fleet-wide sticky assignment — canary splits, the router
+    tier's replica affinity (docs/fleet.md) — is downstream of
+    ``bucket_for_key``. The property tests above would survive swapping
+    the hash for any other stable function; these golden vectors would
+    not: a refactor that changes the digest, the byte-slice, the
+    separator, or the modulus silently reassigns every user on the next
+    deploy. If this test fails, the change is wrong — do not update the
+    expected values."""
+
+    # computed once from the shipped implementation:
+    # SHA-256(f"{salt}|{key}")[:8] as big-endian uint64, mod 10_000
+    GOLDEN = {
+        ("fleet-golden", "user=0"): 1188,
+        ("fleet-golden", "user=1"): 8857,
+        ("fleet-golden", "user=2"): 4115,
+        ("fleet-golden", "user=42"): 4945,
+        ("fleet-golden", "entityId=abc"): 4878,
+        ("fleet-golden", '{"q": 1}'): 5626,
+        ("s2", "user=0"): 8615,
+        ("s2", "user=1"): 8530,
+        ("s2", "user=2"): 8835,
+    }
+
+    def test_exact_bucket_assignments(self):
+        from predictionio_tpu.rollout.plan import NUM_BUCKETS, bucket_for_key
+
+        assert NUM_BUCKETS == 10_000  # percent resolution is part of the
+        # contract: variant thresholds are computed against this modulus
+        for (salt, key), expected in self.GOLDEN.items():
+            assert bucket_for_key(salt, key) == expected, (salt, key)
+
+    def test_variant_threshold_derives_from_buckets(self):
+        """variant_for_key must remain exactly `bucket < percent/100 *
+        NUM_BUCKETS` over the golden buckets — the split a restarted or
+        failed-over server recomputes from the durable plan."""
+        from predictionio_tpu.rollout.plan import bucket_for_key
+
+        for (salt, key), bucket in self.GOLDEN.items():
+            assert bucket_for_key(salt, key) == bucket
+            for percent in (0.0, 11.88, 11.89, 48.78, 50.0, 100.0):
+                expected = (
+                    CANDIDATE
+                    if 0 < percent
+                    and (percent >= 100 or bucket < round(percent * 100))
+                    else BASELINE
+                )
+                assert variant_for_key(salt, key, percent) == expected
+
+
 class TestDivergence:
     def test_identical_is_zero(self):
         result = {"items": [{"item": "a", "score": 1.5}], "n": 3}
