@@ -232,6 +232,12 @@ def _append_ledger(record: dict) -> None:
         # trend-only record (docs/fleet.md, docs/performance.md)
         for fleet_record in perfledger.fleet_records(record):
             perfledger.append_record(path, fleet_record)
+        # model-quality trajectory (score PSI / feedback hit-rate from
+        # the feedback-stream drill) rides as trend-only records so
+        # `pio perf trend` shows quality next to latency
+        # (docs/observability.md#quality)
+        for quality_record in perfledger.quality_records(record):
+            perfledger.append_record(path, quality_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -411,6 +417,15 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
                 "mode": (fs.get("lastCycle") or {}).get("mode"),
                 "ok": fs.get("ok"),
             }
+            # quality block (docs/observability.md#quality): the drill's
+            # monitor measured score PSI vs its pinned train-time
+            # baseline and the feedback join's hit-rate — every BENCH
+            # round gets a quality trajectory point next to train time
+            quality = fs.get("quality")
+            if isinstance(quality, dict):
+                record["quality"] = dict(
+                    quality, ok=bool(fs.get("ok") and quality.get("ok"))
+                )
         except Exception as exc:  # the headline metric must still report
             record["continuousFreshness"] = {"error": str(exc)}
     # Serving-fleet trajectory (docs/fleet.md): a small in-process

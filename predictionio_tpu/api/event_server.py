@@ -160,6 +160,10 @@ class EventServerConfig:
     ip: str = "localhost"
     port: int = 7070
     stats: bool = False
+    #: directory for the ingest quality monitor's durable per-app
+    #: event-mix baselines (docs/observability.md#quality); None reads
+    #: the ``PIO_QUALITY_DIR`` env (unset = in-memory baselines only)
+    quality_dir: Optional[str] = None
 
 
 class _HTTPError(Exception):
@@ -294,15 +298,27 @@ class _EventServiceHandler(JsonHTTPHandler):
         raw = self._body
         try:
             obj = json.loads(raw.decode("utf-8"))
-            if isinstance(obj, dict):
-                self._apply_idempotency_key(obj, app_id)
+            if not isinstance(obj, dict):
+                raise EventValidationError("event body must be a JSON object")
+            self._apply_idempotency_key(obj, app_id)
             event = Event.from_json_dict(obj)
             validate_event(event)
-        except (ValueError, KeyError, EventValidationError) as exc:
+        except (
+            ValueError,
+            KeyError,
+            TypeError,
+            AttributeError,
+            EventValidationError,
+        ) as exc:
             # MalformedRequestContentRejection → 400 (EventAPI.scala:135-137)
+            self.server._observe_quality(app_id)
             self._respond(400, {"message": str(exc)})
             return
         event_id = self.server.events.insert(event, app_id)
+        # quality accounting only AFTER the store accepted the event: a
+        # storage outage (500s + client retries) must not feed the mix
+        # window or auto-pin a baseline from traffic that was never kept
+        self.server._observe_quality(app_id, event)
         status = 201
         if self.server.stats_tracker is not None:
             self.server.stats_tracker.bookkeeping(app_id, status, event)
@@ -328,12 +344,22 @@ class _EventServiceHandler(JsonHTTPHandler):
         valid: list = []  # (position, event)
         for pos, obj in enumerate(objs):
             try:
-                if isinstance(obj, dict):
-                    self._apply_idempotency_key(obj, app_id)
+                if not isinstance(obj, dict):
+                    raise EventValidationError(
+                        "event must be a JSON object"
+                    )
+                self._apply_idempotency_key(obj, app_id)
                 event = Event.from_json_dict(obj)
                 validate_event(event)
                 valid.append((pos, event))
-            except (ValueError, KeyError, TypeError, EventValidationError) as exc:
+            except (
+                ValueError,
+                KeyError,
+                TypeError,
+                AttributeError,
+                EventValidationError,
+            ) as exc:
+                self.server._observe_quality(app_id)
                 results[pos] = {"status": 400, "message": str(exc)}
         if valid:
             from ..storage.event import with_event_id
@@ -355,6 +381,10 @@ class _EventServiceHandler(JsonHTTPHandler):
                 self.server.events.write_new(fresh, app_id)
             if upserts:
                 self.server.events.write(upserts, app_id)
+            # quality accounting only AFTER the batched writes landed
+            # (same stored-events-only discipline as the single path)
+            for _pos, event in valid:
+                self.server._observe_quality(app_id, event)
             if self.server.stats_tracker is not None:
                 for _pos, event in valid:
                     self.server.stats_tracker.bookkeeping(app_id, 201, event)
@@ -444,6 +474,33 @@ class EventServer(BackgroundHTTPServer):
             _EventServiceHandler,
             tracer=Tracer("event-server"),
         )
+        # Ingest data-quality plane (docs/observability.md#quality):
+        # per-app schema/range/poison counters + event-type mix PSI vs a
+        # durable per-app baseline, on this server's /metrics.
+        import os as _os
+
+        from ..obs.quality import IngestQualityMonitor
+
+        self.quality = IngestQualityMonitor(
+            self.metrics,
+            clock=self.metrics.clock,
+            baseline_dir=(
+                config.quality_dir or _os.environ.get("PIO_QUALITY_DIR")
+            ),
+        )
+
+    def _observe_quality(self, app_id: int, event=None) -> None:
+        """Quality accounting, swallowed on error: the serving path's
+        'observability must never fail a query' discipline — a monitor
+        fault after the store committed would turn a stored event into
+        a client-visible 500 (and an SDK retry into a duplicate)."""
+        try:
+            if event is None:
+                self.quality.record_rejected(app_id)
+            else:
+                self.quality.record_event(app_id, event)
+        except Exception:
+            logger.debug("ingest quality accounting failed", exc_info=True)
 
 
 def create_event_server(

@@ -160,6 +160,12 @@ class ContinuousController:
         self.watcher = FeedWatcher(
             feed, config.app_id, config.event_values, state_dir
         )
+        # Feedback join (docs/observability.md#quality): every accepted
+        # delta event is a user acting on an item — the quality monitor
+        # records whether that item was in the user's last served list
+        # (hit-rate + served-rank), the loop's real online-quality
+        # number next to the offline divergence gate.
+        self.watcher.on_event = self._observe_feedback
         self._lock = threading.Lock()
         self._ticking = False  # single-tick gate (flag, not a held lock:
         # a tick trains models — nothing may block behind it)
@@ -225,6 +231,14 @@ class ContinuousController:
                 }
             ).encode(),
         )
+
+    def _observe_feedback(self, event) -> None:
+        """Watcher tap (outside the watcher lock): join one feedback
+        event to the served-list LRU. Never raises — the watcher
+        swallows, but a monitor-less server must not even log."""
+        quality = getattr(self.server, "quality", None)
+        if quality is not None:
+            quality.record_feedback(event.user, event.item)
 
     # -- gauge callbacks (scrape threads: lock every shared read) ---------
     def _candidate_age_s(self) -> float:
@@ -746,6 +760,8 @@ class ContinuousController:
         live baseline actually served (``docs/continuous.md#offline-
         scoring``). No feedback yet → the gate abstains (the rollout's
         own shadow stage still guards)."""
+        from ..obs.quality import scores_from_result
+        from ..obs.sketch import QuantileSketch
         from ..rollout.plan import BASELINE, prediction_divergence
         from ..storage.events import EventFilter
         from ..workflow.serving import (
@@ -756,6 +772,25 @@ class ContinuousController:
         )
 
         out: dict = {"samples": 0, "ok": True}
+        # offline score-drift check (docs/observability.md#quality): the
+        # candidate's replayed score distribution vs the quality
+        # monitor's pinned baseline, gated by the same max_score_psi the
+        # rollout gates carry — a drifted candidate is quarantined before
+        # submission, not after burning a shadow stage
+        quality = getattr(self.server, "quality", None)
+        max_psi = 0.0
+        try:
+            max_psi = float(
+                (self.config.rollout_gates or {}).get("max_score_psi", 0.0)
+                or 0.0
+            )
+        except (TypeError, ValueError):
+            max_psi = 0.0
+        score_sketch = (
+            QuantileSketch(rel_err=quality.config.rel_err)
+            if quality is not None
+            else None
+        )
         with self.server.tracer.span("continuous.score"):
             try:
                 events = list(
@@ -805,11 +840,14 @@ class ContinuousController:
                         )
                     ]
                     replayed = cand_dep.serving.serve(query, predictions)
+                    replayed_enc = encode_result(replayed)
                     divergences.append(
-                        prediction_divergence(
-                            served, encode_result(replayed)
-                        )
+                        prediction_divergence(served, replayed_enc)
                     )
+                    if score_sketch is not None:
+                        score_sketch.extend(
+                            scores_from_result(replayed_enc)[1]
+                        )
                 except Exception:
                     divergences.append(1.0)  # an unservable query is a
                     # maximal divergence, not a scoring crash
@@ -827,6 +865,21 @@ class ContinuousController:
                         f"{self.config.max_offline_divergence:.4f} over "
                         f"{len(divergences)} replayed queries"
                     )
+            if (
+                out["ok"]
+                and score_sketch is not None
+                and score_sketch.count
+            ):
+                psi_value = quality.psi_for_sketch(score_sketch)
+                if psi_value is not None:
+                    out["scorePsi"] = round(psi_value, 6)
+                    if max_psi > 0 and psi_value > max_psi:
+                        out["ok"] = False
+                        out["reason"] = (
+                            f"offline score PSI {psi_value:.4f} exceeds "
+                            f"{max_psi:.4f} vs the baseline score "
+                            "distribution"
+                        )
             return out
 
     # -- status -----------------------------------------------------------
@@ -847,11 +900,15 @@ class ContinuousController:
     def status(self) -> dict:
         """The ``GET /continuous.json`` / ``pio continuous status`` body."""
         state = self.state()
-        # watcher reads take the watcher's own lock; keep them outside
+        # watcher/quality reads take their own locks; keep them outside
         # the controller lock (one lock at a time, no ordering to get
         # wrong)
         feed_lag = self.watcher.feed_lag()
         pending = self.watcher.pending_count()
+        quality = getattr(self.server, "quality", None)
+        online_quality = (
+            quality.online_quality() if quality is not None else None
+        )
         with self._lock:
             out: dict = {
                 "enabled": True,
@@ -864,6 +921,10 @@ class ContinuousController:
                 "cycles": self._cycles,
                 "quarantined": list(self._quarantined),
             }
+            if online_quality is not None:
+                # the feedback join's hit-rate / served-rank digest —
+                # the loop's online-quality number next to divergence
+                out["onlineQuality"] = online_quality
             if self._candidate is not None:
                 out["candidate"] = dict(self._candidate)
             if self._last_cycle is not None:

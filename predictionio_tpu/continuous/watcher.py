@@ -181,6 +181,14 @@ class FeedWatcher:
         self.last_seq = self.cursor_seq  # feed head, as last observed
         self._pending: List[DeltaEvent] = []
         self.skipped_events = 0  # malformed/undecodable, counted not fatal
+        #: optional per-event tap, called OUTSIDE the watcher lock for
+        #: every freshly accepted delta event — the continuous controller
+        #: wires it to the quality monitor's feedback join
+        #: (docs/observability.md#quality). Exceptions are swallowed: an
+        #: observer must never wedge the feed. A restart may replay the
+        #: uncommitted suffix through the tap once (same contract as the
+        #: fold itself: resumed, possibly re-observed, never lost).
+        self.on_event = None
 
     # -- durable cursor ---------------------------------------------------
     def _load_cursor(self) -> None:
@@ -285,6 +293,15 @@ class FeedWatcher:
                 )
                 added += len(fresh)
                 caught_up = not changes or self.position >= self.last_seq
+            tap = self.on_event
+            if tap is not None:
+                for event in fresh:  # outside the lock: observer code
+                    try:
+                        tap(event)
+                    except Exception:
+                        logger.debug(
+                            "continuous: on_event tap failed", exc_info=True
+                        )
             if caught_up:
                 break
         return added

@@ -42,6 +42,7 @@ __all__ = [
     "load_bench_history",
     "load_ledger",
     "make_record",
+    "quality_records",
     "render_trend",
 ]
 
@@ -131,7 +132,10 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
         phases=bench.get("bucketize_stage_phases_s"),
         extra={
             key: bench[key]
-            for key in ("iterations", "nnz", "error", "jit", "servingFleet")
+            for key in (
+                "iterations", "nnz", "error", "jit", "servingFleet",
+                "quality",
+            )
             if key in bench
         },
     )
@@ -199,6 +203,52 @@ def fleet_records(bench: dict, source: str = "bench") -> List[dict]:
                 device=bench.get("device"),
                 scale=fleet.get("replicas"),
                 extra={"sharded": bool(fleet.get("sharded"))},
+            )
+        )
+    return out
+
+
+def quality_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The model-quality numbers a bench run attached
+    (``bench["quality"]``, from the in-process feedback-stream drill —
+    docs/observability.md#quality) as their own trend records, so
+    ``pio perf trend`` shows the quality trajectory alongside latency:
+
+    - ``quality_score_psi`` — the live score distribution's PSI vs the
+      drill's pinned baseline (unit ``psi``, trend-only: PSI is not a
+      lower-is-better wall-clock, and small-sample drill PSI is too
+      noisy to gate; the serving-time gate lives in the rollout plane);
+    - ``quality_feedback_hitrate`` — the feedback join's hit-rate (unit
+      ``ratio``, trend-only for the same reason).
+
+    A drill that failed (``ok`` false) records nothing."""
+    quality = bench.get("quality")
+    if not isinstance(quality, dict) or not quality.get("ok", True):
+        return []
+    out: List[dict] = []
+    score_psi = quality.get("scorePsi")
+    if isinstance(score_psi, (int, float)):
+        out.append(
+            make_record(
+                source=source,
+                metric="quality_score_psi",
+                value=float(score_psi),
+                unit="psi",
+                device=bench.get("device"),
+            )
+        )
+    hit_rate = quality.get("feedbackHitRate")
+    if isinstance(hit_rate, (int, float)):
+        out.append(
+            make_record(
+                source=source,
+                metric="quality_feedback_hitrate",
+                value=float(hit_rate),
+                unit="ratio",
+                device=bench.get("device"),
+                extra={
+                    "samples": quality.get("feedbackSamples"),
+                },
             )
         )
     return out

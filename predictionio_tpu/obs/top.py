@@ -170,6 +170,40 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
     # router had to retry on another replica — the fleet-failover pulse
     row["backends_up"] = _series_sum(m, "pio_router_backends_up")
     row["router_retries"] = _series_sum(m, "pio_router_retries_total")
+    # quality plane (docs/observability.md#quality): the live model's
+    # served-score drift vs its pinned baseline, and the feedback join's
+    # hit-rate; event-server nodes show their worst per-app mix PSI in
+    # the same DRIFT column (one drift number per node, whatever the
+    # node's plane)
+    row["score_psi"] = _series_sum(
+        m, "pio_quality_score_psi", variant="baseline"
+    )
+    if row["score_psi"] is not None and row["score_psi"] < 0:
+        row["score_psi"] = None  # -1 sentinel: the monitor is abstaining
+    if row["score_psi"] is None:
+        mix = [
+            value
+            for _labels, value in m.get("pio_quality_event_mix_psi") or []
+            if value >= 0  # -1 sentinel: that app's mix is abstaining
+        ]
+        if mix:
+            row["score_psi"] = max(mix)
+    row["hit_rate"] = _series_sum(m, "pio_quality_feedback_hit_rate")
+    joined = (
+        _series_sum(
+            m, "pio_quality_feedback_events_total", outcome="hit"
+        )
+        or 0
+    ) + (
+        _series_sum(
+            m, "pio_quality_feedback_events_total", outcome="miss"
+        )
+        or 0
+    )
+    if not joined:
+        # the rate is over JOINED events only — a backlog of unjoined
+        # feedback must not read as a measured 0.00 hit-rate
+        row["hit_rate"] = None
     return row
 
 
@@ -191,6 +225,8 @@ _COLUMNS = (
     ("RETRACE", "jit_retraces", "{:.0f}"),
     ("BACKENDS", "backends_up", "{:.0f}"),
     ("RTRETRY", "router_retries", "{:.0f}"),
+    ("DRIFT", "score_psi", "{:.3f}"),
+    ("HITRATE", "hit_rate", "{:.2f}"),
 )
 
 #: public alias for other fleet renderers (the dashboard's /fleet panel)

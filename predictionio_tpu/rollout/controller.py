@@ -156,6 +156,15 @@ class RolloutController:
         self._divergence: Deque[Tuple[float, float]] = deque(maxlen=4096)
         self._div_lock = threading.Lock()
         self.stage_started = clock()
+        #: optional served-score drift source for the ``max_score_psi``
+        #: gate: a zero-arg callable returning the candidate's current
+        #: PSI vs the quality monitor's pinned baseline, or None while
+        #: there is not enough data (the gate abstains on None). The
+        #: RolloutManager wires this to
+        #: ``QualityMonitor.score_psi("candidate")`` — kept as an
+        #: injected callable so the gate logic stays testable without a
+        #: monitor (docs/observability.md#quality).
+        self.quality_psi: Optional[Callable[[], Optional[float]]] = None
 
     # -- sample intake ----------------------------------------------------
     def record(self, variant_is_candidate: bool, latency_s: float, ok: bool) -> None:
@@ -223,6 +232,19 @@ class RolloutController:
                     return ROLLBACK, (
                         f"mean shadow divergence {mean_div:.4f} exceeds "
                         f"{g.max_divergence:.4f}"
+                    )
+            if g.max_score_psi > 0 and self.quality_psi is not None:
+                # score-distribution drift (both stages: shadow answers
+                # feed the candidate sketch too, so a skewed candidate
+                # rolls back before it ever serves a user). Abstains on
+                # None — "not enough data" must hold, never promote a
+                # drift verdict either way.
+                score_psi = self.quality_psi()
+                if score_psi is not None and score_psi > g.max_score_psi:
+                    return ROLLBACK, (
+                        f"candidate score PSI {score_psi:.4f} exceeds "
+                        f"{g.max_score_psi:.4f} vs the baseline score "
+                        "distribution"
                     )
 
         if cand_n < g.min_samples:

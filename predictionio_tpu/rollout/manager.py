@@ -111,6 +111,12 @@ class RolloutManager:
         )
         self._shadow_pending = 0
         self._shadow_futures: Deque = deque(maxlen=256)
+        #: max_score_psi gate cache: score_psi() merges full sketch
+        #: copies, too heavy for every observe() — recomputed every
+        #: _PSI_RECHECK_EVERY evaluates (count-based, not TTL: injected
+        #: test clocks only advance when driven)
+        self._psi_cached: Optional[float] = None
+        self._psi_countdown = 0
 
         metrics = server.metrics
         self._hist = metrics.histogram(
@@ -270,6 +276,15 @@ class RolloutManager:
             self.plan = dataclasses.replace(plan, id=pid)
             self.candidate_dep = candidate_dep
             self.controller = RolloutController(gate_cfg, clock=self.clock)
+            self.controller.quality_psi = self._candidate_score_psi
+            # a fresh rollout must judge THIS candidate's distribution:
+            # drop any previous (possibly rolled-back-for-drift)
+            # candidate's scores still inside the rolling window
+            quality = getattr(self.server, "quality", None)
+            if quality is not None:
+                quality.reset_variant(CANDIDATE)
+            self._psi_cached = None  # never judge THIS candidate by the
+            self._psi_countdown = 0  # last one's cached drift
             self._persist_pending = False
             self._transitions.inc(1, to=ROLLOUT_SHADOW)
             logger.info(
@@ -363,6 +378,7 @@ class RolloutManager:
             self.plan = plan
             self.candidate_dep = candidate_dep
             self.controller = RolloutController(gate_cfg, clock=self.clock)
+            self.controller.quality_psi = self._candidate_score_psi
             logger.info(
                 "rollout %s resumed at stage %s (candidate %s)",
                 plan.id, plan.stage, plan.candidate_instance_id,
@@ -452,6 +468,26 @@ class RolloutManager:
     def candidate_deployment(self):
         return self.candidate_dep
 
+    _PSI_RECHECK_EVERY = 16
+
+    def _candidate_score_psi(self):
+        """The ``max_score_psi`` gate's drift source: the candidate's
+        served-score PSI off the server's quality monitor, None while
+        there is not enough data (docs/observability.md#quality). Pure
+        read — safe from evaluate() under the manager lock because the
+        monitor takes only its own lock and never blocks. The value is
+        recomputed every ``_PSI_RECHECK_EVERY`` evaluates: score_psi()
+        merges full sketch copies, and drift moves on window
+        timescales, not per request."""
+        quality = getattr(self.server, "quality", None)
+        if quality is None:
+            return None
+        self._psi_countdown -= 1
+        if self._psi_countdown < 0:
+            self._psi_cached = quality.score_psi(CANDIDATE)
+            self._psi_countdown = self._PSI_RECHECK_EVERY - 1
+        return self._psi_cached
+
     def observe(self, variant: str, latency_s: float, ok: bool) -> None:
         """Record one served request and re-evaluate the gates."""
         with self._lock:
@@ -491,9 +527,10 @@ class RolloutManager:
                 return None
             self._shadow_pending += 1
             dep = self.candidate_dep
+            plan_id = self.plan.id
         try:
             future = self._shadow_pool.submit(
-                self._run_shadow, dep, payload, baseline_result
+                self._run_shadow, dep, payload, baseline_result, plan_id
             )
         except RuntimeError:  # pool shut down mid-stop
             with self._lock:
@@ -513,11 +550,16 @@ class RolloutManager:
         while True:
             with self._lock:
                 if not self._shadow_futures:
+                    # a deterministic drain exists so the NEXT gate read
+                    # sees every drained score — drop the cached PSI or
+                    # the post-drain evaluate can return a stale None
+                    # for up to _PSI_RECHECK_EVERY more requests
+                    self._psi_countdown = 0
                     return
                 future = self._shadow_futures.popleft()
             future.result(timeout=timeout_s)
 
-    def _run_shadow(self, dep, payload, baseline_result) -> None:
+    def _run_shadow(self, dep, payload, baseline_result, plan_id) -> None:
         t0 = self.clock()
         divergence: Optional[float] = None
         ok = False
@@ -527,9 +569,27 @@ class RolloutManager:
             _query, prediction = self.server._serve_one(
                 dep, payload, None, CANDIDATE
             )
-            divergence = prediction_divergence(
-                baseline_result, encode_result(prediction)
-            )
+            encoded = encode_result(prediction)
+            divergence = prediction_divergence(baseline_result, encoded)
+            # the candidate's answers feed its score sketch even though
+            # no client saw them: the max_score_psi gate can catch a
+            # skewed candidate while it is still shadow-only
+            # (docs/observability.md#quality). Only while OUR plan is
+            # still the active one: a stale task from a rolled-back
+            # rollout must not re-contaminate the window start() reset
+            # for the next candidate — checked and recorded under the
+            # ONE manager lock, or a rollback + next start() could slip
+            # between an unlocked check and the record. (Safe to hold:
+            # manager→monitor is the established ordering, and a
+            # CANDIDATE record never writes a snapshot, so no I/O.)
+            quality = getattr(self.server, "quality", None)
+            if quality is not None:
+                from ..obs.quality import scores_from_result
+
+                scores = scores_from_result(encoded)[1]
+                with self._lock:
+                    if self.active and self.plan.id == plan_id:
+                        quality.record_scores(CANDIDATE, scores)
             ok = True
         except Exception:
             logger.debug("shadow candidate query failed", exc_info=True)

@@ -34,6 +34,8 @@ import hashlib
 import json
 from typing import Any, Dict, Iterator, Tuple
 
+from ..obs.quality import USER_KEY_FIELDS
+
 __all__ = [
     "BASELINE",
     "CANDIDATE",
@@ -63,17 +65,12 @@ NUM_BUCKETS = 10_000
 _BUCKETS = NUM_BUCKETS
 
 #: payload fields tried (in order) as the sticky entity key before
-#: falling back to the whole canonicalized payload
-_ENTITY_KEY_FIELDS = (
-    "user",
-    "userId",
-    "user_id",
-    "uid",
-    "entityId",
-    "entity_id",
-    "item",
-    "id",
-)
+#: falling back to the whole canonicalized payload. The user-identity
+#: prefix is the feedback join's field order too — shared from ONE home
+#: (obs.quality, stdlib-only) or the served-list and feedback keys
+#: silently diverge; item/id are sticky-only fallbacks for payloads
+#: with no user field.
+_ENTITY_KEY_FIELDS = USER_KEY_FIELDS + ("item", "id")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +97,16 @@ class GateConfig:
     canary_hold_s: float = 120.0
     #: traffic share the candidate takes in the CANARY stage
     canary_percent: float = 10.0
+    #: served-score distribution drift gate (docs/observability.md#quality):
+    #: roll back when the candidate's score PSI vs the pinned baseline
+    #: snapshot exceeds this. 0 disables (the default — PSI needs the
+    #: quality monitor's min_psi_samples on both sides before it reports,
+    #: and an engine whose predictions carry no scores never reports).
+    #: Unlike the other gates this one is an absolute distribution
+    #: distance, not a delta: PSI is already measured against the live
+    #: baseline's own distribution. Conventional reading: <0.1 stable,
+    #: >0.25 a real shift.
+    max_score_psi: float = 0.0
 
     def to_dict(self) -> Dict[str, float]:
         return {

@@ -549,6 +549,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pp.add_argument("perf_args", nargs=argparse.REMAINDER)
 
+    qa = sub.add_parser(
+        "quality",
+        help="model & data quality report: score drift (PSI), feedback "
+        "hit-rate, ingest mix — from a live /metrics scrape or the "
+        "quality-snapshot ledger; `--diff` is the CI drift gate "
+        "(docs/observability.md#quality)",
+        # the quality CLI owns its option surface (tools/quality.py)
+        add_help=False,
+    )
+    qa.add_argument("quality_args", nargs=argparse.REMAINDER)
+
     tr = sub.add_parser(
         "trace",
         help="stitch one X-PIO-Trace id's spans across a node list "
@@ -692,6 +703,14 @@ def main(
 
         tail = list(sys.argv[2:] if argv is None else argv[1:])
         return lint_mod.main(tail)
+    if head == ["quality"]:
+        # forwarded verbatim like lint/perf: the quality CLI owns its
+        # whole option surface (tools/quality.py) and needs neither the
+        # storage plane nor jax — a pure scraper/snapshot reader.
+        from . import quality as quality_mod
+
+        tail = list(sys.argv[2:] if argv is None else argv[1:])
+        return quality_mod.main(tail)
     if head in (["profile"], ["perf"]):
         # same REMAINDER limitation as lint: these CLIs own their whole
         # option surface (tools/perf.py), so forward verbatim. `perf`

@@ -37,6 +37,18 @@ def _fmt_time(dt) -> str:
     return dt.strftime("%Y-%m-%d %H:%M:%S")
 
 
+def _page(title: str, body: str) -> str:
+    """Shared page skeleton for every dashboard panel — one place for
+    the doctype and style block so the panels cannot drift visually."""
+    return (
+        f"<!DOCTYPE html><html><head><title>{html.escape(title)}</title>"
+        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+        + body
+        + "</body></html>"
+    )
+
+
 def render_index(instances) -> str:
     """The main listing page (``Dashboard.scala`` index route)."""
     rows = []
@@ -54,15 +66,13 @@ def render_index(instances) -> str:
             f'<a href="/engine_instances/{inst.id}/evaluator_results.json">JSON</a></td>'
             "</tr>"
         )
-    return (
-        "<!DOCTYPE html><html><head><title>PredictionIO-TPU Dashboard</title>"
-        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
-        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+    return _page(
+        "PredictionIO-TPU Dashboard",
         "<h1>Completed evaluations</h1>"
         "<table><tr><th>ID</th><th>Evaluation</th><th>Params generator</th>"
         "<th>Batch</th><th>Start</th><th>End</th><th>Result</th><th>Detail</th></tr>"
         + "".join(rows)
-        + "</table></body></html>"
+        + "</table>",
     )
 
 
@@ -91,15 +101,13 @@ def render_train_runs(instances) -> str:
             f"<td>{html.escape(phase_text)}</td>"
             "</tr>"
         )
-    return (
-        "<!DOCTYPE html><html><head><title>Train runs</title>"
-        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
-        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+    return _page(
+        "Train runs",
         "<h1>Train runs</h1>"
         "<table><tr><th>ID</th><th>Status</th><th>Engine</th>"
         "<th>Start</th><th>End</th><th>Train phases</th></tr>"
         + "".join(rows)
-        + "</table></body></html>"
+        + "</table>",
     )
 
 
@@ -123,16 +131,14 @@ def render_rollouts(plans) -> str:
             f"<td>{html.escape(str(last.get('reason', '-')))}</td>"
             "</tr>"
         )
-    return (
-        "<!DOCTYPE html><html><head><title>Rollouts</title>"
-        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
-        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+    return _page(
+        "Rollouts",
         "<h1>Rollouts</h1>"
         "<table><tr><th>ID</th><th>Stage</th><th>Engine</th>"
         "<th>Baseline</th><th>Candidate</th><th>Canary %</th>"
         "<th>Updated</th><th>Last transition</th></tr>"
         + "".join(rows)
-        + "</table></body></html>"
+        + "</table>",
     )
 
 
@@ -180,15 +186,63 @@ def render_fleet(rows) -> str:
         + "</tr>"
         for row in rows
     ]
-    return (
-        "<!DOCTYPE html><html><head><title>Fleet</title>"
-        "<style>body{font-family:sans-serif}table{border-collapse:collapse}"
-        "td,th{border:1px solid #ccc;padding:4px 8px}</style></head><body>"
+    return _page(
+        "Fleet",
         "<h1>Fleet</h1>"
         f"<table><tr>{header}</tr>" + "".join(body) + "</table>"
         "<p>FEEDLAG/CANDAGE: continuous-learning freshness; "
-        "JITC/RETRACE: jit compiles / new-signature retraces.</p>"
-        "</body></html>"
+        "JITC/RETRACE: jit compiles / new-signature retraces.</p>",
+    )
+
+
+def render_quality(rows) -> str:
+    """``GET /quality``: per-node quality digest — score drift (PSI vs
+    the pinned baseline), feedback hit-rate, ingest mix drift and
+    violation counts (docs/observability.md#quality)."""
+
+    def fmt(value, spec="{:.4f}"):
+        return "-" if value is None else spec.format(value)
+
+    body = []
+    for row in rows:
+        if not row.get("up"):
+            body.append(
+                f"<tr><td>{html.escape(str(row.get('node', '?')))}</td>"
+                "<td colspan=\"5\">DOWN</td></tr>"
+            )
+            continue
+        psi = row.get("scorePsi") or {}
+        feedback = row.get("feedback") or {}
+        ingest = row.get("ingest") or {}
+        mix = " ".join(
+            f"{app}:{fmt(stats.get('mixPsi'))}"
+            for app, stats in sorted(ingest.items())
+        )
+        violations = sum(
+            n
+            for stats in ingest.values()
+            for n in (stats.get("violations") or {}).values()
+        )
+        body.append(
+            "<tr>"
+            f"<td>{html.escape(str(row.get('node', '?')))}</td>"
+            f"<td>{fmt(psi.get('baseline'))}</td>"
+            f"<td>{fmt(psi.get('candidate'))}</td>"
+            f"<td>{fmt(feedback.get('hitRate'), '{:.3f}')}</td>"
+            f"<td>{html.escape(mix) or '-'}</td>"
+            f"<td>{violations if ingest else '-'}</td>"
+            "</tr>"
+        )
+    return _page(
+        "Quality",
+        "<h1>Quality</h1>"
+        "<table><tr><th>NODE</th><th>PSI baseline</th>"
+        "<th>PSI candidate</th><th>HITRATE</th><th>MIX PSI</th>"
+        "<th>VIOLATIONS</th></tr>" + "".join(body) + "</table>"
+        "<p>PSI: served-score drift vs the baseline snapshot pinned at "
+        "model LIVE; HITRATE: feedback items found in the user's served "
+        "list; MIX PSI: per-app event-type mix drift at ingest "
+        "(docs/observability.md#quality).</p>",
     )
 
 
@@ -244,6 +298,15 @@ class _DashboardHandler(JsonHTTPHandler):
                     200, render_fleet(rows), content_type="text/html"
                 )
             return
+        if path in ("/quality", "/quality.json"):
+            rows = self.server.quality_rows()
+            if path == "/quality.json":
+                self.respond(200, rows)
+            else:
+                self.respond(
+                    200, render_quality(rows), content_type="text/html"
+                )
+            return
         parts = [p for p in path.split("/") if p]
         if len(parts) == 3 and parts[0] == "engine_instances":
             inst = md.evaluation_instance_get(parts[1])
@@ -271,14 +334,13 @@ class DashboardServer(BackgroundHTTPServer):
         self.registry = registry
         super().__init__((config.ip, config.port), _DashboardHandler)
 
-    def fleet_rows(self) -> list:
-        """Scrape the configured node list for the /fleet panel (a dead
-        node renders DOWN). Nodes are scraped concurrently, so the page
-        answers in ~one ``scrape_timeout_s`` even with the whole fleet
-        down — not nodes × timeout."""
+    def _scrape_nodes(self, per_node) -> list:
+        """Run ``per_node(node, timeout)`` over the configured node list
+        concurrently, so a panel answers in ~one ``scrape_timeout_s``
+        even with the whole fleet down — not nodes × timeout."""
         from concurrent.futures import ThreadPoolExecutor
 
-        from ..obs.top import DEFAULT_NODES, node_row
+        from ..obs.top import DEFAULT_NODES
 
         nodes = [
             node.strip()
@@ -290,12 +352,31 @@ class DashboardServer(BackgroundHTTPServer):
         with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as pool:
             return list(
                 pool.map(
-                    lambda node: node_row(
+                    lambda node: per_node(
                         node, timeout=self.config.scrape_timeout_s
                     ),
                     nodes,
                 )
             )
+
+    def fleet_rows(self) -> list:
+        """Scrape the configured node list for the /fleet panel (a dead
+        node renders DOWN)."""
+        from ..obs.top import node_row
+
+        return self._scrape_nodes(node_row)
+
+    def quality_rows(self) -> list:
+        """Scrape the node list for the /quality panel."""
+        from .quality import node_report
+
+        def scrape(node: str, timeout: float) -> dict:
+            report = node_report(node, timeout=timeout)
+            return report if report is not None else {
+                "node": node, "up": False,
+            }
+
+        return self._scrape_nodes(scrape)
 
 
 def create_dashboard(

@@ -526,6 +526,220 @@ def run_rollout_chaos(
         server.server_close()
 
 
+def run_score_drift(
+    queries: int = 60,
+    n_users: int = 16,
+    n_items: int = 12,
+    skew: float = 4.0,
+    max_score_psi: float = 0.25,
+    base_dir: Optional[str] = None,
+    on_live=None,
+) -> dict:
+    """Score-drift chaos scenario (``--score-drift``,
+    docs/observability.md#quality).
+
+    The quality plane's acceptance proof: a candidate whose *score
+    distribution* is skewed — trained on ratings scaled by ``skew``, so
+    every prediction is a perfectly well-formed answer with ~``skew``×
+    the magnitude — would sail through every pre-existing gate (it never
+    errors, its latency is normal, and the divergence gate is disabled
+    here exactly because divergence has its own tests and would mask the
+    signal under test). The drill asserts the ``max_score_psi`` gate
+    alone catches it:
+
+    - baseline traffic pins the quality monitor's score snapshot;
+    - the skewed candidate enters SHADOW behind the rollout plane; its
+      shadow answers feed the candidate sketch;
+    - the PSI gate **auto-rolls back** with **zero** client-visible
+      failures (clients only ever saw baseline answers);
+    - the terminal ``ROLLED_BACK`` plan is durable, and a *restarted*
+      server quarantines the drifted candidate — it re-serves the
+      plan's baseline even though the candidate is the latest completed
+      instance.
+
+    ``on_live(server)`` (optional) runs after the rollback while the
+    server's HTTP surface is still up — the tier-1 test scrapes
+    ``pio quality --node`` through it.
+    """
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..controller import WorkflowParams
+    from ..controller.engine import EngineParams
+    from ..models.recommendation import (
+        ALSAlgorithmParams,
+        RecDataSourceParams,
+        engine_factory,
+    )
+    from ..obs.quality import QualityConfig
+    from ..storage import DataMap, Event, StorageRegistry
+    from ..testing.clock import FakeClock
+    from ..workflow.core_workflow import run_train
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    tmp = base_dir or tempfile.mkdtemp(prefix="pio-score-drift-")
+    owns_tmp = base_dir is None
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": tmp})
+    prev_registry = regmod._default_registry
+    regmod._default_registry = registry  # RecDataSource reads through it
+    report: dict = {"mode": "score-drift", "clientFailures": 0,
+                    "skew": skew, "maxScorePsi": max_score_psi}
+    server = restarted = None
+    try:
+        app_id = 1
+        events_store = registry.get_events()
+        events_store.init(app_id)
+
+        def seed(scale: float) -> List:
+            # fresh rng per call: baseline and candidate must sample the
+            # SAME (u, i) subset — the drill's premise is a pure
+            # distribution shift, not a data change
+            rng = np.random.default_rng(13)
+            return [
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": scale
+                         * (5.0 if (u % 3) == (i % 3) else 2.0)}
+                    ),
+                )
+                for u in range(n_users)
+                for i in range(n_items)
+                if rng.random() < 0.8
+            ]
+
+        engine = engine_factory()
+        ep = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=app_id)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
+            ],
+        )
+        events_store.write(seed(1.0), app_id)
+        baseline_id = run_train(
+            engine, ep, registry,
+            workflow_params=WorkflowParams(batch="drift-baseline"),
+        )
+        # the skewed candidate: SAME interactions, ratings × skew — its
+        # learned factors reproduce the scaled matrix, so every score it
+        # serves is ~skew× the baseline's (a pure distribution shift)
+        events_store.remove(app_id)
+        events_store.init(app_id)
+        events_store.write(seed(skew), app_id)
+        candidate_id = run_train(
+            engine, ep, registry,
+            workflow_params=WorkflowParams(batch="drift-candidate"),
+        )
+        report["baselineInstanceId"] = baseline_id
+        report["candidateInstanceId"] = candidate_id
+
+        clock = FakeClock()
+        server = QueryServer(
+            ServerConfig(
+                ip="127.0.0.1", port=0, batching=False,
+                engine_instance_id=baseline_id,
+                quality=QualityConfig(
+                    pin_min_samples=40, min_psi_samples=40,
+                    window_s=1e9,
+                    # pinned under the drill dir: an ambient
+                    # PIO_QUALITY_SNAPSHOTS must never collect this
+                    # deliberately skewed toy model's snapshots
+                    snapshot_path=tmp + "/quality-snapshots.jsonl",
+                ),
+            ),
+            engine, registry, clock=clock,
+        )
+        server.start_background()
+
+        def drive(n: int) -> dict:
+            counts: dict = {}
+            for i in range(n):
+                info: dict = {}
+                try:
+                    _result, http_status = server.handle_query(
+                        {"user": f"u{i % n_users}", "num": 5}, info=info
+                    )
+                    if http_status != 200:
+                        report["clientFailures"] += 1
+                except Exception:
+                    report["clientFailures"] += 1
+                variant = info.get("variant", "-")
+                counts[variant] = counts.get(variant, 0) + 1
+            return counts
+
+        drive(queries // 3)  # pin the baseline score distribution
+        report["pinnedBeforeRollout"] = server.quality.pinned()
+
+        server.rollout.start(
+            candidate_instance_id=candidate_id,
+            gates={
+                "min_samples": 10,
+                "window_s": 1e9,
+                "shadow_hold_s": 1e9,      # PSI rolls back on its own;
+                "canary_hold_s": 1e9,      # nothing else may promote
+                "max_divergence": 1.0,     # divergence has its own tests
+                "max_p99_latency_ratio": 1e9,
+                "max_score_psi": max_score_psi,
+            },
+        )
+        report["planId"] = server.rollout.plan.id
+
+        drive(queries)                      # shadow traffic
+        server.rollout.drain_shadow()
+        drive(2)                            # one more gate evaluation
+        report["candidatePsi"] = server.quality.score_psi("candidate")
+        report["finalStage"] = server.rollout.stage
+        report["rolledBack"] = server.rollout.stage == "ROLLED_BACK"
+        plan = server.rollout.plan
+        report["rollbackReason"] = (
+            plan.history[-1].get("reason") if plan.history else None
+        )
+        post_counts = drive(queries // 3)   # after rollback
+        report["postRollbackCandidateServed"] = post_counts.get(
+            "candidate", 0
+        )
+        durable = registry.get_metadata().rollout_plan_get(report["planId"])
+        report["durableStage"] = durable.stage if durable else None
+
+        if on_live is not None:
+            on_live(server)
+
+        # restart: the drifted candidate is the LATEST COMPLETED
+        # instance, but the quarantine path must re-serve the plan's
+        # baseline instead of silently undoing the rollback
+        restarted = QueryServer(
+            ServerConfig(ip="127.0.0.1", port=0, batching=False),
+            engine, registry,
+        )
+        report["restartServes"] = restarted.deployment.instance.id
+        report["quarantined"] = (
+            restarted.deployment.instance.id == baseline_id
+        )
+
+        report["ok"] = bool(
+            report["rolledBack"]
+            and report["clientFailures"] == 0
+            and report["pinnedBeforeRollout"]
+            and report["postRollbackCandidateServed"] == 0
+            and report["durableStage"] == "ROLLED_BACK"
+            and report["quarantined"]
+            and "score PSI" in (report["rollbackReason"] or "")
+        )
+        return report
+    finally:
+        regmod._default_registry = prev_registry
+        for srv in (server, restarted):
+            if srv is not None:
+                try:
+                    srv.server_close()
+                except Exception:
+                    pass
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_feedback_stream(
     total_events: int = 60,
     burst: int = 20,
@@ -642,10 +856,24 @@ def run_feedback_stream(
 
         from ..testing.clock import FakeClock
 
+        from ..obs.quality import QualityConfig
+
         clock = FakeClock()
         server = QueryServer(
             ServerConfig(
                 ip="127.0.0.1", port=0, batching=False,
+                # toy-scale monitor thresholds so the drill's quality
+                # digest (bench's record["quality"]) carries a real PSI
+                # instead of abstaining at the defaults' sample floors
+                quality=QualityConfig(
+                    pin_min_samples=20, min_psi_samples=20, window_s=1e9,
+                    # drill-local: never append to an ambient
+                    # PIO_QUALITY_SNAPSHOTS ledger (same isolation as
+                    # PIO_FS_BASEDIR via the private registry)
+                    snapshot_path=_os.path.join(
+                        tmp, "quality-snapshots.jsonl"
+                    ),
+                ),
                 continuous=ContinuousConfig(
                     app_id=app_id,
                     feed_url=primary,
@@ -681,6 +909,12 @@ def run_feedback_stream(
                 except Exception:
                     report["clientFailures"] += 1
             server.rollout.drain_shadow()
+
+        # serve everyone once BEFORE the first feedback burst: the
+        # quality monitor's feedback join can only hit items that were
+        # actually served, and this also pins the baseline score
+        # distribution from the trained model's own traffic
+        drive(n_users, start=0)
 
         posted = 0
         t_first_post = None
@@ -739,6 +973,24 @@ def run_feedback_stream(
         report["freshnessS"] = status.get("lastFreshnessS")
         if report["freshnessS"] is None and t_first_post is not None:
             report["elapsedS"] = round(time.time() - t_first_post, 3)
+        # the fold-in going LIVE re-pinned the monitor: a short post-live
+        # drive re-establishes the new model's baseline so the digest
+        # below reports a real (steady-state, ~0) PSI instead of
+        # abstaining at the sample floor
+        drive(3 * n_users, start=50_000)
+        # quality digest (docs/observability.md#quality): the drill's
+        # query server ran the full monitor — score PSI vs the baseline
+        # it pinned from its own early traffic, and the feedback join's
+        # hit-rate over the trickle the watcher tapped through
+        quality = server.quality.summary()
+        online = quality.get("online") or {}
+        report["quality"] = {
+            "ok": True,
+            "pinned": quality.get("pinned"),
+            "scorePsi": (quality.get("scorePsi") or {}).get("baseline"),
+            "feedbackHitRate": online.get("hitRate"),
+            "feedbackSamples": online.get("feedbackSamples"),
+        }
         report["ok"] = bool(
             report["freshnessS"] is not None
             and status.get("lastCycle", {}).get("outcome") == "live"
@@ -1160,6 +1412,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "shadow, promote to canary, fail the candidate, "
                         "assert auto-rollback with zero client-visible "
                         "failures and a durable ROLLED_BACK plan")
+    p.add_argument("--score-drift", action="store_true",
+                   help="score-drift chaos scenario "
+                        "(docs/observability.md#quality): skewed "
+                        "candidate behind the rollout plane; asserts "
+                        "the max_score_psi gate auto-rolls back with "
+                        "zero client failures, a durable ROLLED_BACK "
+                        "plan and restart quarantine")
+    p.add_argument("--skew", type=float, default=4.0,
+                   help="rating/score scale factor of the drifted "
+                        "candidate for --score-drift")
+    p.add_argument("--max-score-psi", type=float, default=0.25,
+                   help="PSI gate threshold for --score-drift")
     p.add_argument("--feedback-stream", action="store_true",
                    help="closed-loop freshness scenario "
                         "(docs/continuous.md): in-process storage "
@@ -1202,6 +1466,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         enable_compilation_cache()
         result = run_rollout_chaos(
             engine_dir=args.engine_dir, payload_template=args.payload
+        )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.score_drift:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_score_drift(
+            skew=args.skew, max_score_psi=args.max_score_psi
         )
         print(json.dumps(result))
         return 0 if result["ok"] else 1

@@ -294,31 +294,9 @@ class MicroBatcher:
         """Run one batch on an executor thread and fan results out. Runs
         concurrently with up to ``pipeline_depth - 1`` sibling batches."""
         dispatch_ts = self._clock()
-        try:
-            try:
-                results = self._process(items)
-                if len(results) != len(items):
-                    raise RuntimeError(
-                        f"batch processor returned {len(results)} results "
-                        f"for {len(items)} items"
-                    )
-            except Exception as exc:
-                if self._obs_failures is not None:
-                    self._obs_failures.inc(1)
-                for fut in futures:
-                    if not fut.done():
-                        fut.set_exception(exc)
-                return
-            with self._lock:
-                self._batches += 1
-            for fut, result in zip(futures, results):
-                if fut.done():
-                    continue
-                if isinstance(result, Exception):
-                    fut.set_exception(result)  # per-item failure channel
-                else:
-                    fut.set_result(result)
-        finally:
+        recorded = False
+
+        def record() -> None:
             # Metrics/spans for every executed batch, FAILED ones
             # included — an erroring device is exactly when the batch
             # signals matter, so a raise must not zero the flush counts.
@@ -334,6 +312,44 @@ class MicroBatcher:
                 )
             except Exception:
                 pass
+
+        # Observability is recorded BEFORE the result fan-out on both
+        # paths: set_result()/set_exception() unblocks the submitting
+        # thread, which may answer its client — and a client (or an e2e
+        # test) that then reads /traces.json must find this batch's
+        # spans already there. Recording after the fan-out raced exactly
+        # that read (the PR-8/9 batch-span flake).
+        try:
+            try:
+                results = self._process(items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"batch processor returned {len(results)} results "
+                        f"for {len(items)} items"
+                    )
+            except Exception as exc:
+                if self._obs_failures is not None:
+                    self._obs_failures.inc(1)
+                record()
+                recorded = True
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                return
+            with self._lock:
+                self._batches += 1
+            record()
+            recorded = True
+            for fut, result in zip(futures, results):
+                if fut.done():
+                    continue
+                if isinstance(result, Exception):
+                    fut.set_exception(result)  # per-item failure channel
+                else:
+                    fut.set_result(result)
+        finally:
+            if not recorded:  # a raise before the fan-out still records
+                record()
             with self._lock:
                 self._inflight -= 1
             self._slots.release()

@@ -143,6 +143,11 @@ class ServerConfig:
     #: fold-in controller to this server — candidates auto-submit
     #: through the rollout plane (docs/continuous.md). None = disabled.
     continuous: Optional[Any] = None
+    #: Quality-observability knobs: a ``QualityConfig``
+    #: (``predictionio_tpu/obs/quality``) for the served-score drift /
+    #: feedback-join monitor every query server carries
+    #: (docs/observability.md#quality). None = defaults.
+    quality: Optional[Any] = None
     #: Sharded-model serving (docs/fleet.md): with ``shard_count > 1``
     #: this server holds only partition ``shard_index`` of the item
     #: factors (item row ``i`` lives on shard ``i % shard_count``) and
@@ -685,6 +690,16 @@ class QueryServer(BackgroundHTTPServer):
         # tracer per server process, exposed on /metrics + /traces.json.
         metrics = MetricsRegistry(clock=clock)
         self.stats = ServingStats(metrics)
+        # Quality-observability plane (docs/observability.md#quality):
+        # per-variant served-score sketches (drift vs a baseline snapshot
+        # pinned at model LIVE) and the feedback join the continuous
+        # plane feeds — pio_quality_* on /metrics, `pio quality` reads
+        # them fleet-wide.
+        from ..obs.quality import QualityMonitor
+
+        self.quality = QualityMonitor(
+            metrics, clock=clock, config=config.quality
+        )
         # Jit boundary telemetry (docs/observability.md#profiling): the
         # process telemetry mirrors onto this registry so /metrics shows
         # pio_jit_compiles_total / pio_jit_retraces_total — bind() replays
@@ -938,6 +953,15 @@ class QueryServer(BackgroundHTTPServer):
                     )
                 raise
         result = encode_result(prediction)
+
+        # Quality plane: score distribution + the served-list record the
+        # feedback join reads. BEFORE the prId stamp, like the shadow
+        # duplicate — the signals describe the model's answer. Swallowed
+        # on error: observability must never fail a query.
+        try:
+            self.quality.observe_result(variant, payload, result)
+        except Exception:
+            logger.debug("quality observe failed", exc_info=True)
 
         # Shadow duplication BEFORE the feedback prId stamp: divergence
         # must compare model outputs, not the per-request id noise.
@@ -1230,6 +1254,13 @@ class QueryServer(BackgroundHTTPServer):
             old = self.deployment.instance.id
             self.deployment = dep
         self._export_train_phases()
+        # re-pin the quality baseline: drift must be measured against the
+        # distribution of the model NOW serving, not its predecessor's
+        # (the closing state persists as a snapshot first)
+        try:
+            self.quality.model_live(dep.instance.id)
+        except Exception:
+            logger.debug("quality re-pin failed", exc_info=True)
         logger.info(
             "Deployment swapped: engine instance %s -> %s",
             old, dep.instance.id,
@@ -1271,6 +1302,11 @@ class QueryServer(BackgroundHTTPServer):
             old = self.deployment.instance.id
             self.deployment = fresh
         self._export_train_phases()
+        # a reload is a model go-live too: re-pin the drift baseline
+        try:
+            self.quality.model_live(fresh.instance.id)
+        except Exception:
+            logger.debug("quality re-pin failed", exc_info=True)
         logger.info(
             "Reloaded: engine instance %s -> %s", old, fresh.instance.id
         )
@@ -1353,6 +1389,8 @@ class QueryServer(BackgroundHTTPServer):
             }
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
+        if getattr(self, "quality", None) is not None:
+            out["quality"] = self.quality.summary()
         if getattr(self, "rollout", None) is not None:
             out["rollout"] = self.rollout.status()
         if getattr(self, "continuous", None) is not None:

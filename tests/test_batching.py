@@ -432,6 +432,73 @@ class TestBatchedServing:
             srv.server_close()
 
 
+class TestObsRecordedBeforeFanout:
+    """Regression pin for the PR-8/9 e2e batch-span flake: metrics and
+    spans for a batch were recorded in ``_execute``'s finally block,
+    AFTER ``set_result`` unblocked the submitting thread — so a client
+    (or a test) that answered and immediately read ``/traces.json``
+    raced the recording. The fix records before the fan-out on both the
+    success and failure paths; these tests make the old ordering fail
+    deterministically instead of flakily."""
+
+    def test_obs_complete_when_submit_returns(self, monkeypatch):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        recorded = threading.Event()
+        orig = MicroBatcher._record_obs
+
+        def slow_record(self, *args, **kwargs):
+            # widen the historical race window: under the OLD ordering
+            # the submitter returns while this sleeps, turning a
+            # sometimes-flake into a certain failure
+            time.sleep(0.05)
+            orig(self, *args, **kwargs)
+            recorded.set()
+
+        monkeypatch.setattr(MicroBatcher, "_record_obs", slow_record)
+        metrics = MetricsRegistry()
+        mb = MicroBatcher(
+            lambda items: list(items), max_batch=1, max_wait_ms=0.0,
+            metrics=metrics,
+        )
+        flush = metrics.counter(
+            "pio_batch_flush_total", "Batch flushes by trigger",
+            labelnames=("reason",),
+        )
+        try:
+            for i in range(3):
+                recorded.clear()
+                assert mb.submit(i) == i
+                # the moment submit() returns, this batch's obs must
+                # already be on the registry — no drain, no sleep
+                assert recorded.is_set()
+            assert flush.value(reason="full") == 3  # max_batch=1 fills
+        finally:
+            mb.close()
+
+    def test_failed_batch_also_records_before_fanout(self, monkeypatch):
+        recorded = threading.Event()
+        orig = MicroBatcher._record_obs
+
+        def slow_record(self, *args, **kwargs):
+            time.sleep(0.05)
+            orig(self, *args, **kwargs)
+            recorded.set()
+
+        monkeypatch.setattr(MicroBatcher, "_record_obs", slow_record)
+
+        def process(items):
+            raise ValueError("device died")
+
+        mb = MicroBatcher(process, max_batch=1, max_wait_ms=0.0)
+        try:
+            with pytest.raises(ValueError, match="device died"):
+                mb.submit("x")
+            assert recorded.is_set()
+        finally:
+            mb.close()
+
+
 @pytest.fixture()
 def registry(tmp_path):
     from predictionio_tpu.storage import StorageRegistry

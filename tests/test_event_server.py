@@ -277,6 +277,23 @@ def test_batch_events_route(server):
     assert got.status_code == 200
 
 
+def test_non_object_events_get_400_not_500(server):
+    """A non-mapping body (or batch element) is a client error: the
+    single route 400s with a clear message and a batch element only
+    fails its own slot — never the whole batch via a 500."""
+    base, _ = server
+    r = requests.post(f"{base}/events.json?accessKey=SECRET", json=[5])
+    assert r.status_code == 400
+    assert "JSON object" in r.json()["message"]
+    r = requests.post(
+        f"{base}/batches/events.json?accessKey=SECRET",
+        json=[5, _event_payload(entityId="after-bad")],
+    )
+    assert r.status_code == 200
+    results = r.json()
+    assert [x["status"] for x in results] == [400, 201]
+
+
 def test_batch_events_rejects_non_array(server):
     base, _ = server
     r = requests.post(

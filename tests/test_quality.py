@@ -1,0 +1,742 @@
+"""Quality-observability plane (docs/observability.md#quality): sketch
+golden tests vs numpy, PSI identity/shift, monitors on injected clocks,
+the ``pio quality`` CLI exit-code contract, and the score-drift chaos
+drill — the ISSUE 10 acceptance proof. Zero wall-clock sleeps in any
+decision path; the one sleep in this file exists to *widen* a historical
+race into a deterministic ordering assertion."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import expo
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.quality import (
+    IngestQualityMonitor,
+    QualityConfig,
+    QualityMonitor,
+    feedback_key,
+    load_snapshots,
+    scores_from_result,
+    snapshot_psi,
+)
+from predictionio_tpu.obs.sketch import (
+    QuantileSketch,
+    categorical_psi,
+    psi,
+)
+from predictionio_tpu.testing.clock import FakeClock
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "quality")
+
+
+# ---------------------------------------------------------------------------
+# sketch correctness (golden vs numpy)
+# ---------------------------------------------------------------------------
+
+
+class TestQuantileSketch:
+    # The documented bound (obs/sketch.py): quantile() is within rel_err
+    # RELATIVE error of the exact sample quantile for |v| > min_magnitude.
+    # The assertions allow 2*rel_err: one rel_err for the bucket
+    # representative, one for the discrete-rank walk vs numpy's linear
+    # interpolation between order statistics. Fixed rng => deterministic.
+    BOUND = 2 * 0.02
+
+    def _assert_close(self, sketch, values, quantiles):
+        for q in quantiles:
+            exact = float(np.quantile(values, q))
+            got = sketch.quantile(q)
+            assert abs(got - exact) <= self.BOUND * abs(exact) + 1e-9, (
+                f"q={q}: sketch {got} vs numpy {exact}"
+            )
+
+    def test_golden_quantiles_within_documented_bound(self):
+        # one shared sweep over three distribution shapes (tier-1 budget:
+        # one rng, no per-case fixtures)
+        rng = np.random.default_rng(7)
+        cases = [
+            ("lognormal", rng.lognormal(0.0, 1.0, 4000)),
+            ("uniform", rng.uniform(0.5, 100.0, 4000)),
+            ("negated", -rng.lognormal(1.0, 0.5, 4000)),
+        ]
+        for _name, values in cases:
+            s = QuantileSketch()
+            s.extend(values.tolist())
+            assert s.count == len(values)
+            self._assert_close(s, values, (0.01, 0.1, 0.5, 0.9, 0.99))
+
+    def test_mixed_sign_walk_order(self):
+        # negative store walks descending index (most-negative first):
+        # quantiles must be monotone across the sign boundary
+        rng = np.random.default_rng(11)
+        values = rng.normal(0.0, 10.0, 4000)
+        s = QuantileSketch()
+        s.extend(values.tolist())
+        qs = [s.quantile(q) for q in (0.05, 0.25, 0.5, 0.75, 0.95)]
+        assert qs == sorted(qs)
+        # tails are far from zero: the relative bound applies there
+        self._assert_close(s, values, (0.05, 0.95))
+
+    def test_merge_is_lossless_bucket_addition(self):
+        rng = np.random.default_rng(3)
+        values = rng.lognormal(0.0, 1.0, 2000)
+        whole = QuantileSketch()
+        whole.extend(values.tolist())
+        a, b = QuantileSketch(), QuantileSketch()
+        a.extend(values[:700].tolist())
+        b.extend(values[700:].tolist())
+        merged = a.merge(b)
+        assert merged.count == whole.count
+        assert merged.sum == pytest.approx(whole.sum)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            assert merged.quantile(q) == whole.quantile(q)
+
+    def test_merge_rejects_mismatched_accuracy(self):
+        with pytest.raises(ValueError, match="accuracy"):
+            QuantileSketch(rel_err=0.02).merge(QuantileSketch(rel_err=0.05))
+        a, b = QuantileSketch(rel_err=0.02), QuantileSketch(rel_err=0.05)
+        a.add(1.0)
+        b.add(1.0)
+        with pytest.raises(ValueError, match="accuracy"):
+            psi(a, b)  # (empty sketches abstain before the param check)
+
+    def test_bounded_memory_keeps_the_tail_accurate(self):
+        # 6 decades of magnitudes through a 16-bucket cap: memory stays
+        # bounded and the HIGH-magnitude tail stays within the bound
+        # (collapse folds low-magnitude buckets only)
+        values = np.logspace(-3, 3, 5000)
+        s = QuantileSketch(max_buckets=16)
+        s.extend(values.tolist())
+        assert len(s._pos) <= 16
+        exact = float(np.quantile(values, 0.99))
+        assert abs(s.quantile(0.99) - exact) <= self.BOUND * exact
+
+    def test_nan_skipped_inf_clamped_zero_bucketed(self):
+        s = QuantileSketch()
+        s.extend([1.0, 2.0, float("nan"), 0.0, 1e-12, math.inf])
+        assert s.count == 5  # NaN contributes nothing
+        assert s.quantile(0.0) == 0.0  # zero bucket holds 0.0 and 1e-12
+        assert s.quantile(1.0) >= 2.0 * (1 - 0.02)  # inf clamped, not lost
+
+    def test_inf_into_empty_store_ranks_as_the_extreme(self):
+        # review pin: an inf clamped into a FRESH store used to land in
+        # bucket 0 (representative ~1.0) — the overflow score read as
+        # the distribution's MINIMUM, skewing PSI the wrong way
+        s = QuantileSketch()
+        s.add(math.inf)
+        s.extend([1000.0] * 9)
+        assert s.quantile(0.05) == pytest.approx(1000.0, rel=0.05)
+        assert s.quantile(1.0) > 1e300  # finite, huge, never overflows
+        # review pin: the clamp covers sum/min/max too — one inf must
+        # not poison mean() or write "Infinity" (non-RFC JSON) into the
+        # durable snapshot line
+        assert math.isfinite(s.sum) and math.isfinite(s.max)
+        assert math.isfinite(s.mean())
+        json.loads(json.dumps(s.to_dict(), allow_nan=False))
+        # review pin: the RUNNING SUM saturates — several clamped
+        # extremes (or near-max finites, clamped at intake too) must
+        # not overflow sum to inf between them, nor across a merge
+        s.extend([math.inf, math.inf, 1.7e308])
+        other = QuantileSketch()
+        other.extend([1.7e308, math.inf])
+        s.merge(other)
+        assert math.isfinite(s.sum) and math.isfinite(s.mean())
+        json.loads(json.dumps(s.to_dict(), allow_nan=False))
+
+    def test_near_max_finite_scores_never_overflow_reads(self):
+        # review pin (confirmed by execution): a FINITE near-max-float
+        # score used to land in a bucket whose representative value
+        # raised OverflowError in quantile() — the intake clamp now
+        # covers huge finite magnitudes, not just infinities
+        s = QuantileSketch()
+        s.extend([1.7976e308, 1.5e308, -1.7e308, 1000.0])
+        for q in (0.0, 0.25, 0.5, 0.75, 1.0):
+            assert math.isfinite(s.quantile(q))
+        assert s.quantile(1.0) > 1e300
+        assert s.quantile(0.0) < -1e300
+
+    def test_serialization_roundtrip_preserves_quantiles(self):
+        rng = np.random.default_rng(5)
+        s = QuantileSketch()
+        s.extend(rng.lognormal(0.0, 1.0, 1000).tolist())
+        doc = json.loads(json.dumps(s.to_dict()))  # through real JSON
+        back = QuantileSketch.from_dict(doc)
+        assert back.count == s.count
+        for q in (0.1, 0.5, 0.99):
+            assert back.quantile(q) == s.quantile(q)
+        assert psi(s, back) == pytest.approx(0.0, abs=1e-12)
+
+
+class TestPSI:
+    def test_identity_is_zero(self):
+        s = QuantileSketch()
+        s.extend(np.random.default_rng(2).lognormal(0, 1, 500).tolist())
+        assert psi(s, s.copy()) == pytest.approx(0.0, abs=1e-12)
+
+    def test_resampled_same_distribution_stays_stable(self):
+        rng = np.random.default_rng(9)
+        a, b = QuantileSketch(), QuantileSketch()
+        a.extend(rng.lognormal(0.0, 1.0, 3000).tolist())
+        b.extend(rng.lognormal(0.0, 1.0, 3000).tolist())
+        assert psi(a, b) < 0.1  # conventional "stable" reading
+
+    def test_coarsened_bins_keep_small_samples_stable(self):
+        # the PSI_COARSEN rationale pinned: at the gate's sample floor a
+        # same-distribution resample must read stable — over the raw 2%
+        # buckets it reads past the 0.25 "real change" bar on epsilon
+        # noise alone, which would make the rollout gate a coin flip
+        rng = np.random.default_rng(9)
+        values = rng.lognormal(0.0, 0.5, 640)
+        small, big = QuantileSketch(), QuantileSketch()
+        small.extend(values[:120].tolist())
+        big.extend(values.tolist())
+        assert psi(small, big) < 0.1
+        assert psi(small, big, coarsen=1) > 0.25  # the noise floor it fixes
+
+    def test_scale_shift_exceeds_the_drift_threshold(self):
+        rng = np.random.default_rng(9)
+        values = rng.lognormal(0.0, 1.0, 2000)
+        a, b = QuantileSketch(), QuantileSketch()
+        a.extend(values.tolist())
+        b.extend((values * 4.0).tolist())  # the drill's skew shape
+        assert psi(a, b) > 0.25
+
+    def test_empty_side_abstains(self):
+        s = QuantileSketch()
+        s.add(1.0)
+        assert psi(s, QuantileSketch()) is None
+        assert psi(QuantileSketch(), s) is None
+
+    def test_categorical_identity_shift_and_empty(self):
+        mix = {"rate": 800, "buy": 150, "view": 50}
+        assert categorical_psi(mix, dict(mix)) == pytest.approx(
+            0.0, abs=1e-12
+        )
+        # scaled counts, same mix: still zero (PSI is over proportions)
+        doubled = {k: 2 * v for k, v in mix.items()}
+        assert categorical_psi(mix, doubled) == pytest.approx(
+            0.0, abs=1e-12
+        )
+        skewed = {"rate": 50, "buy": 150, "view": 800}
+        assert categorical_psi(mix, skewed) > 0.25
+        assert categorical_psi({}, mix) is None
+        assert categorical_psi(mix, {}) is None
+
+
+# ---------------------------------------------------------------------------
+# monitors (injected clocks)
+# ---------------------------------------------------------------------------
+
+
+def _scores(rng, n, scale=1.0):
+    return (rng.lognormal(0.0, 0.5, n) * scale).tolist()
+
+
+class TestQualityMonitor:
+    def _monitor(self, tmp_path=None, **overrides):
+        clock = FakeClock()
+        cfg = QualityConfig(
+            pin_min_samples=overrides.pop("pin_min_samples", 100),
+            min_psi_samples=overrides.pop("min_psi_samples", 100),
+            snapshot_path=(
+                str(tmp_path / "quality.jsonl") if tmp_path else None
+            ),
+            **overrides,
+        )
+        registry = MetricsRegistry(clock=clock)
+        return QualityMonitor(registry, clock=clock, config=cfg), (
+            registry,
+            clock,
+        )
+
+    def test_baseline_pins_then_reads_stable(self):
+        monitor, (registry, _clock) = self._monitor()
+        rng = np.random.default_rng(1)
+        assert not monitor.pinned()
+        assert monitor.score_psi("baseline") is None  # nothing to drift from
+        monitor.record_scores("baseline", _scores(rng, 120))
+        assert monitor.pinned()
+        monitor.record_scores("baseline", _scores(rng, 400))
+        value = monitor.score_psi("baseline")
+        assert value is not None and value < 0.1
+        # the gauge renders on /metrics with the variant label
+        text = expo.render(registry)
+        assert 'pio_quality_score_psi{variant="baseline"}' in text
+
+    def test_candidate_drift_detected_and_floors_respected(self):
+        monitor, _ = self._monitor()
+        rng = np.random.default_rng(4)
+        monitor.record_scores("baseline", _scores(rng, 300))
+        monitor.record_scores("candidate", _scores(rng, 50, scale=4.0))
+        # below min_psi_samples: abstain, never a coin-flip verdict
+        assert monitor.score_psi("candidate") is None
+        monitor.record_scores("candidate", _scores(rng, 100, scale=4.0))
+        assert monitor.score_psi("candidate") > 0.25
+
+    def test_window_rotation_ages_samples_out(self):
+        monitor, (_registry, clock) = self._monitor(window_s=60.0)
+        rng = np.random.default_rng(6)
+        monitor.record_scores("baseline", _scores(rng, 300))
+        assert monitor.summary()["samples"]["baseline"] == 300
+        clock.advance(200.0)  # > 2 windows idle: both epochs stale
+        assert monitor.summary()["samples"]["baseline"] == 0
+        # the pin survives rotation — it is a snapshot, not a window
+        assert monitor.pinned()
+
+    def test_feedback_join_hit_miss_and_rank(self):
+        monitor, (registry, _clock) = self._monitor()
+        monitor.record_served("u1", ["i3", "i7", "i9"])
+        assert monitor.record_feedback("u1", "i7") == 2  # 1-based rank
+        assert monitor.record_feedback("u1", "i0") is None  # not served
+        assert monitor.record_feedback("ghost", "i7") is None  # unknown user
+        # the unknown user is UNJOINED, not a miss: historical-backlog
+        # feedback (or an evicted user) must not dilute the hit-rate
+        assert monitor.feedback_hit_rate() == pytest.approx(1 / 2)
+        online = monitor.online_quality()
+        assert online["feedbackSamples"] == 2
+        assert online["meanServedRank"] == 2.0
+        counter = registry.counter(
+            "pio_quality_feedback_events_total",
+            "Feedback events joined to served lists, by outcome",
+            labelnames=("outcome",),
+        )
+        assert counter.value(outcome="hit") == 1
+        assert counter.value(outcome="miss") == 1
+        assert counter.value(outcome="unjoined") == 1
+
+    def test_served_lru_is_bounded(self):
+        monitor, _ = self._monitor(served_capacity=4)
+        for i in range(10):
+            monitor.record_served(f"u{i}", ["a"])
+        assert len(monitor._served) == 4
+        assert monitor.record_feedback("u0", "a") is None  # evicted
+        assert monitor.record_feedback("u9", "a") == 1
+
+    def test_reset_variant_drops_a_stale_candidate_window(self):
+        # review pin: the rollout manager resets the candidate window at
+        # every rollout START — without it, a rolled-back candidate's
+        # skewed scores contaminate the NEXT candidate's PSI for up to
+        # 2x window_s (spurious-rollback livelock)
+        monitor, _ = self._monitor()
+        rng = np.random.default_rng(12)
+        monitor.record_scores("baseline", _scores(rng, 300))
+        monitor.record_scores("candidate", _scores(rng, 200, scale=4.0))
+        assert monitor.score_psi("candidate") > 0.25  # the OLD candidate
+        monitor.reset_variant("candidate")
+        assert monitor.summary()["samples"]["candidate"] == 0
+        assert monitor.score_psi("candidate") is None  # abstains, fresh
+        monitor.record_scores("candidate", _scores(rng, 200))  # healthy
+        assert monitor.score_psi("candidate") < 0.25
+        monitor.reset_variant("nonsense")  # unknown variant: no-op
+
+    def test_model_live_repins_and_persists_snapshots(self, tmp_path):
+        monitor, _ = self._monitor(tmp_path)
+        rng = np.random.default_rng(8)
+        monitor.record_scores("baseline", _scores(rng, 300))
+        assert monitor.pinned()
+        monitor.model_live("EI-42")
+        assert not monitor.pinned()  # the NEW model's traffic must re-pin
+        assert monitor.summary()["samples"]["baseline"] == 0
+        snaps = load_snapshots(str(tmp_path / "quality.jsonl"))
+        # auto-pin wrote one, model_live wrote the closing one
+        assert [s["source"] for s in snaps] == [
+            "baseline-pin", "model-live:EI-42",
+        ]
+        # the persisted sketch round-trips into a PSI comparison
+        assert snapshot_psi(snaps[0], snaps[1]) == pytest.approx(
+            0.0, abs=1e-6
+        )
+
+    def test_abstaining_monitor_reads_as_unknown_not_stable(
+        self, monkeypatch
+    ):
+        # review pin: a fresh (or just-reloaded) monitor has no PSI to
+        # report — the gauge exports the -1 sentinel and every scrape
+        # consumer maps it back to unknown, so an operator never reads
+        # "measured stable / zero hit-rate" off an abstaining window
+        from predictionio_tpu.obs import top as top_mod
+        from predictionio_tpu.tools.quality import node_report
+
+        monitor, (registry, _clock) = self._monitor()
+        text = expo.render(registry)
+        assert 'pio_quality_score_psi{variant="baseline"} -1' in text
+        assert "pio_quality_feedback_hit_rate -1" in text
+        parsed = top_mod.parse_text(text)
+        monkeypatch.setattr(
+            top_mod, "fetch_metrics", lambda node, timeout=5.0: parsed
+        )
+        row = top_mod.node_row("fake:1")
+        assert row["score_psi"] is None  # DRIFT renders "-"
+        assert row["hit_rate"] is None  # HITRATE renders "-"
+        report = node_report("fake:1")
+        assert "scorePsi" not in report
+        assert "hitRate" not in report.get("feedback", {})
+        # an unjoined backlog (watcher replay before anyone was served)
+        # is still not a measured 0.00 hit-rate — only hit/miss join
+        monitor.record_feedback("nobody", "a")
+        parsed = top_mod.parse_text(expo.render(registry))
+        assert top_mod.node_row("fake:1")["hit_rate"] is None
+        assert "hitRate" not in node_report("fake:1")["feedback"]
+        # and once real data lands, the same consumers read the number
+        rng = np.random.default_rng(5)
+        monitor.record_scores("baseline", _scores(rng, 300))
+        monitor.record_served("u1", ["a", "b"])
+        monitor.record_feedback("u1", "a")
+        parsed = top_mod.parse_text(expo.render(registry))
+        row = top_mod.node_row("fake:1")
+        assert row["score_psi"] is not None and row["score_psi"] >= 0
+        assert row["hit_rate"] == 1.0
+        assert node_report("fake:1")["scorePsi"]["baseline"] >= 0
+
+    def test_snapshot_psi_abstains_on_corrupt_sketch_fields(self):
+        # review pin: a torn/hand-edited snapshot whose sketch carries a
+        # non-scalar numeric (TypeError at float(), not ValueError) must
+        # abstain like any other unreadable sketch, so `pio quality
+        # --diff` reports exit 2 (error) instead of crashing as exit 1
+        rng = np.random.default_rng(3)
+        sketch = QuantileSketch()
+        sketch.extend(_scores(rng, 300))
+        good = {"serving": {"baseline": sketch.to_dict()}}
+        corrupt = {"serving": {"baseline": dict(sketch.to_dict())}}
+        corrupt["serving"]["baseline"]["relErr"] = {}
+        assert snapshot_psi(good, corrupt) is None
+        assert snapshot_psi(corrupt, good) is None
+
+    def test_snapshot_psi_applies_the_live_sample_floor(self):
+        # review pin: `pio quality --diff` must apply the same
+        # min-sample floor as every live PSI read — a model-live
+        # closing snapshot written after a handful of queries is
+        # sampling noise, not a CI drift verdict (exit 1)
+        rng = np.random.default_rng(9)
+        big, small = QuantileSketch(), QuantileSketch()
+        big.extend(_scores(rng, 300))
+        small.extend(_scores(rng, 10))
+        pin = {"serving": {"baseline": big.to_dict()}}
+        thin = {"serving": {"baseline": small.to_dict()}}
+        assert snapshot_psi(pin, thin) is None
+        assert snapshot_psi(thin, pin) is None
+        assert snapshot_psi(pin, thin, min_samples=5) is not None
+
+    def test_scores_from_result_shapes(self):
+        items, scores = scores_from_result(
+            {"itemScores": [
+                {"item": "a", "score": 1.5},
+                {"item": "b", "score": 2},
+                {"item": "c", "score": "bad"},
+            ]}
+        )
+        assert items == ["a", "b"] and scores == [1.5, 2.0]
+        assert scores_from_result({"score": 0.7}) == ([None], [0.7])
+        assert scores_from_result({"label": "spam"}) == ([], [])
+        assert scores_from_result("not a dict") == ([], [])
+
+    def test_feedback_key_field_preference(self):
+        assert feedback_key({"user": "u1", "num": 5}) == "u1"
+        assert feedback_key({"entityId": 7}) == "7"
+        assert feedback_key("raw") == "raw"
+
+
+class TestIngestQualityMonitor:
+    class _Props:
+        def __init__(self, d):
+            self._d = d
+
+        def to_dict(self):
+            return self._d
+
+    class _Event:
+        def __init__(self, name, props=None):
+            self.event = name
+            self.properties = (
+                TestIngestQualityMonitor._Props(props)
+                if props is not None
+                else None
+            )
+
+    def _monitor(self, baseline_dir=None, **overrides):
+        clock = FakeClock()
+        registry = MetricsRegistry(clock=clock)
+        cfg = QualityConfig(
+            baseline_min_events=overrides.pop("baseline_min_events", 20),
+            **overrides,
+        )
+        return (
+            IngestQualityMonitor(
+                registry, clock=clock, config=cfg,
+                baseline_dir=baseline_dir,
+            ),
+            registry,
+        )
+
+    def test_violation_kinds_counted_not_rejected(self):
+        monitor, registry = self._monitor()
+        monitor.record_event(1, self._Event("rate", {"rating": 3.0}))  # ok
+        monitor.record_event(1, self._Event("rate", {"rating": 42.0}))
+        monitor.record_event(1, self._Event("rate", {}))  # no rating
+        monitor.record_event(1, self._Event("rate", {"rating": True}))
+        monitor.record_rejected(1)
+        counter = registry.counter(
+            "pio_quality_ingest_violations_total",
+            "Ingest data-quality violations by app and kind "
+            "(schema / range / poison)",
+            labelnames=("app", "kind"),
+        )
+        assert counter.value(app="1", kind="range") == 1
+        assert counter.value(app="1", kind="poison") == 2
+        assert counter.value(app="1", kind="schema") == 1
+        # every accepted event still counted (rejected ones are not)
+        assert monitor.summary()["1"]["events"] == 4
+
+    def test_mix_baseline_pins_then_flags_drift(self):
+        monitor, registry = self._monitor()
+        monitor.record_event(7, self._Event("view"))
+        # review pin: below the pin floor the gauge exports the -1
+        # abstention sentinel, never a measured-looking 0.0
+        assert 'pio_quality_event_mix_psi{app="7"} -1' in expo.render(
+            registry
+        )
+        for _ in range(14):
+            monitor.record_event(7, self._Event("view"))
+        for _ in range(5):
+            monitor.record_event(7, self._Event("buy"))
+        assert monitor.summary()["7"]["baselinePinned"]
+        stable = monitor.mix_psi(7)
+        assert stable is not None and stable < 0.1
+        for _ in range(200):  # the mix rots: buys vanish, rates flood in
+            monitor.record_event(7, self._Event("rate", {"rating": 1.0}))
+        assert monitor.mix_psi(7) > 0.25
+        assert 'pio_quality_event_mix_psi{app="7"}' in expo.render(registry)
+
+    def test_baseline_survives_restart_via_durable_file(self, tmp_path):
+        first, _ = self._monitor(baseline_dir=str(tmp_path))
+        for _ in range(25):
+            first.record_event(3, self._Event("view"))
+        assert first.summary()["3"]["baselinePinned"]
+        # a fresh monitor (restarted server) loads the pin from disk:
+        # one event is enough to see drift vs the durable baseline
+        second, _ = self._monitor(baseline_dir=str(tmp_path))
+        second.record_event(3, self._Event("buy"))
+        assert second.summary()["3"]["baselinePinned"]
+        assert second.mix_psi(3) > 0.25
+
+
+# ---------------------------------------------------------------------------
+# `pio quality` CLI — exit-code contract + report rendering
+# ---------------------------------------------------------------------------
+
+
+class TestQualityCLI:
+    STABLE = os.path.join(FIXTURES, "snapshots_stable.jsonl")
+    DRIFT = os.path.join(FIXTURES, "snapshots_drift.jsonl")
+
+    def _main(self, *argv):
+        from predictionio_tpu.tools import quality as quality_mod
+
+        return quality_mod.main(list(argv))
+
+    def test_diff_exit_codes_pinned_0_1_2(self, tmp_path, capsys):
+        # the satellite contract: 0 stable / 1 drift / 2 engine error,
+        # self-tested against the checked-in snapshot pair
+        assert self._main("--diff", "--snapshots", self.STABLE) == 0
+        assert self._main("--diff", "--snapshots", self.DRIFT) == 1
+        assert (
+            self._main("--diff", "--snapshots", str(tmp_path / "none.jsonl"))
+            == 2
+        )
+        single = tmp_path / "single.jsonl"
+        with open(self.STABLE) as fh:
+            single.write_text(fh.readline())
+        assert self._main("--diff", "--snapshots", str(single)) == 2
+        out = capsys.readouterr()
+        assert "DRIFT" in out.out and "error" in out.err
+
+    def test_diff_against_baseline_file_and_json(self, capsys):
+        assert (
+            self._main(
+                "--diff", "--snapshots", self.DRIFT,
+                "--baseline", self.STABLE, "--json",
+            )
+            == 1
+        )
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["drift"] is True
+        assert verdict["psi"]["baseline"] > 0.25
+
+    def test_raised_bar_turns_drift_into_ok(self):
+        assert (
+            self._main(
+                "--diff", "--snapshots", self.DRIFT, "--max-psi", "1e6"
+            )
+            == 0
+        )
+
+    def test_diff_honors_the_snapshots_recorded_sample_floor(
+        self, tmp_path
+    ):
+        # review pin: a deployment configured below the default floor
+        # records minPsiSamples in its snapshots; --diff must judge at
+        # THAT bar (not hard-coded 50), and --min-samples overrides
+        rng = np.random.default_rng(11)
+        path = tmp_path / "thin.jsonl"
+        s = QuantileSketch()
+        s.extend(_scores(rng, 20))
+        with open(path, "w") as fh:
+            for _ in range(2):  # identical 20-sample sketches: psi ~ 0
+                fh.write(json.dumps({
+                    "kind": "quality", "source": "t",
+                    "minPsiSamples": 10,
+                    "serving": {"baseline": s.to_dict()},
+                }) + "\n")
+        assert self._main("--diff", "--snapshots", str(path)) == 0
+        # overriding above the sketch size abstains both variants -> 2
+        assert (
+            self._main(
+                "--diff", "--snapshots", str(path), "--min-samples", "50"
+            )
+            == 2
+        )
+
+    def test_snapshot_report_renders(self, capsys):
+        assert self._main("--snapshots", self.STABLE) == 0
+        out = capsys.readouterr().out
+        assert "baseline" in out and "hits=" in out
+
+    def test_console_forwards_verbatim(self, capsys):
+        from predictionio_tpu.tools.console import main as console_main
+
+        assert (
+            console_main(["quality", "--diff", "--snapshots", self.STABLE])
+            == 0
+        )
+        assert "ok baseline" in capsys.readouterr().out
+
+    def test_no_source_is_an_error(self, monkeypatch, capsys):
+        monkeypatch.delenv("PIO_QUALITY_SNAPSHOTS", raising=False)
+        assert self._main() == 2
+        assert "nothing to report" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# perf-ledger integration (bench's quality block → trend records)
+# ---------------------------------------------------------------------------
+
+
+class TestPerfLedgerIntegration:
+    def test_quality_block_becomes_trend_records(self):
+        from predictionio_tpu.obs import perfledger
+
+        bench = {
+            "metric": "als_train_s", "value": 2.0, "device": "cpu",
+            "quality": {
+                "ok": True, "scorePsi": 0.07,
+                "feedbackHitRate": 0.55, "feedbackSamples": 20,
+            },
+        }
+        records = {
+            r["metric"]: r for r in perfledger.quality_records(bench)
+        }
+        assert records["quality_score_psi"]["value"] == 0.07
+        assert records["quality_score_psi"]["unit"] == "psi"  # trend-only:
+        # the ledger's regression gate compares unit "s" records only
+        assert records["quality_feedback_hitrate"]["unit"] == "ratio"
+        assert records["quality_feedback_hitrate"]["extra"]["samples"] == 20
+        # a failed drill records nothing — no trend point beats a lie
+        assert perfledger.quality_records(
+            {"quality": {"ok": False, "scorePsi": 9.0}}
+        ) == []
+        # the headline bench record carries the block through `extra`
+        rec = perfledger.bench_to_record(bench)
+        assert rec["extra"]["quality"]["scorePsi"] == 0.07
+
+
+# ---------------------------------------------------------------------------
+# dashboard /quality panel
+# ---------------------------------------------------------------------------
+
+
+class TestDashboardQualityPanel:
+    def test_quality_routes_render_with_fleet_down(self, tmp_path):
+        # connection-refused nodes resolve instantly (no timeout wait):
+        # the panel must render DOWN rows, never error
+        import urllib.request
+
+        from predictionio_tpu.storage import StorageRegistry
+        from predictionio_tpu.tools.dashboard import (
+            DashboardConfig,
+            create_dashboard,
+        )
+
+        registry = StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp_path)})
+        server = create_dashboard(
+            DashboardConfig(port=0, nodes="127.0.0.1:9", scrape_timeout_s=0.5),
+            registry, block=False,
+        )
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            with urllib.request.urlopen(f"{base}/quality.json", timeout=10) as r:
+                rows = json.loads(r.read())
+            assert rows == [{"node": "127.0.0.1:9", "up": False}]
+            with urllib.request.urlopen(f"{base}/quality", timeout=10) as r:
+                page = r.read().decode()
+            assert "DOWN" in page and "Quality" in page
+        finally:
+            server.stop_async()
+            server.server_close()
+
+    def test_render_quality_live_rows(self):
+        from predictionio_tpu.tools.dashboard import render_quality
+
+        page = render_quality([
+            {
+                "node": "q1:8000", "up": True,
+                "scorePsi": {"baseline": 0.02, "candidate": 0.41},
+                "feedback": {"hitRate": 0.55},
+                "ingest": {"1": {"mixPsi": 0.01, "violations": {"range": 2}}},
+            },
+        ])
+        assert "0.4100" in page and "0.550" in page and "1:0.0100" in page
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: score-skewed candidate auto-rolled-back by PSI
+# ---------------------------------------------------------------------------
+
+
+class TestScoreDriftDrill:
+    def test_psi_gate_rolls_back_skewed_candidate(self, capsys):
+        """ISSUE 10 acceptance: a candidate whose scores are a pure
+        distribution shift (well-formed, fast, error-free — invisible to
+        every pre-existing gate) is auto-rolled-back by max_score_psi
+        with zero client failures, a durable ROLLED_BACK plan, and
+        restart quarantine; `pio quality` renders the drift from a live
+        /metrics scrape while the server is still up."""
+        from predictionio_tpu.tools import quality as quality_mod
+        from predictionio_tpu.tools.loadgen import run_score_drift
+
+        live: dict = {}
+
+        def scrape(server):
+            node = f"127.0.0.1:{server.bound_port}"
+            live["report"] = quality_mod.node_report(node)
+            live["exit"] = quality_mod.main(["--node", node])
+
+        report = run_score_drift(on_live=scrape)
+        assert report["ok"], report
+        assert report["clientFailures"] == 0
+        assert report["rolledBack"] and report["durableStage"] == "ROLLED_BACK"
+        assert report["postRollbackCandidateServed"] == 0
+        assert report["quarantined"]
+        assert report["candidatePsi"] > 0.25
+        assert "score PSI" in report["rollbackReason"]
+        # the live scrape saw the same drift the gate acted on
+        scraped = live["report"]
+        assert scraped["scorePsi"]["candidate"] > 0.25
+        assert scraped["scorePsi"]["baseline"] < 0.1
+        assert scraped["scoreSamples"]["candidate"] > 0
+        assert live["exit"] == 0
+        rendered = capsys.readouterr().out
+        assert "candidate" in rendered and "psi=" in rendered
