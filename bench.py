@@ -238,6 +238,10 @@ def _append_ledger(record: dict) -> None:
         # (docs/observability.md#quality)
         for quality_record in perfledger.quality_records(record):
             perfledger.append_record(path, quality_record)
+        # alert noisiness from the brownout drill, trend-only
+        # (docs/slo.md): alert hygiene gets a trajectory too
+        for alert_record in perfledger.alert_records(record):
+            perfledger.append_record(path, alert_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -450,6 +454,30 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:  # the headline metric must still report
             record["servingFleet"] = {"error": str(exc)}
+    # Alert hygiene (docs/slo.md): the in-process brownout drill gives
+    # every BENCH round a fired/cleared/false-positive count, so alert
+    # noisiness is tracked across rounds like perf and quality already
+    # are. Opt out with BENCH_BROWNOUT=0; a failure never fails the
+    # bench.
+    if os.environ.get("BENCH_BROWNOUT") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_brownout
+
+            brownout = run_brownout()
+            per_objective = brownout.get("alerts") or {}
+            record["alerts"] = {
+                "fired": sum(
+                    a.get("fired", 0) for a in per_objective.values()
+                ),
+                "cleared": sum(
+                    a.get("cleared", 0) for a in per_objective.values()
+                ),
+                "falsePositives": brownout.get("falsePositives"),
+                "stallsDetected": brownout.get("stallsDetected"),
+                "ok": brownout.get("ok"),
+            }
+        except Exception as exc:
+            record["alerts"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

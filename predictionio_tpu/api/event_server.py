@@ -473,6 +473,7 @@ class EventServer(BackgroundHTTPServer):
             (config.ip, config.port),
             _EventServiceHandler,
             tracer=Tracer("event-server"),
+            health_kind="event",
         )
         # Ingest data-quality plane (docs/observability.md#quality):
         # per-app schema/range/poison counters + event-type mix PSI vs a
@@ -488,18 +489,26 @@ class EventServer(BackgroundHTTPServer):
                 config.quality_dir or _os.environ.get("PIO_QUALITY_DIR")
             ),
         )
+        self._observer_errors = self.metrics.counter(
+            "pio_observer_errors_total",
+            "Swallowed observer/monitor exceptions by site",
+            labelnames=("site",),
+        )
 
     def _observe_quality(self, app_id: int, event=None) -> None:
         """Quality accounting, swallowed on error: the serving path's
         'observability must never fail a query' discipline — a monitor
         fault after the store committed would turn a stored event into
-        a client-visible 500 (and an SDK retry into a duplicate)."""
+        a client-visible 500 (and an SDK retry into a duplicate). The
+        swallow is COUNTED (docs/slo.md): a monitor that starts failing
+        on every event must be visible on /metrics."""
         try:
             if event is None:
                 self.quality.record_rejected(app_id)
             else:
                 self.quality.record_event(app_id, event)
         except Exception:
+            self._observer_errors.inc(1, site="ingest.quality")
             logger.debug("ingest quality accounting failed", exc_info=True)
 
 

@@ -84,9 +84,13 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def serve_obs(self, path: str) -> bool:
-        """Answer ``GET /metrics`` / ``GET /traces.json`` from the owning
-        server's registry and tracer; False when ``path`` is neither (or
-        the server opted out by nulling the attributes)."""
+        """Answer the diagnostic routes every server shares — ``GET
+        /metrics`` (Prometheus text), ``GET /traces.json`` (span ring),
+        ``GET /health.json`` (the health plane's SLO/stall summary) and
+        ``GET /blackbox.json`` (the flight-recorder ring) — from the
+        owning server's registry/tracer/health plane; False when
+        ``path`` is none of them (or the server opted out by nulling
+        the attributes)."""
         if path == "/metrics":
             metrics = getattr(self.server, "metrics", None)
             if metrics is not None:
@@ -102,6 +106,24 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
                     {
                         "service": tracer.service,
                         "spans": tracer.store.dump(),
+                    },
+                )
+                return True
+        elif path == "/health.json":
+            health = getattr(self.server, "health", None)
+            if health is not None:
+                self.respond(200, health.health_json())
+                return True
+        elif path == "/blackbox.json":
+            health = getattr(self.server, "health", None)
+            flight = health.flight if health is not None else None
+            if flight is not None:
+                self.respond(
+                    200,
+                    {
+                        "service": type(self.server).__name__,
+                        "enabled": flight.enabled,
+                        "events": flight.dump(),
                     },
                 )
                 return True
@@ -134,6 +156,8 @@ class BackgroundHTTPServer(ThreadingHTTPServer):
         *args,
         metrics: Optional[MetricsRegistry] = None,
         tracer: Optional[Tracer] = None,
+        health_kind: Optional[str] = None,
+        health_config=None,
         **kwargs,
     ):
         self.metrics = metrics if metrics is not None else MetricsRegistry()
@@ -145,9 +169,34 @@ class BackgroundHTTPServer(ThreadingHTTPServer):
         self.metrics.gauge(
             "pio_up", "1 while the server process is serving"
         ).set(1)
+        # Health plane (docs/slo.md): SLO burn-rate engine + stall
+        # watchdog + the process flight recorder, one ticker thread per
+        # server, read via GET /health.json + /blackbox.json. A server
+        # that passes no kind (tests building bare servers) carries no
+        # plane and the routes simply 404 through.
+        self.health = None
+        if health_kind is not None:
+            from ..obs.slo import HealthPlane
+
+            self.health = HealthPlane(
+                self.metrics,
+                health_kind,
+                clock=self.metrics.clock,
+                config=health_config,
+            )
         super().__init__(*args, **kwargs)
+        if self.health is not None:
+            # AFTER the bind: a failed construction (port in use) must
+            # not leave a ticking daemon thread behind
+            self.health.start()
         self._live_conns: set = set()
         self._conn_lock = threading.Lock()
+
+    def server_close(self) -> None:
+        health = getattr(self, "health", None)
+        if health is not None:
+            health.stop()
+        super().server_close()
 
     # Track accepted sockets so kill() can sever keep-alive connections:
     # shutdown() only stops the accept loop — handler threads blocked on

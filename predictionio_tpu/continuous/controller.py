@@ -58,6 +58,7 @@ from ..storage.metadata import (
 from ..utils.durability import atomic_write_bytes
 from ..utils.resilience import RetryPolicy
 from .foldin import FOLD_IN, FULL_RETRAIN, FoldInPolicy, decide_mode
+from ..obs.flight import record as flight_record
 from .watcher import FeedGap, FeedWatcher, RemoteFeed
 
 logger = logging.getLogger(__name__)
@@ -166,6 +167,23 @@ class ContinuousController:
         # (hit-rate + served-rank), the loop's real online-quality
         # number next to the offline divergence gate.
         self.watcher.on_event = self._observe_feedback
+        # Health plane (docs/slo.md): the controller's tick and the feed
+        # poll heartbeat the server's stall watchdog, and a tap failure
+        # the watcher swallows is COUNTED, never just debug-logged.
+        health = getattr(server, "health", None)
+        self._watchdog = health.watchdog if health is not None else None
+        self._tap_errors = server.metrics.counter(
+            "pio_observer_errors_total",
+            "Swallowed observer/monitor exceptions by site",
+            labelnames=("site",),
+        )
+        self.watcher.on_event_error = lambda: self._tap_errors.inc(
+            1, site="continuous.feedback"
+        )
+        if self._watchdog is not None:
+            self.watcher.heartbeat = lambda: self._watchdog.beat(
+                "continuous.feed"
+            )
         self._lock = threading.Lock()
         self._ticking = False  # single-tick gate (flag, not a held lock:
         # a tick trains models — nothing may block behind it)
@@ -248,6 +266,13 @@ class ContinuousController:
                 return 0.0
             return max(0.0, self.clock() - float(cand["createdS"]))
 
+    def _fold_event(self, kind: str) -> None:
+        """One cycle outcome: counter + flight-recorder timeline entry
+        (promote/kill/escalate events are exactly what a post-mortem of
+        the loop needs in order, docs/slo.md)."""
+        self._folds.inc(1, kind=kind)
+        flight_record("continuous", "continuous.fold", outcome=kind)
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
         """Run the background tick loop (idempotent)."""
@@ -263,6 +288,7 @@ class ContinuousController:
                 target=self._loop, name="continuous", daemon=True
             )
             self._thread.start()
+        self._watch()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.config.poll_interval_s):
@@ -271,13 +297,32 @@ class ContinuousController:
             except Exception:  # the loop must survive anything
                 logger.exception("continuous tick failed")
 
+    def _watch(self) -> None:
+        """Register the loop's stall expectations. Generous gap: a tick
+        that escalates to a full retrain legitimately blocks the loop
+        for the whole training run (docs/slo.md)."""
+        if self._watchdog is None:
+            return
+        gap = max(8 * self.config.poll_interval_s, 900.0)
+        self._watchdog.expect("continuous.tick", max_gap_s=gap)
+        self._watchdog.expect("continuous.feed", max_gap_s=gap)
+
+    def _unwatch(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.unexpect("continuous.tick")
+            self._watchdog.unexpect("continuous.feed")
+
     def stop(self) -> None:
+        self._unwatch()
         self._stop.set()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5.0)
 
     def pause(self) -> dict:
+        # a deliberately paused loop is not a stall: stop watching the
+        # beats until it resumes (docs/slo.md)
+        self._unwatch()
         with self._lock:
             self._paused = True
         return self.status()
@@ -285,6 +330,8 @@ class ContinuousController:
     def resume_watching(self) -> dict:
         with self._lock:
             self._paused = False
+        if self._thread is not None:
+            self._watch()
         return self.status()
 
     def trigger(self, full: bool = False) -> dict:
@@ -302,6 +349,8 @@ class ContinuousController:
         """One deterministic controller step (the background loop and the
         tests both drive this). Never raises on feed/train/storage
         trouble — failures land in ``status()["lastError"]``."""
+        if self._watchdog is not None:
+            self._watchdog.beat("continuous.tick")
         with self._lock:
             if self._ticking:
                 return self.status()
@@ -325,6 +374,7 @@ class ContinuousController:
         except FeedGap as exc:
             # the delta stream is incomplete: only a full retrain (which
             # reads the whole event store) can cover what the feed lost
+            flight_record("continuous", "continuous.gap", error=str(exc))
             with self._lock:
                 self._force_full = True
                 self._feed_gap = True
@@ -421,7 +471,7 @@ class ContinuousController:
                     self._last_cycle["outcome"] = "live"
                     self._last_cycle["freshnessS"] = freshness_s
                 self._persist_state()
-            self._folds.inc(1, kind="promoted")
+            self._fold_event("promoted")
             logger.info(
                 "continuous: candidate %s is LIVE (freshness %.3fs)",
                 cand["instanceId"], freshness_s or -1.0,
@@ -441,7 +491,7 @@ class ContinuousController:
                 if self._last_cycle is not None:
                     self._last_cycle["outcome"] = plan.stage.lower()
                 self._persist_state()
-            self._folds.inc(1, kind="quarantined")
+            self._fold_event("quarantined")
             logger.warning(
                 "continuous: candidate %s was %s by the rollout gates; "
                 "quarantined, cooling down %.0fs, next cycle is a full "
@@ -489,7 +539,7 @@ class ContinuousController:
                 self._candidate = None
                 self._cooldown_until = now + self.config.quarantine_backoff_s
                 self._persist_state()
-            self._folds.inc(1, kind="quarantined")
+            self._fold_event("quarantined")
             logger.exception(
                 "continuous: submitting candidate %s failed", cand["instanceId"]
             )
@@ -542,7 +592,7 @@ class ContinuousController:
                         f"exceeded policy "
                         f"{self.config.policy.max_rmse_drift}: escalated"
                     )
-                    self._folds.inc(1, kind="escalated")
+                    self._fold_event("escalated")
             if mode == FULL_RETRAIN:
                 instance_id = self._full_retrain_candidate(dep)
         except Exception as exc:
@@ -554,7 +604,7 @@ class ContinuousController:
                 self._persist_state()
             logger.exception("continuous: %s cycle failed", mode)
             return
-        self._folds.inc(1, kind=mode)
+        self._fold_event(mode)
         with self._lock:
             self._cycles += 1
             self._force_full = False
@@ -576,7 +626,7 @@ class ContinuousController:
                 cycle["outcome"] = "offline_quarantined"
                 self._last_cycle = cycle
                 self._persist_state()
-            self._folds.inc(1, kind="quarantined")
+            self._fold_event("quarantined")
             logger.warning(
                 "continuous: candidate %s failed offline scoring (%s); "
                 "quarantined before submission",

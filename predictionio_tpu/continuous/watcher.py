@@ -189,6 +189,12 @@ class FeedWatcher:
         #: uncommitted suffix through the tap once (same contract as the
         #: fold itself: resumed, possibly re-observed, never lost).
         self.on_event = None
+        #: counter hook the owner wires so a swallowed tap failure is
+        #: counted, never invisible (docs/slo.md; obs-swallowed-observer)
+        self.on_event_error = None
+        #: stall-watchdog heartbeat hook (docs/slo.md): called once per
+        #: poll round so a wedged fetch is attributable to the feed
+        self.heartbeat = None
 
     # -- durable cursor ---------------------------------------------------
     def _load_cursor(self) -> None:
@@ -257,6 +263,8 @@ class FeedWatcher:
         the pending delta. Returns how many delta events were added.
         Raises :class:`FeedGap` when incremental tailing is over."""
         added = 0
+        if self.heartbeat is not None:
+            self.heartbeat()
         for _ in range(max_rounds):
             with self._lock:
                 since = self.position
@@ -299,6 +307,8 @@ class FeedWatcher:
                     try:
                         tap(event)
                     except Exception:
+                        if self.on_event_error is not None:
+                            self.on_event_error()  # counted, not invisible
                         logger.debug(
                             "continuous: on_event tap failed", exc_info=True
                         )

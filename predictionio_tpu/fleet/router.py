@@ -310,6 +310,7 @@ class RouterServer(BackgroundHTTPServer):
             _RouterHandler,
             metrics=metrics,
             tracer=Tracer("router", clock=clock),
+            health_kind="router",
         )
 
     # -- admission (per-app quotas) ---------------------------------------
@@ -427,14 +428,32 @@ class RouterServer(BackgroundHTTPServer):
             payload = json.loads(raw.decode("utf-8")) if raw else {}
         except ValueError as exc:
             raise RouterBadRequest(f"invalid query JSON: {exc}") from exc
-        if self.config.sharded:
-            status, body, variant = self._route_sharded(
-                raw, payload, deadline, trace_id
+        # stall watchdog (docs/slo.md): a routed request that outlives a
+        # multiple of its budget — every failover leg wedged — is a
+        # fleet-level stall worth a flight dump
+        watchdog = self.health.watchdog if self.health is not None else None
+        token = (
+            watchdog.enter(
+                "router.request",
+                budget_s=(
+                    deadline.remaining_s() if deadline is not None else None
+                ),
             )
-        else:
-            status, body, variant = self._route_replicated(
-                raw, payload, deadline, trace_id
-            )
+            if watchdog is not None
+            else None
+        )
+        try:
+            if self.config.sharded:
+                status, body, variant = self._route_sharded(
+                    raw, payload, deadline, trace_id
+                )
+            else:
+                status, body, variant = self._route_replicated(
+                    raw, payload, deadline, trace_id
+                )
+        finally:
+            if watchdog is not None:
+                watchdog.exit(token)
         if status == 200:
             self._check_variant(payload, variant)
         return status, body, variant

@@ -376,4 +376,123 @@ class UnfencedTiming(Rule):
             )
 
 
-RULES: List[Rule] = [UnboundedLabel(), UnfencedTiming()]
+# -- obs-swallowed-observer ---------------------------------------------------
+
+#: method/name tails whose calls mark a try body as an observer path:
+#: quality monitors, served-list recording, watcher taps
+_OBSERVER_CALL_NAMES = frozenset(
+    {
+        "observe_result", "record_event", "record_rejected",
+        "record_feedback", "record_scores", "record_served",
+        "model_live", "on_event", "tap",
+    }
+)
+
+
+def _is_observer_function(name: str) -> bool:
+    return (
+        name.startswith("_observe")
+        or name.startswith("observe_")
+        or name == "on_event"
+        or name.endswith("_tap")
+    )
+
+
+def _name_tail(node: ast.expr) -> str:
+    dn = dotted_name(node)
+    return dn.rsplit(".", 1)[-1] if dn else ""
+
+
+def _calls_observer(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                if _name_tail(node.func) in _OBSERVER_CALL_NAMES:
+                    return True
+    return False
+
+
+def _accounts_failure(stmts: List[ast.stmt]) -> bool:
+    """Does this block raise, or count the failure into a metric? A
+    ``.inc(`` call is the canonical counter bump; a call whose name
+    ends in ``_error``/``_errors`` is the hook-shaped variant
+    (``on_event_error``) an object without its own registry uses.
+    Deliberately NOT a substring match: ``logger.error(...)`` is
+    exactly the log-only swallow this rule exists to catch."""
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "inc"
+                ):
+                    return True
+                tail = _name_tail(node.func).lower()
+                if tail.endswith("_error") or tail.endswith("_errors"):
+                    return True
+    return False
+
+
+class SwallowedObserver(Rule):
+    """An observer/callback path (quality monitors, ``_observe_*``
+    helpers, watcher taps) that swallows exceptions without
+    incrementing a counter: the swallow is correct — observability must
+    never fail the observed path — but an UNCOUNTED swallow makes a
+    permanently broken observer indistinguishable from a healthy one."""
+
+    id = "obs-swallowed-observer"
+    severity = "error"
+    short = (
+        "observer/tap except-handler swallows without counting "
+        "(no .inc() / raise) — a dead observer becomes invisible"
+    )
+    motivation = (
+        "the serving/ingest planes deliberately swallow observer "
+        "exceptions so a monitor fault never fails a query or drops a "
+        "stored event; the cost is that a monitor broken on EVERY call "
+        "(schema change, corrupt state) looks exactly like a healthy "
+        "one. Counting the swallow (pio_observer_errors_total{site}, "
+        "or an on_event_error hook) keeps the failure observable — "
+        "accounting in the try's finally (an outcome counter) also "
+        "satisfies the rule."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # map each Try to its nearest enclosing function name
+        func_stack: List[str] = []
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            is_func = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_func:
+                func_stack.append(node.name)
+            if isinstance(node, ast.Try):
+                observerish = (
+                    (func_stack and _is_observer_function(func_stack[-1]))
+                    or _calls_observer(node.body)
+                )
+                if observerish and not _accounts_failure(node.finalbody):
+                    for handler in node.handlers:
+                        if not _accounts_failure(handler.body):
+                            yield self.finding(
+                                ctx,
+                                handler,
+                                "observer path swallows exceptions "
+                                "without counting them: increment a "
+                                "counter (pio_observer_errors_total) "
+                                "or an error hook in the handler — or "
+                                "suppress with a reason if the "
+                                "failure is accounted elsewhere.",
+                            )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if is_func:
+                func_stack.pop()
+
+        yield from visit(ctx.tree)
+
+
+RULES: List[Rule] = [UnboundedLabel(), UnfencedTiming(), SwallowedObserver()]

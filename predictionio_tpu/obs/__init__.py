@@ -44,6 +44,14 @@ from .profile import (
     render_profile_report,
     roofline,
 )
+from .flight import FlightRecorder, StallWatchdog, default_recorder
+from .slo import (
+    HealthConfig,
+    HealthPlane,
+    SLOEngine,
+    SLOObjective,
+    default_objectives,
+)
 
 __all__ = [
     "Counter",
@@ -68,4 +76,12 @@ __all__ = [
     "profiling_enabled",
     "render_profile_report",
     "roofline",
+    "FlightRecorder",
+    "StallWatchdog",
+    "default_recorder",
+    "HealthConfig",
+    "HealthPlane",
+    "SLOEngine",
+    "SLOObjective",
+    "default_objectives",
 ]

@@ -156,6 +156,9 @@ class _Instrument:
         with self._lock:
             return sorted(self._children.items())
 
+    def _labels_of(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
     def clear(self) -> None:
         """Drop every series — for re-exported state whose label sets
         can change (a ``/reload`` swapping the deployed instance must
@@ -194,6 +197,15 @@ class Counter(_Instrument):
         with self._lock:
             return child.value
 
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every series as ``(labels, value)`` — the in-process twin of
+        a scraped exposition (the SLO engine reads counters this way)."""
+        with self._lock:
+            return [
+                (self._labels_of(key), child.value)
+                for key, child in sorted(self._children.items())
+            ]
+
 
 class _GaugeChild:
     __slots__ = ("value",)
@@ -228,6 +240,14 @@ class Gauge(_Instrument):
         child = self._child(labels)
         with self._lock:
             return child.value
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every series as ``(labels, value)`` (see Counter.samples)."""
+        with self._lock:
+            return [
+                (self._labels_of(key), child.value)
+                for key, child in sorted(self._children.items())
+            ]
 
 
 class _HistogramChild:
@@ -304,6 +324,32 @@ class Histogram(_Instrument):
         cums = [n for _, n in snap["buckets"]]
         return percentile_from_buckets(uppers, cums, q)
 
+    def label_snapshots(
+        self,
+    ) -> List[Tuple[Dict[str, str], Dict[str, object]]]:
+        """Every series as ``(labels, snapshot)`` — the cumulative shape
+        of :meth:`snapshot` per label set, so the SLO engine can count
+        under-threshold observations across the whole family."""
+        with self._lock:
+            raw = [
+                (self._labels_of(key), list(child.counts), child.sum,
+                 child.count)
+                for key, child in sorted(self._children.items())
+            ]
+        out: List[Tuple[Dict[str, str], Dict[str, object]]] = []
+        for labels, counts, total_sum, total in raw:
+            cumulative = []
+            running = 0
+            for bound, n in zip(self.buckets, counts[:-1]):
+                running += n
+                cumulative.append((bound, running))
+            cumulative.append((math.inf, total))
+            out.append(
+                (labels,
+                 {"buckets": cumulative, "sum": total_sum, "count": total})
+            )
+        return out
+
 
 class MetricsRegistry:
     """One process/server's instrument set.
@@ -368,6 +414,13 @@ class MetricsRegistry:
         return self._get_or_create(
             Histogram, name, help, labelnames, buckets=buckets
         )
+
+    def instrument(self, name: str) -> Optional[_Instrument]:
+        """The registered instrument of that name, or None — the
+        in-process read path the SLO engine evaluates objectives over
+        (absence is the abstention signal, never an error)."""
+        with self._lock:
+            return self._instruments.get(name)
 
     def gauge_callback(
         self,

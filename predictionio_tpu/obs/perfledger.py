@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "SCHEMA_VERSION",
+    "alert_records",
     "append_record",
     "bench_to_record",
     "comparable_key",
@@ -252,6 +253,40 @@ def quality_records(bench: dict, source: str = "bench") -> List[dict]:
             )
         )
     return out
+
+
+def alert_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The alert-noisiness numbers a bench run attached
+    (``bench["alerts"]``, from the in-process brownout drill —
+    docs/slo.md) as trend-only ledger records, so alert hygiene is
+    tracked across BENCH rounds like perf and quality already are:
+
+    - ``alert_false_positives`` — control-run fires plus flaps (unit
+      ``count``, trend-only: the gate only ever compares ``unit ==
+      "s"``; the drill itself is the hard gate — a noisy round fails
+      tier-1, the ledger shows the trajectory).
+
+    A drill that failed (``ok`` false) records nothing — its counts
+    measured a broken drill, not the alerting plane."""
+    alerts = bench.get("alerts")
+    if not isinstance(alerts, dict) or not alerts.get("ok"):
+        return []
+    false_positives = alerts.get("falsePositives")
+    if not isinstance(false_positives, (int, float)):
+        return []
+    return [
+        make_record(
+            source=source,
+            metric="alert_false_positives",
+            value=float(false_positives),
+            unit="count",
+            device=bench.get("device"),
+            extra={
+                "fired": alerts.get("fired"),
+                "cleared": alerts.get("cleared"),
+            },
+        )
+    ]
 
 
 def append_record(path: str, record: dict) -> None:

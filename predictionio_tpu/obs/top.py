@@ -188,6 +188,21 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
         ]
         if mix:
             row["score_psi"] = max(mix)
+    # health plane (docs/slo.md): one HEALTH cell per node — FIRING
+    # objective count beats a stall beats ok; a node with no SLO engine
+    # (pre-health build) shows '-' like every other absent column
+    alert_states = m.get("pio_slo_alert_state")
+    stalls = _series_sum(m, "pio_stall_detected_total")
+    if alert_states is None:
+        row["health"] = None
+    else:
+        firing = sum(1 for _labels, v in alert_states if v == 1)
+        if firing:
+            row["health"] = f"ALERT:{firing}"
+        elif stalls:
+            row["health"] = f"STALL:{int(stalls)}"
+        else:
+            row["health"] = "ok"
     row["hit_rate"] = _series_sum(m, "pio_quality_feedback_hit_rate")
     joined = (
         _series_sum(
@@ -227,6 +242,7 @@ _COLUMNS = (
     ("RTRETRY", "router_retries", "{:.0f}"),
     ("DRIFT", "score_psi", "{:.3f}"),
     ("HITRATE", "hit_rate", "{:.2f}"),
+    ("HEALTH", "health", "{}"),
 )
 
 #: public alias for other fleet renderers (the dashboard's /fleet panel)

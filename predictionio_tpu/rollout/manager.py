@@ -42,6 +42,7 @@ from typing import Any, Deque, Optional
 
 from ..storage import utcnow
 from ..storage.event import to_millis
+from ..obs.flight import record as flight_record
 from ..storage.metadata import (
     ROLLOUT_ABORTED,
     ROLLOUT_CANARY,
@@ -287,6 +288,10 @@ class RolloutManager:
             self._psi_countdown = 0  # last one's cached drift
             self._persist_pending = False
             self._transitions.inc(1, to=ROLLOUT_SHADOW)
+            flight_record(
+                "rollout", "rollout.stage", plan=pid, to=ROLLOUT_SHADOW,
+                candidate=inst.id,
+            )
             logger.info(
                 "rollout %s: candidate %s shadowing baseline %s",
                 pid, inst.id, baseline.id,
@@ -674,6 +679,12 @@ class RolloutManager:
             + [self._history_entry(stage, reason)],
         )
         self._transitions.inc(1, to=stage)
+        # stage changes are the rollout plane's state transitions — the
+        # flight recorder's core vocabulary (docs/slo.md)
+        flight_record(
+            "rollout", "rollout.stage", plan=self.plan.id, to=stage,
+            reason=reason,
+        )
         self._try_persist(self.plan)
 
     def _try_persist(self, plan: RolloutPlan) -> None:
@@ -701,6 +712,10 @@ class RolloutManager:
         )
         self.plan = finished
         self._transitions.inc(1, to=stage)
+        flight_record(
+            "rollout", "rollout.stage", plan=plan.id, to=stage,
+            reason=reason,
+        )
         self._try_persist(finished)
         logger.warning("rollout %s: %s (%s)", plan.id, stage, reason)
 

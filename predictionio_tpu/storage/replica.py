@@ -297,8 +297,19 @@ class StorageReplica(StorageServer):
         :class:`ReplicationError` stops tailing and is surfaced in
         ``/status.json``."""
 
+        watchdog = self.health.watchdog if self.health is not None else None
+        if watchdog is not None:
+            # a tailer that stops looping ENTIRELY (wedged fetch, stuck
+            # apply) is a stall even while pio_replication_lag_ops reads
+            # its last value — the beat watches the loop, not the lag
+            watchdog.expect(
+                "replica.tail", max_gap_s=max(60.0, poll_interval_s * 40)
+            )
+
         def loop() -> None:
             while not self._stop_polling.is_set():
+                if watchdog is not None:
+                    watchdog.beat("replica.tail")
                 try:
                     applied = self.step()
                     self.tailer.last_error = None
@@ -322,6 +333,9 @@ class StorageReplica(StorageServer):
 
     def stop_tailing(self) -> None:
         self._stop_polling.set()
+        if self.health is not None:
+            # a deliberately stopped tailer is not a stall
+            self.health.watchdog.unexpect("replica.tail")
 
     # -- failover ---------------------------------------------------------
     def promote(self, oplog_dir: Optional[str] = None) -> dict:
@@ -353,6 +367,9 @@ class StorageReplica(StorageServer):
             self.primary_url = None
         with self._applied_cond:
             self._applied_cond.notify_all()  # release any waiting reads
+        from ..obs.flight import record as flight_record
+
+        flight_record("promote", "replica.promote", appliedSeq=applied)
         logger.info("replica promoted to primary at seq %d", applied)
         return self.status_json()
 

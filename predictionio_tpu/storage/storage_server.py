@@ -545,7 +545,8 @@ class StorageServer(BackgroundHTTPServer):
         changefeed=None,
     ):
         super().__init__(
-            (host, port), _StorageHandler, tracer=Tracer(self.service_name)
+            (host, port), _StorageHandler, tracer=Tracer(self.service_name),
+            health_kind="storage",
         )
         self.events = events
         self.metadata = metadata

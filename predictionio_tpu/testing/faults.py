@@ -38,6 +38,10 @@ Known sites (grep ``fault_point(`` for the live list):
                          ``fresh``, ``idempotent``)
 - ``serving.feedback``   query server → Event Server feedback POST
 - ``serving.error_log``  query server → ``--log-url`` error POST
+- ``serving.predict``    query server, just before the predict dispatch
+                         (``loadgen --brownout`` wedges it with latency
+                         and refusals — docs/slo.md)
+- ``serving.candidate``  candidate-variant serve (``loadgen --rollout``)
 
 Determinism: per-spec hit counters under one lock; no randomness, no
 wall-clock reads. The harness is stdlib-only, like everything else on

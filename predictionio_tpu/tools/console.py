@@ -711,6 +711,14 @@ def main(
 
         tail = list(sys.argv[2:] if argv is None else argv[1:])
         return quality_mod.main(tail)
+    if head in (["health"], ["alerts"], ["blackbox"]):
+        # the fleet-health CLIs (tools/health.py, docs/slo.md) own their
+        # option surface and are pure scrapers/ledger readers — jax-free,
+        # storage-free, forwarded verbatim with the subcommand included.
+        from . import health as health_mod
+
+        tail = list(sys.argv[2:] if argv is None else argv[1:])
+        return health_mod.main(head + tail)
     if head in (["profile"], ["perf"]):
         # same REMAINDER limitation as lint: these CLIs own their whole
         # option surface (tools/perf.py), so forward verbatim. `perf`
@@ -741,9 +749,17 @@ def main(
     # the interpreter's exit flush can raise noisily): in-process callers
     # (tests, embedding apps) must not inherit a process-killing SIGPIPE.
     prev = None
-    if args.command not in (
+    if args.command in (
         "eventserver", "dashboard", "storageserver", "deploy", "router",
     ):
+        # long-running server commands arm the crash path (docs/slo.md):
+        # with PIO_FLIGHT_DIR set, SIGTERM/exit leaves the flight-
+        # recorder timeline behind; a CLI entry point may own signal
+        # dispositions (run_server does the same for spawned deploys)
+        from ..obs.flight import arm
+
+        arm(signals=True)
+    else:
         try:
             cur = signal.getsignal(signal.SIGPIPE)
             if cur is not None:  # None = C-installed handler: unrestorable,

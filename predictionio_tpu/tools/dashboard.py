@@ -246,6 +246,46 @@ def render_quality(rows) -> str:
     )
 
 
+def render_health(rows) -> str:
+    """``GET /health``: per-node SLO/stall digest scraped from each
+    node's ``/health.json`` (docs/slo.md) — firing objectives, worst
+    fast-window burn, stall counts, abstaining objectives."""
+
+    def fmt(value, spec="{:.2f}"):
+        return "-" if value is None else spec.format(value)
+
+    body = []
+    for row in rows:
+        if not row.get("up"):
+            body.append(
+                f"<tr><td>{html.escape(str(row.get('node', '?')))}</td>"
+                "<td colspan=\"5\">DOWN</td></tr>"
+            )
+            continue
+        firing = row.get("firing") or []
+        body.append(
+            "<tr>"
+            f"<td>{html.escape(str(row.get('node', '?')))}</td>"
+            f"<td>{html.escape(str(row.get('kind', '?')))}</td>"
+            f"<td>{html.escape(' '.join(firing)) or 'ok'}</td>"
+            f"<td>{fmt(row.get('worstBurnFast'))}</td>"
+            f"<td>{row.get('stallsDetected', 0)}</td>"
+            f"<td>{row.get('abstaining', 0)}</td>"
+            "</tr>"
+        )
+    return _page(
+        "Health",
+        "<h1>Health</h1>"
+        "<table><tr><th>NODE</th><th>KIND</th><th>FIRING</th>"
+        "<th>BURN</th><th>STALLS</th><th>ABSTAIN</th></tr>"
+        + "".join(body) + "</table>"
+        "<p>FIRING: objectives whose error budget burns past the "
+        "multi-window threshold; BURN: worst fast-window burn rate; "
+        "STALLS: watchdog detections; ABSTAIN: objectives with no "
+        "data — never read as healthy (docs/slo.md).</p>",
+    )
+
+
 class _DashboardHandler(JsonHTTPHandler):
     server: "DashboardServer"
 
@@ -256,7 +296,29 @@ class _DashboardHandler(JsonHTTPHandler):
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
-        if self.serve_obs(path):  # /metrics + /traces.json
+        # fleet health panel BEFORE serve_obs: on the dashboard,
+        # /health is the scraped fleet view (docs/slo.md), and
+        # /health.json answers the uniform per-node contract (a DICT
+        # with this process's own objectives — `pio health` must never
+        # misread a live dashboard as DOWN) with the scraped fleet rows
+        # riding along under "fleet"
+        if path == "/health":
+            self.respond(
+                200,
+                render_health(self.server.health_rows()),
+                content_type="text/html",
+            )
+            return
+        if path == "/health.json":
+            doc = (
+                self.server.health.health_json()
+                if self.server.health is not None
+                else {}
+            )
+            doc["fleet"] = self.server.health_rows()
+            self.respond(200, doc)
+            return
+        if self.serve_obs(path):  # /metrics, /traces.json, /blackbox.json
             return
         md = self.server.registry.get_metadata()
         if path == "/":
@@ -332,7 +394,10 @@ class DashboardServer(BackgroundHTTPServer):
     def __init__(self, config: DashboardConfig, registry: StorageRegistry):
         self.config = config
         self.registry = registry
-        super().__init__((config.ip, config.port), _DashboardHandler)
+        super().__init__(
+            (config.ip, config.port), _DashboardHandler,
+            health_kind="dashboard",
+        )
 
     def _scrape_nodes(self, per_node) -> list:
         """Run ``per_node(node, timeout)`` over the configured node list
@@ -372,6 +437,19 @@ class DashboardServer(BackgroundHTTPServer):
 
         def scrape(node: str, timeout: float) -> dict:
             report = node_report(node, timeout=timeout)
+            return report if report is not None else {
+                "node": node, "up": False,
+            }
+
+        return self._scrape_nodes(scrape)
+
+    def health_rows(self) -> list:
+        """Scrape the node list's ``/health.json`` for the /health
+        panel (docs/slo.md); a dead node renders DOWN."""
+        from .health import node_health
+
+        def scrape(node: str, timeout: float) -> dict:
+            report = node_health(node, timeout=timeout)
             return report if report is not None else {
                 "node": node, "up": False,
             }

@@ -43,6 +43,7 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import os
 import sys
 import threading
 import time
@@ -253,6 +254,235 @@ def _inprocess_target(engine_dir: str, batching: bool,
         return status
 
     return send, server
+
+
+# ---------------------------------------------------------------------------
+# Toy-train workspace cache: the chaos drills each need a trained toy
+# model, and training is by far their dominant cost. Each recipe trains
+# ONCE per process into a cache dir; every drill run (and every re-run
+# of the same drill in a test module) then clones the finished
+# workspace with a copytree — everything under PIO_FS_BASEDIR (event
+# store, metadata, model blobs) is relocatable by construction, so the
+# clone is a complete independent universe. Tier-1 runs single-process
+# (-p no:xdist), so the cache pays across test FILES, not just within
+# one (the PR 9 sweep_factors pattern applied to the drill fleet).
+# ---------------------------------------------------------------------------
+
+_TOY_CACHE: dict = {}
+_TOY_CACHE_LOCK = threading.Lock()
+
+
+def _prepared_workspace(tag: str, build, dest: str) -> dict:
+    """Clone the cached workspace for ``tag`` into ``dest`` (training it
+    first on the process's first use). ``build(registry)`` trains into a
+    fresh registry rooted at the cache dir and returns a JSON-able info
+    dict (instance ids) persisted alongside."""
+    import atexit
+    import json as _json
+    import os as _os
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..storage import StorageRegistry
+
+    with _TOY_CACHE_LOCK:
+        cached = _TOY_CACHE.get(tag)
+    if cached is None:
+        cache_dir = tempfile.mkdtemp(prefix=f"pio-toytrain-{tag}-")
+        registry = StorageRegistry(env={"PIO_FS_BASEDIR": cache_dir})
+        prev = regmod._default_registry
+        regmod._default_registry = registry  # RecDataSource reads through it
+        try:
+            info = build(registry)
+        finally:
+            regmod._default_registry = prev
+        with open(
+            _os.path.join(cache_dir, "toytrain.json"), "w", encoding="utf-8"
+        ) as fh:
+            _json.dump(info or {}, fh)
+        with _TOY_CACHE_LOCK:
+            cached = _TOY_CACHE.setdefault(tag, cache_dir)
+        if cached != cache_dir:  # lost a build race: drop the duplicate
+            shutil.rmtree(cache_dir, ignore_errors=True)
+        else:
+            atexit.register(shutil.rmtree, cache_dir, ignore_errors=True)
+    shutil.copytree(cached, dest, dirs_exist_ok=True)
+    with open(
+        _os.path.join(dest, "toytrain.json"), encoding="utf-8"
+    ) as fh:
+        return _json.load(fh)
+
+
+def _seed_rating_events(
+    n_users: int, n_items: int, *, seed: int, mod: int,
+    hi: float, lo: float, keep: float, scale: float = 1.0,
+) -> List:
+    """The drill fleet's shared toy corpus: a (u, i) rating lattice —
+    ``hi`` where ``u % mod == i % mod`` else ``lo``, each pair kept with
+    probability ``keep`` under a fixed rng seed. ONE generator for every
+    builder, so a corpus-shape change can never apply to three drills
+    and miss the fourth."""
+    from ..storage import DataMap, Event
+
+    rng = np.random.default_rng(seed)
+    return [
+        Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            properties=DataMap(
+                {"rating": scale * (hi if (u % mod) == (i % mod) else lo)}
+            ),
+        )
+        for u in range(n_users)
+        for i in range(n_items)
+        if rng.random() < keep
+    ]
+
+
+def _toy_engine_params(app_id: int = 1, iterations: int = 2):
+    from ..controller.engine import EngineParams
+    from ..models.recommendation import (
+        ALSAlgorithmParams,
+        RecDataSourceParams,
+    )
+
+    return EngineParams(
+        data_source_params=("", RecDataSourceParams(app_id=app_id)),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=4, num_iterations=iterations)),
+        ],
+    )
+
+
+def _build_score_drift_workspace(
+    registry, n_users: int, n_items: int, skew: float
+) -> dict:
+    """Baseline + skew-scaled candidate for ``--score-drift``."""
+    from ..controller import WorkflowParams
+    from ..models.recommendation import engine_factory
+    from ..workflow.core_workflow import run_train
+
+    app_id = 1
+    events_store = registry.get_events()
+    events_store.init(app_id)
+
+    def seed(scale: float) -> List:
+        # fixed rng seed per call: baseline and candidate must sample
+        # the SAME (u, i) subset — the drill's premise is a pure
+        # distribution shift, not a data change
+        return _seed_rating_events(
+            n_users, n_items, seed=13, mod=3, hi=5.0, lo=2.0,
+            keep=0.8, scale=scale,
+        )
+
+    engine = engine_factory()
+    ep = _toy_engine_params(app_id)
+    events_store.write(seed(1.0), app_id)
+    baseline_id = run_train(
+        engine, ep, registry,
+        workflow_params=WorkflowParams(batch="drift-baseline"),
+    )
+    # the skewed candidate: SAME interactions, ratings x skew — its
+    # learned factors reproduce the scaled matrix, so every score it
+    # serves is ~skew x the baseline's (a pure distribution shift)
+    events_store.remove(app_id)
+    events_store.init(app_id)
+    events_store.write(seed(skew), app_id)
+    candidate_id = run_train(
+        engine, ep, registry,
+        workflow_params=WorkflowParams(batch="drift-candidate"),
+    )
+    return {
+        "baselineInstanceId": baseline_id,
+        "candidateInstanceId": candidate_id,
+    }
+
+
+def _build_fleet_workspace(registry, n_users: int, n_items: int) -> dict:
+    """Baseline + candidate for ``--replicas`` (the sharded mode uses
+    only the baseline; training both here lets one cache serve both
+    drill modes)."""
+    from ..controller import WorkflowParams
+    from ..models.recommendation import engine_factory
+    from ..workflow.core_workflow import run_train
+
+    app_id = 1
+    events_store = registry.get_events()
+    events_store.init(app_id)
+    events_store.write(
+        _seed_rating_events(
+            n_users, n_items, seed=11, mod=3, hi=5.0, lo=2.0, keep=0.8
+        ),
+        app_id,
+    )
+    engine = engine_factory()
+    ep = _toy_engine_params(app_id)
+    baseline_id = run_train(
+        engine, ep, registry,
+        workflow_params=WorkflowParams(batch="fleet-baseline"),
+    )
+    candidate_id = run_train(
+        engine, ep, registry,
+        workflow_params=WorkflowParams(batch="fleet-candidate"),
+    )
+    return {
+        "baselineInstanceId": baseline_id,
+        "candidateInstanceId": candidate_id,
+    }
+
+
+def _build_feedback_workspace(registry, n_users: int, n_items: int) -> dict:
+    """App + access key + seed corpus + baseline train for
+    ``--feedback-stream`` (pre-changefeed history: the loop only ever
+    folds what arrives AFTER its cursor)."""
+    from ..controller import WorkflowParams
+    from ..models.recommendation import engine_factory
+    from ..storage.metadata import AccessKey, App
+    from ..workflow.core_workflow import run_train
+
+    app_id = 1
+    md = registry.get_metadata()
+    events_store = registry.get_events()
+    events_store.init(app_id)
+    md.app_insert(App(id=app_id, name="feedback-stream"))
+    md.access_key_insert(AccessKey(key="LG", appid=app_id, events=[]))
+    events_store.write(
+        _seed_rating_events(
+            n_users, n_items, seed=7, mod=2, hi=5.0, lo=1.0, keep=0.7
+        ),
+        app_id,
+    )
+    engine = engine_factory()
+    ep = _toy_engine_params(app_id, iterations=3)
+    run_train(
+        engine, ep, registry,
+        workflow_params=WorkflowParams(batch="feedback-stream-baseline"),
+    )
+    return {}
+
+
+def _build_brownout_workspace(registry, n_users: int, n_items: int) -> dict:
+    """One baseline model for ``--brownout``."""
+    from ..controller import WorkflowParams
+    from ..models.recommendation import engine_factory
+    from ..workflow.core_workflow import run_train
+
+    app_id = 1
+    events_store = registry.get_events()
+    events_store.init(app_id)
+    events_store.write(
+        _seed_rating_events(
+            n_users, n_items, seed=23, mod=2, hi=5.0, lo=2.0, keep=0.8
+        ),
+        app_id,
+    )
+    engine = engine_factory()
+    baseline_id = run_train(
+        engine, _toy_engine_params(app_id), registry,
+        workflow_params=WorkflowParams(batch="brownout-baseline"),
+    )
+    return {"baselineInstanceId": baseline_id}
 
 
 def run_storage_chaos(
@@ -565,17 +795,10 @@ def run_score_drift(
     import tempfile
 
     import predictionio_tpu.storage.registry as regmod
-    from ..controller import WorkflowParams
-    from ..controller.engine import EngineParams
-    from ..models.recommendation import (
-        ALSAlgorithmParams,
-        RecDataSourceParams,
-        engine_factory,
-    )
+    from ..models.recommendation import engine_factory
     from ..obs.quality import QualityConfig
-    from ..storage import DataMap, Event, StorageRegistry
+    from ..storage import StorageRegistry
     from ..testing.clock import FakeClock
-    from ..workflow.core_workflow import run_train
     from ..workflow.serving import QueryServer, ServerConfig
 
     tmp = base_dir or tempfile.mkdtemp(prefix="pio-score-drift-")
@@ -587,51 +810,16 @@ def run_score_drift(
                     "skew": skew, "maxScorePsi": max_score_psi}
     server = restarted = None
     try:
-        app_id = 1
-        events_store = registry.get_events()
-        events_store.init(app_id)
-
-        def seed(scale: float) -> List:
-            # fresh rng per call: baseline and candidate must sample the
-            # SAME (u, i) subset — the drill's premise is a pure
-            # distribution shift, not a data change
-            rng = np.random.default_rng(13)
-            return [
-                Event(
-                    event="rate", entity_type="user", entity_id=f"u{u}",
-                    target_entity_type="item", target_entity_id=f"i{i}",
-                    properties=DataMap(
-                        {"rating": scale
-                         * (5.0 if (u % 3) == (i % 3) else 2.0)}
-                    ),
-                )
-                for u in range(n_users)
-                for i in range(n_items)
-                if rng.random() < 0.8
-            ]
-
         engine = engine_factory()
-        ep = EngineParams(
-            data_source_params=("", RecDataSourceParams(app_id=app_id)),
-            algorithm_params_list=[
-                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
-            ],
+        info = _prepared_workspace(
+            f"score-drift-{n_users}x{n_items}-{skew:g}",
+            lambda reg: _build_score_drift_workspace(
+                reg, n_users=n_users, n_items=n_items, skew=skew
+            ),
+            tmp,
         )
-        events_store.write(seed(1.0), app_id)
-        baseline_id = run_train(
-            engine, ep, registry,
-            workflow_params=WorkflowParams(batch="drift-baseline"),
-        )
-        # the skewed candidate: SAME interactions, ratings × skew — its
-        # learned factors reproduce the scaled matrix, so every score it
-        # serves is ~skew× the baseline's (a pure distribution shift)
-        events_store.remove(app_id)
-        events_store.init(app_id)
-        events_store.write(seed(skew), app_id)
-        candidate_id = run_train(
-            engine, ep, registry,
-            workflow_params=WorkflowParams(batch="drift-candidate"),
-        )
+        baseline_id = info["baselineInstanceId"]
+        candidate_id = info["candidateInstanceId"]
         report["baselineInstanceId"] = baseline_id
         report["candidateInstanceId"] = candidate_id
 
@@ -775,20 +963,12 @@ def run_feedback_stream(
     import predictionio_tpu.storage.registry as regmod
     from ..api.event_server import EventServer, EventServerConfig
     from ..continuous.controller import ContinuousConfig
-    from ..controller import WorkflowParams
-    from ..controller.engine import EngineParams
-    from ..models.recommendation import (
-        ALSAlgorithmParams,
-        RecDataSourceParams,
-        engine_factory,
-    )
-    from ..storage import DataMap, Event, StorageRegistry
+    from ..models.recommendation import engine_factory
+    from ..storage import StorageRegistry
     from ..storage.changefeed import Changefeed
-    from ..storage.metadata import AccessKey, App
     from ..storage.oplog import OpLog
     from ..storage.remote import RemoteEventStore
     from ..storage.storage_server import StorageServer
-    from ..workflow.core_workflow import run_train
     from ..workflow.serving import QueryServer, ServerConfig
 
     tmp = base_dir or tempfile.mkdtemp(prefix="pio-feedback-stream-")
@@ -800,39 +980,16 @@ def run_feedback_stream(
     storage_srv = event_srv = server = None
     try:
         app_id = 1
+        _prepared_workspace(
+            f"feedback-{n_users}x{n_items}",
+            lambda reg: _build_feedback_workspace(
+                reg, n_users=n_users, n_items=n_items
+            ),
+            tmp,
+        )
         md = registry.get_metadata()
         events_store = registry.get_events()
-        events_store.init(app_id)
-        md.app_insert(App(id=app_id, name="feedback-stream"))
-        md.access_key_insert(AccessKey(key="LG", appid=app_id, events=[]))
-
-        # seed corpus + baseline train (pre-changefeed history: the loop
-        # only ever folds what arrives AFTER its cursor)
-        rng = np.random.default_rng(7)
-        seed_events = [
-            Event(
-                event="rate", entity_type="user", entity_id=f"u{u}",
-                target_entity_type="item", target_entity_id=f"i{i}",
-                properties=DataMap(
-                    {"rating": 5.0 if (u % 2) == (i % 2) else 1.0}
-                ),
-            )
-            for u in range(n_users)
-            for i in range(n_items)
-            if rng.random() < 0.7
-        ]
-        events_store.write(seed_events, app_id)
         engine = engine_factory()
-        ep = EngineParams(
-            data_source_params=("", RecDataSourceParams(app_id=app_id)),
-            algorithm_params_list=[
-                ("als", ALSAlgorithmParams(rank=4, num_iterations=3)),
-            ],
-        )
-        run_train(
-            engine, ep, registry,
-            workflow_params=WorkflowParams(batch="feedback-stream-baseline"),
-        )
 
         storage_srv = StorageServer(
             "127.0.0.1", 0, events_store, md, registry.get_models(),
@@ -1009,6 +1166,252 @@ def run_feedback_stream(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_brownout(
+    queries: int = 30,
+    wedge_errors: int = 10,
+    wedge_slow: int = 10,
+    wedge_latency_ms: float = 250.0,
+    n_users: int = 12,
+    n_items: int = 8,
+    base_dir: Optional[str] = None,
+) -> dict:
+    """Brownout chaos scenario (``--brownout``, docs/slo.md).
+
+    The fleet-health plane's acceptance proof: a backend that is *sick,
+    not dead* — fault-injected latency and refusals on the predict path
+    (``serving.predict``), never a kill — is exactly the failure every
+    pre-existing drill misses (a killed backend fails over; a wedged one
+    just gets slow and wrong). Four phases on one injected clock:
+
+    1. **control** — clean traffic over the full fast window; the SLO
+       engine must fire ZERO alerts (the false-positive bar);
+    2. **stall** — one request wedges in flight past the watchdog bar;
+       the watchdog fires ``pio_stall_detected_total{site}`` and dumps
+       the flight-recorder ring durably, naming the wedged site;
+    3. **wedge** — injected 500s and slow answers burn the availability
+       and latency error budgets in BOTH windows → durable FIRING
+       alerts in the ledger;
+    4. **recovery** — the fault clears, clean traffic drains the fast
+       window → durable CLEARED alerts.
+
+    Acceptance: stall dump names the wedged site, both alerts fire AND
+    clear durably, zero false positives (no control alerts, no flaps).
+    """
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..models.recommendation import engine_factory
+    from ..obs.flight import load_dump
+    from ..obs.slo import HealthConfig, SLOObjective, load_alerts
+    from ..storage import StorageRegistry
+    from ..testing import faults
+    from ..testing.clock import FakeClock
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    tmp = base_dir or tempfile.mkdtemp(prefix="pio-brownout-")
+    owns_tmp = base_dir is None
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": tmp})
+    prev_registry = regmod._default_registry
+    regmod._default_registry = registry
+    report: dict = {
+        "mode": "brownout",
+        "wedgeErrors": wedge_errors,
+        "wedgeSlow": wedge_slow,
+    }
+    ledger = os.path.join(tmp, "alert-ledger.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+    server = None
+    try:
+        engine = engine_factory()
+        info = _prepared_workspace(
+            f"brownout-{n_users}x{n_items}",
+            lambda reg: _build_brownout_workspace(
+                reg, n_users=n_users, n_items=n_items
+            ),
+            tmp,
+        )
+        clock = FakeClock()
+        # drill-sized objectives: the production shapes (availability
+        # over status codes, latency over the serving histogram; fast
+        # 5 m / slow 1 h windows) at toy-traffic sample floors
+        objectives = (
+            SLOObjective(
+                name="availability", kind="ratio",
+                metric="pio_http_responses_total", target=0.999,
+                burn_threshold=8.0, min_window_events=10,
+            ),
+            SLOObjective(
+                name="latency", kind="ratio",
+                metric="pio_serving_request_seconds",
+                latency_threshold_s=0.128, target=0.99,
+                burn_threshold=8.0, min_window_events=10,
+            ),
+        )
+        server = QueryServer(
+            ServerConfig(
+                ip="127.0.0.1", port=0, batching=False,
+                engine_instance_id=info["baselineInstanceId"],
+                health=HealthConfig(
+                    alert_ledger=ledger,
+                    flight_dir=flight_dir,
+                    tick_s=0,  # the drill drives ticks on the fake clock
+                    objectives=objectives,
+                ),
+            ),
+            engine, registry, clock=clock,
+        )
+        server.start_background()
+        plane = server.health
+        assert plane is not None
+        target = _http_target(
+            f"http://127.0.0.1:{server.bound_port}/queries.json"
+        )
+        payloads = _expand_payloads(
+            '{"user": "u{i}", "num": 5}', n=n_users
+        )
+
+        def drive(n: int) -> dict:
+            counts: dict = {}
+            for i in range(n):
+                try:
+                    status = target(payloads[i % len(payloads)])
+                except Exception:
+                    status = -1
+                counts[status] = counts.get(status, 0) + 1
+            return counts
+
+        def fired_total(summary: dict) -> int:
+            return sum(o["fired"] for o in summary["objectives"])
+
+        # -- phase 1: control — a full fast window of clean traffic ----
+        summary: dict = {}
+        for _ in range(5):
+            drive(max(4, queries // 5))
+            clock.advance(60)
+            summary = plane.tick()
+        report["controlAlertsFired"] = fired_total(summary)
+
+        # -- phase 2: one wedged in-flight request → stall + dump ------
+        # the "latency" fault's sleep is INJECTED: the wedged request
+        # blocks on an Event only released AFTER the watchdog has run,
+        # so the stall detection is deterministic — no real-time window
+        # between "seen in flight" and "checked" to lose on a loaded box
+        release = threading.Event()
+        faults.activate(
+            faults.FaultSpec(
+                site="serving.predict", kind="latency",
+                arg=1.0, times=1,
+            ),
+            sleep=lambda _s: release.wait(timeout=30.0),
+        )
+        wedged = threading.Thread(
+            target=lambda: drive(1), daemon=True
+        )
+        wedged.start()
+        watchdog = plane.watchdog
+        for _ in range(1000):  # bounded wait: the request cannot exit
+            if watchdog.summary()["inflight"] > 0:
+                break
+            time.sleep(0.01)
+        report["inflightSeen"] = watchdog.summary()["inflight"]
+        clock.advance(60)  # fake: far past stall_factor x default budget
+        plane.tick()
+        release.set()
+        wedged.join(timeout=10)
+        faults.deactivate()
+        stall_summary = watchdog.summary()
+        report["stallsDetected"] = stall_summary["detected"]
+        report["stallDump"] = stall_summary["lastDump"]
+        dump = (
+            load_dump(stall_summary["lastDump"])
+            if stall_summary["lastDump"]
+            else None
+        )
+        report["stallDumpNamesSite"] = bool(
+            dump
+            and any(
+                e.get("kind") == "stall"
+                and e.get("site") == "serving.request"
+                for e in dump["events"]
+            )
+        )
+
+        # -- phase 3: the wedge — errors + slow answers, alerts FIRE ---
+        faults.activate(
+            faults.FaultSpec(
+                site="serving.predict", kind="refuse",
+                times=wedge_errors,
+            ),
+            faults.FaultSpec(
+                site="serving.predict", kind="latency",
+                arg=wedge_latency_ms, times=wedge_slow,
+            ),
+        )
+        wedge_counts = drive(wedge_errors + wedge_slow + 4)
+        faults.deactivate()
+        report["wedgeStatuses"] = {
+            str(k): v for k, v in sorted(wedge_counts.items())
+        }
+        clock.advance(60)
+        summary = plane.tick()
+        report["firedAfterWedge"] = sorted(
+            o["name"] for o in summary["objectives"]
+            if o["state"] == "FIRING"
+        )
+
+        # -- phase 4: recovery — fast window drains, alerts CLEAR ------
+        for _ in range(6):
+            drive(max(4, queries // 5))
+            clock.advance(60)
+            summary = plane.tick()
+        report["firingAfterRecovery"] = summary["firing"]
+
+        per_objective = {
+            o["name"]: (o["fired"], o["cleared"])
+            for o in summary["objectives"]
+        }
+        report["alerts"] = {
+            name: {"fired": fired, "cleared": cleared}
+            for name, (fired, cleared) in sorted(per_objective.items())
+        }
+        # flaps (an objective firing more than once) are false alerts,
+        # exactly like a control-run fire
+        report["falsePositives"] = report["controlAlertsFired"] + sum(
+            max(0, fired - 1) for fired, _ in per_objective.values()
+        )
+        durable = load_alerts(ledger)
+        report["ledger"] = [
+            {"objective": a["objective"], "state": a["state"]}
+            for a in durable
+        ]
+        expected = {
+            ("availability", "FIRING"), ("availability", "CLEARED"),
+            ("latency", "FIRING"), ("latency", "CLEARED"),
+        }
+        seen = {(a["objective"], a["state"]) for a in durable}
+        report["ok"] = bool(
+            report["controlAlertsFired"] == 0
+            and report["stallsDetected"] >= 1
+            and report["stallDumpNamesSite"]
+            and expected <= seen
+            and report["firedAfterWedge"] == ["availability", "latency"]
+            and report["firingAfterRecovery"] == 0
+            and report["falsePositives"] == 0
+        )
+        return report
+    finally:
+        faults.deactivate()
+        regmod._default_registry = prev_registry
+        if server is not None:
+            try:
+                server.server_close()
+            except Exception:
+                pass
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_fleet_chaos(
     replicas: int = 3,
     sharded: bool = False,
@@ -1049,18 +1452,11 @@ def run_fleet_chaos(
     import tempfile
 
     import predictionio_tpu.storage.registry as regmod
-    from ..controller import WorkflowParams
-    from ..controller.engine import EngineParams
     from ..fleet.router import RouterConfig, RouterServer, VARIANT_HEADER
-    from ..models.recommendation import (
-        ALSAlgorithmParams,
-        RecDataSourceParams,
-        engine_factory,
-    )
+    from ..models.recommendation import engine_factory
     from ..obs.expo import parse_text as _parse_expo
     from ..obs.expo import render as _render_expo
-    from ..storage import DataMap, Event, StorageRegistry
-    from ..workflow.core_workflow import run_train
+    from ..storage import StorageRegistry
     from ..workflow.serving import QueryServer, ServerConfig
 
     if replicas < 2:
@@ -1089,41 +1485,16 @@ def run_fleet_chaos(
     backends: List[QueryServer] = []
     router = reference = None
     try:
-        app_id = 1
-        events_store = registry.get_events()
-        events_store.init(app_id)
-        rng = np.random.default_rng(11)
-        seed_events = [
-            Event(
-                event="rate", entity_type="user", entity_id=f"u{u}",
-                target_entity_type="item", target_entity_id=f"i{i}",
-                properties=DataMap(
-                    {"rating": 5.0 if (u % 3) == (i % 3) else 2.0}
-                ),
-            )
-            for u in range(n_users)
-            for i in range(n_items)
-            if rng.random() < 0.8
-        ]
-        events_store.write(seed_events, app_id)
-
         engine = engine_factory()
-        ep = EngineParams(
-            data_source_params=("", RecDataSourceParams(app_id=app_id)),
-            algorithm_params_list=[
-                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
-            ],
+        info = _prepared_workspace(
+            f"fleet-{n_users}x{n_items}",
+            lambda reg: _build_fleet_workspace(
+                reg, n_users=n_users, n_items=n_items
+            ),
+            tmp,
         )
-        baseline_id = run_train(
-            engine, ep, registry,
-            workflow_params=WorkflowParams(batch="fleet-baseline"),
-        )
-        candidate_id = None
-        if not sharded:
-            candidate_id = run_train(
-                engine, ep, registry,
-                workflow_params=WorkflowParams(batch="fleet-candidate"),
-            )
+        baseline_id = info["baselineInstanceId"]
+        candidate_id = None if sharded else info["candidateInstanceId"]
 
         def backend_config(i: int) -> ServerConfig:
             return ServerConfig(
@@ -1424,6 +1795,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "candidate for --score-drift")
     p.add_argument("--max-score-psi", type=float, default=0.25,
                    help="PSI gate threshold for --score-drift")
+    p.add_argument("--brownout", action="store_true",
+                   help="brownout chaos scenario (docs/slo.md): wedge "
+                        "the predict path with injected latency + "
+                        "refusals (not a kill); asserts the stall "
+                        "watchdog dumps forensics naming the wedged "
+                        "site and the availability/latency SLO burn "
+                        "alerts fire then CLEAR durably, with zero "
+                        "false alerts on the clean control phase")
     p.add_argument("--feedback-stream", action="store_true",
                    help="closed-loop freshness scenario "
                         "(docs/continuous.md): in-process storage "
@@ -1477,6 +1856,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result = run_score_drift(
             skew=args.skew, max_score_psi=args.max_score_psi
         )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.brownout:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_brownout()
         print(json.dumps(result))
         return 0 if result["ok"] else 1
 

@@ -145,6 +145,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # serving compiles per (batch-shape, depth); the loadgen sweep deploys
     # this server once per pipeline depth — warm starts matter there
     enable_compilation_cache()
+    # arm the crash path (docs/slo.md): with PIO_FLIGHT_DIR set, a dying
+    # server leaves its flight-recorder timeline and faulthandler stacks
+    # behind; signals=True also dumps on SIGTERM (CLI entry points only —
+    # a library import must never steal signal dispositions)
+    from ..obs.flight import arm
+
+    arm(signals=True)
     args = build_parser().parse_args(argv)
     make_server(args, block=True)
     return 0

@@ -361,32 +361,56 @@ class CircuitBreaker:
                 self._probes_in_flight += 1
 
     def record_success(self) -> None:
+        closed = False
         with self._lock:
             if self._state == self.HALF_OPEN:
                 self._probes_in_flight = max(0, self._probes_in_flight - 1)
                 self._state = self.CLOSED
+                closed = True
             self._consecutive_failures = 0
+        if closed:
+            self._flight("closed")
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             if self._state == self.HALF_OPEN:
                 # a failed probe re-opens immediately: the dependency is
                 # still down, restart the cooldown
                 self._probes_in_flight = max(0, self._probes_in_flight - 1)
                 self._trip()
-                return
-            self._consecutive_failures += 1
-            if (
-                self._state == self.CLOSED
-                and self._consecutive_failures >= self.failure_threshold
-            ):
-                self._trip()
+                tripped = True
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self._state == self.CLOSED
+                    and self._consecutive_failures >= self.failure_threshold
+                ):
+                    self._trip()
+                    tripped = True
+        if tripped:
+            self._flight("open")
 
     def _trip(self) -> None:  # caller holds the lock
         self._state = self.OPEN
         self._opened_at = self._clock()
         self._open_count += 1
         self._consecutive_failures = 0
+
+    def _flight(self, to_state: str) -> None:
+        """Breaker transitions are exactly the events a post-mortem
+        needs on the timeline — tap the process flight recorder
+        (docs/slo.md), OUTSIDE the breaker lock, best-effort (a
+        forensics fault must never affect the breaker)."""
+        try:
+            from ..obs.flight import record
+
+            record(
+                "breaker", f"breaker.{self.name or 'anonymous'}",
+                state=to_state, opens=self._open_count,
+            )
+        except Exception:
+            pass
 
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         """Run ``fn`` under the breaker: admission check, then outcome

@@ -55,6 +55,7 @@ import requests
 
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..controller.engine import Engine, EngineParams
+from ..obs.flight import record as flight_record
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import TRACE_HEADER, SpanContext, Tracer, current_context
 from ..rollout.manager import RolloutError, RolloutManager
@@ -148,6 +149,11 @@ class ServerConfig:
     #: feedback-join monitor every query server carries
     #: (docs/observability.md#quality). None = defaults.
     quality: Optional[Any] = None
+    #: Fleet-health knobs: a ``HealthConfig``
+    #: (``predictionio_tpu/obs/slo``) for the SLO burn-rate engine,
+    #: stall watchdog and flight recorder every server carries
+    #: (docs/slo.md). None = env defaults.
+    health: Optional[Any] = None
     #: Sharded-model serving (docs/fleet.md): with ``shard_count > 1``
     #: this server holds only partition ``shard_index`` of the item
     #: factors (item row ``i`` lives on shard ``i % shard_count``) and
@@ -778,11 +784,24 @@ class QueryServer(BackgroundHTTPServer):
                 "Lifetime breaker open transitions",
                 labels={"dep": dep},
             )
+        # Observer-fault accounting (docs/slo.md): every swallowed
+        # observer/monitor exception is COUNTED, never just debug-logged
+        # — a quality monitor that starts throwing on every query is
+        # invisible in logs and a flat line on this counter is the
+        # proof the observers are healthy (the obs-swallowed-observer
+        # lint rule pins the pattern).
+        self._observer_errors = metrics.counter(
+            "pio_observer_errors_total",
+            "Swallowed observer/monitor exceptions by site",
+            labelnames=("site",),
+        )
         super().__init__(
             (config.ip, config.port),
             _QueryHandler,
             metrics=metrics,
             tracer=tracer,
+            health_kind="query",
+            health_config=config.health,
         )
         self._export_train_phases()
         # Rollout plane (docs/rollouts.md): the manager owns any staged
@@ -876,6 +895,33 @@ class QueryServer(BackgroundHTTPServer):
         """One query end to end. ``info`` (when given) is filled with the
         serving ``variant`` (and ``fallback`` on candidate containment)
         — the handler forwards it into span tags and response labels."""
+        # Stall watchdog (docs/slo.md): every in-flight request is
+        # tracked with its deadline budget — a request still running at
+        # a multiple of that budget is a wedge the watchdog dumps
+        # forensics for, whether or not the client is still waiting.
+        watchdog = self.health.watchdog if self.health is not None else None
+        token = (
+            watchdog.enter(
+                "serving.request",
+                budget_s=(
+                    deadline.remaining_s() if deadline is not None else None
+                ),
+            )
+            if watchdog is not None
+            else None
+        )
+        try:
+            return self._handle_query_tracked(payload, deadline, info)
+        finally:
+            if watchdog is not None:
+                watchdog.exit(token)
+
+    def _handle_query_tracked(
+        self,
+        payload: Any,
+        deadline: Optional[Deadline] = None,
+        info: Optional[dict] = None,
+    ) -> Tuple[Any, int]:
         started = time.monotonic()
         query_time = utcnow()
         rollout = self.rollout
@@ -957,10 +1003,12 @@ class QueryServer(BackgroundHTTPServer):
         # Quality plane: score distribution + the served-list record the
         # feedback join reads. BEFORE the prId stamp, like the shadow
         # duplicate — the signals describe the model's answer. Swallowed
-        # on error: observability must never fail a query.
+        # on error but COUNTED (docs/slo.md): observability must never
+        # fail a query, and a failing observer must never be invisible.
         try:
             self.quality.observe_result(variant, payload, result)
         except Exception:
+            self._observer_errors.inc(1, site="serving.quality")
             logger.debug("quality observe failed", exc_info=True)
 
         # Shadow duplication BEFORE the feedback prId stamp: divergence
@@ -1000,6 +1048,11 @@ class QueryServer(BackgroundHTTPServer):
                 # the load-shed moment that matters most: an expired query
                 # must never occupy a device slot (ISSUE 2 tentpole)
                 deadline.check("dispatch")
+            # chaos hook (docs/slo.md): the loadgen --brownout scenario
+            # wedges the predict path here — fault-injected latency and
+            # refusals, not a kill — proving the stall watchdog and the
+            # SLO burn alerts on a backend that is sick, not dead
+            fault_point("serving.predict", instance=dep.instance.id)
             if variant == CANDIDATE:
                 # chaos hook: the loadgen --rollout scenario fails the
                 # candidate exactly here, proving auto-rollback with
@@ -1260,7 +1313,12 @@ class QueryServer(BackgroundHTTPServer):
         try:
             self.quality.model_live(dep.instance.id)
         except Exception:
+            self._observer_errors.inc(1, site="serving.quality")
             logger.debug("quality re-pin failed", exc_info=True)
+        flight_record(
+            "deploy", "serving.adopt",
+            fromInstance=old, toInstance=dep.instance.id,
+        )
         logger.info(
             "Deployment swapped: engine instance %s -> %s",
             old, dep.instance.id,
@@ -1306,7 +1364,12 @@ class QueryServer(BackgroundHTTPServer):
         try:
             self.quality.model_live(fresh.instance.id)
         except Exception:
+            self._observer_errors.inc(1, site="serving.quality")
             logger.debug("quality re-pin failed", exc_info=True)
+        flight_record(
+            "deploy", "serving.reload",
+            fromInstance=old, toInstance=fresh.instance.id,
+        )
         logger.info(
             "Reloaded: engine instance %s -> %s", old, fresh.instance.id
         )

@@ -1,0 +1,38 @@
+"""Observability bug class: an observer path swallowing uncounted.
+
+The swallow itself is correct — a quality monitor must never fail the
+query it observes — but without a counter bump the monitor can be
+broken on EVERY call (schema change, corrupt state) and look exactly
+like a healthy one. ``obs-swallowed-observer`` must flag the three
+handlers below (and nothing else in this file).
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def serve(server, variant, payload, result):
+    # observer call in the try body, handler only logs: BAD
+    try:
+        server.quality.observe_result(variant, payload, result)
+    except Exception:
+        logger.debug("quality observe failed", exc_info=True)
+
+
+def _observe_quality(self, app_id, event):
+    # observer-named function, bare-pass swallow: BAD
+    try:
+        self.quality.record_event(app_id, event)
+    except Exception:
+        pass
+
+
+def drain(watcher, event):
+    # logger.error is still a LOG, not a counter: BAD
+    try:
+        watcher.on_event(event)
+    except Exception:
+        logger.error("tap failed")

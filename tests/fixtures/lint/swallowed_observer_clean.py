@@ -1,0 +1,53 @@
+"""Clean twin of ``swallowed_observer_bad.py``: every swallowed
+observer failure is *counted* — a handler counter bump, an error hook,
+or an outcome counter in the try's ``finally``. The linter must report
+NOTHING for this file.
+
+Fixture only: parsed by the linter, never imported or executed.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def serve(server, variant, payload, result):
+    # swallowed but counted: the canonical pattern
+    try:
+        server.quality.observe_result(variant, payload, result)
+    except Exception:
+        server._observer_errors.inc(1, site="serving.quality")
+        logger.debug("quality observe failed", exc_info=True)
+
+
+def drain(watcher, event):
+    # hook-shaped accounting: the object has no registry of its own,
+    # the owner wired an error hook that does the counting
+    try:
+        watcher.on_event(event)
+    except Exception:
+        if watcher.on_event_error is not None:
+            watcher.on_event_error()
+        logger.debug("tap failed", exc_info=True)
+
+
+def shadow(manager, quality, scores, events_counter, elapsed):
+    # accounting in the finally: the outcome counter records ok/error
+    # for every path through the try, handler included
+    ok = False
+    try:
+        quality.record_scores("candidate", scores)
+        ok = True
+    except Exception:
+        logger.debug("shadow record failed", exc_info=True)
+    finally:
+        events_counter.inc(1, kind="shadow_ok" if ok else "shadow_error")
+
+
+def unrelated(store, row):
+    # not an observer path at all: a storage write may swallow-and-log
+    # under its own rules without this family firing
+    try:
+        store.insert(row)
+    except Exception:
+        logger.warning("insert failed", exc_info=True)

@@ -1,0 +1,904 @@
+"""Fleet-health plane tests (ISSUE 11, docs/slo.md).
+
+1. **SLO engine** (`obs/slo.py`): burn-rate math over status counters /
+   latency histograms / gauges on injected clocks, the multi-window
+   fire+clear state machine, the explicit-abstention contract ("no
+   data is never a verdict" — absent series, the ``-1`` gauge
+   sentinel, thin windows, counter resets; a FIRING alert never clears
+   on data loss), and the durable fsynced alert ledger.
+2. **Flight recorder + stall watchdog** (`obs/flight.py`): bounded
+   ring, the ZERO-COST disabled path (counting clock — the PR 8
+   profiler contract), durable dumps, in-flight-request and
+   wedged-tick stall detection with forensic dumps naming the site.
+3. **Wiring**: every server answers ``/health.json`` +
+   ``/blackbox.json``; breaker transitions land in the process flight
+   recorder; ``pio top`` grows the HEALTH column.
+4. **CLIs** (`tools/health.py`): `pio health` / `pio alerts` /
+   `pio blackbox` with the pinned 0/1/2 exit codes, driven in-process.
+5. **The `loadgen --brownout` drill** (tier-1 acceptance): module-
+   scoped — ONE drill run (on the process-cached toy-train workspace),
+   many cheap assertions, the PR 9 `sweep_factors` pattern.
+6. **Metric-catalog golden test**: every `pio_*` instrument registered
+   at server boot is pinned against the table in
+   docs/observability.md#metric-catalog.
+
+Everything engine-side runs on injected clocks with zero wall-clock
+sleeps; the wiring tests use a handful of real loopback round trips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+from predictionio_tpu.obs.flight import (  # noqa: E402
+    FlightRecorder,
+    StallWatchdog,
+    load_dump,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry  # noqa: E402
+from predictionio_tpu.obs.slo import (  # noqa: E402
+    SLOEngine,
+    SLOObjective,
+    default_objectives,
+    load_alerts,
+)
+from predictionio_tpu.testing.clock import FakeClock  # noqa: E402
+
+
+def _ratio_objectives(**overrides):
+    base = dict(
+        target=0.999, burn_threshold=8.0, min_window_events=10,
+        fast_window_s=300.0, slow_window_s=3600.0,
+    )
+    base.update(overrides)
+    return (
+        SLOObjective(
+            name="availability", kind="ratio",
+            metric="pio_http_responses_total", **base,
+        ),
+    )
+
+
+class _Plant:
+    """One registry + engine + traffic pump on a fake clock."""
+
+    def __init__(self, objectives=None, ledger=None):
+        self.clock = FakeClock()
+        self.metrics = MetricsRegistry(clock=self.clock)
+        self.resp = self.metrics.counter(
+            "pio_http_responses_total", labelnames=("status",)
+        )
+        self.hist = self.metrics.histogram("pio_serving_request_seconds")
+        self.engine = SLOEngine(
+            self.metrics,
+            objectives if objectives is not None else _ratio_objectives(),
+            clock=self.clock,
+            ledger_path=ledger,
+        )
+
+    def pump(self, rounds, good=20, bad=0, latency=0.005, advance=60.0):
+        summary = None
+        for _ in range(rounds):
+            for _ in range(good):
+                self.resp.inc(1, status=200)
+                self.hist.observe(latency)
+            for _ in range(bad):
+                self.resp.inc(1, status=500)
+                self.hist.observe(latency)
+            self.clock.advance(advance)
+            summary = self.engine.evaluate()
+        return summary
+
+    def state(self, name="availability"):
+        return next(
+            o for o in self.engine.summary()["objectives"]
+            if o["name"] == name
+        )
+
+
+class TestSLOEngine:
+    def test_clean_traffic_never_fires(self):
+        plant = _Plant()
+        summary = plant.pump(8)
+        assert summary["firing"] == 0
+        state = plant.state()
+        assert state["state"] == "OK" and not state["abstaining"]
+        assert state["burnFast"] == 0.0
+
+    def test_fires_only_when_both_windows_burn(self, tmp_path):
+        ledger = str(tmp_path / "alerts.jsonl")
+        plant = _Plant(ledger=ledger)
+        plant.pump(6)  # a clean hour of history
+        # one bad minute: the fast window burns, the slow window is
+        # still diluted below threshold -> must NOT fire
+        # fast: 10/30 = 0.33/0.001 = 333; slow: 10/(6*20+30) ~ 0.066
+        # -> 66 >= 8 ... both exceed with budget 0.001. Use a milder
+        # burn that only the fast window exceeds:
+        plant.resp.inc(0, status=200)
+        summary = plant.pump(1, good=997, bad=3)  # 0.3% bad
+        # fast burn = 3/(1000)/0.001 = 3 < 8: no fire
+        assert summary["firing"] == 0
+        summary = plant.pump(2, good=10, bad=10)  # 50% bad, sustained
+        assert summary["firing"] == 1
+        state = plant.state()
+        assert state["burnFast"] >= 8.0 and state["burnSlow"] >= 8.0
+        # exactly one durable FIRING line
+        states = [a["state"] for a in load_alerts(ledger)]
+        assert states == ["FIRING"]
+
+    def test_clears_when_fast_window_drains_durably(self, tmp_path):
+        ledger = str(tmp_path / "alerts.jsonl")
+        plant = _Plant(ledger=ledger)
+        plant.pump(6)
+        plant.pump(2, good=10, bad=10)
+        assert plant.state()["state"] == "FIRING"
+        summary = plant.pump(7, good=30)  # > fast window of clean traffic
+        assert summary["firing"] == 0
+        assert plant.state()["cleared"] == 1
+        alerts = load_alerts(ledger)
+        assert [a["state"] for a in alerts] == ["FIRING", "CLEARED"]
+        assert all(a["schema"] == 1 and a["kind"] == "alert"
+                   for a in alerts)
+
+    def test_latency_objective_over_histogram(self):
+        objectives = (
+            SLOObjective(
+                name="latency", kind="ratio",
+                metric="pio_serving_request_seconds",
+                latency_threshold_s=0.128, target=0.99,
+                burn_threshold=8.0, min_window_events=10,
+            ),
+        )
+        plant = _Plant(objectives=objectives)
+        plant.pump(6, latency=0.005)
+        assert plant.state("latency")["state"] == "OK"
+        plant.pump(2, good=10, latency=0.3)  # every answer slow
+        assert plant.state("latency")["state"] == "FIRING"
+
+    def test_absent_series_abstains_not_ok(self):
+        clock = FakeClock()
+        metrics = MetricsRegistry(clock=clock)
+        engine = SLOEngine(
+            metrics, _ratio_objectives(), clock=clock
+        )
+        summary = engine.evaluate()
+        state = summary["objectives"][0]
+        assert state["abstaining"] and state["state"] == "OK"
+        # exported as -1, never 0 ("no data" must not read healthy)
+        gauge = metrics.instrument("pio_slo_alert_state")
+        assert gauge.value(objective="availability") == -1.0
+
+    def test_thin_window_abstains(self):
+        plant = _Plant()
+        plant.resp.inc(1, status=500)  # 1 bad of 2: 50% "error rate"
+        plant.resp.inc(1, status=200)
+        plant.clock.advance(60)
+        plant.engine.evaluate()
+        plant.clock.advance(60)
+        plant.engine.evaluate()
+        state = plant.state()
+        assert state["abstaining"]  # < min_window_events: no verdict
+
+    def test_gauge_sentinel_reads_absent_and_firing_holds_on_data_loss(
+        self,
+    ):
+        clock = FakeClock()
+        metrics = MetricsRegistry(clock=clock)
+        gauge = metrics.gauge(
+            "pio_quality_score_psi", labelnames=("variant",)
+        )
+        obj = SLOObjective(
+            name="drift", kind="gauge",
+            metric="pio_quality_score_psi",
+            labels=(("variant", "baseline"),),
+            max_value=0.25, burn_threshold=1.0,
+            fast_window_s=300.0, slow_window_s=3600.0,
+        )
+        engine = SLOEngine(metrics, (obj,), clock=clock)
+        gauge.set(-1.0, variant="baseline")  # the abstention sentinel
+        state = engine.evaluate()["objectives"][0]
+        assert state["abstaining"]
+        gauge.set(0.6, variant="baseline")
+        clock.advance(60)
+        state = engine.evaluate()["objectives"][0]
+        assert state["state"] == "FIRING"
+        # data loss while firing: the alert HOLDS, export stays 1
+        gauge.set(-1.0, variant="baseline")
+        clock.advance(60)
+        state = engine.evaluate()["objectives"][0]
+        assert state["state"] == "FIRING" and state["abstaining"]
+        alert_state = metrics.instrument("pio_slo_alert_state")
+        assert alert_state.value(objective="drift") == 1.0
+
+    def test_counter_reset_abstains_instead_of_false_firing(self):
+        plant = _Plant()
+        plant.pump(6)
+        # "restart": a fresh registry value below the last sample would
+        # make the delta negative — the window must abstain
+        plant.resp._children.clear()  # simulate the process restart
+        plant.resp.inc(1, status=200)
+        plant.clock.advance(60)
+        plant.engine.evaluate()
+        assert plant.state()["abstaining"]
+
+    def test_torn_ledger_lines_skipped(self, tmp_path):
+        ledger = tmp_path / "alerts.jsonl"
+        ledger.write_text(
+            json.dumps(
+                {"schema": 1, "kind": "alert", "objective": "x",
+                 "state": "FIRING"}
+            )
+            + "\n{torn"
+        )
+        alerts = load_alerts(str(ledger))
+        assert len(alerts) == 1 and alerts[0]["objective"] == "x"
+
+    def test_default_objectives_cover_every_server_kind(self):
+        for kind in ("query", "router", "event", "storage", "dashboard"):
+            objectives = default_objectives(kind)
+            assert any(o.name == "availability" for o in objectives)
+            for obj in objectives:  # constructable = validated
+                assert obj.kind in ("ratio", "gauge")
+        assert any(
+            o.name == "drift" for o in default_objectives("query")
+        )
+        assert any(
+            o.name == "freshness" for o in default_objectives("storage")
+        )
+
+
+class _CountingClock:
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return 0.0
+
+
+class TestFlightRecorder:
+    def test_disabled_path_is_zero_cost(self):
+        clock = _CountingClock()
+        recorder = FlightRecorder(enabled=False, clock=clock)
+        for _ in range(256):
+            recorder.record("rollout", "rollout.stage", to="CANARY")
+        assert clock.calls == 0  # the clock was NEVER touched
+        assert len(recorder) == 0
+
+    def test_ring_is_bounded(self):
+        recorder = FlightRecorder(capacity=8, enabled=True,
+                                  clock=FakeClock())
+        for i in range(32):
+            recorder.record("k", "s", i=i)
+        events = recorder.dump()
+        assert len(events) == 8
+        assert events[-1]["details"] == {"i": 31}  # newest survive
+
+    def test_ambient_trace_id_tagged(self):
+        from predictionio_tpu.obs.trace import Tracer
+
+        recorder = FlightRecorder(enabled=True, clock=FakeClock())
+        tracer = Tracer("t", clock=FakeClock())
+        with tracer.server_span("x", header_value="trace42"):
+            recorder.record("k", "s")
+        assert recorder.dump()[-1]["trace"] == "trace42"
+
+    def test_dump_to_roundtrip(self, tmp_path):
+        recorder = FlightRecorder(enabled=True, clock=FakeClock())
+        recorder.record("breaker", "breaker.es", state="open")
+        path = str(tmp_path / "flight.jsonl")
+        recorder.dump_to(path, reason="test")
+        doc = load_dump(path)
+        assert doc["header"]["reason"] == "test"
+        assert doc["events"][0]["site"] == "breaker.es"
+        assert load_dump(str(tmp_path / "missing.jsonl")) is None
+
+    def test_breaker_transitions_land_in_process_recorder(self):
+        from predictionio_tpu.obs.flight import default_recorder
+        from predictionio_tpu.utils.resilience import CircuitBreaker
+
+        recorder = default_recorder()
+        before = len(recorder.dump())
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="health-test", failure_threshold=1, clock=clock
+        )
+        with pytest.raises(RuntimeError):
+            breaker.call(lambda: (_ for _ in ()).throw(RuntimeError()))
+        events = recorder.dump()[before:]
+        assert any(
+            e["kind"] == "breaker"
+            and e["site"] == "breaker.health-test"
+            and e["details"]["state"] == "open"
+            for e in events
+        )
+
+
+class TestStallWatchdog:
+    def _watchdog(self, tmp_path=None):
+        clock = FakeClock()
+        metrics = MetricsRegistry(clock=clock)
+        flight = FlightRecorder(enabled=True, clock=clock)
+        watchdog = StallWatchdog(
+            metrics, clock=clock, flight=flight,
+            dump_dir=str(tmp_path) if tmp_path else None,
+        )
+        return watchdog, clock, metrics
+
+    def test_inflight_stall_fires_once_then_recovers(self, tmp_path):
+        watchdog, clock, metrics = self._watchdog(tmp_path)
+        token = watchdog.enter("serving.request", budget_s=1.0)
+        clock.advance(2.0)
+        assert watchdog.check() == []  # under 4x budget (and min floor)
+        clock.advance(10.0)
+        stalls = watchdog.check()
+        assert [s["site"] for s in stalls] == ["serving.request"]
+        assert stalls[0]["stallKind"] == "request"
+        assert watchdog.check() == []  # fires ONCE per episode
+        counter = metrics.instrument("pio_stall_detected_total")
+        assert counter.value(site="serving.request") == 1.0
+        # durable dump names the site
+        dump_path = watchdog.summary()["lastDump"]
+        assert dump_path and os.path.exists(dump_path)
+        doc = load_dump(dump_path)
+        assert doc["header"]["reason"] == "stall:serving.request"
+        watchdog.exit(token)
+        watchdog.check()
+        assert watchdog.summary()["active"] == []
+
+    def test_missing_deadline_gets_default_budget(self):
+        watchdog, clock, _ = self._watchdog()
+        watchdog.enter("serving.request", budget_s=None)
+        clock.advance(39.0)
+        assert watchdog.check() == []  # 4 x 10 s default
+        clock.advance(2.0)
+        assert watchdog.check()
+
+    def test_wedged_tick_detected_and_unexpect_clears(self):
+        watchdog, clock, metrics = self._watchdog()
+        watchdog.expect("continuous.tick", max_gap_s=30.0)
+        watchdog.beat("continuous.tick")
+        clock.advance(20.0)
+        assert watchdog.check() == []
+        watchdog.beat("continuous.tick")
+        clock.advance(31.0)
+        stalls = watchdog.check()
+        assert stalls and stalls[0]["stallKind"] == "tick"
+        watchdog.unexpect("continuous.tick")
+        assert watchdog.check() == []
+        summary = watchdog.summary()
+        assert summary["watched"] == [] and summary["detected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# server wiring: every server answers /health.json + /blackbox.json
+# ---------------------------------------------------------------------------
+
+
+def _get_json(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        return resp.status, json.loads(body)
+    finally:
+        conn.close()
+
+
+class TestServerWiring:
+    @pytest.fixture()
+    def event_server(self, tmp_path):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.storage import StorageRegistry
+
+        registry = StorageRegistry(
+            env={"PIO_FS_BASEDIR": str(tmp_path)}
+        )
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0),
+            events=registry.get_events(),
+            metadata=registry.get_metadata(),
+        )
+        server.start_background()
+        yield server
+        server.server_close()
+
+    def test_health_and_blackbox_routes(self, event_server):
+        status, doc = _get_json(event_server.bound_port, "/health.json")
+        assert status == 200
+        assert doc["kind"] == "event"
+        names = {o["name"] for o in doc["objectives"]}
+        assert "availability" in names
+        # fresh server: every objective is abstaining, none firing
+        assert doc["firing"] == 0
+        assert all(o["abstaining"] for o in doc["objectives"])
+        assert "stalls" in doc
+        status, doc = _get_json(event_server.bound_port, "/blackbox.json")
+        assert status == 200
+        assert "events" in doc and isinstance(doc["events"], list)
+
+    def test_slo_families_on_metrics_and_top_health_column(
+        self, event_server
+    ):
+        from predictionio_tpu.obs.top import FLEET_COLUMNS, node_row
+
+        node = f"127.0.0.1:{event_server.bound_port}"
+        row = node_row(node)
+        assert row["up"]
+        # abstaining everywhere, no stalls -> 'ok' (the engine exists)
+        assert row["health"] == "ok"
+        assert any(key == "health" for _t, key, _f in FLEET_COLUMNS)
+
+    def test_health_plane_ticker_stops_on_close(self, tmp_path):
+        from predictionio_tpu.storage import StorageRegistry
+        from predictionio_tpu.storage.storage_server import StorageServer
+
+        registry = StorageRegistry(
+            env={"PIO_FS_BASEDIR": str(tmp_path)}
+        )
+        server = StorageServer(
+            "127.0.0.1", 0, registry.get_events(),
+            registry.get_metadata(), registry.get_models(),
+        )
+        plane = server.health
+        assert plane is not None and plane.kind == "storage"
+        port = server.bound_port
+        # a FAILED construction (port already bound) must not leak a
+        # ticking thread: the ticker starts only after the bind
+        import threading
+
+        before = threading.active_count()
+        with pytest.raises(OSError):
+            StorageServer(
+                "127.0.0.1", port, registry.get_events(),
+                registry.get_metadata(), registry.get_models(),
+            )
+        assert threading.active_count() == before
+        server.server_close()
+        assert plane._thread is None  # ticker joined, not leaked
+
+    def test_dashboard_health_panel_renders_down_rows(self, tmp_path):
+        import http.client
+
+        from predictionio_tpu.storage import StorageRegistry
+        from predictionio_tpu.tools.dashboard import (
+            DashboardConfig,
+            DashboardServer,
+        )
+
+        registry = StorageRegistry(
+            env={"PIO_FS_BASEDIR": str(tmp_path)}
+        )
+        server = DashboardServer(
+            DashboardConfig(
+                ip="127.0.0.1", port=0, nodes="127.0.0.1:9",
+                scrape_timeout_s=0.5,
+            ),
+            registry,
+        )
+        server.start_background()
+        try:
+            status, doc = _get_json(server.bound_port, "/health.json")
+            assert status == 200
+            # the uniform per-node contract holds (a dict with the
+            # dashboard's OWN objectives — `pio health` must not read a
+            # live dashboard as DOWN), fleet rows ride along
+            assert doc["kind"] == "dashboard"
+            assert any(
+                o["name"] == "availability" for o in doc["objectives"]
+            )
+            assert doc["fleet"] == [{"node": "127.0.0.1:9", "up": False}]
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.bound_port, timeout=10
+            )
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            conn.close()
+            assert resp.status == 200 and "DOWN" in body
+        finally:
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the CLIs (in-process, pinned exit codes)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthCLI:
+    def _main(self, *argv):
+        from predictionio_tpu.tools import health
+
+        return health.main(list(argv))
+
+    def test_health_no_nodes_reachable_is_engine_error(self, capsys):
+        rc = self._main(
+            "health", "--nodes", "127.0.0.1:9", "--timeout", "0.5"
+        )
+        assert rc == 2
+        assert "DOWN" in capsys.readouterr().out
+
+    def test_alerts_ledger_exit_codes(self, tmp_path, capsys):
+        missing = str(tmp_path / "missing.jsonl")
+        assert self._main("alerts", "--ledger", missing) == 2
+        # existing-but-unreadable (a directory) is an error too, never
+        # a silent "everything cleared"
+        assert self._main("alerts", "--ledger", str(tmp_path)) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert self._main("alerts", "--ledger", str(empty)) == 0
+        from predictionio_tpu.obs.perfledger import append_record
+
+        ledger = str(tmp_path / "alerts.jsonl")
+        fire = {
+            "schema": 1, "kind": "alert", "objective": "availability",
+            "metric": "m", "state": "FIRING", "burnFast": 12.0,
+            "burnSlow": 9.0, "node": "query", "at": 1000.0,
+        }
+        append_record(ledger, fire)
+        assert self._main("alerts", "--ledger", ledger) == 1  # firing
+        append_record(ledger, dict(fire, state="CLEARED", burnFast=0.1))
+        assert self._main("alerts", "--ledger", ledger) == 0  # cleared
+        out = capsys.readouterr().out
+        assert "FIRING" in out and "CLEARED" in out
+
+    def test_blackbox_show_and_errors(self, tmp_path, capsys):
+        recorder = FlightRecorder(enabled=True, clock=FakeClock())
+        recorder.record("rollout", "rollout.stage", to="CANARY")
+        path = str(tmp_path / "flight.jsonl")
+        recorder.dump_to(path)
+        assert self._main("blackbox", "show", "--file", path) == 0
+        assert "rollout.stage" in capsys.readouterr().out
+        assert self._main(
+            "blackbox", "show", "--file", str(tmp_path / "nope.jsonl")
+        ) == 2
+        assert self._main(
+            "blackbox", "dump", "--node", "127.0.0.1:9",
+            "--timeout", "0.5",
+        ) == 2
+
+    def test_console_forwards_health_family(self, tmp_path, capsys):
+        from predictionio_tpu.tools import console
+
+        ledger = str(tmp_path / "alerts.jsonl")
+        from predictionio_tpu.obs.perfledger import append_record
+
+        append_record(
+            ledger,
+            {"schema": 1, "kind": "alert", "objective": "o",
+             "metric": "m", "state": "CLEARED", "at": 1.0,
+             "node": "n"},
+        )
+        assert console.main(["alerts", "--ledger", ledger]) == 0
+
+    def test_live_scrape_health_and_blackbox(self, tmp_path, capsys):
+        from predictionio_tpu.storage import StorageRegistry
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        registry = StorageRegistry(
+            env={"PIO_FS_BASEDIR": str(tmp_path)}
+        )
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0),
+            events=registry.get_events(),
+            metadata=registry.get_metadata(),
+        )
+        server.start_background()
+        try:
+            node = f"127.0.0.1:{server.bound_port}"
+            assert self._main("health", "--nodes", node) == 0
+            out = capsys.readouterr().out
+            assert "event" in out
+            out_file = str(tmp_path / "bb.jsonl")
+            assert self._main(
+                "blackbox", "dump", "--node", node, "--out", out_file
+            ) == 0
+            assert os.path.exists(out_file)
+            assert self._main("alerts", "--node", node) == 0
+        finally:
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# lint: obs-swallowed-observer fixture twins
+# ---------------------------------------------------------------------------
+
+
+class TestSwallowedObserverLint:
+    def _unsuppressed(self, path):
+        from predictionio_tpu.lint import lint_file
+
+        return [f for f in lint_file(path) if not f.suppressed]
+
+    def test_bad_fixture_fires_exactly_intended_rule(self):
+        path = os.path.join(FIXTURES, "swallowed_observer_bad.py")
+        findings = self._unsuppressed(path)
+        assert [f.rule_id for f in findings] == (
+            ["obs-swallowed-observer"] * 3
+        ), [(f.rule_id, f.line) for f in findings]
+
+    def test_clean_twin_has_no_findings(self):
+        findings = self._unsuppressed(
+            os.path.join(FIXTURES, "swallowed_observer_clean.py")
+        )
+        assert findings == [], [(f.rule_id, f.line) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# perfledger: the alert-noisiness trend records
+# ---------------------------------------------------------------------------
+
+
+class TestAlertLedgerRecords:
+    def test_alert_records_shape_and_gating(self):
+        from predictionio_tpu.obs import perfledger
+
+        bench = {
+            "device": "cpu", "alerts": {
+                "ok": True, "fired": 2, "cleared": 2,
+                "falsePositives": 0,
+            },
+        }
+        records = perfledger.alert_records(bench)
+        assert len(records) == 1
+        record = records[0]
+        assert record["metric"] == "alert_false_positives"
+        assert record["unit"] == "count"  # trend-only: never gates
+        assert record["value"] == 0.0
+        # a failed drill records NOTHING
+        assert perfledger.alert_records(
+            {"alerts": {"ok": False, "falsePositives": 3}}
+        ) == []
+        assert perfledger.alert_records({}) == []
+        # unit != "s" means detect_regressions ignores it even at 100x
+        history = [
+            dict(record, value=0.0), dict(record, value=0.0),
+            dict(record, value=100.0),
+        ]
+        assert perfledger.detect_regressions(history) == []
+
+
+# ---------------------------------------------------------------------------
+# the brownout drill (tier-1 acceptance) — ONE run, many assertions
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def brownout_report():
+    from predictionio_tpu.tools.loadgen import run_brownout
+
+    return run_brownout()
+
+
+class TestBrownoutDrill:
+    def test_drill_accepts(self, brownout_report):
+        assert brownout_report["ok"], brownout_report
+
+    def test_control_run_fires_zero_alerts(self, brownout_report):
+        assert brownout_report["controlAlertsFired"] == 0
+        assert brownout_report["falsePositives"] == 0
+
+    def test_stall_watchdog_dump_names_the_wedged_site(
+        self, brownout_report
+    ):
+        assert brownout_report["stallsDetected"] >= 1
+        assert brownout_report["stallDumpNamesSite"]
+        # the drill's dump dir may already be cleaned (tmp workspace);
+        # the parsed verdict above is the contract
+
+    def test_alerts_fire_and_clear_durably(self, brownout_report):
+        ledger = {
+            (a["objective"], a["state"])
+            for a in brownout_report["ledger"]
+        }
+        assert {
+            ("availability", "FIRING"), ("availability", "CLEARED"),
+            ("latency", "FIRING"), ("latency", "CLEARED"),
+        } <= ledger
+        assert brownout_report["firingAfterRecovery"] == 0
+        for stats in brownout_report["alerts"].values():
+            assert stats["fired"] == 1 and stats["cleared"] == 1
+
+
+class TestWorkspaceCache:
+    def test_builder_runs_once_per_tag(self, tmp_path):
+        from predictionio_tpu.tools import loadgen
+
+        calls = []
+
+        def build(registry):
+            calls.append(1)
+            return {"id": "X"}
+
+        a = str(tmp_path / "a")
+        b = str(tmp_path / "b")
+        tag = "cache-test-health"
+        info_a = loadgen._prepared_workspace(tag, build, a)
+        info_b = loadgen._prepared_workspace(tag, build, b)
+        assert calls == [1]  # trained ONCE
+        assert info_a == info_b == {"id": "X"}
+        assert os.path.isdir(a) and os.path.isdir(b)
+
+
+# ---------------------------------------------------------------------------
+# metric-catalog golden test: boot-registered pio_* vs docs
+# ---------------------------------------------------------------------------
+
+
+def _parse_catalog():
+    """docs/observability.md#metric-catalog rows →
+    {name: (kind, frozenset(labels))}; `runtime:`-marked and
+    bracketed rows are documentation-only (not boot-registered)."""
+    path = os.path.join(REPO, "docs", "observability.md")
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    section = text.split("## Metric catalog", 1)[1]
+    catalog = {}
+    for line in section.splitlines():
+        match = re.match(
+            r"\|\s*`(pio_[a-z0-9_]+)`\s*\|\s*(\w+)\s*\|\s*([^|]+)\|",
+            line,
+        )
+        if not match:
+            continue
+        name, kind, labels_text = match.groups()
+        labels_text = labels_text.strip()
+        if labels_text.startswith("runtime:") or "[" in labels_text:
+            catalog[name] = (kind, None)  # documented, schema unpinned
+            continue
+        labels = frozenset(
+            part.strip()
+            for part in labels_text.split(",")
+            if part.strip() and part.strip() != "-"
+        )
+        catalog[name] = (kind, labels)
+    return catalog
+
+
+def _boot_instruments(server):
+    return {
+        inst.name: (inst.kind, frozenset(inst.labelnames))
+        for inst in server.metrics.collect()
+        if inst.name.startswith("pio_")
+    }
+
+
+@pytest.fixture(scope="module")
+def boot_metrics(tmp_path_factory):
+    """Every server type booted in-process; their boot-registered
+    pio_* instruments, merged (schemas are pinned registry-wide, so a
+    name can never disagree between servers)."""
+    from predictionio_tpu.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.controller import Engine, WorkflowParams
+    from predictionio_tpu.fleet.router import RouterConfig, RouterServer
+    from predictionio_tpu.storage import StorageRegistry
+    from predictionio_tpu.storage.storage_server import StorageServer
+    from predictionio_tpu.tools.dashboard import (
+        DashboardConfig,
+        DashboardServer,
+    )
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.serving import (
+        QueryServer,
+        ServerConfig,
+    )
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from sample_engine import (  # noqa: E402
+        Algo0,
+        DataSource0,
+        Preparator0,
+        Query,
+        Serving0,
+    )
+    from test_engine import make_params  # noqa: E402
+
+    tmp = tmp_path_factory.mktemp("catalog")
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": str(tmp)})
+    merged = {}
+    servers = []
+    try:
+        servers.append(
+            EventServer(
+                EventServerConfig(ip="127.0.0.1", port=0),
+                events=registry.get_events(),
+                metadata=registry.get_metadata(),
+            )
+        )
+        servers.append(
+            StorageServer(
+                "127.0.0.1", 0, registry.get_events(),
+                registry.get_metadata(), registry.get_models(),
+            )
+        )
+        servers.append(
+            RouterServer(
+                RouterConfig(
+                    ip="127.0.0.1", port=0, backends=("127.0.0.1:9",)
+                )
+            )
+        )
+        servers.append(
+            DashboardServer(
+                DashboardConfig(ip="127.0.0.1", port=0), registry
+            )
+        )
+
+        class TypedAlgo(Algo0):
+            def query_class(self):
+                return Query
+
+        engine = Engine(
+            {"": DataSource0}, {"": Preparator0},
+            {"": TypedAlgo}, {"": Serving0},
+        )
+        run_train(
+            engine, make_params(algo_ids=(11,)), registry,
+            engine_id="default", engine_version="1",
+            workflow_params=WorkflowParams(batch="catalog"),
+        )
+        servers.append(
+            QueryServer(
+                ServerConfig(ip="127.0.0.1", port=0, batch_wait_ms=0.0),
+                engine, registry,
+            )
+        )
+        for server in servers:
+            merged.update(_boot_instruments(server))
+    finally:
+        for server in servers:
+            try:
+                server.server_close()
+            except Exception:
+                pass
+    return merged
+
+
+class TestMetricCatalog:
+    def test_every_boot_metric_is_documented_with_exact_schema(
+        self, boot_metrics
+    ):
+        catalog = _parse_catalog()
+        assert len(catalog) > 40  # the parse actually found the table
+        missing = sorted(set(boot_metrics) - set(catalog))
+        assert not missing, (
+            "metrics registered at server boot but absent from "
+            f"docs/observability.md#metric-catalog: {missing} — "
+            "update the table (the docs are the pinned schema)"
+        )
+        mismatched = {
+            name: (boot_metrics[name], catalog[name])
+            for name in boot_metrics
+            if catalog[name][1] is not None
+            and boot_metrics[name] != catalog[name]
+        }
+        assert not mismatched, (
+            "metric kind/label schema drifted from the documented "
+            f"catalog: {mismatched}"
+        )
+
+    def test_catalog_kinds_are_valid(self):
+        for name, (kind, _labels) in _parse_catalog().items():
+            assert kind in ("counter", "gauge", "histogram"), (
+                name, kind,
+            )
