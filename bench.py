@@ -29,7 +29,15 @@ smoke runs; ``BENCH_ITERATIONS`` (default 10); ``BENCH_CPU_SCALE`` (default
 the deterministic synthetic dataset is cached across runs — cache files
 are keyed by (generator version, scale, seed). Lever knobs
 (``BENCH_SOLVE_MODE``/``BENCH_GATHER_DTYPE``/``BENCH_SORT_GATHER``/
-``BENCH_FUSED_GATHER``) are documented at their ALSConfig fields.
+``BENCH_FUSED_GATHER``) are documented at their ALSConfig fields; since
+round 12 the fast paths default ON (sort-gather rides every run,
+``BENCH_SORT_GATHER=0`` opts out; fused-gather resolves with the
+solver, ``BENCH_FUSED_GATHER=0`` forces it off) and every round trains
+a bf16-gather twin whose holdout RMSE must stay within
+``BENCH_BF16_RMSE_GATE`` (default 0.01) of the f32 run —
+``BENCH_BF16_GATE=0`` opts out, a drift fails the bench loudly. The
+recorded lever flags are the RESOLVED values, and the gate's margin
+rides the record (``bf16_gate``) into the perf ledger's ``extra``.
 """
 
 import json
@@ -270,18 +278,28 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
 
     solve_mode = os.environ.get("BENCH_SOLVE_MODE", "auto")
     gather_dtype = os.environ.get("BENCH_GATHER_DTYPE", "f32")
-    sort_gather = os.environ.get("BENCH_SORT_GATHER") == "1"
-    fused_gather = os.environ.get("BENCH_FUSED_GATHER") == "1"
-    if fused_gather and fallback:
+    # fast paths default ON (round 12): sort-gather is host-side and
+    # proven equivalence-safe (ROUND7_NOTES), so it rides every run
+    # unless BENCH_SORT_GATHER=0 opts out; fused_gather tri-states —
+    # unset resolves WITH the solver (on exactly when solve_mode
+    # resolves to pallas), "0"/"1" force it
+    sort_gather = os.environ.get("BENCH_SORT_GATHER", "1") == "1"
+    fused_env = os.environ.get("BENCH_FUSED_GATHER")
+    fused_gather = None if fused_env is None else fused_env == "1"
+    if fallback and fused_gather is not False:
         # the fused kernel's per-row DMA loops run in interpret mode off
-        # TPU — hours at any real scale; the A/B is a TPU-only step
-        print(
-            "bench: BENCH_FUSED_GATHER ignored on CPU fallback",
-            file=sys.stderr,
-        )
+        # TPU — hours at any real scale; force it off on fallback for
+        # ANY non-explicit value: the unset default would resolve ON
+        # under BENCH_SOLVE_MODE=pallas (a supported off-TPU A/B leg),
+        # not just under an explicit BENCH_FUSED_GATHER=1
+        if fused_gather or solve_mode == "pallas":
+            print(
+                "bench: BENCH_FUSED_GATHER ignored on CPU fallback",
+                file=sys.stderr,
+            )
         fused_gather = False
     if fused_gather and solve_mode == "auto":
-        solve_mode = "pallas"  # fused build requires the pallas solver
+        solve_mode = "pallas"  # explicit fused build forces the solver
     cfg = ALSConfig(
         rank=50, iterations=iterations, lambda_=0.05, seed=0,
         solve_mode=solve_mode, gather_dtype=gather_dtype,
@@ -373,10 +391,15 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         "est_hbm_gb_per_iter": round(hbm_bytes / 1e9, 2),
         "est_hbm_util_v5e": round(hbm_util, 3),
         "bucket_shapes": profile.get("bucket_shapes"),
+        # RESOLVED lever flags from the train run itself (tri-state
+        # defaults resolve inside als_train) — the ledger must record
+        # what executed, not what was requested. sort_gather is resolved
+        # HERE: the bench sorts host-side before staging, so the config
+        # flag the train run saw is moot.
         "solve_mode": profile.get("solve_mode", solve_mode),
-        "gather_dtype": gather_dtype,
+        "gather_dtype": profile.get("gather_dtype", gather_dtype),
         "sort_gather": sort_gather,
-        "fused_gather": fused_gather,
+        "fused_gather": profile.get("fused_gather", bool(fused_gather)),
         # compile/retrace accounting for THIS process (warmup included):
         # a bench round whose timed section quietly recompiled is not
         # measuring steady state, and this field says so
@@ -399,6 +422,70 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
         _append_ledger(record)
         print(json.dumps(record))
         return 1
+    # bf16 precision gate (docs/performance.md#levers): every round
+    # trains a reduced-precision twin on the SAME staged (and sorted)
+    # buckets — only gather_dtype differs — and bounds its holdout-RMSE
+    # drift vs the f32 run. The gate keeps the bf16 lever adoptable:
+    # the bench fails LOUDLY the round bf16 precision drifts, instead
+    # of a dashboard noticing a quality slide later. Default bound
+    # 0.01 absolute RMSE: measured drift at CPU-fallback scale is
+    # <1e-4 (round 12 — two orders of magnitude of headroom; the λ·n_u
+    # ridge keeps the solves stable), while a real precision bug (e.g.
+    # bf16 accumulation sneaking into the Gramian) shifts holdout RMSE
+    # by >0.05. BENCH_BF16_GATE=0 opts out; BENCH_BF16_RMSE_GATE
+    # overrides the bound.
+    if os.environ.get("BENCH_BF16_GATE", "1") != "0":
+        import dataclasses as _dc
+
+        gate = float(os.environ.get("BENCH_BF16_RMSE_GATE", "0.01"))
+        twin_dtype = "bf16" if record["gather_dtype"] == "f32" else "f32"
+        # the twin runs the EINSUM build: gramian_fused upcasts bf16
+        # tables to f32 at kernel entry (Mosaic cannot DMA half-width
+        # sublanes), so a fused-path twin would measure f32 math under
+        # a bf16 label — the einsum path is where the bf16 lever
+        # actually feeds the MXU at reduced precision, and the only
+        # path where it buys HBM bytes (estimate_iteration_hbm_bytes)
+        twin_cfg = _dc.replace(
+            cfg, gather_dtype=twin_dtype, fused_gather=False
+        )
+        twin = als_train(by_user, by_item, twin_cfg)
+        twin_rmse = rmse(twin, users[test], items[test], ratings[test])
+        if record["gather_dtype"] == "bf16" and record["fused_gather"]:
+            # a bf16 MAIN run that resolved the fused build rode the
+            # upcasting kernel — its holdout is f32 math under a bf16
+            # label, not a bf16 measurement; train the einsum-built
+            # bf16 leg explicitly so the gate compares real reduced-
+            # precision math against the f32 twin
+            bf16_leg = als_train(
+                by_user, by_item,
+                _dc.replace(cfg, gather_dtype="bf16", fused_gather=False),
+            )
+            bf16_rmse = rmse(
+                bf16_leg, users[test], items[test], ratings[test]
+            )
+            f32_rmse = twin_rmse
+        else:
+            f32_rmse = (
+                holdout if record["gather_dtype"] == "f32" else twin_rmse
+            )
+            bf16_rmse = twin_rmse if twin_dtype == "bf16" else holdout
+        margin = abs(bf16_rmse - f32_rmse)
+        record["bf16_gate"] = {
+            "rmse_f32": round(f32_rmse, 4),
+            "rmse_bf16": round(bf16_rmse, 4),
+            "margin": round(margin, 4),
+            "gate": gate,
+            "ok": margin <= gate,
+        }
+        if margin > gate:
+            record["vs_baseline"] = 0.0
+            record["error"] = (
+                f"bf16 gather RMSE drifted {margin:.4f} vs f32 "
+                f"(gate {gate})"
+            )
+            _append_ledger(record)
+            print(json.dumps(record))
+            return 1
     if (
         not fallback
         and scale >= 1.0

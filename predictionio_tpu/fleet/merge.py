@@ -21,7 +21,11 @@ from __future__ import annotations
 import heapq
 from typing import Any, Dict, List, Optional, Sequence
 
-__all__ = ["merge_item_scores", "merge_predictions"]
+__all__ = [
+    "merge_item_scores",
+    "merge_predictions",
+    "merged_matches_reference",
+]
 
 
 def _sort_key(entry: Dict[str, Any]):
@@ -82,3 +86,44 @@ def merge_predictions(
             "merge; this engine's result shape cannot be served sharded"
         )
     return first
+
+
+def merged_matches_reference(
+    merged: Any, reference: Any, rtol: float = 1e-5, atol: float = 1e-6
+) -> bool:
+    """The f32 ranking-equality contract shared by sharded serving and
+    the fused top-k kernels: identical item *ranking* (the top-k and its
+    order — exact), scores equal to f32 reassociation tolerance. The
+    item set/order is what "exact top-k" means; scores carry last-ulp
+    noise because XLA's matmul accumulation order depends on the matrix
+    shape, so a 6-item shard and a 12-item catalog — or a streamed tile
+    and a dense row — round differently (docs/fleet.md; the ROUND7
+    sort-gather analysis). Lives here, next to the merge whose exactness
+    it defines, so every consumer (the fleet chaos drill, the fused
+    top-k equivalence tests) pins the SAME contract. Stdlib-only like
+    the rest of the module (``|a-b| <= atol + rtol*|b|``, numpy
+    ``allclose`` semantics)."""
+    if not (isinstance(merged, dict) and isinstance(reference, dict)):
+        return merged == reference
+    got = merged.get("itemScores")
+    want = reference.get("itemScores")
+    if got is None or want is None:
+        return merged == reference
+    got_items = [e.get("item") for e in got]
+    want_items = [e.get("item") for e in want]
+    if got_items != want_items:
+        # Two items whose scores differ by LESS than the tolerance can
+        # legitimately swap rank between two computations of the same
+        # top-k (the same noise, applied to a near-tie). Accept a
+        # permutation only when the item SETS agree and the positionwise
+        # scores still align — which confines any swap to within a tied
+        # window; a genuinely different item in the list still fails.
+        if set(got_items) != set(want_items):
+            return False
+    if len(got) != len(want):
+        return False
+    for a, b in zip(got, want):
+        ga, gb = float(a.get("score", 0.0)), float(b.get("score", 0.0))
+        if not abs(ga - gb) <= atol + rtol * abs(gb):
+            return False
+    return True

@@ -385,10 +385,250 @@ class UnboundedForiTrip(Rule):
                     )
 
 
+class Bf16AccumWithoutF32(Rule):
+    """The round-12 bf16-gather default's safety contract: a bf16 input
+    halves gather bytes and doubles MXU rate ONLY because accumulation
+    stays f32 via ``preferred_element_type=jnp.float32`` — a
+    ``dot``/``matmul``/``einsum``/``dot_general`` that drops the kwarg
+    accumulates at bf16, and the resulting precision slide surfaces as
+    an RMSE drift the bench gate catches only after the fact. Applied
+    package-wide (the einsum sites live OUTSIDE kernels — the
+    ``als.py`` gather build is the clean exemplar). Taint is tracked
+    per top-level scope: a name assigned from a bf16 cast (or a
+    conditional that may produce one, the ``gdt = jnp.bfloat16 if ...``
+    idiom) taints everything derived from it; an explicit
+    ``.astype(jnp.float32)`` clears it."""
+
+    id = "mosaic-bf16-accum"
+    severity = "error"
+    short = (
+        "bf16-cast operand feeds dot/matmul/einsum without "
+        "preferred_element_type forcing f32 accumulation"
+    )
+    motivation = (
+        "the round-12 gather_dtype='bf16' lever (ALSConfig): its "
+        "equivalence proof (bench bf16 RMSE gate) holds only while "
+        "every contraction over bf16 operands pins f32 accumulation — "
+        "als.py's _system_explicit/_system_implicit einsums are the "
+        "clean exemplar"
+    )
+
+    #: contraction calls whose accumulator dtype follows the operand
+    #: dtype unless preferred_element_type overrides it
+    _CONTRACTIONS = ("einsum", "dot", "matmul", "dot_general", "tensordot")
+
+    @staticmethod
+    def _mentions_bf16(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr == "bfloat16":
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "bfloat16":
+                return True
+            if isinstance(sub, ast.Constant) and sub.value == "bfloat16":
+                return True
+        return False
+
+    @staticmethod
+    def _mentions_f32(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                "float32", "float64",
+            ):
+                return True
+            if isinstance(sub, ast.Constant) and sub.value in (
+                "float32", "float64",
+            ):
+                return True
+        return False
+
+    def _value_tainted(self, value: ast.AST, tainted: Set[str]) -> bool:
+        """Does ``value`` (an RHS or call argument) carry possibly-bf16
+        data? A pure f32 upcast (``x.astype(jnp.float32)``) clears the
+        taint — including NESTED inside an expression
+        (``g.astype(jnp.float32) * w`` is clean); ``x.astype(gdt)``
+        with a tainted/bf16 dtype argument keeps it."""
+        # names under a clearing f32 upcast are exempt from the walk
+        cleared: Set[int] = set()
+        for sub in ast.walk(value):
+            if not (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "astype"
+                and len(sub.args) == 1
+            ):
+                continue
+            dtype_arg = sub.args[0]
+            if self._mentions_bf16(dtype_arg):
+                return True  # an explicit bf16 cast anywhere taints
+            if any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(dtype_arg)
+            ):
+                # .astype(gdt) / .astype(g.dtype): dtype follows a
+                # possibly-bf16 source — NOT a clearing cast
+                continue
+            if self._mentions_f32(dtype_arg):
+                for n in ast.walk(sub.func.value):
+                    cleared.add(id(n))
+        if self._mentions_bf16(value):
+            return True
+        return any(
+            isinstance(sub, ast.Name)
+            and sub.id in tainted
+            and id(sub) not in cleared
+            for sub in ast.walk(value)
+        )
+
+    @staticmethod
+    def _iter_assigns(root: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+        """(name, value) pairs for every name-binding assignment under
+        ``root`` — plain and annotated assigns, plus tuple unpacking
+        (``g1, g2 = a.astype(gdt), b.astype(gdt)`` pairs element-wise;
+        unpacking an opaque RHS taints every bound name with it)."""
+        for node in ast.walk(root):
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.value is not None
+            ):
+                yield node.target.id, node.value
+                continue
+            if not (
+                isinstance(node, ast.Assign) and len(node.targets) == 1
+            ):
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                yield target.id, node.value
+            elif isinstance(target, ast.Tuple) and all(
+                isinstance(elt, ast.Name) for elt in target.elts
+            ):
+                if isinstance(node.value, ast.Tuple) and len(
+                    node.value.elts
+                ) == len(target.elts):
+                    for elt, value in zip(target.elts, node.value.elts):
+                        yield elt.id, value
+                else:
+                    for elt in target.elts:
+                        yield elt.id, node.value
+
+    @staticmethod
+    def _scopes(ctx: FileContext) -> List[ast.AST]:
+        """Top-level analysis units: module + each outermost function
+        (nested defs analyzed WITH their parent so closure-captured
+        casts — the ``_solve_side_traced`` idiom — stay visible)."""
+        out: List[ast.AST] = [ctx.tree]
+        stack: List[ast.AST] = [ctx.tree]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    out.append(child)
+                elif isinstance(child, (ast.ClassDef, ast.Module)):
+                    stack.append(child)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "bfloat16" not in ctx.source:
+            return  # cheap source-text bail (tier-1 sweep budget)
+        module_seed: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                for name, value in self._iter_assigns(stmt):
+                    if self._mentions_bf16(value):
+                        module_seed.add(name)
+        reported: Set[int] = set()
+        for scope in self._scopes(ctx):
+            if isinstance(scope, ast.Module):
+                # module-level statements only: functions are their own
+                # units (a name in one function must not taint the same
+                # name in another), and class methods arrive via _scopes
+                body = [
+                    stmt
+                    for stmt in scope.body
+                    if not isinstance(
+                        stmt,
+                        (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef),
+                    )
+                ]
+            else:
+                body = [scope]
+            assigns: List[Tuple[str, ast.AST]] = []
+            for root in body:
+                assigns.extend(self._iter_assigns(root))
+            tainted = set(module_seed)
+            changed = True
+            while changed:  # tiny fixpoint; assignment count bounds it
+                changed = False
+                for name, value in assigns:
+                    if name in tainted:
+                        continue
+                    if self._value_tainted(value, tainted):
+                        tainted.add(name)
+                        changed = True
+            if not tainted:
+                continue
+            for root in body:
+                for node in ast.walk(root):
+                    if id(node) in reported:
+                        continue
+                    if isinstance(node, ast.BinOp) and isinstance(
+                        node.op, ast.MatMult
+                    ):
+                        # the @ operator CANNOT carry
+                        # preferred_element_type at all — with a bf16
+                        # operand it always accumulates at bf16
+                        if self._value_tainted(
+                            node.left, tainted
+                        ) or self._value_tainted(node.right, tainted):
+                            reported.add(id(node))
+                            yield self.finding(
+                                ctx,
+                                node,
+                                "`@` over a possibly-bf16 operand: the "
+                                "operator form cannot pin an "
+                                "accumulator dtype — use jnp.einsum/"
+                                "jax.lax.dot_general with "
+                                "preferred_element_type=jnp.float32, "
+                                "or upcast the operand explicitly.",
+                            )
+                        continue
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if call_name(node) not in self._CONTRACTIONS:
+                        continue
+                    if any(
+                        kw.arg == "preferred_element_type"
+                        for kw in node.keywords
+                    ):
+                        continue
+                    if any(
+                        self._value_tainted(arg, tainted)
+                        for arg in node.args
+                        if not isinstance(arg, ast.Constant)
+                    ):
+                        reported.add(id(node))
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"{call_name(node)} over a possibly-bf16 "
+                            "operand without preferred_element_type: the "
+                            "MXU will accumulate at bf16 and the "
+                            "precision loss lands in the result — pin "
+                            "preferred_element_type=jnp.float32 (the "
+                            "als.py normal-equation einsums are the "
+                            "exemplar) or upcast the operand explicitly.",
+                        )
+
+
 RULES = [
     UnalignedLaneSlice(),
     BlockSpecTiling(),
     Rank3BroadcastCompare(),
     PerRowDMA(),
     UnboundedForiTrip(),
+    Bf16AccumWithoutF32(),
 ]

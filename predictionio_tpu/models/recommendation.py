@@ -30,7 +30,12 @@ from ..controller import (
     Preparator,
 )
 from ..ops.als import ALSConfig, als_train_coo
-from ..ops.scoring import pad_pow2, top_k_for_users, use_streaming_topk
+from ..ops.scoring import (
+    pad_pow2,
+    resolve_topk_path,
+    top_k_for_users_fused,
+    use_streaming_topk,
+)
 from ..storage import BiMap, get_registry
 from ..workflow.infeed import stream_ratings
 
@@ -218,22 +223,27 @@ class ALSAlgorithmParams(Params):
     #: Cholesky kernel on a single-chip TPU run, "chunked" elsewhere)
     solve_mode: str = "auto"
     #: "f32" | "bf16" — gathered-factor precision for the normal-equation
-    #: einsums (see ops.als.ALSConfig.gather_dtype; quality-gate before
-    #: adopting bf16)
+    #: einsums (see ops.als.ALSConfig.gather_dtype; the bench's RMSE gate
+    #: — docs/performance.md#levers — bounds the drift before adopting
+    #: bf16)
     gather_dtype: str = "f32"
     #: Sort each solve row's column indices before staging (gather
-    #: locality; permutation-invariant math — see
+    #: locality; permutation-invariant math). None (default) resolves to
+    #: ON — pass False for the legacy unsorted path (see
     #: ops.als.ALSConfig.sort_gather_indices)
-    sort_gather_indices: bool = False
+    sort_gather_indices: Optional[bool] = None
     #: Build normal equations with the fused gather+Gramian Pallas
-    #: kernel (requires solve_mode to resolve to "pallas"; EXPERIMENTAL,
-    #: hardware-gated — see ops.als.ALSConfig.fused_gather)
-    fused_gather: bool = False
+    #: kernel. None (default) resolves to ON exactly when solve_mode
+    #: resolves to "pallas" — pass False for the einsum build (see
+    #: ops.als.ALSConfig.fused_gather)
+    fused_gather: Optional[bool] = None
     #: Serving top-k path: "auto" (default) streams item blocks through
-    #: the Pallas kernel — never materializing the [batch, n_items] score
-    #: matrix in HBM — when on TPU and that matrix would exceed ~1 GB;
-    #: "always"/"never" force the choice (see
-    #: ops.pallas_kernels.top_k_for_users_streaming).
+    #: the fused Pallas score+select kernel — never materializing the
+    #: [batch, n_items] score matrix in HBM — when on TPU and that
+    #: matrix would exceed 64 MB (ops.scoring.STREAMING_TOPK_BYTES);
+    #: "always"/"never" force the choice. Serving dispatches through
+    #: ops.scoring.top_k_for_users_fused (XLA lax.top_k fallback
+    #: off-TPU) and /status.json reports the resolved path (topkPath).
     streaming_top_k: str = "auto"
 
 
@@ -263,6 +273,14 @@ class ALSAlgorithm(Algorithm):
 
     def __init__(self, params: ALSAlgorithmParams = ALSAlgorithmParams()):
         self.params = params
+        #: the top-k path the LAST batch actually took ("streaming" |
+        #: "dense"; None before the first query) — the resolved serving
+        #: lever, read by the query server's /status.json
+        self._topk_path: Optional[str] = None
+
+    @property
+    def topk_path(self) -> Optional[str]:
+        return self._topk_path
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         p = self.params
@@ -463,18 +481,19 @@ class ALSAlgorithm(Algorithm):
             b_pad = pad_pow2(b)
             k_pad = min(pad_pow2(max_k, lo=8), n_items)
             padded_idx = np.pad(user_idx, (0, b_pad - b))
-            if self._use_streaming_topk(b_pad, n_items):
-                from ..ops.pallas_kernels import top_k_for_users_streaming
-
-                scores, items = top_k_for_users_streaming(
-                    model.user_factors, model.item_factors, padded_idx,
-                    k=k_pad,
-                )
-            else:
-                scores, items = top_k_for_users(
-                    model.user_factors, model.item_factors, padded_idx,
-                    k=k_pad,
-                )
+            # the fused score+select entry dispatches: Pallas streaming
+            # on TPU past the use_streaming_topk bar (the [B, I] score
+            # matrix never exists), XLA score + lax.top_k below it —
+            # record which path serves (resolve_topk_path is the ONE
+            # decision home the entry itself dispatches on, same
+            # (mode, b, n) inputs), surfaced at /status.json
+            self._topk_path = resolve_topk_path(
+                self.params.streaming_top_k, b_pad, n_items
+            )
+            scores, items = top_k_for_users_fused(
+                model.user_factors, model.item_factors, padded_idx,
+                k=k_pad, mode=self.params.streaming_top_k,
+            )
             # one fetch for both arrays: each device_get is a full host↔
             # device round trip, which dominates per-batch latency on
             # high-latency links (tunneled/remote devices)
@@ -501,10 +520,6 @@ class ALSAlgorithm(Algorithm):
                     )
                 )
         return out
-
-    def _use_streaming_topk(self, b_pad: int, n_items: int) -> bool:
-        """Shared selection rule — see ``ops.scoring.use_streaming_topk``."""
-        return use_streaming_topk(self.params.streaming_top_k, b_pad, n_items)
 
     def query_class(self):
         return Query

@@ -287,6 +287,14 @@ class SimilarALSAlgorithm(Algorithm):
 
     def __init__(self, params: SimilarALSParams = SimilarALSParams()):
         self.params = params
+        #: top-k path the LAST batch took ("streaming" | "dense"; None
+        #: before the first query) — surfaced at /status.json like the
+        #: recommendation template's
+        self._topk_path = None
+
+    @property
+    def topk_path(self):
+        return self._topk_path
 
     # -- train ------------------------------------------------------------
     def _ratings(self, pd: TrainingData) -> List[Tuple[str, str, float]]:
@@ -395,11 +403,20 @@ class SimilarALSAlgorithm(Algorithm):
         k_pad = min(pad_pow2(max_k, lo=8), n_items)
         if b_pad > b:
             qvecs = np.pad(qvecs, ((0, b_pad - b), (0, 0)))
-        if self._use_streaming_topk(b_pad, n_items, rows):
+        self._topk_path = (
+            "streaming"
+            if self._use_streaming_topk(b_pad, n_items, rows)
+            else "dense"
+        )
+        if self._topk_path == "streaming":
             # exclusions are small index lists (query items + blacklist):
             # the streaming kernel applies them per block without a dense
-            # [B, I] mask, and the score matrix never touches HBM
-            from ..ops.pallas_kernels import top_k_streaming
+            # [B, I] mask, and the score matrix never touches HBM. The
+            # dispatch rides the fused entry (one jitted program; its
+            # resolve_topk_path decision matches this branch's
+            # _use_streaming_topk for the unconstrained batches that
+            # reach here — same (mode, b, n) inputs).
+            from ..ops.scoring import top_k_fused_vectors
 
             excl_lists = []
             for _pos, q, qi in rows:
@@ -418,7 +435,10 @@ class SimilarALSAlgorithm(Algorithm):
             excl = np.full((b_pad, width), -1, dtype=np.int32)
             for r, lst in enumerate(excl_lists):
                 excl[r, : len(lst)] = lst
-            scores, idx = top_k_streaming(qvecs, unit, k_pad, excl)
+            scores, idx = top_k_fused_vectors(
+                qvecs, unit, k_pad, excl,
+                mode=getattr(self.params, "streaming_top_k", "auto"),
+            )
         else:
             exclude = np.stack(
                 [_candidate_mask(model, q, qi) for _, q, qi in rows]

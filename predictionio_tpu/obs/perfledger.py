@@ -39,6 +39,7 @@ __all__ = [
     "bench_to_record",
     "comparable_key",
     "detect_regressions",
+    "find_no_prior",
     "fleet_records",
     "load_bench_history",
     "load_ledger",
@@ -135,7 +136,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             key: bench[key]
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
-                "quality",
+                "quality", "bf16_gate",
             )
             if key in bench
         },
@@ -383,6 +384,92 @@ def _median(values: List[float]) -> float:
     )
 
 
+def _key_dict(key: Tuple) -> dict:
+    """A comparable key rendered as the verdict dict both gates share."""
+    return {
+        "metric": key[0],
+        "device_class": key[1],
+        "scale": key[2],
+        "solve_mode": key[3],
+        "gather_dtype": key[4],
+        "sort_gather": key[5],
+        "fused_gather": key[6],
+    }
+
+
+def _gateable_groups(records: List[dict]) -> Dict[Tuple, List[dict]]:
+    """Records eligible for the regression gate, grouped by comparable
+    key in given (= chronological) order: lower-is-better seconds only,
+    failed runs (value -1) and error-carrying runs excluded — a
+    quality-gate failure carries a real (positive) wall time but
+    measured an invalid run, so it must neither be gated nor pollute a
+    baseline median."""
+    groups: Dict[Tuple, List[dict]] = {}
+    for record in records:
+        if record.get("unit", "s") != "s":
+            continue
+        value = record.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue
+        if record.get("error") or (record.get("extra") or {}).get("error"):
+            continue
+        groups.setdefault(comparable_key(record), []).append(record)
+    return groups
+
+
+#: ``find_no_prior`` only reports groups whose latest record sits
+#: within this many trailing records — an abandoned one-off lever
+#: experiment ages out of the diff output once enough newer evidence
+#: lands, instead of printing a stale "no comparable prior" forever.
+NO_PRIOR_RECENT_WINDOW = 12
+
+
+def find_no_prior(
+    records: List[dict],
+    min_history: int = MIN_HISTORY,
+    recent_window: int = NO_PRIOR_RECENT_WINDOW,
+) -> List[dict]:
+    """Gate-able groups whose latest record has FEWER than
+    ``min_history`` predecessors — measured, but with nothing honest to
+    compare against. Distinct from "stable": lever flags are part of
+    the comparable key, so flipping a default starts a fresh group and
+    a silent exit-0 would read as "no regression" when the truth is
+    "no baseline yet" (``pio perf diff`` prints these explicitly —
+    docs/performance.md#perf-ledger). One verdict dict per group, with
+    the history count the group still needs. Only groups still ACTIVE
+    — latest record within the trailing ``recent_window`` gate-able
+    records — are reported, so a forgotten one-off experiment stops
+    cluttering the diff once newer evidence buries it."""
+    groups = _gateable_groups(records)
+    # recency = position in the gate-able stream (same record objects
+    # the groups hold, so id() is a stable key even for duplicates)
+    gateable_ids = {id(r) for g in groups.values() for r in g}
+    positions: Dict[int, int] = {}
+    for record in records:
+        if id(record) in gateable_ids and id(record) not in positions:
+            positions[id(record)] = len(positions)
+    total = len(positions)
+    out: List[dict] = []
+    for key, group in groups.items():
+        if len(group) >= min_history + 1:
+            continue
+        latest = group[-1]
+        if total > recent_window and (
+            positions.get(id(latest), total) < total - recent_window
+        ):
+            continue  # stale experiment: aged out of the report
+        out.append(
+            {
+                "key": _key_dict(key),
+                "latest": float(latest["value"]),
+                "latest_source": latest.get("source"),
+                "history": len(group) - 1,
+                "needed": min_history,
+            }
+        )
+    return out
+
+
 def detect_regressions(
     records: List[dict],
     noise_band: float = DEFAULT_NOISE_BAND,
@@ -395,20 +482,10 @@ def detect_regressions(
     the fleet drive's small-sample p99); the group's effective band is
     the WIDER of it and the caller's, so a noisy metric can never be
     held to a tighter bar than its producer declared. Returns one
-    verdict dict per flagged group — empty means clean."""
-    groups: Dict[Tuple, List[dict]] = {}
-    for record in records:
-        if record.get("unit", "s") != "s":
-            continue
-        value = record.get("value")
-        if not isinstance(value, (int, float)) or value <= 0:
-            continue  # failed runs (value -1) gate nothing
-        if record.get("error") or (record.get("extra") or {}).get("error"):
-            # a quality-gate failure carries a real (positive) wall time
-            # but measured an invalid run — it must neither be gated nor
-            # pollute the baseline median
-            continue
-        groups.setdefault(comparable_key(record), []).append(record)
+    verdict dict per flagged group — empty means clean (groups without
+    enough history are NOT clean, they are unestablished — see
+    :func:`find_no_prior`)."""
+    groups = _gateable_groups(records)
     flagged: List[dict] = []
     for key, group in groups.items():
         if len(group) < min_history + 1:
@@ -429,15 +506,7 @@ def detect_regressions(
         if ratio > 1.0 + band:
             flagged.append(
                 {
-                    "key": {
-                        "metric": key[0],
-                        "device_class": key[1],
-                        "scale": key[2],
-                        "solve_mode": key[3],
-                        "gather_dtype": key[4],
-                        "sort_gather": key[5],
-                        "fused_gather": key[6],
-                    },
+                    "key": _key_dict(key),
                     "latest": float(latest["value"]),
                     "latest_source": latest.get("source"),
                     "baseline_median": round(baseline, 4),

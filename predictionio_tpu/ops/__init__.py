@@ -15,8 +15,11 @@ from . import classifier, forest, markov, naive_bayes
 from .scoring import (
     standardize,
     top_k_for_users,
+    top_k_for_users_fused,
     top_k_for_vectors,
+    top_k_fused_vectors,
     top_k_similar_items,
+    top_k_similar_items_fused,
 )
 
 __all__ = [
@@ -34,6 +37,9 @@ __all__ = [
     "rmse",
     "standardize",
     "top_k_for_users",
+    "top_k_for_users_fused",
     "top_k_for_vectors",
+    "top_k_fused_vectors",
     "top_k_similar_items",
+    "top_k_similar_items_fused",
 ]

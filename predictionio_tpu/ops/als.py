@@ -40,7 +40,9 @@ import numpy as np
 #: Default degree-bucket widths (powers of 4; rows pad to the nearest).
 DEFAULT_BUCKET_WIDTHS = (8, 32, 128, 512, 2048, 8192, 32768)
 
-#: Rows per device block inside a bucket solve (bounds peak gather memory).
+#: Max rows per device block inside a bucket solve (bounds peak gather
+#: memory). Small buckets allocate LESS than a full block — see
+#: :func:`_alloc_block`: sentinel padding rows cost real device FLOPs.
 _BLOCK_ROWS = {8: 16384, 32: 8192, 128: 4096, 512: 1024, 2048: 256, 8192: 64, 32768: 16}
 
 
@@ -157,6 +159,27 @@ def _idx_dtype(n_cols: int):
     return np.uint16 if n_cols <= 0xFFFF else np.int32
 
 
+def _alloc_block(width: int, n_real: int) -> int:
+    """Row-allocation granularity for one bucket: the smaller of the
+    width's :data:`_BLOCK_ROWS` bound (peak gather memory) and the
+    power-of-two envelope of the bucket's real row count (floor 8, the
+    sublane granularity).
+
+    Sentinel padding rows are not free — the solve einsums compute over
+    them — and allocating a FULL device block regardless of occupancy
+    made small workloads mostly padding: at the bench's CPU-fallback
+    scale the widest buckets carried 1–7 real rows in 16–64-row blocks
+    (74–99% wasted FLOPs, measured round 12). Right-sizing to a power
+    of two keeps the compiled-program set O(log) per width (the serving
+    ``pad_pow2`` discipline) while the block bound still caps the
+    gather working set for full buckets."""
+    block = _block_rows_for(int(width))
+    if n_real <= 0:
+        return block
+    pow2 = 1 << (max(int(n_real), 8) - 1).bit_length()
+    return min(block, pow2)
+
+
 def _alloc_rows(sel, counts_clip, n_rows, width, pad_to_blocks):
     """Rows/counts arrays for one bucket, optionally rounded up to the
     device chunk size with (n_rows, 0) sentinel padding rows. Empty
@@ -165,7 +188,7 @@ def _alloc_rows(sel, counts_clip, n_rows, width, pad_to_blocks):
     b = len(sel)
     if not pad_to_blocks or b == 0:
         return sel, counts_clip, b
-    block = _block_rows_for(int(width))
+    block = _alloc_block(int(width), b)
     b_alloc = -(-b // block) * block
     rows_arr = np.full(b_alloc, n_rows, dtype=np.int32)
     rows_arr[:b] = sel
@@ -362,21 +385,55 @@ class ALSConfig:
     #: Sort each solve row's gathered column indices ascending before
     #: staging (host-side, one vectorized argsort per bucket). The
     #: Gramian sum over K is permutation-invariant, so results are
-    #: identical up to float reassociation; what changes is HBM access
-    #: locality — adjacent gathers hit adjacent factor rows, which is the
-    #: cheap lever against the gather-bound iteration (the solve is
-    #: already fused Pallas). Off by default pending a measured win.
-    sort_gather_indices: bool = False
+    #: identical up to float reassociation (the ROUND7_NOTES contract:
+    #: factors to rtol 1e-3 / atol 1e-4 over 3 iterations, training RMSE
+    #: to 1e-3 — pinned in tests/test_als.py); what changes is HBM
+    #: access locality — adjacent gathers hit adjacent factor rows.
+    #: ``None`` (the default) resolves to True when the inputs are
+    #: host-side :class:`BucketedMatrix` (the sort happens pre-staging)
+    #: and False for already-staged inputs, which cannot be reordered.
+    #: Pass ``False`` explicitly to opt out (the legacy unsorted path);
+    #: an explicit ``True`` with staged inputs still fails loudly.
+    sort_gather_indices: Optional[bool] = None
     #: Build the normal equations with the fused gather+Gramian Pallas
     #: kernel (``ops/pallas_kernels.gramian_fused``) instead of the XLA
     #: gather + einsum: factor rows stream HBM→VMEM exactly once and the
     #: ``[B, K, R]`` gathered intermediate never exists (~3× less
-    #: gather-stage HBM traffic by the PERF.md accounting). Requires
-    #: ``solve_mode`` to resolve to "pallas". EXPERIMENTAL: off by
-    #: default until the Mosaic lowering and the DMA-throughput claim
-    #: are validated on hardware (BENCH_FUSED_GATHER=1 A/B in the
-    #: revalidation queue).
-    fused_gather: bool = False
+    #: gather-stage HBM traffic by the PERF.md accounting). ``None``
+    #: (the default) resolves to True exactly when ``solve_mode``
+    #: resolves to "pallas" (the fused build shares that kernel family's
+    #: VMEM envelope); pass ``False`` explicitly to opt out (the
+    #: einsum-built legacy path). An explicit ``True`` with a
+    #: non-pallas solve mode still fails loudly — a silently ignored
+    #: flag would corrupt the hardware A/B.
+    fused_gather: Optional[bool] = None
+
+    def resolve_levers(self, staged_inputs: bool = False) -> dict:
+        """The CONCRETE lever settings a train run with this config will
+        execute — ``None`` tri-states resolved against the backend
+        (``solve_mode="auto"``) and the input form (``staged_inputs``).
+        One home for the resolution rules, shared by :func:`als_train`
+        and the bench/ledger accounting ("record resolved, not
+        requested" — docs/performance.md#levers)."""
+        solve_mode = self.solve_mode
+        if solve_mode == "auto":
+            solve_mode = (
+                "pallas"
+                if (self.rank <= 80 and jax.default_backend() == "tpu")
+                else "chunked"
+            )
+        sort = self.sort_gather_indices
+        if sort is None:
+            sort = not staged_inputs
+        fused = self.fused_gather
+        if fused is None:
+            fused = solve_mode == "pallas"
+        return {
+            "solve_mode": solve_mode,
+            "gather_dtype": self.gather_dtype,
+            "sort_gather": bool(sort),
+            "fused_gather": bool(fused),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -485,10 +542,14 @@ def stage(
     """
     staged = []
     for bucket in side.buckets:
-        block = _block_rows_for(bucket.width)
+        # same right-sizing rule as _alloc_rows: a bucket already padded
+        # by bucketize(pad_to_blocks=True) re-chunks to its own size (no
+        # re-padding back up to a full block), an unpadded one pads to
+        # its pow2 envelope
+        n = bucket.rows.shape[0]
+        block = _alloc_block(bucket.width, n)
         if row_multiple > 1:
             block = ((block + row_multiple - 1) // row_multiple) * row_multiple
-        n = bucket.rows.shape[0]
         n_chunks = max(1, (n + block - 1) // block)
         padded = n_chunks * block
         pad = padded - n
@@ -980,32 +1041,34 @@ def als_train(
         raise ValueError(
             f"gather_dtype must be 'f32' or 'bf16', got {cfg.gather_dtype!r}"
         )
-    solve_mode = cfg.solve_mode
+    staged_inputs = not (
+        isinstance(by_user, BucketedMatrix)
+        and isinstance(by_item, BucketedMatrix)
+    )
+    levers = cfg.resolve_levers(staged_inputs=staged_inputs)
+    solve_mode = levers["solve_mode"]
     # The pallas solve kernel has bounded VMEM scratch (rank padded to a
     # multiple of 8, n²·128·4 bytes) — "auto" selects around that limit;
     # an explicit "pallas" beyond it must fail loudly, not die in
     # Mosaic's allocator. Under a mesh the kernel runs per-device inside
     # shard_map over the data axis (see _solve_side_traced), so
     # distributed training keeps the fused-Cholesky iteration win.
-    if solve_mode == "auto":
-        solve_mode = (
-            "pallas"
-            if (cfg.rank <= 80 and jax.default_backend() == "tpu")
-            else "chunked"
+    if cfg.solve_mode == "pallas" and cfg.rank > 80:
+        raise ValueError(
+            f"solve_mode='pallas' supports rank <= 80 (VMEM scratch "
+            f"bound), got rank={cfg.rank}; use 'auto' or 'chunked'"
         )
-    elif solve_mode == "pallas":
-        if cfg.rank > 80:
-            raise ValueError(
-                f"solve_mode='pallas' supports rank <= 80 (VMEM scratch "
-                f"bound), got rank={cfg.rank}; use 'auto' or 'chunked'"
-            )
     if cfg.fused_gather and solve_mode != "pallas":
-        # a silently ignored flag would corrupt the hardware A/B
+        # only an EXPLICIT True can conflict (the None default resolves
+        # with the solve mode); a silently ignored flag would corrupt
+        # the hardware A/B
         raise ValueError(
             "fused_gather=True requires solve_mode to resolve to 'pallas' "
             f"(resolved to {solve_mode!r}); pass solve_mode='pallas' "
             "explicitly off-TPU"
         )
+    fused_gather = levers["fused_gather"]
+    sort_gather = levers["sort_gather"]
     rank = cfg.rank
 
     iteration = _als_iteration
@@ -1031,18 +1094,17 @@ def als_train(
         half = _als_half_sharded(tbl_spec)
 
     t_stage = _time.monotonic()
-    if cfg.sort_gather_indices:
+    if cfg.sort_gather_indices and staged_inputs:
+        # already-staged tensors cannot be reordered host-side; only an
+        # EXPLICIT True can conflict (the None default resolves to False
+        # for staged inputs) and silently ignoring it would corrupt an
+        # A/B measurement
+        raise ValueError(
+            "sort_gather_indices=True requires BucketedMatrix inputs "
+            "(sort before staging: sort_bucket_indices(bucketize(...)))"
+        )
+    if sort_gather:
         # gather-locality pass (host, pre-staging); see sort_bucket_indices
-        if not (
-            isinstance(by_user, BucketedMatrix)
-            and isinstance(by_item, BucketedMatrix)
-        ):
-            # already-staged tensors cannot be reordered host-side; a
-            # silently ignored flag would corrupt an A/B measurement
-            raise ValueError(
-                "sort_gather_indices=True requires BucketedMatrix inputs "
-                "(sort before staging: sort_bucket_indices(bucketize(...)))"
-            )
         by_user = sort_bucket_indices(by_user)
         by_item = sort_bucket_indices(by_item)
     if isinstance(by_user, BucketedMatrix):
@@ -1051,12 +1113,19 @@ def als_train(
         by_item = stage(by_item, row_sharding, row_multiple)
     if profile is not None:
         profile["stage_s"] = _time.monotonic() - t_stage
+        # RESOLVED lever flags — what this run actually executed, not
+        # what the config requested (tri-state defaults resolve here);
+        # the bench and perf ledger record these (docs/performance.md)
         profile["solve_mode"] = solve_mode
+        profile["gather_dtype"] = cfg.gather_dtype
+        profile["sort_gather"] = sort_gather
+        profile["fused_gather"] = fused_gather
         profile["flops_per_iteration"] = estimate_iteration_flops(
             by_user, by_item, rank, cfg.implicit_prefs
         )
         profile["hbm_bytes_per_iteration"] = estimate_iteration_hbm_bytes(
-            by_user, by_item, rank, cfg.gather_dtype
+            by_user, by_item, rank, cfg.gather_dtype,
+            fused_gather=fused_gather,
         )
         profile["bucket_shapes"] = {
             "by_user": [
@@ -1125,7 +1194,7 @@ def als_train(
         solve_mode=solve_mode,
         gather_dtype=cfg.gather_dtype,
         mesh=mesh if solve_mode == "pallas" else None,
-        fused_gather=cfg.fused_gather,
+        fused_gather=fused_gather,
     )
     # jit boundary telemetry (docs/observability.md#profiling): a solve
     # call that compiles is counted (and, past the first, counted as a
@@ -1200,27 +1269,52 @@ def estimate_iteration_flops(
 def estimate_iteration_hbm_bytes(
     by_user: StagedMatrix, by_item: StagedMatrix, rank: int,
     gather_dtype: str = "f32",
+    fused_gather: bool = False,
 ) -> float:
     """Padded-shape HBM-traffic estimate for one full iteration — the ALS
     solve is gather-bound, so bandwidth utilization (not MFU) is the
-    honest efficiency number. Per padded row of width K, per side: the
-    factor gather reads K·R elements (the dominant term — counted at the
-    gather dtype's width), idx/val/counts stream in once, and the solved
-    row writes back R floats. Real gathers touch whole (8,128) tiles, so
-    treat this as a lower bound on true traffic."""
+    honest efficiency number.
+
+    Einsum-built path, per padded row of width K, per side: the factor
+    gather reads K·R elements (the dominant term — counted at the gather
+    dtype's width, 2 B for bf16), idx/val/counts stream in once, and the
+    solved row writes back R floats. Real gathers touch whole (8,128)
+    tiles, so treat this as a lower bound on true traffic.
+
+    Fused path (``fused_gather=True``, buckets with K >= rank — narrower
+    buckets keep the einsum build, mirroring ``_solve_side_traced``'s
+    auto-gate): each rating's factor row moves as ONE lane-aligned
+    1×128-lane f32 DMA — 512 B at bench ranks, REGARDLESS of
+    ``gather_dtype`` (Mosaic cannot slice a half-width bf16 sublane, so
+    the kernel upcasts at entry; ``ops/pallas_kernels.gramian_fused``) —
+    plus the [B, R, R] systems written once and re-read through the
+    transposed-layout round trip the solver needs. bf16 therefore buys
+    bytes only on the einsum path; the fused path's win is removing the
+    [B, K, R] intermediate, not narrowing the rows."""
     elt = 2.0 if gather_dtype == "bf16" else 4.0
+    lane_pad = float(-(-int(rank) // 128) * 128)  # 1×128-lane DMA floor
     total = 0.0
     for side in (by_user, by_item):
         for b in side.buckets:
             rows = float(np.prod(b.rows.shape))
             k = float(b.idx.shape[-1])
             idx_b = b.idx.dtype.itemsize
-            total += rows * (
-                k * rank * elt  # gathered opposite factors
-                + k * (idx_b + 4.0)  # idx + val stream
-                + 4.0  # per-row counts read
-                + rank * 4.0  # solution write
-            )
+            if fused_gather and k >= rank:
+                per_row = (
+                    k * lane_pad * 4.0  # per-rating aligned row DMAs (f32)
+                    + k * (idx_b + 4.0)  # idx + val stream
+                    + 4.0  # per-row counts read
+                    + 3.0 * rank * rank * 4.0  # A write + transpose trip
+                    + 2.0 * rank * 4.0  # rhs vector + solution write
+                )
+            else:
+                per_row = (
+                    k * rank * elt  # gathered opposite factors
+                    + k * (idx_b + 4.0)  # idx + val stream
+                    + 4.0  # per-row counts read
+                    + rank * 4.0  # solution write
+                )
+            total += rows * per_row
     return total
 
 

@@ -166,32 +166,14 @@ def top_k_streaming(
     """
     if not _HAVE_PALLAS:
         # XLA fallback with the SAME contract: exclusions applied (dense
-        # mask) and k clamped/padded to the catalog size.
-        from .scoring import top_k_for_vectors
+        # mask), k clamped/padded to the catalog size, -inf slots carry
+        # the -1 sentinel. One home for that contract now that the fused
+        # serving entries (ops/scoring.py) share it.
+        from .scoring import xla_topk_with_sentinels
 
-        n_items = item_factors.shape[0]
-        k_eff = min(k, n_items)
-        mask = None
-        if exclude_idx is not None and exclude_idx.shape[1] > 0:
-            b = query_vectors.shape[0]
-            excl = jnp.asarray(exclude_idx, jnp.int32)
-            one_hot = jax.nn.one_hot(
-                jnp.where(excl >= 0, excl, n_items), n_items + 1,
-                dtype=jnp.bool_,
-            ).any(axis=1)[:, :n_items]
-            mask = one_hot
-        scores, idx = top_k_for_vectors(
-            query_vectors, item_factors, k_eff, exclude_mask=mask
+        return xla_topk_with_sentinels(
+            query_vectors, item_factors, k, exclude_idx
         )
-        # Same contract as the kernel: any -inf slot (excluded/invalid)
-        # carries the -1 index sentinel, never a real (excluded) item id.
-        idx = jnp.where(jnp.isneginf(scores), -1, idx)
-        if k_eff < k:
-            scores = jnp.pad(
-                scores, ((0, 0), (0, k - k_eff)), constant_values=-np.inf
-            )
-            idx = jnp.pad(idx, ((0, 0), (0, k - k_eff)), constant_values=-1)
-        return scores, idx
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -358,10 +340,12 @@ def spd_solve_t(
 # Cost model (why this can win despite per-row DMAs): the XLA path moves
 # ~3 × B·K·R·4 bytes of HBM traffic per chunk; this kernel moves
 # B·K·(R·4 + ~overhead) with K_tile copies in flight to hide latency. The
-# risk is DMA-issue rate on small (rank·4 ≈ 200 B) transfers — which is
-# exactly what the hardware A/B (BENCH_FUSED_GATHER=1) measures; the
-# kernel stays behind an explicit flag until a chip validates both the
-# Mosaic lowering and the throughput claim.
+# risk is DMA-issue rate on small (rank·4 ≈ 200 B) transfers. Since
+# round 12 the kernel is the DEFAULT build wherever the pallas solver
+# resolves (ALSConfig.fused_gather=None; BENCH_FUSED_GATHER=0 /
+# fused_gather=False opt out) — the issue-rate question is still open
+# on silicon and sits FIRST on the hardware-day bisect checklist
+# (docs/hardware_day.md "Reclaiming the 3.29×").
 #
 # Replaces the same MLlib hot loop as the solver above (reference:
 # ``examples/scala-parallel-recommendation/custom-prepartor/src/main/
@@ -405,7 +389,7 @@ def _gramian_kernel(idx_ref, w2_ref, rhs_ref, ridge_ref, y_ref, yty_ref,
         t = s % k_tiles
 
         def one(k, _):
-            # pio: lint-ok[mosaic-per-row-dma] the per-row gather IS this kernel's design; flag-gated (BENCH_FUSED_GATHER=1) until the hardware A/B prices the DMA-issue rate (PERF.md)
+            # pio: lint-ok[mosaic-per-row-dma] the per-row gather IS this kernel's design; default-ON with the pallas solver since round 12 (explicit opt-out BENCH_FUSED_GATHER=0 / fused_gather=False), with the DMA-issue rate still first on the hardware-day A/B bisect list (docs/hardware_day.md)
             dma = pltpu.make_async_copy(
                 y_ref.at[pl.ds(idx_ref[b, t * kt + k], 1), :],
                 gbuf.at[slot, pl.ds(k, 1), :],
@@ -530,8 +514,10 @@ def gramian_fused(
     than the unpadded 224 B, which is what the hardware A/B prices.
 
     ``interpret=None`` auto-selects interpreter off-TPU. No XLA fallback:
-    callers opt in explicitly (flag-gated until hardware-validated) and
-    the surrounding code keeps its einsum path as the default.
+    the caller (``_solve_side_traced``) owns the dispatch — default-ON
+    with the pallas solver since round 12, with ``fused_gather=False``
+    as the explicit einsum-build opt-out and narrow (K < rank) buckets
+    auto-kept on the einsum path.
     """
     if not _HAVE_PALLAS:
         raise NotImplementedError(

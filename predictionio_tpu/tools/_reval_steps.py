@@ -275,10 +275,15 @@ def step_implicit_gate() -> dict:
 
     base = dict(rank=32, iterations=5, lambda_=0.05, alpha=10.0,
                 implicit_prefs=True, seed=3)
+    # tri-state lever envs mirror bench.py round 12: unset rides the
+    # ALSConfig defaults (sort ON for bucketized inputs; fused resolves
+    # with the solver), "0"/"1" force the leg explicitly
+    sort_env = os.environ.get("BENCH_SORT_GATHER")
+    fused_env = os.environ.get("BENCH_FUSED_GATHER")
     lever = dict(
         gather_dtype=os.environ.get("BENCH_GATHER_DTYPE", "bf16"),
-        sort_gather_indices=os.environ.get("BENCH_SORT_GATHER") == "1",
-        fused_gather=os.environ.get("BENCH_FUSED_GATHER") == "1",
+        sort_gather_indices=None if sort_env is None else sort_env == "1",
+        fused_gather=None if fused_env is None else fused_env == "1",
     )
     if lever["fused_gather"]:
         lever["solve_mode"] = "pallas"

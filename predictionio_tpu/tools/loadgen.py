@@ -1693,39 +1693,10 @@ def run_fleet_chaos(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
-def merged_matches_reference(
-    merged: Any, reference: Any, rtol: float = 1e-5, atol: float = 1e-6
-) -> bool:
-    """The sharded-serving equality contract: identical item *ranking*
-    (the top-k and its order — exact), scores equal to f32
-    reassociation tolerance. The item set/order is what "exact top-k"
-    means; scores carry last-ulp noise because XLA's matmul
-    accumulation order depends on the matrix shape, so a 6-item shard
-    and a 12-item catalog round differently (docs/fleet.md)."""
-    if not (isinstance(merged, dict) and isinstance(reference, dict)):
-        return merged == reference
-    got = merged.get("itemScores")
-    want = reference.get("itemScores")
-    if got is None or want is None:
-        return merged == reference
-    got_items = [e.get("item") for e in got]
-    want_items = [e.get("item") for e in want]
-    if got_items != want_items:
-        # Two items whose scores differ by LESS than the tolerance can
-        # legitimately swap rank between the router's merge and the
-        # device top-k (the same noise, applied to a near-tie). Accept a
-        # permutation only when the item SETS agree and the positionwise
-        # scores still align — which confines any swap to within a tied
-        # window; a genuinely different item in the list still fails.
-        if set(got_items) != set(want_items):
-            return False
-    return bool(
-        np.allclose(
-            [float(e.get("score", 0.0)) for e in got],
-            [float(e.get("score", 0.0)) for e in want],
-            rtol=rtol, atol=atol,
-        )
-    )
+# merged_matches_reference moved to fleet/merge.py — ONE home for the
+# f32 ranking-equality contract, shared with the fused top-k
+# equivalence tests (re-exported here for the drill callers/tests).
+from ..fleet.merge import merged_matches_reference  # noqa: E402,F401
 
 
 def _post_with_headers(node: str, payload: bytes):

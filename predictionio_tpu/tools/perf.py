@@ -264,9 +264,19 @@ def run_perf(args: argparse.Namespace) -> int:
     flagged = perfledger.detect_regressions(
         records, noise_band=args.noise_band
     )
+    no_prior = perfledger.find_no_prior(records)
     if args.json:
-        print(json.dumps({"regressions": flagged, "records": len(records)}))
-    elif flagged:
+        print(
+            json.dumps(
+                {
+                    "regressions": flagged,
+                    "noPrior": no_prior,
+                    "records": len(records),
+                }
+            )
+        )
+        return EXIT_REGRESSION if flagged else EXIT_OK
+    if flagged:
         for item in flagged:
             key = item["key"]
             print(
@@ -277,10 +287,32 @@ def run_perf(args: argparse.Namespace) -> int:
                 f"runs — {item['ratio']:.2f}x, band "
                 f"{1.0 + item['noise_band']:.2f}x"
             )
-    else:
+    # "no baseline yet" is a different statement from "stable": a lever
+    # default flip starts a fresh comparable group (flags are part of
+    # the key), and reporting nothing would read as "no regression"
+    for item in no_prior:
+        key = item["key"]
+        levers = (
+            f"solve={key['solve_mode']} gather={key['gather_dtype']}"
+            + (" sort" if key["sort_gather"] else "")
+            + (" fused" if key["fused_gather"] else "")
+        )
+        print(
+            f"NO COMPARABLE PRIOR {key['metric']} [{key['device_class']} "
+            f"scale={key['scale']} {levers}]: latest {item['latest']:.3f}s "
+            f"({item['latest_source']}) has {item['history']} prior "
+            f"run(s), needs {item['needed']} — not gated, not 'stable'"
+        )
+    if not flagged:
         print(
             f"no regressions across {len(records)} records "
-            f"(noise band {args.noise_band:.0%})"
+            f"(noise band {args.noise_band:.0%}"
+            + (
+                f"; {len(no_prior)} group(s) await comparable history"
+                if no_prior
+                else ""
+            )
+            + ")"
         )
     return EXIT_REGRESSION if flagged else EXIT_OK
 

@@ -101,12 +101,14 @@ def _stage_avals(side, sh, row_multiple: int = 1):
 
     buckets = []
     for bucket in side.buckets:
-        block = als._block_rows_for(bucket.width)
+        # right-sized allocation, same rule as stage(): the block is
+        # capped by the bucket's own pow2 row envelope (round 12)
+        n = bucket.rows.shape[0]
+        block = als._alloc_block(bucket.width, n)
         if row_multiple > 1:
             block = (
                 (block + row_multiple - 1) // row_multiple
             ) * row_multiple
-        n = bucket.rows.shape[0]
         n_chunks = max(1, (n + block - 1) // block)
         idx_dtype = als._idx_dtype(side.n_cols)
         aval = lambda shape, dt: jax.ShapeDtypeStruct(

@@ -119,9 +119,10 @@ class ServerConfig:
     # batch_pipeline_depth × batch_max — at 10M items and depth 2,
     # 2×512×1e7×4 B ≈ 41 GB. Size batch_max to the catalog AND depth:
     # batch_max ≲ device_bytes / (batch_pipeline_depth × n_items × 4)
-    # (e.g. 64 for 10M items at depth 2 on a 16 GB chip). The Pallas
-    # streaming top-k (auto-selected for huge catalogs) sidesteps the
-    # score matrix entirely.
+    # (e.g. 64 for 10M items at depth 2 on a 16 GB chip). The fused
+    # streaming top-k (auto-selected on TPU past 64 MB of would-be
+    # scores — ops.scoring.STREAMING_TOPK_BYTES; /status.json topkPath
+    # reports the resolved path) sidesteps the score matrix entirely.
     batch_max: int = 512
     batch_wait_ms: float = 1.0
     # In-flight batch pipelining: while one batch's results travel back
@@ -1450,6 +1451,18 @@ class QueryServer(BackgroundHTTPServer):
                 "index": self.config.shard_index,
                 "count": self.config.shard_count,
             }
+        # resolved serving top-k path per algorithm ("streaming" = the
+        # fused device-resident Pallas kernel, "dense" = XLA score +
+        # lax.top_k; None until the first query) — the serve-side lever
+        # record, matching the train side's resolved-flag discipline
+        # (docs/performance.md#levers)
+        topk = {
+            f"{idx}:{type(algo).__name__}": algo.topk_path
+            for idx, algo in enumerate(dep.algorithms)
+            if getattr(algo, "topk_path", None) is not None
+        }
+        if topk:
+            out["topkPath"] = topk
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
         if getattr(self, "quality", None) is not None:

@@ -1,0 +1,41 @@
+"""Fixture: bf16-cast operands reaching contractions WITHOUT
+preferred_element_type — the accumulator silently follows the operand
+dtype down to bf16 (mosaic-bf16-accum)."""
+import jax
+import jax.numpy as jnp
+
+
+def direct_cast_einsum(y, idx, mask):
+    g = y.astype(jnp.bfloat16)[idx] * mask
+    # BAD: bf16 operands, accumulator defaults to bf16
+    return jnp.einsum("bkr,bks->brs", g, g)
+
+
+def conditional_dtype_dot(y, val, reduced):
+    gdt = jnp.bfloat16 if reduced else jnp.float32
+    y_g = y.astype(gdt)
+    # BAD: possibly-bf16 via the conditional-dtype idiom, kwarg missing
+    return jax.lax.dot_general(
+        y_g, val.astype(y_g.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+    )
+
+
+def one_hop_matmul(table, q):
+    low = table.astype("bfloat16")
+    padded = jnp.pad(low, ((0, 0), (0, 8)))
+    # BAD: taint survives the pad (still bf16 data)
+    return jnp.matmul(q, padded.T)
+
+
+def operator_matmul(table, q):
+    low = table.astype(jnp.bfloat16)
+    # BAD: the @ operator cannot pin an accumulator dtype at all
+    return q @ low.T
+
+
+def tuple_unpacked_einsum(yu, yi, reduced):
+    gdt = jnp.bfloat16 if reduced else jnp.float32
+    g1, g2 = yu.astype(gdt), yi.astype(gdt)
+    # BAD: taint flows through the tuple-unpacking assignment
+    return jnp.einsum("bkr,bks->brs", g1, g2)

@@ -1,0 +1,56 @@
+"""Fixture twin: the same bf16-cast shapes with f32 accumulation pinned
+(or the operand explicitly upcast) — mosaic-bf16-accum stays quiet."""
+import jax
+import jax.numpy as jnp
+
+
+def direct_cast_einsum(y, idx, mask):
+    g = y.astype(jnp.bfloat16)[idx] * mask
+    # clean: accumulation forced to f32 (the als.py exemplar shape)
+    return jnp.einsum(
+        "bkr,bks->brs", g, g, preferred_element_type=jnp.float32
+    )
+
+
+def conditional_dtype_dot(y, val, reduced):
+    gdt = jnp.bfloat16 if reduced else jnp.float32
+    y_g = y.astype(gdt)
+    return jax.lax.dot_general(
+        y_g, val.astype(y_g.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def one_hop_matmul(table, q):
+    low = table.astype("bfloat16")
+    # clean: explicit upcast clears the reduced-precision taint
+    wide = low.astype(jnp.float32)
+    return jnp.matmul(q, wide.T)
+
+
+def f32_only_matmul(a, b):
+    # clean: no bf16 anywhere near it
+    return jnp.matmul(a, b)
+
+
+def nested_upcast_in_expression(table, w, q):
+    low = table.astype(jnp.bfloat16)
+    # clean: the upcast clears the taint even nested inside the
+    # operand expression — no redundant preferred_element_type needed
+    return jnp.matmul(q, low.astype(jnp.float32) * w)
+
+
+def operator_matmul_upcast(table, q):
+    low = table.astype(jnp.bfloat16)
+    # clean: explicit upcast before the operator form
+    return q @ low.astype(jnp.float32).T
+
+
+def tuple_unpacked_einsum(yu, yi, reduced):
+    gdt = jnp.bfloat16 if reduced else jnp.float32
+    g1, g2 = yu.astype(gdt), yi.astype(gdt)
+    # clean: tuple-unpacked bf16 operands with f32 accumulation pinned
+    return jnp.einsum(
+        "bkr,bks->brs", g1, g2, preferred_element_type=jnp.float32
+    )

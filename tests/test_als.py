@@ -227,11 +227,20 @@ def _sweep_data():
     return u, i, v, n_u, n_i
 
 
-def sweep_factors(mode, implicit=False, fused=False, meshed=False):
+def sweep_factors(mode, implicit=False, fused=False, meshed=False,
+                  gather="f32", sort=None):
     """Factors for one lever setting over the shared dataset, trained at
     most once per session (rank 12, 3 iterations, seed 2 — identical
-    across every consumer so the cached runs stay comparable)."""
-    key = (mode, implicit, fused, meshed)
+    across every consumer so the cached runs stay comparable).
+
+    ``sort=None`` rides the round-12 default (resolves to sorted for
+    these bucketized inputs), so the cached baseline legs ARE the
+    flipped-default runs; ``sort=False`` is the explicit legacy opt-out
+    leg the default-equivalence test compares against. ``fused=False``
+    (the signature default) is likewise the explicit einsum-build
+    opt-out — under the flipped defaults a bare pallas config resolves
+    fused ON, pinned in TestLeverDefaults without training anything."""
+    key = (mode, implicit, fused, meshed, gather, sort)
     if key not in _SWEEP_CACHE:
         from predictionio_tpu.ops.als import ALSConfig, als_train_coo
         from predictionio_tpu.parallel.mesh import create_mesh
@@ -241,6 +250,7 @@ def sweep_factors(mode, implicit=False, fused=False, meshed=False):
             rank=12, iterations=3, lambda_=0.05,
             implicit_prefs=implicit, alpha=1.0, seed=2,
             solve_mode=mode, fused_gather=fused,
+            gather_dtype=gather, sort_gather_indices=sort,
         )
         f = als_train_coo(
             u, i, v, n_users=n_u, n_items=n_i, cfg=cfg,
@@ -370,62 +380,81 @@ class TestSortGatherIndices:
             )
 
     def test_training_result_unchanged(self):
-        from predictionio_tpu.ops.als import ALSConfig, als_train_coo, rmse
+        """The round-12 default flip's equivalence proof: the DEFAULT
+        config (sort resolves ON for bucketized inputs) vs the explicit
+        ``sort_gather_indices=False`` legacy opt-out, riding the shared
+        sweep cache — the sorted leg IS every other equivalence test's
+        baseline, so the flip costs one extra cached training run."""
+        from predictionio_tpu.ops.als import ALSFactors, rmse
 
-        rng = np.random.default_rng(6)
-        nnz, n_u, n_i = 20_000, 500, 200
-        u = rng.integers(0, n_u, nnz).astype(np.int32)
-        i = rng.integers(0, n_i, nnz).astype(np.int32)
-        v = (rng.random(nnz) * 4 + 1).astype(np.float32)
-        base = als_train_coo(
-            u, i, v, n_u, n_i,
-            ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=0),
-        )
-        sorted_run = als_train_coo(
-            u, i, v, n_u, n_i,
-            ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=0,
-                      sort_gather_indices=True),
-        )
+        u, i, v, _, _ = _sweep_data()
+        sorted_run = sweep_factors("chunked")  # default ⇒ sorted
+        legacy = sweep_factors("chunked", sort=False)
         # Factor parity to the f32 reassociation tolerance: the sort
         # reorders each row's einsum accumulation, so per-solve rounding
         # is ~1e-5 and three alternating iterations amplify it through
-        # the Cholesky solves (ROUND7_NOTES.md). The old atol=1e-5 bound
-        # asserted bitwise-ish equality that f32 cannot promise.
+        # the Cholesky solves (ROUND7_NOTES.md). The seed-era atol=1e-5
+        # bound asserted bitwise-ish equality that f32 cannot promise.
         np.testing.assert_allclose(
-            np.asarray(base.user_factors),
-            np.asarray(sorted_run.user_factors),
-            rtol=1e-3, atol=1e-4,
+            sorted_run[0], legacy[0], rtol=1e-3, atol=1e-4,
         )
         # ...and the bound that actually matters for an A/B: training
         # quality is unchanged.
-        assert abs(rmse(base, u, i, v) - rmse(sorted_run, u, i, v)) < 1e-3
+        r_sorted = rmse(ALSFactors(*sorted_run, rank=12), u, i, v)
+        r_legacy = rmse(ALSFactors(*legacy, rank=12), u, i, v)
+        assert abs(r_sorted - r_legacy) < 1e-3
+
+    def test_staged_input_default_resolves_unsorted(self):
+        """Staged inputs + the None default must NOT raise (the flip
+        keeps pre-staged callers working): the sort resolves OFF and the
+        resolved levers say so in the profile."""
+        from predictionio_tpu.ops.als import (
+            ALSConfig, als_train, bucketize, stage,
+        )
+
+        rng = np.random.default_rng(7)
+        u = rng.integers(0, 50, 500).astype(np.int32)
+        i = rng.integers(0, 30, 500).astype(np.int32)
+        v = np.ones(500, dtype=np.float32)
+        bu = stage(bucketize(u, i, v, 50, 30, pad_to_blocks=True))
+        bi = stage(bucketize(i, u, v, 30, 50, pad_to_blocks=True))
+        profile: dict = {}
+        factors = als_train(
+            bu, bi, ALSConfig(rank=4, iterations=1), profile=profile,
+        )
+        assert np.isfinite(np.asarray(factors.user_factors)).all()
+        assert profile["sort_gather"] is False
+        assert profile["fused_gather"] is False  # chunked on CPU
+        assert profile["gather_dtype"] == "f32"
 
 
 class TestGatherDtype:
     """bf16 gathers must track the f32 result closely (input rounding at
     2^-8 relative; the λ·n_u ridge keeps solves stable) and fail loudly on
-    unknown dtypes."""
+    unknown dtypes. Rides the shared sweep cache (tier-1 budget): the
+    f32 leg IS TestSolveModes' chunked baseline, so only the bf16 legs
+    train."""
 
     @pytest.mark.parametrize("implicit", [False, True])
     def test_bf16_tracks_f32(self, implicit):
-        from predictionio_tpu.ops.als import ALSConfig, als_train_coo
-
-        rng = np.random.default_rng(11)
-        nnz, n_u, n_i = 20_000, 600, 200
-        u = rng.integers(0, n_u, nnz).astype(np.int32)
-        i = rng.integers(0, n_i, nnz).astype(np.int32)
-        v = rng.integers(1, 6, nnz).astype(np.float32)
-        out = {}
-        for gd in ("f32", "bf16"):
-            cfg = ALSConfig(rank=8, iterations=3, lambda_=0.1,
-                            implicit_prefs=implicit, gather_dtype=gd)
-            f = als_train_coo(u, i, v, n_users=n_u, n_items=n_i, cfg=cfg)
-            out[gd] = np.asarray(f.user_factors)
-        rel = np.linalg.norm(out["f32"] - out["bf16"]) / np.linalg.norm(
-            out["f32"]
-        )
-        assert np.isfinite(out["bf16"]).all()
+        f32 = sweep_factors("chunked", implicit=implicit)
+        bf16 = sweep_factors("chunked", implicit=implicit, gather="bf16")
+        rel = np.linalg.norm(f32[0] - bf16[0]) / np.linalg.norm(f32[0])
+        assert np.isfinite(bf16[0]).all()
         assert rel < 0.05, rel  # tracks, within reduced-precision drift
+
+    def test_bf16_rmse_within_bench_gate(self):
+        """The bench's bf16 RMSE gate (docs/performance.md#levers) holds
+        at test scale too: reduced-precision gathers move training RMSE
+        by far less than the documented 0.01 bound."""
+        from predictionio_tpu.ops.als import ALSFactors, rmse
+
+        u, i, v, _, _ = _sweep_data()
+        f32 = sweep_factors("chunked")
+        bf16 = sweep_factors("chunked", gather="bf16")
+        r_f32 = rmse(ALSFactors(*f32, rank=12), u, i, v)
+        r_bf16 = rmse(ALSFactors(*bf16, rank=12), u, i, v)
+        assert abs(r_f32 - r_bf16) <= 0.01, (r_f32, r_bf16)
 
     def test_unknown_dtype_fails_loudly(self):
         from predictionio_tpu.ops.als import ALSConfig, als_train_coo
@@ -477,3 +506,255 @@ class TestFusedGather:
                 np.ones(2, dtype=np.float32),
                 n_users=2, n_items=2, cfg=cfg,
             )
+
+
+class TestLeverDefaults:
+    """The round-12 default flip, pinned WITHOUT training anything:
+    ``resolve_levers`` is the one home for the tri-state resolution the
+    trainer, the bench and the ledger all read."""
+
+    def test_defaults_resolve_fast_paths_on(self):
+        from predictionio_tpu.ops.als import ALSConfig
+
+        levers = ALSConfig().resolve_levers()
+        # CPU test host: auto solve resolves chunked, so fused follows
+        # it off — but sort is host-side and unconditional for
+        # bucketized inputs
+        assert levers["sort_gather"] is True
+        assert levers["solve_mode"] == "chunked"
+        assert levers["fused_gather"] is False
+        assert levers["gather_dtype"] == "f32"
+
+    def test_pallas_solver_resolves_fused_on(self):
+        from predictionio_tpu.ops.als import ALSConfig
+
+        levers = ALSConfig(solve_mode="pallas").resolve_levers()
+        assert levers["fused_gather"] is True
+        # ...and the explicit opt-out wins over the default
+        opted = ALSConfig(
+            solve_mode="pallas", fused_gather=False
+        ).resolve_levers()
+        assert opted["fused_gather"] is False
+
+    def test_staged_inputs_resolve_sort_off(self):
+        from predictionio_tpu.ops.als import ALSConfig
+
+        assert (
+            ALSConfig().resolve_levers(staged_inputs=True)["sort_gather"]
+            is False
+        )
+
+    def test_explicit_opt_outs(self):
+        from predictionio_tpu.ops.als import ALSConfig
+
+        levers = ALSConfig(
+            sort_gather_indices=False, fused_gather=False
+        ).resolve_levers()
+        assert levers["sort_gather"] is False
+        assert levers["fused_gather"] is False
+
+
+class TestAllocBlock:
+    """Right-sized bucket allocation (round 12): blocks cap at the
+    device bound but shrink to the bucket's pow2 row envelope — sentinel
+    padding rows cost real solve FLOPs (74–99% of them at the bench's
+    CPU-fallback scale before the fix)."""
+
+    def test_alloc_block_arithmetic(self):
+        from predictionio_tpu.ops.als import _alloc_block
+
+        assert _alloc_block(32768, 1) == 8  # sublane floor
+        assert _alloc_block(32768, 16) == 16
+        assert _alloc_block(8192, 7) == 8
+        assert _alloc_block(128, 1051) == 2048  # pow2 envelope
+        assert _alloc_block(32, 10_000) == 8192  # device bound caps
+        assert _alloc_block(512, 1024) == 1024
+
+    def test_bucketize_allocates_right_sized_blocks(self):
+        from predictionio_tpu.ops.als import _alloc_block, bucketize
+
+        rng = np.random.default_rng(3)
+        nnz, n_u, n_i = 8000, 400, 150
+        u = rng.integers(0, n_u, nnz).astype(np.int32)
+        i = rng.integers(0, n_i, nnz).astype(np.int32)
+        v = np.ones(nnz, dtype=np.float32)
+        side = bucketize(u, i, v, n_u, n_i, pad_to_blocks=True)
+        for b in side.buckets:
+            real = int((b.counts > 0).sum())
+            block = _alloc_block(b.width, real)
+            assert b.rows.shape[0] == -(-real // block) * block
+            # the pow2 envelope bounds waste: less than one block spare
+            assert b.rows.shape[0] - real < block
+
+    def test_stage_keeps_right_sized_chunks(self):
+        """stage() must not re-pad a right-sized bucket back up to a
+        full device block (that would undo the allocation win)."""
+        from predictionio_tpu.ops.als import bucketize, stage
+
+        rng = np.random.default_rng(4)
+        u = rng.integers(0, 100, 3000).astype(np.int32)
+        i = rng.integers(0, 60, 3000).astype(np.int32)
+        v = np.ones(3000, dtype=np.float32)
+        side = bucketize(u, i, v, 100, 60, pad_to_blocks=True)
+        staged = stage(side)
+        for b, s in zip(side.buckets, staged.buckets):
+            assert int(np.prod(s.rows.shape)) == b.rows.shape[0]
+
+
+class TestHbmBytesModel:
+    """The roofline bytes accounting (``pio profile --train-smoke`` /
+    bench est_hbm_*), pinned on hand-computed arithmetic so the model
+    cannot silently drift from the kernels it describes."""
+
+    @staticmethod
+    def _staged(rows, width, idx_dtype=np.int32):
+        from predictionio_tpu.ops.als import StagedMatrix, _StagedBucket
+
+        bucket = _StagedBucket(
+            rows=np.zeros((1, rows), np.int32),
+            idx=np.zeros((1, rows, width), idx_dtype),
+            val=np.zeros((1, rows, width), np.float32),
+            counts=np.zeros((1, rows), np.int32),
+        )
+        return StagedMatrix(n_rows=rows, n_cols=64, nnz=rows * width,
+                            buckets=[bucket])
+
+    def test_einsum_path_counts_gather_at_dtype_width(self):
+        from predictionio_tpu.ops.als import estimate_iteration_hbm_bytes
+
+        side = self._staged(rows=4, width=16)
+        empty = self._staged(rows=0, width=8)
+        rank = 8
+        # per row: gather 16·8·elt, idx+val 16·(4+4), counts 4, out 8·4
+        f32 = estimate_iteration_hbm_bytes(side, empty, rank, "f32")
+        assert f32 == 4 * (16 * 8 * 4 + 16 * 8 + 4 + 32)
+        bf16 = estimate_iteration_hbm_bytes(side, empty, rank, "bf16")
+        assert bf16 == 4 * (16 * 8 * 2 + 16 * 8 + 4 + 32)
+
+    def test_fused_path_counts_lane_padded_f32_rows(self):
+        """The fused kernel DMAs whole 128-lane f32 rows (bf16 upcasts
+        at entry), so its gather bytes are dtype-INDEPENDENT and the
+        [B, R, R] transpose round trip is charged."""
+        from predictionio_tpu.ops.als import estimate_iteration_hbm_bytes
+
+        side = self._staged(rows=4, width=16)
+        empty = self._staged(rows=0, width=8)
+        rank = 8
+        expect = 4 * (
+            16 * 128 * 4  # per-rating lane-padded row DMA
+            + 16 * 8  # idx + val
+            + 4  # counts
+            + 3 * 8 * 8 * 4  # A write + transposed round trip
+            + 2 * 8 * 4  # rhs + solution
+        )
+        for dtype in ("f32", "bf16"):
+            got = estimate_iteration_hbm_bytes(
+                side, empty, rank, dtype, fused_gather=True
+            )
+            assert got == expect, (dtype, got, expect)
+
+    def test_fused_gate_spares_narrow_buckets(self):
+        """Buckets narrower than the rank keep the einsum build (the
+        _solve_side_traced auto-gate) and must be charged accordingly."""
+        from predictionio_tpu.ops.als import estimate_iteration_hbm_bytes
+
+        narrow = self._staged(rows=4, width=4)  # width < rank
+        empty = self._staged(rows=0, width=8)
+        rank = 8
+        fused = estimate_iteration_hbm_bytes(
+            narrow, empty, rank, "f32", fused_gather=True
+        )
+        plain = estimate_iteration_hbm_bytes(narrow, empty, rank, "f32")
+        assert fused == plain
+
+    def test_topk_bytes_model(self):
+        """Serve-side companion: streaming removes BOTH score-matrix
+        trips; everything else is identical."""
+        from predictionio_tpu.ops.scoring import estimate_topk_hbm_bytes
+
+        b, n, r, k = 8, 1000, 8, 10
+        factors = b * r * 4 + n * r * 4
+        results = b * k * 8
+        dense = estimate_topk_hbm_bytes(b, n, r, k, streaming=False)
+        stream = estimate_topk_hbm_bytes(b, n, r, k, streaming=True)
+        assert dense == factors + 2 * b * n * 4 + results
+        assert stream == factors + results
+        assert dense - stream == 2 * b * n * 4
+
+
+class TestFusedTopK:
+    """The serve-side fused score+select entries must reproduce the
+    dense kernels exactly — same items, same order, scores to f32
+    reassociation tolerance — on BOTH dispatch legs: the XLA fallback
+    ("never"/off-TPU) and the Pallas streaming kernel ("always",
+    interpret mode on CPU). The score contract is the fleet merge's
+    ``merged_matches_reference`` (one home, fleet/merge.py)."""
+
+    @staticmethod
+    def _item_scores(scores, idx):
+        return [
+            {"item": str(int(i)), "score": float(s)}
+            for s, i in zip(np.asarray(scores), np.asarray(idx))
+            if i >= 0
+        ]
+
+    def _assert_matches(self, got, want):
+        from predictionio_tpu.fleet.merge import merged_matches_reference
+
+        got_s, got_i = got
+        want_s, want_i = want
+        for row in range(np.asarray(want_i).shape[0]):
+            assert merged_matches_reference(
+                {"itemScores": self._item_scores(got_s[row], got_i[row])},
+                {"itemScores": self._item_scores(want_s[row], want_i[row])},
+            ), (row, got_i[row], want_i[row])
+
+    def test_users_fused_matches_dense(self):
+        from predictionio_tpu.ops.scoring import (
+            top_k_for_users, top_k_for_users_fused,
+        )
+
+        rng = np.random.default_rng(2)
+        uf = rng.normal(size=(12, 8)).astype(np.float32)
+        itf = rng.normal(size=(64, 8)).astype(np.float32)
+        users = np.array([1, 4, 9, 11], dtype=np.int32)
+        want = top_k_for_users(uf, itf, users, k=8)
+        for mode in ("never", "always"):
+            got = top_k_for_users_fused(uf, itf, users, k=8, mode=mode)
+            # ranking exact — same items, same order
+            np.testing.assert_array_equal(
+                np.asarray(got[1]), np.asarray(want[1]), err_msg=mode
+            )
+            self._assert_matches(got, want)
+
+    def test_similar_items_fused_matches_dense(self):
+        from predictionio_tpu.ops.scoring import (
+            top_k_similar_items, top_k_similar_items_fused,
+        )
+
+        rng = np.random.default_rng(5)
+        itf = rng.normal(size=(40, 8)).astype(np.float32)
+        queries = np.array([3, 17, 25], dtype=np.int32)
+        want = top_k_similar_items(itf, queries, k=6)
+        for mode in ("never", "always"):
+            got = top_k_similar_items_fused(itf, queries, k=6, mode=mode)
+            np.testing.assert_array_equal(
+                np.asarray(got[1]), np.asarray(want[1]), err_msg=mode
+            )
+            self._assert_matches(got, want)
+            # self-exclusion holds on both legs
+            for row, q in enumerate(queries):
+                assert int(q) not in np.asarray(got[1])[row].tolist()
+
+    def test_sentinel_contract_past_catalog(self):
+        """k beyond the catalog: sub-k slots are (-inf, -1) on BOTH
+        legs — callers must never index with the sentinel."""
+        from predictionio_tpu.ops.scoring import top_k_fused_vectors
+
+        q = np.eye(2, 4, dtype=np.float32)
+        itf = np.eye(3, 4, dtype=np.float32)
+        for mode in ("never", "always"):
+            scores, idx = top_k_fused_vectors(q, itf, k=5, mode=mode)
+            assert np.asarray(idx).shape == (2, 5)
+            assert (np.asarray(idx)[:, 3:] == -1).all(), mode
+            assert np.isneginf(np.asarray(scores)[:, 3:]).all(), mode

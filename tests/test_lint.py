@@ -95,6 +95,52 @@ class TestRound5Fixtures:
         )
 
 
+class TestBf16AccumFixtures:
+    """``mosaic-bf16-accum`` (the round-12 bf16-gather default's safety
+    rule): every contraction shape in the bad twin fires — direct cast,
+    the conditional-dtype ``gdt`` idiom, and one-hop taint through a pad
+    — the clean twin (kwarg pinned / explicit upcast / no bf16) is
+    silent, and the REAL gather-build site in ops/als.py is the clean
+    exemplar the rule's message cites."""
+
+    def test_bad_fixture_fires_on_every_contraction(self):
+        path = os.path.join(FIXTURES, "bf16_accum_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == ["mosaic-bf16-accum"] * 5, (
+            f"expected five mosaic-bf16-accum findings (einsum, "
+            f"dot_general, matmul, the @ operator form, and the "
+            f"tuple-unpacked operands), got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_clean_twin_has_no_findings(self):
+        path = os.path.join(FIXTURES, "bf16_accum_clean.py")
+        findings = lint_file(path)
+        assert findings == [], (
+            f"false positive(s) on clean twin: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_als_gather_site_is_clean_exemplar(self):
+        """ops/als.py mentions bfloat16 (the rule engages — the
+        source-text bail does NOT skip it) yet carries zero findings:
+        every normal-equation contraction pins f32 accumulation."""
+        als_path = os.path.join(
+            REPO, "predictionio_tpu", "ops", "als.py"
+        )
+        with open(als_path, encoding="utf-8") as fh:
+            assert "bfloat16" in fh.read()
+        findings = [
+            f
+            for f in _unsuppressed(als_path)
+            if f.rule_id == "mosaic-bf16-accum"
+        ]
+        assert findings == [], (
+            f"als.py gather build regressed the bf16 accumulation "
+            f"contract: {[(f.rule_id, f.line) for f in findings]}"
+        )
+
+
 class TestRobustFixtures:
     """Family C (robustness) bad/clean twins, same contract as the
     round-5 fixtures: the bad file fires exactly its intended rule at

@@ -453,6 +453,71 @@ class TestPerfLedger:
         ]
         assert perfledger.detect_regressions(records) == []
 
+    def test_bf16_gate_margin_rides_extra(self):
+        """Satellite hygiene (round 12): the bench's bf16 RMSE-gate
+        block travels into the ledger record's extra, so r06+ rounds
+        are self-describing."""
+        gate = {"rmse_f32": 0.53, "rmse_bf16": 0.5301, "margin": 0.0001,
+                "gate": 0.01, "ok": True}
+        record = _bench_like(10.0, source="gated", bf16_gate=gate)
+        assert record["extra"]["bf16_gate"] == gate
+
+
+class TestNoPriorReporting:
+    """Flipping a lever default starts a FRESH comparable group (flags
+    are part of the key) — the diff must say "no comparable prior"
+    explicitly, never let an ungated group read as "stable"."""
+
+    def test_flipped_levers_reported_as_no_prior(self):
+        history = perfledger.load_bench_history(REPO)
+        flipped = _bench_like(5.0, source="flip", sort_gather=True)
+        verdicts = perfledger.find_no_prior(history + [flipped])
+        assert len(verdicts) == 1
+        v = verdicts[0]
+        assert v["latest_source"] == "flip"
+        assert v["history"] == 0
+        assert v["needed"] == perfledger.MIN_HISTORY
+        assert v["key"]["sort_gather"] is True
+        # ...and the flipped record is NOT a regression either
+        assert perfledger.detect_regressions(history + [flipped]) == []
+
+    def test_established_history_has_no_no_prior(self):
+        history = perfledger.load_bench_history(REPO)
+        assert perfledger.find_no_prior(history) == []
+
+    def test_failed_runs_do_not_count_as_measurements(self):
+        failed = _bench_like(-1.0, source="failed",
+                             sort_gather=True)
+        assert perfledger.find_no_prior([failed]) == []
+
+    def test_stale_experiment_ages_out_of_report(self):
+        """A one-off lever experiment must not print 'no comparable
+        prior' forever: once enough newer gate-able evidence lands, the
+        stale group drops out of the report."""
+        stale = _bench_like(9.0, source="oneoff", gather_dtype="bf16")
+        newer = [
+            _bench_like(10.0 + i * 0.01, source=f"r{i}")
+            for i in range(perfledger.NO_PRIOR_RECENT_WINDOW + 1)
+        ]
+        verdicts = perfledger.find_no_prior([stale] + newer)
+        assert [v["latest_source"] for v in verdicts] == []
+        # ...but while it is still recent, it IS reported
+        recent = perfledger.find_no_prior([stale] + newer[:3])
+        assert [v["latest_source"] for v in recent] == ["oneoff"]
+
+    def test_trend_renders_lever_flags(self):
+        """The trend output must name the levers so two short disjoint
+        histories across a default flip read as what they are."""
+        text = perfledger.render_trend(
+            [
+                _bench_like(12.0, source="old"),
+                _bench_like(5.0, source="new", sort_gather=True,
+                            gather_dtype="bf16"),
+            ]
+        )
+        assert "solve=chunked gather=f32" in text
+        assert "solve=chunked gather=bf16 sort" in text
+
 
 # ---------------------------------------------------------------------------
 # 5. CLIs (in-process through the console, tier-1-budget style)
@@ -498,6 +563,43 @@ class TestPerfCLI:
         out = capsys.readouterr().out
         assert "ml20m_als_rank50_train_s" in out
         assert "bench_r05" in out
+
+    def test_perf_diff_reports_no_prior_distinct_from_stable(
+        self, tmp_path, capsys
+    ):
+        """A flipped-lever record exits 0 but is called out as
+        unestablished — wording distinct from the clean-history line —
+        while an empty ledger run says plain "no regressions"."""
+        ledger = str(tmp_path / "ledger.jsonl")
+        perfledger.append_record(
+            ledger, _bench_like(5.0, source="flip", sort_gather=True)
+        )
+        rc = self._main(["perf", "diff", "--ledger", ledger])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "NO COMPARABLE PRIOR" in out
+        assert "sort" in out  # the levers that opened the new group
+        assert "await comparable history" in out
+        # the stable leg: same history, no unestablished groups
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        rc = self._main(["perf", "diff", "--ledger", str(empty)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "NO COMPARABLE PRIOR" not in out
+        assert "no regressions" in out
+        assert "await comparable history" not in out
+
+    def test_perf_diff_json_carries_no_prior(self, tmp_path, capsys):
+        ledger = str(tmp_path / "ledger.jsonl")
+        perfledger.append_record(
+            ledger, _bench_like(5.0, source="flip", sort_gather=True)
+        )
+        rc = self._main(["perf", "diff", "--json", "--ledger", ledger])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["regressions"] == []
+        assert [v["latest_source"] for v in doc["noPrior"]] == ["flip"]
 
     def test_profile_smoke_train_reports_everything(self, capsys):
         """The ISSUE 8 acceptance drive: a smoke-scale in-process train

@@ -141,6 +141,26 @@ def test_status_page(server):
     assert "Request count" in r.text
 
 
+def test_status_json_reports_resolved_topk_path(server):
+    """/status.json surfaces each algorithm's RESOLVED serving top-k
+    path ("streaming" | "dense") once it has served — the serve-side
+    lever record (docs/performance.md#levers). Sample-engine algos
+    don't expose one, so the block is absent here; an algo that does is
+    picked up by name."""
+    base, srv, _, _ = server
+    requests.post(f"{base}/queries.json", json={"id": 1})
+    doc = requests.get(f"{base}/status.json").json()
+    assert "topkPath" not in doc  # sample algos carry no topk_path
+    # graft a reporting algorithm in: the server reads the attribute
+    srv.deployment.algorithms[0].topk_path = "dense"
+    try:
+        doc = requests.get(f"{base}/status.json").json()
+        key = f"0:{type(srv.deployment.algorithms[0]).__name__}"
+        assert doc["topkPath"] == {key: "dense"}
+    finally:
+        del srv.deployment.algorithms[0].topk_path
+
+
 def test_reload_hot_swaps_to_latest(server):
     base, srv, registry, engine = server
     old_id = srv.deployment.instance.id
