@@ -250,6 +250,11 @@ def _append_ledger(record: dict) -> None:
         # (docs/slo.md): alert hygiene gets a trajectory too
         for alert_record in perfledger.alert_records(record):
             perfledger.append_record(path, alert_record)
+        # ingest throughput per partition count, trend-only and keyed
+        # by N via scale (docs/storage.md#partitioning): different
+        # partition counts never gate each other
+        for ingest_record in perfledger.ingest_records(record):
+            perfledger.append_record(path, ingest_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -565,6 +570,26 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:
             record["alerts"] = {"error": str(exc)}
+    # Ingest scaling (docs/storage.md#partitioning): acked-writes/second
+    # at 1, 2 and 4 event-store partitions — subprocess primaries with
+    # the strict fsync-per-ack oplog, concurrent writer processes, best
+    # of 2 rounds per N on this (possibly contended) box. Scaling tops
+    # out at the box's core count: a 2-core CI box shows the 1→2 win
+    # and a 4-way plateau; real silicon shows the full fan. Opt out
+    # with BENCH_INGEST_SCALING=0; a failure never fails the bench.
+    if os.environ.get("BENCH_INGEST_SCALING") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_ingest_scaling
+
+            scaling = run_ingest_scaling()
+            record["ingestScaling"] = {
+                "counts": scaling.get("counts"),
+                "writers": scaling.get("writers"),
+                "rounds": scaling.get("rounds"),
+                "ok": scaling.get("ok"),
+            }
+        except Exception as exc:
+            record["ingestScaling"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

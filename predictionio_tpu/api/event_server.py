@@ -242,6 +242,11 @@ class _EventServiceHandler(JsonHTTPHandler):
     def _dispatch(self, method: str, path: str, query: Dict[str, list]) -> None:
         if path == "/" and method == "GET":
             self._respond(200, {"status": "alive"})
+        elif path == "/replication.json" and method == "GET":
+            # the ingest tier's per-partition view of the partitioned
+            # event store (docs/storage.md#partitioning): one row per
+            # partition, probed client-side — `pio top`'s PARTS column
+            self._respond(200, self.server.replication_json())
         elif path == "/events.json" and method == "POST":
             self._post_event(query)
         elif path == "/batches/events.json" and method == "POST":
@@ -314,7 +319,26 @@ class _EventServiceHandler(JsonHTTPHandler):
             self.server._observe_quality(app_id)
             self._respond(400, {"message": str(exc)})
             return
-        event_id = self.server.events.insert(event, app_id)
+        try:
+            event_id = self.server.events.insert(event, app_id)
+        except Exception as exc:
+            shed = self.server._partition_shed(exc)
+            if shed is None:
+                raise
+            # partial-partition degradation (docs/robustness.md): the
+            # partition owning THIS key is down — shed it with 503 +
+            # Retry-After so a well-behaved SDK backs off and retries,
+            # while every other partition's keys keep acking 201. The
+            # event was never acked, so nothing is lost — just late.
+            self._respond(
+                503,
+                {
+                    "message": str(exc),
+                    "partitions": list(getattr(exc, "partitions", ())),
+                },
+                headers={"Retry-After": shed},
+            )
+            return
         # quality accounting only AFTER the store accepted the event: a
         # storage outage (500s + client retries) must not feed the mix
         # window or auto-pin a baseline from traffic that was never kept
@@ -377,18 +401,78 @@ class _EventServiceHandler(JsonHTTPHandler):
                     eid = event.event_id
                     upserts.append(event)
                 results[pos] = {"status": 201, "eventId": eid}
-            if fresh:
-                self.server.events.write_new(fresh, app_id)
-            if upserts:
-                self.server.events.write(upserts, app_id)
+            # One write per (partition, path): a mixed batch over a
+            # partially-down partitioned store lands everything whose
+            # partition is up and answers 503 for the rest, per event —
+            # never all-or-nothing behind the dead keyspace
+            # (docs/storage.md#partitioning). The unpartitioned store is
+            # one group, preserving the original two batched writes.
+            # Failures are scoped PER WRITER CALL: a partition that died
+            # between the fresh and the upsert writes must 503 only the
+            # events of the call that actually failed — marking an
+            # already-acked event 503 would invite a client retry that
+            # duplicates an unkeyed event.
+            failed = self._write_groups(app_id, fresh, upserts)
+            if failed is not None:
+                part_of = self.server.events.partition_for
+                for pos, event in valid:
+                    call_failed = failed[
+                        "fresh" if event.event_id is None else "upserts"
+                    ]
+                    if part_of(app_id, event.entity_id) in call_failed:
+                        results[pos] = {
+                            "status": 503,
+                            "message": (
+                                "event-store partition "
+                                f"{part_of(app_id, event.entity_id)} "
+                                "unavailable; retry later"
+                            ),
+                        }
+            stored = [
+                (pos, event) for pos, event in valid
+                if results[pos]["status"] == 201
+            ]
             # quality accounting only AFTER the batched writes landed
             # (same stored-events-only discipline as the single path)
-            for _pos, event in valid:
+            for _pos, event in stored:
                 self.server._observe_quality(app_id, event)
             if self.server.stats_tracker is not None:
-                for _pos, event in valid:
+                for _pos, event in stored:
                     self.server.stats_tracker.bookkeeping(app_id, 201, event)
         self._respond(200, results)
+
+    def _write_groups(self, app_id: int, fresh: list, upserts: list):
+        """Run the batch's two write paths; None = all landed, else a
+        dict of failed partition-index sets PER CALL (``fresh`` /
+        ``upserts``). The shed counter advances once per shed EVENT
+        (not per failed group), so batch-heavy and single-post traffic
+        read identically on ``pio_ingest_partition_shed_total``."""
+        from ..storage.remote import PartitionUnavailable
+
+        failed = {"fresh": set(), "upserts": set()}
+        any_failed = False
+        for key, events, writer in (
+            ("fresh", fresh, self.server.events.write_new),
+            ("upserts", upserts, self.server.events.write),
+        ):
+            if not events:
+                continue
+            try:
+                writer(events, app_id)
+            except PartitionUnavailable as exc:
+                # only the partitioned remote store raises this, so the
+                # partition_for accessor exists exactly when needed —
+                # local stores never take this branch
+                part_of = self.server.events.partition_for
+                parts = set(exc.partitions)
+                failed[key] |= parts
+                any_failed = True
+                self.server._count_partition_shed(
+                    part_of(app_id, e.entity_id)
+                    for e in events
+                    if part_of(app_id, e.entity_id) in parts
+                )
+        return failed if any_failed else None
 
     def _find_events(self, query: Dict[str, list]) -> None:
         """``EventAPI.scala:254-325``; single ``event`` name, limit default 20."""
@@ -494,6 +578,40 @@ class EventServer(BackgroundHTTPServer):
             "Swallowed observer/monitor exceptions by site",
             labelnames=("site",),
         )
+        # partial-partition degradation accounting
+        # (docs/storage.md#partitioning): every 503-shed ingest write,
+        # by the partition whose keyspace was unavailable
+        self._partition_shed_total = self.metrics.counter(
+            "pio_ingest_partition_shed_total",
+            "Ingest writes shed 503 because the owning event-store "
+            "partition was unavailable",
+            labelnames=("partition",),
+        )
+
+    def _partition_shed(self, exc: Exception) -> Optional[int]:
+        """If ``exc`` is a partition outage, count it and return the
+        Retry-After seconds for the 503; None = not a shed (re-raise)."""
+        from ..storage.remote import PartitionUnavailable
+
+        if not isinstance(exc, PartitionUnavailable):
+            return None
+        self._count_partition_shed(exc.partitions)
+        return max(1, int(round(exc.retry_after_s)))
+
+    def _count_partition_shed(self, partitions) -> None:
+        for p in partitions:
+            # pio: lint-ok[obs-unbounded-label] partition indices are a closed operator-configured set (0..N-1, N = deployed partition count); the registry cardinality cap bounds the series regardless
+            self._partition_shed_total.inc(1, partition=str(p))
+
+    def replication_json(self) -> dict:
+        """The ingest tier's ``GET /replication.json``: one probed row
+        per event-store partition (empty for a local, unpartitioned
+        store — the route answers uniformly so scrapers need no
+        store-type knowledge)."""
+        status = getattr(self.events, "partition_status", None)
+        if status is None:
+            return {"partitions": []}
+        return {"partitions": status()}
 
     def _observe_quality(self, app_id: int, event=None) -> None:
         """Quality accounting, swallowed on error: the serving path's

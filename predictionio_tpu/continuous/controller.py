@@ -59,7 +59,7 @@ from ..utils.durability import atomic_write_bytes
 from ..utils.resilience import RetryPolicy
 from .foldin import FOLD_IN, FULL_RETRAIN, FoldInPolicy, decide_mode
 from ..obs.flight import record as flight_record
-from .watcher import FeedGap, FeedWatcher, RemoteFeed
+from .watcher import FeedGap, RemoteFeed, make_watcher
 
 logger = logging.getLogger(__name__)
 
@@ -147,7 +147,18 @@ class ContinuousController:
                     "continuous learning needs a changefeed: pass feed_url "
                     "(a storage primary's URL) or an explicit feed object"
                 )
-            feed = RemoteFeed(config.feed_url)
+            from ..storage.partition import partition_primaries
+
+            # a partitioned URL (';'-separated sets,
+            # docs/storage.md#partitioning) tails one changefeed per
+            # partition primary, merged with independent durable
+            # cursors by PartitionedFeedWatcher
+            primaries = partition_primaries(config.feed_url)
+            feed = (
+                [RemoteFeed(u) for u in primaries]
+                if len(primaries) > 1
+                else RemoteFeed(primaries[0])
+            )
         state_dir = config.state_dir
         if state_dir is None:
             from ..storage.registry import base_dir
@@ -158,7 +169,7 @@ class ContinuousController:
             state_dir = os.path.join(base_dir(reg_env), "continuous")
         self._state_dir = state_dir
         self._state_path = os.path.join(state_dir, STATE_NAME)
-        self.watcher = FeedWatcher(
+        self.watcher = make_watcher(
             feed, config.app_id, config.event_values, state_dir
         )
         # Feedback join (docs/observability.md#quality): every accepted
@@ -462,7 +473,37 @@ class ContinuousController:
                     with self._lock:
                         self._last_error = f"resync failed: {exc}"
             else:
-                self.watcher.commit(int(cand["uptoSeq"]))
+                upto = cand["uptoSeq"]
+                try:
+                    # flat watcher: one int; partitioned: the per-
+                    # partition map take_batch() produced (string keys
+                    # after the JSON round-trip through the durable
+                    # candidate state)
+                    self.watcher.commit(
+                        upto if isinstance(upto, dict) else int(upto)
+                    )
+                except (TypeError, ValueError) as exc:
+                    # a resharding restart crossed watcher shapes (a
+                    # per-partition cursor map against a flat watcher,
+                    # or vice versa): the stored seqs are meaningless
+                    # against the new feed layout. Never wedge the LIVE
+                    # path — resync to the new head and force a full
+                    # retrain to cover whatever sits in between.
+                    logger.warning(
+                        "continuous: candidate cursor %r does not match "
+                        "the current feed layout (%s); resyncing and "
+                        "forcing a full retrain", upto, exc,
+                    )
+                    with self._lock:
+                        self._force_full = True
+                        self._trigger = True
+                    try:
+                        self.watcher.resync()
+                    except Exception as resync_exc:
+                        with self._lock:
+                            self._last_error = (
+                                f"resync failed: {resync_exc}"
+                            )
             with self._lock:
                 self._candidate = None
                 self._last_freshness_s = freshness_s

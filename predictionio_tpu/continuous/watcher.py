@@ -49,7 +49,9 @@ __all__ = [
     "FeedGap",
     "FeedWatcher",
     "LocalFeed",
+    "PartitionedFeedWatcher",
     "RemoteFeed",
+    "make_watcher",
 ]
 
 CURSOR_NAME = "continuous_cursor.json"
@@ -78,6 +80,7 @@ class LocalFeed:
             "changes": [{"seq": s, "op": o} for s, o in entries],
             "lastSeq": last_seq,
             "generation": self._oplog.generation,
+            "oldestSeq": self._oplog.oldest_seq,
         }
 
     def checkpoint(self) -> dict:
@@ -290,10 +293,24 @@ class FeedWatcher:
                 if self.generation is None:
                     self.generation = generation
                 elif generation is not None and generation != self.generation:
-                    raise FeedGap(
-                        f"feed generation changed ({self.generation} -> "
-                        f"{generation}): primary store replaced"
-                    )
+                    if self._is_continuation(batch):
+                        # promoted-standby failover: the new log CONTINUES
+                        # our numbering (its base_seq explicitly extends a
+                        # predecessor and it can serve our position), so
+                        # the cursor stays meaningful — adopt the new
+                        # generation and resume WITHOUT replay or retrain
+                        # (docs/continuous.md#per-partition-cursors)
+                        logger.warning(
+                            "continuous: feed generation %s -> %s is a "
+                            "promoted continuation at seq %d; adopting",
+                            self.generation, generation, self.position,
+                        )
+                        self.generation = generation
+                    else:
+                        raise FeedGap(
+                            f"feed generation changed ({self.generation} "
+                            f"-> {generation}): primary store replaced"
+                        )
                 self._pending.extend(fresh)
                 self.position = max(self.position, top)
                 self.last_seq = max(
@@ -315,6 +332,30 @@ class FeedWatcher:
             if caught_up:
                 break
         return added
+
+    def _is_continuation(self, batch: dict) -> bool:
+        """Is a generation change a *promoted standby continuing the
+        same history* rather than a wiped/replaced store? True when the
+        new log (a) explicitly continues a predecessor's numbering
+        (``oldestSeq > 1`` means nonzero base_seq), (b) can serve our
+        position (``oldestSeq <= position + 1`` — no unreadable window
+        between cursor and log start), and (c) has not rewound behind us
+        (``lastSeq >= position``). A wiped store re-mints from seq 1 and
+        fails (a); a promotion the watcher lagged behind fails (b) —
+        both correctly stay a :class:`FeedGap`. Caller holds ``_lock``.
+        """
+        oldest = batch.get("oldestSeq")
+        try:
+            last = int(batch.get("lastSeq", -1))
+            oldest = int(oldest) if oldest is not None else None
+        except (TypeError, ValueError):
+            return False
+        return (
+            oldest is not None
+            and oldest > 1
+            and oldest <= self.position + 1
+            and last >= self.position
+        )
 
     # -- introspection (gauge-callback safe) ------------------------------
     def feed_lag(self) -> int:
@@ -353,9 +394,10 @@ class FeedWatcher:
     def commit(self, upto_seq: int) -> None:
         """Durably advance the cursor through ``upto_seq`` and drop the
         consumed delta. Call exactly when the batch's model went live."""
+        upto_seq = int(upto_seq)  # JSON round-trips may deliver a str
         with self._lock:
             self._pending = [e for e in self._pending if e.seq > upto_seq]
-            self.cursor_seq = max(self.cursor_seq, int(upto_seq))
+            self.cursor_seq = max(self.cursor_seq, upto_seq)
             self._persist_cursor()
 
     def resync(self) -> None:
@@ -374,3 +416,220 @@ class FeedWatcher:
             "continuous: feed resynced to seq %d (generation %s)",
             self.cursor_seq, self.generation,
         )
+
+
+class PartitionedFeedWatcher:
+    """N per-partition :class:`FeedWatcher` children behind the single-
+    watcher surface the continuous controller drives
+    (``docs/continuous.md#per-partition-cursors``).
+
+    Each partition's changefeed is an independent history with its own
+    **durable cursor** (``partition-<i>/continuous_cursor.json``) — there
+    is no merged sequence space, so there is nothing a cross-partition
+    commit could reorder or drop. The merged delta orders events by
+    ``(event_time_ms, partition, seq)`` — deterministic for a given set
+    of consumed ops regardless of poll interleaving, seq-ordered within
+    each partition (what convergent folding needs).
+
+    Failure scoping: a gap or non-continuation generation change on ONE
+    partition marks only that partition gapped — the others keep
+    accumulating (their cursors and uncommitted suffixes untouched) —
+    and :meth:`poll` raises :class:`FeedGap` naming the gapped set so
+    the controller escalates to a full retrain exactly as today.
+    :meth:`resync` then jumps ONLY the gapped partitions to their feed
+    heads; the healthy partitions resume their uncommitted suffixes.
+    """
+
+    def __init__(
+        self,
+        feeds,
+        app_id: int,
+        event_values: Dict[str, object],
+        state_dir: str,
+        batch_limit: int = 500,
+        max_pending: int = 250_000,
+    ):
+        feeds = list(feeds)
+        if not feeds:
+            raise ValueError("PartitionedFeedWatcher needs >= 1 feed")
+        self.watchers = [
+            FeedWatcher(
+                feed, app_id, event_values,
+                os.path.join(state_dir, f"partition-{i}"),
+                batch_limit=batch_limit,
+                # each child bounds its own share: the merged pending
+                # stays bounded by the same total as one flat watcher
+                max_pending=max(1, max_pending // len(feeds)),
+            )
+            for i, feed in enumerate(feeds)
+        ]
+        self._lock = threading.Lock()
+        #: partition indices whose feed gapped; cleared by resync()
+        self._gapped: set = set()
+
+    # -- observer hooks (fan to every child) ------------------------------
+    @property
+    def on_event(self):
+        return self.watchers[0].on_event
+
+    @on_event.setter
+    def on_event(self, tap) -> None:
+        for w in self.watchers:
+            w.on_event = tap
+
+    @property
+    def on_event_error(self):
+        return self.watchers[0].on_event_error
+
+    @on_event_error.setter
+    def on_event_error(self, hook) -> None:
+        for w in self.watchers:
+            w.on_event_error = hook
+
+    @property
+    def heartbeat(self):
+        return self.watchers[0].heartbeat
+
+    @heartbeat.setter
+    def heartbeat(self, hook) -> None:
+        for w in self.watchers:
+            w.heartbeat = hook
+
+    # -- tailing ----------------------------------------------------------
+    def poll(self, max_rounds: int = 50) -> int:
+        """Poll every non-gapped partition; a child's gap is recorded
+        and the rest STILL poll (a dead partition must not starve the
+        healthy keyspace), then one :class:`FeedGap` naming the gapped
+        set raises — same escalation contract as the flat watcher."""
+        added = 0
+        errors = []
+        with self._lock:
+            gapped = set(self._gapped)
+        for idx, w in enumerate(self.watchers):
+            if idx in gapped:
+                continue  # pointless until resync(); others keep flowing
+            try:
+                added += w.poll(max_rounds=max_rounds)
+            except FeedGap as exc:
+                gapped.add(idx)
+                errors.append(f"partition {idx}: {exc}")
+        with self._lock:
+            self._gapped |= gapped
+            gap_now = sorted(self._gapped)
+        if gap_now:
+            raise FeedGap(
+                f"partition(s) {gap_now} gapped"
+                + (f" ({'; '.join(errors)})" if errors else "")
+            )
+        return added
+
+    # -- introspection (gauge-callback safe) ------------------------------
+    def feed_lag(self) -> int:
+        return sum(w.feed_lag() for w in self.watchers)
+
+    def pending_count(self) -> int:
+        return sum(w.pending_count() for w in self.watchers)
+
+    def oldest_pending_ms(self) -> Optional[int]:
+        values = [
+            ms for ms in (w.oldest_pending_ms() for w in self.watchers)
+            if ms is not None
+        ]
+        return min(values) if values else None
+
+    @property
+    def skipped_events(self) -> int:
+        return sum(w.skipped_events for w in self.watchers)
+
+    @property
+    def cursor_seq(self) -> Dict[str, int]:
+        """Per-partition durable cursors (status surface; the flat
+        watcher's single int becomes one entry per partition)."""
+        return {str(i): w.cursor_seq for i, w in enumerate(self.watchers)}
+
+    @property
+    def position(self) -> Dict[str, int]:
+        return {str(i): w.position for i, w in enumerate(self.watchers)}
+
+    # -- consumption -------------------------------------------------------
+    def take_batch(self) -> Optional[DeltaBatch]:
+        """Merged snapshot of every partition's pending delta.
+        ``upto_seq`` is a per-partition map (JSON-safe string keys) —
+        :meth:`commit` advances each durable cursor independently, so no
+        partition's ack ever gates another's."""
+        parts = [(i, w.take_batch()) for i, w in enumerate(self.watchers)]
+        parts = [(i, b) for i, b in parts if b is not None]
+        if not parts:
+            return None
+        decorated = [
+            (e.event_time_ms, i, e.seq, e)
+            for i, b in parts
+            for e in b.events
+        ]
+        decorated.sort(key=lambda t: t[:3])
+        return DeltaBatch(
+            events=[t[3] for t in decorated],
+            upto_seq={str(i): b.upto_seq for i, b in parts},
+            oldest_event_ms=min(b.oldest_event_ms for _i, b in parts),
+        )
+
+    def commit(self, upto_seq) -> None:
+        """Advance each partition's durable cursor through its own
+        ``upto_seq`` entry (absent partitions had nothing in the batch
+        and stay put). Accepts the JSON-round-tripped string-keyed map
+        the controller persists."""
+        if not isinstance(upto_seq, dict):
+            raise TypeError(
+                "PartitionedFeedWatcher.commit needs the per-partition "
+                f"upto_seq map from take_batch(), got {type(upto_seq)}"
+            )
+        for key, seq in upto_seq.items():
+            idx = int(key)
+            if not (0 <= idx < len(self.watchers)):
+                # a candidate that survived a partition-count change
+                # (a resharding restart): commit what still exists, log
+                # the rest — wedging the LIVE path would strand the
+                # whole loop over an index that no longer has a cursor
+                logger.warning(
+                    "continuous: dropping commit for unknown partition "
+                    "%s (now %d partitions)", key, len(self.watchers),
+                )
+                continue
+            self.watchers[idx].commit(int(seq))
+
+    def resync(self) -> None:
+        """Partition-scoped post-gap recovery: ONLY the gapped
+        partitions jump to their feed heads (dropping their incomplete
+        deltas); the healthy partitions keep their cursors AND their
+        uncommitted pending suffixes. With no recorded gap (a restart
+        lost the in-memory set mid-gap-retrain) every partition resyncs
+        — conservative, and safe: the full retrain that triggered the
+        resync read the whole store."""
+        with self._lock:
+            gapped = sorted(self._gapped)
+        targets = gapped or list(range(len(self.watchers)))
+        for idx in targets:
+            self.watchers[idx].resync()
+        with self._lock:
+            self._gapped.clear()
+
+
+def make_watcher(
+    feeds,
+    app_id: int,
+    event_values: Dict[str, object],
+    state_dir: str,
+    **kwargs,
+):
+    """One feed → :class:`FeedWatcher`; a list of per-partition feeds →
+    :class:`PartitionedFeedWatcher`. The controller's one construction
+    point for both shapes."""
+    if isinstance(feeds, (list, tuple)):
+        if len(feeds) == 1:
+            return FeedWatcher(
+                feeds[0], app_id, event_values, state_dir, **kwargs
+            )
+        return PartitionedFeedWatcher(
+            list(feeds), app_id, event_values, state_dir, **kwargs
+        )
+    return FeedWatcher(feeds, app_id, event_values, state_dir, **kwargs)

@@ -16,6 +16,13 @@ first incident:
   no fsync in the same scope leaves a durable *name* over torn *data*
   after a power loss — the bug class ``testing/crashsim.py`` proves and
   ``utils/durability.py`` packages the fix for.
+- ``robust-unbounded-retry`` (ISSUE 13): a ``while True`` retry loop
+  whose except handler swallows and re-iterates, with no attempt cap,
+  no conditional exit (deadline check) and no backoff — against a dead
+  dependency it spins forever at full speed, pinning a CPU and
+  hammering the recovering peer; the partitioned write path's whole
+  point is that a dead partition sheds *boundedly*
+  (``RetryPolicy`` + ``PartitionUnavailable``).
 """
 
 from __future__ import annotations
@@ -253,4 +260,105 @@ class RenameNoFsync(Rule):
                 )
 
 
-RULES: List[Rule] = [NoTimeout(), BareSleepRetry(), RenameNoFsync()]
+#: substrings that mark a call as introducing delay/bounding between
+#: attempts: sleeps, condition waits, RetryPolicy-style schedules
+_BACKOFF_MARKERS = ("sleep", "wait", "backoff", "delay")
+
+
+def _truthy_const(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+class UnboundedRetry(Rule):
+    """A ``while True`` loop re-invoking a failed call — the except
+    handler swallows and the loop re-iterates — with **no attempt cap,
+    no conditional exit, and no backoff**: against a dead dependency it
+    retries forever at full speed. The loop never converges, never
+    sheds, and stampedes the peer the moment it recovers."""
+
+    id = "robust-unbounded-retry"
+    severity = "error"
+    short = (
+        "while-True retry loop with no attempt cap or deadline check "
+        "and no backoff between attempts"
+    )
+    motivation = (
+        "the partitioned write path (docs/storage.md#partitioning) "
+        "sheds a dead partition after a BOUNDED jittered schedule "
+        "(utils/resilience.RetryPolicy); an unbounded bare retry loop "
+        "instead pins a thread forever and turns the dependency's "
+        "recovery into a thundering herd"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: the shape needs both a while loop and a handler
+        if "while" not in ctx.source or "except" not in ctx.source:
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            if not _truthy_const(loop.test):
+                continue  # a real condition IS the cap/deadline check
+            handlers = [
+                node for node in _walk_in_scope(loop)
+                if isinstance(node, ast.ExceptHandler)
+            ]
+            swallowing = [
+                h for h in handlers if not self._handler_exits(h)
+            ]
+            if not swallowing:
+                continue  # every handler re-raises/returns/breaks
+            if self._has_guarded_exit(loop) or self._has_backoff(loop):
+                continue
+            yield self.finding(
+                ctx,
+                loop,
+                "while-True retry loop: the except handler swallows and "
+                "re-iterates with no attempt cap, no conditional exit "
+                "and no backoff — a dead dependency spins this thread "
+                "forever; use utils/resilience.RetryPolicy (bounded "
+                "attempts, full-jitter delays, deadline-aware) or bound "
+                "the loop.",
+            )
+
+    @staticmethod
+    def _handler_exits(handler: ast.ExceptHandler) -> bool:
+        """Does the handler leave the loop (raise / return / break)?"""
+        return any(
+            isinstance(node, (ast.Raise, ast.Return, ast.Break))
+            for node in _walk_in_scope(handler)
+        )
+
+    @staticmethod
+    def _has_guarded_exit(loop: ast.While) -> bool:
+        """A conditional exit anywhere in the loop — ``if attempts > N:
+        raise``, ``if deadline.expired: break``, ``if done: return`` —
+        bounds the retry; the *unconditional* success-path return does
+        not (it is never reached while the call keeps failing)."""
+        for node in _walk_in_scope(loop):
+            if isinstance(node, ast.If):
+                if any(
+                    isinstance(sub, (ast.Raise, ast.Return, ast.Break))
+                    for sub in _walk_in_scope(node)
+                ):
+                    return True
+        return False
+
+    @staticmethod
+    def _has_backoff(loop: ast.While) -> bool:
+        for node in _walk_in_scope(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (call_name(node) or "").lower()
+            dn = dotted_name(node.func).lower()
+            if any(
+                marker in name or marker in dn
+                for marker in _BACKOFF_MARKERS
+            ):
+                return True
+        return False
+
+
+RULES: List[Rule] = [
+    NoTimeout(), BareSleepRetry(), RenameNoFsync(), UnboundedRetry(),
+]

@@ -41,6 +41,7 @@ __all__ = [
     "detect_regressions",
     "find_no_prior",
     "fleet_records",
+    "ingest_records",
     "load_bench_history",
     "load_ledger",
     "make_record",
@@ -136,7 +137,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             key: bench[key]
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
-                "quality", "bf16_gate",
+                "quality", "bf16_gate", "ingestScaling",
             )
             if key in bench
         },
@@ -288,6 +289,47 @@ def alert_records(bench: dict, source: str = "bench") -> List[dict]:
             },
         )
     ]
+
+
+def ingest_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The ingest-scaling numbers a bench run attached
+    (``bench["ingestScaling"]``, from ``loadgen --ingest-scaling`` —
+    docs/storage.md#partitioning) as their own trend records:
+
+    - ``ingest_acked_qps`` — acked event writes per second through the
+      partitioned write path (unit ``qps``, higher-better → trend-only:
+      the gate only ever compares ``unit == "s"``).
+
+    The partition count travels as ``scale``, exactly like the fleet
+    records carry their replica count: ``comparable_key`` groups by
+    scale, so ``pio perf diff`` never gates a 4-partition run against a
+    1-partition run — each N has its own trajectory. A failed drive
+    (``ok`` false) records nothing."""
+    scaling = bench.get("ingestScaling")
+    if not isinstance(scaling, dict) or not scaling.get("ok"):
+        return []
+    out: List[dict] = []
+    counts = scaling.get("counts") or {}
+    for key in sorted(counts, key=lambda k: int(k)):
+        row = counts[key] or {}
+        qps = row.get("ackedQPS")
+        if isinstance(qps, (int, float)) and qps > 0:
+            out.append(
+                make_record(
+                    source=source,
+                    metric="ingest_acked_qps",
+                    value=float(qps),
+                    unit="qps",
+                    device=bench.get("device"),
+                    scale=int(key),
+                    extra={
+                        "writers": scaling.get("writers"),
+                        "acked": row.get("acked"),
+                        "inProcess": scaling.get("inProcess"),
+                    },
+                )
+            )
+    return out
 
 
 def append_record(path: str, record: dict) -> None:

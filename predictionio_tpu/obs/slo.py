@@ -107,6 +107,13 @@ class SLOObjective:
     max_value: Optional[float] = None
     #: label filter applied to the source series (e.g. variant=baseline)
     labels: Tuple[Tuple[str, str], ...] = ()
+    #: gauge only: evaluate an INDEPENDENT burn/alert state machine per
+    #: distinct value of this label (e.g. ``partition`` on
+    #: ``pio_replication_lag_ops``, docs/storage.md#partitioning) —
+    #: each series fires/clears alone, named ``<name>[<value>]``, so
+    #: one lagging partition can never hide behind a healthy mean (or
+    #: behind the fleet's worst-of collapse losing WHICH one is sick)
+    per_label: Optional[str] = None
     fast_window_s: float = 300.0
     slow_window_s: float = 3600.0
     burn_threshold: float = 8.0
@@ -122,6 +129,10 @@ class SLOObjective:
             raise ValueError(f"{self.name}: target must be in (0, 1)")
         if self.kind == "gauge" and not self.max_value:
             raise ValueError(f"{self.name}: gauge objectives need max_value")
+        if self.per_label and self.kind != "gauge":
+            raise ValueError(
+                f"{self.name}: per_label evaluation is gauge-only"
+            )
 
 
 def default_objectives(kind: str) -> Tuple[SLOObjective, ...]:
@@ -188,6 +199,11 @@ def default_objectives(kind: str) -> Tuple[SLOObjective, ...]:
                 name="freshness", kind="gauge",
                 metric="pio_replication_lag_ops",
                 max_value=10000.0, burn_threshold=1.0,
+                # one alert state machine PER PARTITION slot: a single
+                # lagging chain fires freshness[<i>] on its own, never
+                # averaged against healthy siblings
+                # (docs/storage.md#partitioning)
+                per_label="partition",
             ),
         )
     # dashboard and anything future: availability is universal
@@ -351,6 +367,28 @@ def _read_gauge(
     return max(values) if values else None
 
 
+def _read_gauge_by_label(
+    metrics: MetricsRegistry, obj: SLOObjective
+) -> Optional[Dict[str, float]]:
+    """Per-``per_label``-value worst (max) non-negative sample of a
+    gauge family — one independent reading per label value (per
+    partition, docs/storage.md#partitioning). None when the family is
+    absent or every matching sample abstains."""
+    inst = metrics.instrument(obj.metric)
+    if inst is None or not isinstance(inst, Gauge):
+        return None
+    out: Dict[str, float] = {}
+    for labels, value in inst.samples():
+        if not _match(labels, obj.labels) or value < 0:
+            continue
+        key = labels.get(obj.per_label or "", "")
+        if key in out:
+            out[key] = max(out[key], value)
+        else:
+            out[key] = value
+    return out or None
+
+
 # -- the engine ---------------------------------------------------------------
 
 
@@ -382,19 +420,17 @@ class SLOEngine:
         self.node = node
         self.flight = flight
         self._lock = threading.Lock()
+        # Entries are keyed by NAME: one per objective, except
+        # ``per_label`` gauge objectives, which expand into one entry
+        # per observed label value (``freshness[2]``) — each with its
+        # own window series and fire/clear state machine. The flat
+        # name starts as a visible abstaining placeholder and retires
+        # when the first per-label reading arrives.
         self._series: Dict[str, _Series] = {
             obj.name: _Series() for obj in self.objectives
         }
         self._state: Dict[str, dict] = {
-            obj.name: {
-                "state": _OK,
-                "abstaining": True,
-                "burn_fast": None,
-                "burn_slow": None,
-                "fired": 0,
-                "cleared": 0,
-            }
-            for obj in self.objectives
+            obj.name: self._fresh_state() for obj in self.objectives
         }
         self._burn_gauge = metrics.gauge(
             "pio_slo_burn_rate",
@@ -418,6 +454,26 @@ class SLOEngine:
                 self._burn_gauge.set(
                     -1.0, objective=obj.name, window=window
                 )
+
+    @staticmethod
+    def _fresh_state() -> dict:
+        return {
+            "state": _OK,
+            "abstaining": True,
+            "burn_fast": None,
+            "burn_slow": None,
+            "fired": 0,
+            "cleared": 0,
+        }
+
+    def _ensure_entry(self, name: str) -> None:
+        if name not in self._state:
+            self._series[name] = _Series()
+            self._state[name] = self._fresh_state()
+
+    def _sub_entries(self, obj: SLOObjective) -> List[str]:
+        prefix = obj.name + "["
+        return sorted(n for n in self._state if n.startswith(prefix))
 
     # -- evaluation --------------------------------------------------------
     def _burns(
@@ -457,69 +513,27 @@ class SLOEngine:
         transitions: List[dict] = []
         with self._lock:
             for obj in self.objectives:
-                series = self._series[obj.name]
-                state = self._state[obj.name]
+                if obj.kind == "gauge" and obj.per_label:
+                    self._evaluate_per_label(obj, now, transitions)
+                    continue
+                sample = None
                 gauge_absent = False
                 if obj.kind == "ratio":
                     observed = _read_ratio(self.metrics, obj)
                     if observed is not None:
-                        series.add(
-                            (now, observed[0], observed[1]),
-                            obj.slow_window_s * 1.5,
-                        )
+                        sample = (now, observed[0], observed[1])
                 else:
                     value = _read_gauge(self.metrics, obj)
                     if value is not None:
-                        series.add((now, value), obj.slow_window_s * 1.5)
+                        sample = (now, value)
                     else:
                         # the source went away (or is exporting the -1
                         # sentinel): stale window samples are not a
                         # verdict about NOW — abstain outright
                         gauge_absent = True
-                if gauge_absent:
-                    burn_fast = burn_slow = None
-                else:
-                    burn_fast, burn_slow = self._burns(obj, series, now)
-                abstaining = burn_fast is None or burn_slow is None
-                state["burn_fast"] = burn_fast
-                state["burn_slow"] = burn_slow
-                state["abstaining"] = abstaining
-                if not abstaining:
-                    if (
-                        state["state"] == _OK
-                        and burn_fast >= obj.burn_threshold
-                        and burn_slow >= obj.burn_threshold
-                    ):
-                        state["state"] = _FIRING
-                        state["fired"] += 1
-                        transitions.append(
-                            self._transition(obj, _FIRING, state)
-                        )
-                    elif (
-                        state["state"] == _FIRING
-                        and burn_fast < obj.clear_threshold
-                    ):
-                        state["state"] = _OK
-                        state["cleared"] += 1
-                        transitions.append(
-                            self._transition(obj, "CLEARED", state)
-                        )
-                # export: -1 abstaining / 0 ok / 1 firing; a FIRING
-                # objective that loses its data keeps exporting 1 — an
-                # alert never clears on data loss
-                if state["state"] == _FIRING:
-                    self._state_gauge.set(1.0, objective=obj.name)
-                elif abstaining:
-                    self._state_gauge.set(-1.0, objective=obj.name)
-                else:
-                    self._state_gauge.set(0.0, objective=obj.name)
-                for window, burn in (
-                    ("fast", burn_fast), ("slow", burn_slow)
-                ):
-                    self._burn_gauge.set(
-                        -1.0 if burn is None else burn,
-                        objective=obj.name, window=window,
-                    )
+                self._evaluate_entry(
+                    obj, obj.name, sample, gauge_absent, now, transitions
+                )
         # durable + counter + flight work OUTSIDE the lock
         for record in transitions:
             event = "fire" if record["state"] == _FIRING else "clear"
@@ -537,13 +551,114 @@ class SLOEngine:
                     pass  # forensics must never fail the evaluator
         return self.summary()
 
+    def _evaluate_per_label(
+        self, obj: SLOObjective, now: float, transitions: List[dict]
+    ) -> None:
+        """One independent entry per observed ``per_label`` value.
+        Family absent: every known entry holds its state on abstention
+        (a FIRING partition never clears on data loss); with no entry
+        ever observed, the flat placeholder stays visibly abstaining.
+        Caller holds the lock."""
+        readings = _read_gauge_by_label(self.metrics, obj)
+        known = self._sub_entries(obj)
+        if not readings:
+            for name in known or ():
+                self._evaluate_entry(obj, name, None, True, now, transitions)
+            if not known and obj.name in self._state:
+                self._evaluate_entry(
+                    obj, obj.name, None, True, now, transitions
+                )
+            return
+        if not known and obj.name in self._state:
+            # first real reading: the placeholder retires (its exported
+            # gauge row stays -1 = abstaining, which is the truth)
+            self._state.pop(obj.name)
+            self._series.pop(obj.name, None)
+        current = {f"{obj.name}[{key}]": key for key in readings}
+        for name in sorted(current):
+            self._ensure_entry(name)
+            self._evaluate_entry(
+                obj, name, (now, readings[current[name]]), False, now,
+                transitions,
+            )
+        for name in known:
+            if name not in current:
+                # the label row vanished (node stopped exporting that
+                # partition): data loss, not recovery — state holds
+                self._evaluate_entry(obj, name, None, True, now, transitions)
+
+    def _evaluate_entry(
+        self,
+        obj: SLOObjective,
+        name: str,
+        sample,
+        gauge_absent: bool,
+        now: float,
+        transitions: List[dict],
+    ) -> None:
+        """Window update + fire/clear state machine for ONE entry
+        (an objective, or one per-label sub-entry). Caller holds the
+        lock."""
+        series = self._series[name]
+        state = self._state[name]
+        if sample is not None:
+            series.add(sample, obj.slow_window_s * 1.5)
+        if gauge_absent:
+            burn_fast = burn_slow = None
+        else:
+            burn_fast, burn_slow = self._burns(obj, series, now)
+        abstaining = burn_fast is None or burn_slow is None
+        state["burn_fast"] = burn_fast
+        state["burn_slow"] = burn_slow
+        state["abstaining"] = abstaining
+        if not abstaining:
+            if (
+                state["state"] == _OK
+                and burn_fast >= obj.burn_threshold
+                and burn_slow >= obj.burn_threshold
+            ):
+                state["state"] = _FIRING
+                state["fired"] += 1
+                transitions.append(
+                    self._transition(obj, _FIRING, state, name)
+                )
+            elif (
+                state["state"] == _FIRING
+                and burn_fast < obj.clear_threshold
+            ):
+                state["state"] = _OK
+                state["cleared"] += 1
+                transitions.append(
+                    self._transition(obj, "CLEARED", state, name)
+                )
+        # export: -1 abstaining / 0 ok / 1 firing; a FIRING
+        # objective that loses its data keeps exporting 1 — an
+        # alert never clears on data loss
+        if state["state"] == _FIRING:
+            self._state_gauge.set(1.0, objective=name)
+        elif abstaining:
+            self._state_gauge.set(-1.0, objective=name)
+        else:
+            self._state_gauge.set(0.0, objective=name)
+        for window, burn in (
+            ("fast", burn_fast), ("slow", burn_slow)
+        ):
+            self._burn_gauge.set(
+                -1.0 if burn is None else burn,
+                objective=name, window=window,
+            )
+
     def _transition(
-        self, obj: SLOObjective, state: str, snapshot: dict
+        self,
+        obj: SLOObjective,
+        state: str,
+        snapshot: dict,
+        name: Optional[str] = None,
     ) -> dict:
         return {
             "schema": ALERT_SCHEMA,
             "kind": "alert",
-            "objective": obj.name,
+            "objective": name or obj.name,
             "metric": obj.metric,
             "state": state,
             "burnFast": _round(snapshot["burn_fast"]),
@@ -567,21 +682,27 @@ class SLOEngine:
     # -- reporting ---------------------------------------------------------
     def summary(self) -> dict:
         with self._lock:
-            objectives = [
-                {
-                    "name": obj.name,
-                    "kind": obj.kind,
-                    "metric": obj.metric,
-                    "state": self._state[obj.name]["state"],
-                    "abstaining": self._state[obj.name]["abstaining"],
-                    "burnFast": _round(self._state[obj.name]["burn_fast"]),
-                    "burnSlow": _round(self._state[obj.name]["burn_slow"]),
-                    "burnThreshold": obj.burn_threshold,
-                    "fired": self._state[obj.name]["fired"],
-                    "cleared": self._state[obj.name]["cleared"],
-                }
-                for obj in self.objectives
-            ]
+            objectives = []
+            for obj in self.objectives:
+                names = (
+                    [obj.name] if obj.name in self._state else []
+                ) + self._sub_entries(obj)
+                for name in names:
+                    entry = self._state[name]
+                    objectives.append(
+                        {
+                            "name": name,
+                            "kind": obj.kind,
+                            "metric": obj.metric,
+                            "state": entry["state"],
+                            "abstaining": entry["abstaining"],
+                            "burnFast": _round(entry["burn_fast"]),
+                            "burnSlow": _round(entry["burn_slow"]),
+                            "burnThreshold": obj.burn_threshold,
+                            "fired": entry["fired"],
+                            "cleared": entry["cleared"],
+                        }
+                    )
         return {
             "objectives": objectives,
             "firing": sum(

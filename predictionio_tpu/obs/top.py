@@ -154,6 +154,11 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
         row["batch_avg"] = submitted / batches
     row["lag"] = _series_sum(m, "pio_replication_lag_ops")
     row["seq"] = _series_sum(m, "pio_changefeed_seq")
+    # partitioned write path (docs/storage.md#partitioning): one PARTS
+    # cell per node — an ingest node shows how many event-store
+    # partitions its client view can reach ("2/3"), a storage node its
+    # own keyspace slot ("p1/3"); nodes without the route show '-'
+    row["parts"] = _partition_cell(node, timeout=timeout)
     row["train_s"] = _series_sum(m, "pio_train_phase_seconds")
     # continuous-learning freshness (docs/continuous.md): how far the
     # model lags the feedback stream, fleet-wide at a glance
@@ -222,6 +227,28 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
     return row
 
 
+def _partition_cell(node: str, timeout: float = 5.0) -> Optional[str]:
+    """``GET /replication.json`` → the PARTS cell, or None when the
+    node lacks the route / reports no partition rows."""
+    body = _fetch(node, "/replication.json", timeout=timeout)
+    if body is None:
+        return None
+    try:
+        doc = json.loads(body)
+    except ValueError:
+        return None
+    rows = (doc or {}).get("partitions") or []
+    if not rows:
+        return None
+    first = rows[0]
+    if "role" in first:
+        # a storage node reporting its own slot
+        return f"p{first.get('partition', 0)}/{first.get('of', 1)}"
+    total = max(int(r.get("of", len(rows))) for r in rows)
+    up = sum(1 for r in rows if r.get("up"))
+    return f"{up}/{total}"
+
+
 _COLUMNS = (
     ("NODE", "node", "{}"),
     ("UP", "up", "{}"),
@@ -233,6 +260,7 @@ _COLUMNS = (
     ("BATCH", "batch_avg", "{:.1f}"),
     ("LAG", "lag", "{:.0f}"),
     ("SEQ", "seq", "{:.0f}"),
+    ("PARTS", "parts", "{}"),
     ("TRAIN_S", "train_s", "{:.2f}"),
     ("FEEDLAG", "feed_lag", "{:.0f}"),
     ("CANDAGE", "cand_age", "{:.0f}"),

@@ -46,6 +46,20 @@ SEQ_HEADER = "X-PIO-Seq"
 #: request header: the minimum applied seq a replica read requires
 MIN_SEQ_HEADER = "X-PIO-Min-Seq"
 
+
+class WrongPartition(Exception):
+    """An event write reached a partitioned primary that does not own
+    its (app, entity) key (``docs/storage.md#partitioning``). Accepting
+    it would fork the keyspace: the event's *owning* partition's oplog
+    would never carry it, so replicas, the feed watcher and failover all
+    disagree about history. The hash contract is enforced loudly at the
+    one place every mutation already passes through."""
+
+    def __init__(self, message: str, expected: int):
+        super().__init__(message)
+        #: the partition index the key actually hashes to
+        self.expected = expected
+
 #: MetadataStore methods that mutate (the complement of the read RPCs);
 #: an explicit list, like METADATA_RPC_METHODS — replication of a future
 #: method must be a decision, never an accident.
@@ -79,13 +93,27 @@ def _resolve_events(events: Sequence[Event]) -> List[Event]:
 
 
 class Changefeed:
-    """Primary-side recorder: apply-then-log under one total-order lock."""
+    """Primary-side recorder: apply-then-log under one total-order lock.
 
-    def __init__(self, oplog: OpLog, events, metadata, models):
+    On a partitioned primary (the oplog carries a partition slot, or an
+    explicit ``partition=(index, count)`` is passed) every event write
+    is checked against the hash contract first — a misrouted event
+    raises :class:`WrongPartition` *before* touching store or log."""
+
+    def __init__(self, oplog: OpLog, events, metadata, models,
+                 partition: Optional[Tuple[int, int]] = None):
         self.oplog = oplog
         self._events = events
         self._metadata = metadata
         self._models = models
+        if partition is None and oplog.partition is not None:
+            partition = (oplog.partition[0], oplog.partition[1])
+        #: ``(index, count)``; ``count == 1`` disables the ownership check
+        self.partition: Tuple[int, int] = (
+            (int(partition[0]), int(partition[1]))
+            if partition is not None
+            else (0, 1)
+        )
         # One lock across apply+append: two concurrent upserts of the same
         # key must reach the log in the order they reached the store, or a
         # replica converges to the loser. Serializing mutations is the
@@ -96,8 +124,24 @@ class Changefeed:
     def last_seq(self) -> int:
         return self.oplog.last_seq
 
+    def _check_owner(self, event: Event, app_id: int) -> None:
+        index, count = self.partition
+        if count <= 1:
+            return
+        from .partition import partition_for_event
+
+        expected = partition_for_event(count, app_id, event.entity_id)
+        if expected != index:
+            raise WrongPartition(
+                f"event for app {app_id} entity {event.entity_id!r} "
+                f"belongs to partition {expected}, this primary owns "
+                f"partition {index} of {count}",
+                expected=expected,
+            )
+
     # -- events -----------------------------------------------------------
     def insert_event(self, event: Event, app_id: int) -> Tuple[str, int]:
+        self._check_owner(event, app_id)
         with self._lock:
             event_id = self._events.insert(event, app_id)
             d = event.to_json_dict()
@@ -115,6 +159,8 @@ class Changefeed:
         caller-explicit ids take the upsert ``insert`` — the same routing
         ``NativeEventStore.write`` does internally."""
         events = list(events)
+        for event in events:
+            self._check_owner(event, app_id)
         resolved = _resolve_events(events)
         with self._lock:
             if fresh:

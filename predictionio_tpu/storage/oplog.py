@@ -26,7 +26,13 @@ A log directory also carries ``oplog.meta.json`` holding the log's
 **generation** (a random id minted at creation — the store-identity
 fingerprint replicas use to detect that a primary was wiped or replaced)
 and ``base_seq`` (the sequence number *before* the first record, nonzero
-when a promoted replica continues a predecessor's numbering).
+when a promoted replica continues a predecessor's numbering). A
+partitioned primary's log (``docs/storage.md#partitioning``) also
+records its **partition slot** ``[index, count]`` — minted at creation
+like the generation, checked loudly on reopen, and surfaced in the
+checkpoint so a tailer can prove it is following the partition it was
+configured for (tailing the wrong partition's history would silently
+diverge the keyspace).
 """
 
 from __future__ import annotations
@@ -71,15 +77,22 @@ class OpLog:
         directory: str,
         sync_every: int = DEFAULT_SYNC_EVERY,
         base_seq: int = 0,
+        partition: Optional[Tuple[int, int]] = None,
     ):
         self._dir = directory
         self._sync_every = max(1, int(sync_every))
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, _LOG_NAME)
-        meta = self._load_or_init_meta(base_seq)
+        meta = self._load_or_init_meta(base_seq, partition)
         self.generation: str = meta["generation"]
         self.base_seq: int = int(meta["base_seq"])
+        #: ``[index, count]`` for a partitioned primary's log, else None
+        self.partition: Optional[List[int]] = (
+            [int(v) for v in meta["partition"]]
+            if meta.get("partition") is not None
+            else None
+        )
         #: sparse [(seq, byte offset of that record)] every _INDEX_EVERY
         self._index: List[Tuple[int, int]] = []
         self._records = 0
@@ -91,7 +104,9 @@ class OpLog:
         self._fh = open(self._path, "ab", buffering=0)
 
     # -- meta / recovery --------------------------------------------------
-    def _load_or_init_meta(self, base_seq: int) -> dict:
+    def _load_or_init_meta(
+        self, base_seq: int, partition: Optional[Tuple[int, int]]
+    ) -> dict:
         path = os.path.join(self._dir, _META_NAME)
         if os.path.exists(path):
             with open(path) as fh:
@@ -105,8 +120,22 @@ class OpLog:
                     f"{meta['base_seq']}, caller requires {base_seq}: "
                     "stale log directory, use a fresh one"
                 )
+            if partition is not None:
+                from .partition import check_partition
+
+                # same discipline as base_seq: appending partition k's
+                # ops to a log minted for partition j would diverge both
+                check_partition(
+                    meta.get("partition"), partition[0], partition[1]
+                )
+                if meta.get("partition") is None:
+                    # adopt the slot on a pre-partitioning log (upgrade)
+                    meta["partition"] = [int(partition[0]), int(partition[1])]
+                    atomic_write_bytes(path, json.dumps(meta).encode())
             return meta
         meta = {"generation": secrets.token_hex(8), "base_seq": int(base_seq)}
+        if partition is not None:
+            meta["partition"] = [int(partition[0]), int(partition[1])]
         atomic_write_bytes(path, json.dumps(meta).encode())
         return meta
 
@@ -165,13 +194,17 @@ class OpLog:
         return self.base_seq + 1
 
     def checkpoint(self) -> dict:
-        """The ``/replicate/checkpoint`` identity triple."""
+        """The ``/replicate/checkpoint`` identity triple (plus the
+        partition slot when this is a partitioned primary's log)."""
         with self._lock:
-            return {
+            out = {
                 "seq": self._last_seq,
                 "generation": self.generation,
                 "oldestSeq": self.oldest_seq,
             }
+            if self.partition is not None:
+                out["partition"] = list(self.partition)
+            return out
 
     # -- append -----------------------------------------------------------
     def append(self, op: dict) -> int:

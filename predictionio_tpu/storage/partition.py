@@ -1,0 +1,132 @@
+"""Partitioned write path: the event store's keyspace → partition math.
+
+The reference system leaned on HBase's region partitioning to scale
+ingest (PAPER.md L1); this module is the rebuild's equivalent contract
+(``docs/storage.md#partitioning``): the event keyspace is split across
+``N`` write primaries by a **pure hash of (app, entity)** — the same
+SHA-256 bucket primitive everything sticky already rides
+(:func:`~predictionio_tpu.rollout.plan.bucket_for_key`), under a salt
+deliberately distinct from both the rollout plan salts (minted per
+plan) and the router's replica-affinity salt, so repartitioning the
+store can never reshuffle canary splits or backend affinity (and vice
+versa).
+
+Everything here is a deterministic function of its string inputs — no
+process state, no randomness — so every writer (event server, SDK
+client, chaos drill) and every reader (feed watcher, failover probe)
+computes the *same* owner for a key with zero coordination. The
+golden-vector test in ``tests/test_partition.py`` pins exact outputs:
+changing this mapping silently would strand every already-stored
+event on the wrong primary.
+
+Partitioned endpoint syntax (``docs/storage.md#partitioning``)::
+
+    pio+ha://p0:7079,p0r:7079;p1:7079,p1r:7079
+
+``;`` separates partitions (index = position), ``,`` separates the
+endpoints *within* one partition (primary first, warm standbys after —
+exactly the single-chain ``pio+ha://`` syntax, N times). A URL with no
+``;`` is the 1-partition degenerate case, so every existing single
+primary config is already a valid partitioned config.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "PARTITION_SALT",
+    "partition_for_event",
+    "partition_for_key",
+    "partition_key",
+    "split_partition_sets",
+]
+
+#: the keyspace salt. NOT a rollout salt (minted per plan) and NOT the
+#: router's ``routing_salt`` — one hash primitive, three independent
+#: assignments (docs/fleet.md's one-hash design, applied to storage).
+PARTITION_SALT = "pio-event-partition-v1"
+
+_bucket_for_key = None  # resolved lazily: rollout imports storage
+
+
+def _bucket(key: str) -> int:
+    # Lazy import: ``rollout.plan`` is pure/stdlib, but importing it at
+    # module level would run ``rollout/__init__`` → manager → storage
+    # mid-initialization. At first call every package is complete.
+    global _bucket_for_key
+    if _bucket_for_key is None:
+        from ..rollout.plan import bucket_for_key
+
+        _bucket_for_key = bucket_for_key
+    return _bucket_for_key(PARTITION_SALT, key)
+
+
+def partition_key(app_id: int, entity_id: str) -> str:
+    """The string the partition hash runs over: app + entity, so one
+    entity's events always land on one primary (its oplog is a total
+    order for that entity) while apps spread across the fleet."""
+    return f"{int(app_id)}|{entity_id}"
+
+
+def partition_for_key(count: int, key: str) -> int:
+    """Owning partition index for ``key`` among ``count`` partitions.
+    ``count == 1`` short-circuits to 0 — the unpartitioned fast path
+    never pays a hash."""
+    if count <= 1:
+        return 0
+    return _bucket(key) % count
+
+
+def partition_for_event(count: int, app_id: int, entity_id: str) -> int:
+    return partition_for_key(count, partition_key(app_id, entity_id))
+
+
+def split_partition_sets(base_url: str) -> List[str]:
+    """A (possibly partitioned) storage URL → one single-chain URL per
+    partition, index = position. ``pio+ha://a;b,c`` →
+    ``["pio+ha://a", "pio+ha://b,c"]``; a URL without ``;`` (including
+    plain ``http://`` endpoints) is one partition."""
+    base_url = base_url.strip()
+    if ";" not in base_url:
+        return [base_url]
+    prefix = ""
+    body = base_url
+    if base_url.startswith("pio+ha://"):
+        prefix = "pio+ha://"
+        body = base_url[len(prefix):]
+    parts = [p.strip().strip(",") for p in body.split(";")]
+    parts = [p for p in parts if p]
+    if not parts:
+        raise ValueError(f"no partitions in storage URL {base_url!r}")
+    return [prefix + p if prefix else p for p in parts]
+
+
+def partition_primaries(base_url: str) -> List[str]:
+    """The write primary (first endpoint) of every partition — what the
+    continuous plane tails, one changefeed per entry."""
+    out: List[str] = []
+    for part in split_partition_sets(base_url):
+        if part.startswith("pio+ha://"):
+            first = part[len("pio+ha://"):].split(",")[0].strip()
+            out.append(first if "://" in first else f"http://{first}")
+        else:
+            out.append(part.rstrip("/"))
+    return out
+
+
+def check_partition(
+    declared: Optional[Sequence[int]], index: int, count: int
+) -> None:
+    """Loud mismatch guard shared by the oplog meta and the replica
+    tailer: a node configured as partition ``index``/``count`` must
+    never adopt a log minted for a different slot — silently tailing or
+    extending the wrong partition's history diverges the keyspace."""
+    if declared is None:
+        return
+    want = [int(index), int(count)]
+    if [int(v) for v in declared] != want:
+        raise ValueError(
+            f"partition mismatch: log belongs to partition "
+            f"{list(declared)}, this node is configured as {want}"
+        )

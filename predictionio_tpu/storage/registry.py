@@ -5,6 +5,15 @@ Rebuild of the reference's ``Storage`` object
 sources are declared by ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ ``_PATH`` here,
 instead of hosts/ports), and the three repositories are bound by
 ``PIO_STORAGE_REPOSITORIES_{METADATA,MODELDATA,EVENTDATA}_{NAME,SOURCE}``.
+
+Remote sources scale out with ``PIO_STORAGE_SOURCES_<NAME>_NODES``
+(one HA chain: ``primary:7079,replica:7079``) or, for the partitioned
+write path (``docs/storage.md#partitioning``),
+``PIO_STORAGE_SOURCES_<NAME>_PARTITIONS`` — ``;``-separated HA chains,
+one per keyspace partition in index order
+(``p0:7079,p0r:7079;p1:7079,p1r:7079``). Event writes then route by
+the (app, entity) partition hash; metadata and models stay on the
+first chain (the meta partition).
 Clients are constructed lazily and cached per source
 (``Storage.scala:124-174``); ``verify_all_data_objects`` backs the ``status``
 CLI command (``Storage.scala:230-250``).

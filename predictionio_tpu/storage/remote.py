@@ -42,7 +42,27 @@ until its circuit breaker opens, then fail over to the freshest replica
 (ordered by a one-shot ``/replicate/checkpoint`` probe) carrying
 ``X-PIO-Min-Seq`` = the last acked seq — a replica that has not yet
 applied the caller's own writes answers 409 and the next one is tried,
-preserving read-your-writes across failover.
+preserving read-your-writes across failover. When the primary is
+transport-dead and the write is safe to re-issue (an idempotent upsert,
+or the circuit was already open so nothing went out), the write path
+additionally *discovers* a promoted replica: it offers the write to the
+standbys freshest-first — a standby still answers 409 and is skipped, a
+**promoted** one acks and becomes the set's acting primary from then on
+(no automatic flip-back: the old primary returning must not split the
+write stream).
+
+Partitioned event store (``docs/storage.md#partitioning``): ``;`` in a
+``pio+ha://`` URL separates N independent (primary, replicas) sets —
+one per keyspace partition, in index order. :class:`RemoteEventStore`
+routes every event write to the partition owning its ``(app, entity)``
+hash (``storage/partition.py``) through that partition's own circuit
+breakers, with a bounded full-jitter retry for replayable writes; a
+partition that stays unreachable raises :class:`PartitionUnavailable`
+(→ the event server's 503 + Retry-After), so a dead partition sheds
+ONLY its keyspace while the other N−1 keep acking. Reads fan out and
+merge. Metadata and models are fleet-global, low-rate state: they live
+on partition 0's endpoint set (the "meta partition") — only the event
+keyspace shards.
 """
 
 from __future__ import annotations
@@ -61,6 +81,7 @@ from ..utils.resilience import (
     CircuitOpen,
     Deadline,
     DEADLINE_HEADER,
+    RetryPolicy,
     current_deadline,
 )
 from .backends import BackendFamily, SourceConf, register_backend
@@ -83,6 +104,25 @@ class RemoteStorageError(Exception):
     def __init__(self, message: str, code: Optional[int] = None):
         super().__init__(message)
         self.code = code
+
+
+class PartitionUnavailable(RemoteStorageError):
+    """One (or more) event-store partitions cannot take the operation:
+    primary transport-dead, breaker open, and no promoted standby found
+    after the bounded retry schedule. Only the listed partitions'
+    keyspace is affected — the caller (the event server's ingest path)
+    sheds exactly those keys with 503 + ``retry_after_s`` while every
+    other partition keeps acking (``docs/robustness.md``)."""
+
+    def __init__(
+        self,
+        message: str,
+        partitions,
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message, code=None)
+        self.partitions = tuple(partitions)
+        self.retry_after_s = retry_after_s
 
 
 # -- pooled keep-alive transport ---------------------------------------------
@@ -205,6 +245,19 @@ class _HAEndpoints:
         self.token = _get_seq_token("|".join(urls))
         self._order_lock = threading.Lock()
         self._order = None  # freshness-sorted replicas, cached per outage
+        #: a promoted standby discovered by the write path; once set,
+        #: writes go there — NO automatic flip-back when the old primary
+        #: reappears (two nodes accepting writes would split the stream)
+        self._acting_primary: Optional[str] = None
+
+    def write_url(self) -> str:
+        with self._order_lock:
+            return self._acting_primary or self.primary
+
+    def set_acting_primary(self, url: str) -> None:
+        with self._order_lock:
+            if url != self.primary:
+                self._acting_primary = url
 
     def note_response(self, resp) -> None:
         seq = resp.getheader(SEQ_HEADER)
@@ -249,13 +302,57 @@ def _ha_write(
     timeout: float = 60.0,
     idempotent: Optional[bool] = None,
 ):
-    """Mutations always target the primary; a successful ack's seq
-    feeds the shared token."""
-    resp = _request(
-        endpoints.primary + path, method, body, timeout, idempotent=idempotent
-    )
-    endpoints.note_response(resp)
-    return resp
+    """Mutations target the set's write endpoint (the configured primary,
+    or a previously discovered promoted standby); a successful ack's seq
+    feeds the shared token.
+
+    Promoted-standby discovery: when the write target is transport-dead
+    AND re-issuing the request cannot double-apply — it is an idempotent
+    upsert, or the circuit was already open so no bytes ever went out —
+    the write is offered to the standbys freshest-first. A standby that
+    is still a replica answers 409 (it is skipped and the set keeps
+    shedding); a **promoted** one acks, becomes the acting primary, and
+    the outage is over for this keyspace. A non-replayable write after
+    an in-flight transport failure still raises immediately: the dead
+    primary may have executed it."""
+    target = endpoints.write_url()
+    try:
+        resp = _request(
+            target + path, method, body, timeout, idempotent=idempotent
+        )
+        endpoints.note_response(resp)
+        return resp
+    except RemoteStorageError as exc:
+        if exc.code is not None or not endpoints.replicas:
+            raise  # the server answered (409/500/...), or nowhere to go
+        effective_idempotent = (
+            idempotent if idempotent is not None
+            else method in ("GET", "DELETE")
+        )
+        if not (
+            getattr(exc, "circuit_open", False) or effective_idempotent
+        ):
+            raise  # may have executed server-side: a replay could double-apply
+        for candidate in endpoints.replica_order(timeout):
+            if candidate == target:
+                continue
+            try:
+                resp = _request(
+                    candidate + path, method, body, timeout,
+                    idempotent=idempotent,
+                )
+            except RemoteStorageError:
+                # 409 = still a replica (writes have no home yet); any
+                # transport error = that standby is down too — next
+                continue
+            endpoints.note_response(resp)
+            endpoints.set_acting_primary(candidate)
+            return resp
+        # no promoted standby: the set is write-dead. Re-raise the
+        # ORIGINAL outage (not a candidate's 409) — the caller's shed
+        # path keys on "transport-dead", and a 409 here would read as
+        # "the server answered", hiding the outage.
+        raise exc
 
 
 def _ha_read(
@@ -266,19 +363,21 @@ def _ha_read(
     timeout: float = 60.0,
     idempotent: bool = True,
 ):
-    """Reads prefer the primary; once its breaker is open (the endpoint
-    is known-dead, PR 2 semantics) they fail over to the freshest replica
+    """Reads prefer the primary (or the discovered acting primary after
+    a write failover); once its breaker is open (the endpoint is
+    known-dead, PR 2 semantics) they fail over to the freshest replica
     carrying the read-your-writes floor. A single transient primary
     failure below the breaker threshold still raises — failover is an
     outage response, not a retry policy."""
+    preferred = endpoints.write_url()
     if not endpoints.replicas:
         return _request(
-            endpoints.primary + path, method, body, timeout,
+            preferred + path, method, body, timeout,
             idempotent=idempotent,
         )
     try:
         resp = _request(
-            endpoints.primary + path, method, body, timeout,
+            preferred + path, method, body, timeout,
             idempotent=idempotent,
         )
         endpoints.clear_order()  # healthy again: next outage re-probes
@@ -286,7 +385,7 @@ def _ha_read(
     except RemoteStorageError as exc:
         if exc.code is not None:
             raise  # the server answered; an HTTP error is not an outage
-        breaker = _get_breaker(_netloc(endpoints.primary))
+        breaker = _get_breaker(_netloc(preferred))
         if not getattr(exc, "circuit_open", False) and (
             breaker.state == CircuitBreaker.CLOSED
         ):
@@ -574,77 +673,273 @@ def _json(resp) -> dict:
     return json.loads(resp.read())
 
 
+#: bounded full-jitter retry schedule for replayable writes against one
+#: partition (docs/robustness.md): 3 total tries, 50 ms base doubling to
+#: a 0.5 s cap — enough to ride out a primary restart's socket blip,
+#: bounded enough that a dead partition sheds within ~1 s. Deadline-aware
+#: (no retry is attempted once the ambient budget cannot cover its
+#: backoff). Env-tunable attempts for drills.
+PARTITION_RETRY_ATTEMPTS_ENV = "PIO_PARTITION_RETRY_ATTEMPTS"
+
+
+def _partition_retry_policy(sleep=time.sleep) -> RetryPolicy:
+    import os
+
+    attempts = 3
+    raw = os.environ.get(PARTITION_RETRY_ATTEMPTS_ENV)
+    if raw:
+        try:
+            attempts = max(1, int(raw))
+        except ValueError:
+            pass
+    return RetryPolicy(
+        attempts=attempts, base_delay_s=0.05, max_delay_s=0.5,
+        retry_on=(RemoteStorageError,), sleep=sleep,
+    )
+
+
 class RemoteEventStore(EventStore):
-    """``EventStore`` over the storage server's /events routes."""
+    """``EventStore`` over the storage server's /events routes.
+
+    A partitioned URL (``;``-separated endpoint sets, index order —
+    module docstring) makes this the fan-out client of the partitioned
+    write path: writes route to the owning partition, reads fan out and
+    merge, and per-partition failures surface as
+    :class:`PartitionUnavailable` so only that keyspace sheds."""
 
     def __init__(self, base_url: str, timeout: float = 60.0):
+        from .partition import split_partition_sets
+
         # 60 s default mirrors the reference LEvents op timeout
         # (LEvents.scala:35).
-        self._ep = _HAEndpoints(base_url)
+        self._parts = [
+            _HAEndpoints(u) for u in split_partition_sets(base_url)
+        ]
+        self._ep = self._parts[0]
         self._timeout = timeout
+        self._retry = _partition_retry_policy()
+
+    @property
+    def partition_count(self) -> int:
+        return len(self._parts)
+
+    def partition_for(self, app_id: int, entity_id: str) -> int:
+        """The owning partition of one (app, entity) key — exposed so
+        the event server's batch path can group a mixed batch and shed
+        per keyspace (docs/storage.md#partitioning)."""
+        from .partition import partition_for_event
+
+        return partition_for_event(len(self._parts), int(app_id), entity_id)
+
+    def _ep_for(self, app_id: int, entity_id: str):
+        idx = self.partition_for(app_id, entity_id)
+        return idx, self._parts[idx]
+
+    def _partition_call(self, idx: int, fn, retryable: bool):
+        """Run one partition-bound operation under the bounded jittered
+        retry (replayable ops only), converting a transport-dead
+        partition into :class:`PartitionUnavailable` — HTTP-status
+        errors (the server talking) pass through untouched."""
+
+        def transient(exc: BaseException) -> bool:
+            return (
+                isinstance(exc, RemoteStorageError)
+                and exc.code is None
+                and not getattr(exc, "circuit_open", False)
+            )
+
+        try:
+            if retryable:
+                return self._retry.call(
+                    fn, should_retry=transient, deadline=current_deadline()
+                )
+            return fn()
+        except RemoteStorageError as exc:
+            if exc.code is not None:
+                raise
+            raise PartitionUnavailable(
+                f"event-store partition {idx} of {len(self._parts)} "
+                f"unavailable: {exc}",
+                partitions=(idx,),
+            ) from exc
 
     def _path(self, app_id: int, suffix: str = "") -> str:
         return f"/events/{app_id}{suffix}"
 
+    def _fan_all(self, fn, retryable: bool):
+        """Run one op against EVERY partition (app lifecycle, bulk
+        groups). All partitions are attempted — a dead one must not
+        starve the rest — then the failures raise together."""
+        results = []
+        failed: list = []
+        last: Optional[RemoteStorageError] = None
+        for idx in range(len(self._parts)):
+            try:
+                results.append(self._partition_call(
+                    idx, lambda i=idx: fn(i, self._parts[i]), retryable
+                ))
+            except PartitionUnavailable as exc:
+                failed.extend(exc.partitions)
+                last = exc
+        if failed:
+            raise PartitionUnavailable(
+                f"event-store partition(s) {sorted(failed)} of "
+                f"{len(self._parts)} unavailable: {last}",
+                partitions=sorted(failed),
+            ) from last
+        return results
+
     def init(self, app_id: int) -> bool:
-        with _ha_write(self._ep, self._path(app_id, "/init"), "POST", b"{}",
-                       self._timeout, idempotent=True) as r:
-            return bool(_json(r)["ok"])
+        def one(_idx, ep) -> bool:
+            with _ha_write(ep, self._path(app_id, "/init"), "POST", b"{}",
+                           self._timeout, idempotent=True) as r:
+                return bool(_json(r)["ok"])
+
+        return all(self._fan_all(one, retryable=True))
 
     def remove(self, app_id: int) -> bool:
-        with _ha_write(self._ep, self._path(app_id, "/remove"), "POST", b"{}",
-                       self._timeout, idempotent=True) as r:
-            return bool(_json(r)["ok"])
+        def one(_idx, ep) -> bool:
+            with _ha_write(ep, self._path(app_id, "/remove"), "POST", b"{}",
+                           self._timeout, idempotent=True) as r:
+                return bool(_json(r)["ok"])
+
+        return all(self._fan_all(one, retryable=True))
 
     def insert(self, event: Event, app_id: int) -> str:
         body = json.dumps(event.to_json_dict()).encode()
         # An event that already carries its id (client-assigned, or
         # minted from an idempotencyKey upstream) is an UPSERT on the
         # server: replaying it lands on itself, so the POST may take the
-        # one-shot stale-connection retry. Unkeyed inserts keep NO retry
-        # — a replay would double-insert.
-        with _ha_write(
-            self._ep, self._path(app_id), "POST", body, self._timeout,
-            idempotent=event.event_id is not None,
-        ) as r:
-            return _json(r)["eventId"]
+        # one-shot stale-connection retry AND the partition retry
+        # schedule. Unkeyed inserts keep NO retry — a replay would
+        # double-insert.
+        idempotent = event.event_id is not None
+        idx, ep = self._ep_for(app_id, event.entity_id)
+
+        def send() -> str:
+            with _ha_write(
+                ep, self._path(app_id), "POST", body, self._timeout,
+                idempotent=idempotent,
+            ) as r:
+                return _json(r)["eventId"]
+
+        return self._partition_call(idx, send, retryable=idempotent)
 
     def get(self, event_id: str, app_id: int) -> Optional[Event]:
-        try:
-            with _ha_read(
-                self._ep, self._path(app_id, f"/{event_id}"),
-                timeout=self._timeout,
-            ) as r:
-                return Event.from_json_dict(_json(r))
-        except RemoteStorageError as exc:
-            if exc.code == 404:
-                return None
-            raise
+        # an event id does not carry its entity key: point reads probe
+        # every partition (cheap: N is small, misses are indexed 404s)
+        last: Optional[RemoteStorageError] = None
+        for ep in self._parts:
+            try:
+                with _ha_read(
+                    ep, self._path(app_id, f"/{event_id}"),
+                    timeout=self._timeout,
+                ) as r:
+                    return Event.from_json_dict(_json(r))
+            except RemoteStorageError as exc:
+                if exc.code == 404:
+                    continue
+                last = exc
+        if last is not None:
+            # a miss everywhere with a partition unreachable is NOT a
+            # clean "absent" — the event may live on the dead partition
+            raise last
+        return None
 
     def delete(self, event_id: str, app_id: int) -> bool:
-        with _ha_write(
-            self._ep, self._path(app_id, f"/{event_id}"), "DELETE",
-            timeout=self._timeout,
-        ) as r:
-            return bool(_json(r)["found"])
+        def one(_idx, ep) -> bool:
+            with _ha_write(
+                ep, self._path(app_id, f"/{event_id}"), "DELETE",
+                timeout=self._timeout,
+            ) as r:
+                return bool(_json(r)["found"])
+
+        # attempt-all-then-raise (the _fan_all discipline): a dead
+        # partition must not stop the delete from landing everywhere
+        # else, and the raised error names every failed partition
+        return any(self._fan_all(one, retryable=True))
 
     def find(
         self, app_id: int, filter: Optional[EventFilter] = None
     ) -> Iterator[Event]:
-        body = self._filter_dict(filter or EventFilter())
-        resp = _ha_read(
-            self._ep, self._path(app_id, "/find"), "POST",
-            json.dumps(body).encode(), self._timeout,  # pure read
-        )
+        flt = filter or EventFilter()
+        body = json.dumps(self._filter_dict(flt)).encode()
+        if len(self._parts) == 1:
+            resp = _ha_read(
+                self._ep, self._path(app_id, "/find"), "POST",
+                body, self._timeout,  # pure read
+            )
 
-        def iterate() -> Iterator[Event]:
+            def iterate() -> Iterator[Event]:
+                with resp:
+                    for line in resp:  # http.client decodes the framing
+                        line = line.strip()
+                        if line:
+                            yield Event.from_json_dict(json.loads(line))
+
+            return iterate()
+        # Partitioned scan: every partition streams its own time-ordered
+        # slice; a lazy k-way merge re-establishes the global
+        # (event_time, event_id) order the single-store contract
+        # promises. A dead partition fails the scan LOUDLY (after read
+        # failover to its replicas) — a silently truncated training scan
+        # is worse than an error, same principle as the fleet's dead
+        # shard (docs/fleet.md).
+        responses: list = []
+
+        def close_all() -> None:
+            for resp in responses:
+                try:
+                    resp.close()
+                except Exception:
+                    pass
+
+        try:
+            for ep in self._parts:
+                responses.append(
+                    _ha_read(
+                        ep, self._path(app_id, "/find"), "POST", body,
+                        self._timeout,
+                    )
+                )
+        except Exception:
+            # a later partition failed to open: the already-open
+            # streams must not linger with unread bodies poisoning
+            # their pooled connections
+            close_all()
+            raise
+
+        def stream(resp) -> Iterator[Event]:
             with resp:
-                for line in resp:  # http.client decodes the chunked framing
+                for line in resp:
                     line = line.strip()
                     if line:
                         yield Event.from_json_dict(json.loads(line))
 
-        return iterate()
+        def merged() -> Iterator[Event]:
+            import heapq
+
+            def key(e: Event):
+                return (e.event_time, e.event_id or "")
+
+            try:
+                produced = 0
+                limit = flt.limit  # None or <0 = unlimited (EventFilter)
+                bounded = limit is not None and limit >= 0
+                for event in heapq.merge(
+                    *(stream(r) for r in responses),
+                    key=key, reverse=bool(flt.reversed),
+                ):
+                    if bounded and produced >= limit:
+                        return
+                    yield event
+                    produced += 1
+            finally:
+                # an abandoned/limited merge must release the N-1
+                # still-open streams deterministically, not at GC time
+                close_all()
+
+        return merged()
 
     def _filter_dict(self, flt: EventFilter) -> dict:
         return {
@@ -664,34 +959,126 @@ class RemoteEventStore(EventStore):
     def scan_columnar(self, app_id: int, filter: Optional[EventFilter] = None):
         """Columnar fast path over the wire (same contract as
         ``SqliteEventStore.scan_columnar``); the server delegates to the
-        backing store's native columnar scan."""
+        backing store's native columnar scan. Partitioned: every
+        partition's columns concatenate, then one stable argsort on
+        ``event_time_ms`` restores the global time order."""
         import numpy as np
 
         body = json.dumps(self._filter_dict(filter or EventFilter())).encode()
-        with _ha_read(
-            self._ep, self._path(app_id, "/scan_columnar"), "POST", body,
-            self._timeout,  # pure read
-        ) as r:
-            cols = _json(r)
-        cols["event_time_ms"] = np.asarray(cols["event_time_ms"], dtype=np.int64)
-        return cols
+        if len(self._parts) == 1:
+            with _ha_read(
+                self._ep, self._path(app_id, "/scan_columnar"), "POST",
+                body, self._timeout,  # pure read
+            ) as r:
+                cols = _json(r)
+            cols["event_time_ms"] = np.asarray(
+                cols["event_time_ms"], dtype=np.int64
+            )
+            return cols
+        merged: Optional[dict] = None
+        for ep in self._parts:
+            with _ha_read(
+                ep, self._path(app_id, "/scan_columnar"), "POST", body,
+                self._timeout,
+            ) as r:
+                cols = _json(r)
+            if merged is None:
+                merged = {k: list(v) for k, v in cols.items()}
+            else:
+                for k, v in cols.items():
+                    merged[k].extend(v)
+        assert merged is not None
+        times = np.asarray(merged["event_time_ms"], dtype=np.int64)
+        order = np.argsort(times, kind="stable")
+        out = {
+            k: [v[i] for i in order] for k, v in merged.items()
+            if k != "event_time_ms"
+        }
+        out["event_time_ms"] = times[order]
+        return out
+
+    def _write_batch(self, events, app_id: int, fresh: bool) -> None:
+        events = list(events)
+        suffix = "/batch?fresh=1" if fresh else "/batch"
+        if len(self._parts) == 1:
+            body = json.dumps([e.to_json_dict() for e in events]).encode()
+            with _ha_write(
+                self._ep, self._path(app_id, suffix), "POST", body,
+                self._timeout,
+            ):
+                pass
+            return
+        # Group by owning partition, land every reachable group, then
+        # raise ONE PartitionUnavailable naming the dead keyspaces — a
+        # mixed batch makes maximum progress, never all-or-nothing
+        # behind the slowest partition. No cross-partition buffering:
+        # each group is acked (or not) by its own primary's oplog.
+        groups: dict = {}
+        for event in events:
+            idx = self.partition_for(app_id, event.entity_id)
+            groups.setdefault(idx, []).append(event)
+        failed: list = []
+        last: Optional[RemoteStorageError] = None
+        for idx in sorted(groups):
+            group = groups[idx]
+            body = json.dumps([e.to_json_dict() for e in group]).encode()
+            # replayable only when every event in the group upserts
+            retryable = all(e.event_id is not None for e in group)
+
+            def send(i=idx, b=body) -> None:
+                with _ha_write(
+                    self._parts[i], self._path(app_id, suffix), "POST", b,
+                    self._timeout,
+                    idempotent=retryable or None,
+                ):
+                    pass
+
+            try:
+                self._partition_call(idx, send, retryable=retryable)
+            except PartitionUnavailable as exc:
+                failed.extend(exc.partitions)
+                last = exc
+        if failed:
+            raise PartitionUnavailable(
+                f"event batch lost partition(s) {sorted(failed)} of "
+                f"{len(self._parts)}: {last}",
+                partitions=sorted(failed),
+            ) from last
 
     def write(self, events, app_id: int) -> None:
-        body = json.dumps([e.to_json_dict() for e in events]).encode()
-        with _ha_write(
-            self._ep, self._path(app_id, "/batch"), "POST", body, self._timeout
-        ):
-            pass
+        self._write_batch(events, app_id, fresh=False)
 
     def write_new(self, events, app_id: int) -> None:
         """Freshness contract forwarded to the server so the backing store
         can take its guaranteed-new batch path."""
-        body = json.dumps([e.to_json_dict() for e in events]).encode()
-        with _ha_write(
-            self._ep, self._path(app_id, "/batch?fresh=1"), "POST", body,
-            self._timeout,
-        ):
-            pass
+        self._write_batch(events, app_id, fresh=True)
+
+    def partition_status(self, timeout: float = 2.0) -> list:
+        """One ``/replication.json``-shaped row per partition, probed
+        from this client's view (write endpoint + ``/replicate/
+        checkpoint``): the event server's ingest-tier fleet surface and
+        ``pio top``'s PARTS column read these rows."""
+        rows = []
+        n = len(self._parts)
+        for idx, ep in enumerate(self._parts):
+            url = ep.write_url()
+            row = {"partition": idx, "of": n, "endpoint": url, "up": False}
+            try:
+                with _request(
+                    f"{url}/replicate/checkpoint", timeout=timeout
+                ) as resp:
+                    ck = _json(resp)
+                row["up"] = True
+                row["seq"] = ck.get("seq")
+                row["generation"] = ck.get("generation")
+            except (RemoteStorageError, ValueError) as exc:
+                if getattr(exc, "code", None) == 404:
+                    # alive but changefeed-less: up, just not replicating
+                    row["up"] = True
+                else:
+                    row["error"] = str(exc)[:200]
+            rows.append(row)
+        return rows
 
 
 #: Pure-read metadata RPCs: pooled keep-alive + stale retry is safe for
@@ -705,12 +1092,21 @@ _READ_RPC_METHODS = METADATA_READ_METHODS
 assert _READ_RPC_METHODS <= METADATA_RPC_METHODS
 
 
+def _meta_endpoint_set(base_url: str) -> str:
+    """Metadata and models are fleet-global, low-rate state: on a
+    partitioned URL they live on partition 0's endpoint set (the "meta
+    partition") — only the event keyspace shards."""
+    from .partition import split_partition_sets
+
+    return split_partition_sets(base_url)[0]
+
+
 class _RemoteRPC:
     """One metadata RPC method bound to an endpoint set."""
 
     def __init__(self, endpoints, method: str, timeout: float):
         if isinstance(endpoints, str):  # bare URL accepted for callers
-            endpoints = _HAEndpoints(endpoints)
+            endpoints = _HAEndpoints(_meta_endpoint_set(endpoints))
         self._ep, self._method, self._timeout = endpoints, method, timeout
         self._read = method in _READ_RPC_METHODS
 
@@ -739,7 +1135,7 @@ class RemoteMetadataStore:
     """
 
     def __init__(self, base_url: str, timeout: float = 60.0):
-        endpoints = _HAEndpoints(base_url)
+        endpoints = _HAEndpoints(_meta_endpoint_set(base_url))
         for method in METADATA_RPC_METHODS:
             setattr(self, method, _RemoteRPC(endpoints, method, timeout))
 
@@ -749,7 +1145,7 @@ class RemoteMetadataStore:
 
 class RemoteModelStore(ModelStore):
     def __init__(self, base_url: str, timeout: float = 60.0):
-        self._ep = _HAEndpoints(base_url)
+        self._ep = _HAEndpoints(_meta_endpoint_set(base_url))
         self._timeout = timeout
 
     def insert(self, model: Model) -> None:
@@ -779,10 +1175,14 @@ class RemoteModelStore(ModelStore):
 
 
 def _base_url(conf: SourceConf) -> str:
-    """Resolve a source conf to a (possibly multi-endpoint) base URL:
-    ``URL`` verbatim, ``NODES`` as a ``pio+ha://`` set, else HOST/PORT."""
+    """Resolve a source conf to a (possibly multi-endpoint, possibly
+    partitioned) base URL: ``URL`` verbatim, ``PARTITIONS`` as a
+    ``;``-separated partitioned ``pio+ha://`` spec, ``NODES`` as a
+    single ``pio+ha://`` set, else HOST/PORT."""
     if conf.get("url"):
         return conf["url"]
+    if conf.get("partitions"):
+        return f"pio+ha://{conf['partitions']}"
     if conf.get("nodes"):
         return f"pio+ha://{conf['nodes']}"
     host = conf.get("host", "127.0.0.1")

@@ -71,6 +71,7 @@ class ReplicaTailer:
         state_dir: str,
         timeout: float = 30.0,
         batch_limit: int = 500,
+        partition: Optional[tuple] = None,
     ):
         self._primary = primary_url.rstrip("/")
         self._events = events
@@ -79,6 +80,14 @@ class ReplicaTailer:
         self._state_dir = state_dir
         self._timeout = timeout
         self._batch_limit = batch_limit
+        #: declared ``(index, count)`` slot — checked against the
+        #: primary's own declaration on every batch so a replica can
+        #: never silently converge on the wrong partition's history
+        self.partition: Optional[tuple] = (
+            (int(partition[0]), int(partition[1]))
+            if partition is not None and int(partition[1]) > 1
+            else None
+        )
         #: serializes the apply phase against promotion: promote() takes
         #: this lock after stopping the poll loop, so a batch already
         #: fetched from the dying primary can never apply *after* the
@@ -146,6 +155,16 @@ class ReplicaTailer:
         with self.apply_lock:
             if self.aborted():
                 return 0  # promotion won the race: drop the fetched batch
+            if self.partition is not None:
+                from .partition import check_partition
+
+                try:
+                    check_partition(
+                        batch.get("partition"),
+                        self.partition[0], self.partition[1],
+                    )
+                except ValueError as exc:
+                    raise ReplicationError(str(exc)) from exc
             generation = batch.get("generation")
             if self.generation is None:
                 self.generation = generation
@@ -216,13 +235,17 @@ class StorageReplica(StorageServer):
         state_dir: str,
         catchup_wait_s: float = 2.0,
         timeout: float = 30.0,
+        partition: Optional[tuple] = None,
     ):
-        super().__init__(host, port, events, metadata, models, changefeed=None)
+        super().__init__(
+            host, port, events, metadata, models, changefeed=None,
+            partition=partition,
+        )
         self.primary_url = primary_url.rstrip("/")
         self.catchup_wait_s = catchup_wait_s
         self.tailer = ReplicaTailer(
             self.primary_url, events, metadata, models, state_dir,
-            timeout=timeout,
+            timeout=timeout, partition=partition,
         )
         self.tailer.aborted = lambda: self._stop_polling.is_set()
         self._applied_cond = threading.Condition()
@@ -232,10 +255,16 @@ class StorageReplica(StorageServer):
         # for a stalling tailer. A promoted replica is the primary — by
         # definition caught up with itself — so the gauge pins to 0 after
         # failover (the loadgen chaos scenario asserts exactly this).
+        # Labeled by partition slot so the SLO plane's freshness
+        # objective evaluates each partition's chain independently — one
+        # lagging partition must never hide behind a healthy fleet mean
+        # (docs/slo.md).
         self.metrics.gauge_callback(
             "pio_replication_lag_ops",
             self.replication_lag,
             "Ops behind the last observed primary seq (0 = caught up)",
+            # pio: lint-ok[obs-unbounded-label] the partition index is this node's own configured slot — one value per process, a closed 0..N-1 vocabulary fleet-wide
+            labels={"partition": str(self.partition[0])},
         )
 
     def replication_lag(self) -> int:
@@ -360,7 +389,15 @@ class StorageReplica(StorageServer):
                     self.tailer._state_dir, f"oplog-{applied}"
                 )
             self.changefeed = Changefeed(
-                OpLog(oplog_dir, base_seq=applied),
+                OpLog(
+                    oplog_dir, base_seq=applied,
+                    # the promoted log keeps the dead primary's keyspace
+                    # slot: clients and tailers of partition k keep
+                    # talking to partition k, just at a new address
+                    partition=(
+                        self._partition if self._partition[1] > 1 else None
+                    ),
+                ),
                 self.events, self.metadata, self.models,
             )
             self.accepts_writes = True
@@ -378,11 +415,24 @@ class StorageReplica(StorageServer):
         (no changefeed exists until promotion)."""
         if self.changefeed is not None:  # promoted
             return super().checkpoint_json()
-        return {
+        out = {
             "seq": self.tailer.applied_seq,
             "generation": self.tailer.generation,
             "replica": True,
         }
+        if self.tailer.partition is not None:
+            out["partition"] = list(self.tailer.partition)
+        return out
+
+    def replication_json(self) -> dict:
+        out = super().replication_json()
+        if not self.accepts_writes:
+            row = out["partitions"][0]
+            row["primary"] = self.primary_url
+            lag = self.tailer.lag()
+            if lag is not None:
+                row["lag"] = lag
+        return out
 
     def status_json(self) -> dict:
         out = super().status_json()
@@ -404,9 +454,15 @@ def create_storage_replica(
     primary_url: str,
     registry=None,
     state_dir: Optional[str] = None,
+    partition_index: int = 0,
+    partition_count: int = 1,
 ) -> StorageReplica:
     """Build a replica fronting ``registry``'s local stores (the ``pio
-    storageserver --replica-of URL`` entry point)."""
+    storageserver --replica-of URL`` entry point).
+    ``partition_index``/``partition_count`` declare which keyspace slot
+    the tailed primary must own (docs/storage.md#partitioning) — a slot
+    mismatch stops tailing loudly instead of converging on the wrong
+    partition's history."""
     if registry is None:
         from .registry import get_registry
 
@@ -423,4 +479,5 @@ def create_storage_replica(
         registry.get_models(),
         primary_url,
         state_dir,
+        partition=(partition_index, partition_count),
     )

@@ -62,7 +62,7 @@ from urllib.parse import parse_qs, urlparse
 from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
 from ..obs.trace import TRACE_HEADER, Tracer
 from ..utils.resilience import DEADLINE_HEADER, Deadline
-from .changefeed import MIN_SEQ_HEADER, SEQ_HEADER
+from .changefeed import MIN_SEQ_HEADER, SEQ_HEADER, WrongPartition
 from .event import Event
 from .events import EventFilter
 from .metadata import MetadataStore
@@ -190,8 +190,20 @@ class _StorageHandler(JsonHTTPHandler):
                 self.respond(200, {"status": "alive"})
             elif parts == ["status.json"] and method == "GET":
                 self.respond(200, self.server.status_json())
-            elif method == "GET" and parts in (["metrics"], ["traces.json"]):
-                self.serve_obs("/" + parts[0])  # docs/observability.md
+            elif parts == ["replication.json"] and method == "GET":
+                # per-partition replication rows (docs/storage.md
+                # #partitioning): a storage node reports its own slot;
+                # the event server aggregates its client-side view of
+                # all N — ``pio top`` renders both as the PARTS column
+                self.respond(200, self.server.replication_json())
+            elif method == "GET" and parts in (
+                ["metrics"], ["traces.json"],
+                ["health.json"], ["blackbox.json"],
+            ):
+                # docs/observability.md + docs/slo.md — without the
+                # health route, `pio health` cannot read a storage
+                # node's per-partition freshness objectives
+                self.serve_obs("/" + parts[0])
             elif parts and parts[0] == "replicate":
                 with self._obs_scope(method, "replicate"):
                     self._route_replicate(method, parts[1:])
@@ -209,6 +221,20 @@ class _StorageHandler(JsonHTTPHandler):
             else:
                 self.read_body()
                 self.respond(404, {"message": "Not found"})
+        except WrongPartition as exc:
+            # hash-contract violation: a write routed to a primary that
+            # does not own its key. 409 + the owning index — loud and
+            # actionable for a misconfigured client, never a silent fork
+            # of the keyspace (write paths never stream, so headers are
+            # still ours to send).
+            self.respond(
+                409,
+                {
+                    "message": str(exc),
+                    "expectedPartition": exc.expected,
+                    "partition": list(self.server.partition),
+                },
+            )
         except (BrokenPipeError, ConnectionResetError) as exc:
             # client dropped mid-stream (abandoned scan): normal operation
             logger.debug("client dropped during %s %s: %s", method, path, exc)
@@ -286,15 +312,17 @@ class _StorageHandler(JsonHTTPHandler):
             except OpLogGap as exc:
                 self.respond(410, {"message": str(exc), **cf.oplog.checkpoint()})
                 return
-            self.respond(
-                200,
-                {
-                    "changes": [{"seq": s, "op": o} for s, o in entries],
-                    "lastSeq": last_seq,
-                    "generation": cf.oplog.generation,
-                    "oldestSeq": cf.oplog.oldest_seq,
-                },
-            )
+            body = {
+                "changes": [{"seq": s, "op": o} for s, o in entries],
+                "lastSeq": last_seq,
+                "generation": cf.oplog.generation,
+                "oldestSeq": cf.oplog.oldest_seq,
+            }
+            if cf.oplog.partition is not None:
+                # tailers verify they follow the slot they were
+                # configured for (storage/partition.check_partition)
+                body["partition"] = list(cf.oplog.partition)
+            self.respond(200, body)
         elif rest == ["checkpoint"] and method == "GET":
             ck = self.server.checkpoint_json()
             if ck is None:
@@ -543,6 +571,7 @@ class StorageServer(BackgroundHTTPServer):
         metadata: MetadataStore,
         models,
         changefeed=None,
+        partition: Optional[tuple] = None,
     ):
         super().__init__(
             (host, port), _StorageHandler, tracer=Tracer(self.service_name),
@@ -552,6 +581,14 @@ class StorageServer(BackgroundHTTPServer):
         self.metadata = metadata
         self.models = models
         self.changefeed = changefeed
+        #: explicit ``(index, count)`` slot; the changefeed's own slot
+        #: (from the oplog meta) wins when one is attached — see the
+        #: ``partition`` property
+        self._partition = (
+            (int(partition[0]), int(partition[1]))
+            if partition is not None
+            else (0, 1)
+        )
         self.start_time = _dt.datetime.now(tz=_dt.timezone.utc)
         # The changefeed seq is the append *counter* of the mutation log:
         # a scraper's rate() over it IS the append rate, and comparing it
@@ -565,6 +602,33 @@ class StorageServer(BackgroundHTTPServer):
             ),
             "Last sequence number appended to the changefeed op log",
         )
+
+    @property
+    def partition(self) -> tuple:
+        """This node's ``(index, count)`` keyspace slot. Derived from the
+        attached changefeed when it carries one (the oplog meta is the
+        durable identity — it survives restarts that lose CLI flags),
+        else the construction-time value; ``(0, 1)`` = unpartitioned."""
+        cf = self.changefeed
+        if cf is not None and getattr(cf, "partition", (0, 1))[1] > 1:
+            return cf.partition
+        return self._partition
+
+    def replication_json(self) -> dict:
+        """``GET /replication.json`` — this node's per-partition rows
+        (one row: itself). The event server's aggregated N-row twin and
+        ``pio top``'s PARTS column read the same shape."""
+        index, count = self.partition
+        row = {
+            "partition": index,
+            "of": count,
+            "up": True,
+            "role": "primary" if self.accepts_writes else "replica",
+            "seq": self.applied_seq(),
+        }
+        if self.changefeed is not None:
+            row["generation"] = self.changefeed.oplog.generation
+        return {"partitions": [row]}
 
     # -- replication hooks (overridden by StorageReplica) -----------------
     def applied_seq(self) -> int:
@@ -607,6 +671,8 @@ class StorageServer(BackgroundHTTPServer):
         if self.changefeed is not None:
             out["seq"] = self.changefeed.last_seq
             out["generation"] = self.changefeed.oplog.generation
+        if self.partition[1] > 1:
+            out["partition"] = list(self.partition)
         return out
 
 
@@ -615,21 +681,51 @@ def create_storage_server(
     port: int = DEFAULT_PORT,
     registry: Optional[object] = None,
     oplog_dir: Optional[str] = None,
+    partition_index: int = 0,
+    partition_count: int = 1,
+    sync_every: Optional[int] = None,
 ) -> StorageServer:
     """Build a storage server fronting ``registry`` (default: the
     process-wide env-configured registry). ``oplog_dir`` attaches a
-    changefeed rooted there, making the server a replication primary."""
+    changefeed rooted there, making the server a replication primary.
+    ``partition_index``/``partition_count`` declare this primary's
+    keyspace slot (docs/storage.md#partitioning) — stamped into the
+    oplog meta and enforced on every event write. ``sync_every``
+    overrides the oplog fsync cadence (1 = fsync before every ack:
+    the strict power-loss-safe ack discipline)."""
     if registry is None:
         from .registry import get_registry
 
         registry = get_registry()
+    if not (0 <= partition_index < max(1, partition_count)):
+        raise ValueError(
+            f"partition_index {partition_index} out of range for "
+            f"partition_count {partition_count}"
+        )
     events = registry.get_events()
     metadata = registry.get_metadata()
     models = registry.get_models()
     changefeed = None
     if oplog_dir is not None:
         from .changefeed import Changefeed
-        from .oplog import OpLog
+        from .oplog import DEFAULT_SYNC_EVERY, OpLog
 
-        changefeed = Changefeed(OpLog(oplog_dir), events, metadata, models)
-    return StorageServer(host, port, events, metadata, models, changefeed)
+        changefeed = Changefeed(
+            OpLog(
+                oplog_dir,
+                sync_every=(
+                    sync_every if sync_every is not None
+                    else DEFAULT_SYNC_EVERY
+                ),
+                partition=(
+                    (partition_index, partition_count)
+                    if partition_count > 1
+                    else None
+                ),
+            ),
+            events, metadata, models,
+        )
+    return StorageServer(
+        host, port, events, metadata, models, changefeed,
+        partition=(partition_index, partition_count),
+    )

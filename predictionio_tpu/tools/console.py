@@ -484,6 +484,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--poll-interval", type=float, default=0.5,
         help="replica changefeed poll interval in seconds",
     )
+    ss.add_argument(
+        "--partition-index", type=int, default=0, metavar="I",
+        help="this node's keyspace slot in a partitioned event store "
+             "(docs/storage.md#partitioning): stamped into the oplog "
+             "meta and enforced on every event write; replicas refuse "
+             "to tail a primary declaring a different slot",
+    )
+    ss.add_argument(
+        "--partition-count", type=int, default=1, metavar="N",
+        help="total partitions of the event store (1 = unpartitioned)",
+    )
+    ss.add_argument(
+        "--sync-every", type=int, default=None, metavar="N",
+        help="oplog fsync cadence (primary mode; default 256): 1 = "
+             "fsync before every ack, the strict power-loss-safe ack "
+             "discipline",
+    )
 
     sub.add_parser("status", help="verify storage backends")
 
@@ -990,12 +1007,15 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
             from ..storage.replica import create_storage_replica
 
             replica = create_storage_replica(
-                args.ip, args.port, args.replica_of, registry
+                args.ip, args.port, args.replica_of, registry,
+                partition_index=args.partition_index,
+                partition_count=args.partition_count,
             )
             replica.start_tailing(poll_interval_s=args.poll_interval)
             _emit({
                 "status": "serving", "role": "replica",
                 "port": replica.bound_port, "primary": args.replica_of,
+                "partition": [args.partition_index, args.partition_count],
             })
             try:
                 replica.serve_forever()
@@ -1011,12 +1031,16 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         if not args.no_changefeed:
             oplog_dir = args.oplog_dir or os.path.join(base_dir(), "oplog")
         server = create_storage_server(
-            args.ip, args.port, registry, oplog_dir=oplog_dir
+            args.ip, args.port, registry, oplog_dir=oplog_dir,
+            partition_index=args.partition_index,
+            partition_count=args.partition_count,
+            sync_every=args.sync_every,
         )
         _emit({
             "status": "serving", "role": "primary",
             "port": server.bound_port,
             "changefeed": oplog_dir is not None,
+            "partition": [args.partition_index, args.partition_count],
         })
         try:
             server.serve_forever()

@@ -173,8 +173,11 @@ def train_runs_json(instances) -> list:
 def render_fleet(rows) -> str:
     """``GET /fleet``: the ``pio top`` table as a dashboard panel —
     per-node serving latency, shed/breaker state, replication lag,
-    continuous-learning freshness (FEEDLAG / CANDAGE, docs/continuous.md)
-    and jit compile/retrace counts (docs/observability.md#profiling)."""
+    event-store partition health (PARTS: partitions reachable / total
+    from each node's ``/replication.json``,
+    docs/storage.md#partitioning), continuous-learning freshness
+    (FEEDLAG / CANDAGE, docs/continuous.md) and jit compile/retrace
+    counts (docs/observability.md#profiling)."""
     from ..obs.top import FLEET_COLUMNS, format_row
 
     header = "".join(

@@ -54,8 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "rollout submission (docs/continuous.md)")
     p.add_argument("--continuous-feed", default=None, metavar="URL",
                    help="storage primary whose GET /replicate/changes the "
-                        "loop tails (default: $PIO_STORAGE_SOURCES_*_URL "
-                        "when the registry is remote)")
+                        "loop tails; a ';'-separated partitioned URL "
+                        "(storage.md#partitioning) tails one changefeed "
+                        "per partition with independent durable cursors "
+                        "(default: $PIO_STORAGE_SOURCES_*_URL when the "
+                        "registry is remote)")
     p.add_argument("--continuous-min-events", type=int, default=10,
                    help="delta size that triggers a training cycle")
     p.add_argument("--continuous-staleness-s", type=float, default=300.0,
@@ -73,14 +76,20 @@ def _continuous_config(args: argparse.Namespace, registry):
 
     feed_url = getattr(args, "continuous_feed", None)
     if not feed_url:
-        # derive the primary from a remote-registry env: the loop tails
-        # the same storage server every other plane already talks to
+        # derive the primaries from a remote-registry env: the loop
+        # tails the same storage server(s) every other plane already
+        # talks to — one changefeed per partition primary on a
+        # partitioned URL (docs/storage.md#partitioning)
+        from ..storage.partition import partition_primaries
+
         env = registry._env if registry is not None else {}
         for key, value in env.items():
-            if key.startswith("PIO_STORAGE_SOURCES_") and key.endswith("_URL"):
-                feed_url = value.split(",")[0]
-                if feed_url.startswith("pio+ha://"):
-                    feed_url = "http://" + feed_url[len("pio+ha://"):]
+            if key.startswith("PIO_STORAGE_SOURCES_") and (
+                key.endswith("_URL") or key.endswith("_PARTITIONS")
+            ):
+                if key.endswith("_PARTITIONS"):
+                    value = f"pio+ha://{value}"
+                feed_url = ";".join(partition_primaries(value))
                 break
     if not feed_url:
         raise SystemExit(

@@ -1,0 +1,27 @@
+"""Fixture: robust-unbounded-retry MUST fire on both loops."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def fetch_forever(client):
+    # BAD: no cap, no deadline, no backoff — a dead client pins this
+    # thread at full speed forever
+    while True:
+        try:
+            return client.fetch()
+        except ConnectionError:
+            continue
+
+
+def drain_forever(queue, sink):
+    # BAD: the swallowed handler just logs; the loop re-iterates
+    # immediately against the same failing sink
+    while True:
+        item = queue.peek()
+        try:
+            sink.send(item)
+            queue.pop()
+        except OSError as exc:
+            logger.warning("send failed: %s", exc)

@@ -1,0 +1,49 @@
+"""Fixture: robust-unbounded-retry must NOT fire on any of these."""
+
+import random
+import time
+
+
+def fetch_bounded(client):
+    # clean: attempt cap (a for loop IS the cap) + jittered backoff
+    for attempt in range(5):
+        try:
+            return client.fetch()
+        except ConnectionError:
+            if attempt == 4:
+                raise
+            time.sleep(random.uniform(0.0, 0.05 * 2 ** attempt))
+
+
+def fetch_with_policy(client, policy):
+    # clean: RetryPolicy owns the schedule (bounded, jittered)
+    return policy.call(client.fetch)
+
+
+def fetch_until_deadline(client, deadline):
+    # clean: conditional exit — the deadline check bounds the loop
+    while True:
+        try:
+            return client.fetch()
+        except ConnectionError:
+            if deadline.expired:
+                raise
+
+
+def fetch_reraising(client):
+    # clean: the handler re-raises — no silent re-iteration
+    while True:
+        try:
+            return client.fetch()
+        except ConnectionError:
+            raise
+
+
+def poll_until_stopped(client, stop_event):
+    # clean: a real loop condition is the exit, and the failure path
+    # waits (backoff) instead of spinning
+    while not stop_event.is_set():
+        try:
+            client.poll()
+        except ConnectionError:
+            stop_event.wait(0.5)

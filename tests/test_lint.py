@@ -166,7 +166,7 @@ class TestRobustFixtures:
     @pytest.mark.parametrize(
         "fixture",
         ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
-         "rename_no_fsync_clean.py"],
+         "rename_no_fsync_clean.py", "unbounded_retry_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)
@@ -175,6 +175,22 @@ class TestRobustFixtures:
             f"false positive(s) on clean twin {fixture}: "
             f"{[(f.rule_id, f.line) for f in findings]}"
         )
+
+    def test_unbounded_retry_bad_fires_on_both_loops(self):
+        """The bad twin carries TWO unbounded retry shapes (swallow-and-
+        continue, swallow-and-log); each fires exactly the intended
+        rule at its while line."""
+        path = os.path.join(FIXTURES, "unbounded_retry_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [
+            "robust-unbounded-retry", "robust-unbounded-retry"
+        ], [(f.rule_id, f.line) for f in findings]
+        with open(path) as fh:
+            while_lines = [
+                lineno for lineno, line in enumerate(fh, start=1)
+                if line.strip().startswith("while True")
+            ]
+        assert [f.line for f in findings] == while_lines
 
 
 #: family E/F fixture slug → the one rule its bad twin must trip

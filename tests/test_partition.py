@@ -1,0 +1,903 @@
+"""Partitioned write path (docs/storage.md#partitioning): partition
+math golden vectors, the oplog/changefeed ownership guards, the
+partitioned ``pio+ha://`` client, the event server's partial-outage
+shed, per-partition feed-watcher cursor semantics, the N-partition
+chaos drill, and the PARTS / per-partition-freshness surfaces.
+
+Everything here is storage-plane only — no jax, no training, in-process
+servers on injected state — so the whole file stays cheap against the
+tier-1 budget.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from predictionio_tpu.continuous.watcher import (
+    FeedGap,
+    FeedWatcher,
+    LocalFeed,
+    PartitionedFeedWatcher,
+    make_watcher,
+)
+from predictionio_tpu.storage import MetadataStore, SqliteEventStore
+from predictionio_tpu.storage import remote
+from predictionio_tpu.storage.changefeed import Changefeed, WrongPartition
+from predictionio_tpu.storage.event import Event
+from predictionio_tpu.storage.events import EventFilter
+from predictionio_tpu.storage.model_store import SqliteModelStore
+from predictionio_tpu.storage.oplog import OpLog
+from predictionio_tpu.storage.partition import (
+    PARTITION_SALT,
+    check_partition,
+    partition_for_event,
+    partition_for_key,
+    partition_key,
+    partition_primaries,
+    split_partition_sets,
+)
+from predictionio_tpu.storage.replica import StorageReplica
+from predictionio_tpu.storage.storage_server import StorageServer
+
+
+def _rate(user: str, item: str = "i1", value: float = 4.0) -> Event:
+    from predictionio_tpu.storage import DataMap
+
+    return Event(
+        event="rate", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": value}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# partition math
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionMath:
+    def test_golden_vectors(self):
+        """Exact assignments pinned: changing the salt, the key format
+        or the hash silently would strand every stored event on the
+        wrong primary (the bucket golden-vector discipline, PR 9)."""
+        assert partition_key(1, "u1") == "1|u1"
+        assert partition_for_key(1, "1|u1") == 0  # count=1 short-circuit
+        vectors = {
+            ("1|u1", 2): 0,
+            ("1|u2", 2): 1,
+            ("1|u3", 2): 0,
+            ("1|u1", 4): 2,
+            ("1|u2", 4): 3,
+            ("1|u3", 4): 0,
+            ("2|u2", 4): 0,  # app id is part of the key (≠ 1|u2's 3)
+        }
+        for (key, count), expected in vectors.items():
+            assert partition_for_key(count, key) == expected, (key, count)
+
+    def test_salt_is_not_a_rollout_or_routing_salt(self):
+        # the one-hash design holds only because the salts differ
+        assert PARTITION_SALT not in ("", "routing")
+        from predictionio_tpu.rollout.plan import bucket_for_key
+
+        assert bucket_for_key(PARTITION_SALT, "1|u1") != bucket_for_key(
+            "routing", "1|u1"
+        )
+
+    def test_every_partition_owns_some_keyspace(self):
+        for count in (2, 3, 4):
+            owners = {
+                partition_for_event(count, 1, f"u{i}") for i in range(200)
+            }
+            assert owners == set(range(count))
+
+    def test_url_splitting(self):
+        assert split_partition_sets("http://x:1") == ["http://x:1"]
+        assert split_partition_sets("pio+ha://a:1,b:2") == [
+            "pio+ha://a:1,b:2"
+        ]
+        assert split_partition_sets("pio+ha://a:1,b:2;c:3") == [
+            "pio+ha://a:1,b:2", "pio+ha://c:3"
+        ]
+        assert partition_primaries("pio+ha://a:1,b:2;c:3,d:4") == [
+            "http://a:1", "http://c:3"
+        ]
+        assert partition_primaries("http://x:1/") == ["http://x:1"]
+
+    def test_check_partition(self):
+        check_partition(None, 1, 3)         # undeclared: tolerated
+        check_partition([1, 3], 1, 3)       # match
+        with pytest.raises(ValueError, match="partition mismatch"):
+            check_partition([0, 3], 1, 3)
+        with pytest.raises(ValueError, match="partition mismatch"):
+            check_partition([1, 4], 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# oplog + changefeed ownership guards
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionIdentity:
+    def test_oplog_meta_persists_and_guards_slot(self, tmp_path):
+        log = OpLog(str(tmp_path / "ol"), partition=(1, 3))
+        assert log.partition == [1, 3]
+        assert log.checkpoint()["partition"] == [1, 3]
+        log.close()
+        # reopen with the same slot: fine; different slot: loud
+        OpLog(str(tmp_path / "ol"), partition=(1, 3)).close()
+        with pytest.raises(ValueError, match="partition mismatch"):
+            OpLog(str(tmp_path / "ol"), partition=(2, 3))
+
+    def test_pre_partitioning_log_adopts_declared_slot(self, tmp_path):
+        OpLog(str(tmp_path / "ol")).close()  # legacy: no slot in meta
+        log = OpLog(str(tmp_path / "ol"), partition=(0, 2))
+        assert log.partition == [0, 2]  # upgrade stamped durably
+        log.close()
+        assert OpLog(str(tmp_path / "ol")).partition == [0, 2]
+
+    def test_changefeed_rejects_misrouted_event(self, tmp_path):
+        count = 2
+        index = 0
+        cf = Changefeed(
+            OpLog(str(tmp_path / "ol"), partition=(index, count)),
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        owned = next(
+            f"u{i}" for i in range(50)
+            if partition_for_event(count, 1, f"u{i}") == index
+        )
+        foreign = next(
+            f"u{i}" for i in range(50)
+            if partition_for_event(count, 1, f"u{i}") != index
+        )
+        cf.insert_event(_rate(owned), 1)  # owned key lands
+        with pytest.raises(WrongPartition) as exc_info:
+            cf.insert_event(_rate(foreign), 1)
+        assert exc_info.value.expected != index
+        with pytest.raises(WrongPartition):
+            cf.write_events([_rate(owned), _rate(foreign)], 1, fresh=True)
+        # an unpartitioned feed never checks
+        flat = Changefeed(
+            OpLog(str(tmp_path / "flat")),
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        flat.insert_event(_rate(foreign), 1)
+
+
+# ---------------------------------------------------------------------------
+# live partitioned fleet helpers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def breaker_one():
+    prev = os.environ.get("PIO_BREAKER_FAILURES")
+    os.environ["PIO_BREAKER_FAILURES"] = "1"
+    remote.reset_resilience()
+    yield
+    if prev is None:
+        os.environ.pop("PIO_BREAKER_FAILURES", None)
+    else:
+        os.environ["PIO_BREAKER_FAILURES"] = prev
+    remote.reset_resilience()
+
+
+def _boot_fleet(tmp_path, count: int, replicas: bool = False):
+    servers, reps, sets = [], [], []
+    for i in range(count):
+        server = StorageServer(
+            "127.0.0.1", 0,
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+            changefeed=None, partition=(i, count),
+        )
+        server.changefeed = Changefeed(
+            OpLog(
+                str(tmp_path / f"oplog-{i}"),
+                partition=(i, count) if count > 1 else None,
+            ),
+            server.events, server.metadata, server.models,
+        )
+        server.start_background()
+        servers.append(server)
+        endpoints = f"127.0.0.1:{server.bound_port}"
+        if replicas:
+            rep = StorageReplica(
+                "127.0.0.1", 0,
+                SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+                SqliteModelStore(":memory:"),
+                f"http://127.0.0.1:{server.bound_port}",
+                str(tmp_path / f"rep-{i}"),
+                catchup_wait_s=0.0, partition=(i, count),
+            )
+            rep.start_background()
+            reps.append(rep)
+            endpoints += f",127.0.0.1:{rep.bound_port}"
+        sets.append(endpoints)
+    return servers, reps, "pio+ha://" + ";".join(sets)
+
+
+def _kill_all(servers):
+    for server in servers:
+        try:
+            server.kill()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the partitioned remote client
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionedRemoteStore:
+    def test_routing_reads_and_merge(self, tmp_path, breaker_one):
+        servers, _reps, url = _boot_fleet(tmp_path, 2)
+        try:
+            store = remote.RemoteEventStore(url, timeout=5.0)
+            assert store.partition_count == 2
+            store.init(1)
+            acked = {}
+            for i in range(24):
+                user = f"u{i}"
+                eid = store.insert(_rate(user, value=float(i % 5)), 1)
+                acked[eid] = store.partition_for(1, user)
+            # both partitions took writes, on their own changefeeds
+            per_server = [s.changefeed.last_seq for s in servers]
+            assert all(seq > 1 for seq in per_server)
+            # point reads fan; every acked id readable
+            assert all(store.get(eid, 1) is not None for eid in acked)
+            assert store.get("nope", 1) is None
+            # find merges the per-partition streams back into global
+            # (event_time, event_id) order
+            events = list(store.find(1))
+            assert len(events) == 24
+            keys = [(e.event_time, e.event_id) for e in events]
+            assert keys == sorted(keys)
+            limited = list(store.find(1, EventFilter(limit=5)))
+            assert len(limited) == 5
+            assert [e.event_id for e in limited] == [
+                e.event_id for e in events[:5]
+            ]
+            # columnar scan merges and re-sorts by time
+            cols = store.scan_columnar(1)
+            times = list(cols["event_time_ms"])
+            assert times == sorted(times)
+            assert len(cols["entity_id"]) == 24
+            # batch write groups by partition
+            batch = [_rate(f"b{i}") for i in range(10)]
+            store.write(batch, 1)
+            assert len(list(store.find(1))) == 34
+            # delete fans
+            victim = next(iter(acked))
+            assert store.delete(victim, 1) is True
+            assert store.get(victim, 1) is None
+        finally:
+            _kill_all(servers)
+
+    def test_misrouted_direct_write_answers_409(self, tmp_path, breaker_one):
+        servers, _reps, _url = _boot_fleet(tmp_path, 2)
+        try:
+            direct = remote.RemoteEventStore(
+                f"http://127.0.0.1:{servers[0].bound_port}", timeout=5.0
+            )
+            direct.init(1)
+            foreign = next(
+                f"u{i}" for i in range(50)
+                if partition_for_event(2, 1, f"u{i}") == 1
+            )
+            with pytest.raises(remote.RemoteStorageError) as exc_info:
+                direct.insert(_rate(foreign), 1)
+            assert exc_info.value.code == 409
+            assert "partition" in str(exc_info.value)
+        finally:
+            _kill_all(servers)
+
+    def test_dead_partition_sheds_only_its_keyspace(
+        self, tmp_path, breaker_one
+    ):
+        servers, _reps, url = _boot_fleet(tmp_path, 2)
+        try:
+            store = remote.RemoteEventStore(url, timeout=5.0)
+            store.init(1)
+            servers[1].kill()
+            shed = acked = 0
+            for i in range(20):
+                user = f"u{i}"
+                part = store.partition_for(1, user)
+                try:
+                    store.insert(_rate(user), 1)
+                    acked += 1
+                    assert part == 0, "ack from the dead partition"
+                except remote.PartitionUnavailable as exc:
+                    assert exc.partitions == (1,)
+                    assert part == 1
+                    shed += 1
+            assert acked > 0 and shed > 0
+            rows = store.partition_status()
+            assert [r["up"] for r in rows] == [True, False]
+        finally:
+            _kill_all(servers)
+
+    def test_write_failover_discovers_promoted_replica(
+        self, tmp_path, breaker_one
+    ):
+        servers, reps, url = _boot_fleet(tmp_path, 2, replicas=True)
+        try:
+            store = remote.RemoteEventStore(url, timeout=5.0)
+            store.init(1)
+            for i in range(12):
+                store.insert(_rate(f"u{i}"), 1)
+            for rep in reps:
+                rep.catch_up()
+            servers[1].kill()
+            dead_key = next(
+                f"v{i}" for i in range(50)
+                if store.partition_for(1, f"v{i}") == 1
+            )
+            with pytest.raises(remote.PartitionUnavailable):
+                store.insert(_rate(dead_key), 1)
+            reps[1].promote(str(tmp_path / "promoted-oplog"))
+            # same client, zero reconfiguration: the write path offers
+            # the write to the standbys and the promoted one acks
+            eid = store.insert(_rate(dead_key), 1)
+            assert store.get(eid, 1) is not None
+        finally:
+            _kill_all(servers + reps)
+
+    def test_server_replication_json_rows(self, tmp_path, breaker_one):
+        servers, _reps, _url = _boot_fleet(tmp_path, 2)
+        try:
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", servers[1].bound_port, timeout=5.0
+            )
+            conn.request("GET", "/replication.json")
+            body = json.loads(conn.getresponse().read())
+            conn.close()
+            assert body["partitions"] == [
+                {
+                    "partition": 1, "of": 2, "up": True,
+                    "role": "primary",
+                    "seq": servers[1].changefeed.last_seq,
+                    "generation": servers[1].changefeed.oplog.generation,
+                }
+            ]
+            assert servers[1].status_json()["partition"] == [1, 2]
+        finally:
+            _kill_all(servers)
+
+
+# ---------------------------------------------------------------------------
+# event server: partial-partition degradation
+# ---------------------------------------------------------------------------
+
+
+class TestEventServerPartitionShed:
+    @pytest.fixture
+    def ingest(self, tmp_path, breaker_one):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.storage.metadata import AccessKey, App
+
+        servers, _reps, url = _boot_fleet(tmp_path, 2)
+        store = remote.RemoteEventStore(url, timeout=5.0)
+        store.init(1)
+        md = MetadataStore(":memory:")
+        md.app_insert(App(id=1, name="shed"))
+        md.access_key_insert(AccessKey(key="K", appid=1, events=[]))
+        event_srv = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0),
+            events=store, metadata=md,
+        )
+        event_srv.start_background()
+        yield servers, store, event_srv
+        _kill_all(servers + [event_srv])
+
+    @staticmethod
+    def _post(event_srv, payload, path="/events.json?accessKey=K"):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", event_srv.bound_port, timeout=10.0
+        )
+        try:
+            conn.request(
+                "POST", path, body=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _event_obj(user):
+        return {
+            "event": "rate", "entityType": "user", "entityId": user,
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 4.0},
+        }
+
+    def test_single_insert_sheds_503_with_retry_after(self, ingest):
+        servers, store, event_srv = ingest
+        servers[1].kill()
+        alive = next(
+            f"u{i}" for i in range(50) if store.partition_for(1, f"u{i}") == 0
+        )
+        dead = next(
+            f"u{i}" for i in range(50) if store.partition_for(1, f"u{i}") == 1
+        )
+        status, _headers, _body = self._post(
+            event_srv, self._event_obj(alive)
+        )
+        assert status == 201
+        status, headers, body = self._post(event_srv, self._event_obj(dead))
+        assert status == 503
+        assert int(headers.get("Retry-After", "0")) >= 1
+        assert json.loads(body)["partitions"] == [1]
+        # the shed is counted, per partition, on /metrics
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        samples = parse_text(render(event_srv.metrics)).get(
+            "pio_ingest_partition_shed_total", []
+        )
+        assert [
+            (labels["partition"], value) for labels, value in samples
+        ] == [("1", 1.0)]
+
+    def test_batch_sheds_per_event(self, ingest):
+        servers, store, event_srv = ingest
+        servers[1].kill()
+        users = [f"u{i}" for i in range(12)]
+        status, _headers, body = self._post(
+            event_srv, [self._event_obj(u) for u in users],
+            path="/batches/events.json?accessKey=K",
+        )
+        assert status == 200
+        results = json.loads(body)
+        for user, result in zip(users, results):
+            expected = 201 if store.partition_for(1, user) == 0 else 503
+            assert result["status"] == expected, (user, result)
+        statuses = {r["status"] for r in results}
+        assert statuses == {201, 503}  # a mixed batch made progress
+        # the shed counter advances once per shed EVENT, so batch-heavy
+        # and single-post traffic read identically on the metric
+        shed_events = sum(1 for r in results if r["status"] == 503)
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        samples = parse_text(render(event_srv.metrics)).get(
+            "pio_ingest_partition_shed_total", []
+        )
+        assert [
+            (labels["partition"], value) for labels, value in samples
+        ] == [("1", float(shed_events))]
+
+    def test_replication_json_reports_partition_rows(self, ingest):
+        servers, _store, event_srv = ingest
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", event_srv.bound_port, timeout=5.0
+        )
+        conn.request("GET", "/replication.json")
+        body = json.loads(conn.getresponse().read())
+        conn.close()
+        rows = body["partitions"]
+        assert [r["partition"] for r in rows] == [0, 1]
+        assert all(r["up"] for r in rows)
+        assert all(r["of"] == 2 for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# per-partition cursor semantics (the merged feed watcher)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionedFeedWatcher:
+    def _fleet(self, tmp_path, count=2):
+        """N local (changefeed, feed) pairs + the merged watcher."""
+        feeds, cfs = [], []
+        for i in range(count):
+            cf = Changefeed(
+                OpLog(str(tmp_path / f"ol-{i}"), partition=(i, count)),
+                SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+                SqliteModelStore(":memory:"),
+            )
+            cfs.append(cf)
+            feeds.append(LocalFeed(cf.oplog))
+        watcher = PartitionedFeedWatcher(
+            feeds, 1, {"rate": "rating"}, str(tmp_path / "watch")
+        )
+        return cfs, feeds, watcher
+
+    def _owned_users(self, count, index, n):
+        return [
+            f"u{i}" for i in range(200)
+            if partition_for_event(count, 1, f"u{i}") == index
+        ][:n]
+
+    def test_factory_picks_shape(self, tmp_path):
+        cf = Changefeed(
+            OpLog(str(tmp_path / "f")), SqliteEventStore(":memory:"),
+            MetadataStore(":memory:"), SqliteModelStore(":memory:"),
+        )
+        flat = make_watcher(
+            LocalFeed(cf.oplog), 1, {}, str(tmp_path / "w1")
+        )
+        assert isinstance(flat, FeedWatcher)
+        single = make_watcher(
+            [LocalFeed(cf.oplog)], 1, {}, str(tmp_path / "w2")
+        )
+        assert isinstance(single, FeedWatcher)
+        merged = make_watcher(
+            [LocalFeed(cf.oplog), LocalFeed(cf.oplog)], 1, {},
+            str(tmp_path / "w3"),
+        )
+        assert isinstance(merged, PartitionedFeedWatcher)
+
+    def test_merge_ordering_is_deterministic(self, tmp_path):
+        import datetime as dt
+
+        from predictionio_tpu.storage import DataMap
+
+        cfs, _feeds, watcher = self._fleet(tmp_path)
+        u0 = self._owned_users(2, 0, 3)
+        u1 = self._owned_users(2, 1, 3)
+
+        def rate_at(user, minute):
+            return Event(
+                event="rate", entity_type="user", entity_id=user,
+                target_entity_type="item", target_entity_id="i1",
+                properties=DataMap({"rating": 4.0}),
+                event_time=dt.datetime(
+                    2024, 1, 1, 0, minute, tzinfo=dt.timezone.utc
+                ),
+            )
+
+        # interleaved event times across partitions, including a cross-
+        # partition tie at minute 5 — broken by (partition, seq)
+        cfs[0].insert_event(rate_at(u0[0], 1), 1)
+        cfs[1].insert_event(rate_at(u1[0], 2), 1)
+        cfs[0].insert_event(rate_at(u0[1], 5), 1)
+        cfs[1].insert_event(rate_at(u1[1], 5), 1)
+        cfs[1].insert_event(rate_at(u1[2], 7), 1)
+        cfs[0].insert_event(rate_at(u0[2], 9), 1)
+        watcher.poll()
+        merged_a = [(e.user, e.seq) for e in watcher.take_batch().events]
+        assert [u for u, _s in merged_a] == [
+            u0[0], u1[0], u0[1], u1[1], u1[2], u0[2]
+        ]
+        # a second watcher over the same feeds, polled child-by-child in
+        # REVERSE order, produces the identical merged order: the order
+        # is a function of the consumed ops, not the poll interleaving
+        other = PartitionedFeedWatcher(
+            [LocalFeed(cfs[0].oplog), LocalFeed(cfs[1].oplog)], 1,
+            {"rate": "rating"}, str(tmp_path / "watch2"),
+        )
+        for child in reversed(other.watchers):
+            child.poll()
+        merged_b = [(e.user, e.seq) for e in other.take_batch().events]
+        assert merged_a == merged_b
+
+    def test_commit_is_per_partition_and_durable(self, tmp_path):
+        cfs, _feeds, watcher = self._fleet(tmp_path)
+        for user in self._owned_users(2, 0, 3):
+            cfs[0].insert_event(_rate(user), 1)
+        for user in self._owned_users(2, 1, 2):
+            cfs[1].insert_event(_rate(user), 1)
+        watcher.poll()
+        batch = watcher.take_batch()
+        assert set(batch.upto_seq) == {"0", "1"}
+        watcher.commit(batch.upto_seq)
+        assert watcher.pending_count() == 0
+        # cursor files are independent and durable
+        for i in (0, 1):
+            path = os.path.join(
+                str(tmp_path / "watch"), f"partition-{i}",
+                "continuous_cursor.json",
+            )
+            with open(path) as fh:
+                assert json.load(fh)["seq"] == int(batch.upto_seq[str(i)])
+
+    def test_restart_resumes_never_replays(self, tmp_path):
+        cfs, _feeds, watcher = self._fleet(tmp_path)
+        for user in self._owned_users(2, 0, 3):
+            cfs[0].insert_event(_rate(user), 1)
+        for user in self._owned_users(2, 1, 3):
+            cfs[1].insert_event(_rate(user), 1)
+        watcher.poll()
+        first = watcher.take_batch()
+        watcher.commit(first.upto_seq)
+        committed = {int(k): int(v) for k, v in first.upto_seq.items()}
+        # new events after the commit
+        fresh0 = self._owned_users(2, 0, 5)[3:]
+        for user in fresh0:
+            cfs[0].insert_event(_rate(user), 1)
+        # restart: same cursor dirs, fresh instance
+        resumed = PartitionedFeedWatcher(
+            [LocalFeed(cfs[0].oplog), LocalFeed(cfs[1].oplog)], 1,
+            {"rate": "rating"}, str(tmp_path / "watch"),
+        )
+        resumed.poll()
+        batch = resumed.take_batch()
+        users = {e.user for e in batch.events}
+        assert users == set(fresh0)  # resumed, exactly the suffix
+        for i, child in enumerate(resumed.watchers):
+            child_batch = child.take_batch()
+            if child_batch is not None:
+                assert all(
+                    e.seq > committed[i] for e in child_batch.events
+                )
+
+    def test_single_partition_gap_scopes_resync(self, tmp_path):
+        cfs, feeds, watcher = self._fleet(tmp_path)
+        u0 = self._owned_users(2, 0, 2)
+        u1 = self._owned_users(2, 1, 2)
+        for user in u0:
+            cfs[0].insert_event(_rate(user), 1)
+        for user in u1:
+            cfs[1].insert_event(_rate(user), 1)
+        watcher.poll()
+        assert watcher.pending_count() == 4
+        # partition 1's store is wiped and replaced: new oplog, new
+        # generation, numbering restarted — NOT a continuation
+        replacement = Changefeed(
+            OpLog(str(tmp_path / "ol-1b")),
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        watcher.watchers[1]._feed = LocalFeed(replacement.oplog)
+        with pytest.raises(FeedGap, match=r"partition\(s\) \[1\]"):
+            watcher.poll()
+        # partition 0's pending delta is untouched by the gap
+        assert watcher.watchers[0].pending_count() == 2
+        # a second poll keeps flowing for partition 0 (new event lands)
+        extra = self._owned_users(2, 0, 3)[2:]
+        for user in extra:
+            cfs[0].insert_event(_rate(user), 1)
+        with pytest.raises(FeedGap):
+            watcher.poll()
+        assert watcher.watchers[0].pending_count() == 3
+        # resync: ONLY the gapped partition jumps to its feed head and
+        # drops its pending; partition 0 keeps its uncommitted suffix
+        cursor0_before = watcher.watchers[0].cursor_seq
+        watcher.resync()
+        assert watcher.watchers[0].pending_count() == 3
+        assert watcher.watchers[0].cursor_seq == cursor0_before
+        assert watcher.watchers[1].pending_count() == 0
+        assert (
+            watcher.watchers[1].generation == replacement.oplog.generation
+        )
+        # and the loop is whole again
+        assert watcher.poll() == 0
+
+    def test_shape_mismatch_commits_raise_catchably(self, tmp_path):
+        """A resharding restart can pair a durable per-partition cursor
+        map with a flat watcher (or vice versa). Both mismatches must
+        surface as TypeError — the catchable contract the continuous
+        controller's LIVE path relies on to resync-and-retrain instead
+        of wedging the loop forever."""
+        cfs, _feeds, watcher = self._fleet(tmp_path)
+        with pytest.raises(TypeError):
+            watcher.commit(7)  # int cursor against a partitioned layout
+        flat = FeedWatcher(
+            LocalFeed(cfs[0].oplog), 1, {"rate": "rating"},
+            str(tmp_path / "flat"),
+        )
+        with pytest.raises(TypeError):
+            flat.commit({"0": 7})  # map cursor against a flat layout
+
+    def test_promoted_continuation_adopts_without_gap(self, tmp_path):
+        """A promoted replica CONTINUES the dead primary's numbering:
+        the generation changes but the cursor stays meaningful — the
+        watcher adopts and resumes instead of forcing a retrain."""
+        cf = Changefeed(
+            OpLog(str(tmp_path / "ol")),
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        watcher = FeedWatcher(
+            LocalFeed(cf.oplog), 1, {"rate": "rating"},
+            str(tmp_path / "w"),
+        )
+        for i in range(3):
+            cf.insert_event(_rate(f"u{i}"), 1)
+        watcher.poll()
+        applied = cf.oplog.last_seq
+        old_generation = watcher.generation
+        # failover: a new log continues the numbering (promotion path)
+        promoted = Changefeed(
+            OpLog(str(tmp_path / "promoted"), base_seq=applied),
+            SqliteEventStore(":memory:"), MetadataStore(":memory:"),
+            SqliteModelStore(":memory:"),
+        )
+        watcher._feed = LocalFeed(promoted.oplog)
+        promoted.insert_event(_rate("u9"), 1)
+        assert watcher.poll() == 1  # no FeedGap: continuation adopted
+        assert watcher.generation == promoted.oplog.generation
+        assert watcher.generation != old_generation
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill + ingest scaling (tier-1 acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionChaosDrill:
+    def test_drill_is_green(self):
+        from predictionio_tpu.tools.loadgen import run_partition_chaos
+
+        report = run_partition_chaos(
+            partitions=2, kill_partition=1, ops_per_phase=16,
+            concurrency=2,
+        )
+        assert report["ok"], report
+        assert report["lostAckedWrites"] == 0
+        assert report["failuresOnUnaffected"] == 0
+        assert report["shedOnUnaffected"] == 0
+        assert report["shedOnKilledPartition"] > 0
+        assert report["replicationLagAfterPromote"] == 0
+        assert report["watcherResumeGap"] is None
+        assert report["watcherReplayedCommitted"] == 0
+        assert report["watcherResumeEvents"] > 0
+
+    def test_rejects_bad_arguments(self):
+        from predictionio_tpu.tools.loadgen import run_partition_chaos
+
+        with pytest.raises(ValueError):
+            run_partition_chaos(partitions=1, kill_partition=0)
+        with pytest.raises(ValueError):
+            run_partition_chaos(partitions=2, kill_partition=5)
+
+
+class TestIngestScaling:
+    def test_in_process_shape(self):
+        from predictionio_tpu.tools.loadgen import run_ingest_scaling
+
+        report = run_ingest_scaling(
+            partition_counts=(1, 2), events=24, writers=2,
+            in_process=True,
+        )
+        assert report["ok"], report
+        assert set(report["counts"]) == {"1", "2"}
+        for row in report["counts"].values():
+            assert row["errors"] == 0
+            assert row["ackedQPS"] > 0
+
+    def test_ledger_records_keyed_by_partition_count(self):
+        from predictionio_tpu.obs import perfledger
+
+        bench = {
+            "device": "cpu",
+            "ingestScaling": {
+                "ok": True,
+                "writers": 4,
+                "counts": {
+                    "1": {"ackedQPS": 100.0, "acked": 480},
+                    "2": {"ackedQPS": 180.0, "acked": 480},
+                    "4": {"ackedQPS": 300.0, "acked": 480},
+                },
+            },
+        }
+        records = perfledger.ingest_records(bench)
+        assert [r["metric"] for r in records] == ["ingest_acked_qps"] * 3
+        assert [r["scale"] for r in records] == [1, 2, 4]
+        assert all(r["unit"] == "qps" for r in records)
+        # different partition counts never share a comparable group, so
+        # `pio perf diff` can never gate across N
+        keys = {perfledger.comparable_key(r) for r in records}
+        assert len(keys) == 3
+        # a failed drive records nothing
+        assert perfledger.ingest_records(
+            {"ingestScaling": {"ok": False, "counts": {}}}
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# fleet surfaces: PARTS column + per-partition freshness objectives
+# ---------------------------------------------------------------------------
+
+
+class TestPartsColumn:
+    def test_fleet_columns_grow_parts(self):
+        from predictionio_tpu.obs.top import FLEET_COLUMNS
+
+        assert any(title == "PARTS" for title, _k, _f in FLEET_COLUMNS)
+
+    def test_node_rows_render_parts(self, tmp_path, breaker_one):
+        from predictionio_tpu.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.obs.top import format_row, node_row
+
+        servers, _reps, url = _boot_fleet(tmp_path, 2)
+        event_srv = None
+        try:
+            store = remote.RemoteEventStore(url, timeout=5.0)
+            event_srv = EventServer(
+                EventServerConfig(ip="127.0.0.1", port=0),
+                events=store, metadata=MetadataStore(":memory:"),
+            )
+            event_srv.start_background()
+            ingest_row = node_row(f"127.0.0.1:{event_srv.bound_port}")
+            assert ingest_row["parts"] == "2/2"
+            storage_row = node_row(f"127.0.0.1:{servers[1].bound_port}")
+            assert storage_row["parts"] == "p1/2"
+            # a node without the surface shows '-'
+            assert "-" in format_row({"node": "x", "up": True})
+            servers[0].kill()
+            degraded = node_row(f"127.0.0.1:{event_srv.bound_port}")
+            assert degraded["parts"] == "1/2"
+        finally:
+            _kill_all(servers + ([event_srv] if event_srv else []))
+
+
+class TestPerPartitionFreshness:
+    def _engine(self, objectives):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+        from predictionio_tpu.obs.slo import SLOEngine
+        from predictionio_tpu.testing.clock import FakeClock
+
+        clock = FakeClock()
+        registry = MetricsRegistry(clock=clock)
+        engine = SLOEngine(registry, objectives, clock=clock)
+        return registry, engine, clock
+
+    def _freshness(self):
+        from predictionio_tpu.obs.slo import default_objectives
+
+        objectives = [
+            o for o in default_objectives("storage") if o.name == "freshness"
+        ]
+        assert objectives and objectives[0].per_label == "partition"
+        return objectives
+
+    def test_one_lagging_partition_fires_alone(self):
+        registry, engine, clock = self._engine(self._freshness())
+        gauge = registry.gauge(
+            "pio_replication_lag_ops", "", labelnames=("partition",)
+        )
+        for _ in range(80):
+            gauge.set(2.0, partition="0")
+            gauge.set(50000.0, partition="1")  # way past max_value
+            clock.advance(60.0)
+            summary = engine.evaluate()
+        states = {o["name"]: o["state"] for o in summary["objectives"]}
+        assert states == {"freshness[0]": "OK", "freshness[1]": "FIRING"}
+        # the healthy mean would have hidden it: (2 + 50000)/2 / 10000
+        # barely burns, but the per-partition machine fired regardless
+        assert summary["firing"] == 1
+
+    def test_data_loss_holds_firing_state(self):
+        registry, engine, clock = self._engine(self._freshness())
+        gauge = registry.gauge(
+            "pio_replication_lag_ops", "", labelnames=("partition",)
+        )
+        for _ in range(80):
+            gauge.set(50000.0, partition="1")
+            clock.advance(60.0)
+            engine.evaluate()
+        assert engine.firing() == ["freshness[1]"]
+        # the node stops exporting (scrape loss): the alert HOLDS
+        gauge.set(-1.0, partition="1")  # abstention sentinel
+        clock.advance(60.0)
+        summary = engine.evaluate()
+        states = {o["name"]: o["state"] for o in summary["objectives"]}
+        assert states["freshness[1]"] == "FIRING"
+
+    def test_no_rows_is_visible_abstention(self):
+        _registry, engine, clock = self._engine(self._freshness())
+        clock.advance(60.0)
+        summary = engine.evaluate()
+        assert [
+            (o["name"], o["abstaining"]) for o in summary["objectives"]
+        ] == [("freshness", True)]
