@@ -7,23 +7,40 @@ the router tier that fronts N of them (``docs/fleet.md``):
   replica affinity and fleet-wide canary stickiness (both riding the
   pure ``rollout/plan.py`` SHA-256 bucket split), per-app admission
   quotas, breaker-guarded backend health with retry-on-another-replica,
-  and the sharded-model scatter/gather serving mode.
+  and the sharded-model scatter/gather serving mode with
+  replicas-per-shard failover.
 - :mod:`~predictionio_tpu.fleet.merge` — exact global top-k from
   per-shard top-k candidates (k-way merge on score, ties broken by item
   id for determinism).
+- :mod:`~predictionio_tpu.fleet.cache` — the serving-tier memory
+  hierarchy (``docs/fleet.md#cache``): a bounded LRU+TTL response cache
+  with epoch-checked reads (a cached answer can never outlive the
+  rollout stage or model that produced it) and the single-flight gate
+  that coalesces concurrent identical scatter/gathers.
 
 Like the rollout plane's :mod:`~predictionio_tpu.rollout.plan`, the
-routing arithmetic is pure; the router server itself is stdlib + the
-shared resilience/obs planes — no jax import anywhere in the package,
-so a router node needs no accelerator runtime.
+routing and cache arithmetic is pure; the router server itself is
+stdlib + the shared resilience/obs planes — no jax import anywhere in
+the package, so a router node needs no accelerator runtime.
 """
 
+from .cache import CACHE_HEADER, ResponseCache, SingleFlight, canonical_query
 from .merge import merge_item_scores, merge_predictions
-from .router import RouterConfig, RouterServer, create_router
+from .router import (
+    RouterConfig,
+    RouterServer,
+    ShardUnavailable,
+    create_router,
+)
 
 __all__ = [
+    "CACHE_HEADER",
+    "ResponseCache",
     "RouterConfig",
     "RouterServer",
+    "ShardUnavailable",
+    "SingleFlight",
+    "canonical_query",
     "create_router",
     "merge_item_scores",
     "merge_predictions",

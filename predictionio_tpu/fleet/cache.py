@@ -1,0 +1,292 @@
+"""Router response cache + single-flight: serve from memory, not matmul.
+
+At millions of users the head of the query distribution is Zipfian — the
+same handful of (engine, variant, query) triples arrives over and over —
+so the fastest top-k is the one never recomputed (docs/fleet.md#cache;
+the memory-over-recompute discipline of the ads-serving infrastructure
+in PAPERS.md). This module is the pure, stdlib-only storage half of
+that tier (the ``rollout/plan.py`` discipline: injected clock, no HTTP,
+no jax — testable in isolation):
+
+- :func:`canonical_query` — ONE canonical byte form per logical query,
+  so ``{"user": "u1", "num": 5}`` and ``{"num": 5, "user": "u1"}`` share
+  a cache line.
+- :class:`ResponseCache` — bounded LRU + TTL storage keyed by
+  ``(variant, canonical query)``, every entry stamped with the **epoch**
+  (:func:`~predictionio_tpu.rollout.plan.plan_epoch` + the serving model
+  instance) it was filled under. A lookup whose current epoch disagrees
+  with the entry's drops the entry — a cached answer can never outlive
+  the rollout stage or the model that produced it, *by construction*,
+  not by timer.
+- :class:`SingleFlight` — coalesces concurrent identical calls onto one
+  in-flight execution, so N simultaneous sharded queries for the same
+  key cost ONE scatter/gather instead of N.
+
+The router (:mod:`~predictionio_tpu.fleet.router`) owns the policy:
+when to look up, what the epoch is, and how invalidations surface as
+``pio_router_cache_*`` metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "CACHE_HEADER",
+    "CacheEntry",
+    "ResponseCache",
+    "SingleFlight",
+    "canonical_query",
+]
+
+#: response header naming the router cache's verdict for this request
+#: ("hit" / "miss"; absent when the cache is disabled). Headers only —
+#: the BODY of a hit is byte-identical to the miss that filled it
+#: (docs/fleet.md#cache).
+CACHE_HEADER = "X-PIO-Cache"
+
+#: invalidation reasons — a closed vocabulary, safe as a metric label
+#: (docs/observability.md#metric-catalog): "epoch" = rollout stage /
+#: model swap flush, "ttl" = entry outlived its freshness budget,
+#: "capacity" = LRU eviction at the bound, "explicit" = operator flush.
+INVALIDATION_REASONS = ("epoch", "ttl", "capacity", "explicit")
+
+
+def canonical_query(payload: Any) -> str:
+    """The one canonical string form of a query payload: key-sorted,
+    separator-free JSON — byte-stable across clients that serialize the
+    same logical query differently. Unserializable payloads degrade to
+    ``repr`` (still deterministic within a process; such shapes are
+    exotic enough that a missed cache line beats a wrong shared one)."""
+    try:
+        return json.dumps(
+            payload, sort_keys=True, separators=(",", ":"), default=str
+        )
+    except (TypeError, ValueError):
+        return repr(payload)
+
+
+class CacheEntry:
+    """One cached response: the parsed 200 body, the variant header it
+    was served under, and the epoch it was filled at."""
+
+    __slots__ = ("body", "variant", "epoch", "stored_at")
+
+    def __init__(
+        self, body: Any, variant: Optional[str], epoch: str, stored_at: float
+    ):
+        self.body = body
+        self.variant = variant
+        self.epoch = epoch
+        self.stored_at = stored_at
+
+
+class ResponseCache:
+    """Bounded LRU + TTL response store with epoch-checked reads.
+
+    One lock over one OrderedDict; nothing blocking runs under it (the
+    package's lock discipline). ``on_invalidate(reason, count)`` — when
+    given — is called for every eviction class, so the owner can mirror
+    the counts into labeled metrics without this module importing the
+    metrics plane.
+    """
+
+    def __init__(
+        self,
+        max_entries: int = 2048,
+        ttl_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_invalidate: Optional[Callable[[str, int], None]] = None,
+    ):
+        if max_entries <= 0:
+            raise ValueError("max_entries must be positive (the whole "
+                             "point is a BOUNDED cache)")
+        if ttl_s <= 0:
+            raise ValueError("ttl_s must be positive")
+        self.max_entries = int(max_entries)
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        self._on_invalidate = on_invalidate
+        self._lock = threading.Lock()
+        self._cache: "OrderedDict[Tuple[str, str], CacheEntry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations: Dict[str, int] = {}
+
+    # -- internal ----------------------------------------------------------
+    def _note_invalidation(self, reason: str, count: int) -> None:
+        """Caller holds the lock for the bookkeeping; the owner callback
+        runs OUTSIDE it (callers pass the counts out) — see call sites."""
+        self.invalidations[reason] = self.invalidations.get(reason, 0) + count
+
+    def _emit(self, reason: str, count: int) -> None:
+        if count and self._on_invalidate is not None:
+            try:
+                self._on_invalidate(reason, count)
+            except Exception:
+                pass  # observability must never fail a lookup
+
+    # -- read/write --------------------------------------------------------
+    def get(
+        self, key: Tuple[str, str], epoch: str
+    ) -> Optional[CacheEntry]:
+        """The live entry for ``key`` under the CURRENT ``epoch``, or
+        None. An entry past its TTL or filled under another epoch is
+        dropped on the spot (and counted) — a stale read is never an
+        answer."""
+        dropped: Optional[str] = None
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            if self.clock() - entry.stored_at > self.ttl_s:
+                del self._cache[key]
+                self._note_invalidation("ttl", 1)
+                self.misses += 1
+                dropped = "ttl"
+            elif entry.epoch != epoch:
+                del self._cache[key]
+                self._note_invalidation("epoch", 1)
+                self.misses += 1
+                dropped = "epoch"
+            else:
+                self._cache.move_to_end(key)
+                self.hits += 1
+        if dropped is not None:
+            self._emit(dropped, 1)
+            return None
+        return entry
+
+    def put(
+        self,
+        key: Tuple[str, str],
+        body: Any,
+        variant: Optional[str],
+        epoch: str,
+    ) -> None:
+        """Store one 200 response under the epoch it was computed at.
+        Beyond ``max_entries`` the least-recently-used entry is evicted
+        (counted as a "capacity" invalidation)."""
+        evicted = 0
+        with self._lock:
+            self._cache[key] = CacheEntry(
+                body=body, variant=variant, epoch=epoch,
+                stored_at=self.clock(),
+            )
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+                evicted += 1
+            if evicted:
+                self._note_invalidation("capacity", evicted)
+        self._emit("capacity", evicted)
+
+    def flush(
+        self, variant: Optional[str] = None, reason: str = "epoch"
+    ) -> int:
+        """Drop every entry (or every entry of one ``variant``) and
+        return how many were dropped. The router calls this when the
+        observed epoch moves — a rollout stage change or a model swap
+        flushes the keyspace the moment it is seen, instead of letting
+        each entry die lazily at its next read."""
+        with self._lock:
+            if variant is None:
+                count = len(self._cache)
+                self._cache.clear()
+            else:
+                doomed = [k for k in self._cache if k[0] == variant]
+                for k in doomed:
+                    del self._cache[k]
+                count = len(doomed)
+            if count:
+                self._note_invalidation(reason, count)
+        self._emit(reason, count)
+        return count
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def snapshot(self) -> dict:
+        """The ``/router.json`` cache block."""
+        with self._lock:
+            return {
+                "entries": len(self._cache),
+                "maxEntries": self.max_entries,
+                "ttlS": self.ttl_s,
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": dict(self.invalidations),
+            }
+
+
+class _Flight:
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class SingleFlight:
+    """Coalesce concurrent calls for the same key onto one execution.
+
+    ``do(key, fn)`` → ``(value, shared)``: the first caller for a key
+    becomes the *leader* and runs ``fn``; callers arriving while the
+    leader is in flight wait and receive the leader's result
+    (``shared=True``) without executing anything. The leader's exception
+    propagates to followers too — with one exception: a follower never
+    inherits the leader's *deadline* failure (that was the leader's
+    budget, not the follower's — see the router's 504 discipline), it
+    falls back to its own execution instead. A follower whose own
+    ``timeout_s`` expires first raises :class:`TimeoutError`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: Dict[Any, _Flight] = {}
+
+    def do(
+        self,
+        key: Any,
+        fn: Callable[[], Any],
+        timeout_s: Optional[float] = None,
+        share_error: Callable[[BaseException], bool] = lambda exc: True,
+    ) -> Tuple[Any, bool]:
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = _Flight()
+                self._flights[key] = flight
+                leader = True
+            else:
+                leader = False
+        if leader:
+            try:
+                flight.value = fn()
+            except BaseException as exc:
+                flight.error = exc
+                raise
+            finally:
+                with self._lock:
+                    self._flights.pop(key, None)
+                flight.done.set()
+            return flight.value, False
+        if not flight.done.wait(timeout_s):
+            raise TimeoutError(
+                "coalesced request timed out waiting for the in-flight leg"
+            )
+        if flight.error is not None:
+            if share_error(flight.error):
+                raise flight.error
+            # the leader's failure was caller-specific (e.g. ITS deadline
+            # expired) — run our own leg rather than inherit it
+            return fn(), False
+        return flight.value, True

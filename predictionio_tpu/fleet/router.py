@@ -42,6 +42,8 @@ import dataclasses
 import http.client
 import json
 import logging
+import os
+import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -53,6 +55,7 @@ from ..rollout.plan import (
     BASELINE,
     VARIANT_HEADER,
     bucket_for_key,
+    plan_epoch,
     sticky_key,
     variant_for_key,
 )
@@ -63,16 +66,19 @@ from ..utils.resilience import (
     Deadline,
     DeadlineExceeded,
 )
+from .cache import CACHE_HEADER, ResponseCache, SingleFlight, canonical_query
 from .merge import merge_predictions
 
 logger = logging.getLogger(__name__)
 
 __all__ = [
     "APP_HEADER",
+    "CACHE_HEADER",
     "VARIANT_HEADER",
     "RouterBadRequest",
     "RouterConfig",
     "RouterServer",
+    "ShardUnavailable",
     "create_router",
 ]
 
@@ -91,6 +97,23 @@ class FleetOverloaded(RuntimeError):
     def __init__(self, message: str, retry_after_s: int = 1):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class ShardUnavailable(RuntimeError):
+    """A sharded read lost at least one whole shard — every replica of
+    it dead or shedding — so an exact merge is impossible. Surfaces as
+    502 NAMING the shard index(es): "3 shards failed" tells an operator
+    nothing, "shard 1 has no live replica" names the keyspace to heal
+    (docs/fleet.md#failure-modes)."""
+
+    def __init__(self, shards: Sequence[int], detail: str):
+        self.shards = tuple(shards)
+        noun = "shard" if len(self.shards) == 1 else "shards"
+        ids = ", ".join(str(s) for s in self.shards)
+        super().__init__(
+            f"{noun} {ids}: no live replica answered ({detail})"
+        )
+
 
 #: app identity a quota is keyed on; absent header = the "-" default app
 APP_HEADER = "X-PIO-App"
@@ -116,6 +139,12 @@ class RouterConfig:
     #: backend holds one item-factor partition; queries fan out to all
     #: and merge.
     sharded: bool = False
+    #: with ``sharded``: each shard is served by this many consecutive
+    #: backends (backend i serves shard i // replicas_per_shard), and a
+    #: shard leg fails over inside its replica group exactly like the
+    #: replicated mode does across the whole ring — a sharded fleet
+    #: survives a backend kill (docs/fleet.md#replicas-per-shard)
+    replicas_per_shard: int = 1
     #: per-app in-flight caps ({app: max}); apps not listed fall back to
     #: ``default_quota`` (0 = unbounded)
     quotas: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -142,8 +171,33 @@ class RouterConfig:
     engine_id: Optional[str] = None
     engine_version: Optional[str] = None
     engine_variant: str = "engine.json"
-    #: seconds an active-plan read is cached before re-reading metadata
+    #: seconds an active-plan read is cached before re-reading metadata.
+    #: Also the response cache's invalidation-observation cadence: an
+    #: epoch change (rollout stage / model swap) is seen at most this
+    #: long after the durable write (0 = re-read every request)
     plan_refresh_s: float = 2.0
+    #: router response cache (docs/fleet.md#cache). Tri-state like the
+    #: PR-12 kernel levers: None resolves from PIO_ROUTER_CACHE
+    #: (default ON — serve from memory is the point); explicit
+    #: False/True overrides the env
+    cache_enabled: Optional[bool] = None
+    #: LRU bound; None resolves from PIO_ROUTER_CACHE_MAX (default 2048)
+    cache_max_entries: Optional[int] = None
+    #: per-entry freshness budget; None resolves from
+    #: PIO_ROUTER_CACHE_TTL_S (default 30 s). The TTL is a *backstop* —
+    #: correctness comes from epoch invalidation, the TTL just bounds
+    #: staleness against signals the epoch cannot see
+    cache_ttl_s: Optional[float] = None
+    #: coalesce concurrent identical sharded fan-outs onto one in-flight
+    #: scatter/gather; None resolves from PIO_ROUTER_COALESCE
+    #: (default ON in sharded mode)
+    coalesce: Optional[bool] = None
+    #: long-lived fan-out worker threads per shard whose keep-alive
+    #: connections distinct concurrent queries share; beyond the bound a
+    #: leg spills to an ephemeral thread (never head-of-line blocks).
+    #: None resolves from PIO_ROUTER_LEG_WORKERS (default 2);
+    #: 0 = per-request threads only (the pre-cache behavior)
+    leg_workers: Optional[int] = None
 
 
 class _RouterHandler(JsonHTTPHandler):
@@ -172,17 +226,23 @@ class _RouterHandler(JsonHTTPHandler):
         try:
             if deadline is not None:
                 deadline.check("router-admission")
+            info: Dict[str, str] = {}
             with self.server.tracer.server_span(
                 "POST /queries.json",
                 header_value=self.headers.get(TRACE_HEADER),
                 tags={"router": "1"},
             ) as span:
                 status, body, variant = self.server.route_query(
-                    raw, deadline, trace_id=span.trace_id
+                    raw, deadline, trace_id=span.trace_id, info=info
                 )
             headers = {TRACE_HEADER: span.trace_id}
             if variant is not None:
                 headers[VARIANT_HEADER] = variant
+            if info.get("cache"):
+                # hit bodies are byte-identical to the miss that filled
+                # them; only the trace id (above) and this verdict
+                # header differ (docs/fleet.md#cache)
+                headers[CACHE_HEADER] = info["cache"]
             self.server.count_request("ok" if status == 200 else "error")
             self.respond(status, body, headers=headers)
         except DeadlineExceeded as exc:
@@ -222,6 +282,109 @@ class _RouterHandler(JsonHTTPHandler):
             self.respond(404, {"message": "Not Found"})
 
 
+class _CountDownLatch:
+    """Join point for a scatter/gather round: ``wait`` returns once
+    every leg has counted down, whichever thread (pool worker or
+    ephemeral) ran it."""
+
+    def __init__(self, count: int):
+        self._count = count
+        self._cond = threading.Condition()
+
+    def count_down(self) -> None:
+        with self._cond:
+            self._count -= 1
+            if self._count <= 0:
+                self._cond.notify_all()
+
+    def wait(self) -> None:
+        with self._cond:
+            while self._count > 0:
+                self._cond.wait()
+
+
+class _ShardLegPool:
+    """Bounded long-lived leg workers for ONE shard: distinct concurrent
+    queries share the workers' keep-alive connections instead of paying
+    a fresh socket per fan-out leg. Admission-aware — a leg arriving
+    while every worker may already be occupied (``unfinished_tasks >=
+    workers``) spills to an ephemeral thread instead of queueing, so
+    the pool can never head-of-line-block a request behind another
+    request's slowest leg (the failure mode that kept PR 9 on
+    per-request threads)."""
+
+    _STOP = object()
+
+    def __init__(
+        self,
+        name: str,
+        workers: int,
+        on_thread_exit: Callable[[], None],
+    ):
+        self.name = name
+        self.workers = max(1, workers)
+        self._on_thread_exit = on_thread_exit
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def submit(self, task: Callable[[], None]) -> None:
+        with self._lock:
+            if not self._stopped and not self._threads:
+                # lazy start: a router that never fans out (unit tests,
+                # replicated mode misconfig) spawns nothing
+                for i in range(self.workers):
+                    t = threading.Thread(
+                        target=self._run, daemon=True,
+                        name=f"router-{self.name}-leg{i}",
+                    )
+                    t.start()
+                    self._threads.append(t)
+            # spill the moment every worker could be busy: a leg must
+            # never QUEUE behind another request's slow leg (queued =
+            # head-of-line blocked while its deadline ticks); the pool
+            # only buys connection reuse for legs a worker can take now
+            spill = (
+                self._stopped
+                or self._q.unfinished_tasks >= self.workers
+            )
+        if spill:
+            threading.Thread(
+                target=self._spill_run, args=(task,), daemon=True,
+                name=f"router-{self.name}-spill",
+            ).start()
+        else:
+            self._q.put(task)
+
+    def _spill_run(self, task: Callable[[], None]) -> None:
+        try:
+            task()
+        finally:
+            self._on_thread_exit()
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is self._STOP:
+                    return
+                task()
+            finally:
+                self._q.task_done()
+                if task is self._STOP:
+                    self._on_thread_exit()
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            threads = list(self._threads)
+        for _ in threads:
+            self._q.put(self._STOP)
+
+
 class RouterServer(BackgroundHTTPServer):
     """The router process: stateless but for quota counters, breaker
     state and the cached plan read — everything a replica needs to agree
@@ -235,10 +398,37 @@ class RouterServer(BackgroundHTTPServer):
     ):
         if not config.backends:
             raise ValueError("router needs at least one backend (host:port)")
+        if config.replicas_per_shard < 1:
+            raise ValueError("replicas-per-shard must be >= 1")
+        if config.replicas_per_shard > 1 and not config.sharded:
+            raise ValueError(
+                "replicas-per-shard only applies to --sharded (replicated "
+                "mode already treats every backend as a replica)"
+            )
+        if config.sharded and (
+            len(config.backends) % config.replicas_per_shard
+        ):
+            raise ValueError(
+                f"{len(config.backends)} backends do not divide into "
+                f"replica groups of {config.replicas_per_shard}: backend i "
+                "serves shard i // replicas_per_shard, so the list length "
+                "must be shard_count * replicas_per_shard"
+            )
         self.config = config
         self.registry = registry
         self.clock = clock
         self.backends: Tuple[str, ...] = tuple(config.backends)
+        #: number of model partitions the fleet serves (sharded mode)
+        self.shard_count = (
+            len(self.backends) // config.replicas_per_shard
+            if config.sharded
+            else 1
+        )
+        # dead-shard metric labels, minted once from config: a closed
+        # 0..shard_count-1 vocabulary, never interpolated per request
+        self._shard_labels = tuple(
+            f"shard-{i}" for i in range(self.shard_count)
+        )
         # one breaker per backend: health is judged per process, and an
         # open breaker takes the backend out of the rotation until its
         # cooldown admits a probe
@@ -259,10 +449,53 @@ class RouterServer(BackgroundHTTPServer):
             if config.engine_id
             else None
         )
+        self._epoch: Optional[str] = None
         # per-(worker thread, backend) persistent connections: handler
         # and fan-out threads each keep their own socket per backend, so
         # keep-alive reuse never interleaves two requests on one socket
         self._conns = threading.local()
+
+        # -- the serving-tier memory hierarchy (docs/fleet.md#cache) ------
+        # tri-state resolution, PR-12 lever style: explicit config wins,
+        # else env, else the fast default (ON)
+        cache_on = config.cache_enabled
+        if cache_on is None:
+            cache_on = os.environ.get("PIO_ROUTER_CACHE", "1") != "0"
+        max_entries = config.cache_max_entries
+        if max_entries is None:
+            max_entries = int(os.environ.get("PIO_ROUTER_CACHE_MAX", "2048"))
+        ttl_s = config.cache_ttl_s
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("PIO_ROUTER_CACHE_TTL_S", "30"))
+        self._cache: Optional[ResponseCache] = (
+            ResponseCache(
+                max_entries=max_entries,
+                ttl_s=ttl_s,
+                clock=clock,
+                on_invalidate=self._count_invalidation,
+            )
+            if cache_on and max_entries > 0 and ttl_s > 0
+            else None
+        )
+        coalesce = config.coalesce
+        if coalesce is None:
+            coalesce = os.environ.get("PIO_ROUTER_COALESCE", "1") != "0"
+        self._singleflight: Optional[SingleFlight] = (
+            SingleFlight() if (coalesce and config.sharded) else None
+        )
+        leg_workers = config.leg_workers
+        if leg_workers is None:
+            leg_workers = int(os.environ.get("PIO_ROUTER_LEG_WORKERS", "2"))
+        self._leg_pools: Dict[int, _ShardLegPool] = (
+            {
+                shard: _ShardLegPool(
+                    f"shard{shard}", leg_workers, self._close_thread_conns
+                )
+                for shard in range(self.shard_count)
+            }
+            if config.sharded and leg_workers > 0
+            else {}
+        )
 
         metrics_clock = clock
         from ..obs.metrics import MetricsRegistry
@@ -296,6 +529,30 @@ class RouterServer(BackgroundHTTPServer):
             "pio_router_variant_mismatch_total",
             "Requests whose backend variant disagreed with the router's "
             "own pure-function assignment (must stay 0)",
+        )
+        self._cache_hits = metrics.counter(
+            "pio_router_cache_hits_total",
+            "Queries answered from the router response cache",
+        )
+        self._cache_misses = metrics.counter(
+            "pio_router_cache_misses_total",
+            "Cache lookups that went to the backends",
+        )
+        self._cache_invalidations = metrics.counter(
+            "pio_router_cache_invalidations_total",
+            "Cache entries dropped, by reason (epoch = rollout/model "
+            "swap flush, ttl, capacity, explicit)",
+            labelnames=("reason",),
+        )
+        self._coalesced = metrics.counter(
+            "pio_router_coalesced_total",
+            "Sharded fan-outs answered by joining another request's "
+            "in-flight scatter/gather (single-flight)",
+        )
+        metrics.gauge_callback(
+            "pio_router_cache_entries",
+            lambda: len(self._cache) if self._cache is not None else 0,
+            "Live entries in the router response cache",
         )
         metrics.gauge_callback(
             "pio_router_backends_up",
@@ -346,6 +603,9 @@ class RouterServer(BackgroundHTTPServer):
     def count_shed(self, app: str) -> None:
         self._shed.inc(1, app=app)
 
+    def _count_invalidation(self, reason: str, count: int) -> None:
+        self._cache_invalidations.inc(count, reason=reason)
+
     def observe_latency(self, elapsed_s: float) -> None:
         self._hist.observe(max(0.0, elapsed_s))
 
@@ -362,7 +622,17 @@ class RouterServer(BackgroundHTTPServer):
         ``rollout_plan_get_active`` read, cached ``plan_refresh_s``.
         Any failure (no registry, metadata outage, unknown engine)
         degrades to None — the consistency check is an alarm, never a
-        serving dependency."""
+        serving dependency.
+
+        Every refresh also derives the CACHE EPOCH — ``plan_epoch``
+        over the active plan plus the latest completed instance id for
+        the engine key — and an observed epoch move flushes the response
+        cache on the spot (docs/fleet.md#cache): a rollout stage change
+        or a model swap (a new instance landing through the continuous
+        plane and replicated metadata) invalidates within one
+        ``plan_refresh_s`` of the durable write. Reads that cannot
+        complete keep the PRIOR epoch: "metadata unreachable" must not
+        flap the epoch and stampede the backends with a cold cache."""
         if self.registry is None:
             return None
         with self._lock:
@@ -375,21 +645,47 @@ class RouterServer(BackgroundHTTPServer):
                 return self._plan
             engine_key = self._engine_key
         plan = None
+        epoch: Optional[str] = None
         try:
             md = self.registry.get_metadata()
             if engine_key is None:
                 engine_key = self._discover_engine_key(md)
             if engine_key is not None:
                 plan = md.rollout_plan_get_active(*engine_key)
+                latest = md.engine_instance_get_latest_completed(*engine_key)
+                epoch = plan_epoch(plan) + "#" + (
+                    latest.id if latest is not None else "-"
+                )
         except Exception:
             logger.debug("router plan read failed", exc_info=True)
             plan = None
+            epoch = None
+        flush_from: Optional[str] = None
         with self._lock:
             self._plan = plan
             self._plan_read_at = self.clock()
             if engine_key is not None:
                 self._engine_key = engine_key
+            if epoch is not None:
+                if self._epoch is not None and self._epoch != epoch:
+                    flush_from = self._epoch
+                self._epoch = epoch
+        if flush_from is not None and self._cache is not None:
+            dropped = self._cache.flush(reason="epoch")
+            logger.info(
+                "rollout/model epoch moved; flushed %d cached responses",
+                dropped,
+            )
         return plan
+
+    def current_epoch(self) -> str:
+        """The epoch cache entries are stamped/validated with. Refreshes
+        through :meth:`active_plan` on the same cadence; "-" without a
+        registry (TTL is then the only staleness bound — documented in
+        docs/fleet.md#cache)."""
+        self.active_plan()
+        with self._lock:
+            return self._epoch or "-"
 
     def _discover_engine_key(self, md) -> Optional[Tuple[str, str, str]]:
         """Without an explicit --engine-id, mirror whatever engine the
@@ -421,13 +717,47 @@ class RouterServer(BackgroundHTTPServer):
         raw: bytes,
         deadline: Optional[Deadline],
         trace_id: Optional[str] = None,
+        info: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Any, Optional[str]]:
         """One client request end to end → ``(status, body, variant)``.
-        Raises DeadlineExceeded/ValueError for the handler's 504/400."""
+        Raises DeadlineExceeded/ValueError for the handler's 504/400.
+        ``info`` (when given) reports the cache verdict for the
+        ``X-PIO-Cache`` response header.
+
+        The memory hierarchy, in order (docs/fleet.md#cache): the
+        response cache answers a hit without touching a backend (body
+        byte-identical to the miss that filled it, variant still
+        verified); a sharded miss coalesces onto any identical scatter/
+        gather already in flight; only then does a fresh fan-out run."""
         try:
             payload = json.loads(raw.decode("utf-8")) if raw else {}
         except ValueError as exc:
             raise RouterBadRequest(f"invalid query JSON: {exc}") from exc
+        qkey: Optional[Tuple[str, str]] = None
+        epoch = "-"
+        expected: Optional[str] = ""  # "" = not computed (recompute later)
+        if self._cache is not None or self._singleflight is not None:
+            # ONE key for both tiers: the router's own pure-function
+            # variant assignment ("-" when no plan routes traffic) over
+            # the canonical byte form of the query
+            expected = self.variant_preview(payload)
+            qkey = (expected or "-", canonical_query(payload))
+        if self._cache is not None and qkey is not None:
+            epoch = self.current_epoch()
+            entry = self._cache.get(qkey, epoch)
+            if entry is not None:
+                self._cache_hits.inc(1)
+                if info is not None:
+                    info["cache"] = "hit"
+                # a hit still verifies the variant contract: the entry
+                # was served under some variant, and the router's own
+                # assignment must still agree with it (reusing the
+                # assignment the key was built from — no second read)
+                self._check_variant(payload, entry.variant, expected)
+                return 200, entry.body, entry.variant
+            self._cache_misses.inc(1)
+            if info is not None:
+                info["cache"] = "miss"
         # stall watchdog (docs/slo.md): a routed request that outlives a
         # multiple of its budget — every failover leg wedged — is a
         # fleet-level stall worth a flight dump
@@ -444,8 +774,8 @@ class RouterServer(BackgroundHTTPServer):
         )
         try:
             if self.config.sharded:
-                status, body, variant = self._route_sharded(
-                    raw, payload, deadline, trace_id
+                status, body, variant = self._sharded_singleflight(
+                    qkey, raw, payload, deadline, trace_id
                 )
             else:
                 status, body, variant = self._route_replicated(
@@ -455,11 +785,64 @@ class RouterServer(BackgroundHTTPServer):
             if watchdog is not None:
                 watchdog.exit(token)
         if status == 200:
-            self._check_variant(payload, variant)
+            self._check_variant(payload, variant, expected)
+            if self._cache is not None and qkey is not None:
+                # filled under the epoch observed BEFORE the backend
+                # call: if the plan moved mid-request, the very next
+                # refresh observes the new epoch and drops this entry
+                self._cache.put(qkey, body, variant, epoch)
         return status, body, variant
 
-    def _check_variant(self, payload: Any, served: Optional[str]) -> None:
-        expected = self.variant_preview(payload)
+    def _sharded_singleflight(
+        self,
+        qkey: Optional[Tuple[str, str]],
+        raw: bytes,
+        payload: Any,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+    ) -> Tuple[int, Any, Optional[str]]:
+        """Sharded dispatch behind the single-flight gate: concurrent
+        identical queries share ONE in-flight scatter/gather. Followers
+        never inherit a leader's *deadline* failure (that was its
+        budget, not theirs) — they fall back to their own fan-out."""
+
+        def scatter() -> Tuple[int, Any, Optional[str]]:
+            return self._route_sharded(raw, payload, deadline, trace_id)
+
+        if self._singleflight is None or qkey is None:
+            return scatter()
+        try:
+            result, shared = self._singleflight.do(
+                qkey,
+                scatter,
+                timeout_s=(
+                    deadline.remaining_s() if deadline is not None else None
+                ),
+                share_error=lambda exc: not isinstance(
+                    exc, DeadlineExceeded
+                ),
+            )
+        except TimeoutError:
+            raise DeadlineExceeded(
+                "deadline exceeded waiting for the coalesced fan-out",
+                stage="router-coalesce",
+            ) from None
+        if shared:
+            self._coalesced.inc(1)
+        return result
+
+    def _check_variant(
+        self,
+        payload: Any,
+        served: Optional[str],
+        expected: Optional[str] = "",
+    ) -> None:
+        """``expected=""`` (the default sentinel) recomputes the
+        router's own assignment; callers that already computed it for
+        the cache key pass it through — the hot hit path must not pay
+        a second plan read + bucket hash."""
+        if expected == "":
+            expected = self.variant_preview(payload)
         if expected is None or served in (None, "", "-"):
             return  # no active plan, or a backend predating the header
         if served != expected:
@@ -551,6 +934,26 @@ class RouterServer(BackgroundHTTPServer):
             f"{last_error or 'all breakers open'}"
         )
 
+    def _shard_replicas(self, shard: int) -> Tuple[str, ...]:
+        """The consecutive backend group serving ``shard``."""
+        r = self.config.replicas_per_shard
+        return self.backends[shard * r:(shard + 1) * r]
+
+    def _ordered_shard_replicas(self, shard: int, key: str) -> List[str]:
+        """Affinity-first rotation WITHIN one shard's replica group —
+        the replicated mode's ring discipline, scoped to the shard: the
+        sticky bucket picks the home replica, failover walks the rest,
+        open breakers leave the rotation (but an all-open group still
+        tries the ring — before_call re-checks cooldowns properly)."""
+        replicas = self._shard_replicas(shard)
+        start = bucket_for_key(self.config.routing_salt, key) % len(replicas)
+        ring = list(replicas[start:] + replicas[:start])
+        admitting = [
+            b for b in ring
+            if self.breakers[b].state != CircuitBreaker.OPEN
+        ]
+        return admitting or ring
+
     def _route_sharded(
         self,
         raw: bytes,
@@ -558,59 +961,86 @@ class RouterServer(BackgroundHTTPServer):
         deadline: Optional[Deadline],
         trace_id: Optional[str],
     ) -> Tuple[int, Any, Optional[str]]:
-        """Scatter to every shard, gather, merge exactly. All legs run
+        """Scatter to every shard, gather, merge exactly. Shard legs run
         concurrently, each under the full remaining budget (they are
-        parallel — splitting it would punish fan-out width). Legs get
-        per-request threads, not a shared pool: a pool sized to the
-        shard count would serialize concurrent client requests behind
-        each other's slowest leg (head-of-line blocking — one backend
-        stalling to its socket timeout would inflate every queued
-        request). ThreadingHTTPServer already spawns per connection;
-        N short-lived leg threads per request is the same discipline."""
-        results: List = [None] * len(self.backends)
+        parallel — splitting across shards would punish fan-out width);
+        WITHIN a shard a leg fails over across the replica group
+        sequentially, splitting its budget like the replicated mode.
 
-        def run_leg(idx: int, backend: str) -> None:
+        Legs run on the per-shard worker pools when configured
+        (``leg_workers``): a few long-lived threads per shard whose
+        keep-alive connections distinct concurrent queries share —
+        admission-aware, because a leg arriving while the pool's backlog
+        exceeds its bound spills to an ephemeral thread instead of
+        queueing behind a slow leg (the PR-9 head-of-line lesson: a
+        fixed pool must never serialize requests behind each other's
+        slowest leg)."""
+        results: List = [None] * self.shard_count
+        key = sticky_key(payload)
+        latch = _CountDownLatch(self.shard_count)
+
+        def run_leg(shard: int) -> None:
             try:
-                results[idx] = self._shard_leg(
-                    backend, raw, deadline, trace_id
+                results[shard] = self._shard_leg(
+                    shard, key, raw, deadline, trace_id
                 )
+            except Exception as exc:  # belt: a leg never leaves a hole
+                results[shard] = ("dead", (f"leg failed: {exc}", False))
             finally:
-                # ephemeral thread: its thread-local conns die with it —
-                # close deterministically instead of leaking the socket
-                # to GC (TIME_WAIT/fd churn under sustained fan-out)
-                self._close_thread_conns()
+                latch.count_down()
 
-        threads = [
-            threading.Thread(
-                target=run_leg, args=(i, b), daemon=True,
-                name=f"router-leg-{i}",
-            )
-            for i, b in enumerate(self.backends)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        for shard in range(self.shard_count):
+            pool = self._leg_pools.get(shard)
+            if pool is not None:
+                pool.submit(lambda s=shard: run_leg(s))
+            else:
+                threading.Thread(
+                    target=self._ephemeral_leg, args=(run_leg, shard),
+                    daemon=True, name=f"router-leg-{shard}",
+                ).start()
+        latch.wait()
         bodies: List[Any] = []
         variant: Optional[str] = None
-        errors: List[str] = []
-        for backend, (ok, value, leg_variant) in zip(
-            self.backends, results
-        ):
-            if ok:
-                bodies.append(value)
+        dead: List[int] = []
+        details: List[str] = []
+        all_dead_shed = True
+        for shard, (kind, value) in enumerate(results):
+            if kind == "ok":
+                status, body, leg_variant = value
+                if status != 200:
+                    # a non-retryable backend answer (expired client
+                    # deadline, 4xx) passes through like the replicated
+                    # mode — it is the client's outcome, not shard death
+                    return status, body, leg_variant
+                bodies.append(body)
                 if variant is None:
                     variant = leg_variant
             else:
-                errors.append(f"{backend}: {value}")
-        if errors:
+                errors, all_shed = value
+                dead.append(shard)
+                details.append(f"shard {shard}: {errors}")
+                all_dead_shed = all_dead_shed and all_shed
+        if dead:
+            if all_dead_shed:
+                # every replica of every failed shard answered 503:
+                # fleet backpressure, not shard death — relay the shed
+                # so well-behaved clients back off (the replicated
+                # mode's FleetOverloaded discipline)
+                raise FleetOverloaded(
+                    "sharded read shed: every replica of "
+                    + ", ".join(f"shard {s}" for s in dead)
+                    + " is shedding load"
+                )
             # a missing shard makes an exact merge impossible: fail the
-            # read loudly instead of returning a silently truncated
-            # catalog (docs/fleet.md#failure-modes)
-            raise RuntimeError(
-                f"{len(errors)}/{len(self.backends)} shards failed: "
-                + "; ".join(errors)
-            )
+            # read loudly — NAMING the shard — instead of returning a
+            # silently truncated catalog (docs/fleet.md#failure-modes),
+            # and count the dead-shard kind distinctly from ordinary
+            # per-backend errors
+            for shard in dead:
+                self._backend_events.inc(
+                    1, backend=self._shard_labels[shard], kind="dead_shard"
+                )
+            raise ShardUnavailable(dead, "; ".join(details))
         k = payload.get("num") if isinstance(payload, dict) else None
         if not isinstance(k, int):
             # the engine's query class filled its default on every shard
@@ -620,33 +1050,74 @@ class RouterServer(BackgroundHTTPServer):
         merged = merge_predictions(bodies, k)
         return 200, merged, variant
 
+    def _ephemeral_leg(self, run_leg, shard: int) -> None:
+        try:
+            run_leg(shard)
+        finally:
+            # ephemeral thread: its thread-local conns die with it —
+            # close deterministically instead of leaking the socket
+            # to GC (TIME_WAIT/fd churn under sustained fan-out)
+            self._close_thread_conns()
+
     def _shard_leg(
         self,
-        backend: str,
+        shard: int,
+        key: str,
         raw: bytes,
         deadline: Optional[Deadline],
         trace_id: Optional[str],
-    ) -> Tuple[bool, Any, Optional[str]]:
-        """One shard fan-out leg (pool thread) → (ok, body|error, variant)."""
-        breaker = self.breakers[backend]
-        try:
-            breaker.before_call()
-        except CircuitOpen as exc:
-            self._backend_events.inc(1, backend=backend, kind="open_skip")
-            return False, str(exc), None
-        try:
-            status, body, headers = self._leg(
-                backend, raw, deadline, 1, trace_id
-            )
-            if status != 200:
-                raise RuntimeError(f"HTTP {status}")
-        except Exception as exc:
-            breaker.record_failure()
-            self._backend_events.inc(1, backend=backend, kind="error")
-            return False, str(exc), None
-        breaker.record_success()
-        self._backend_events.inc(1, backend=backend, kind="ok")
-        return True, body, headers.get(VARIANT_HEADER.lower())
+    ) -> Tuple[str, Any]:
+        """One shard's fan-out leg: try the shard's replicas
+        affinity-first, failing a dead, erroring or shedding replica
+        over to the next — the SAME status discipline as the replicated
+        ring, applied inside the replica group: 503/5xx fail over and
+        trip the breaker; 504 does NEITHER (an expired deadline is the
+        client's budget, not backend sickness) and non-retryable
+        answers (504, 4xx) pass through. Returns
+        ``("ok", (status, body, variant))`` or
+        ``("dead", (error detail, all_replicas_shed))``."""
+        replicas = self._ordered_shard_replicas(shard, key)
+        errors: List[str] = []
+        all_shed = bool(replicas)
+        for i, backend in enumerate(replicas):
+            if deadline is not None:
+                deadline.check("shard-retry")
+            attempts_left = len(replicas) - i
+            breaker = self.breakers[backend]
+            try:
+                breaker.before_call()
+            except CircuitOpen as exc:
+                self._backend_events.inc(
+                    1, backend=backend, kind="open_skip"
+                )
+                errors.append(f"{backend}: {exc}")
+                all_shed = False
+                continue
+            try:
+                status, body, headers = self._leg(
+                    backend, raw, deadline, attempts_left, trace_id
+                )
+            except Exception as exc:
+                breaker.record_failure()
+                self._backend_events.inc(1, backend=backend, kind="error")
+                if i + 1 < len(replicas):
+                    self._retries.inc(1, backend=backend)
+                errors.append(f"{backend}: {exc}")
+                all_shed = False
+                continue
+            if status == 503 or (status >= 500 and status != 504):
+                breaker.record_failure()
+                self._backend_events.inc(1, backend=backend, kind="error")
+                if i + 1 < len(replicas):
+                    self._retries.inc(1, backend=backend)
+                errors.append(f"{backend}: HTTP {status}")
+                if status != 503:
+                    all_shed = False
+                continue
+            breaker.record_success()
+            self._backend_events.inc(1, backend=backend, kind="ok")
+            return "ok", (status, body, headers.get(VARIANT_HEADER.lower()))
+        return "dead", ("; ".join(errors) or "no replica configured", all_shed)
 
     # -- one backend leg --------------------------------------------------
     def _leg_timeout(
@@ -740,6 +1211,11 @@ class RouterServer(BackgroundHTTPServer):
                 pass
         pool.clear()
 
+    def server_close(self) -> None:
+        for pool in self._leg_pools.values():
+            pool.stop()
+        super().server_close()
+
     # -- status -----------------------------------------------------------
     def status_json(self) -> dict:
         with self._lock:
@@ -750,18 +1226,35 @@ class RouterServer(BackgroundHTTPServer):
         out: dict = {
             "role": "router",
             "sharded": self.config.sharded,
+            "shardCount": self.shard_count if self.config.sharded else None,
+            "replicasPerShard": (
+                self.config.replicas_per_shard if self.config.sharded
+                else None
+            ),
             "backends": [
                 {
                     "backend": b,
                     "breaker": self.breakers[b].snapshot(),
+                    **(
+                        {"shard": i // self.config.replicas_per_shard}
+                        if self.config.sharded
+                        else {}
+                    ),
                 }
-                for b in self.backends
+                for i, b in enumerate(self.backends)
             ],
             "backendsUp": self._backends_up(),
             "quotas": dict(self.config.quotas),
             "defaultQuota": self.config.default_quota,
             "inflight": inflight,
+            "cache": (
+                self._cache.snapshot()
+                if self._cache is not None
+                else {"enabled": False}
+            ),
         }
+        if self._cache is not None:
+            out["cache"]["enabled"] = True
         if plan is not None:
             out["rolloutPlan"] = {
                 "id": plan.id,

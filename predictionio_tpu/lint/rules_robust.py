@@ -23,6 +23,11 @@ first incident:
   hammering the recovering peer; the partitioned write path's whole
   point is that a dead partition sheds *boundedly*
   (``RetryPolicy`` + ``PartitionUnavailable``).
+- ``robust-unbounded-cache`` (ISSUE 14): a dict/OrderedDict named like
+  a cache, written get-then-set on request-derived keys with no
+  eviction bound in scope — a slow OOM whose growth rate the client
+  controls; ``fleet/cache.py``'s ``ResponseCache`` (bounded LRU + TTL +
+  epoch invalidation) is the packaged fix.
 """
 
 from __future__ import annotations
@@ -359,6 +364,208 @@ class UnboundedRetry(Rule):
         return False
 
 
+#: constructor shapes that mint a plain mapping (the cache container
+#: candidates); lru_cache / cachetools-style bounded stores never match
+_DICT_CTORS = frozenset(
+    {"dict", "OrderedDict", "collections.OrderedDict"}
+)
+
+#: method calls on the container that evidence an eviction bound
+_EVICTION_METHODS = frozenset({"pop", "popitem", "clear"})
+
+
+def _is_dict_ctor(node: ast.AST) -> bool:
+    if isinstance(node, ast.Dict):
+        return True
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        return dotted_name(node.func) in _DICT_CTORS or call_name(
+            node
+        ) == "OrderedDict"
+    return False
+
+
+def _const_key(node: ast.AST) -> bool:
+    """A compile-time-constant subscript key: a store under it cannot
+    grow with traffic, so it is configuration, not a cache line."""
+    return isinstance(node, ast.Constant)
+
+
+class UnboundedCache(Rule):
+    """A dict/OrderedDict named like a cache, fed by the get-then-set
+    idiom on request-derived (non-constant) keys, with **no eviction
+    bound anywhere in scope**: every distinct key ever seen stays
+    resident. On a long-lived serving process that is a slow OOM with a
+    client-controlled growth rate — the exact failure the router tier's
+    response cache exists to package correctly (``fleet/cache.py``:
+    LRU bound + TTL + epoch invalidation)."""
+
+    id = "robust-unbounded-cache"
+    severity = "error"
+    short = (
+        "dict used as a cache (get-then-set on non-constant keys) "
+        "with no eviction bound in scope"
+    )
+    motivation = (
+        "a cache keyed by request-derived values and never evicted "
+        "grows with traffic until the process dies; fleet/cache.py's "
+        "ResponseCache (bounded LRU + TTL + epoch invalidation) is the "
+        "packaged fix — or bound the table with popitem/pop/clear/del "
+        "under a size check"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # cheap bail: the rule only reasons about containers the author
+        # already CALLS a cache — naming is the intent signal that keeps
+        # ordinary dicts (indexes, configs, registries) out of scope
+        if "cache" not in ctx.source.lower():
+            return
+        for name, scope in self._cache_containers(ctx):
+            stores: List[ast.AST] = []
+            has_read = False
+            has_bound = False
+            for node in ast.walk(scope):
+                if self._is_store(node, name):
+                    stores.append(node)
+                elif self._is_read(node, name):
+                    has_read = True
+                if self._is_bound(node, name):
+                    has_bound = True
+            if has_bound or not has_read:
+                continue
+            for store in stores:
+                yield self.finding(
+                    ctx,
+                    store,
+                    f"{name} is written get-then-set on request-derived "
+                    "keys with no eviction in scope: every distinct key "
+                    "stays resident forever — bound it (LRU popitem / "
+                    "TTL sweep / len() check + pop) or use "
+                    "fleet/cache.py's ResponseCache.",
+                )
+
+    # -- candidate discovery ----------------------------------------------
+    def _cache_containers(self, ctx: FileContext):
+        """(dotted target name, analysis scope) for every empty-mapping
+        assignment whose target name contains "cache". ``self.x``
+        candidates analyze over the enclosing class (every method sees
+        the attribute); locals over their function; globals over the
+        whole module."""
+        out = []
+
+        def visit(node: ast.AST, chain: List[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        child.targets
+                        if isinstance(child, ast.Assign)
+                        else [child.target]
+                    )
+                    value = child.value
+                    if value is not None and _is_dict_ctor(value):
+                        for target in targets:
+                            name = dotted_name(target)
+                            if "cache" not in name.lower():
+                                continue
+                            out.append((name, self._scope_for(name, chain)))
+                new_chain = chain
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    new_chain = chain + [child]
+                visit(child, new_chain)
+
+        visit(ctx.tree, [ctx.tree])
+        return out
+
+    @staticmethod
+    def _scope_for(name: str, chain: List[ast.AST]) -> ast.AST:
+        if name.startswith("self."):
+            for node in reversed(chain):
+                if isinstance(node, ast.ClassDef):
+                    return node
+        for node in reversed(chain):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return chain[0]
+
+    # -- evidence ----------------------------------------------------------
+    @staticmethod
+    def _is_store(node: ast.AST, name: str) -> bool:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and dotted_name(target.value) == name
+                    and not _const_key(target.slice)
+                ):
+                    return True
+            return False
+        if isinstance(node, ast.Call):
+            func = node.func
+            return (
+                isinstance(func, ast.Attribute)
+                and func.attr == "setdefault"
+                and dotted_name(func.value) == name
+                and bool(node.args)
+                and not _const_key(node.args[0])
+            )
+        return False
+
+    @staticmethod
+    def _is_read(node: ast.AST, name: str) -> bool:
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and dotted_name(node.value) == name
+        ):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("get", "setdefault")
+                and dotted_name(func.value) == name
+            ):
+                return True
+        if isinstance(node, ast.Compare):
+            return any(
+                isinstance(op, (ast.In, ast.NotIn))
+                and dotted_name(comp) == name
+                for op, comp in zip(node.ops, node.comparators)
+            )
+        return False
+
+    @staticmethod
+    def _is_bound(node: ast.AST, name: str) -> bool:
+        """Eviction evidence: pop/popitem/clear on the container, a
+        ``del container[...]``, or a ``len(container)`` read (the size
+        check an eviction loop hangs off — present exactly when someone
+        thought about the bound)."""
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _EVICTION_METHODS
+                and dotted_name(func.value) == name
+            ):
+                return True
+            if (
+                call_name(node) == "len"
+                and len(node.args) == 1
+                and dotted_name(node.args[0]) == name
+            ):
+                return True
+        if isinstance(node, ast.Delete):
+            return any(
+                isinstance(t, ast.Subscript)
+                and dotted_name(t.value) == name
+                for t in node.targets
+            )
+        return False
+
+
 RULES: List[Rule] = [
     NoTimeout(), BareSleepRetry(), RenameNoFsync(), UnboundedRetry(),
+    UnboundedCache(),
 ]

@@ -111,6 +111,7 @@ def load_library(name: str, sources=None) -> ctypes.CDLL:
     with _LOCK:
         if name not in _CACHE:
             try:
+                # pio: lint-ok[robust-unbounded-cache] keys are the in-tree native component names (a closed set), and a dlopen'd library has no meaningful eviction
                 _CACHE[name] = ctypes.CDLL(build_library(name, sources))
             except NativeBuildError:
                 raise

@@ -37,6 +37,7 @@ __all__ = [
     "alert_records",
     "append_record",
     "bench_to_record",
+    "cache_records",
     "comparable_key",
     "detect_regressions",
     "find_no_prior",
@@ -137,7 +138,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             key: bench[key]
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
-                "quality", "bf16_gate", "ingestScaling",
+                "quality", "bf16_gate", "ingestScaling", "cachedFleet",
             )
             if key in bench
         },
@@ -206,6 +207,74 @@ def fleet_records(bench: dict, source: str = "bench") -> List[dict]:
                 device=bench.get("device"),
                 scale=fleet.get("replicas"),
                 extra={"sharded": bool(fleet.get("sharded"))},
+            )
+        )
+    return out
+
+
+def cache_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The serve-from-memory numbers a bench run attached
+    (``bench["cachedFleet"]``, from ``loadgen --cached-hot-set`` —
+    docs/fleet.md#cache) as their own ledger records:
+
+    - ``fleet_cached_p99_s`` — seconds through the cache-on router on
+      the Zipfian hot-set mix, lower-better → GATED, at the same wide
+      record-declared band (0.5) as the fleet p99: the tail of a small
+      in-process drive is one scheduler hiccup from 2×, so only a cache
+      collapse (a lock convoy, an accidental always-miss) should fire;
+    - ``fleet_cached_qps`` — the step-function headline, higher-better →
+      trend-only (the gate only compares ``unit == "s"``); the uncached
+      twin QPS and the speedup travel in ``extra`` so the trend renders
+      the step, not just the number;
+    - ``fleet_cache_hit_rate`` — trend-only ``ratio`` (the drill itself
+      hard-gates correctness: byte identity and zero stale responses).
+
+    A failed drive (``ok`` false) records nothing — its numbers measured
+    a broken cache, not the code."""
+    cached = bench.get("cachedFleet")
+    if not isinstance(cached, dict) or not cached.get("ok"):
+        return []
+    out: List[dict] = []
+    p99_ms = cached.get("cachedP99Ms")
+    if isinstance(p99_ms, (int, float)) and p99_ms > 0:
+        record = make_record(
+            source=source,
+            metric="fleet_cached_p99_s",
+            value=float(p99_ms) / 1000.0,
+            unit="s",
+            device=bench.get("device"),
+            scale=cached.get("replicas"),
+            extra={"hitRate": cached.get("hitRate")},
+        )
+        record["noise_band"] = 0.5
+        out.append(record)
+    qps = cached.get("cachedQPS")
+    if isinstance(qps, (int, float)) and qps > 0:
+        out.append(
+            make_record(
+                source=source,
+                metric="fleet_cached_qps",
+                value=float(qps),
+                unit="qps",
+                device=bench.get("device"),
+                scale=cached.get("replicas"),
+                extra={
+                    "uncachedQPS": cached.get("uncachedQPS"),
+                    "speedup": cached.get("speedup"),
+                    "hitRate": cached.get("hitRate"),
+                },
+            )
+        )
+    hit_rate = cached.get("hitRate")
+    if isinstance(hit_rate, (int, float)):
+        out.append(
+            make_record(
+                source=source,
+                metric="fleet_cache_hit_rate",
+                value=float(hit_rate),
+                unit="ratio",
+                device=bench.get("device"),
+                scale=cached.get("replicas"),
             )
         )
     return out

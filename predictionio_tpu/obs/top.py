@@ -175,6 +175,16 @@ def node_row(node: str, timeout: float = 5.0) -> Dict[str, object]:
     # router had to retry on another replica — the fleet-failover pulse
     row["backends_up"] = _series_sum(m, "pio_router_backends_up")
     row["router_retries"] = _series_sum(m, "pio_router_retries_total")
+    # router response cache (docs/fleet.md#cache): hit rate over actual
+    # lookups — a router that has seen none (cache off, or no traffic)
+    # shows '-', never a measured 0.00
+    cache_hits = _series_sum(m, "pio_router_cache_hits_total")
+    cache_misses = _series_sum(m, "pio_router_cache_misses_total")
+    row["cache_hit_rate"] = None
+    if cache_hits is not None and cache_misses is not None:
+        lookups = cache_hits + cache_misses
+        if lookups > 0:
+            row["cache_hit_rate"] = cache_hits / lookups
     # quality plane (docs/observability.md#quality): the live model's
     # served-score drift vs its pinned baseline, and the feedback join's
     # hit-rate; event-server nodes show their worst per-app mix PSI in
@@ -268,6 +278,7 @@ _COLUMNS = (
     ("RETRACE", "jit_retraces", "{:.0f}"),
     ("BACKENDS", "backends_up", "{:.0f}"),
     ("RTRETRY", "router_retries", "{:.0f}"),
+    ("CACHE", "cache_hit_rate", "{:.2f}"),
     ("DRIFT", "score_psi", "{:.3f}"),
     ("HITRATE", "hit_rate", "{:.2f}"),
     ("HEALTH", "health", "{}"),

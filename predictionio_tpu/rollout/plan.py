@@ -43,6 +43,7 @@ __all__ = [
     "VARIANT_HEADER",
     "GateConfig",
     "bucket_for_key",
+    "plan_epoch",
     "plan_to_json",
     "prediction_divergence",
     "sticky_key",
@@ -150,6 +151,37 @@ def plan_to_json(plan: Any) -> Dict[str, Any]:
         "gates": dict(plan.gates),
         "history": list(plan.history),
     }
+
+
+def plan_epoch(plan: Any) -> str:
+    """The rollout plane's cache-invalidation epoch: a deterministic
+    token over everything in a :class:`~predictionio_tpu.storage.metadata
+    .RolloutPlan` that can change what a query is answered with — plan
+    identity, stage, split (percent + salt), and both instance ids.
+    ``updated_time`` rides along so ANY durable plan write moves the
+    epoch (over-flushing is a wasted recompute; under-flushing is a
+    stale answer).
+
+    The router response cache (``fleet/cache.py``, docs/fleet.md#cache)
+    stamps every entry with the epoch observed at fill time and drops
+    any entry whose epoch no longer matches — a cached answer can never
+    outlive the rollout stage that produced it, by construction. Pure
+    function of the plan (``None`` — no active plan — is its own
+    epoch), stdlib-only like everything in this module."""
+    if plan is None:
+        return "-"
+    return "|".join(
+        str(getattr(plan, field, ""))
+        for field in (
+            "id",
+            "stage",
+            "percent",
+            "salt",
+            "baseline_instance_id",
+            "candidate_instance_id",
+            "updated_time",
+        )
+    )
 
 
 def sticky_key(payload: Any) -> str:

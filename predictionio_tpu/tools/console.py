@@ -426,6 +426,29 @@ def build_parser() -> argparse.ArgumentParser:
         "partition, answers merge into the exact global top-k",
     )
     rt.add_argument(
+        "--replicas-per-shard", type=int, default=1, metavar="R",
+        help="with --sharded: every R consecutive backends serve one "
+        "shard (backend i serves shard i//R) and a shard leg fails "
+        "over inside its replica group — a sharded fleet survives a "
+        "backend kill (docs/fleet.md#replicas-per-shard)",
+    )
+    rt.add_argument(
+        "--no-cache", action="store_true",
+        help="disable the router response cache (docs/fleet.md#cache; "
+        "default on, PIO_ROUTER_CACHE=0 also disables)",
+    )
+    rt.add_argument(
+        "--cache-ttl", type=float, default=None, metavar="S",
+        help="response-cache TTL backstop in seconds (default "
+        "PIO_ROUTER_CACHE_TTL_S or 30; correctness comes from "
+        "rollout/model epoch invalidation, not the TTL)",
+    )
+    rt.add_argument(
+        "--cache-max-entries", type=int, default=None, metavar="N",
+        help="response-cache LRU bound (default PIO_ROUTER_CACHE_MAX "
+        "or 2048)",
+    )
+    rt.add_argument(
         "--quota", action="append", default=[], metavar="APP=N",
         help="per-app in-flight cap (X-PIO-App header), repeatable",
     )
@@ -972,12 +995,16 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
             port=args.port,
             backends=backends,
             sharded=args.sharded,
+            replicas_per_shard=args.replicas_per_shard,
             quotas=quotas,
             default_quota=args.default_quota,
             timeout_s=args.timeout,
             engine_id=args.engine_id,
             engine_version=args.engine_version,
             engine_variant=args.engine_variant,
+            cache_enabled=False if args.no_cache else None,
+            cache_ttl_s=args.cache_ttl,
+            cache_max_entries=args.cache_max_entries,
         )
         create_router(config, registry=registry, block=True)
         return EXIT_OK

@@ -303,6 +303,7 @@ def _prepared_workspace(tag: str, build, dest: str) -> dict:
         ) as fh:
             _json.dump(info or {}, fh)
         with _TOY_CACHE_LOCK:
+            # pio: lint-ok[robust-unbounded-cache] keys are the drills' recipe tags — a closed in-tree set, one workspace each, reclaimed atexit
             cached = _TOY_CACHE.setdefault(tag, cache_dir)
         if cached != cache_dir:  # lost a build race: drop the duplicate
             shutil.rmtree(cache_dir, ignore_errors=True)
@@ -1998,6 +1999,7 @@ def run_brownout(
 def run_fleet_chaos(
     replicas: int = 3,
     sharded: bool = False,
+    replicas_per_shard: int = 1,
     kill_backend_at: Optional[int] = None,
     queries: int = 120,
     concurrency: int = 4,
@@ -2044,15 +2046,27 @@ def run_fleet_chaos(
 
     if replicas < 2:
         raise ValueError("--replicas needs at least 2 backends")
-    if kill_backend_at is not None and not (0 <= kill_backend_at < replicas):
+    if replicas_per_shard < 1:
+        raise ValueError("--replicas-per-shard must be >= 1")
+    if replicas_per_shard > 1 and not sharded:
         raise ValueError(
-            f"--kill-backend-at must name a backend in [0, {replicas})"
+            "--replicas-per-shard needs --sharded (replicated mode "
+            "already treats every backend as a replica)"
         )
-    if sharded and kill_backend_at is not None:
+    total_backends = (
+        replicas * replicas_per_shard if sharded else replicas
+    )
+    if kill_backend_at is not None and not (
+        0 <= kill_backend_at < total_backends
+    ):
         raise ValueError(
-            "--sharded has no replica redundancy (one backend per shard; "
-            "a dead shard fails reads loudly by design) — the kill drill "
-            "is a replicated-mode scenario"
+            f"--kill-backend-at must name a backend in [0, {total_backends})"
+        )
+    if sharded and replicas_per_shard == 1 and kill_backend_at is not None:
+        raise ValueError(
+            "--sharded with one backend per shard has no replica "
+            "redundancy (a dead shard fails reads loudly by design) — "
+            "the kill drill needs --replicas-per-shard >= 2"
         )
     tmp = base_dir or tempfile.mkdtemp(prefix="pio-fleet-chaos-")
     owns_tmp = base_dir is None
@@ -2063,6 +2077,7 @@ def run_fleet_chaos(
         "mode": "fleet-chaos",
         "replicas": replicas,
         "sharded": sharded,
+        "replicasPerShard": replicas_per_shard if sharded else None,
         "clientFailures": 0,
     }
     backends: List[QueryServer] = []
@@ -2082,10 +2097,12 @@ def run_fleet_chaos(
         def backend_config(i: int) -> ServerConfig:
             return ServerConfig(
                 ip="127.0.0.1", port=0, batching=False,
-                # shard layout in sharded mode; in replicated mode the
-                # FIRST backend pins the baseline and starts the rollout,
-                # the rest resolve it from replicated metadata on boot
-                shard_index=i if sharded else 0,
+                # shard layout in sharded mode (backend i serves shard
+                # i // replicas_per_shard — consecutive replica groups,
+                # mirroring the router's ring math); in replicated mode
+                # the FIRST backend pins the baseline and starts the
+                # rollout, the rest resolve it from replicated metadata
+                shard_index=(i // replicas_per_shard) if sharded else 0,
                 shard_count=replicas if sharded else 1,
                 engine_instance_id=(
                     baseline_id if (sharded or i == 0) else None
@@ -2112,7 +2129,7 @@ def run_fleet_chaos(
             )
             first.rollout.promote("fleet chaos drill: shadow -> canary")
             report["rolloutPlanId"] = first.rollout.plan.id
-        for i in range(1, replicas):
+        for i in range(1, total_backends):
             backends.append(QueryServer(backend_config(i), engine, registry))
         for server in backends:
             server.start_background()
@@ -2127,8 +2144,14 @@ def run_fleet_chaos(
                     f"127.0.0.1:{s.bound_port}" for s in backends
                 ),
                 sharded=sharded,
+                replicas_per_shard=replicas_per_shard,
                 timeout_s=10.0,
                 plan_refresh_s=0.0,  # every request re-checks consistency
+                # failover is the thing under test: the response cache
+                # would mask it (a hit never exercises a backend) — the
+                # cached-hot-set drive (run_cached_hot_set) owns the
+                # cache's own acceptance
+                cache_enabled=False,
             ),
             registry=registry,
         )
@@ -2276,6 +2299,323 @@ def run_fleet_chaos(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_cached_hot_set(
+    queries: int = 240,
+    concurrency: int = 4,
+    n_users: int = 24,
+    n_items: int = 16,
+    zipf_s: float = 1.2,
+    percent: float = 50.0,
+    cache_ttl_s: float = 120.0,
+    base_dir: Optional[str] = None,
+) -> dict:
+    """The serve-from-memory acceptance drive (``--cached-hot-set``,
+    docs/fleet.md#cache): a Zipfian hot-set query mix through two
+    routers over the SAME backend — one cache-off, one cache-on — so
+    the step-function QPS win is measured against an identical server
+    on the same box, plus the two correctness proofs the cache must
+    carry:
+
+    - **byte identity**: for sampled keys, the cached hit's response
+      body equals the filling miss's body byte-for-byte (only the trace
+      id / cache-verdict headers differ);
+    - **invalidation**: a rollout stage transition mid-drive flushes the
+      keyspace — every post-transition response's ``X-PIO-Variant``
+      matches the NEW plan's pure-function assignment (zero stale
+      responses), and the router's epoch-invalidation counter moved.
+
+    One backend on purpose: the cache tier is the thing under test (a
+    mid-drive stage transition is only immediately visible on the
+    backend that performs it), and failover already has its own drill
+    (:func:`run_fleet_chaos`). Reports ``cachedQPS``/``uncachedQPS``/
+    ``hitRate`` — the numbers ``bench.py`` attaches (``cachedFleet``,
+    opt out ``BENCH_CACHE=0``) and the perf ledger records as
+    ``fleet_cached_qps`` (trend) and ``fleet_cached_p99_s`` (gated).
+    """
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..fleet.cache import CACHE_HEADER
+    from ..fleet.router import RouterConfig, RouterServer, VARIANT_HEADER
+    from ..models.recommendation import engine_factory
+    from ..rollout.plan import sticky_key, variant_for_key
+    from ..storage import StorageRegistry
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    tmp = base_dir or tempfile.mkdtemp(prefix="pio-cached-hot-set-")
+    owns_tmp = base_dir is None
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": tmp})
+    prev_registry = regmod._default_registry
+    regmod._default_registry = registry
+    report: dict = {
+        "mode": "cached-hot-set",
+        "replicas": 1,
+        "clientFailures": 0,
+    }
+    backends: List[QueryServer] = []
+    routers: List[RouterServer] = []
+    try:
+        engine = engine_factory()
+        # the fleet drills' shared train-once workspace: this drive adds
+        # ZERO training cost to a process that already ran a fleet drill
+        info = _prepared_workspace(
+            f"fleet-{n_users}x{n_items}",
+            lambda reg: _build_fleet_workspace(
+                reg, n_users=n_users, n_items=n_items
+            ),
+            tmp,
+        )
+        baseline_id = info["baselineInstanceId"]
+        candidate_id = info["candidateInstanceId"]
+        backends.append(
+            QueryServer(
+                ServerConfig(
+                    ip="127.0.0.1", port=0, batching=False,
+                    engine_instance_id=baseline_id,
+                ),
+                engine, registry,
+            )
+        )
+        for server in backends:
+            server.start_background()
+
+        def make_router(cache_on: bool) -> RouterServer:
+            router = RouterServer(
+                RouterConfig(
+                    ip="127.0.0.1", port=0,
+                    backends=tuple(
+                        f"127.0.0.1:{s.bound_port}" for s in backends
+                    ),
+                    timeout_s=10.0,
+                    # observe every durable plan write immediately: the
+                    # invalidation proof must not race the refresh cadence
+                    plan_refresh_s=0.0,
+                    cache_enabled=cache_on,
+                    cache_ttl_s=cache_ttl_s,
+                ),
+                registry=registry,
+            )
+            router.start_background()
+            routers.append(router)
+            return router
+
+        uncached_router = make_router(False)
+        cached_router = make_router(True)
+
+        # Zipfian hot-set mix: rank r drawn with weight 1/r^s — the
+        # "millions of users" head, shrunk to drill size. One fixed
+        # sequence drives BOTH routers, so the QPS comparison is
+        # apples-to-apples.
+        rng = np.random.default_rng(7)
+        keys = [f"u{u}" for u in range(n_users)]
+        weights = np.array(
+            [1.0 / (r + 1) ** zipf_s for r in range(len(keys))]
+        )
+        weights /= weights.sum()
+        mix = [
+            keys[i]
+            for i in rng.choice(len(keys), size=queries, p=weights)
+        ]
+        payloads = {
+            k: json.dumps({"user": k, "num": 5}).encode() for k in keys
+        }
+
+        lock = threading.Lock()
+
+        def drive(router: RouterServer) -> dict:
+            latencies: List[float] = []
+            cursor = {"next": 0}
+
+            def worker() -> None:
+                while True:
+                    with lock:
+                        pos = cursor["next"]
+                        if pos >= len(mix):
+                            return
+                        cursor["next"] = pos + 1
+                    t0 = time.monotonic()
+                    try:
+                        status, _headers, _body = _post_raw(
+                            f"127.0.0.1:{router.bound_port}",
+                            payloads[mix[pos]],
+                        )
+                    except Exception:
+                        status = -1
+                    elapsed = time.monotonic() - t0
+                    with lock:
+                        if status == 200:
+                            latencies.append(elapsed)
+                        else:
+                            report["clientFailures"] += 1
+
+            t_start = time.monotonic()
+            threads = [
+                threading.Thread(target=worker, daemon=True)
+                for _ in range(concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t_start
+            out = {
+                "qps": round(len(latencies) / wall, 1) if wall > 0 else 0.0,
+            }
+            if latencies:
+                lat = np.asarray(latencies)
+                out["p50Ms"] = round(float(np.percentile(lat, 50)) * 1e3, 3)
+                out["p99Ms"] = round(float(np.percentile(lat, 99)) * 1e3, 3)
+            return out
+
+        # -- proof 1: hit bodies are byte-identical to the filling miss
+        byte_identical = True
+        for key in keys[:6]:
+            s1, h1, b1 = _post_raw(
+                f"127.0.0.1:{cached_router.bound_port}", payloads[key]
+            )
+            s2, h2, b2 = _post_raw(
+                f"127.0.0.1:{cached_router.bound_port}", payloads[key]
+            )
+            if not (
+                s1 == s2 == 200
+                and h1.get(CACHE_HEADER.lower()) == "miss"
+                and h2.get(CACHE_HEADER.lower()) == "hit"
+                and b1 == b2
+            ):
+                byte_identical = False
+        report["byteIdentical"] = byte_identical
+        # the warmup pairs above pre-filled part of the hot set; flush so
+        # the throughput phase measures a cold-start cache honestly, and
+        # snapshot the counters so the reported hit rate is the DRIVE's
+        # delta, not contaminated by the warmup lookups
+        if cached_router._cache is not None:
+            cached_router._cache.flush(reason="explicit")
+        before = (
+            cached_router._cache.snapshot()
+            if cached_router._cache is not None
+            else {}
+        )
+
+        # -- the step function: same mix, cache off vs on
+        uncached = drive(uncached_router)
+        cached = drive(cached_router)
+        report["uncachedQPS"] = uncached["qps"]
+        report["uncachedP99Ms"] = uncached.get("p99Ms")
+        report["cachedQPS"] = cached["qps"]
+        report["cachedP50Ms"] = cached.get("p50Ms")
+        report["cachedP99Ms"] = cached.get("p99Ms")
+        report["speedup"] = (
+            round(cached["qps"] / uncached["qps"], 2)
+            if uncached["qps"] > 0
+            else None
+        )
+        snap = (
+            cached_router._cache.snapshot()
+            if cached_router._cache is not None
+            else {}
+        )
+        hits = snap.get("hits", 0) - before.get("hits", 0)
+        lookups = hits + snap.get("misses", 0) - before.get("misses", 0)
+        report["hitRate"] = round(hits / lookups, 3) if lookups else 0.0
+
+        # -- proof 2: a rollout stage change mid-drive leaves ZERO stale
+        # responses. Start a canary (epoch move #1: SHADOW; #2: CANARY),
+        # then require every response's variant header to match the NEW
+        # plan's pure-function assignment.
+        stale = 0
+        backends[0].rollout.start(
+            candidate_instance_id=candidate_id,
+            percent=percent,
+            gates={
+                "min_samples": 1_000_000, "window_s": 1e9,
+                "shadow_hold_s": 1e9, "canary_hold_s": 1e9,
+                "max_divergence": 1.0, "max_p99_latency_ratio": 1e9,
+            },
+        )
+        backends[0].rollout.promote("cached-hot-set drill: -> canary")
+        plan = backends[0].rollout.plan
+        for key in keys:
+            status, headers, _body = _post_raw(
+                f"127.0.0.1:{cached_router.bound_port}", payloads[key]
+            )
+            if status != 200:
+                report["clientFailures"] += 1
+                continue
+            expected = variant_for_key(
+                plan.salt, sticky_key({"user": key, "num": 5}), plan.percent
+            )
+            if headers.get(VARIANT_HEADER.lower()) != expected:
+                stale += 1
+        # drive the hot set AGAIN through the cache and re-verify: hits
+        # (this time cached under the canary epoch) must still carry the
+        # canary assignment
+        for key in keys[:8]:
+            status, headers, _body = _post_raw(
+                f"127.0.0.1:{cached_router.bound_port}", payloads[key]
+            )
+            expected = variant_for_key(
+                plan.salt, sticky_key({"user": key, "num": 5}), plan.percent
+            )
+            if status == 200 and (
+                headers.get(VARIANT_HEADER.lower()) != expected
+            ):
+                stale += 1
+        report["staleAfterRollout"] = stale
+        snap = (
+            cached_router._cache.snapshot()
+            if cached_router._cache is not None
+            else {}
+        )
+        report["invalidations"] = sum(
+            snap.get("invalidations", {}).values()
+        )
+        report["epochInvalidations"] = snap.get("invalidations", {}).get(
+            "epoch", 0
+        )
+        report["ok"] = bool(
+            report["clientFailures"] == 0
+            and byte_identical
+            and stale == 0
+            and report["epochInvalidations"] > 0
+            and report["hitRate"] > 0.3
+            and report["cachedQPS"] > report["uncachedQPS"]
+        )
+        return report
+    finally:
+        regmod._default_registry = prev_registry
+        for srv in [*routers, *backends]:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _post_raw(node: str, payload: bytes):
+    """One POST /queries.json against ``host:port`` → (status, headers
+    dict lowercase, raw body BYTES). The cached-hot-set drive compares
+    hit and miss bodies byte-for-byte — parsing would hide an encoding
+    difference the byte-identity contract forbids."""
+    host, _, port = node.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    try:
+        conn.request(
+            "POST", "/queries.json", body=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        body = resp.read()
+        return (
+            resp.status,
+            {k.lower(): v for k, v in resp.getheaders()},
+            body,
+        )
+    finally:
+        conn.close()
+
+
 # merged_matches_reference moved to fleet/merge.py — ONE home for the
 # f32 ranking-equality contract, shared with the fused top-k
 # equivalence tests (re-exported here for the drill callers/tests).
@@ -2377,12 +2717,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="with --replicas: partition the item factors "
                         "across the backends and assert the router's "
                         "merged top-k equals the unsharded top-k exactly")
+    p.add_argument("--replicas-per-shard", type=int, default=1, metavar="R",
+                   help="with --replicas --sharded: R backends per shard "
+                        "(total servers = N*R); the kill drill then "
+                        "proves a sharded fleet survives a backend kill "
+                        "exactly like the replicated fleet "
+                        "(docs/fleet.md#replicas-per-shard)")
     p.add_argument("--kill-backend-at", type=int, default=None, metavar="I",
                    help="with --replicas: hard-kill backend I between "
                         "the two drive phases; acceptance is zero client "
                         "failures and byte-identical variant assignments")
     p.add_argument("--queries", type=int, default=120,
                    help="total queries across the --replicas drive phases")
+    p.add_argument("--cached-hot-set", action="store_true",
+                   help="serve-from-memory acceptance drive "
+                        "(docs/fleet.md#cache): Zipfian hot-set mix "
+                        "through cache-off and cache-on routers over the "
+                        "same backend; proves the step-function QPS win, "
+                        "byte-identical hit bodies, and zero stale "
+                        "responses across a mid-drive rollout stage "
+                        "transition (the BENCH cachedFleet block)")
     p.add_argument("--partitions", type=int, default=None, metavar="N",
                    help="partitioned write-path chaos scenario "
                         "(docs/storage.md#partitioning): N in-process "
@@ -2445,9 +2799,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result = run_fleet_chaos(
             replicas=args.replicas,
             sharded=args.sharded,
+            replicas_per_shard=args.replicas_per_shard,
             kill_backend_at=args.kill_backend_at,
             queries=args.queries,
         )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.cached_hot_set:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_cached_hot_set(queries=args.queries)
         print(json.dumps(result))
         return 0 if result["ok"] else 1
 

@@ -1,0 +1,33 @@
+"""Fixture: robust-unbounded-cache MUST fire on both container shapes."""
+
+import threading
+from collections import OrderedDict
+
+_RESPONSE_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def lookup(key, compute):
+    # module-global dict cache: get-then-set on a request-derived key,
+    # properly locked — but nothing in the module ever evicts
+    with _CACHE_LOCK:
+        hit = _RESPONSE_CACHE.get(key)
+    if hit is None:
+        hit = compute(key)
+        with _CACHE_LOCK:
+            _RESPONSE_CACHE[key] = hit  # BAD: grows with every distinct key
+    return hit
+
+
+class PlanMirror:
+    def __init__(self):
+        self.plan_cache = OrderedDict()
+
+    def plan_for(self, engine_key, load):
+        # attribute cache over the whole class: ordered, but order
+        # without popitem is not an LRU — nothing bounds it
+        if engine_key in self.plan_cache:
+            return self.plan_cache[engine_key]
+        plan = load(engine_key)
+        self.plan_cache[engine_key] = plan  # BAD: unbounded attribute cache
+        return plan

@@ -1,0 +1,66 @@
+"""Fixture: robust-unbounded-cache must NOT fire on any of these."""
+
+import functools
+import threading
+from collections import OrderedDict
+
+_LIMIT = 128
+
+_lru_cache = OrderedDict()
+_LRU_LOCK = threading.Lock()
+
+
+def lookup_lru(key, compute):
+    # clean: a real LRU — the popitem under the len() check is the bound
+    with _LRU_LOCK:
+        hit = _lru_cache.get(key)
+    if hit is None:
+        hit = compute(key)
+        with _LRU_LOCK:
+            _lru_cache[key] = hit
+            _lru_cache.move_to_end(key)
+            while len(_lru_cache) > _LIMIT:
+                _lru_cache.popitem(last=False)
+    return hit
+
+
+@functools.lru_cache(maxsize=256)
+def lookup_decorated(key):
+    # clean: functools.lru_cache owns the bound
+    return key.upper()
+
+
+_config_cache = {}
+_CONFIG_LOCK = threading.Lock()
+
+
+def configured(name):
+    # clean: constant keys only — configuration, not a per-request cache
+    with _CONFIG_LOCK:
+        if "mode" not in _config_cache:
+            _config_cache["mode"] = name
+        return _config_cache["mode"]
+
+
+class EvictingMirror:
+    def __init__(self):
+        self.row_cache = {}
+
+    def row_for(self, key, load):
+        # clean: the del under a size check is eviction evidence
+        if key in self.row_cache:
+            return self.row_cache[key]
+        if len(self.row_cache) >= _LIMIT:
+            victim = next(iter(self.row_cache))
+            del self.row_cache[victim]
+        value = load(key)
+        self.row_cache[key] = value
+        return value
+
+
+def plain_index(rows):
+    # clean: not named a cache — an ordinary build-once index
+    index = {}
+    for row in rows:
+        index[row.key] = row
+    return index

@@ -11,6 +11,7 @@ answer. All in-process; the only clocks on decision paths are injected.
 from __future__ import annotations
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -283,12 +284,656 @@ class TestRouterUnits:
             [
                 "router", "--backends", "a:1,b:2", "--sharded",
                 "--quota", "gold=4", "--default-quota", "8",
+                "--replicas-per-shard", "2", "--no-cache",
+                "--cache-ttl", "5", "--cache-max-entries", "64",
             ]
         )
         assert args.command == "router"
         assert args.backends == "a:1,b:2"
         assert args.sharded and args.quota == ["gold=4"]
         assert args.default_quota == 8
+        assert args.replicas_per_shard == 2
+        assert args.no_cache is True
+        assert args.cache_ttl == 5.0 and args.cache_max_entries == 64
+
+
+# ---------------------------------------------------------------------------
+# response cache + single-flight (docs/fleet.md#cache)
+# ---------------------------------------------------------------------------
+
+
+class TestResponseCacheUnit:
+    def test_canonical_query_is_order_insensitive(self):
+        from predictionio_tpu.fleet.cache import canonical_query
+
+        a = canonical_query({"user": "u1", "num": 5})
+        b = canonical_query({"num": 5, "user": "u1"})
+        assert a == b
+        assert canonical_query({"user": "u2"}) != a
+
+    def test_hit_miss_ttl_and_epoch(self):
+        from predictionio_tpu.fleet.cache import ResponseCache
+
+        clock = FakeClock()
+        dropped = []
+        cache = ResponseCache(
+            max_entries=8, ttl_s=10.0, clock=clock,
+            on_invalidate=lambda reason, n: dropped.append((reason, n)),
+        )
+        key = ("-", '{"user":"u1"}')
+        assert cache.get(key, "e1") is None  # miss
+        cache.put(key, {"itemScores": []}, "-", "e1")
+        entry = cache.get(key, "e1")
+        assert entry is not None and entry.body == {"itemScores": []}
+        # TTL expiry on the injected clock
+        clock.advance(10.5)
+        assert cache.get(key, "e1") is None
+        assert ("ttl", 1) in dropped
+        # epoch mismatch drops the entry — a cached answer can never
+        # outlive the plan/model that produced it
+        cache.put(key, {"itemScores": []}, "-", "e1")
+        assert cache.get(key, "e2") is None
+        assert ("epoch", 1) in dropped
+        snap = cache.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 3
+        assert snap["invalidations"] == {"ttl": 1, "epoch": 1}
+
+    def test_lru_bound_and_flush(self):
+        from predictionio_tpu.fleet.cache import ResponseCache
+
+        cache = ResponseCache(max_entries=3, ttl_s=60.0, clock=FakeClock())
+        for i in range(5):
+            cache.put(("-", f"q{i}"), i, "-", "e")
+        assert len(cache) == 3
+        assert cache.snapshot()["invalidations"]["capacity"] == 2
+        # oldest evicted, newest resident
+        assert cache.get(("-", "q0"), "e") is None
+        assert cache.get(("-", "q4"), "e").body == 4
+        # variant-scoped flush drops only that keyspace
+        cache.put(("candidate", "qc"), 9, "candidate", "e")
+        assert cache.flush(variant="candidate", reason="explicit") == 1
+        assert cache.get(("-", "q4"), "e") is not None
+        assert cache.flush() == 2  # q3 and q4 remained -> all dropped
+        with pytest.raises(ValueError, match="BOUNDED"):
+            ResponseCache(max_entries=0)
+
+    def test_single_flight_coalesces(self):
+        import threading
+
+        from predictionio_tpu.fleet.cache import SingleFlight
+
+        sf = SingleFlight()
+        gate = threading.Event()
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            gate.wait(5)
+            return "answer"
+
+        results = []
+
+        def go():
+            results.append(sf.do("k", fn))
+
+        threads = [threading.Thread(target=go) for _ in range(6)]
+        for t in threads:
+            t.start()
+        # let the followers pile onto the leader before releasing it
+        deadline = time.monotonic() + 5
+        while calls["n"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)
+        gate.set()
+        for t in threads:
+            t.join()
+        assert calls["n"] == 1  # ONE execution for six callers
+        assert all(value == "answer" for value, _shared in results)
+        assert sum(1 for _v, shared in results if shared) == 5
+
+    def test_single_flight_error_sharing_and_deadline_fallback(self):
+        import threading
+
+        from predictionio_tpu.fleet.cache import SingleFlight
+
+        sf = SingleFlight()
+        gate = threading.Event()
+        started = threading.Event()
+        calls = {"n": 0}
+
+        def failing():
+            calls["n"] += 1
+            started.set()
+            gate.wait(5)
+            raise OSError("backend down")
+
+        errors = []
+
+        def follower():
+            try:
+                sf.do("k", failing)
+            except OSError as exc:
+                errors.append(str(exc))
+
+        leader = threading.Thread(target=follower)
+        leader.start()
+        started.wait(5)
+        chaser = threading.Thread(target=follower)
+        chaser.start()
+        time.sleep(0.05)
+        gate.set()
+        leader.join()
+        chaser.join()
+        # a generic failure IS shared (one backend storm, one error)...
+        assert calls["n"] == 1 and len(errors) == 2
+        # ...but a caller-specific error (share_error False) makes the
+        # follower run its own leg instead of inheriting it
+        sf2 = SingleFlight()
+        gate2 = threading.Event()
+        started2 = threading.Event()
+        outcome = {}
+
+        def leader_fn():
+            started2.set()
+            gate2.wait(5)
+            raise TimeoutError("my deadline, not yours")
+
+        def follower_fn():
+            started2.wait(5)
+            try:
+                value, shared = sf2.do(
+                    "k", lambda: "fresh",
+                    share_error=lambda e: not isinstance(e, TimeoutError),
+                )
+                outcome["value"] = value
+            except TimeoutError:
+                outcome["inherited"] = True
+
+        def leader_run():
+            try:
+                sf2.do("k", leader_fn)
+            except TimeoutError:
+                outcome["leader_raised"] = True
+
+        t1 = threading.Thread(target=leader_run)
+        t1.start()
+        started2.wait(5)
+        t2 = threading.Thread(target=follower_fn)
+        t2.start()
+        time.sleep(0.05)
+        gate2.set()
+        t1.join()
+        t2.join()
+        assert outcome == {"value": "fresh", "leader_raised": True}
+
+
+class _FakePlan:
+    """Just enough RolloutPlan surface for the router's preview/epoch."""
+
+    def __init__(self, stage="CANARY", percent=50.0, plan_id="RP-1",
+                 updated="t0"):
+        self.id = plan_id
+        self.stage = stage
+        self.percent = percent
+        self.salt = "salt-1"
+        self.baseline_instance_id = "EI-base"
+        self.candidate_instance_id = "EI-cand"
+        self.updated_time = updated
+
+
+class _FakeInstance:
+    def __init__(self, iid):
+        self.id = iid
+
+
+class _FakeRegistry:
+    def __init__(self):
+        self.plan = None
+        self.latest = _FakeInstance("EI-1")
+
+    def get_metadata(self):
+        return self
+
+    def rollout_plan_get_active(self, *_key):
+        return self.plan
+
+    def engine_instance_get_latest_completed(self, *_key):
+        return self.latest
+
+
+def _cached_router(**kw):
+    registry = kw.pop("registry", None)
+    clock = kw.pop("clock", FakeClock())
+    kw.setdefault("cache_enabled", True)
+    kw.setdefault("cache_ttl_s", 30.0)
+    kw.setdefault("plan_refresh_s", 0.0)
+    kw.setdefault("engine_id", "eng")
+    cfg = RouterConfig(
+        ip="127.0.0.1", port=0, backends=kw.pop("backends", ("h1:1",)),
+        **kw,
+    )
+    return RouterServer(cfg, registry=registry, clock=clock), clock
+
+
+class TestRouterCacheUnits:
+    def _scrape(self, router, name):
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        return parse_text(render(router.metrics)).get(name, [])
+
+    def test_hit_skips_backend_and_stamps_verdict(self):
+        router, _clock = _cached_router()
+        calls = {"n": 0}
+
+        def leg(*_a, **_k):
+            calls["n"] += 1
+            return 200, {"itemScores": [{"item": "a", "score": 1.0}]}, {
+                "x-pio-variant": "-",
+            }
+
+        router._leg = leg
+        try:
+            info: dict = {}
+            status, body, variant = router.route_query(
+                b'{"user": "u1", "num": 2}', None, info=info
+            )
+            assert (status, info["cache"], calls["n"]) == (200, "miss", 1)
+            info = {}
+            status, body2, variant2 = router.route_query(
+                b'{"num": 2, "user": "u1"}', None, info=info  # reordered
+            )
+            assert (status, info["cache"], calls["n"]) == (200, "hit", 1)
+            assert body2 == body and variant2 == variant
+            assert [v for _l, v in self._scrape(
+                router, "pio_router_cache_hits_total"
+            )] == [1.0]
+        finally:
+            router.server_close()
+
+    def test_ttl_expiry_on_fake_clock(self):
+        router, clock = _cached_router(cache_ttl_s=5.0)
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            clock.advance(5.5)
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+            invalidations = {
+                labels["reason"]: v
+                for labels, v in self._scrape(
+                    router, "pio_router_cache_invalidations_total"
+                )
+            }
+            assert invalidations.get("ttl") == 1.0
+        finally:
+            router.server_close()
+
+    def test_rollout_stage_change_flushes(self):
+        """The invalidation contract: an observed plan-epoch move drops
+        the affected keyspace — a stage transition can never serve a
+        pre-transition answer (docs/fleet.md#cache)."""
+        registry = _FakeRegistry()
+        router, _clock = _cached_router(registry=registry)
+        router._leg = lambda *a, **k: (
+            200, {"n": 1}, {"x-pio-variant": "baseline"}
+        )
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            # SHADOW -> CANARY: stage + updated_time move the epoch
+            registry.plan = _FakePlan(stage="CANARY", updated="t1")
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+            invalidations = {
+                labels["reason"]: v
+                for labels, v in self._scrape(
+                    router, "pio_router_cache_invalidations_total"
+                )
+            }
+            assert invalidations.get("epoch", 0) >= 1.0
+            # mid-canary percent bump flushes again
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            registry.plan = _FakePlan(
+                stage="CANARY", percent=80.0, updated="t2"
+            )
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+        finally:
+            router.server_close()
+
+    def test_model_swap_flushes(self):
+        """A new COMPLETED instance (the continuous plane promoting a
+        fresh model) moves the epoch even with no rollout active — a
+        cached answer can never outlive the model that produced it."""
+        registry = _FakeRegistry()
+        router, _clock = _cached_router(registry=registry)
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            registry.latest = _FakeInstance("EI-2")  # model swap observed
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+        finally:
+            router.server_close()
+
+    def test_canary_variants_get_distinct_cache_lines(self):
+        """Under an active CANARY the cache key includes the router's
+        own variant assignment: a baseline user's hit can never serve a
+        candidate user's answer (and vice versa)."""
+        from predictionio_tpu.rollout.plan import sticky_key, variant_for_key
+
+        registry = _FakeRegistry()
+        registry.plan = _FakePlan(stage="CANARY", percent=50.0)
+        router, _clock = _cached_router(registry=registry)
+        served = []
+
+        def leg(backend, raw, *_a, **_k):
+            payload = json.loads(raw)
+            variant = variant_for_key(
+                "salt-1", sticky_key(payload), 50.0
+            )
+            served.append(variant)
+            return 200, {"for": payload["user"]}, {"x-pio-variant": variant}
+
+        router._leg = leg
+        try:
+            # find one key per variant
+            by_variant: dict = {}
+            for n in range(50):
+                v = variant_for_key("salt-1", f"user=u{n}", 50.0)
+                by_variant.setdefault(v, f"u{n}")
+                if len(by_variant) == 2:
+                    break
+            for variant, user in by_variant.items():
+                raw = json.dumps({"user": user}).encode()
+                status, body, got = router.route_query(raw, None)
+                assert got == variant
+                info: dict = {}
+                status, body2, got2 = router.route_query(raw, None, info=info)
+                assert info["cache"] == "hit" and got2 == variant
+                assert body2 == body
+            # zero cross-variant contamination, zero mismatches
+            assert sum(
+                v for _l, v in self._scrape(
+                    router, "pio_router_variant_mismatch_total"
+                )
+            ) == 0
+        finally:
+            router.server_close()
+
+    def test_sharded_single_flight_coalesces_concurrent_queries(self):
+        import threading
+
+        router, _clock = _cached_router(
+            backends=("s0:1", "s1:1"), sharded=True, cache_enabled=False
+        )
+        gate = threading.Event()
+        scatters = {"n": 0}
+
+        def slow_scatter(raw, payload, deadline, trace_id):
+            scatters["n"] += 1
+            gate.wait(5)
+            return 200, {"itemScores": []}, "-"
+
+        router._route_sharded = slow_scatter
+        results = []
+
+        def go():
+            results.append(router.route_query(b'{"user": "u9"}', None))
+
+        try:
+            threads = [threading.Thread(target=go) for _ in range(5)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 5
+            while scatters["n"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.05)
+            gate.set()
+            for t in threads:
+                t.join()
+            assert scatters["n"] == 1 and len(results) == 5
+            assert [v for _l, v in self._scrape(
+                router, "pio_router_coalesced_total"
+            )] == [4.0]
+        finally:
+            router.server_close()
+
+    def test_quota_admission_runs_before_the_cache(self):
+        """The shed path composes: an app over its quota sheds 503 even
+        for a query the cache could answer — admission is the front
+        door, memory is not a side entrance around it."""
+        router, _clock = _cached_router(quotas={"capped": 1})
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        router.start_background()
+        try:
+            payload = {"user": "hot"}
+            status, _body, headers = _post(
+                router.bound_port, payload, headers={APP_HEADER: "capped"}
+            )
+            assert status == 200 and headers.get("x-pio-cache") == "miss"
+            status, _body, headers = _post(
+                router.bound_port, payload, headers={APP_HEADER: "capped"}
+            )
+            assert status == 200 and headers.get("x-pio-cache") == "hit"
+            assert router.admit("capped")  # occupy the only slot
+            try:
+                status, body, _headers = _post(
+                    router.bound_port, payload,
+                    headers={APP_HEADER: "capped"},
+                )
+                assert status == 503 and "quota" in body["message"]
+            finally:
+                router.release("capped")
+            # released: the hot entry answers again
+            status, _body, headers = _post(
+                router.bound_port, payload, headers={APP_HEADER: "capped"}
+            )
+            assert status == 200 and headers.get("x-pio-cache") == "hit"
+        finally:
+            router.kill()
+
+    def test_status_json_cache_block_and_disabled(self):
+        router, _clock = _cached_router()
+        try:
+            block = router.status_json()["cache"]
+            assert block["enabled"] is True
+            assert block["maxEntries"] == 2048 and block["ttlS"] == 30.0
+        finally:
+            router.server_close()
+        off = RouterServer(
+            RouterConfig(
+                ip="127.0.0.1", port=0, backends=("h1:1",),
+                cache_enabled=False,
+            ),
+            clock=FakeClock(),
+        )
+        try:
+            assert off.status_json()["cache"] == {"enabled": False}
+        finally:
+            off.server_close()
+
+
+class TestShardReplicaUnits:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="replicas-per-shard"):
+            RouterServer(RouterConfig(
+                port=0, backends=("a:1", "b:1"), replicas_per_shard=2,
+            ))
+        with pytest.raises(ValueError, match="divide"):
+            RouterServer(RouterConfig(
+                port=0, backends=("a:1", "b:1", "c:1"), sharded=True,
+                replicas_per_shard=2,
+            ))
+        with pytest.raises(ValueError, match=">= 1"):
+            RouterServer(RouterConfig(
+                port=0, backends=("a:1",), sharded=True,
+                replicas_per_shard=0,
+            ))
+
+    def test_shard_replica_ring_math(self):
+        router, _clock = _cached_router(
+            backends=("s0a:1", "s0b:1", "s1a:1", "s1b:1"),
+            sharded=True, replicas_per_shard=2, cache_enabled=False,
+        )
+        try:
+            assert router.shard_count == 2
+            assert router._shard_replicas(0) == ("s0a:1", "s0b:1")
+            assert router._shard_replicas(1) == ("s1a:1", "s1b:1")
+            order = router._ordered_shard_replicas(0, "user=u7")
+            assert sorted(order) == ["s0a:1", "s0b:1"]
+            # pure: same key, same order
+            assert order == router._ordered_shard_replicas(0, "user=u7")
+            # an OPEN breaker leaves the rotation...
+            router.breakers[order[0]]._trip()
+            assert router._ordered_shard_replicas(0, "user=u7") == order[1:]
+            # ...but an all-open group still tries the ring
+            router.breakers[order[1]]._trip()
+            assert sorted(
+                router._ordered_shard_replicas(0, "user=u7")
+            ) == ["s0a:1", "s0b:1"]
+        finally:
+            router.server_close()
+
+    def test_replica_failover_inside_shard(self):
+        router, _clock = _cached_router(
+            backends=("s0a:1", "s0b:1", "s1a:1", "s1b:1"),
+            sharded=True, replicas_per_shard=2, cache_enabled=False,
+        )
+        home = router._ordered_shard_replicas(0, "user=u1")[0]
+
+        def leg(backend, *_a, **_k):
+            if backend == home:
+                raise OSError("connect refused")
+            shard = 0 if backend.startswith("s0") else 1
+            return 200, {
+                "itemScores": [{"item": f"i{shard}", "score": 1.0 - shard}]
+            }, {"x-pio-variant": "-"}
+
+        router._leg = leg
+        try:
+            status, body, _variant = router.route_query(
+                b'{"user": "u1", "num": 5}', None
+            )
+            assert status == 200
+            assert [e["item"] for e in body["itemScores"]] == ["i0", "i1"]
+        finally:
+            router.server_close()
+
+    def test_sharded_504_passes_through_without_tripping_breakers(self):
+        """A backend 504 is the CLIENT's expired budget, not backend
+        sickness — the replicated mode's discipline, now mirrored
+        inside the shard replica groups: no breaker trip, no failover
+        burn, the 504 relays to the client."""
+        router, _clock = _cached_router(
+            backends=("s0a:1", "s0b:1", "s1a:1", "s1b:1"),
+            sharded=True, replicas_per_shard=2, cache_enabled=False,
+        )
+        calls = []
+
+        def leg(backend, *_a, **_k):
+            calls.append(backend)
+            if backend.startswith("s0"):
+                return 504, {"message": "deadline exceeded"}, {}
+            return 200, {"itemScores": []}, {"x-pio-variant": "-"}
+
+        router._leg = leg
+        try:
+            status, body, _v = router.route_query(b'{"user": "u1"}', None)
+            assert status == 504 and "deadline" in body["message"]
+            # exactly ONE s0 replica tried (no failover burned)...
+            assert len([b for b in calls if b.startswith("s0")]) == 1
+            # ...and its breaker holds no failure
+            from predictionio_tpu.utils.resilience import CircuitBreaker
+
+            assert all(
+                router.breakers[b].state == CircuitBreaker.CLOSED
+                for b in router.backends
+            )
+        finally:
+            router.server_close()
+
+    def test_all_replicas_shedding_relays_fleet_overloaded(self):
+        """Every replica of a shard answering 503 is backpressure, not
+        shard death: the read relays as FleetOverloaded (503 +
+        Retry-After) so clients back off, exactly like the replicated
+        ring; a mixed failure stays the loud ShardUnavailable 502."""
+        from predictionio_tpu.fleet.router import (
+            FleetOverloaded,
+            ShardUnavailable,
+        )
+
+        router, _clock = _cached_router(
+            backends=("s0a:1", "s0b:1", "s1a:1", "s1b:1"),
+            sharded=True, replicas_per_shard=2, cache_enabled=False,
+        )
+        router._leg = lambda backend, *a, **k: (
+            (503, {"message": "shed"}, {})
+            if backend.startswith("s1")
+            else (200, {"itemScores": []}, {"x-pio-variant": "-"})
+        )
+        try:
+            with pytest.raises(FleetOverloaded):
+                router.route_query(b'{"user": "u1"}', None)
+
+            def mixed(backend, *_a, **_k):
+                if backend == "s1a:1":
+                    raise OSError("connect refused")
+                if backend == "s1b:1":
+                    return 503, {"message": "shed"}, {}
+                return 200, {"itemScores": []}, {"x-pio-variant": "-"}
+
+            router._leg = mixed
+            with pytest.raises(ShardUnavailable):
+                router.route_query(b'{"user": "u1"}', None)
+        finally:
+            router.server_close()
+
+    def test_dead_shard_names_its_index_and_counts_distinctly(self):
+        from predictionio_tpu.fleet.router import ShardUnavailable
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        router, _clock = _cached_router(
+            backends=("s0a:1", "s0b:1", "s1a:1", "s1b:1"),
+            sharded=True, replicas_per_shard=2, cache_enabled=False,
+        )
+
+        def leg(backend, *_a, **_k):
+            if backend.startswith("s1"):
+                raise OSError("dead")
+            return 200, {"itemScores": []}, {"x-pio-variant": "-"}
+
+        router._leg = leg
+        try:
+            with pytest.raises(ShardUnavailable) as exc_info:
+                router.route_query(b'{"user": "u1"}', None)
+            assert "shard 1" in str(exc_info.value)
+            assert exc_info.value.shards == (1,)
+            scraped = parse_text(render(router.metrics))
+            dead = [
+                (labels, v)
+                for labels, v in scraped.get(
+                    "pio_router_backend_events_total", []
+                )
+                if labels.get("kind") == "dead_shard"
+            ]
+            assert dead == [({"backend": "shard-1", "kind": "dead_shard"}, 1.0)]
+        finally:
+            router.server_close()
 
 
 # ---------------------------------------------------------------------------
@@ -406,6 +1051,10 @@ class TestReplicatedFleet:
                     f"127.0.0.1:{s.bound_port}" for s in backends
                 ),
                 quotas={"capped": 1},
+                # routing behavior is what this class measures — a cache
+                # hit never exercises affinity/failover (TestCachedFleet
+                # owns the cache's own live assertions)
+                cache_enabled=False,
             ),
             registry=fleet_registry[0],
         )
@@ -527,6 +1176,7 @@ class TestShardedFleet:
                     f"127.0.0.1:{s.bound_port}" for s in shards
                 ),
                 sharded=True,
+                cache_enabled=False,  # scatter/gather is the thing under test
             ),
         )
         router.start_background()
@@ -661,6 +1311,23 @@ class TestFleetChaosDrill:
         assert report["clientFailures"] == 0
         assert report["ok"] is True
 
+    def test_sharded_with_replicas_survives_backend_kill(self):
+        """ISSUE 14 acceptance: `--sharded --replicas-per-shard 2
+        --kill-backend-at I` — a sharded fleet survives a backend kill
+        exactly like the replicated fleet does (zero client failures,
+        merged answers still equal the unsharded reference)."""
+        from predictionio_tpu.tools.loadgen import run_fleet_chaos
+
+        report = run_fleet_chaos(
+            replicas=2, sharded=True, replicas_per_shard=2,
+            kill_backend_at=1, queries=24,
+        )
+        assert report["clientFailures"] == 0
+        assert report["killedBackend"] == 1
+        assert report["mergedEqualsUnsharded"] is True
+        assert report["routerRetries"] > 0  # the failover actually ran
+        assert report["ok"] is True
+
     def test_cli_flag_validation(self):
         from predictionio_tpu.tools.loadgen import run_fleet_chaos
 
@@ -668,6 +1335,10 @@ class TestFleetChaosDrill:
             run_fleet_chaos(replicas=1)
         with pytest.raises(ValueError, match="kill-backend-at"):
             run_fleet_chaos(replicas=2, kill_backend_at=5)
+        with pytest.raises(ValueError, match="replicas-per-shard"):
+            run_fleet_chaos(replicas=2, sharded=True, kill_backend_at=0)
+        with pytest.raises(ValueError, match="needs --sharded"):
+            run_fleet_chaos(replicas=2, replicas_per_shard=2)
 
 
 # ---------------------------------------------------------------------------
@@ -785,3 +1456,198 @@ class TestFleetLedger:
 
         record = bench_to_record(self.BENCH)
         assert record["extra"]["servingFleet"]["servedQPS"] == 450.0
+
+
+# ---------------------------------------------------------------------------
+# live cached fleet + the cached-hot-set acceptance drill (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class TestCachedFleet:
+    """A live cache-on router over the module's trained backend: the
+    byte-identity contract over real HTTP, which no stubbed-leg unit
+    can prove."""
+
+    @pytest.fixture(scope="class")
+    def cached_fleet(self, fleet_registry):
+        backend = _backend(fleet_registry)
+        router = RouterServer(
+            RouterConfig(
+                ip="127.0.0.1", port=0,
+                backends=(f"127.0.0.1:{backend.bound_port}",),
+                cache_enabled=True, cache_ttl_s=60.0,
+            ),
+            registry=fleet_registry[0],
+        )
+        router.start_background()
+        yield backend, router
+        for srv in (router, backend):
+            try:
+                srv.kill()
+            except Exception:
+                pass
+
+    def _post_raw(self, port, payload: bytes):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request(
+                "POST", "/queries.json", body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            return resp.status, {
+                k.lower(): v for k, v in resp.getheaders()
+            }, resp.read()
+        finally:
+            conn.close()
+
+    def test_hit_body_is_byte_identical_and_headers_stamped(self, cached_fleet):
+        _backend_srv, router = cached_fleet
+        payload = json.dumps({"user": "u5", "num": 4}).encode()
+        s1, h1, b1 = self._post_raw(router.bound_port, payload)
+        s2, h2, b2 = self._post_raw(router.bound_port, payload)
+        assert s1 == s2 == 200
+        assert h1["x-pio-cache"] == "miss" and h2["x-pio-cache"] == "hit"
+        # the BODY is byte-identical; only trace id / cache verdict differ
+        assert b1 == b2
+        assert h1["x-pio-variant"] == h2["x-pio-variant"]
+        assert h1["x-pio-trace"] != h2["x-pio-trace"]
+        doc = json.loads(b2.decode())
+        assert doc["itemScores"]
+
+    def test_canonicalized_payload_shares_the_line(self, cached_fleet):
+        _backend_srv, router = cached_fleet
+        a = json.dumps({"user": "u7", "num": 3}).encode()
+        b = b'{"num": 3,   "user": "u7"}'  # reordered + respaced
+        s1, h1, b1 = self._post_raw(router.bound_port, a)
+        s2, h2, b2 = self._post_raw(router.bound_port, b)
+        assert s1 == s2 == 200
+        assert h2["x-pio-cache"] == "hit"
+        assert b1 == b2
+
+    def test_router_json_and_top_cache_column(self, cached_fleet):
+        from predictionio_tpu.obs.top import node_row, render_table
+
+        _backend_srv, router = cached_fleet
+        status, body = _get(router.bound_port, "/router.json")
+        assert status == 200
+        cache = json.loads(body)["cache"]
+        assert cache["enabled"] is True and cache["hits"] >= 1
+        row = node_row(f"127.0.0.1:{router.bound_port}")
+        assert row["up"] is True
+        assert row["cache_hit_rate"] is not None
+        assert 0.0 < row["cache_hit_rate"] < 1.0
+        table = render_table([row])
+        assert "CACHE" in table
+
+
+class TestCachedHotSetDrill:
+    def test_step_win_byte_identity_and_zero_stale(self):
+        from predictionio_tpu.tools.loadgen import run_cached_hot_set
+
+        report = run_cached_hot_set(queries=120)
+        assert report["clientFailures"] == 0
+        assert report["byteIdentical"] is True
+        # the rollout-driven invalidation proof: a stage transition
+        # mid-drive yields ZERO stale responses, and the flush actually
+        # happened (epoch invalidations moved)
+        assert report["staleAfterRollout"] == 0
+        assert report["epochInvalidations"] > 0
+        assert report["hitRate"] > 0.3
+        # the step function: serving from memory beats re-fanning out
+        assert report["cachedQPS"] > report["uncachedQPS"]
+        assert report["ok"] is True
+
+
+class TestCacheLedger:
+    BENCH = {
+        "metric": "ml20m_als_rank50_train_s",
+        "value": 12.0,
+        "unit": "s",
+        "device": "TFRT_CPU_0",
+        "scale": 0.01,
+        "cachedFleet": {
+            "replicas": 1,
+            "cachedQPS": 400.0,
+            "uncachedQPS": 120.0,
+            "speedup": 3.33,
+            "hitRate": 0.85,
+            "cachedP50Ms": 4.0,
+            "cachedP99Ms": 40.0,
+            "byteIdentical": True,
+            "staleAfterRollout": 0,
+            "ok": True,
+        },
+    }
+
+    def test_cache_records_shape(self):
+        from predictionio_tpu.obs.perfledger import cache_records
+
+        records = cache_records(self.BENCH)
+        by_metric = {r["metric"]: r for r in records}
+        p99 = by_metric["fleet_cached_p99_s"]
+        assert p99["unit"] == "s" and p99["value"] == pytest.approx(0.04)
+        assert p99["noise_band"] == pytest.approx(0.5)
+        qps = by_metric["fleet_cached_qps"]
+        assert qps["unit"] == "qps"  # trend-only: the gate compares "s"
+        assert qps["extra"]["uncachedQPS"] == 120.0
+        assert qps["extra"]["speedup"] == pytest.approx(3.33)
+        hit = by_metric["fleet_cache_hit_rate"]
+        assert hit["unit"] == "ratio" and hit["value"] == pytest.approx(0.85)
+
+    def test_failed_drive_records_nothing(self):
+        from predictionio_tpu.obs.perfledger import cache_records
+
+        bad = dict(self.BENCH, cachedFleet={"ok": False, "cachedQPS": 9e9})
+        assert cache_records(bad) == []
+        assert cache_records({"metric": "x", "value": 1.0}) == []
+
+    def test_cached_records_never_gate_uncached_fleet_records(self):
+        from predictionio_tpu.obs.perfledger import (
+            cache_records,
+            comparable_key,
+            fleet_records,
+        )
+
+        cached_keys = {comparable_key(r) for r in cache_records(self.BENCH)}
+        fleet_keys = {
+            comparable_key(r)
+            for r in fleet_records(TestFleetLedger.BENCH)
+        }
+        assert cached_keys.isdisjoint(fleet_keys)
+
+    def test_gate_fires_on_cached_p99_collapse_only(self):
+        from predictionio_tpu.obs.perfledger import (
+            cache_records,
+            detect_regressions,
+        )
+
+        def history(p99s):
+            out = []
+            for p99 in p99s:
+                bench = dict(
+                    self.BENCH,
+                    cachedFleet=dict(
+                        self.BENCH["cachedFleet"], cachedP99Ms=p99
+                    ),
+                )
+                out.extend(cache_records(bench))
+            return out
+
+        flat = [40.0, 42.0, 41.0]
+        assert detect_regressions(history(flat)) == []
+        # +40% is inside the declared 0.5 band (CI weather)...
+        assert detect_regressions(history(flat + [57.0])) == []
+        # ...a 2.2x collapse fires
+        flagged = detect_regressions(history(flat + [90.0]))
+        assert [f["key"]["metric"] for f in flagged] == [
+            "fleet_cached_p99_s"
+        ]
+
+    def test_bench_record_carries_cached_block(self):
+        from predictionio_tpu.obs.perfledger import bench_to_record
+
+        record = bench_to_record(self.BENCH)
+        assert record["extra"]["cachedFleet"]["hitRate"] == 0.85

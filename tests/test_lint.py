@@ -166,7 +166,8 @@ class TestRobustFixtures:
     @pytest.mark.parametrize(
         "fixture",
         ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
-         "rename_no_fsync_clean.py", "unbounded_retry_clean.py"],
+         "rename_no_fsync_clean.py", "unbounded_retry_clean.py",
+         "unbounded_cache_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)
@@ -191,6 +192,39 @@ class TestRobustFixtures:
                 if line.strip().startswith("while True")
             ]
         assert [f.line for f in findings] == while_lines
+
+    def test_unbounded_cache_bad_fires_on_both_containers(self):
+        """The bad twin carries TWO unbounded cache shapes (locked
+        module-global dict, OrderedDict attribute over a class); each
+        fires exactly robust-unbounded-cache at its marked store line."""
+        path = os.path.join(FIXTURES, "unbounded_cache_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [
+            "robust-unbounded-cache", "robust-unbounded-cache"
+        ], [(f.rule_id, f.line) for f in findings]
+        with open(path) as fh:
+            marked = [
+                lineno for lineno, line in enumerate(fh, start=1)
+                if "# BAD:" in line
+            ]
+        assert sorted(f.line for f in findings) == marked
+
+    def test_response_cache_is_the_clean_exemplar(self):
+        """fleet/cache.py IS a cache (the name gate engages, it stores
+        under request-derived keys) yet carries zero findings: the LRU
+        popitem under the len() bound and the TTL/epoch drops are the
+        eviction evidence the rule demands."""
+        path = os.path.join(
+            REPO, "predictionio_tpu", "fleet", "cache.py"
+        )
+        findings = [
+            f for f in _unsuppressed(path)
+            if f.rule_id == "robust-unbounded-cache"
+        ]
+        assert findings == [], (
+            f"fleet/cache.py regressed its own bound: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
 
 
 #: family E/F fixture slug → the one rule its bad twin must trip

@@ -241,6 +241,44 @@ class TestStickySplit:
         assert sticky_key({"z": 1, "a": 2}) == sticky_key({"a": 2, "z": 1})
 
 
+class TestPlanEpoch:
+    """The cache-invalidation epoch (docs/fleet.md#response-cache):
+    pure over the plan, and it MUST move for every field that can
+    change what a query is answered with."""
+
+    class _Plan:
+        def __init__(self, **kw):
+            self.id = kw.get("id", "RP-1")
+            self.stage = kw.get("stage", "CANARY")
+            self.percent = kw.get("percent", 10.0)
+            self.salt = kw.get("salt", "s")
+            self.baseline_instance_id = kw.get("baseline", "EI-1")
+            self.candidate_instance_id = kw.get("candidate", "EI-2")
+            self.updated_time = kw.get("updated", "t0")
+
+    def test_deterministic_and_none_is_its_own_epoch(self):
+        from predictionio_tpu.rollout.plan import plan_epoch
+
+        assert plan_epoch(None) == "-"
+        assert plan_epoch(self._Plan()) == plan_epoch(self._Plan())
+        assert plan_epoch(self._Plan()) != "-"
+
+    def test_every_serving_relevant_field_moves_the_epoch(self):
+        from predictionio_tpu.rollout.plan import plan_epoch
+
+        base = plan_epoch(self._Plan())
+        for change in (
+            {"id": "RP-2"},
+            {"stage": "SHADOW"},
+            {"percent": 50.0},
+            {"salt": "other"},
+            {"baseline": "EI-9"},
+            {"candidate": "EI-9"},
+            {"updated": "t1"},
+        ):
+            assert plan_epoch(self._Plan(**change)) != base, change
+
+
 class TestBucketGoldenVectors:
     """Exact bucket ids for fixed (salt, key) pairs.
 
