@@ -260,9 +260,119 @@ def _append_ledger(record: dict) -> None:
         # partition counts never gate each other
         for ingest_record in perfledger.ingest_records(record):
             perfledger.append_record(path, ingest_record)
+        # sharded-train wall clock per shard count, keyed by N via scale
+        # the same way (docs/distributed_training.md): each shard count
+        # has its own gated trajectory, declared wide-band
+        for sharded_record in perfledger.sharded_records(record):
+            perfledger.append_record(path, sharded_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
+
+
+#: Child program for one sharded-train measurement. Runs in a SUBPROCESS
+#: because the virtual device count must be pinned in XLA_FLAGS before
+#: the first `import jax`; the recipe is deterministic in its seed so
+#: every shard count trains the identical dataset (docs/
+#: distributed_training.md — equivalence is pinned in tier-1, this
+#: measures wall clock).
+_SHARDED_SNIPPET = r"""
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from predictionio_tpu.ops.als import ALSConfig, rmse
+from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+shards = {shards}
+rng = np.random.default_rng(7)
+nnz, n_u, n_i = 60_000, 2_000, 600
+w = 1.0 / np.arange(1, n_u + 1) ** 0.8
+u = rng.choice(n_u, size=nnz, p=w / w.sum()).astype(np.int32)
+i = rng.integers(0, n_i, nnz).astype(np.int32)
+v = rng.integers(1, 6, nnz).astype(np.float32)
+cfg = ALSConfig(rank=16, iterations=3, lambda_=0.05, seed=0)
+profile = {{}}
+t0 = time.monotonic()
+factors = als_train_sharded(
+    u, i, v, n_users=n_u, n_items=n_i, cfg=cfg, shards=shards,
+    profile=profile,
+)
+np.asarray(factors.user_factors)
+train_s = time.monotonic() - t0
+import jax
+out = {{
+    "trainS": round(train_s, 3),
+    "rmse": round(rmse(factors, u, i, v), 4),
+    "shards": profile.get("shards"),
+    "device": str(jax.devices()[0]),
+    "nnz": nnz,
+    "iterations": cfg.iterations,
+    "solve_mode": profile.get("solve_mode", "chunked"),
+    "gather_dtype": profile.get("gather_dtype", "f32"),
+    "sort_gather": profile.get("sort_gather", True),
+    "fused_gather": profile.get("fused_gather", False),
+    "flopImbalance": (profile.get("shard_plan") or {{}}).get(
+        "flopImbalance"
+    ),
+}}
+print("SHARDED_JSON " + json.dumps(out))
+"""
+
+
+def run_sharded_train(shard_counts=(1, 2, 4), timeout_s: float = 600.0) -> dict:
+    """Train the small deterministic sharded recipe at each shard count
+    in a forced-virtual-device subprocess; returns the ``shardedTrain``
+    bench block (``counts`` keyed by N, ``ok`` only when every count
+    measured)."""
+    from predictionio_tpu.utils.platform import force_cpu_env
+
+    counts: dict = {}
+    ok = True
+    for n in shard_counts:
+        env = force_cpu_env(n_devices=n)
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    _SHARDED_SNIPPET.format(repo=_REPO_ROOT, shards=n),
+                ],
+                env=env,
+                cwd=_REPO_ROOT,
+                timeout=timeout_s,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        except subprocess.TimeoutExpired:
+            counts[str(n)] = {"error": f"timed out after {timeout_s:.0f}s"}
+            ok = False
+            continue
+        line = next(
+            (
+                ln[len("SHARDED_JSON "):]
+                for ln in proc.stdout.decode("utf-8", "replace").splitlines()
+                if ln.startswith("SHARDED_JSON ")
+            ),
+            None,
+        )
+        if proc.returncode != 0 or line is None:
+            tail = proc.stderr.decode("utf-8", "replace").strip().splitlines()
+            counts[str(n)] = {
+                "error": (
+                    f"rc={proc.returncode}: "
+                    f"{tail[-1] if tail else '(no stderr)'}"
+                )
+            }
+            ok = False
+            continue
+        counts[str(n)] = json.loads(line)
+        print(
+            f"bench shardedTrain: shards={n} "
+            f"train {counts[str(n)]['trainS']}s "
+            f"rmse {counts[str(n)]['rmse']}",
+            file=sys.stderr,
+        )
+    return {"counts": counts, "ok": ok}
 
 
 def run_bench(scale: float, iterations: int, fallback: str) -> int:
@@ -619,6 +729,17 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:
             record["ingestScaling"] = {"error": str(exc)}
+    # Sharded training (docs/distributed_training.md): the ALX-style
+    # shard_map trainer at 1/2/4 shards on forced virtual CPU devices —
+    # subprocesses, because the device count must be pinned before jax
+    # imports. Each shard count's wall clock rides the ledger keyed by N
+    # as `scale` (train_sharded_s), so counts never gate each other.
+    # Opt out with BENCH_SHARDED=0; a failure never fails the bench.
+    if os.environ.get("BENCH_SHARDED") != "0":
+        try:
+            record["shardedTrain"] = run_sharded_train()
+        except Exception as exc:
+            record["shardedTrain"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

@@ -118,6 +118,16 @@ class ContinuousConfig:
     max_offline_divergence: float = 0.75
     #: cooldown after a rollback/quarantine before the next cycle
     quarantine_backoff_s: float = 300.0
+    #: concurrent per-partition fold workers when the feed is partitioned
+    #: and the engine folds per partition
+    #: (docs/continuous.md#partitioned-folds)
+    fold_workers: int = 2
+    #: bound on how long a partitioned fold waits for straggler
+    #: partitions; a partition past the deadline is skipped this cycle —
+    #: its cursor stays put and its delta re-folds next cycle, so a slow
+    #: partition never blocks another's commit. 0 = wait for every
+    #: partition.
+    fold_partition_timeout_s: float = 0.0
     #: start the background tick thread with the server
     autostart: bool = True
 
@@ -617,11 +627,55 @@ class ContinuousController:
             "deltaEvents": len(batch.events) if batch else 0,
             "atS": round(now, 3),
         }
+        # what the candidate will commit at LIVE: the merged cursor by
+        # default; the partitioned fold path narrows it to the partitions
+        # whose fold actually completed
+        commit_upto = batch.upto_seq if batch else self.watcher.position
+        commit_oldest = batch.oldest_event_ms if batch else None
         try:
             if mode == FOLD_IN:
-                instance_id, fold_stats = self._fold_in_candidate(
-                    dep, batch, pd
-                )
+                part_batches = None
+                take_batches = getattr(self.watcher, "take_batches", None)
+                if take_batches is not None and dep.algorithms and all(
+                    hasattr(a, "fold_in_partitioned") for a in dep.algorithms
+                ):
+                    part_batches = take_batches()
+                if part_batches and len(part_batches) > 1:
+                    (
+                        instance_id, fold_stats, completed, skipped,
+                    ) = self._fold_in_candidate_partitioned(
+                        dep, part_batches, pd
+                    )
+                    if instance_id is None:
+                        # drift escalation: NOTHING was committed —
+                        # reporting partitions as "completed" here would
+                        # mislead the status surface
+                        cycle["foldPartitions"] = {
+                            "escalated": sorted(part_batches),
+                        }
+                    else:
+                        cycle["foldPartitions"] = {
+                            "completed": completed, "skipped": skipped,
+                        }
+                    if instance_id is not None:
+                        # only the completed partitions' cursors advance
+                        # at LIVE; a skipped partition keeps its delta
+                        # pending (re-folded next cycle, never lost)
+                        commit_upto = {
+                            str(i): part_batches[i].upto_seq
+                            for i in completed
+                        }
+                        commit_oldest = min(
+                            part_batches[i].oldest_event_ms
+                            for i in completed
+                        )
+                        cycle["deltaEvents"] = sum(
+                            len(part_batches[i].events) for i in completed
+                        )
+                else:
+                    instance_id, fold_stats = self._fold_in_candidate(
+                        dep, batch, pd
+                    )
                 if fold_stats is not None:
                     cycle["foldIn"] = fold_stats
                 if instance_id is None:  # drift escalation inside the fold
@@ -679,8 +733,8 @@ class ContinuousController:
             needs_resync = self._feed_gap
         cand = {
             "instanceId": instance_id,
-            "uptoSeq": batch.upto_seq if batch else self.watcher.position,
-            "oldestMs": batch.oldest_event_ms if batch else None,
+            "uptoSeq": commit_upto,
+            "oldestMs": commit_oldest,
             "mode": mode,
             "submitted": False,
             "createdS": now,
@@ -771,6 +825,59 @@ class ContinuousController:
                 models.append(folded)
             instance_id = self._persist_candidate(dep, models, FOLD_IN)
         return instance_id, stats_json
+
+    def _fold_in_candidate_partitioned(
+        self, dep, part_batches, pd
+    ) -> Tuple[Optional[str], Optional[dict], List[int], List[int]]:
+        """Concurrent per-partition folds on a bounded pool
+        (docs/continuous.md#partitioned-folds): every algorithm folds
+        each partition's delta against the same base model; a partition
+        whose fold missed ``fold_partition_timeout_s`` (or raised) is
+        SKIPPED — counted, excluded from the commit set, its delta
+        re-folds next cycle — so a slow partition never blocks another
+        partition's commit. Returns ``(instance_id | None, stats_json,
+        completed, skipped)``; ``None`` = drift escalation, exactly like
+        the merged fold path."""
+        ctx = self.server.ctx
+        cfg = self.config
+        parts = {
+            i: (b.user_ids, b.item_ids) for i, b in part_batches.items()
+        }
+        with self.server.tracer.span("continuous.fold"):
+            models = []
+            stats_json: Optional[dict] = None
+            completed_all: Optional[set] = None
+            for algo, model in zip(dep.algorithms, dep.models):
+                folded, stats, completed = algo.fold_in_partitioned(
+                    ctx, model, pd, parts,
+                    policy=cfg.policy,
+                    max_workers=cfg.fold_workers,
+                    timeout_s=cfg.fold_partition_timeout_s,
+                )
+                if stats.rmse_drift > cfg.policy.max_rmse_drift:
+                    return None, stats.to_json(), sorted(parts), []
+                stats_json = stats.to_json()
+                models.append(folded)
+                # multi-algorithm engines commit the INTERSECTION: a
+                # partition folded into one model but skipped by another
+                # re-folds next cycle (convergent — the watcher's replay
+                # contract)
+                completed_all = (
+                    set(completed)
+                    if completed_all is None
+                    else completed_all & set(completed)
+                )
+            done = sorted(completed_all or [])
+            skipped = sorted(set(parts) - set(done))
+            for _ in skipped:
+                self._fold_event("partition_skipped")
+            if not done:
+                raise RuntimeError(
+                    f"no partition fold completed (partitions "
+                    f"{sorted(parts)} all timed out or failed)"
+                )
+            instance_id = self._persist_candidate(dep, models, FOLD_IN)
+        return instance_id, stats_json, done, skipped
 
     def _full_retrain_candidate(self, dep) -> str:
         """The existing train/persist path, parameter-identical to the

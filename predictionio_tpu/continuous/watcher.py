@@ -573,6 +573,17 @@ class PartitionedFeedWatcher:
             oldest_event_ms=min(b.oldest_event_ms for _i, b in parts),
         )
 
+    def take_batches(self) -> Optional[Dict[int, "DeltaBatch"]]:
+        """Per-partition snapshots of the pending delta — the partitioned
+        fold path's input (docs/continuous.md#partitioned-folds): the
+        controller folds each partition's delta concurrently and commits
+        ONLY the partitions whose fold completed, so a slow partition
+        never gates another's cursor. Same non-clearing contract as
+        :meth:`take_batch`: :meth:`commit` drops consumed events."""
+        parts = {i: w.take_batch() for i, w in enumerate(self.watchers)}
+        parts = {i: b for i, b in parts.items() if b is not None}
+        return parts or None
+
     def commit(self, upto_seq) -> None:
         """Advance each partition's durable cursor through its own
         ``upto_seq`` entry (absent partitions had nothing in the batch

@@ -13,9 +13,12 @@ predict is the batched gather-dot top-k kernel
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 from ..controller import (
     Algorithm,
@@ -215,6 +218,16 @@ class ALSAlgorithmParams(Params):
     #: the factor-table layout (see :func:`ops.als.als_train`).
     distributed: bool = False
     factor_sharding: str = "replicated"
+    #: Train with BOTH factor tables sharded over N devices via the
+    #: ALX-style shard_map trainer (ops.als_sharded.als_train_sharded,
+    #: docs/distributed_training.md). Tri-state per the PR-12 lever
+    #: discipline: an explicit N wins, None resolves from
+    #: ``PIO_TRAIN_SHARDS`` (what ``pio train --shards N`` sets), else 1 —
+    #: the single-device trainer, byte-identical config resolution to
+    #: today's path. Mutually exclusive with ``distributed`` (the
+    #: pjit-annotation path) and ``checkpoint_every`` — conflicts fail
+    #: loudly at train time, never silently pick one.
+    shards: Optional[int] = None
     #: checkpoint factor tables every N iterations (0 = off); a rerun of the
     #: same workflow resumes from the newest step
     checkpoint_every: int = 0
@@ -299,6 +312,42 @@ class ALSAlgorithm(Algorithm):
             sort_gather_indices=p.sort_gather_indices,
             fused_gather=p.fused_gather,
         )
+        from ..ops.als_sharded import als_train_sharded, resolve_shards
+
+        shards = resolve_shards(p.shards)
+        if shards > 1:
+            # the ALX-style sharded data plane (docs/distributed_training
+            # .md): both factor tables sharded over the mesh data axis.
+            # Conflicting levers fail loudly — a silently ignored flag
+            # would corrupt the hardware A/B (the PR-12 discipline).
+            if p.distributed:
+                raise ValueError(
+                    "shards > 1 and distributed=True are mutually "
+                    "exclusive: the sharded trainer builds its own mesh "
+                    "(pass one or the other)"
+                )
+            if p.checkpoint_every > 0:
+                raise ValueError(
+                    "checkpoint_every is not supported with shards > 1 "
+                    "yet (sharded step-resume is hardware-day headroom, "
+                    "docs/distributed_training.md#headroom)"
+                )
+            factors = als_train_sharded(
+                pd.users,
+                pd.items,
+                pd.ratings,
+                n_users=len(pd.user_map),
+                n_items=len(pd.item_map),
+                cfg=cfg,
+                shards=shards,
+            )
+            return ALSModel(
+                rank=p.rank,
+                user_factors=np.asarray(factors.user_factors),
+                item_factors=np.asarray(factors.item_factors),
+                user_map=pd.user_map,
+                item_map=pd.item_map,
+            )
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None
         if p.checkpoint_every > 0 and ctx is not None:
@@ -338,6 +387,54 @@ class ALSAlgorithm(Algorithm):
         retrain instead (docs/continuous.md)."""
         return not self.params.implicit_prefs
 
+    def _fold_base(self, model: ALSModel, pd: PreparedData) -> dict:
+        """The fold prologue shared by :meth:`fold_in` and
+        :meth:`fold_in_partitioned`: extend the model's id maps with
+        pd's universe (stable indices), translate pd's COO into the
+        combined space, and seed rows for new entities. Deterministic in
+        (model, pd) — every concurrent partition fold starts from this
+        SAME extended base, which is what makes their results
+        mergeable."""
+        from ..continuous.foldin import extend_bimap_indexing, seeded_rows
+
+        p = self.params
+        rank = model.user_factors.shape[1]
+        old_u, old_i = len(model.user_map), len(model.item_map)
+        # pd's maps are freshly built in arrival order — append the ids
+        # the baseline has never seen, preserving every existing index
+        pd_u_ids = [pd.user_map.inverse[i] for i in range(len(pd.user_map))]
+        pd_i_ids = [pd.item_map.inverse[i] for i in range(len(pd.item_map))]
+        comb_u, new_u = extend_bimap_indexing(model.user_map.to_dict(), pd_u_ids)
+        comb_i, new_i = extend_bimap_indexing(model.item_map.to_dict(), pd_i_ids)
+        # translate pd's index space into the combined space via id strings
+        t_u = np.asarray([comb_u[k] for k in pd_u_ids], dtype=np.int32)
+        t_i = np.asarray([comb_i[k] for k in pd_i_ids], dtype=np.int32)
+        uf = np.concatenate(
+            [
+                np.asarray(model.user_factors, dtype=np.float32),
+                seeded_rows(new_u, rank, p.seed, offset=old_u),
+            ]
+        )
+        itf = np.concatenate(
+            [
+                np.asarray(model.item_factors, dtype=np.float32),
+                seeded_rows(new_i, rank, p.seed + 1, offset=old_i),
+            ]
+        )
+        return {
+            "rank": rank,
+            "old_u": old_u,
+            "old_i": old_i,
+            "new_u": new_u,
+            "new_i": new_i,
+            "comb_u": comb_u,
+            "comb_i": comb_i,
+            "users": t_u[pd.users],
+            "items": t_i[pd.items],
+            "uf": uf,
+            "itf": itf,
+        }
+
     def fold_in(
         self,
         ctx,
@@ -356,9 +453,7 @@ class ALSAlgorithm(Algorithm):
         from ..continuous.foldin import (
             FoldInPolicy,
             FoldInStats,
-            extend_bimap_indexing,
             fold_in_factors,
-            seeded_rows,
         )
         from ..ops.als import ALSFactors, rmse
 
@@ -368,44 +463,22 @@ class ALSAlgorithm(Algorithm):
                 "implicit_prefs=True models must retrain fully"
             )
         policy = policy or FoldInPolicy()
-        p = self.params
-        rank = model.user_factors.shape[1]
-        old_u, old_i = len(model.user_map), len(model.item_map)
-        # pd's maps are freshly built in arrival order — append the ids
-        # the baseline has never seen, preserving every existing index
-        pd_u_ids = [pd.user_map.inverse[i] for i in range(len(pd.user_map))]
-        pd_i_ids = [pd.item_map.inverse[i] for i in range(len(pd.item_map))]
-        comb_u, new_u = extend_bimap_indexing(model.user_map.to_dict(), pd_u_ids)
-        comb_i, new_i = extend_bimap_indexing(model.item_map.to_dict(), pd_i_ids)
-        # translate pd's index space into the combined space via id strings
-        t_u = np.asarray([comb_u[k] for k in pd_u_ids], dtype=np.int32)
-        t_i = np.asarray([comb_i[k] for k in pd_i_ids], dtype=np.int32)
-        users = t_u[pd.users]
-        items = t_i[pd.items]
-        uf = np.concatenate(
-            [
-                np.asarray(model.user_factors, dtype=np.float32),
-                seeded_rows(new_u, rank, p.seed, offset=old_u),
-            ]
-        )
-        itf = np.concatenate(
-            [
-                np.asarray(model.item_factors, dtype=np.float32),
-                seeded_rows(new_i, rank, p.seed + 1, offset=old_i),
-            ]
-        )
+        base = self._fold_base(model, pd)
+        rank, users, items = base["rank"], base["users"], base["items"]
+        uf, itf = base["uf"], base["itf"]
+        comb_u, comb_i = base["comb_u"], base["comb_i"]
         changed_u = sorted(
             {comb_u[k] for k in changed_user_ids if k in comb_u}
-            | set(range(old_u, old_u + new_u))
+            | set(range(base["old_u"], base["old_u"] + base["new_u"]))
         )
         changed_i = sorted(
             {comb_i[k] for k in changed_item_ids if k in comb_i}
-            | set(range(old_i, old_i + new_i))
+            | set(range(base["old_i"], base["old_i"] + base["new_i"]))
         )
         before = rmse(ALSFactors(uf, itf, rank), users, items, pd.ratings)
         uf, itf, counts = fold_in_factors(
             uf, itf, users, items, pd.ratings,
-            changed_u, changed_i, p.lambda_, policy=policy,
+            changed_u, changed_i, self.params.lambda_, policy=policy,
         )
         after = rmse(ALSFactors(uf, itf, rank), users, items, pd.ratings)
         folded = ALSModel(
@@ -418,12 +491,176 @@ class ALSAlgorithm(Algorithm):
         stats = FoldInStats(
             folded_users=counts["solved_users"],
             folded_items=counts["solved_items"],
-            new_users=new_u,
-            new_items=new_i,
+            new_users=base["new_u"],
+            new_items=base["new_i"],
             rmse_before=before,
             rmse_after=after,
         )
         return folded, stats
+
+    def fold_in_partitioned(
+        self,
+        ctx,
+        model: ALSModel,
+        pd: PreparedData,
+        parts,
+        policy=None,
+        max_workers: int = 2,
+        timeout_s: float = 0.0,
+        clock=None,
+    ):
+        """Fold per-partition deltas CONCURRENTLY on a bounded pool
+        (docs/continuous.md#partitioned-folds).
+
+        ``parts`` maps partition index → ``(user_ids, item_ids)`` — the
+        per-keyspace deltas ``PartitionedFeedWatcher.take_batches``
+        yields. Every partition's fold runs :func:`fold_in_factors` over
+        the SAME extended base tables (so results merge by row copy):
+        the write-path hash partitions users, making the per-partition
+        changed-user row sets disjoint; changed-item rows may overlap and
+        merge last-partition-wins — both solves read the full rating
+        corpus against the same base, so the difference is bounded by the
+        user-row deltas and the RMSE drift gate guards the composition.
+
+        ``timeout_s > 0`` bounds the wait: a partition whose fold has not
+        finished by the deadline (or raised) is SKIPPED — excluded from
+        the merge and from the returned ``completed`` list, so the
+        controller never commits its cursor and its delta re-folds next
+        cycle (convergent, the watcher's replay contract). A slow
+        partition therefore never blocks another partition's commit.
+        ``timeout_s == 0`` waits for every partition.
+
+        Returns ``(ALSModel, FoldInStats, completed)`` — stats measured
+        on the MERGED model. Raises ``RuntimeError`` when no partition
+        completed (nothing to commit)."""
+        import concurrent.futures
+        import time as _time
+
+        from ..continuous.foldin import (
+            FoldInPolicy,
+            FoldInStats,
+            fold_in_factors,
+        )
+        from ..ops.als import ALSFactors, rmse
+
+        if not self.fold_in_supported:
+            raise ValueError(
+                "fold_in solves explicit normal equations; "
+                "implicit_prefs=True models must retrain fully"
+            )
+        policy = policy or FoldInPolicy()
+        clock = clock or _time.monotonic
+        base = self._fold_base(model, pd)
+        rank, users, items = base["rank"], base["users"], base["items"]
+        comb_u, comb_i = base["comb_u"], base["comb_i"]
+        new_u_rows = set(range(base["old_u"], base["old_u"] + base["new_u"]))
+        new_i_rows = set(range(base["old_i"], base["old_i"] + base["new_i"]))
+        changed: dict = {}
+        claimed_u: set = set()
+        claimed_i: set = set()
+        for idx in sorted(parts):
+            user_ids, item_ids = parts[idx]
+            cu = {comb_u[k] for k in user_ids if k in comb_u}
+            ci = {comb_i[k] for k in item_ids if k in comb_i}
+            changed[idx] = (cu, ci)
+            claimed_u |= cu
+            claimed_i |= ci
+        # new entities nobody's delta named (races between the batch
+        # snapshot and the pd read) go to EVERY partition: identical
+        # inputs solve to identical rows, so whichever folds complete
+        # cover them and the merge copies are byte-equal
+        orphan_u = new_u_rows - claimed_u
+        orphan_i = new_i_rows - claimed_i
+        for idx, (cu, ci) in changed.items():
+            cu |= orphan_u
+            ci |= orphan_i
+
+        before = rmse(
+            ALSFactors(base["uf"], base["itf"], rank),
+            users, items, pd.ratings,
+        )
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, min(len(changed), int(max_workers))),
+            thread_name_prefix="fold-part",
+        )
+        futures = {
+            idx: pool.submit(
+                fold_in_factors,
+                base["uf"], base["itf"], users, items, pd.ratings,
+                sorted(cu), sorted(ci), self.params.lambda_, policy=policy,
+            )
+            for idx, (cu, ci) in sorted(changed.items())
+        }
+        deadline = clock() + timeout_s if timeout_s > 0 else None
+        concurrent.futures.wait(
+            futures.values(),
+            timeout=None if deadline is None else max(0.0, deadline - clock()),
+        )
+        # a wedged fold thread keeps running past the deadline (threads
+        # cannot be killed) but is bounded by the pool size and holds
+        # only the shared read-only base arrays; never join on it —
+        # queued-but-unstarted folds ARE cancellable and must not burn
+        # the next cycle's CPU on thrown-away results
+        pool.shutdown(wait=False, cancel_futures=True)
+        uf = np.array(base["uf"], dtype=np.float32, copy=True)
+        itf = np.array(base["itf"], dtype=np.float32, copy=True)
+        completed = []
+        folded_users = folded_items = 0
+        for idx in sorted(futures):
+            fut = futures[idx]
+            if not fut.done() or fut.cancelled():
+                # timed out (or cancelled while queued): cursor stays
+                # put, delta re-folds next cycle
+                _logger.warning(
+                    "fold_in_partitioned: partition %d missed the "
+                    "%.1fs deadline; skipped (delta re-folds)",
+                    idx, timeout_s,
+                )
+                continue
+            if fut.exception() is not None:
+                # a failing partition must be DIAGNOSABLE, not a bare
+                # skip counter: the error is logged here, the cursor
+                # stays put, and the delta re-folds (a deterministic
+                # failure keeps logging every cycle — loud by design)
+                _logger.warning(
+                    "fold_in_partitioned: partition %d fold failed; "
+                    "skipped (delta re-folds)",
+                    idx, exc_info=fut.exception(),
+                )
+                continue
+            uf_p, itf_p, counts = fut.result()
+            cu, ci = changed[idx]
+            cu_rows = np.asarray(sorted(cu), dtype=np.int64)
+            ci_rows = np.asarray(sorted(ci), dtype=np.int64)
+            if len(cu_rows):
+                uf[cu_rows] = uf_p[cu_rows]
+            if len(ci_rows):
+                itf[ci_rows] = itf_p[ci_rows]
+            completed.append(idx)
+            folded_users += counts["solved_users"]
+            folded_items += counts["solved_items"]
+        if not completed:
+            raise RuntimeError(
+                f"no partition fold completed within {timeout_s}s "
+                f"(partitions {sorted(futures)}) — nothing to commit"
+            )
+        after = rmse(ALSFactors(uf, itf, rank), users, items, pd.ratings)
+        folded = ALSModel(
+            rank=model.rank,
+            user_factors=uf,
+            item_factors=itf,
+            user_map=BiMap(comb_u),
+            item_map=BiMap(comb_i),
+        )
+        stats = FoldInStats(
+            folded_users=folded_users,
+            folded_items=folded_items,
+            new_users=base["new_u"],
+            new_items=base["new_i"],
+            rmse_before=before,
+            rmse_after=after,
+        )
+        return folded, stats, completed
 
     def shard_model(
         self, model: ALSModel, shard_index: int, shard_count: int

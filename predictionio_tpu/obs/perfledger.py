@@ -48,6 +48,7 @@ __all__ = [
     "make_record",
     "quality_records",
     "render_trend",
+    "sharded_records",
 ]
 
 SCHEMA_VERSION = 1
@@ -139,6 +140,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
                 "quality", "bf16_gate", "ingestScaling", "cachedFleet",
+                "shardedTrain",
             )
             if key in bench
         },
@@ -398,6 +400,57 @@ def ingest_records(bench: dict, source: str = "bench") -> List[dict]:
                     },
                 )
             )
+    return out
+
+
+def sharded_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The sharded-train numbers a bench run attached
+    (``bench["shardedTrain"]``, from the forced-virtual-device subprocess
+    drive — docs/distributed_training.md) as their own ledger records:
+
+    - ``train_sharded_s`` — wall-clock of the small sharded recipe (unit
+      ``s``, lower-better → gated), with the SHARD COUNT as ``scale``
+      exactly like ``ingest_acked_qps`` carries its partition count:
+      ``comparable_key`` groups by scale, so ``pio perf diff`` never
+      gates a 4-shard run against a 1-shard run — each N has its own
+      trajectory. Records declare a wide ``noise_band`` (0.5): the drive
+      is a subprocess on a possibly-contended CI box, so only a collapse
+      should fire the gate, not scheduler weather.
+
+    A failed drive (``ok`` false) records nothing — its wall-clock
+    measured a broken run, not the code."""
+    block = bench.get("shardedTrain")
+    if not isinstance(block, dict) or not block.get("ok"):
+        return []
+    out: List[dict] = []
+    counts = block.get("counts") or {}
+    for key in sorted(counts, key=lambda k: int(k)):
+        row = counts[key] or {}
+        train_s = row.get("trainS")
+        if isinstance(train_s, (int, float)) and train_s > 0:
+            record = make_record(
+                source=source,
+                metric="train_sharded_s",
+                value=float(train_s),
+                unit="s",
+                device=row.get("device"),
+                scale=int(key),
+                levers={
+                    "solve_mode": row.get("solve_mode", "chunked"),
+                    "gather_dtype": row.get("gather_dtype", "f32"),
+                    "sort_gather": bool(row.get("sort_gather", True)),
+                    "fused_gather": bool(row.get("fused_gather", False)),
+                    "fallback": "",
+                },
+                rmse=row.get("rmse"),
+                extra={
+                    k: row[k]
+                    for k in ("nnz", "iterations", "flopImbalance")
+                    if k in row
+                },
+            )
+            record["noise_band"] = 0.5
+            out.append(record)
     return out
 
 

@@ -11,6 +11,7 @@ from .als import (
     predict_pairs,
     rmse,
 )
+from .als_sharded import als_train_sharded, resolve_shards
 from . import classifier, forest, markov, naive_bayes
 from .scoring import (
     standardize,
@@ -32,6 +33,8 @@ __all__ = [
     "BucketedMatrix",
     "als_train",
     "als_train_coo",
+    "als_train_sharded",
+    "resolve_shards",
     "bucketize",
     "predict_pairs",
     "rmse",

@@ -439,6 +439,23 @@ class ALSConfig:
 # ---------------------------------------------------------------------------
 # Device kernels
 # ---------------------------------------------------------------------------
+def _system_explicit_g(g, val, mask, lam, rank):
+    """Normal equations from ALREADY-GATHERED masked factors ``g``
+    [B, K, R] — the math half of :func:`_system_explicit`, split out so
+    the sharded trainer's pipelined off-shard gathers
+    (``ops/als_sharded.py``) can issue the gather separately from the
+    solve it feeds."""
+    # Batched Gramian: MXU matmul [B, R, K] @ [B, K, R]
+    a = jnp.einsum("bkr,bks->brs", g, g, preferred_element_type=jnp.float32)
+    n_u = mask.astype(jnp.float32).sum(axis=1)  # [B]
+    a = a + (lam * n_u)[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
+    b = jnp.einsum(
+        "bkr,bk->br", g, val.astype(g.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return a, b
+
+
 def _system_explicit(y, idx, val, mask, lam, rank):
     """Normal equations for one row block (traceable body).
 
@@ -448,12 +465,23 @@ def _system_explicit(y, idx, val, mask, lam, rank):
     A_u = Gᵀ G + λ n_u I,  b_u = Gᵀ r_u   (G = masked gathered factors)
     """
     g = y[idx] * mask[..., None]  # [B, K, R]
-    # Batched Gramian: MXU matmul [B, R, K] @ [B, K, R]
-    a = jnp.einsum("bkr,bks->brs", g, g, preferred_element_type=jnp.float32)
-    n_u = mask.astype(jnp.float32).sum(axis=1)  # [B]
+    return _system_explicit_g(g, val, mask, lam, rank)
+
+
+def _system_implicit_g(g, yty, val, mask, lam, alpha, rank):
+    """Implicit-feedback normal equations from already-gathered masked
+    factors ``g`` [B, K, R] (see :func:`_system_explicit_g`)."""
+    maskf = mask.astype(jnp.float32)
+    c_minus_1 = (alpha * jnp.abs(val)) * maskf  # [B, K]
+    pref = (val > 0).astype(jnp.float32) * maskf  # [B, K]
+    a = yty[None] + jnp.einsum(
+        "bkr,bk,bks->brs", g, c_minus_1.astype(g.dtype), g,
+        preferred_element_type=jnp.float32,
+    )
+    n_u = maskf.sum(axis=1)
     a = a + (lam * n_u)[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
     b = jnp.einsum(
-        "bkr,bk->br", g, val.astype(g.dtype),
+        "bkr,bk->br", g, ((1.0 + c_minus_1) * pref).astype(g.dtype),
         preferred_element_type=jnp.float32,
     )
     return a, b
@@ -469,20 +497,7 @@ def _system_implicit(y, yty, idx, val, mask, lam, alpha, rank):
     from sign — a negative rating is high-confidence "not preferred").
     """
     g = y[idx] * mask[..., None]  # [B, K, R]
-    maskf = mask.astype(jnp.float32)
-    c_minus_1 = (alpha * jnp.abs(val)) * maskf  # [B, K]
-    pref = (val > 0).astype(jnp.float32) * maskf  # [B, K]
-    a = yty[None] + jnp.einsum(
-        "bkr,bk,bks->brs", g, c_minus_1.astype(g.dtype), g,
-        preferred_element_type=jnp.float32,
-    )
-    n_u = maskf.sum(axis=1)
-    a = a + (lam * n_u)[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
-    b = jnp.einsum(
-        "bkr,bk->br", g, ((1.0 + c_minus_1) * pref).astype(g.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    return a, b
+    return _system_implicit_g(g, yty, val, mask, lam, alpha, rank)
 
 
 def _cho_solve(a, b):

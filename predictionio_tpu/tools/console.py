@@ -639,6 +639,9 @@ _WORKFLOW_FLAGS = [
     ("--stop-after-read", {"action": "store_true"}),
     ("--stop-after-prepare", {"action": "store_true"}),
     ("--eval-parallelism", {"type": int, "default": 0}),
+    ("--shards", {"type": int, "default": None, "metavar": "N",
+                  "help": "train with both factor tables sharded over N "
+                          "devices (docs/distributed_training.md)"}),
 ]
 
 
@@ -721,6 +724,10 @@ def _workflow_argv(args: argparse.Namespace, extra: Sequence[str] = ()) -> List[
             argv.append("--" + flag.replace("_", "-"))
     if getattr(args, "eval_parallelism", 0):
         argv += ["--eval-parallelism", str(args.eval_parallelism)]
+    if getattr(args, "shards", None) is not None:
+        # forward an explicit 0 too: it must fail loudly in
+        # resolve_shards, never silently train single-device
+        argv += ["--shards", str(args.shards)]
     return argv + list(extra)
 
 

@@ -48,6 +48,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--eval-parallelism", type=int, default=0,
         help="sweep parallelism over mesh slices (0 = auto, 1 = serial)",
     )
+    p.add_argument(
+        "--shards", type=int, default=None, metavar="N",
+        help="train with both factor tables sharded over N devices "
+             "(ALX-style shard_map trainer, docs/distributed_training.md); "
+             "sets PIO_TRAIN_SHARDS, which the algorithm's `shards` "
+             "tri-state resolves from — an explicit engine.json value "
+             "still wins",
+    )
     return p
 
 
@@ -57,6 +65,37 @@ def run(
     """Execute one train or eval run; returns the instance id
     (``CreateWorkflow.main``, ``CreateWorkflow.scala:142-279``)."""
     loader.modify_logging(args.verbose)
+    if getattr(args, "shards", None) is not None:
+        # an explicit 0 must reach resolve_shards and fail loudly there
+        # — a falsy check would silently train single-device
+        # the tri-state env the algorithm's `shards=None` resolves from
+        # (ops.als_sharded.resolve_shards) — env-driven like every other
+        # config tier, so --spawn and in-process runs behave identically.
+        # Scoped to this run: an in-process console must not leak the
+        # flag into a later train in the same process.
+        from ..ops.als_sharded import SHARDS_ENV
+
+        return _with_env(
+            SHARDS_ENV, str(args.shards), lambda: _run_inner(args, registry)
+        )
+    return _run_inner(args, registry)
+
+
+def _with_env(key: str, value: str, fn):
+    prior = os.environ.get(key)
+    os.environ[key] = value
+    try:
+        return fn()
+    finally:
+        if prior is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prior
+
+
+def _run_inner(
+    args: argparse.Namespace, registry: Optional[StorageRegistry] = None
+) -> str:
     registry = registry or get_registry()
     wp = WorkflowParams(
         batch=args.batch,

@@ -912,3 +912,175 @@ class TestClosedLoopE2E:
             assert status["state"] == "MONITORING"  # still auto-submitted
         finally:
             srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-15 satellite: per-partition fold-in parallelism
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionedFoldParallelism:
+    """The controller folds per-partition deltas CONCURRENTLY on a
+    bounded pool (docs/continuous.md#partitioned-folds): a slow
+    partition is skipped — its cursor stays put and its delta re-folds
+    next cycle — so it never blocks another partition's commit, and no
+    folded event is ever lost or double-committed."""
+
+    def _als_loop(self, registry, tmp_path, monkeypatch, **cfg_kw):
+        import predictionio_tpu.storage.registry as regmod
+
+        from predictionio_tpu.controller.engine import EngineParams
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithmParams,
+            RecDataSourceParams,
+            engine_factory,
+        )
+
+        # RecDataSource reads the process-default registry
+        monkeypatch.setattr(regmod, "_default_registry", registry)
+        store = registry.get_events()
+        store.init(1)
+        seed = [
+            _rate(f"u{u}", f"i{i}", 4.0)
+            for u in range(8) for i in range(5)
+        ]
+        store.write(seed, 1)
+        engine = engine_factory()
+        ep = EngineParams(
+            data_source_params=("", RecDataSourceParams(app_id=1)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2)),
+            ],
+        )
+        run_train(
+            engine, ep, registry,
+            workflow_params=WorkflowParams(batch="als-base"),
+        )
+        feeds, cfs = [], []
+        for p in range(2):
+            cf = Changefeed(
+                OpLog(str(tmp_path / f"oplog{p}")),
+                store, registry.get_metadata(), registry.get_models(),
+            )
+            cfs.append(cf)
+            feeds.append(LocalFeed(cf.oplog))
+        clock = FakeClock()
+        srv = QueryServer(
+            ServerConfig(ip="127.0.0.1", port=0, batching=False),
+            engine, registry, clock=clock,
+        )
+        defaults = dict(
+            app_id=1,
+            min_events=2,
+            max_staleness_s=1e9,
+            rollout_gates=_gates(),
+            state_dir=str(tmp_path / "cstate"),
+        )
+        defaults.update(cfg_kw)
+        ctl = ContinuousController(
+            srv, ContinuousConfig(**defaults), feed=feeds, clock=clock
+        )
+        srv.continuous = ctl
+        return srv, ctl, cfs, clock
+
+    def _promote(self, srv, ctl, clock):
+        for _round in range(6):
+            if not srv.rollout.active:
+                break
+            for k in range(8):
+                _r, status = srv.handle_query(
+                    {"user": f"u{k % 8}", "num": 2}
+                )
+                assert status == 200
+            srv.rollout.drain_shadow()
+            clock.advance(11.0)
+            for k in range(3):
+                _r, status = srv.handle_query(
+                    {"user": f"u{(k + 3) % 8}", "num": 2}
+                )
+                assert status == 200
+            srv.rollout.drain_shadow()
+        ctl.tick()
+
+    def test_partitions_fold_concurrently_and_both_commit(
+        self, registry, tmp_path, monkeypatch
+    ):
+        srv, ctl, cfs, clock = self._als_loop(registry, tmp_path, monkeypatch)
+        try:
+            cfs[0].insert_event(_rate("u0", "i0", 5.0), 1)
+            cfs[0].insert_event(_rate("u2", "i1", 5.0), 1)
+            cfs[1].insert_event(_rate("u1", "i0", 5.0), 1)
+            cfs[1].insert_event(_rate("u3", "i2", 5.0), 1)
+            status = ctl.tick()
+            assert status["lastCycle"]["mode"] == FOLD_IN
+            parts = status["lastCycle"]["foldPartitions"]
+            assert parts == {"completed": [0, 1], "skipped": []}
+            # BOTH partitions' cursors ride the candidate
+            assert set(status["candidate"]["uptoSeq"]) == {"0", "1"}
+            assert status["lastCycle"]["deltaEvents"] == 4
+            self._promote(srv, ctl, clock)
+            status = ctl.status()
+            assert status["lastCycle"]["outcome"] == "live"
+            # every partition committed, nothing left pending
+            assert status["pendingEvents"] == 0
+            for w in ctl.watcher.watchers:
+                assert w.cursor_seq > 0
+        finally:
+            srv.server_close()
+
+    def test_slow_partition_skipped_never_blocks_commit(
+        self, registry, tmp_path, monkeypatch
+    ):
+        import time as _time
+
+        import predictionio_tpu.continuous.foldin as foldin_mod
+
+        srv, ctl, cfs, clock = self._als_loop(
+            registry, tmp_path, monkeypatch,
+            fold_workers=2,
+            fold_partition_timeout_s=0.5,
+        )
+        try:
+            cfs[0].insert_event(_rate("u0", "i0", 5.0), 1)
+            cfs[0].insert_event(_rate("u2", "i1", 5.0), 1)
+            cfs[1].insert_event(_rate("u1", "i0", 5.0), 1)
+            cfs[1].insert_event(_rate("u3", "i2", 5.0), 1)
+            slow_row = srv.deployment.models[0].user_map["u0"]
+            orig = foldin_mod.fold_in_factors
+
+            def slow_p0(uf, itf, u, i, r, cu, ci, lam, policy=None):
+                if slow_row in cu:  # partition 0 owns u0
+                    _time.sleep(2.0)
+                return orig(uf, itf, u, i, r, cu, ci, lam, policy=policy)
+
+            monkeypatch.setattr(foldin_mod, "fold_in_factors", slow_p0)
+            status = ctl.tick()
+            parts = status["lastCycle"]["foldPartitions"]
+            assert parts == {"completed": [1], "skipped": [0]}
+            # ONLY the completed partition's cursor rides the candidate
+            assert set(status["candidate"]["uptoSeq"]) == {"1"}
+            assert status["lastCycle"]["deltaEvents"] == 2
+            assert ctl._folds.value(kind="partition_skipped") == 1
+            monkeypatch.setattr(foldin_mod, "fold_in_factors", orig)
+            self._promote(srv, ctl, clock)
+            status = ctl.status()
+            # partition 1 committed; partition 0's delta is PENDING, not
+            # lost — and partition 1 has nothing left to re-fold
+            w0, w1 = ctl.watcher.watchers
+            assert w1.cursor_seq > 0 and w1.pending_count() == 0
+            assert w0.cursor_seq == 0 and w0.pending_count() == 2
+            # the commit tick already started the NEXT cycle over the
+            # still-pending delta: it re-folds ONLY the skipped
+            # partition's 2 events — nothing re-folds partition 1, so no
+            # folded event is ever duplicated. A single-partition delta
+            # rides the merged fold path (no foldPartitions block).
+            assert status["lastCycle"]["mode"] == FOLD_IN
+            assert status["lastCycle"]["deltaEvents"] == 2
+            assert "foldPartitions" not in status["lastCycle"]
+            self._promote(srv, ctl, clock)
+            status = ctl.status()
+            assert status["lastCycle"]["outcome"] == "live"
+            assert w0.cursor_seq > 0 and w0.pending_count() == 0
+            assert status["pendingEvents"] == 0
+        finally:
+            srv.server_close()

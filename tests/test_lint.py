@@ -244,7 +244,50 @@ _SPMD_FIXTURES = [
     ("shard_map_arity", "spmd-shard-map-arity"),
     ("unordered_operand", "spmd-unordered-collective-operand"),
     ("host_dependent_rng", "spmd-host-dependent-rng"),
+    ("collective_missing_axis", "spmd-collective-missing-axis"),
 ]
+
+
+class TestShardedTrainerExemplar:
+    """ops/als_sharded.py is the spmd family's clean exemplar BY TEST:
+    its shard_map-mapped body carries a psum + all_gather the rules
+    genuinely inspect (proven by mutating the source: stripping the
+    psum's axis makes the new rule fire), and the real file is clean."""
+
+    _PSUM_CALL = "jax.lax.psum(local_yty, SHARD_AXIS)"
+
+    def _path(self):
+        return os.path.join(
+            REPO, "predictionio_tpu", "ops", "als_sharded.py"
+        )
+
+    def test_sharded_trainer_is_clean(self):
+        findings = [
+            f
+            for f in _unsuppressed(self._path())
+            if f.rule_id.startswith("spmd-")
+        ]
+        assert findings == [], (
+            f"als_sharded.py regressed the spmd contract: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_rule_genuinely_engages_on_the_trainer(self):
+        """Strip the Gramian psum's axis argument and the new rule must
+        fire — the exemplar is inside the rule's scope, not skipped."""
+        with open(self._path(), encoding="utf-8") as fh:
+            src = fh.read()
+        assert self._PSUM_CALL in src  # the collective the pin rides on
+        mutated = src.replace(self._PSUM_CALL, "jax.lax.psum(local_yty)")
+        findings = [
+            f
+            for f in lint_file(self._path(), source=mutated)
+            if f.rule_id == "spmd-collective-missing-axis"
+        ]
+        assert len(findings) == 1, (
+            f"expected the axis-stripped psum to fire exactly once, got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
 
 
 class TestConcSpmdFixtures:
@@ -968,7 +1011,7 @@ class TestSelfLintGate:
         for _slug, rule_id in _CONC_FIXTURES + _SPMD_FIXTURES:
             assert rule_id in ids, f"{rule_id} missing from all_rules()"
         assert sum(1 for i in ids if i.startswith("conc-")) >= 6
-        assert sum(1 for i in ids if i.startswith("spmd-")) >= 6
+        assert sum(1 for i in ids if i.startswith("spmd-")) >= 7
 
     def test_rule_catalog_is_documented(self):
         """docs/lint.md is the catalog the suppression workflow points
