@@ -265,6 +265,10 @@ def _append_ledger(record: dict) -> None:
         # has its own gated trajectory, declared wide-band
         for sharded_record in perfledger.sharded_records(record):
             perfledger.append_record(path, sharded_record)
+        # lint-sweep cold wall clock, trend-only (docs/lint.md#cache):
+        # the warm time and cache byte-identity ride in extra
+        for lint_record in perfledger.lint_records(record):
+            perfledger.append_record(path, lint_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -317,6 +321,38 @@ out = {{
 }}
 print("SHARDED_JSON " + json.dumps(out))
 """
+
+
+def run_lint_sweep() -> dict:
+    """Cold-vs-warm full-package lint sweep with a throwaway cache;
+    returns the ``lintSweep`` bench block (``coldS``/``warmS``/
+    ``files``/``identical``, ``ok`` only when both sweeps ran clean of
+    engine errors AND the warm findings were byte-identical). The
+    engine is stdlib-only, so this runs in-process on any box."""
+    import tempfile
+
+    from predictionio_tpu.lint import lint_paths, render_json
+
+    package_dir = os.path.join(_REPO_ROOT, "predictionio_tpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = os.path.join(tmp, "lint_cache.json")
+        t0 = time.perf_counter()
+        cold = lint_paths([package_dir], cache_path=cache)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = lint_paths([package_dir], cache_path=cache)
+        warm_s = time.perf_counter() - t0
+    identical = render_json(cold) == render_json(warm)
+    return {
+        "coldS": cold_s,
+        "warmS": warm_s,
+        "files": cold.files,
+        "findings": len(cold.findings),
+        "identical": identical,
+        "ok": bool(
+            not cold.errors and not warm.errors and identical
+        ),
+    }
 
 
 def run_sharded_train(shard_counts=(1, 2, 4), timeout_s: float = 600.0) -> dict:
@@ -740,6 +776,17 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             record["shardedTrain"] = run_sharded_train()
         except Exception as exc:
             record["shardedTrain"] = {"error": str(exc)}
+    # Lint-sweep wall clock (docs/lint.md#cache): cold vs warm over the
+    # package with a throwaway cache, in-process (the linter is stdlib-
+    # only — no device, no subprocess needed). Rides the ledger trend-
+    # only as lint_wall_s; `identical` pins the cache contract where a
+    # regression would show in history. Opt out with BENCH_LINT=0; a
+    # failure never fails the bench.
+    if os.environ.get("BENCH_LINT") != "0":
+        try:
+            record["lintSweep"] = run_lint_sweep()
+        except Exception as exc:
+            record["lintSweep"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

@@ -8,7 +8,7 @@ full compile-debug cycle. The bug classes are mechanical, so this
 package catches them at AST level, before XLA/Mosaic ever runs: the
 "catch it in the graph, not on the device" discipline.
 
-Six rule families (see ``docs/lint.md`` for the full catalog):
+Seven rule families (see ``docs/lint.md`` for the full catalog):
 
 - **Family A — Mosaic/Pallas hygiene** (``rules_mosaic``): applied to
   functions passed to ``pl.pallas_call`` (plus helpers they call) and to
@@ -29,6 +29,18 @@ Six rule families (see ``docs/lint.md`` for the full catalog):
   ISSUE 6): applied package-wide; guards the distributed-training arc
   against host-divergent collectives, axis-name/spec drift, unordered
   operand construction, and host-dependent RNG. Rule ids ``spmd-*``.
+- **Family G — cross-file flow rules** (``rules_flow`` over the
+  ``packagectx`` call graph, ISSUE 16): blocking helpers invoked under
+  a held lock, deadlines dropped at module boundaries, started threads
+  with no reachable stop story, and the call-graph upgrade of
+  ``spmd-collective-missing-axis`` that judges ``*args``/``**kwargs``
+  forwarding. One-level resolution by contract; what does not resolve
+  is not judged. Rule ids ``flow-*``.
+
+The engine is incremental: full default-rule sweeps keep a result cache
+keyed by content hash, import-closure hash (for ``flow-*``) and rules
+signature, and the per-file pass runs in worker processes — both speed
+levers only, never able to change a verdict (``docs/lint.md#cache``).
 
 Suppression: ``# pio: lint-ok[rule-id] reason`` on the finding's line or
 as a comment-only line directly above. The reason is mandatory — a bare

@@ -16,12 +16,22 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import os
 import re
 import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+#: bumped whenever any rule's judgment OR the fact-extraction schema
+#: changes: the incremental cache keys every stored result (findings
+#: AND the per-file fact tables flow rules judge) on (this, the
+#: registered rule set), so an analysis edit invalidates the whole
+#: cache instead of serving verdicts a previous version produced. A
+#: stale cache can therefore never suppress a finding the current
+#: rules would raise.
+RULES_VERSION = "2"
 
 #: ``# pio: lint-ok[rule-a, rule-b] free-text reason``
 _SUPPRESS_RE = re.compile(
@@ -691,6 +701,7 @@ def build_context(path: str, source: Optional[str] = None) -> FileContext:
 def all_rules() -> List[Rule]:
     from . import (
         rules_conc,
+        rules_flow,
         rules_jit,
         rules_mosaic,
         rules_obs,
@@ -705,7 +716,26 @@ def all_rules() -> List[Rule]:
         *rules_obs.RULES,
         *rules_conc.RULES,
         *rules_spmd.RULES,
+        *rules_flow.RULES,
     ]
+
+
+def _split_rules(rules: Sequence[Rule]):
+    """(per-file rules, package-scope flow rules)."""
+    from .rules_flow import FlowRule
+
+    file_rules = [r for r in rules if not isinstance(r, FlowRule)]
+    flow_rules = [r for r in rules if isinstance(r, FlowRule)]
+    return file_rules, flow_rules
+
+
+def rules_signature(rules: Sequence[Rule]) -> str:
+    """Cache key component: RULES_VERSION plus a digest of the
+    registered (id, class) pairs — adding, removing, or re-homing a
+    rule invalidates every cached verdict."""
+    ids = ",".join(sorted({f"{r.id}/{type(r).__name__}" for r in rules}))
+    digest = hashlib.sha256(ids.encode("utf-8")).hexdigest()[:16]
+    return f"{RULES_VERSION}:{digest}"
 
 
 @dataclasses.dataclass
@@ -720,6 +750,11 @@ class LintResult:
     baselined: List[Finding] = dataclasses.field(default_factory=list)
     #: files that failed to parse: (path, error)
     errors: List[tuple] = dataclasses.field(default_factory=list)
+    #: what the engine actually did this run (cache hits, files parsed,
+    #: files whose flow-* verdicts ran vs. came from cache) — the cache
+    #: contract's observable surface; diagnostics, not part of the JSON
+    #: document
+    stats: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -731,8 +766,19 @@ def _apply_suppressions(
     raw: Iterable[Finding],
     active_rule_ids: Set[str],
 ) -> Iterator[Finding]:
+    return _match_suppressions(
+        ctx.path, ctx.suppressions, raw, active_rule_ids
+    )
+
+
+def _match_suppressions(
+    path: str,
+    suppressions: List[_Suppression],
+    raw: Iterable[Finding],
+    active_rule_ids: Set[str],
+) -> Iterator[Finding]:
     by_line: Dict[int, List[_Suppression]] = {}
-    for sup in ctx.suppressions:
+    for sup in suppressions:
         by_line.setdefault(sup.line, []).append(sup)
     for finding in sorted(raw, key=lambda f: (f.line, f.col, f.rule_id)):
         matched = None
@@ -758,11 +804,11 @@ def _apply_suppressions(
     # a suppression is a claim someone reviewed the exception; without a
     # reason the claim is unreviewable — and the self-lint gate requires
     # every suppression in the tree to justify itself
-    for sup in ctx.suppressions:
+    for sup in suppressions:
         if not sup.reason:
             yield Finding(
                 rule_id="lint-suppression-missing-reason",
-                path=ctx.path,
+                path=path,
                 line=sup.line,
                 col=1,
                 message=(
@@ -778,7 +824,7 @@ def _apply_suppressions(
         elif not sup.used and sup.rule_ids & active_rule_ids:
             yield Finding(
                 rule_id="lint-unused-suppression",
-                path=ctx.path,
+                path=path,
                 line=sup.line,
                 col=1,
                 message=(
@@ -823,15 +869,163 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(root, name)
 
 
+#: minimum per-file passes before a parallel run pays for its process
+#: pool (fork + pickle overhead beats a serial parse below this)
+_PARALLEL_MIN = 12
+
+
+def _file_pass(path: str, module: str, select: Optional[Set[str]]):
+    """One file's parse + per-file rules + fact extraction. Module-level
+    and default-rule-set-only so it is picklable into a worker process.
+    Returns (path, facts | None, raw per-file Findings | None, error)."""
+    from . import packagectx
+    from .rules_flow import FlowRule
+
+    try:
+        ctx = build_context(path)
+    # SyntaxError: does not parse. ValueError: null bytes, and the
+    # UnicodeDecodeError subclass for non-UTF8 files. OSError: file
+    # vanished/unreadable mid-walk. All must be a recorded parse
+    # error (and a nonzero exit), never a traceback that costs the
+    # watcher its JSON document.
+    except (SyntaxError, ValueError, OSError) as exc:
+        return (path, None, None, f"{type(exc).__name__}: {exc}")
+    rules = [r for r in all_rules() if not isinstance(r, FlowRule)]
+    if select:
+        rules = [r for r in rules if r.id in select]
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(ctx))
+    return (path, packagectx.extract_facts(ctx, module), raw, None)
+
+
+def _run_file_passes(
+    todo: Sequence[str],
+    modules: Dict[str, str],
+    select: Optional[Set[str]],
+    jobs: int,
+    custom_file_rules: Optional[Sequence[Rule]],
+) -> Dict[str, tuple]:
+    """path → (facts, raw findings, error) for every file needing a
+    fresh per-file pass; process-parallel when the batch is big enough
+    (and the rule set is the picklable default), serial otherwise. Any
+    pool failure falls back to the serial path — parallelism is a speed
+    lever, never a correctness dependency."""
+    out: Dict[str, tuple] = {}
+    if custom_file_rules is None and jobs > 1 and len(todo) >= _PARALLEL_MIN:
+        try:
+            import concurrent.futures as cf
+
+            with cf.ProcessPoolExecutor(
+                max_workers=min(jobs, len(todo))
+            ) as pool:
+                futures = [
+                    pool.submit(_file_pass, p, modules[p], select)
+                    for p in todo
+                ]
+                for fut in futures:
+                    path, facts, raw, err = fut.result()
+                    out[path] = (facts, raw, err)
+            return out
+        except Exception:
+            out = {}
+    for path in todo:
+        if custom_file_rules is None:
+            _, facts, raw, err = _file_pass(path, modules[path], select)
+            out[path] = (facts, raw, err)
+            continue
+        from . import packagectx
+
+        try:
+            ctx = build_context(path)
+        except (SyntaxError, ValueError, OSError) as exc:
+            out[path] = (None, None, f"{type(exc).__name__}: {exc}")
+            continue
+        raw = []
+        for rule in custom_file_rules:
+            raw.extend(rule.check(ctx))
+        out[path] = (
+            packagectx.extract_facts(ctx, modules[path]), raw, None
+        )
+    return out
+
+
+def _load_cache(path: str) -> Optional[dict]:
+    """Best-effort cache read: a missing, torn, or corrupt file is
+    simply a cold sweep — never an error, never a different verdict."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("files"), dict):
+        return None
+    return doc
+
+
+def _save_cache(path: str, doc: dict) -> None:
+    """Atomic best-effort write (tmp + rename): a half-written cache
+    must never exist for the next run to trust, and a read-only target
+    dir must not fail the lint run that earned its verdict."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        # pio: lint-ok[robust-rename-no-fsync] the cache is best-effort by contract: a torn or missing file just reads as a cold sweep, so durability buys nothing here
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _finding_from_dict(doc: dict, path: str) -> Finding:
+    return Finding(
+        rule_id=doc["rule"],
+        path=path,
+        line=doc["line"],
+        col=doc["col"],
+        message=doc["message"],
+        severity=doc.get("severity", "error"),
+    )
+
+
 def lint_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[Rule]] = None,
     select: Optional[Set[str]] = None,
+    *,
+    cache_path: Optional[str] = None,
+    jobs: int = 0,
 ) -> LintResult:
-    """Lint files/directories. ``select`` restricts to those rule ids."""
-    rules = list(rules) if rules is not None else all_rules()
+    """Lint files/directories. ``select`` restricts to those rule ids.
+
+    Two phases: a per-file pass (parse, families A–F, fact extraction —
+    parallelizable across ``jobs`` worker processes) and a package pass
+    (assemble :class:`~.packagectx.PackageContext` from the facts, run
+    the ``flow-*`` rules). With ``cache_path`` set *and* the default
+    rule set (no ``rules``/``select`` — partial rule sets must never
+    write results a full run would trust), results are cached per file:
+
+    - per-file findings + facts under the file's content hash;
+    - ``flow-*`` findings under (content hash, hash of the transitive
+      package-internal import closure's content hashes) — editing a
+      helper invalidates exactly the flow verdicts of every file that
+      can reach it through imports, and nothing else;
+    - everything under :func:`rules_signature`, so a rules change
+      invalidates the world. A stale cache can never suppress a
+      finding: any mismatch falls back to a fresh judgment.
+
+    ``result.stats`` reports what actually ran (``cache_hits``,
+    ``parsed``, ``flow_ran``, ``flow_cached``) — the contract the cache
+    tests pin."""
+    from . import packagectx
+
+    base = list(rules) if rules is not None else all_rules()
     if select:
-        rules = [r for r in rules if r.id in select]
+        base = [r for r in base if r.id in select]
+    file_rules, flow_rules = _split_rules(base)
     result = LintResult()
     # a target that does not exist must fail the run: the gate reading
     # exit 0 / ok=true as "lint-clean" must never get it from a typo'd
@@ -840,22 +1034,130 @@ def lint_paths(
     for p in missing:
         result.errors.append((p, "no such file or directory"))
     paths = [p for p in paths if p not in missing]
-    for path in iter_python_files(paths):
+    files = list(iter_python_files(paths))
+    roots = [os.path.abspath(p) for p in paths if os.path.isdir(p)]
+    modules = {p: packagectx.module_name_for(p, roots) for p in files}
+
+    cache_ok = bool(cache_path) and rules is None and select is None
+    sig = rules_signature(base)
+    cache = _load_cache(cache_path) if cache_ok else None
+    if cache is not None and cache.get("rules") != sig:
+        cache = None
+    cached_files: Dict[str, dict] = (cache or {}).get("files", {})
+
+    hashes: Dict[str, str] = {}
+    per_file: Dict[str, tuple] = {}
+    hits: List[str] = []
+    todo: List[str] = []
+    for path in files:
         result.files += 1
         try:
-            findings = lint_file(path, rules=rules)
-        # SyntaxError: does not parse. ValueError: null bytes, and the
-        # UnicodeDecodeError subclass for non-UTF8 files. OSError: file
-        # vanished/unreadable mid-walk. All must be a recorded parse
-        # error (and a nonzero exit), never a traceback that costs the
-        # watcher its JSON document.
-        except (SyntaxError, ValueError, OSError) as exc:
-            result.errors.append(
-                (path, f"{type(exc).__name__}: {exc}")
-            )
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError as exc:
+            per_file[path] = (None, None, f"OSError: {exc}")
             continue
-        for f in findings:
-            (result.suppressed if f.suppressed else result.findings).append(f)
+        hashes[path] = packagectx.content_hash(data)
+        ent = cached_files.get(os.path.abspath(path))
+        if (
+            ent
+            and ent.get("hash") == hashes[path]
+            and isinstance(ent.get("facts"), dict)
+            and isinstance(ent.get("local"), list)
+        ):
+            facts = dict(ent["facts"])
+            facts["path"] = path  # display path of THIS run
+            per_file[path] = (
+                facts,
+                [_finding_from_dict(d, path) for d in ent["local"]],
+                None,
+            )
+            hits.append(path)
+        else:
+            todo.append(path)
+
+    per_file.update(_run_file_passes(
+        todo, modules, select, jobs,
+        file_rules if rules is not None else None,
+    ))
+
+    table: Dict[str, dict] = {}
+    hash_of_module: Dict[str, str] = {}
+    for path in files:
+        facts = per_file.get(path, (None, None, None))[0]
+        if facts is not None:
+            table[modules[path]] = facts
+            hash_of_module[modules[path]] = hashes.get(path, "")
+    pctx = packagectx.PackageContext(table)
+
+    def deps_hash(module: str) -> str:
+        closure = sorted(pctx.import_closure(module))
+        blob = "|".join(
+            f"{m}={hash_of_module.get(m, '?')}" for m in closure
+        )
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+    active_ids = {r.id for r in base}
+    flow_ran: List[str] = []
+    flow_cached = 0
+    new_files: Dict[str, dict] = {}
+    for path in files:
+        facts, raw_local, err = per_file.get(path, (None, None, None))
+        if err is not None:
+            result.errors.append((path, err))
+            continue
+        if facts is None:
+            continue
+        module = modules[path]
+        dh = deps_hash(module)
+        ent = cached_files.get(os.path.abspath(path))
+        flow_doc = (ent or {}).get("flow") or {}
+        if (
+            path in hits
+            and flow_doc.get("deps") == dh
+            and isinstance(flow_doc.get("raw"), list)
+        ):
+            raw_flow = [
+                _finding_from_dict(d, path) for d in flow_doc["raw"]
+            ]
+            flow_cached += 1
+        else:
+            raw_flow = []
+            for rule in flow_rules:
+                raw_flow.extend(rule.check_module(module, pctx))
+            flow_ran.append(path)
+        sups = [
+            _Suppression(
+                line=ln, rule_ids=set(ids), reason=reason,
+                comment_only=comment_only,
+            )
+            for ln, ids, reason, comment_only in facts["suppressions"]
+        ]
+        for f in _match_suppressions(
+            path, sups, list(raw_local) + raw_flow, active_ids
+        ):
+            (result.suppressed if f.suppressed else
+             result.findings).append(f)
+        if cache_ok:
+            new_files[os.path.abspath(path)] = {
+                "hash": hashes[path],
+                "facts": facts,
+                "local": [f.as_dict() for f in raw_local],
+                "flow": {
+                    "deps": dh,
+                    "raw": [f.as_dict() for f in raw_flow],
+                },
+            }
+    if cache_ok:
+        _save_cache(cache_path, {
+            "version": 1, "rules": sig, "files": new_files,
+        })
+    result.stats = {
+        "cache_hits": len(hits),
+        "parsed": sorted(todo),
+        "flow_ran": sorted(flow_ran),
+        "flow_cached": flow_cached,
+    }
     return result
 
 

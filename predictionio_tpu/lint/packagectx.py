@@ -1,0 +1,863 @@
+"""Package-wide analysis layer: per-file fact tables + a one-level
+call graph over them.
+
+The per-file rules (families A–F) reason inside one
+:class:`~predictionio_tpu.lint.engine.FileContext`; every one of them
+ships a documented blind spot of the same shape — "the helper is
+defined in another module, so the call is invisible". This module
+closes that gap without giving up the engine's two properties:
+
+- **stdlib-only** — ``ast`` + ``hashlib``; the linter must run where
+  jax cannot import.
+- **per-file incrementality** — a file's facts are a pure function of
+  its source, expressed as JSON-serializable dicts (no AST nodes), so
+  the engine can extract them in a worker process, cache them under a
+  content hash, and rebuild the package view without re-parsing
+  unchanged files.
+
+:func:`extract_facts` boils one parsed file down to a fact dict:
+function signatures, the blocking/collective calls each function makes
+directly, the call sites each function issues (with the lock set held
+at each site), class thread/lifecycle facts, the import table, and the
+suppression comments. :class:`PackageContext` assembles the fact dicts
+of every file in the lint scope and resolves call references through
+the import table — direct calls, ``functools.partial`` locals,
+``self.method`` through single-inheritance base classes — **one level
+deep**. The flow rules (:mod:`rules_flow`) are judges over this
+resolution; they never see an AST from another file.
+
+Resolution contract (documented in docs/lint.md#family-g): a reference
+that does not resolve to a function in the lint scope is *not judged*
+— third-party and stdlib callees get the benefit of the doubt, exactly
+like the per-file rules treat ``**kwargs`` splats.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import (
+    FileContext,
+    call_name,
+    dotted_name,
+    is_partial_call,
+    walk_in_scope,
+)
+
+#: thread-constructor tails the thread-lifecycle facts track
+_THREAD_CTORS = ("Thread", "Timer")
+
+#: lifecycle method names (plus any ``stop*``-prefixed method) from
+#: which a worker's stop/join story must be reachable
+_LIFECYCLE_NAMES = frozenset(
+    {"close", "server_close", "shutdown", "__exit__", "__del__"}
+)
+
+
+def is_lifecycle_method(name: str) -> bool:
+    return name in _LIFECYCLE_NAMES or name.startswith("stop")
+
+
+def module_name_for(path: str, roots: Sequence[str]) -> str:
+    """Dotted module name for ``path`` given the directory targets of
+    the lint run: ``<root>/fleet/router.py`` under root
+    ``.../predictionio_tpu`` → ``predictionio_tpu.fleet.router`` (the
+    root's basename is the package name, so absolute imports inside the
+    package resolve). A file outside every root takes its package name
+    from the ``__init__.py`` chain above it — ``--changed`` passes bare
+    files, and naming them by stem alone would silently unresolve every
+    absolute import between them — and only a file with no package at
+    all is its bare stem."""
+    abspath = os.path.abspath(path)
+    for root in roots:
+        root = os.path.abspath(root)
+        if abspath == root or abspath.startswith(root + os.sep):
+            rel = os.path.relpath(abspath, root)
+            parts = rel.replace(os.sep, "/").split("/")
+            parts[-1] = parts[-1][:-3]  # strip .py
+            if parts[-1] == "__init__":
+                parts.pop()
+            return ".".join([os.path.basename(root)] + parts) or \
+                os.path.basename(root)
+    stem = os.path.basename(abspath)
+    stem = stem[:-3] if stem.endswith(".py") else stem
+    pkg_parts: List[str] = []
+    d = os.path.dirname(abspath)
+    while d and os.path.isfile(os.path.join(d, "__init__.py")):
+        pkg_parts.insert(0, os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    if pkg_parts:
+        if stem == "__init__":
+            return ".".join(pkg_parts)
+        return ".".join(pkg_parts + [stem])
+    return stem
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    tail = dn.rsplit(".", 1)[-1]
+    return tail in _THREAD_CTORS and dn in (tail, f"threading.{tail}")
+
+
+def _collect_imports(tree: ast.Module, module: str) -> Dict[str, str]:
+    """Local name → dotted target. Targets are ``"a.b"`` (a module) or
+    ``"a.b:sym"`` (a symbol of module ``a.b`` — which may itself turn
+    out to be the submodule ``a.b.sym``; :class:`PackageContext`
+    disambiguates against the actual module table at resolve time).
+    Relative imports are resolved against ``module``'s package."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    # `import a.b.c` binds `a`; dotted uses walk from it
+                    out[alias.name.split(".")[0]] = alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # level 1 = the containing package, each extra level one up
+                parts = module.split(".")
+                cut = len(parts) - node.level
+                if cut < 0:
+                    continue
+                base = ".".join(parts[:cut])
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            if not base:
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = f"{base}:{alias.name}"
+    return out
+
+
+def _import_modules(imports: Dict[str, str]) -> List[str]:
+    """Candidate module dependencies for an import table. A
+    ``"mod:sym"`` target contributes BOTH ``mod`` and ``mod.sym``:
+    ``from pkg import sub`` binds a submodule that call resolution will
+    follow (``_resolve_import`` promotes it), so the dependency set that
+    keys flow caching and the ``--changed`` reverse closure must cover
+    it too — a candidate that turns out not to be a module just fails
+    to resolve in ``internal_imports``. The resolver and the dependency
+    set must never disagree: an edge the resolver can follow but the
+    deps miss is a stale cached verdict waiting to suppress a finding."""
+    out: Set[str] = set()
+    for target in imports.values():
+        mod, _, sym = target.partition(":")
+        out.add(mod)
+        if sym:
+            out.add(f"{mod}.{sym}")
+    return sorted(out)
+
+
+def _call_ref(
+    call: ast.Call,
+    module_funcs: Set[str],
+    partials: Dict[str, Tuple[str, int]],
+) -> Tuple[str, int]:
+    """(reference string, prebound-positional-count) for a call site,
+    or ("", 0) when the callee is not a resolvable shape (a call on an
+    arbitrary expression)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id in partials:
+            ref, bound = partials[fn.id]
+            return ref, bound
+        if fn.id in module_funcs:
+            return f"local:{fn.id}", 0
+        return f"name:{fn.id}", 0
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+            return f"self:{fn.attr}", 0
+        dn = dotted_name(fn)
+        if dn:
+            return f"dotted:{dn}", 0
+    return "", 0
+
+
+def _local_partials(
+    fn: ast.AST,
+    module_funcs: Set[str],
+) -> Dict[str, Tuple[str, int]]:
+    """``cb = functools.partial(helper, a, b)`` locals: name →
+    (reference to the wrapped callable, count of prebound positionals).
+    A later ``cb(...)`` call then resolves through the partial — the
+    call-graph edge the tentpole names."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in walk_in_scope(fn):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and is_partial_call(node.value)
+            and node.value.args
+        ):
+            continue
+        inner = node.value.args[0]
+        bound = len(node.value.args) - 1
+        if isinstance(inner, ast.Name):
+            ref = (
+                f"local:{inner.id}" if inner.id in module_funcs
+                else f"name:{inner.id}"
+            )
+            out[node.targets[0].id] = (ref, bound)
+        elif isinstance(inner, ast.Attribute):
+            if isinstance(inner.value, ast.Name) and inner.value.id == "self":
+                out[node.targets[0].id] = (f"self:{inner.attr}", bound)
+            else:
+                dn = dotted_name(inner)
+                if dn:
+                    out[node.targets[0].id] = (f"dotted:{dn}", bound)
+    return out
+
+
+def _iter_with_lockstate(
+    root: ast.AST, holds
+) -> Iterator[Tuple[ast.AST, Set[str]]]:
+    """(node, held-lock-labels) over one execution scope; nested
+    function/class bodies restart with an empty lock set (an enclosing
+    ``with`` wraps their definition, not their execution)."""
+
+    def visit(node: ast.AST, held: Set[str]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.ClassDef),
+            ):
+                continue  # their calls are extracted via their own facts
+            now = held
+            if isinstance(child, ast.With):
+                got = holds(child)
+                if got:
+                    now = held | got
+            yield child, now
+            yield from visit(child, now)
+
+    yield from visit(root, set())
+
+
+def _self_attr_of(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+_DEADLINE_FACTORIES = frozenset({"from_header", "after_ms"})
+
+
+def _acquires_deadline(fn: ast.AST) -> bool:
+    """True when the function's body binds or scopes a deadline: an
+    assignment from ``current_deadline()`` / ``Deadline.from_header`` /
+    ``Deadline.after_ms``, or a ``with deadline_scope(...)`` block."""
+    for node in walk_in_scope(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name == "current_deadline":
+                return True
+            if name in _DEADLINE_FACTORIES and \
+                    "Deadline" in dotted_name(node.func):
+                return True
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        call_name(item.context_expr) == "deadline_scope":
+                    return True
+    return False
+
+
+def _collective_fact(
+    call: ast.Call, vararg: Optional[str], kwarg: Optional[str]
+) -> Optional[dict]:
+    from . import rules_spmd
+
+    if not rules_spmd._is_collective(call):
+        return None
+    axis_idx = rules_spmd._COLLECTIVES[call_name(call)]
+    pre = sum(1 for a in call.args if not isinstance(a, ast.Starred))
+    # an axis is statically present only via axis_name= or a real (non-
+    # Starred) positional in the axis slot — _collective_axis_arg would
+    # count a `*args` splat AT the slot as an axis, which is exactly
+    # the case this fact exists to judge at call sites
+    has_axis = any(
+        kw.arg == "axis_name" for kw in call.keywords
+    ) or (
+        not any(isinstance(a, ast.Starred) for a in call.args)
+        and len(call.args) > axis_idx
+    )
+    splat_own = any(
+        isinstance(a, ast.Starred)
+        and isinstance(a.value, ast.Name)
+        and vararg is not None
+        and a.value.id == vararg
+        for a in call.args
+    ) or any(
+        kw.arg is None
+        and isinstance(kw.value, ast.Name)
+        and kwarg is not None
+        and kw.value.id == kwarg
+        for kw in call.keywords
+    )
+    other_splat = (
+        any(isinstance(a, ast.Starred) for a in call.args)
+        or any(kw.arg is None for kw in call.keywords)
+    ) and not splat_own
+    return {
+        "name": dotted_name(call.func),
+        "line": call.lineno,
+        # ok: axis statically present, OR splatted from something that
+        # is not the enclosing function's own *args/**kwargs (benefit
+        # of the doubt — not statically knowable even via call sites)
+        "ok": has_axis or other_splat,
+        # vararg: the axis slot can only be filled by the enclosing
+        # function's own *args/**kwargs — judged at its call sites
+        "vararg": splat_own and not has_axis and pre <= axis_idx,
+    }
+
+
+def _function_facts(
+    fn: ast.FunctionDef,
+    cls_name: Optional[str],
+    ctx: FileContext,
+    module_funcs: Set[str],
+    class_locks: Dict[str, str],
+) -> dict:
+    from . import rules_conc
+
+    args = fn.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    if cls_name and params and params[0] in ("self", "cls"):
+        params = params[1:]
+    kwonly = [a.arg for a in args.kwonlyargs]
+    kwonly_defaulted = [
+        a.arg
+        for a, d in zip(args.kwonlyargs, args.kw_defaults)
+        if d is not None
+    ]
+    mutexes = {
+        attr for attr, kind in class_locks.items()
+        if kind in ("lock", "rlock", "condition")
+    }
+
+    def holds(w: ast.With) -> Set[str]:
+        got: Set[str] = set()
+        for item in w.items:
+            expr = item.context_expr
+            attr = _self_attr_of(expr)
+            if attr and attr in mutexes:
+                got.add(f"self.{attr}")
+            elif isinstance(expr, ast.Name) and ctx.module_locks.get(
+                expr.id
+            ) in ("lock", "rlock", "condition"):
+                got.add(expr.id)
+        return got
+
+    partials = _local_partials(fn, module_funcs)
+    calls: List[dict] = []
+    blocking: List[List] = []
+    collectives: List[dict] = []
+    ambient = False
+    self_reads: Set[str] = set()
+    event_sets: Set[str] = set()
+    joins: Set[str] = set()
+    # `for t in self._threads:` iteration vars, so `t.join()` counts as
+    # joining the attr
+    iter_vars: Dict[str, str] = {}
+    for node in walk_in_scope(fn):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            attr = _self_attr_of(node.iter)
+            if attr:
+                iter_vars[node.target.id] = attr
+    for node, held in _iter_with_lockstate(fn, holds):
+        attr = _self_attr_of(node)
+        if attr:
+            self_reads.add(attr)
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name == "current_deadline":
+            ambient = True
+        shown = rules_conc._is_blocking_call(node)
+        if shown:
+            blocking.append([shown, node.lineno])
+        cfact = _collective_fact(node, args.vararg and args.vararg.arg,
+                                 args.kwarg and args.kwarg.arg)
+        if cfact is not None:
+            collectives.append(cfact)
+        if isinstance(node.func, ast.Attribute):
+            recv_attr = _self_attr_of(node.func.value)
+            if node.func.attr == "set" and recv_attr:
+                event_sets.add(recv_attr)
+            if node.func.attr == "join":
+                if recv_attr:
+                    joins.add(recv_attr)
+                elif isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in iter_vars:
+                    joins.add(iter_vars[node.func.value.id])
+        ref, bound = _call_ref(node, module_funcs, partials)
+        if ref:
+            calls.append({
+                "line": node.lineno,
+                "col": node.col_offset + 1,
+                "ref": ref,
+                "nargs": bound + sum(
+                    1 for a in node.args if not isinstance(a, ast.Starred)
+                ),
+                "star": any(
+                    isinstance(a, ast.Starred) for a in node.args
+                ),
+                "kwsplat": any(kw.arg is None for kw in node.keywords),
+                "kws": sorted(
+                    kw.arg for kw in node.keywords if kw.arg is not None
+                ),
+                "locks": sorted(held),
+            })
+    return {
+        "name": fn.name,
+        "line": fn.lineno,
+        "cls": cls_name,
+        "params": params,
+        "defaults": len(args.defaults),
+        "kwonly": kwonly,
+        "kwonly_defaulted": kwonly_defaulted,
+        "vararg": bool(args.vararg),
+        "kwarg": bool(args.kwarg),
+        "has_deadline": (
+            "deadline" in params
+            or "deadline" in kwonly
+            or _acquires_deadline(fn)
+        ),
+        "ambient_deadline": ambient,
+        "blocking": blocking,
+        "collectives": collectives,
+        "calls": calls,
+        "self_reads": sorted(self_reads),
+        "event_sets": sorted(event_sets),
+        "joins": sorted(joins),
+    }
+
+
+def _class_thread_attrs(node: ast.ClassDef) -> Tuple[List[List], bool]:
+    """(thread-holding self attrs [[attr, line], ...], started?) for one
+    class: direct ``self.X = Thread(...)``, a list literal/comprehension
+    of thread constructors, and the ``t = Thread(...); self.X.append(t)``
+    idiom. ``started`` is a cheap class-wide gate: some ``.start()``
+    call exists (a constructed-but-never-started worker can't leak)."""
+    threads: Dict[str, int] = {}
+    started = False
+    local_threads: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.ClassDef) and sub is not node:
+            continue
+        if isinstance(sub, ast.Call) and isinstance(
+            sub.func, ast.Attribute
+        ) and sub.func.attr == "start":
+            started = True
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        target, value = sub.targets[0], sub.value
+        attr = _self_attr_of(target)
+        if attr:
+            if _is_thread_ctor(value):
+                threads.setdefault(attr, sub.lineno)
+            elif isinstance(value, (ast.List, ast.Tuple)) and any(
+                _is_thread_ctor(e) for e in value.elts
+            ):
+                threads.setdefault(attr, sub.lineno)
+            elif isinstance(value, ast.ListComp) and _is_thread_ctor(
+                value.elt
+            ):
+                threads.setdefault(attr, sub.lineno)
+        elif isinstance(target, ast.Name) and _is_thread_ctor(value):
+            local_threads.add(target.id)
+    if local_threads:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "append"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id in local_threads
+            ):
+                attr = _self_attr_of(sub.func.value)
+                if attr:
+                    threads.setdefault(attr, sub.lineno)
+    return [[a, ln] for a, ln in sorted(threads.items())], started
+
+
+def extract_facts(ctx: FileContext, module: str) -> dict:
+    """One file's flow-relevant facts as a JSON-serializable dict — the
+    unit the incremental cache stores and worker processes ship back."""
+    module_funcs = {
+        f.name for f in ctx.tree.body if isinstance(f, ast.FunctionDef)
+    }
+    functions: Dict[str, dict] = {}
+    classes: Dict[str, dict] = {}
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.FunctionDef):
+            functions[stmt.name] = _function_facts(
+                stmt, None, ctx, module_funcs, {}
+            )
+    for cs in ctx.classes:
+        threads, started = _class_thread_attrs(cs.node)
+        classes[cs.name] = {
+            "name": cs.name,
+            "line": cs.node.lineno,
+            "bases": [
+                dotted_name(b) for b in cs.node.bases if dotted_name(b)
+            ],
+            "methods": sorted(cs.methods),
+            "locks": dict(cs.lock_attrs),
+            "threads": threads,
+            "started": started,
+            "thread_subclass": cs.is_thread_subclass,
+        }
+        for name, meth in cs.methods.items():
+            functions[f"{cs.name}.{name}"] = _function_facts(
+                meth, cs.name, ctx, module_funcs, cs.lock_attrs
+            )
+    mapped: List[dict] = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and call_name(node) in ("shard_map", "pmap")
+            and node.args
+        ):
+            continue
+        fn = node.args[0]
+        if isinstance(fn, ast.Call) and is_partial_call(fn) and fn.args:
+            fn = fn.args[0]
+        ref = ""
+        if isinstance(fn, ast.Name):
+            ref = (
+                f"local:{fn.id}" if fn.id in module_funcs
+                else f"name:{fn.id}"
+            )
+        elif isinstance(fn, ast.Attribute):
+            dn = dotted_name(fn)
+            if dn:
+                ref = f"dotted:{dn}"
+        if ref:
+            mapped.append({"line": node.lineno, "ref": ref})
+    imports = _collect_imports(ctx.tree, module)
+    import_modules = _import_modules(imports)
+    return {
+        "module": module,
+        "path": ctx.path,
+        "imports": imports,
+        "import_modules": sorted(import_modules),
+        "functions": functions,
+        "classes": classes,
+        "mapped": mapped,
+        "suppressions": [
+            [s.line, sorted(s.rule_ids), s.reason, s.comment_only]
+            for s in ctx.suppressions
+        ],
+    }
+
+
+class PackageContext:
+    """The assembled package view: fact dicts for every module in the
+    lint scope, plus the resolution machinery (imports, one-level call
+    graph, single-inheritance method resolution) the flow rules judge
+    against."""
+
+    #: resolution depth cap for base-class chains (defensive: a base
+    #: cycle in analyzed code must not hang the linter)
+    _MAX_CHAIN = 8
+
+    def __init__(self, facts_by_module: Dict[str, dict]):
+        self.modules = facts_by_module
+        # unambiguous tail-component index: lets a single-file or
+        # fixture-dir run resolve `from helper import f` even though
+        # its modules are rooted at the target dir's basename
+        tails: Dict[str, Optional[str]] = {}
+        for mod in facts_by_module:
+            tail = mod.rsplit(".", 1)[-1]
+            tails[tail] = None if tail in tails else mod
+            if mod not in tails:
+                tails[mod] = mod
+        self._by_tail = {t: m for t, m in tails.items() if m}
+
+    # -- module / import resolution ------------------------------------
+
+    def _module(self, dotted: str) -> Optional[str]:
+        if dotted in self.modules:
+            return dotted
+        hit = self._by_tail.get(dotted)
+        if hit:
+            return hit
+        # suffix match: `predictionio_tpu.fleet.router` target seen
+        # from a run rooted deeper/shallower
+        for mod in self.modules:
+            if mod.endswith("." + dotted):
+                return mod
+        return None
+
+    def _resolve_import(
+        self, module: str, name: str
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve local ``name`` in ``module`` through its import
+        table → ("module", "") for a module binding or
+        ("module", "symbol") for a symbol binding; None when the import
+        leaves the lint scope."""
+        facts = self.modules.get(module)
+        if facts is None:
+            return None
+        target = facts["imports"].get(name)
+        if target is None:
+            return None
+        if ":" in target:
+            mod, sym = target.split(":", 1)
+            # `from a.b import m` where a.b.m is a module in scope
+            as_module = self._module(f"{mod}.{sym}")
+            if as_module:
+                return (as_module, "")
+            base = self._module(mod)
+            if base:
+                return (base, sym)
+            return None
+        base = self._module(target)
+        return (base, "") if base else None
+
+    # -- call resolution (the one-level call graph) --------------------
+
+    def resolve_call(
+        self, module: str, cls: Optional[str], ref: str
+    ) -> Optional[Tuple[str, str, dict]]:
+        """Resolve one call reference from (module, enclosing class) to
+        (callee module, callee qualname, callee function facts), or
+        None when the callee is outside the lint scope. This is the
+        whole call-graph contract: exactly one resolution hop — the
+        callee's own calls are facts, not edges to chase further."""
+        facts = self.modules.get(module)
+        if facts is None or not ref:
+            return None
+        kind, _, rest = ref.partition(":")
+        if kind == "local":
+            fn = facts["functions"].get(rest)
+            return (module, rest, fn) if fn else None
+        if kind == "self":
+            if cls is None:
+                return None
+            return self.resolve_method(module, cls, rest)
+        if kind == "name":
+            hit = self._resolve_import(module, rest)
+            if hit is None:
+                return None
+            mod, sym = hit
+            if not sym:
+                return None  # a bare module is not callable here
+            fn = self.modules[mod]["functions"].get(sym)
+            return (mod, sym, fn) if fn else None
+        if kind == "dotted":
+            return self._resolve_dotted(module, rest)
+        return None
+
+    def _resolve_dotted(
+        self, module: str, dotted: str
+    ) -> Optional[Tuple[str, str, dict]]:
+        facts = self.modules[module]
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        # `SomeClass.method(...)` on a same-module class
+        if head in facts["classes"] and len(rest) == 1:
+            fn = facts["functions"].get(f"{head}.{rest[0]}")
+            return (module, f"{head}.{rest[0]}", fn) if fn else None
+        hit = self._resolve_import(module, head)
+        if hit is None:
+            return None
+        mod, sym = hit
+        if sym:
+            # imported class: `Cls.method(...)`
+            if len(rest) == 1 and sym in self.modules[mod]["classes"]:
+                fn = self.modules[mod]["functions"].get(f"{sym}.{rest[0]}")
+                return (mod, f"{sym}.{rest[0]}", fn) if fn else None
+            return None
+        # walk module path as deep as the module table allows
+        while len(rest) > 1:
+            deeper = self._module(f"{mod}.{rest[0]}")
+            if deeper is None:
+                break
+            mod, rest = deeper, rest[1:]
+        if len(rest) == 1:
+            fn = self.modules[mod]["functions"].get(rest[0])
+            if fn:
+                return (mod, rest[0], fn)
+        if len(rest) == 2:
+            fn = self.modules[mod]["functions"].get(f"{rest[0]}.{rest[1]}")
+            if fn:
+                return (mod, f"{rest[0]}.{rest[1]}", fn)
+        return None
+
+    # -- class machinery -----------------------------------------------
+
+    def _resolve_class(
+        self, module: str, dotted: str
+    ) -> Optional[Tuple[str, str]]:
+        facts = self.modules.get(module)
+        if facts is None:
+            return None
+        if dotted in facts["classes"]:
+            return (module, dotted)
+        parts = dotted.split(".")
+        hit = self._resolve_import(module, parts[0])
+        if hit is None:
+            return None
+        mod, sym = hit
+        if sym and not parts[1:]:
+            if sym in self.modules[mod]["classes"]:
+                return (mod, sym)
+            return None
+        if not sym and len(parts) == 2:
+            if parts[1] in self.modules[mod]["classes"]:
+                return (mod, parts[1])
+        return None
+
+    def class_chain(
+        self, module: str, cls: str
+    ) -> Iterator[Tuple[str, str, dict]]:
+        """(module, class name, class facts) for ``cls`` and its
+        package-resolvable single-inheritance ancestors — first base
+        only, the documented resolution contract."""
+        seen: Set[Tuple[str, str]] = set()
+        cur: Optional[Tuple[str, str]] = (module, cls)
+        for _ in range(self._MAX_CHAIN):
+            if cur is None or cur in seen:
+                return
+            seen.add(cur)
+            mod, name = cur
+            facts = self.modules.get(mod)
+            if facts is None:
+                return
+            cfacts = facts["classes"].get(name)
+            if cfacts is None:
+                return
+            yield mod, name, cfacts
+            bases = cfacts.get("bases") or []
+            cur = self._resolve_class(mod, bases[0]) if bases else None
+
+    def resolve_method(
+        self, module: str, cls: str, method: str
+    ) -> Optional[Tuple[str, str, dict]]:
+        for mod, name, _cfacts in self.class_chain(module, cls):
+            fn = self.modules[mod]["functions"].get(f"{name}.{method}")
+            if fn:
+                return (mod, f"{name}.{method}", fn)
+        return None
+
+    # -- import graph (cache invalidation + --changed closure) ---------
+
+    def internal_imports(self, module: str) -> List[str]:
+        facts = self.modules.get(module)
+        if facts is None:
+            return []
+        out = []
+        for dep in facts["import_modules"]:
+            hit = self._module(dep)
+            if hit and hit != module:
+                out.append(hit)
+        return sorted(set(out))
+
+    def import_closure(self, module: str) -> Set[str]:
+        """Transitive package-internal import closure, ``module``
+        included — the dependency set whose content hashes key a flow
+        result in the incremental cache."""
+        out: Set[str] = set()
+        stack = [module]
+        while stack:
+            mod = stack.pop()
+            if mod in out:
+                continue
+            out.add(mod)
+            stack.extend(self.internal_imports(mod))
+        return out
+
+    def reverse_importers(self, module: str) -> Set[str]:
+        """Modules whose transitive import closure contains ``module``
+        (itself included) — the re-lint scope when ``module`` changes."""
+        return {
+            mod for mod in self.modules
+            if module in self.import_closure(mod)
+        }
+
+
+def single_file_context(ctx: FileContext) -> Tuple[str, "PackageContext"]:
+    """PackageContext over just one parsed file (``lint_file`` — fixture
+    twins, editor integrations). Cached on the FileContext so the flow
+    rules share one extraction."""
+    cached = getattr(ctx, "_pkg_single", None)
+    if cached is not None:
+        return cached
+    module = module_name_for(ctx.path, [])
+    facts = extract_facts(ctx, module)
+    pctx = PackageContext({module: facts})
+    ctx._pkg_single = (module, pctx)  # type: ignore[attr-defined]
+    return module, pctx
+
+
+def content_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def reverse_closure_paths(
+    scope_dirs: Sequence[str], changed: Sequence[str]
+) -> List[str]:
+    """The ``--changed`` cross-file closure: package files under
+    ``scope_dirs`` whose transitive imports reach a changed file — the
+    files whose ``flow-*`` verdicts the edit may have flipped. Parses
+    import statements only; a file that fails to parse is simply not
+    pulled in (it will fail loudly when it is itself linted)."""
+    from .engine import iter_python_files
+
+    roots = [os.path.abspath(d) for d in scope_dirs if os.path.isdir(d)]
+    if not roots:
+        return []
+    table: Dict[str, dict] = {}
+    path_of: Dict[str, str] = {}
+    for path in iter_python_files(roots):
+        module = module_name_for(path, roots)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (SyntaxError, ValueError, OSError):
+            continue
+        imports = _collect_imports(tree, module)
+        table[module] = {
+            "module": module,
+            "path": path,
+            "imports": imports,
+            "import_modules": _import_modules(imports),
+            "functions": {},
+            "classes": {},
+            "mapped": [],
+            "suppressions": [],
+        }
+        path_of[module] = path
+    pctx = PackageContext(table)
+    changed_abs = {os.path.abspath(p) for p in changed}
+    changed_mods = {
+        m for m, p in path_of.items() if os.path.abspath(p) in changed_abs
+    }
+    out: Set[str] = set()
+    for target in changed_mods:
+        for mod in pctx.reverse_importers(target):
+            if os.path.abspath(path_of[mod]) not in changed_abs:
+                out.add(path_of[mod])
+    return sorted(out)

@@ -1,0 +1,444 @@
+"""Family G — cross-file flow rules (``flow-*``): the interprocedural
+closure of the per-file families, judged over the package-wide fact
+tables of :mod:`packagectx` instead of a single file's AST.
+
+Every rule here follows one resolution contract (docs/lint.md#family-g):
+a call site is resolved through the import table / single-inheritance
+method resolution **one level deep** to a function whose facts were
+extracted from its own file; the callee's *direct* behavior (a blocking
+call, a collective, a ``deadline`` parameter) is then judged at the
+caller's line. A reference that does not resolve inside the lint scope
+is not judged — stdlib and third-party callees get the benefit of the
+doubt, and a two-hop chain (helper calling helper calling ``sleep``) is
+out of contract by design: one level keeps every verdict explainable by
+exactly two source locations, both named in the message.
+
+Findings are always attributed to the file whose facts are being
+judged, so suppressions stay file-local and the incremental cache can
+key flow results on (file hash, import-closure hash).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .engine import FileContext, Finding, Rule
+from .packagectx import (
+    PackageContext,
+    is_lifecycle_method,
+    single_file_context,
+)
+
+
+class FlowRule(Rule):
+    """Base for package-scope rules: ``check_module`` judges one
+    module's facts against the package context. ``check(ctx)`` keeps
+    the per-file entry point working (``lint_file`` on fixtures /
+    single files) by wrapping the file in a one-module package."""
+
+    scope = "package"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        module, pctx = single_file_context(ctx)
+        yield from self.check_module(module, pctx)
+
+    def check_module(
+        self, module: str, pctx: PackageContext
+    ) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def flow_finding(
+        self, facts: dict, line: int, col: int, message: str
+    ) -> Finding:
+        return Finding(
+            rule_id=self.id,
+            path=facts["path"],
+            line=line,
+            col=col,
+            message=message,
+            severity=self.severity,
+        )
+
+
+def _where(pctx: PackageContext, mod: str, fn: dict, line: int) -> str:
+    path = pctx.modules[mod]["path"]
+    return f"{path}:{line}"
+
+
+class FlowBlockingUnderLock(FlowRule):
+    """The interprocedural closure of ``conc-blocking-under-lock``: the
+    blocking call is one resolution hop away — a helper defined
+    anywhere in the package that sleeps / joins / does socket or
+    subprocess I/O, invoked while a lock is held. The per-file rule
+    sees only lexically-direct blocking calls; refactoring the blocking
+    work into a helper (the natural cleanup!) used to move the convoy
+    out of the linter's sight without moving it out of the critical
+    section."""
+
+    id = "flow-blocking-under-lock"
+    severity = "error"
+    short = (
+        "call under a held lock resolves to a package helper that "
+        "blocks (sleep/HTTP/fsync/join/subprocess) in its own body"
+    )
+    motivation = (
+        "conc-blocking-under-lock's documented blind spot: the oplog/"
+        "rollout persistence paths kept their locks honest by inlining "
+        "I/O where the rule could see it — a helper extraction would "
+        "have un-gated them silently"
+    )
+
+    def check_module(
+        self, module: str, pctx: PackageContext
+    ) -> Iterator[Finding]:
+        facts = pctx.modules[module]
+        for fn in facts["functions"].values():
+            for call in fn["calls"]:
+                if not call["locks"]:
+                    continue
+                hit = pctx.resolve_call(module, fn["cls"], call["ref"])
+                if hit is None:
+                    continue
+                cal_mod, qual, callee = hit
+                if callee is None or not callee["blocking"]:
+                    continue
+                shown, bline = callee["blocking"][0]
+                locks = ", ".join(call["locks"])
+                yield self.flow_finding(
+                    facts, call["line"], call["col"],
+                    f"{cal_mod}.{qual}(...) called while holding "
+                    f"{locks}: the callee blocks on {shown} "
+                    f"({_where(pctx, cal_mod, callee, bline)}) — every "
+                    "thread needing the lock waits out that I/O; "
+                    "snapshot state under the lock and call the helper "
+                    "after releasing it.",
+                )
+
+
+class FlowDeadlineDropped(FlowRule):
+    """A deadline in hand is a contract to bound *all* remaining work;
+    a call that reaches a deadline-capable package callee without
+    forwarding it silently un-bounds that leg (the callee falls back to
+    its default timeout — or none), which is exactly how a 250 ms
+    budget turns into a 30 s stall on the slowest shard. The router and
+    partitioned-write retry paths thread ``deadline=`` by hand; this
+    rule makes the discipline mechanical.
+
+    Judged only when the caller is deadline-scoped (a ``deadline``
+    parameter, a ``current_deadline()`` / ``Deadline.from_header`` /
+    ``Deadline.after_ms`` binding, or a ``with deadline_scope(...)``
+    block) and the callee resolves in-package with a ``deadline``
+    parameter (or a *required* ``timeout`` parameter). Exempt: the
+    callee reads the ambient ``current_deadline()`` itself — the
+    contextvar-propagation idiom ``storage/remote.py`` uses — or the
+    call forwards via ``*args``/``**kwargs`` (benefit of the doubt)."""
+
+    id = "flow-deadline-dropped"
+    severity = "error"
+    short = (
+        "deadline-scoped caller invokes a package callee that accepts "
+        "deadline/timeout without forwarding it"
+    )
+    motivation = (
+        "the fan-out budget bugs of the router rounds: one leg that "
+        "forgets to pass the deadline waits out a dead peer's full "
+        "socket timeout while the request's budget is long gone"
+    )
+
+    #: parameter names that make a callee deadline-capable
+    _PARAM = "deadline"
+
+    def check_module(
+        self, module: str, pctx: PackageContext
+    ) -> Iterator[Finding]:
+        facts = pctx.modules[module]
+        for fn in facts["functions"].values():
+            if not fn["has_deadline"]:
+                continue
+            for call in fn["calls"]:
+                hit = pctx.resolve_call(module, fn["cls"], call["ref"])
+                if hit is None:
+                    continue
+                cal_mod, qual, callee = hit
+                if callee is None:
+                    continue
+                pname = self._capable_param(callee)
+                if pname is None:
+                    continue
+                if self._forwarded(call, callee, pname):
+                    continue
+                if callee["ambient_deadline"]:
+                    continue  # reads current_deadline() itself
+                yield self.flow_finding(
+                    facts, call["line"], call["col"],
+                    f"{cal_mod}.{qual}(...) accepts `{pname}` but this "
+                    "deadline-scoped call site does not forward one: "
+                    "the leg runs unbounded while the caller's budget "
+                    f"ticks — pass {pname}=..., or have the callee read "
+                    "current_deadline().",
+                )
+
+    def _capable_param(self, callee: dict) -> Optional[str]:
+        if self._PARAM in callee["params"] or \
+                self._PARAM in callee["kwonly"]:
+            return self._PARAM
+        # a REQUIRED timeout parameter is the same contract under the
+        # older name; optional timeouts (timeout=30.0 defaults) are
+        # family-C territory and judging them here would flag every
+        # caller that deliberately rides the default
+        params = callee["params"]
+        if "timeout" in params:
+            idx = params.index("timeout")
+            if idx < len(params) - callee["defaults"]:
+                return "timeout"
+        if "timeout" in callee["kwonly"] and \
+                "timeout" not in callee["kwonly_defaulted"]:
+            return "timeout"
+        return None
+
+    @staticmethod
+    def _forwarded(call: dict, callee: dict, pname: str) -> bool:
+        if pname in call["kws"] or call["kwsplat"] or call["star"]:
+            return True
+        if pname in callee["params"]:
+            return call["nargs"] > callee["params"].index(pname)
+        return False
+
+
+class FlowThreadLeak(FlowRule):
+    """A worker thread stored on ``self`` and started must have a stop
+    story reachable from the class's lifecycle methods (``close`` /
+    ``server_close`` / ``shutdown`` / ``stop*`` / ``__exit__``),
+    resolved through single-inheritance base classes. Accepted evidence
+    for a thread attribute: a lifecycle method (or a self-method it
+    calls, one hop) joins it, references it (sentinel draining counts —
+    ``_ShardLegPool.stop`` pushes stop sentinels through the queue the
+    workers drain), or sets one of the class's ``threading.Event``
+    attributes (the loop-flag idiom ``obs/slo.py`` and the replica
+    tailer use). No lifecycle method at all, or none that touches the
+    worker or an event, and the thread outlives every ``close()`` —
+    the leak that keeps test processes and rolling restarts hanging."""
+
+    id = "flow-thread-leak"
+    severity = "error"
+    short = (
+        "Thread/Timer stored on self and started, with no stop/join "
+        "reachable from close/server_close/shutdown/stop* (bases "
+        "included)"
+    )
+    motivation = (
+        "every long-lived control-plane class in the tree (SLO ticker, "
+        "continuous controller, replica tailer, router leg pools) had "
+        "to get this right by review; a worker added without a stop "
+        "story only surfaces as a hung shutdown in production"
+    )
+
+    def check_module(
+        self, module: str, pctx: PackageContext
+    ) -> Iterator[Finding]:
+        facts = pctx.modules[module]
+        for cname, cfacts in facts["classes"].items():
+            if cfacts["thread_subclass"]:
+                continue  # it IS the worker; its owner is judged
+            if not cfacts["threads"] or not cfacts["started"]:
+                continue
+            chain = list(pctx.class_chain(module, cname))
+            event_attrs: Set[str] = set()
+            for _m, _n, cf in chain:
+                event_attrs |= {
+                    a for a, k in cf.get("locks", {}).items()
+                    if k == "event"
+                }
+            lifecycle = self._lifecycle_functions(pctx, chain)
+            if not lifecycle:
+                for attr, line in cfacts["threads"]:
+                    yield self.flow_finding(
+                        facts, line, 1,
+                        f"{cname} starts a worker thread on "
+                        f"self.{attr} but defines no close/shutdown/"
+                        "stop method (own or inherited in-package): "
+                        "the thread outlives the object — add a stop "
+                        "method that signals and joins it.",
+                    )
+                continue
+            reach = self._reachable(pctx, chain, lifecycle)
+            for attr, line in cfacts["threads"]:
+                if any(
+                    attr in fn["joins"]
+                    or attr in fn["self_reads"]
+                    or (event_attrs and set(fn["event_sets"])
+                        & event_attrs)
+                    for fn in reach
+                ):
+                    continue
+                names = sorted({fn["name"] for fn in lifecycle})
+                yield self.flow_finding(
+                    facts, line, 1,
+                    f"{cname} starts a worker thread on self.{attr} "
+                    f"but no lifecycle method ({', '.join(names)}) "
+                    "joins it, references it, or sets a stop Event: "
+                    "close() returns with the worker still running — "
+                    "signal and join the thread in teardown.",
+                )
+
+    @staticmethod
+    def _lifecycle_functions(
+        pctx: PackageContext,
+        chain: List[Tuple[str, str, dict]],
+    ) -> List[dict]:
+        out: List[dict] = []
+        seen: Set[Tuple[str, str]] = set()
+        for mod, cname, cfacts in chain:
+            for meth in cfacts["methods"]:
+                if not is_lifecycle_method(meth):
+                    continue
+                key = (mod, f"{cname}.{meth}")
+                fn = pctx.modules[mod]["functions"].get(key[1])
+                if fn and key not in seen:
+                    seen.add(key)
+                    out.append(fn)
+        return out
+
+    @staticmethod
+    def _reachable(
+        pctx: PackageContext,
+        chain: List[Tuple[str, str, dict]],
+        lifecycle: List[dict],
+    ) -> List[dict]:
+        """Lifecycle methods plus the self-methods they call (one hop,
+        resolved through the chain) — the scope searched for stop
+        evidence."""
+        mod0, cls0 = chain[0][0], chain[0][1]
+        out = list(lifecycle)
+        seen = {id(fn) for fn in out}
+        for fn in lifecycle:
+            for call in fn["calls"]:
+                kind, _, rest = call["ref"].partition(":")
+                if kind != "self":
+                    continue
+                hit = pctx.resolve_method(mod0, cls0, rest)
+                if hit is not None and id(hit[2]) not in seen:
+                    seen.add(id(hit[2]))
+                    out.append(hit[2])
+        return out
+
+
+class CollectiveMissingAxisDeep(FlowRule):
+    """The call-graph extension of ``spmd-collective-missing-axis``
+    (same rule id — one catalog entry, one suppression token): a
+    collective hidden one call deep inside a shard_map/pmap body is
+    judged too. Three shapes the per-file rule cannot see:
+
+    - the mapped body lives in another module (``shard_map(ops.body)``);
+    - the mapped body calls a package helper whose collective omits the
+      axis;
+    - the helper forwards its own ``*args``/``**kwargs`` into the
+      collective's axis slot — the per-file rule's documented skip. The
+      call site decides: a site that provably forwards nothing extra
+      (no spare positionals, no ``axis_name=``, no splat) makes the
+      missing axis a static fact and fires; a site that feeds the splat
+      is clean."""
+
+    id = "spmd-collective-missing-axis"
+    severity = "error"
+    short = (
+        "collective with no axis reached through the call graph (mapped "
+        "body in another module, helper call, *args forwarding)"
+    )
+    motivation = (
+        "the per-file rule shipped with '*args/**kwargs calls pass' in "
+        "its own comment; the call graph makes the forwarding judgeable "
+        "instead of exempt"
+    )
+
+    def check_module(
+        self, module: str, pctx: PackageContext
+    ) -> Iterator[Finding]:
+        facts = pctx.modules[module]
+        seen: Set[Tuple] = set()
+        for mapped in facts["mapped"]:
+            hit = pctx.resolve_call(module, None, mapped["ref"])
+            if hit is None:
+                continue
+            body_mod, body_qual, body = hit
+            if body is None:
+                continue
+            local_body = body_mod == module
+            # the body's own collectives: the per-file rule already
+            # judges them when the body is in the mapping file; when it
+            # is not, this is the only judge they get
+            if not local_body:
+                for cf in body["collectives"]:
+                    if cf["ok"] or cf["vararg"]:
+                        continue
+                    key = (body_mod, cf["line"], "own")
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.flow_finding(
+                        facts, mapped["line"], 1,
+                        f"shard_map/pmap maps {body_mod}.{body_qual}, "
+                        f"whose {cf['name']}(...) at "
+                        f"{_where(pctx, body_mod, body, cf['line'])} "
+                        "has no axis argument: trace-time TypeError "
+                        "the first time the sharded path runs.",
+                    )
+            # one hop: helpers the mapped body calls
+            for call in body["calls"]:
+                hop = pctx.resolve_call(body_mod, body["cls"], call["ref"])
+                if hop is None:
+                    continue
+                helper_mod, helper_qual, helper = hop
+                if helper is None:
+                    continue
+                for cf in helper["collectives"]:
+                    if cf["ok"]:
+                        continue
+                    if cf["vararg"] and self._site_feeds_axis(call, helper):
+                        continue
+                    key = (helper_mod, cf["line"], call["line"], body_mod)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    line, col = (
+                        (call["line"], call["col"]) if local_body
+                        else (mapped["line"], 1)
+                    )
+                    how = (
+                        "forwards no axis into its *args/**kwargs"
+                        if cf["vararg"]
+                        else "omits the axis outright"
+                    )
+                    yield self.flow_finding(
+                        facts, line, col,
+                        f"{helper_mod}.{helper_qual}(...) called from "
+                        "a shard_map/pmap-mapped body reaches "
+                        f"{cf['name']}(...) at "
+                        f"{_where(pctx, helper_mod, helper, cf['line'])} "
+                        f"with no axis ({how}): trace-time TypeError "
+                        "on the sharded path — pass the axis name "
+                        "through.",
+                    )
+
+    @staticmethod
+    def _site_feeds_axis(call: dict, helper: dict) -> bool:
+        """Does this call site put anything into the helper's
+        ``*args``/``**kwargs`` that could be the axis?"""
+        if call["star"] or call["kwsplat"]:
+            return True
+        if "axis_name" in call["kws"]:
+            return True
+        extra_kws = set(call["kws"]) - set(helper["params"]) - set(
+            helper["kwonly"]
+        )
+        if extra_kws and helper["kwarg"]:
+            return True
+        return call["nargs"] > len(helper["params"])
+
+
+RULES: List[Rule] = [
+    FlowBlockingUnderLock(),
+    FlowDeadlineDropped(),
+    FlowThreadLeak(),
+    CollectiveMissingAxisDeep(),
+]

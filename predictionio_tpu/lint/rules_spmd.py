@@ -196,6 +196,14 @@ def _resolve_mapped_fn(
     if not call.args:
         return None
     fn = call.args[0]
+    # functools.partial(body, ...): the mapped callable IS the bound
+    # function — judge its body, not the partial wrapper
+    if (
+        isinstance(fn, ast.Call)
+        and dotted_name(fn.func) in ("partial", "functools.partial")
+        and fn.args
+    ):
+        fn = fn.args[0]
     if isinstance(fn, ast.Lambda):
         return fn
     if isinstance(fn, ast.Name):
@@ -691,7 +699,13 @@ class CollectiveMissingAxis(Rule):
                 if any(isinstance(a, ast.Starred) for a in sub.args) or any(
                     kw.arg is None for kw in sub.keywords
                 ):
-                    continue  # *args/**kwargs: not statically knowable
+                    # *args/**kwargs splats at the collective itself are
+                    # judged by the package-level twin of this rule
+                    # (rules_flow.CollectiveMissingAxisDeep), which can
+                    # see whether the mapped body's own varargs actually
+                    # carry an axis — here the call is not statically
+                    # knowable, so stay silent rather than guess
+                    continue
                 if _collective_axis_arg(sub) is None:
                     yield self.finding(
                         ctx,

@@ -111,7 +111,7 @@ def load_library(name: str, sources=None) -> ctypes.CDLL:
     with _LOCK:
         if name not in _CACHE:
             try:
-                # pio: lint-ok[robust-unbounded-cache] keys are the in-tree native component names (a closed set), and a dlopen'd library has no meaningful eviction
+                # pio: lint-ok[robust-unbounded-cache, flow-blocking-under-lock] keys are a closed set of in-tree component names, and _LOCK exists precisely to serialize the one-time compile — blocking under it is the point
                 _CACHE[name] = ctypes.CDLL(build_library(name, sources))
             except NativeBuildError:
                 raise

@@ -454,6 +454,43 @@ def sharded_records(bench: dict, source: str = "bench") -> List[dict]:
     return out
 
 
+def lint_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The lint-sweep timings a bench run attached (``bench["lintSweep"]``,
+    from the in-process cold-vs-warm engine drive — docs/lint.md#cache)
+    as trend-only ledger records:
+
+    - ``lint_wall_s`` — cold full-package sweep wall-clock (unit
+      ``wall_s``, deliberately NOT ``s``: the gate only ever compares
+      ``unit == "s"``, and a lint sweep on a contended CI box must
+      never fail a perf gate — the trajectory is the product). The warm
+      wall-clock, file count, and the byte-identity verdict ride along
+      in ``extra`` so a cache regression (warm ≈ cold, or
+      ``identical: false``) is visible in the ledger history.
+
+    A failed sweep (``ok`` false) records nothing — its wall-clock
+    measured a broken engine run, not the linter."""
+    block = bench.get("lintSweep")
+    if not isinstance(block, dict) or not block.get("ok"):
+        return []
+    cold_s = block.get("coldS")
+    if not isinstance(cold_s, (int, float)) or cold_s <= 0:
+        return []
+    return [
+        make_record(
+            source=source,
+            metric="lint_wall_s",
+            value=float(cold_s),
+            unit="wall_s",
+            device=bench.get("device"),
+            extra={
+                "warmS": block.get("warmS"),
+                "files": block.get("files"),
+                "identical": block.get("identical"),
+            },
+        )
+    ]
+
+
 def append_record(path: str, record: dict) -> None:
     """Append one record as a JSON line, fsynced — the ledger is the
     durable evidence trail, a torn tail must cost at most one line."""

@@ -13,9 +13,19 @@ pre-window checklist all read):
 
 ``--format json`` emits one machine-readable document on stdout.
 ``--changed`` lints only files git reports as modified/added/untracked
-(diff-scoped pre-commit runs); ``--baseline FILE`` adopts legacy
-findings recorded by an earlier ``--format json`` run and ratchets:
-baselined debt is absorbed, anything new still fails.
+(diff-scoped pre-commit runs) *plus their reverse-import closure* — a
+changed helper re-judges every file that can reach it through imports,
+so the ``flow-*`` rules cannot miss a cross-file regression in a
+diff-scoped run; ``--baseline FILE`` adopts legacy findings recorded by
+an earlier ``--format json`` run and ratchets: baselined debt is
+absorbed, anything new still fails.
+
+Full-package default-rule runs keep an incremental result cache at
+``<target>/.pio_lint_cache.json`` (``PIO_LINT_CACHE`` overrides the
+path, ``PIO_LINT_CACHE=0``/``off`` or ``--no-cache`` disables it) and
+parse files in parallel worker processes (``--jobs``, 0 = auto). Both
+are speed levers only: a corrupt cache or a failed pool falls back to
+the cold serial sweep with an unchanged verdict.
 """
 
 from __future__ import annotations
@@ -71,6 +81,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
+    )
+    p.add_argument(
+        "--explain", default=None, metavar="RULE_ID",
+        help="print the rule's full docstring and docs/lint.md anchor, "
+        "then exit (unknown id is exit 2)",
+    )
+    p.add_argument(
+        "--no-cache", action="store_true",
+        help="skip the incremental result cache for this run (same "
+        "verdict, cold speed)",
+    )
+    p.add_argument(
+        "--jobs", type=int, default=0, metavar="N",
+        help="worker processes for the per-file pass (default 0 = auto; "
+        "1 forces serial)",
     )
     return p
 
@@ -156,14 +181,49 @@ def changed_files(paths: Sequence[str]) -> List[str]:
     return sorted(out)
 
 
+def _cache_path_for(paths: Sequence[str]) -> Optional[str]:
+    """Default cache location: under the target root when the run lints
+    exactly one directory (the full-sweep shape). ``PIO_LINT_CACHE``
+    overrides the path; ``0``/``off``/empty disables."""
+    env = os.environ.get("PIO_LINT_CACHE")
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off"):
+            return None
+        return os.path.abspath(env)
+    if len(paths) == 1 and os.path.isdir(paths[0]):
+        return os.path.join(
+            os.path.abspath(paths[0]), ".pio_lint_cache.json"
+        )
+    return None
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        _emit("\n".join(
-            f"{rule.id} [{rule.severity}]: {rule.short}"
-            for rule in all_rules()
-        ))
+        seen = set()
+        lines = []
+        for rule in all_rules():
+            if rule.id in seen:
+                continue  # one id may have per-file + package variants
+            seen.add(rule.id)
+            lines.append(f"{rule.id} [{rule.severity}]: {rule.short}")
+        _emit("\n".join(lines))
         return EXIT_CLEAN
+    if args.explain:
+        import inspect
+
+        for rule in all_rules():
+            if rule.id == args.explain:
+                doc = inspect.cleandoc(
+                    type(rule).__doc__ or rule.short or ""
+                )
+                _emit(
+                    f"{rule.id} [{rule.severity}]\n\n{doc}\n\n"
+                    f"docs: docs/lint.md#{rule.id}"
+                )
+                return EXIT_CLEAN
+        _emit(f"error: --explain: no such rule '{args.explain}'")
+        return EXIT_ENGINE_ERROR
     paths = args.paths or [PACKAGE_DIR]
     # validate the baseline BEFORE any early return: a typo'd baseline
     # path must be exit 2 even on a day when --changed finds nothing —
@@ -176,12 +236,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except (OSError, ValueError) as exc:
             _emit(f"error: --baseline: {exc}")
             return EXIT_ENGINE_ERROR
+    cache_path = (
+        None if (args.no_cache or args.changed or args.select)
+        else _cache_path_for(paths)
+    )
     if args.changed:
+        dir_roots = [p for p in paths if os.path.isdir(p)]
         try:
             paths = changed_files(paths)
         except RuntimeError as exc:
             _emit(f"error: --changed: {exc}")
             return EXIT_ENGINE_ERROR
+        if paths and dir_roots:
+            # cross-file closure: a changed helper must re-judge every
+            # file that can reach it through imports, or a flow-* rule's
+            # verdict would silently go stale in diff-scoped runs
+            from ..lint import packagectx
+
+            paths = paths + packagectx.reverse_closure_paths(
+                dir_roots, paths
+            )
         if not paths:
             # the empty-scope happy path must still honor --format json:
             # a CI consumer piping into a JSON parser hits this on every
@@ -198,7 +272,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.select
         else None
     )
-    result = lint_paths(paths, select=select)
+    jobs = args.jobs if args.jobs > 0 else min(8, os.cpu_count() or 1)
+    result = lint_paths(
+        paths, select=select, cache_path=cache_path, jobs=jobs
+    )
     if baseline is not None:
         apply_baseline(result, baseline)
     _emit(render_json(result) if args.format == "json"

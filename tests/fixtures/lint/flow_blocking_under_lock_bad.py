@@ -1,0 +1,23 @@
+"""Family G fixture: the blocking work was refactored into a helper —
+lexically clean for conc-blocking-under-lock, but every thread that
+wants the lock still waits out the sleep."""
+
+import threading
+import time
+
+
+def _refresh_from_disk():
+    time.sleep(0.05)  # stand-in for the slow I/O
+    return 1
+
+
+class ModelCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._model = None
+
+    def get(self):
+        with self._lock:
+            if self._model is None:
+                self._model = _refresh_from_disk()  # BAD: blocking helper under self._lock
+            return self._model

@@ -1,0 +1,36 @@
+"""Clean twin: the helper still sleeps, but every call happens outside
+the critical section — snapshot under the lock, do the slow work after
+release — and a non-blocking helper under the lock is fine."""
+
+import threading
+import time
+
+
+def _refresh_from_disk():
+    time.sleep(0.05)
+    return 1
+
+
+def _pure_default():
+    return 0
+
+
+class ModelCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._model = None
+
+    def get(self):
+        with self._lock:
+            cached = self._model
+        if cached is not None:
+            return cached
+        fresh = _refresh_from_disk()  # slow path outside the lock
+        with self._lock:
+            if self._model is None:
+                self._model = fresh
+            return self._model
+
+    def reset(self):
+        with self._lock:
+            self._model = _pure_default()  # non-blocking helper under the lock

@@ -1,0 +1,14 @@
+"""Family G fixture: a deadline-scoped caller invokes a package callee
+that accepts ``deadline=`` without forwarding it — that leg runs
+unbounded while the caller's budget ticks away."""
+
+
+def fetch_rows(shard, deadline=None):
+    return shard.read(deadline=deadline)
+
+
+def query(shards, deadline):
+    out = []
+    for shard in shards:
+        out.append(fetch_rows(shard))  # BAD: deadline in hand, not forwarded
+    return out

@@ -1,0 +1,29 @@
+"""Clean twin: every deadline-scoped call forwards the budget — by
+keyword, or by the callee reading the ambient ``current_deadline()``
+itself (the contextvar idiom ``storage/remote.py`` uses) — and a callee
+with no deadline/timeout parameter has nothing to forward."""
+
+from predictionio_tpu.utils.resilience import current_deadline
+
+
+def fetch_rows(shard, deadline=None):
+    return shard.read(deadline=deadline)
+
+
+def tail_rows(shard, deadline=None):
+    if deadline is None:
+        deadline = current_deadline()
+    return shard.read(deadline=deadline)
+
+
+def count_rows(shard):
+    return len(shard)
+
+
+def query(shards, deadline):
+    out = []
+    for shard in shards:
+        out.append(fetch_rows(shard, deadline=deadline))  # forwarded by keyword
+        out.append(tail_rows(shard))  # callee reads the ambient deadline itself
+        out.append(count_rows(shard))  # not deadline-capable: nothing to forward
+    return out

@@ -1,0 +1,19 @@
+"""Family G fixture: a worker thread started and stored on ``self``
+with no stop/join reachable from any lifecycle method — ``close()``
+does not exist, so the worker outlives the object."""
+
+import threading
+import time
+
+
+class MetricsPusher:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)  # BAD: no lifecycle method stops this thread
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            time.sleep(60)
+
+    def push(self, sample):
+        return sample

@@ -1,0 +1,56 @@
+"""Clean twin: every started worker has a stop story a lifecycle
+method can reach — a join, a stop Event the run loop watches, or a
+sentinel pushed through the queue the workers drain (the
+``_ShardLegPool`` idiom: referencing the thread list counts)."""
+
+import queue
+import threading
+
+
+class JoinedPusher:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._worker.join(timeout=5)
+
+
+class EventStopped:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._ticker = threading.Thread(target=self._run, daemon=True)
+        self._ticker.start()
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def stop(self):
+        self._stop.set()  # loop-flag idiom: the run loop exits on the event
+
+
+class SentinelDrained:
+    _STOP = object()
+
+    def __init__(self):
+        self._q = queue.Queue()
+        self._workers = [
+            threading.Thread(target=self._drain, daemon=True)
+            for _ in range(2)
+        ]
+        for t in self._workers:
+            t.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+
+    def close(self):
+        for _ in self._workers:  # one sentinel per worker
+            self._q.put(self._STOP)
