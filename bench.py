@@ -269,6 +269,11 @@ def _append_ledger(record: dict) -> None:
         # the warm time and cache byte-identity ride in extra
         for lint_record in perfledger.lint_records(record):
             perfledger.append_record(path, lint_record)
+        # migration-drill wall + dual-write overhead, trend-only and
+        # keyed by "N->M" via scale (docs/storage.md#live-migration):
+        # an expansion and a merge never share a trajectory
+        for migration_record in perfledger.migration_records(record):
+            perfledger.append_record(path, migration_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -765,6 +770,28 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:
             record["ingestScaling"] = {"error": str(exc)}
+    # Live-migration drill (docs/storage.md#live-migration): the full
+    # N=2 -> M=3 chaos choreography — dual-write, coordinator kill,
+    # new-primary kill mid-backfill, watermark, flip, cursor handoff.
+    # Wall time and the dual-write ingest overhead ride the ledger
+    # trend-only, keyed by "N->M" as `scale` so different layout moves
+    # never compare. Opt out with BENCH_MIGRATE=0; a failure never
+    # fails the bench.
+    if os.environ.get("BENCH_MIGRATE") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_migrate_drill
+
+            drill = run_migrate_drill()
+            record["migrationDrill"] = {
+                k: drill.get(k)
+                for k in (
+                    "ok", "oldPartitions", "newPartitions", "opsPerPhase",
+                    "wallS", "dualWriteOverhead", "lostAckedWrites",
+                    "duplicateFolds",
+                )
+            }
+        except Exception as exc:
+            record["migrationDrill"] = {"error": str(exc)}
     # Sharded training (docs/distributed_training.md): the ALX-style
     # shard_map trainer at 1/2/4 shards on forced virtual CPU devices —
     # subprocesses, because the device count must be pinned before jax

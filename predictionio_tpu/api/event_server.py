@@ -296,10 +296,27 @@ class _EventServiceHandler(JsonHTTPHandler):
         if not obj.get("eventId"):
             obj["eventId"] = idempotency_event_id(app_id, key)
 
+    def _shed_if_frozen(self) -> bool:
+        """During the cutover flip the attached migration briefly holds
+        writes (docs/storage.md#live-migration); shed them with the same
+        503 + Retry-After contract as a partition outage. Nothing is
+        acked, so nothing is lost — just late."""
+        after = self.server.migration_frozen_after()
+        if after is None:
+            return False
+        self._respond(
+            503,
+            {"message": "migration cutover in progress; retry shortly"},
+            headers={"Retry-After": after},
+        )
+        return True
+
     # -- routes -----------------------------------------------------------
     def _post_event(self, query: Dict[str, list]) -> None:
         """``EventAPI.scala:229-252``."""
         app_id = self._auth(query)
+        if self._shed_if_frozen():
+            return
         raw = self._body
         try:
             obj = json.loads(raw.decode("utf-8"))
@@ -339,6 +356,17 @@ class _EventServiceHandler(JsonHTTPHandler):
                 headers={"Retry-After": shed},
             )
             return
+        if self.server.migration is not None:
+            from ..storage.event import with_event_id
+
+            self.server.mirror_events(
+                [
+                    event
+                    if event.event_id is not None
+                    else with_event_id(event, event_id)
+                ],
+                app_id,
+            )
         # quality accounting only AFTER the store accepted the event: a
         # storage outage (500s + client retries) must not feed the mix
         # window or auto-pin a baseline from traffic that was never kept
@@ -357,6 +385,8 @@ class _EventServiceHandler(JsonHTTPHandler):
         "message": ...}`` in input order — one bad event does not reject
         the batch. Valid events take the store's batched append path."""
         app_id = self._auth(query)
+        if self._shed_if_frozen():
+            return
         try:
             objs = json.loads(self._body.decode("utf-8"))
             if not isinstance(objs, list):
@@ -391,15 +421,18 @@ class _EventServiceHandler(JsonHTTPHandler):
 
             fresh = []  # server-minted ids: guaranteed-new batch path
             upserts = []  # client-supplied ids keep upsert semantics
+            resolved: Dict[int, Event] = {}  # pos → event with final id
             for pos, event in valid:
                 if event.event_id is None:
                     eid = make_event_id(event)
                     # with_event_id, not dataclasses.replace: replace()
                     # re-validates every field per event on this hot path
-                    fresh.append(with_event_id(event, eid))
+                    event = with_event_id(event, eid)
+                    fresh.append(event)
                 else:
                     eid = event.event_id
                     upserts.append(event)
+                resolved[pos] = event
                 results[pos] = {"status": 201, "eventId": eid}
             # One write per (partition, path): a mixed batch over a
             # partially-down partitioned store lands everything whose
@@ -432,6 +465,10 @@ class _EventServiceHandler(JsonHTTPHandler):
                 (pos, event) for pos, event in valid
                 if results[pos]["status"] == 201
             ]
+            if self.server.migration is not None:
+                self.server.mirror_events(
+                    [resolved[pos] for pos, _event in stored], app_id
+                )
             # quality accounting only AFTER the batched writes landed
             # (same stored-events-only discipline as the single path)
             for _pos, event in stored:
@@ -515,8 +552,11 @@ class _EventServiceHandler(JsonHTTPHandler):
             self._respond(200, event.to_json_dict())
 
     def _delete_event(self, event_id: str, app_id: int) -> None:
+        if self._shed_if_frozen():
+            return
         found = self.server.events.delete(event_id, app_id)
         if found:
+            self.server.mirror_delete(event_id, app_id)
             self._respond(200, {"message": "Found"})
         else:
             self._respond(404, {"message": "Not Found"})
@@ -544,9 +584,11 @@ class EventServer(BackgroundHTTPServer):
         config: EventServerConfig,
         events: EventStore,
         metadata: MetadataStore,
+        migration=None,
     ):
         self.config = config
-        self.events = events
+        self._events = events
+        self.migration = migration
         self.metadata = metadata
         self.stats_tracker: Optional[StatsTracker] = (
             StatsTracker() if config.stats else None
@@ -587,6 +629,40 @@ class EventServer(BackgroundHTTPServer):
             "partition was unavailable",
             labelnames=("partition",),
         )
+
+    @property
+    def events(self) -> EventStore:
+        """The event store of record. With a live ``PartitionMigration``
+        attached this indirects through its active layout, so the cutover
+        flip moves every read and write in one swap
+        (docs/storage.md#live-migration)."""
+        if self.migration is not None:
+            return self.migration.active_events()
+        return self._events
+
+    def migration_frozen_after(self) -> Optional[int]:
+        """Retry-After seconds if the attached migration is holding
+        writes for the cutover flip; None = writes may proceed."""
+        if self.migration is None:
+            return None
+        from ..storage.migration import MigrationFrozen
+
+        try:
+            self.migration.check_frozen()
+        except MigrationFrozen as exc:
+            return max(1, int(round(exc.retry_after_s)))
+        return None
+
+    def mirror_events(self, events, app_id: int) -> None:
+        """Dual-write acked events into the migration's other layout
+        (no-op without a migration; never raises — the mirror path is
+        queue-backed and failure-isolated by design)."""
+        if self.migration is not None and events:
+            self.migration.mirror(events, app_id)
+
+    def mirror_delete(self, event_id: str, app_id: int) -> None:
+        if self.migration is not None:
+            self.migration.mirror_delete(event_id, app_id)
 
     def _partition_shed(self, exc: Exception) -> Optional[int]:
         """If ``exc`` is a partition outage, count it and return the

@@ -51,6 +51,7 @@ __all__ = [
     "LocalFeed",
     "PartitionedFeedWatcher",
     "RemoteFeed",
+    "handoff_cursors",
     "make_watcher",
 ]
 
@@ -623,6 +624,44 @@ class PartitionedFeedWatcher:
             self.watchers[idx].resync()
         with self._lock:
             self._gapped.clear()
+
+
+def handoff_cursors(new_feeds, state_dir: str) -> Dict[int, dict]:
+    """Pre-seed the per-partition durable cursors at the NEW layout's
+    feed heads — the live-migration cursor handoff
+    (docs/storage.md#live-migration).
+
+    Call AFTER the cutover flip, once the old-layout watcher is drained
+    (caught up, every taken batch folded and committed) and retired. The
+    watermark guarantees the new layout holds exactly the folded
+    history, so a cursor at each new feed's head re-folds nothing (zero
+    duplicates) and — because post-flip writes land only in the new
+    layout at higher seqs — misses nothing. A :class:`PartitionedFeedWatcher`
+    (or single :class:`FeedWatcher` for ``len(new_feeds) == 1``) built
+    over ``state_dir`` afterwards resumes from these cursors as if it
+    had tailed the new layout all along.
+
+    Returns ``{partition index: written cursor}`` for status output.
+    """
+    new_feeds = list(new_feeds)
+    written: Dict[int, dict] = {}
+    for i, feed in enumerate(new_feeds):
+        cp = feed.checkpoint()
+        cursor = {
+            "seq": int(cp.get("seq", cp.get("lastSeq", 0))),
+            "generation": cp.get("generation"),
+        }
+        if len(new_feeds) == 1:
+            cursor_dir = state_dir
+        else:
+            cursor_dir = os.path.join(state_dir, f"partition-{i}")
+        os.makedirs(cursor_dir, exist_ok=True)
+        atomic_write_bytes(
+            os.path.join(cursor_dir, CURSOR_NAME),
+            json.dumps(cursor).encode(),
+        )
+        written[i] = cursor
+    return written
 
 
 def make_watcher(

@@ -1,0 +1,483 @@
+"""SLO-driven fleet autoscaling: bounded, hysteresis-damped, ledgered.
+
+The ROADMAP's "Elastic fleet" motion needs a control loop, not a human
+watching dashboards: the PR-11 SLO engine already computes burn rates,
+the PR-13/14 fleets already export per-partition shed counters and
+per-backend breaker state — this module closes the loop
+(``docs/robustness.md#autoscaler``). Design constraints, in order:
+
+**Bounded.** At most ONE action per tick; replica targets clamped to
+``[min_replicas, max_replicas]``; a partition migration only ever
+recommends ``N+1`` (never a jump) and never past ``max_partitions``.
+An autoscaler that can emit unbounded actions is an outage machine
+with extra steps — the Google ads-serving paper's elasticity loops
+(PAPERS.md) are all clamped this way.
+
+**Hysteresis-damped.** Scaling up takes ``up_ticks`` *consecutive* hot
+ticks; scaling down takes ``down_ticks`` consecutive calm ticks
+(asymmetric — flapping wastes more than a spare replica costs); after
+ANY action a ``cooldown_ticks`` refractory window holds, because the
+action's effect takes time to show in the very signals being read.
+
+**Ledgered.** Every decision — actions AND holds — goes through the
+flight recorder (``obs/flight.py``), and executed/dry-run actions count
+in ``pio_autoscale_actions_total{action,dry_run}``. An autoscaler whose
+reasoning cannot be reconstructed after the fact is untrustable.
+
+**Dry-run by default.** ``AutoscaleConfig.dry_run`` is True unless the
+operator sets ``PIO_AUTOSCALE_DRY_RUN=0`` (or ``--execute``): the loop
+decides and ledgers but calls no actuator. Trust is earned from the
+ledger first.
+
+The class consumes an :class:`AutoscaleSignals` snapshot per tick and
+never scrapes anything itself — adapters (:class:`SignalSource` for an
+in-process fleet, :func:`signals_from_dict` for ``pio autoscale
+--signals``) own the plumbing, the loop owns only the decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from ..obs import flight
+from ..obs.metrics import MetricsRegistry
+
+__all__ = [
+    "ACTIONS",
+    "AutoscaleAction",
+    "AutoscaleConfig",
+    "AutoscaleSignals",
+    "FleetAutoscaler",
+    "SignalSource",
+    "signals_from_dict",
+]
+
+#: the closed action vocabulary (and the metric's ``action`` label set)
+ACTIONS = ("add_replica", "remove_replica", "migrate_partitions", "hold")
+
+
+def _env_int(env: Mapping[str, str], name: str, default: int) -> int:
+    try:
+        return int(env.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """``pio autoscale`` surface (docs/cli.md). Every field resolves
+    from a ``PIO_AUTOSCALE_*`` env var in :meth:`from_env`."""
+
+    #: decide + ledger but execute nothing (the default posture)
+    dry_run: bool = True
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: consecutive hot ticks before a scale-up action
+    up_ticks: int = 2
+    #: consecutive calm ticks before a scale-down action (asymmetric:
+    #: flapping costs more than a spare replica)
+    down_ticks: int = 6
+    #: refractory ticks after any action — its effect must have time to
+    #: reach the signals before the loop reads them again
+    cooldown_ticks: int = 5
+    #: a raw burn rate at/above this marks the tick hot even when the
+    #: engine's own fire state machine has not latched yet (matches
+    #: SLOObjective.burn_threshold's default)
+    burn_threshold: float = 8.0
+    #: per-tick ingest sheds (summed over partitions) that mark ingest
+    #: pressure — the signal that recommends a partition migration
+    shed_threshold: float = 1.0
+    max_partitions: int = 8
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None, **overrides
+    ) -> "AutoscaleConfig":
+        env = os.environ if env is None else env
+        fields = dict(
+            dry_run=env.get("PIO_AUTOSCALE_DRY_RUN", "1") != "0",
+            min_replicas=_env_int(env, "PIO_AUTOSCALE_MIN_REPLICAS", 1),
+            max_replicas=_env_int(env, "PIO_AUTOSCALE_MAX_REPLICAS", 4),
+            up_ticks=_env_int(env, "PIO_AUTOSCALE_UP_TICKS", 2),
+            down_ticks=_env_int(env, "PIO_AUTOSCALE_DOWN_TICKS", 6),
+            cooldown_ticks=_env_int(env, "PIO_AUTOSCALE_COOLDOWN_TICKS", 5),
+            max_partitions=_env_int(env, "PIO_AUTOSCALE_MAX_PARTITIONS", 8),
+        )
+        fields.update(overrides)
+        return cls(**fields)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSignals:
+    """One tick's read of the fleet. Rates are per-tick deltas, not
+    cumulative counters — :class:`SignalSource` owns that subtraction."""
+
+    replicas_per_shard: int = 1
+    shard_count: int = 1
+    partition_count: int = 1
+    #: SLO entries currently FIRING (names from SLOEngine.firing())
+    firing: Tuple[str, ...] = ()
+    #: objective name -> fast-window burn rate (abstentions omitted)
+    burn: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    #: router backends whose breaker is currently open
+    breaker_open_backends: int = 0
+    #: shard index -> shed/error legs this tick (router view)
+    shard_pressure: Mapping[int, float] = dataclasses.field(
+        default_factory=dict
+    )
+    #: partition index -> ingest sheds this tick (event-server view)
+    partition_shed: Mapping[int, float] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleAction:
+    """One emitted decision. ``executed`` is only ever True when the
+    actuator ran and returned; a dry-run action is a recommendation."""
+
+    kind: str
+    reason: str
+    target: Optional[int] = None  # shard index / new replica or N count
+    dry_run: bool = True
+    executed: bool = False
+    error: Optional[str] = None
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {k: v for k, v in out.items() if v is not None}
+
+
+class FleetAutoscaler:
+    """The control loop: feed one :class:`AutoscaleSignals` per tick to
+    :meth:`observe`, get back the (at most one) action it took. The
+    ``actuator`` — ``callable(AutoscaleAction) -> None`` — is whatever
+    can actually move the fleet (the drill wires a ring resize +
+    migration start; production wires provisioning); it is only called
+    outside dry-run, and its failure marks the action, never raises."""
+
+    def __init__(
+        self,
+        config: Optional[AutoscaleConfig] = None,
+        actuator: Optional[Callable[[AutoscaleAction], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config if config is not None else AutoscaleConfig.from_env()
+        self.actuator = actuator
+        self.clock = clock
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._actions_total = self.metrics.counter(
+            "pio_autoscale_actions_total",
+            "Autoscaler decisions by action kind and dry-run posture",
+            labelnames=("action", "dry_run"),
+        )
+        self.tick_count = 0
+        self._hot = 0
+        self._calm = 0
+        self._ingest_hot = 0
+        self._cooldown = 0
+        #: recent decisions (actions and holds), newest last — the
+        #: in-memory tail of the flight-recorder ledger for status/CLI
+        self.history: deque = deque(maxlen=128)
+
+    # -- signal classification -------------------------------------------
+    def _serving_hot(self, s: AutoscaleSignals) -> Optional[str]:
+        if s.firing:
+            return f"SLO firing: {', '.join(sorted(s.firing))}"
+        burned = [
+            name for name, rate in sorted(s.burn.items())
+            if rate is not None and rate >= self.config.burn_threshold
+        ]
+        if burned:
+            return f"burn rate over {self.config.burn_threshold}: " + ", ".join(burned)
+        if s.breaker_open_backends > 0:
+            return f"{s.breaker_open_backends} backend breaker(s) open"
+        shed = [
+            str(i) for i, v in sorted(s.shard_pressure.items()) if v > 0
+        ]
+        if shed:
+            return f"shard shed pressure on shard(s) {', '.join(shed)}"
+        return None
+
+    def _ingest_pressure(self, s: AutoscaleSignals) -> Optional[str]:
+        total = sum(v for v in s.partition_shed.values() if v)
+        if total >= self.config.shed_threshold:
+            worst = max(s.partition_shed, key=lambda k: s.partition_shed[k])
+            return (
+                f"{total:.0f} ingest shed(s) this tick "
+                f"(worst partition {worst})"
+            )
+        return None
+
+    def _worst_shard(self, s: AutoscaleSignals) -> Optional[int]:
+        if not s.shard_pressure:
+            return None
+        return max(s.shard_pressure, key=lambda k: s.shard_pressure[k])
+
+    # -- the tick ---------------------------------------------------------
+    def observe(self, signals: AutoscaleSignals) -> List[AutoscaleAction]:
+        """One control tick. Returns the emitted actions (0 or 1) —
+        holds are ledgered but not returned."""
+        cfg = self.config
+        self.tick_count += 1
+        hot_reason = self._serving_hot(signals)
+        ingest_reason = self._ingest_pressure(signals)
+        if hot_reason:
+            self._hot += 1
+            self._calm = 0
+        else:
+            self._hot = 0
+            self._calm += 1
+        self._ingest_hot = self._ingest_hot + 1 if ingest_reason else 0
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return self._hold(
+                f"cooldown ({self._cooldown} tick(s) left)", signals
+            )
+
+        # scale-up beats scale-out beats scale-down: serving pain is
+        # user-visible now, ingest pain sheds (bounded) until migrated
+        if hot_reason and self._hot >= cfg.up_ticks:
+            if signals.replicas_per_shard < cfg.max_replicas:
+                return self._act(
+                    AutoscaleAction(
+                        kind="add_replica",
+                        reason=hot_reason,
+                        target=signals.replicas_per_shard + 1,
+                        dry_run=cfg.dry_run,
+                    ),
+                    signals,
+                )
+            return self._hold(
+                f"hot ({hot_reason}) but already at max_replicas="
+                f"{cfg.max_replicas}",
+                signals,
+            )
+        if ingest_reason and self._ingest_hot >= cfg.up_ticks:
+            if signals.partition_count < cfg.max_partitions:
+                return self._act(
+                    AutoscaleAction(
+                        kind="migrate_partitions",
+                        reason=ingest_reason,
+                        target=signals.partition_count + 1,
+                        dry_run=cfg.dry_run,
+                    ),
+                    signals,
+                )
+            return self._hold(
+                f"ingest pressure ({ingest_reason}) but already at "
+                f"max_partitions={cfg.max_partitions}",
+                signals,
+            )
+        if (
+            not hot_reason
+            and self._calm >= cfg.down_ticks
+            and signals.replicas_per_shard > cfg.min_replicas
+        ):
+            return self._act(
+                AutoscaleAction(
+                    kind="remove_replica",
+                    reason=f"calm for {self._calm} tick(s)",
+                    target=signals.replicas_per_shard - 1,
+                    dry_run=cfg.dry_run,
+                ),
+                signals,
+            )
+        return self._hold(
+            hot_reason
+            and f"hot ({self._hot}/{cfg.up_ticks} tick(s)): {hot_reason}"
+            or f"calm ({self._calm}/{cfg.down_ticks} tick(s))",
+            signals,
+        )
+
+    # -- emit / ledger ----------------------------------------------------
+    def _ledger(self, action: AutoscaleAction, signals: AutoscaleSignals):
+        entry = {
+            "tick": self.tick_count,
+            "action": action.to_json(),
+            "replicasPerShard": signals.replicas_per_shard,
+            "partitionCount": signals.partition_count,
+        }
+        self.history.append(entry)
+        flight.record(
+            "autoscale",
+            "fleet.autoscale.decide",
+            tick=self.tick_count,
+            action=action.kind,
+            reason=action.reason,
+            target=action.target,
+            dryRun=action.dry_run,
+            executed=action.executed,
+            error=action.error,
+        )
+
+    def _hold(
+        self, reason: str, signals: AutoscaleSignals
+    ) -> List[AutoscaleAction]:
+        self._ledger(
+            AutoscaleAction(
+                kind="hold", reason=reason, dry_run=self.config.dry_run
+            ),
+            signals,
+        )
+        return []
+
+    def _act(
+        self, action: AutoscaleAction, signals: AutoscaleSignals
+    ) -> List[AutoscaleAction]:
+        if not action.dry_run and self.actuator is not None:
+            try:
+                self.actuator(action)
+                action = dataclasses.replace(action, executed=True)
+            except Exception as exc:
+                action = dataclasses.replace(action, error=str(exc))
+        self._actions_total.inc(
+            1, action=action.kind, dry_run="1" if action.dry_run else "0"
+        )
+        self._cooldown = self.config.cooldown_ticks
+        self._hot = 0
+        self._calm = 0
+        self._ingest_hot = 0
+        self._ledger(action, signals)
+        return [action]
+
+    def decisions(self) -> List[dict]:
+        return list(self.history)
+
+
+class SignalSource:
+    """In-process adapter: turns an :class:`~predictionio_tpu.obs.slo
+    .SLOEngine`, a :class:`~predictionio_tpu.fleet.router.RouterServer`
+    and/or an event server into per-tick :class:`AutoscaleSignals`.
+    Counters are cumulative, the loop wants deltas — this object keeps
+    the previous totals and subtracts."""
+
+    def __init__(self, slo_engine=None, router=None, event_server=None):
+        self._slo = slo_engine
+        self._router = router
+        self._event_server = event_server
+        self._prev_shard: Dict[int, float] = {}
+        self._prev_partition: Dict[int, float] = {}
+
+    def _shard_pressure(self, status: dict) -> Dict[int, float]:
+        """Per-shard shed/error legs since the last sample, read off the
+        router's per-backend event counter."""
+        if self._router is None:
+            return {}
+        rps = max(1, self._router.config.replicas_per_shard)
+        totals: Dict[int, float] = {}
+        for labels, value in self._router._backend_events.samples():
+            if labels.get("kind") not in ("error", "open_skip", "dead_shard"):
+                continue
+            backend = labels.get("backend") or ""
+            if backend.startswith("shard-"):
+                # dead-shard legs are already labelled by shard
+                try:
+                    shard = int(backend.split("-", 1)[1])
+                except ValueError:
+                    continue
+            else:
+                try:
+                    shard = self._router.backends.index(backend) // rps
+                except ValueError:
+                    continue
+            totals[shard] = totals.get(shard, 0.0) + float(value)
+        out = {
+            shard: max(0.0, total - self._prev_shard.get(shard, 0.0))
+            for shard, total in totals.items()
+        }
+        self._prev_shard = totals
+        return out
+
+    def _partition_shed(self) -> Dict[int, float]:
+        if self._event_server is None:
+            return {}
+        counter = getattr(self._event_server, "_partition_shed_total", None)
+        if counter is None:
+            return {}
+        totals: Dict[int, float] = {}
+        for labels, value in counter.samples():
+            try:
+                totals[int(labels.get("partition", -1))] = float(value)
+            except (TypeError, ValueError):
+                continue
+        out = {
+            part: max(0.0, total - self._prev_partition.get(part, 0.0))
+            for part, total in totals.items()
+        }
+        self._prev_partition = totals
+        return out
+
+    def sample(self) -> AutoscaleSignals:
+        firing: Tuple[str, ...] = ()
+        burn: Dict[str, float] = {}
+        if self._slo is not None:
+            summary = self._slo.summary()
+            firing = tuple(
+                o["name"] for o in summary["objectives"]
+                if o["state"] == "FIRING"
+            )
+            burn = {
+                o["name"]: o["burnFast"]
+                for o in summary["objectives"]
+                if o.get("burnFast") is not None
+            }
+        replicas, shards, breakers_open = 1, 1, 0
+        status: dict = {}
+        if self._router is not None:
+            status = self._router.status_json()
+            replicas = status.get("replicasPerShard") or 1
+            shards = status.get("shardCount") or 1
+            breakers_open = sum(
+                1 for b in status.get("backends", ())
+                if (b.get("breaker") or {}).get("state") == "open"
+            )
+        partition_count = 1
+        if self._event_server is not None:
+            events = getattr(self._event_server, "events", None)
+            partition_count = getattr(events, "partition_count", 1)
+        return AutoscaleSignals(
+            replicas_per_shard=replicas,
+            shard_count=shards,
+            partition_count=partition_count,
+            firing=firing,
+            burn=burn,
+            breaker_open_backends=breakers_open,
+            shard_pressure=self._shard_pressure(status),
+            partition_shed=self._partition_shed(),
+        )
+
+
+def signals_from_dict(d: Mapping) -> AutoscaleSignals:
+    """``pio autoscale --signals FILE`` adapter: a JSON snapshot (the
+    shape ``AutoscaleSignals`` prints) → one tick's signals. Unknown
+    keys are ignored so operators can annotate the file."""
+
+    def _int_keys(m) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        for k, v in (m or {}).items():
+            try:
+                out[int(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    return AutoscaleSignals(
+        replicas_per_shard=int(d.get("replicasPerShard", 1)),
+        shard_count=int(d.get("shardCount", 1)),
+        partition_count=int(d.get("partitionCount", 1)),
+        firing=tuple(d.get("firing", ())),
+        burn={
+            str(k): float(v) for k, v in (d.get("burn") or {}).items()
+            if v is not None
+        },
+        breaker_open_backends=int(d.get("breakerOpenBackends", 0)),
+        shard_pressure=_int_keys(d.get("shardPressure")),
+        partition_shed=_int_keys(d.get("partitionShed")),
+    )

@@ -570,6 +570,51 @@ class RouterServer(BackgroundHTTPServer):
             health_kind="router",
         )
 
+    # -- live ring update (fleet/autoscale.py) ----------------------------
+    def resize_replicas(
+        self, backends: Sequence[str], replicas_per_shard: int
+    ) -> dict:
+        """Autoscaler actuation: swap in a new backend ring with the
+        SAME shard count but a different replicas-per-shard — the one
+        ring change that is safe live, because shard labels, leg pools
+        and the shard→replica-group function all key on shard index.
+        New backends get fresh breakers; departing backends keep their
+        (now idle) breaker entries so an in-flight leg racing the swap
+        still finds its state. Loud on anything that would change the
+        shard count — that is a partition/shard migration, not a
+        resize."""
+        backends = tuple(backends)
+        if not self.config.sharded:
+            raise ValueError(
+                "resize_replicas applies to sharded mode (replicated "
+                "mode scales by just adding backends to the ring)"
+            )
+        if replicas_per_shard < 1:
+            raise ValueError("replicas-per-shard must be >= 1")
+        if len(backends) != self.shard_count * replicas_per_shard:
+            raise ValueError(
+                f"{len(backends)} backends do not give {self.shard_count} "
+                f"shards x {replicas_per_shard} replicas — a resize must "
+                "keep the shard count; migrate to change it"
+            )
+        with self._lock:
+            for b in backends:
+                if b not in self.breakers:
+                    self.breakers[b] = CircuitBreaker.from_env(
+                        f"backend-{b}", clock=self.clock
+                    )
+            self.config = dataclasses.replace(
+                self.config,
+                backends=backends,
+                replicas_per_shard=replicas_per_shard,
+            )
+            self.backends = backends
+        return {
+            "backends": list(backends),
+            "replicasPerShard": replicas_per_shard,
+            "shardCount": self.shard_count,
+        }
+
     # -- admission (per-app quotas) ---------------------------------------
     def quota_for(self, app: str) -> int:
         return self.config.quotas.get(app, self.config.default_quota)
@@ -610,10 +655,12 @@ class RouterServer(BackgroundHTTPServer):
         self._hist.observe(max(0.0, elapsed_s))
 
     def _backends_up(self) -> int:
+        # snapshot under the lock: resize_replicas (autoscaler
+        # actuation) grows this table concurrently with scrapes
+        with self._lock:
+            breakers = list(self.breakers.values())
         return sum(
-            1
-            for b in self.breakers.values()
-            if b.state != CircuitBreaker.OPEN
+            1 for b in breakers if b.state != CircuitBreaker.OPEN
         )
 
     # -- fleet-consistent plan view ---------------------------------------

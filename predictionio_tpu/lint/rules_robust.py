@@ -28,6 +28,13 @@ first incident:
   eviction bound in scope — a slow OOM whose growth rate the client
   controls; ``fleet/cache.py``'s ``ResponseCache`` (bounded LRU + TTL +
   epoch invalidation) is the packaged fix.
+- ``robust-cutover-no-watermark`` (ISSUE 17): a cutover-named function
+  that flips a read/write path between two stores/layouts (the same
+  target assigned one source per branch) with no drain/watermark/
+  barrier evidence anywhere in scope — flipping without verifying the
+  lagging side strands every write still in flight on a path nothing
+  reads anymore; ``storage/migration.py``'s ``cutover`` (freeze →
+  final drain → per-keyspace watermark → flip) is the packaged shape.
 """
 
 from __future__ import annotations
@@ -565,7 +572,140 @@ class UnboundedCache(Rule):
         return False
 
 
+_FLIP_MARKERS = ("cutover", "flip", "switch", "swap", "promote", "migrat")
+_BARRIER_MARKERS = (
+    "watermark", "drain", "barrier", "flush", "quiesce", "catch",
+    "verify", "freeze", "wait", "join", "sync",
+)
+
+
+def _dotted_source(node: ast.AST) -> str:
+    """A plain dotted read (``self._new``, ``new_layout``) — the shape a
+    store/layout handle has at a flip site.  Returns ``""`` for anything
+    computed (calls, subscripts), which never counts as a flip source."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return dotted_name(node) or ""
+    return ""
+
+
+class CutoverNoWatermark(Rule):
+    """A cutover-named function that flips a read/write path between two
+    stores/layouts — the same dotted target assigned one source per
+    branch — with no drain/watermark/barrier evidence anywhere in the
+    function.  Flipping without verifying the lagging side caught up
+    strands every in-flight write on a path nothing reads anymore: the
+    acks were real, the data is gone from the reader's universe."""
+
+    id = "robust-cutover-no-watermark"
+    severity = "error"
+    short = (
+        "cutover flips between two stores/layouts with no "
+        "watermark/drain evidence in scope"
+    )
+    motivation = (
+        "a layout flip is only safe behind a verified barrier (drain "
+        "the mirror queue, check the backfill watermark, freeze "
+        "writers); storage/migration.py's cutover() — freeze, final "
+        "drain, per-keyspace watermark, then the flip — is the "
+        "packaged shape"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        lowered = ctx.source.lower()
+        if not any(m in lowered for m in _FLIP_MARKERS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            lname = node.name.lower()
+            if not any(m in lname for m in _FLIP_MARKERS):
+                continue
+            sites = self._flip_sites(node)
+            if not sites or self._has_barrier(node):
+                continue
+            for site in sites:
+                yield self.finding(
+                    ctx,
+                    site,
+                    f"{node.name}() flips between two stores/layouts "
+                    "with no watermark/drain/barrier evidence in "
+                    "scope — verify the lagging side caught up "
+                    "(drain the queue, check the watermark) before "
+                    "the flip, or every in-flight write is stranded "
+                    "on the retired path.",
+                )
+
+    # -- flip-site detection ------------------------------------------
+
+    @classmethod
+    def _flip_sites(cls, fn: ast.AST) -> List[ast.AST]:
+        sites: List[ast.AST] = []
+        for node in _walk_in_scope(fn):
+            if isinstance(node, ast.If) and node.orelse:
+                body = cls._branch_assigns(node.body)
+                orelse = cls._branch_assigns(node.orelse)
+                for target, src_a in body.items():
+                    src_b = orelse.get(target)
+                    if src_b is None:
+                        continue
+                    if cls._two_sources(src_a, src_b):
+                        sites.append(node)
+                        break
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.IfExp
+            ):
+                if (
+                    len(node.targets) == 1
+                    and _dotted_source(node.targets[0])
+                    and cls._two_sources(
+                        node.value.body, node.value.orelse
+                    )
+                ):
+                    sites.append(node)
+        return sites
+
+    @staticmethod
+    def _branch_assigns(stmts) -> dict:
+        """Map of dotted-target -> source node for the plain
+        handle-from-handle assignments in one branch of an ``if``."""
+        out: dict = {}
+        for stmt in stmts:
+            for node in _walk_in_scope(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if len(node.targets) != 1:
+                    continue
+                target = _dotted_source(node.targets[0])
+                if target and _dotted_source(node.value):
+                    out[target] = node.value
+        return out
+
+    @staticmethod
+    def _two_sources(a: ast.AST, b: ast.AST) -> bool:
+        """Two *different* same-shaped dotted sources — the signature of
+        choosing between two live handles rather than resetting one."""
+        da, db = _dotted_source(a), _dotted_source(b)
+        return bool(da) and bool(db) and da != db and type(a) is type(b)
+
+    @staticmethod
+    def _has_barrier(fn: ast.AST) -> bool:
+        """Barrier evidence: any identifier in the function's own scope
+        (not nested defs) that names a drain/watermark/freeze step."""
+        for node in _walk_in_scope(fn):
+            if isinstance(node, ast.Name):
+                ident = node.id.lower()
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr.lower()
+            else:
+                continue
+            if any(m in ident for m in _BARRIER_MARKERS):
+                return True
+        return False
+
+
 RULES: List[Rule] = [
     NoTimeout(), BareSleepRetry(), RenameNoFsync(), UnboundedRetry(),
-    UnboundedCache(),
+    UnboundedCache(), CutoverNoWatermark(),
 ]

@@ -46,6 +46,7 @@ __all__ = [
     "load_bench_history",
     "load_ledger",
     "make_record",
+    "migration_records",
     "quality_records",
     "render_trend",
     "sharded_records",
@@ -140,7 +141,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
                 "quality", "bf16_gate", "ingestScaling", "cachedFleet",
-                "shardedTrain",
+                "shardedTrain", "migrationDrill",
             )
             if key in bench
         },
@@ -400,6 +401,61 @@ def ingest_records(bench: dict, source: str = "bench") -> List[dict]:
                     },
                 )
             )
+    return out
+
+
+def migration_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The live-migration drill numbers a bench run attached
+    (``bench["migrationDrill"]``, from ``loadgen --migrate-drill`` —
+    docs/storage.md#live-migration) as trend-only ledger records:
+
+    - ``migration_drill_wall_s`` — full drill wall clock (unit
+      ``wall_s``, NOT the gated ``s``: the drill is chaos choreography
+      on a possibly-contended box, a trajectory not a gate);
+    - ``migration_dualwrite_overhead`` — dual-write wave wall over the
+      plain-write baseline wave (unit ``ratio``) — the ingest tax of
+      mirroring, the number an operator sizes the migration window by.
+
+    The layout move travels as ``scale`` verbatim (``"2->3"``):
+    ``comparable_key`` groups by scale, so a 2→3 expansion and a 3→2
+    merge never share a trajectory. A failed drill (``ok`` false)
+    records nothing — its timings measured a broken run."""
+    block = bench.get("migrationDrill")
+    if not isinstance(block, dict) or not block.get("ok"):
+        return []
+    out: List[dict] = []
+    scale = f"{block.get('oldPartitions')}->{block.get('newPartitions')}"
+    extra = {
+        k: block[k]
+        for k in ("opsPerPhase", "lostAckedWrites", "duplicateFolds")
+        if k in block
+    }
+    wall = block.get("wallS")
+    if isinstance(wall, (int, float)) and wall > 0:
+        out.append(
+            make_record(
+                source=source,
+                metric="migration_drill_wall_s",
+                value=float(wall),
+                unit="wall_s",
+                device=bench.get("device"),
+                scale=scale,
+                extra=extra,
+            )
+        )
+    overhead = block.get("dualWriteOverhead")
+    if isinstance(overhead, (int, float)) and overhead > 0:
+        out.append(
+            make_record(
+                source=source,
+                metric="migration_dualwrite_overhead",
+                value=float(overhead),
+                unit="ratio",
+                device=bench.get("device"),
+                scale=scale,
+                extra=extra,
+            )
+        )
     return out
 
 

@@ -124,6 +124,14 @@ class Changefeed:
     def last_seq(self) -> int:
         return self.oplog.last_seq
 
+    def adopt_slot(self, index: int, count: int) -> None:
+        """Claim partition slot ``(index, count)`` for this feed and its
+        oplog — the live-migration path where an empty pre-layout log
+        joins the new layout (see :meth:`OpLog.adopt_slot` for the
+        history guard)."""
+        self.oplog.adopt_slot(index, count)
+        self.partition = (int(index), int(count))
+
     def _check_owner(self, event: Event, app_id: int) -> None:
         index, count = self.partition
         if count <= 1:

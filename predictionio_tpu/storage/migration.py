@@ -1,0 +1,822 @@
+"""Live partition migration: dual-write + backfill + cutover watermark.
+
+PR 13 froze the event keyspace at boot: changing the partition count
+was an export/import outage (the old ``docs/storage.md`` failure-mode
+row said so out loud). This module makes resharding an *online*
+operation (``docs/storage.md#live-migration``): the old ``N``-partition
+layout and the new ``M``-partition layout run concurrently, and a
+:class:`PartitionMigration` coordinator walks four phases:
+
+``dual_write``
+    Every acked event write lands on the old layout (the ack — clients
+    see exactly the pre-migration durability contract) and is mirrored
+    to the new layout **asynchronously** through a durable
+    :class:`PendingQueue`: append is fsync'd before the writer returns,
+    drain happens on the coordinator's cadence, so a new-layout primary
+    hiccup can never block or fail ingest.
+
+``backfill``
+    A worker streams each old partition's **oplog history** into the
+    new layout with a durable per-partition progress cursor. Replaying
+    the old feed (not a table scan) is what makes the copy convergent:
+    logged event ops are *resolved* (final event ids) and idempotent
+    (upsert/delete), and the old oplog is a total order per partition —
+    so however mirror writes and backfill interleave, once the cursor
+    reaches the head the new layout equals the old layout's state.
+    Crash anywhere, restart, re-apply from the cursor: same state.
+
+``ready`` → ``cutover``
+    The **watermark** verifies per keyspace slice (every old partition:
+    backfill cursor == feed head) and that the mirror queue is drained.
+    :meth:`PartitionMigration.cutover` then freezes writes (the event
+    server answers 503 + ``Retry-After`` — the one bounded unavailable
+    window, docs/storage.md#live-migration), re-drains, re-verifies,
+    and flips reads-then-writes with ONE durable record through the
+    replicated metadata plane (:data:`LAYOUT_MANIFEST_ID`). A write
+    racing the watermark check lands in both layouts — it was
+    dual-written like every other — so the re-verify inside the freeze
+    is a bounded drain, never a redo.
+
+``abort`` (any phase before the flip) stops the workers, discards the
+queue and cursors, and leaves the old layout **byte-identical**: the
+migration never wrote to it, only read its feed. After the flip, abort
+refuses loudly — the system of record has moved.
+
+Deployment note: in a real fleet the *mirror* role (queue append, on
+the ingest path) lives in the event server process and the *coordinator*
+role (drain + backfill + cutover) in ``pio migrate``; both speak through
+the durable state directory, which is why every handoff here — queue
+offset, backfill cursors, phase — is a file, never memory. The chaos
+drill (``loadgen --migrate-drill``) kills and resumes the coordinator
+across instances to pin exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..obs import flight
+from ..obs.metrics import MetricsRegistry
+from ..utils.durability import atomic_write_bytes, fsync_dir
+from .event import Event
+
+logger = logging.getLogger("predictionio.storage.migration")
+
+__all__ = [
+    "LAYOUT_MANIFEST_ID",
+    "MigrationError",
+    "MigrationFrozen",
+    "MigrationState",
+    "PartitionMigration",
+    "PendingQueue",
+    "PHASES",
+    "active_layout",
+    "open_migration",
+]
+
+#: phase order; the ``pio_migration_phase`` gauge exports the index
+PHASES = (
+    "idle",
+    "dual_write",
+    "backfill",
+    "ready",
+    "cutover",
+    "done",
+    "aborted",
+)
+_PHASE_INDEX = {name: i for i, name in enumerate(PHASES)}
+
+#: phases in which acked writes are mirrored to the new layout
+_MIRRORING = frozenset({"dual_write", "backfill", "ready", "cutover"})
+
+#: the metadata-plane record the cutover flip writes: one replicated
+#: manifest row (id, version="active") whose description carries the
+#: new layout as JSON — readers resolve the active layout from the meta
+#: partition's chain, exactly like every other replicated config
+LAYOUT_MANIFEST_ID = "pio::event-layout"
+
+_STATE_NAME = "migration.json"
+_QUEUE_DIR = "mirror-queue"
+
+
+class MigrationError(RuntimeError):
+    """An invalid migration transition (cutover before the watermark,
+    abort after the flip, start over a live migration) — always loud,
+    never a silent no-op: every caller is an operator surface."""
+
+
+class MigrationFrozen(MigrationError):
+    """A write arrived inside the cutover freeze window. The event
+    server maps this to 503 + ``Retry-After`` — the same shed contract
+    as :class:`~predictionio_tpu.storage.remote.PartitionUnavailable`,
+    because to a well-behaved client the freeze IS a brief partition
+    unavailability with a bounded horizon."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class MigrationState:
+    """The durable coordinator state (``<state_dir>/migration.json``,
+    written crash-safely). Everything a restarted coordinator needs:
+    phase, both layouts, and the per-old-partition backfill cursors."""
+
+    phase: str = "idle"
+    migration_id: str = ""
+    old_url: str = ""
+    new_url: str = ""
+    old_count: int = 1
+    new_count: int = 1
+    #: old partition index (str, JSON keys) -> last oplog seq backfilled
+    cursors: Dict[str, int] = dataclasses.field(default_factory=dict)
+    started_at_unix: float = 0.0
+    flipped_at_unix: float = 0.0
+    aborted_reason: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MigrationState":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @classmethod
+    def load(cls, path: str) -> Optional["MigrationState"]:
+        try:
+            with open(path) as fh:
+                return cls.from_json(json.load(fh))
+        except OSError:
+            return None
+
+    def save(self, path: str) -> None:
+        atomic_write_bytes(
+            path, json.dumps(self.to_json(), sort_keys=True).encode()
+        )
+
+
+class PendingQueue:
+    """Durable mirror-write queue: append-only JSONL plus a drain
+    cursor file. The append fsyncs before the writer returns — the
+    mirror copy is part of the write's durability story even though it
+    is never part of its *ack* — and the cursor advances only after the
+    new layout applied the entry. Every entry is an idempotent op
+    (resolved event ids → upsert; deletes keyed by id), so a crash
+    between apply and cursor persist re-applies a suffix and converges,
+    the same replay contract the oplog gives replicas."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self._dir = directory
+        self._path = os.path.join(directory, "queue.jsonl")
+        self._cursor_path = os.path.join(directory, "queue_cursor.json")
+        self._lock = threading.Lock()
+        self._offset = 0  # drained byte offset
+        self.drained = 0
+        try:
+            with open(self._cursor_path) as fh:
+                cur = json.load(fh)
+            self._offset = int(cur.get("offset", 0))
+            self.drained = int(cur.get("drained", 0))
+        except OSError:
+            pass
+        self.appended = 0
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as fh:
+                self.appended = sum(1 for _ in fh)
+        # unbuffered append handle: a completed append is visible to a
+        # concurrent drain (coordinator instance) via the page cache
+        self._fh = open(self._path, "ab", buffering=0)
+
+    def append(self, entry: dict) -> None:
+        """Durably enqueue one mirror op. Fsync per append: if the old
+        layout acked the write, the mirror intent must survive a crash
+        — losing it would silently strand the event on cutover."""
+        line = (json.dumps(entry, separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            self._fh.write(line)
+            # pio: lint-ok[conc-blocking-under-lock] the fsync IS the ack barrier: a concurrent append must not reorder against this one's durability
+            os.fsync(self._fh.fileno())
+            self.appended += 1
+
+    def pending(self) -> int:
+        with self._lock:
+            return self.appended - self.drained
+
+    def drain(
+        self, apply_fn: Callable[[dict], None], max_entries: int = 500
+    ) -> int:
+        """Apply up to ``max_entries`` undrained entries in order. Stops
+        (without raising) at the first failing entry — a dead new-layout
+        primary leaves the queue intact for the next round; ingest never
+        sees it. Returns the number applied."""
+        applied = 0
+        with self._lock:
+            offset = self._offset
+        try:
+            fh = open(self._path, "rb")
+        except OSError:
+            return 0
+        with fh:
+            fh.seek(offset)
+            for _ in range(max_entries):
+                line = fh.readline()
+                if not line:
+                    break
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    # torn tail of a crashed append: everything after it
+                    # is unreadable until the writer completes the line
+                    break
+                try:
+                    apply_fn(entry)
+                except Exception as exc:
+                    logger.warning(
+                        "mirror queue drain stalled (entry %d): %s",
+                        self.drained + applied + 1, exc,
+                    )
+                    break
+                applied += 1
+                offset = fh.tell()
+        if applied:
+            with self._lock:
+                self._offset = offset
+                self.drained += applied
+                drained = self.drained
+            # cursor write outside the lock: only one coordinator
+            # drains, so the snapshot cannot go backwards, and appends
+            # (the hot ingest path) never wait out the rename
+            atomic_write_bytes(
+                self._cursor_path,
+                json.dumps(
+                    {"offset": offset, "drained": drained}
+                ).encode(),
+            )
+        return applied
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    def discard(self) -> None:
+        """Abort path: close and remove the queue files — the mirror
+        intent dies with the migration, the old layout never needed it."""
+        self.close()
+        for path in (self._path, self._cursor_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        fsync_dir(self._dir)
+
+
+def open_migration(
+    state_dir: str,
+    old_url: str = "",
+    new_url: str = "",
+    timeout: float = 10.0,
+) -> "PartitionMigration":
+    """The CLI's one construction point (``pio migrate``): resume a
+    coordinator over ``state_dir`` with ``pio+ha://`` remote clients
+    derived from the recorded layout URLs (``--old``/``--new`` only
+    needed on the very first ``start``). The metadata plane rides the
+    OLD layout's meta chain — both layouts share it, which is what
+    makes the flip a replicated metadata write."""
+    state = MigrationState.load(os.path.join(state_dir, _STATE_NAME))
+    if state is not None:
+        old_url = old_url or state.old_url
+        new_url = new_url or state.new_url
+    if not old_url or not new_url:
+        raise MigrationError(
+            "no layout URLs: pass --old and --new (none recorded in "
+            f"{state_dir})"
+        )
+    from .remote import RemoteEventStore, RemoteMetadataStore
+
+    return PartitionMigration(
+        RemoteEventStore(old_url, timeout=timeout),
+        RemoteEventStore(new_url, timeout=timeout),
+        state_dir,
+        old_url=old_url,
+        new_url=new_url,
+        metadata=RemoteMetadataStore(old_url, timeout=timeout),
+    )
+
+
+def active_layout(metadata) -> Optional[dict]:
+    """The layout record the last cutover flipped to (None before any
+    migration): ``{"url", "partitions", "migrationId", "flippedAtUnix"}``
+    read from the replicated metadata plane."""
+    try:
+        m = metadata.manifest_get(LAYOUT_MANIFEST_ID, "active")
+    except Exception:
+        return None
+    if m is None or not m.description:
+        return None
+    try:
+        return json.loads(m.description)
+    except ValueError:
+        return None
+
+
+class PartitionMigration:
+    """Coordinator for one live migration old(N) → new(M).
+
+    ``old_store`` / ``new_store`` are event-store clients (the
+    ``pio+ha://`` :class:`~predictionio_tpu.storage.remote
+    .RemoteEventStore`, or any store with the same ``insert`` /
+    ``write`` / ``delete`` / ``init`` surface); each client routes to
+    its *own* layout's owning partition internally, so this class never
+    recomputes hash math. ``old_feeds`` are per-old-partition changefeed
+    sources (:class:`~predictionio_tpu.continuous.watcher.LocalFeed` /
+    ``RemoteFeed``); resolved lazily from ``old_url`` when omitted.
+
+    Construction over an existing ``state_dir`` *resumes*: phase, queue
+    offset and backfill cursors are all durable, so a coordinator killed
+    mid-anything picks up where the files say."""
+
+    def __init__(
+        self,
+        old_store,
+        new_store,
+        state_dir: str,
+        *,
+        old_url: str = "",
+        new_url: str = "",
+        old_feeds: Optional[Sequence] = None,
+        metadata=None,
+        clock: Callable[[], float] = time.monotonic,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.old_store = old_store
+        self.new_store = new_store
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self._state_path = os.path.join(state_dir, _STATE_NAME)
+        self._metadata = metadata
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._dead = False
+        self.writes_frozen = False
+        state = MigrationState.load(self._state_path)
+        self.state = state if state is not None else MigrationState(
+            old_url=old_url,
+            new_url=new_url,
+            old_count=getattr(old_store, "partition_count", 1),
+            new_count=getattr(new_store, "partition_count", 1),
+        )
+        if old_url and not self.state.old_url:
+            self.state.old_url = old_url
+        if new_url and not self.state.new_url:
+            self.state.new_url = new_url
+        self._feeds = list(old_feeds) if old_feeds is not None else None
+        self.queue = PendingQueue(os.path.join(state_dir, _QUEUE_DIR))
+        #: the store of record, swapped exactly once (in :meth:`cutover`,
+        #: behind the verified watermark); one attribute read on the hot
+        #: ingest path instead of a phase recompute per request
+        self._active = (
+            self.new_store if self.state.phase == "done" else self.old_store
+        )
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._phase_gauge = self.metrics.gauge(
+            "pio_migration_phase",
+            "Live-migration phase index (order: " + ", ".join(PHASES) + ")",
+        )
+        self._lag_gauge = self.metrics.gauge(
+            "pio_migration_backfill_lag_events",
+            "Old-partition oplog ops not yet backfilled into the new "
+            "layout, per old partition",
+            labelnames=("partition",),
+        )
+        self._phase_gauge.set(_PHASE_INDEX[self.state.phase])
+
+    # -- feeds ------------------------------------------------------------
+    def _old_feeds(self) -> List:
+        if self._feeds is None:
+            from ..continuous.watcher import RemoteFeed
+            from .partition import partition_primaries
+
+            if not self.state.old_url:
+                raise MigrationError(
+                    "no old_feeds and no old_url to derive them from"
+                )
+            self._feeds = [
+                RemoteFeed(url)
+                for url in partition_primaries(self.state.old_url)
+            ]
+        return self._feeds
+
+    # -- phase machinery --------------------------------------------------
+    @property
+    def phase(self) -> str:
+        return self.state.phase
+
+    @property
+    def flipped(self) -> bool:
+        return self.state.phase in ("done",)
+
+    def _check_alive(self) -> None:
+        if self._dead:
+            raise MigrationError("coordinator instance was killed")
+
+    def _set_phase(self, phase: str) -> None:
+        self.state.phase = phase
+        self.state.save(self._state_path)
+        self._phase_gauge.set(_PHASE_INDEX[phase])
+        flight.record(
+            "migration", "storage.migration.phase",
+            phase=phase, migrationId=self.state.migration_id,
+        )
+
+    def start(self) -> dict:
+        """``idle`` → ``dual_write``: from this moment every acked write
+        must be mirrored (:meth:`mirror`). Loud on re-entry — a second
+        concurrent migration would fork the mirror queue."""
+        self._check_alive()
+        with self._lock:
+            if self.state.phase != "idle":
+                raise MigrationError(
+                    f"migration already {self.state.phase} "
+                    f"(id {self.state.migration_id or '?'})"
+                )
+            self.state.migration_id = secrets.token_hex(6)
+            self.state.started_at_unix = time.time()
+            self.state.cursors = {
+                str(i): 0 for i in range(self.state.old_count)
+            }
+            self._set_phase("dual_write")
+        return self.status()
+
+    # -- the dual-write path ---------------------------------------------
+    def mirroring(self) -> bool:
+        return self.state.phase in _MIRRORING
+
+    def check_frozen(self) -> None:
+        """Raise :class:`MigrationFrozen` inside the cutover freeze —
+        the event server calls this before acking any event write."""
+        if self.writes_frozen:
+            raise MigrationFrozen(
+                "migration cutover in progress: writes are frozen for "
+                "the final drain", retry_after_s=1.0,
+            )
+
+    def active_events(self):
+        """The store of record: old until the flip, new after. The event
+        server routes every event read and write through this."""
+        return self._active
+
+    def mirror(self, events: Sequence[Event], app_id: int) -> None:
+        """Enqueue already-ACKED events for the new layout. Every event
+        must carry its resolved id (the ack resolved it) — the queue
+        replay and the backfill both upsert that id, which is the whole
+        dedup story. Never raises into the ingest path: a queue append
+        failure is recorded loudly instead (the backfill still covers
+        the event, because it is in the old oplog)."""
+        if not self.mirroring():
+            return
+        try:
+            payload = []
+            for e in events:
+                if e.event_id is None:
+                    raise ValueError(
+                        "mirror requires resolved event ids (got an "
+                        "id-less event) — mirror after the ack"
+                    )
+                payload.append(e.to_json_dict())
+            self.queue.append(
+                {"kind": "write", "app": int(app_id), "events": payload}
+            )
+        except Exception as exc:
+            logger.error("migration mirror enqueue failed: %s", exc)
+            flight.record(
+                "migration", "storage.migration.mirror_failed",
+                error=str(exc), app=int(app_id),
+            )
+
+    def mirror_delete(self, event_id: str, app_id: int) -> None:
+        """Deletes mirror too — a delete acked on the old layout must
+        not resurrect on the new one (the backfill also replays it, so
+        this is latency, not correctness)."""
+        if not self.mirroring():
+            return
+        try:
+            self.queue.append(
+                {"kind": "delete", "app": int(app_id), "eventId": event_id}
+            )
+        except Exception as exc:
+            logger.error("migration mirror enqueue failed: %s", exc)
+            flight.record(
+                "migration", "storage.migration.mirror_failed",
+                error=str(exc), app=int(app_id),
+            )
+
+    def write(self, events: Sequence[Event], app_id: int) -> List[str]:
+        """Convenience full dual-write (the drill's writer path; the
+        event server composes the same steps inline): ack on the active
+        store, then mirror the resolved events. Returns the acked ids."""
+        self.check_frozen()
+        store = self.active_events()
+        ids: List[str] = []
+        resolved: List[Event] = []
+        for e in events:
+            event_id = store.insert(e, app_id)
+            ids.append(event_id)
+            resolved.append(
+                e if e.event_id is not None
+                else dataclasses.replace(e, event_id=event_id)
+            )
+        if not self.flipped:
+            self.mirror(resolved, app_id)
+        return ids
+
+    def _apply_queue_entry(self, entry: dict) -> None:
+        kind = entry.get("kind")
+        if kind == "write":
+            self.new_store.write(
+                [Event.from_json_dict(d) for d in entry["events"]],
+                entry["app"],
+            )
+        elif kind == "delete":
+            self.new_store.delete(entry["eventId"], entry["app"])
+        else:
+            raise MigrationError(f"unknown mirror queue entry {kind!r}")
+
+    def drain_queue(self, max_entries: int = 500) -> int:
+        self._check_alive()
+        return self.queue.drain(self._apply_queue_entry, max_entries)
+
+    # -- backfill ---------------------------------------------------------
+    def begin_backfill(self) -> dict:
+        self._check_alive()
+        with self._lock:
+            if self.state.phase != "dual_write":
+                raise MigrationError(
+                    f"backfill starts from dual_write, not "
+                    f"{self.state.phase}"
+                )
+            self._set_phase("backfill")
+        return self.status()
+
+    def _apply_backfill_op(self, op: dict) -> None:
+        """Replay one old-oplog op into the new layout. Only event ops:
+        metadata and models live on the meta chain, which both layouts
+        share — migrating them here would double-apply. Idempotent by
+        the same argument as changefeed.apply_op (resolved ids)."""
+        kind = op.get("kind")
+        if kind == "event_insert":
+            self.new_store.insert(
+                Event.from_json_dict(op["event"]), op["app"]
+            )
+        elif kind == "event_write":
+            self.new_store.write(
+                [Event.from_json_dict(d) for d in op["events"]], op["app"]
+            )
+        elif kind == "event_delete":
+            self.new_store.delete(op["eventId"], op["app"])
+        elif kind == "event_init":
+            self.new_store.init(op["app"])
+        elif kind == "event_remove":
+            self.new_store.remove(op["app"])
+        # meta / model ops: deliberately skipped (see docstring)
+
+    def backfill_step(self, max_ops: int = 500) -> dict:
+        """One bounded backfill round across every old partition: fetch
+        from the durable cursor, apply, persist the cursor *after* the
+        apply (crash between = idempotent re-apply). Returns per-
+        partition progress; a partition whose fetch or apply fails is
+        reported stalled and retried next round — one dead primary
+        never wedges the others' progress."""
+        self._check_alive()
+        if self.state.phase not in ("backfill", "ready", "cutover"):
+            raise MigrationError(
+                f"backfill_step in phase {self.state.phase}"
+            )
+        progress: Dict[str, dict] = {}
+        feeds = self._old_feeds()
+        for i, feed in enumerate(feeds):
+            key = str(i)
+            cursor = int(self.state.cursors.get(key, 0))
+            row = {"cursor": cursor, "applied": 0, "stalled": False}
+            try:
+                batch = feed.fetch(cursor, max_ops)
+                for change in batch.get("changes", []):
+                    self._apply_backfill_op(change["op"])
+                    cursor = int(change["seq"])
+                    row["applied"] += 1
+                head = int(batch.get("lastSeq", cursor))
+            except Exception as exc:
+                logger.warning(
+                    "backfill partition %d stalled at seq %d: %s",
+                    i, cursor, exc,
+                )
+                row["stalled"] = True
+                row["error"] = str(exc)
+                head = cursor
+            if row["applied"]:
+                self.state.cursors[key] = cursor
+                self.state.save(self._state_path)
+            row["cursor"] = cursor
+            row["head"] = max(head, cursor)
+            row["lag"] = max(0, row["head"] - cursor)
+            self._lag_gauge.set(row["lag"], partition=key)
+            progress[key] = row
+        return progress
+
+    # -- watermark + cutover ----------------------------------------------
+    def watermark(self) -> dict:
+        """The cutover precondition, verified per keyspace slice: every
+        old partition's backfill cursor has reached its feed head, AND
+        the mirror queue is drained. Read-only — callers decide what to
+        do about a false verdict."""
+        partitions: Dict[str, dict] = {}
+        ok = self.state.phase in ("backfill", "ready", "cutover")
+        for i, feed in enumerate(self._old_feeds()):
+            key = str(i)
+            cursor = int(self.state.cursors.get(key, 0))
+            try:
+                cp = feed.checkpoint()
+                head = int(cp.get("seq", cp.get("lastSeq", 0)))
+                row = {"cursor": cursor, "head": head,
+                       "lag": max(0, head - cursor)}
+            except Exception as exc:
+                row = {"cursor": cursor, "head": None, "lag": None,
+                       "error": str(exc)}
+                ok = False
+            if row.get("lag") != 0:
+                ok = False
+            self._lag_gauge.set(row.get("lag") or 0, partition=key)
+            partitions[key] = row
+        pending = self.queue.pending()
+        if pending:
+            ok = False
+        return {"ok": ok, "partitions": partitions, "queuePending": pending}
+
+    def pump(self, max_ops: int = 500) -> dict:
+        """One coordinator tick: drain the mirror queue, advance the
+        backfill, and promote ``backfill`` → ``ready`` the first time
+        the watermark verifies. This is the unit the drill kills and
+        resumes around — everything it advances is durable."""
+        self._check_alive()
+        out: dict = {"phase": self.state.phase}
+        out["queueDrained"] = self.drain_queue(max_ops)
+        if self.state.phase == "dual_write":
+            # the first coordinator tick commits to the backfill; the
+            # operator's mirror-health window is between start and here
+            self.begin_backfill()
+            out["phase"] = self.state.phase
+        if self.state.phase in ("backfill", "ready", "cutover"):
+            out["backfill"] = self.backfill_step(max_ops)
+        if self.state.phase == "backfill":
+            wm = self.watermark()
+            out["watermark"] = wm
+            if wm["ok"]:
+                with self._lock:
+                    self._set_phase("ready")
+                out["phase"] = "ready"
+        return out
+
+    def cutover(self, timeout_s: float = 30.0) -> dict:
+        """Freeze, final drain, re-verify, flip. The flip writes the
+        new layout through the replicated metadata plane and only then
+        advances the durable phase to ``done`` — a crash between leaves
+        phase ``cutover`` with the manifest already new, and resume
+        completes the phase write (the manifest is the authority, the
+        phase file is the coordinator's bookmark). Raises (and thaws)
+        if the watermark cannot verify inside ``timeout_s``."""
+        self._check_alive()
+        with self._lock:
+            if self.state.phase not in ("ready", "backfill", "cutover"):
+                raise MigrationError(
+                    f"cutover from phase {self.state.phase!r} — run the "
+                    "backfill to the watermark first"
+                )
+        self.writes_frozen = True
+        try:
+            deadline = self._clock() + timeout_s
+            while True:
+                # the race-window write: anything acked between the
+                # caller's watermark check and this freeze was dual-
+                # written like every other write, so the final drain
+                # below is bounded by the freeze, not re-opened by it
+                self.drain_queue()
+                if self.state.phase in ("backfill", "ready"):
+                    self.backfill_step()
+                wm = self.watermark()
+                if wm["ok"]:
+                    break
+                if self._clock() >= deadline:
+                    raise MigrationError(
+                        "cutover watermark did not verify within "
+                        f"{timeout_s:.1f}s: {json.dumps(wm)}"
+                    )
+                time.sleep(0.01)
+            with self._lock:
+                self._set_phase("cutover")
+                self._flip()
+                self.state.flipped_at_unix = time.time()
+                self._set_phase("done")
+                # reads and writes flip together, behind the watermark
+                # this function just verified and the drained queue —
+                # the evidence robust-cutover-no-watermark demands
+                if self.flipped:
+                    self._active = self.new_store
+                else:
+                    self._active = self.old_store
+        finally:
+            self.writes_frozen = False
+        flight.record(
+            "migration", "storage.migration.cutover",
+            migrationId=self.state.migration_id,
+            oldCount=self.state.old_count, newCount=self.state.new_count,
+        )
+        return self.status()
+
+    def _flip(self) -> None:
+        """The atomic read+write flip: after this, :meth:`active_events`
+        answers the new store. Guarded by the watermark verified in
+        :meth:`cutover` (queue drained + every keyspace slice caught
+        up) — flipping without it would strand the undrained suffix on
+        a layout nothing reads anymore."""
+        if self._metadata is not None:
+            from .metadata import EngineManifest
+
+            self._metadata.manifest_update(
+                EngineManifest(
+                    id=LAYOUT_MANIFEST_ID,
+                    version="active",
+                    name="event-layout",
+                    description=json.dumps(
+                        {
+                            "url": self.state.new_url,
+                            "partitions": self.state.new_count,
+                            "migrationId": self.state.migration_id,
+                            "flippedAtUnix": time.time(),
+                        }
+                    ),
+                )
+            )
+
+    # -- abort / drill helpers --------------------------------------------
+    def abort(self, reason: str = "") -> dict:
+        """Safe before the flip, refused loudly after. Discards the
+        mirror queue and cursors; the old layout is untouched (the
+        migration only ever *read* it), so service continues exactly as
+        before ``start``."""
+        with self._lock:
+            if self.state.phase in ("done",):
+                raise MigrationError(
+                    "cannot abort: cutover already flipped to the new "
+                    "layout — migrate back instead"
+                )
+            self.queue.discard()
+            self.state.cursors = {}
+            self.state.aborted_reason = reason or "operator abort"
+            self._set_phase("aborted")
+        flight.record(
+            "migration", "storage.migration.abort",
+            migrationId=self.state.migration_id, reason=reason,
+        )
+        logger.warning(
+            "migration %s aborted (%s): old layout remains the system "
+            "of record", self.state.migration_id, reason,
+        )
+        return self.status()
+
+    def kill(self) -> None:
+        """Drill helper: simulate the coordinator process dying. The
+        instance refuses further coordination (writers keep their queue
+        handle — the mirror role survives in the event server); a new
+        instance over the same ``state_dir`` resumes from the durable
+        cursors."""
+        self._dead = True
+
+    def status(self) -> dict:
+        queue_pending = self.queue.pending()
+        return {
+            "phase": self.state.phase,
+            "migrationId": self.state.migration_id,
+            "oldUrl": self.state.old_url,
+            "newUrl": self.state.new_url,
+            "oldCount": self.state.old_count,
+            "newCount": self.state.new_count,
+            "cursors": dict(self.state.cursors),
+            "queuePending": queue_pending,
+            "queueAppended": self.queue.appended,
+            "queueDrained": self.queue.drained,
+            "abortedReason": self.state.aborted_reason or None,
+        }
+
+    def close(self) -> None:
+        self.queue.close()

@@ -193,6 +193,34 @@ class OpLog:
         """First sequence number this log can serve (base_seq + 1)."""
         return self.base_seq + 1
 
+    def adopt_slot(self, index: int, count: int) -> None:
+        """Claim partition slot ``[index, count]`` for this log — the
+        live-migration upgrade path (docs/storage.md#live-migration)
+        where an empty log minted before the new layout existed joins
+        it. Only legal while the log has served nothing: re-sloting a
+        log with history would let a tailer resume a cursor minted
+        against a different keyspace split. A matching existing slot is
+        a no-op; a conflicting one, or any history, is loud."""
+        with self._lock:
+            if self.partition is not None:
+                from .partition import check_partition
+
+                check_partition(self.partition, index, count)
+                return
+            if self._last_seq != self.base_seq:
+                raise ValueError(
+                    f"oplog {self._dir} has history through seq "
+                    f"{self._last_seq}; cannot adopt partition slot "
+                    f"[{index}, {count}] — use a fresh log directory"
+                )
+            path = os.path.join(self._dir, _META_NAME)
+            with open(path) as fh:
+                meta = json.load(fh)
+            meta["partition"] = [int(index), int(count)]
+            # pio: lint-ok[flow-blocking-under-lock] one-shot admin op on a provably empty log; the slot must be durable before any append can observe it
+            atomic_write_bytes(path, json.dumps(meta).encode())
+            self.partition = [int(index), int(count)]
+
     def checkpoint(self) -> dict:
         """The ``/replicate/checkpoint`` identity triple (plus the
         partition slot when this is a partitioned primary's log)."""

@@ -258,6 +258,77 @@ def rollout_command(args: argparse.Namespace) -> dict:
     )
 
 
+def migrate_command(args: argparse.Namespace) -> int:
+    """``pio migrate start|pump|status|cutover|abort`` — drives one
+    :class:`~predictionio_tpu.storage.migration.PartitionMigration`
+    over its durable state dir (docs/storage.md#live-migration). Every
+    invocation is a fresh coordinator instance resuming from the files;
+    ``pump`` is the bounded tick an operator (or cron) repeats until
+    ``status`` reports the watermark ok, then ``cutover`` flips."""
+    from ..storage.migration import open_migration
+
+    sub = args.migrate_command
+    mig = open_migration(
+        args.state,
+        old_url=getattr(args, "old", "") or "",
+        new_url=getattr(args, "new", "") or "",
+    )
+    try:
+        if sub == "start":
+            _emit(mig.start())
+        elif sub == "pump":
+            rounds = [
+                mig.pump(max_ops=args.max_ops)
+                for _ in range(max(1, args.rounds))
+            ]
+            _emit({"rounds": rounds, "status": mig.status()})
+        elif sub == "status":
+            out = mig.status()
+            if mig.mirroring():
+                out["watermark"] = mig.watermark()
+            _emit(out)
+        elif sub == "cutover":
+            _emit(mig.cutover(timeout_s=args.timeout))
+        elif sub == "abort":
+            _emit(mig.abort(args.reason))
+        return EXIT_OK
+    finally:
+        mig.close()
+
+
+def autoscale_command(args: argparse.Namespace) -> int:
+    """``pio autoscale --signals FILE [--ticks N] [--execute]`` — run
+    the :class:`~predictionio_tpu.fleet.autoscale.FleetAutoscaler`
+    control loop over a signals snapshot and print every decision
+    (docs/robustness.md#autoscaler). Dry-run unless ``--execute``; the
+    CLI wires no actuator, so even executed runs emit recommendations —
+    the posture still flips the ``dry_run`` label on the counter and
+    the ledger, which is what the drill pins."""
+    from ..fleet.autoscale import (
+        AutoscaleConfig,
+        FleetAutoscaler,
+        signals_from_dict,
+    )
+
+    with open(args.signals, encoding="utf-8") as fh:
+        signals = signals_from_dict(json.load(fh))
+    config = AutoscaleConfig.from_env(
+        **({"dry_run": False} if args.execute else {})
+    )
+    scaler = FleetAutoscaler(config)
+    actions = []
+    for _ in range(max(1, args.ticks)):
+        for action in scaler.observe(signals):
+            actions.append(action.to_json())
+    _emit({
+        "dryRun": config.dry_run,
+        "ticks": scaler.tick_count,
+        "actions": actions,
+        "decisions": scaler.decisions(),
+    })
+    return EXIT_OK
+
+
 # ---------------------------------------------------------------------------
 # CLI grammar + dispatch
 # ---------------------------------------------------------------------------
@@ -614,6 +685,78 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--json", action="store_true",
                     help="emit raw spans as JSON")
     tr.add_argument("--timeout", type=float, default=5.0)
+
+    mg = sub.add_parser(
+        "migrate",
+        help="live event-store partition migration: dual-write + "
+        "backfill + watermark cutover with zero ingest downtime "
+        "(docs/storage.md#live-migration)",
+    )
+    mg_sub = mg.add_subparsers(dest="migrate_command", required=True)
+    mg_start = mg_sub.add_parser(
+        "start", help="enter dual_write: every acked write mirrors to "
+        "the new layout"
+    )
+    mg_start.add_argument(
+        "--old", required=True, metavar="URL",
+        help="current layout (pio+ha:// partition sets)",
+    )
+    mg_start.add_argument(
+        "--new", required=True, metavar="URL",
+        help="target layout (pio+ha:// partition sets, M partitions)",
+    )
+    mg_pump = mg_sub.add_parser(
+        "pump", help="bounded coordinator ticks: drain the mirror "
+        "queue, advance the backfill, promote to ready at the watermark"
+    )
+    mg_pump.add_argument("--rounds", type=int, default=1, metavar="N")
+    mg_pump.add_argument("--max-ops", type=int, default=500, metavar="K",
+                         help="queue entries / oplog ops per round")
+    mg_status = mg_sub.add_parser(
+        "status", help="phase, cursors, queue depth, per-keyspace "
+        "watermark verdict"
+    )
+    mg_cut = mg_sub.add_parser(
+        "cutover", help="freeze writes, final drain, verify the "
+        "watermark per keyspace, flip reads+writes atomically"
+    )
+    mg_cut.add_argument("--timeout", type=float, default=30.0,
+                        help="seconds the freeze may hold before the "
+                        "cutover aborts (writes thaw, phase unchanged)")
+    mg_abort = mg_sub.add_parser(
+        "abort", help="abandon before the flip: mirror queue discarded, "
+        "old layout stays the system of record, byte-identical"
+    )
+    mg_abort.add_argument("--reason", default="operator abort")
+    for sp in (mg_start, mg_pump, mg_status, mg_cut, mg_abort):
+        sp.add_argument(
+            "--state", required=True, metavar="DIR",
+            help="durable coordinator state dir (phase, queue, cursors)",
+        )
+
+    asc = sub.add_parser(
+        "autoscale",
+        help="SLO-driven fleet autoscaler: at most one bounded, "
+        "hysteresis-damped action per tick, dry-run by default "
+        "(docs/robustness.md#autoscaler)",
+    )
+    asc.add_argument(
+        "--signals", required=True, metavar="FILE",
+        help="JSON signals snapshot: replicasPerShard, partitionCount, "
+        "firing, burn, breakerOpenBackends, shardPressure, partitionShed "
+        "(docs/cli.md)",
+    )
+    asc.add_argument(
+        "--ticks", type=int, default=1, metavar="N",
+        help="control ticks over the snapshot (hysteresis needs "
+        "sustained pressure: up_ticks consecutive hot ticks)",
+    )
+    asc.add_argument(
+        "--execute", action="store_true",
+        help="clear dry-run for this run (PIO_AUTOSCALE_DRY_RUN=0 "
+        "equivalent); without a wired actuator actions stay "
+        "recommendations",
+    )
 
     up = sub.add_parser(
         "upgrade", help="migrate event data between storage backends"
@@ -1105,6 +1248,12 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
         result = status(registry)
         _emit(result)
         return EXIT_OK if result["ok"] else EXIT_FAIL
+
+    if cmd == "migrate":
+        return migrate_command(args)
+
+    if cmd == "autoscale":
+        return autoscale_command(args)
 
     if cmd == "upgrade":
         from .upgrade import run_upgrade

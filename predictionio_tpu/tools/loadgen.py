@@ -963,6 +963,309 @@ def run_partition_chaos(
                 pass
 
 
+def run_migrate_drill(
+    old_partitions: int = 2,
+    new_partitions: int = 3,
+    ops_per_phase: int = 18,
+    concurrency: int = 3,
+    kill_new_partition: int = 1,
+    state_root: Optional[str] = None,
+) -> dict:
+    """Live partition-migration chaos drill (``--migrate-drill``,
+    docs/storage.md#live-migration): N=2 → M=3 under concurrent
+    writers, with BOTH failure injections the design claims to survive:
+
+    - **coordinator killed mid-dual-write**: the first
+      :class:`~predictionio_tpu.storage.migration.PartitionMigration`
+      is killed after the first write wave; writers keep acking through
+      its surviving mirror role (the event-server side of the split),
+      and a second instance over the same ``state_dir`` resumes from
+      the durable phase/queue/cursor files;
+    - **new-layout primary killed mid-backfill**: partition
+      ``kill_new_partition`` of the NEW fleet is drained then
+      hard-killed; the backfill stalls only the affected keyspace
+      slices (loudly, retried), a cutover attempted inside the window
+      is REFUSED because the watermark cannot verify, and after the
+      replica promotes the backfill converges with no reconfiguration;
+    - acceptance: zero lost acked writes (every acked id readable from
+      the new layout after the flip, old and new id sets identical at
+      flip time), cutover only after the per-keyspace watermark, and
+      zero duplicated folded events across the
+      :class:`~predictionio_tpu.continuous.watcher.PartitionedFeedWatcher`
+      cursor handoff (old-layout folds ∩ new-layout folds = ∅).
+
+    Returns a report dict; ``report["ok"]`` is the drill verdict. Wall
+    time and dual-write overhead ride into the perf ledger via the
+    ``migrationDrill`` bench block (trend-only).
+    """
+    import os
+    import tempfile
+    import time as _time
+
+    from ..continuous.watcher import (
+        PartitionedFeedWatcher,
+        RemoteFeed,
+        handoff_cursors,
+    )
+    from ..storage import remote
+    from ..storage.migration import MigrationError, PartitionMigration
+
+    if not (0 <= kill_new_partition < new_partitions):
+        raise ValueError(
+            "--kill-partition-at must name a NEW-layout partition in "
+            f"[0, {new_partitions})"
+        )
+    root = state_root or tempfile.mkdtemp(prefix="pio-migrate-drill-")
+    remote.reset_resilience()
+    report: dict = {
+        "mode": "migrate-drill",
+        "oldPartitions": old_partitions,
+        "newPartitions": new_partitions,
+        "killNewPartition": kill_new_partition,
+    }
+    old_primaries: List = []
+    new_primaries: List = []
+    new_replicas: List = []
+    migs: List = []
+    t_start = _time.monotonic()
+    try:
+        old_primaries, _none, old_url = _boot_partition_fleet(
+            os.path.join(root, "old"), old_partitions, with_replicas=False
+        )
+        new_primaries, new_replicas, new_url = _boot_partition_fleet(
+            os.path.join(root, "new"), new_partitions, with_replicas=True
+        )
+        old_store = remote.RemoteEventStore(old_url, timeout=10.0)
+        new_store = remote.RemoteEventStore(new_url, timeout=10.0)
+        app_id = 1
+        old_store.init(app_id)
+        new_store.init(app_id)
+        for replica in new_replicas:
+            replica.catch_up()
+
+        acked: dict = {}  # event_id -> corpus tag
+        lock = threading.Lock()
+        failures = {"count": 0}
+
+        def drive(writer, events: List, tag: str) -> float:
+            """Concurrent writers through ``writer(event) -> id``;
+            returns the wave's wall seconds."""
+            cursor = {"next": 0}
+
+            def worker() -> None:
+                while True:
+                    with lock:
+                        pos = cursor["next"]
+                        if pos >= len(events):
+                            return
+                        cursor["next"] = pos + 1
+                    try:
+                        eid = writer(events[pos])
+                        with lock:
+                            acked[eid] = tag
+                    except Exception:
+                        with lock:
+                            failures["count"] += 1
+
+            t0 = _time.monotonic()
+            threads = [
+                threading.Thread(target=worker, daemon=True)
+                for _ in range(concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return _time.monotonic() - t0
+
+        # -- pre-migration history (also the dual-write-overhead
+        # baseline: the same writer fan, no mirror in the path) --------
+        seed = _partition_corpus(old_store, app_id, ops_per_phase, "seed")
+        plain_wall = drive(
+            lambda e: old_store.insert(e, app_id), seed, "seed"
+        )
+
+        # old-layout watcher accumulates folds through the whole
+        # migration; its per-partition cursors are the handoff's floor
+        watcher_dir = os.path.join(root, "watcher")
+        old_feeds = [
+            RemoteFeed(f"http://127.0.0.1:{p.bound_port}")
+            for p in old_primaries
+        ]
+        watcher = PartitionedFeedWatcher(
+            old_feeds, app_id, {"rate": "rating"}, watcher_dir,
+        )
+        folded_old: set = set()
+
+        state_dir = os.path.join(root, "migration")
+        meta = remote.RemoteMetadataStore(old_url, timeout=10.0)
+        mig = PartitionMigration(
+            old_store, new_store, state_dir,
+            old_url=old_url, new_url=new_url,
+            old_feeds=old_feeds, metadata=meta,
+        )
+        migs.append(mig)
+        mig.start()
+
+        # -- dual-write wave 1, then the coordinator "dies" ------------
+        wave1 = _partition_corpus(old_store, app_id, ops_per_phase, "w1")
+        dual_wall = drive(
+            lambda e: mig.write([e], app_id)[0], wave1, "w1"
+        )
+        report["dualWriteOverhead"] = (
+            dual_wall / plain_wall if plain_wall > 0 else None
+        )
+        mig.kill()  # coordinator crash; the mirror role survives
+        refused = False
+        try:
+            mig.pump()
+        except MigrationError:
+            refused = True
+        report["deadCoordinatorRefusesPump"] = refused
+
+        # -- wave 2 rides the surviving mirror role while a NEW
+        # coordinator instance resumes from the durable state ----------
+        wave2 = _partition_corpus(old_store, app_id, ops_per_phase, "w2")
+        drive(lambda e: mig.write([e], app_id)[0], wave2, "w2")
+        mig2 = PartitionMigration(
+            old_store, new_store, state_dir,
+            old_url=old_url, new_url=new_url,
+            old_feeds=old_feeds, metadata=meta,
+        )
+        migs.append(mig2)
+        report["resumedPhase"] = mig2.phase  # "dual_write"
+        mig2.begin_backfill()
+        mig2.pump(max_ops=5)  # partial backfill before the kill
+
+        # -- kill a NEW-layout primary mid-backfill --------------------
+        new_replicas[kill_new_partition].catch_up()
+        new_primaries[kill_new_partition].kill()
+        stalled_rounds = 0
+        for _ in range(3):
+            out = mig2.pump(max_ops=10)
+            rows = (out.get("backfill") or {}).values()
+            if any(r.get("stalled") for r in rows):
+                stalled_rounds += 1
+        report["stalledRoundsDuringKill"] = stalled_rounds
+        wm_dead = mig2.watermark()
+        report["watermarkDuringKill"] = wm_dead["ok"]
+        early_refused = None
+        if not wm_dead["ok"]:
+            try:
+                mig2.cutover(timeout_s=0.2)
+            except MigrationError:
+                early_refused = True
+            else:
+                early_refused = False
+        report["earlyCutoverRefused"] = early_refused
+
+        # -- promote the replica; the pio+ha chain client discovers the
+        # new primary with no reconfiguration, backfill converges ------
+        promoted = new_replicas[kill_new_partition].promote(
+            os.path.join(root, "promoted-oplog")
+        )
+        report["promotedSeq"] = promoted.get("seq")
+        deadline = _time.monotonic() + 30.0
+        while mig2.phase == "backfill" and _time.monotonic() < deadline:
+            mig2.pump()
+        report["phaseBeforeCutover"] = mig2.phase  # "ready"
+
+        # -- flip, then prove old == new at flip time ------------------
+        mig2.cutover(timeout_s=30.0)
+        report["phaseAfterCutover"] = mig2.phase  # "done"
+
+        def _all_ids(store) -> set:
+            from ..storage.events import EventFilter
+
+            return {
+                e.event_id
+                for e in store.find(app_id, EventFilter(limit=1_000_000))
+            }
+
+        old_ids = _all_ids(old_store)
+        new_ids = _all_ids(new_store)
+        report["oldLayoutEvents"] = len(old_ids)
+        report["newLayoutEvents"] = len(new_ids)
+        report["layoutsIdenticalAtFlip"] = old_ids == new_ids
+        lost = sum(1 for eid in acked if eid not in new_ids)
+        report["ackedWrites"] = len(acked)
+        report["lostAckedWrites"] = lost
+        report["writerFailures"] = failures["count"]
+
+        # -- fold the whole old-layout history, then hand the cursors
+        # off to the new layout and prove nothing folds twice ----------
+        watcher.poll()
+        batch = watcher.take_batch()
+        while batch is not None:
+            for e in batch.events:
+                folded_old.add((e.user, e.item, e.event_time_ms))
+            watcher.commit(batch.upto_seq)
+            watcher.poll()
+            batch = watcher.take_batch()
+        report["foldedOldLayout"] = len(folded_old)
+
+        new_feeds = []
+        for i, p in enumerate(new_primaries):
+            port = (
+                new_replicas[i].bound_port
+                if i == kill_new_partition
+                else p.bound_port
+            )
+            new_feeds.append(RemoteFeed(f"http://127.0.0.1:{port}"))
+        handoff_cursors(new_feeds, watcher_dir)
+
+        # post-flip writes land ONLY in the new layout
+        wave3 = _partition_corpus(old_store, app_id, ops_per_phase, "w3")
+        drive(lambda e: mig2.write([e], app_id)[0], wave3, "w3")
+        report["postFlipInNewOnly"] = bool(
+            _all_ids(new_store) - new_ids
+        ) and _all_ids(old_store) == old_ids
+
+        resumed = PartitionedFeedWatcher(
+            new_feeds, app_id, {"rate": "rating"}, watcher_dir,
+        )
+        folded_new: set = set()
+        resumed.poll()
+        batch = resumed.take_batch()
+        while batch is not None:
+            for e in batch.events:
+                folded_new.add((e.user, e.item, e.event_time_ms))
+            resumed.commit(batch.upto_seq)
+            resumed.poll()
+            batch = resumed.take_batch()
+        dup = folded_old & folded_new
+        report["foldedNewLayout"] = len(folded_new)
+        report["duplicateFolds"] = len(dup)
+
+        report["wallS"] = _time.monotonic() - t_start
+        report["ok"] = bool(
+            report["lostAckedWrites"] == 0
+            and report["writerFailures"] == 0
+            and report["duplicateFolds"] == 0
+            and report["foldedNewLayout"] == ops_per_phase
+            and report["layoutsIdenticalAtFlip"]
+            and report["postFlipInNewOnly"]
+            and report["deadCoordinatorRefusesPump"]
+            and report["resumedPhase"] == "dual_write"
+            and report["stalledRoundsDuringKill"] > 0
+            and report["earlyCutoverRefused"] is True
+            and report["phaseAfterCutover"] == "done"
+        )
+        return report
+    finally:
+        remote.reset_resilience()
+        for m in migs:
+            try:
+                m.close()
+            except Exception:
+                pass
+        for server in old_primaries + new_primaries + new_replicas:
+            try:
+                server.kill()
+            except Exception:
+                pass
+
+
 #: self-contained partition primary for the ingest-scaling drive: its
 #: own interpreter (real CPU parallelism across partitions, which one
 #: GIL cannot show) with the STRICT ack discipline (sync_every=1 —
@@ -1458,8 +1761,18 @@ def run_score_drift(
         )
         report["planId"] = server.rollout.plan.id
 
-        drive(queries)                      # shadow traffic
-        server.rollout.drain_shadow()
+        # shadow traffic, drained in slices: under post-tier-1 CPU load
+        # the 2-worker shadow pool falls behind a flat-out drive, and
+        # pending shadow queries past the cap are DROPPED (by design —
+        # shadow must never block serving). Dropped shadows starve the
+        # candidate's PSI sketch below min_psi_samples and the gate
+        # abstains instead of rolling back — a load-dependent flake,
+        # not a quality-plane verdict. Slices below the pending cap +
+        # a drain per slice keep every shadow answer in the sketch at
+        # any host load, without changing what the gate measures.
+        for start in range(0, queries, 12):
+            drive(min(12, queries - start))
+            server.rollout.drain_shadow(timeout_s=60.0)
         drive(2)                            # one more gate evaluation
         report["candidatePsi"] = server.quality.score_psi("candidate")
         report["finalStage"] = server.rollout.stage
@@ -2750,6 +3063,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    metavar="I",
                    help="with --partitions: the partition whose primary "
                         "is hard-killed mid-run (default 1)")
+    p.add_argument("--migrate-drill", action="store_true",
+                   help="live partition-migration chaos drill: N=2 -> "
+                        "M=3 dual-write + backfill under concurrent "
+                        "writers, coordinator killed mid-dual-write, a "
+                        "new-layout primary killed mid-backfill, cutover "
+                        "only behind the per-keyspace watermark "
+                        "(docs/storage.md#live-migration)")
+    p.add_argument("--new-partitions", type=int, default=3, metavar="M",
+                   help="with --migrate-drill: the target layout's "
+                        "partition count (default 3)")
     p.add_argument("--ingest-scaling", action="store_true",
                    help="ingest-scaling drive: acked-writes/second at "
                         "1, 2 and 4 partitions on this box (the BENCH "
@@ -2820,6 +3143,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         enable_compilation_cache()
         result = run_feedback_stream(
             total_events=args.events, burst=args.burst
+        )
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.migrate_drill:
+        result = run_migrate_drill(
+            old_partitions=args.partitions or 2,
+            new_partitions=args.new_partitions,
+            kill_new_partition=(
+                args.kill_partition_at
+                if args.kill_partition_at is not None
+                else 1
+            ),
         )
         print(json.dumps(result))
         return 0 if result["ok"] else 1

@@ -1,0 +1,25 @@
+"""Fixture: robust-cutover-no-watermark MUST fire on both flip shapes."""
+
+
+class Layouts:
+    def __init__(self, old_store, new_store):
+        self.old_store = old_store
+        self.new_store = new_store
+        self.active = old_store
+        self.flipped = False
+
+    def cutover(self):
+        # flips reads between two layouts with no drain/watermark
+        # evidence anywhere in scope — in-flight mirror writes are
+        # stranded on the retired path the moment this returns
+        self.flipped = True
+        if self.flipped:  # BAD: branch flip without a barrier
+            self.active = self.new_store
+        else:
+            self.active = self.old_store
+        return self.active
+
+
+def switch_layout(use_new, old_store, new_store):
+    active = new_store if use_new else old_store  # BAD: bare IfExp flip
+    return active

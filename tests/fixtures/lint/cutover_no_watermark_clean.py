@@ -1,0 +1,34 @@
+"""Fixture twin: the same flips behind a verified barrier stay clean."""
+
+
+class Layouts:
+    def __init__(self, old_store, new_store):
+        self.old_store = old_store
+        self.new_store = new_store
+        self.active = old_store
+        self.flipped = False
+
+    def drain_queue(self):
+        return 0
+
+    def watermark(self):
+        return {"ok": True}
+
+    def cutover(self):
+        # CLEAN: drain + watermark checked before the flip.
+        self.drain_queue()
+        if not self.watermark()["ok"]:
+            raise RuntimeError("backfill not caught up")
+        self.flipped = True
+        if self.flipped:
+            self.active = self.new_store
+        else:
+            self.active = self.old_store
+        return self.active
+
+
+def switch_layout(use_new, old_store, new_store, pending):
+    # CLEAN: waits for the lagging side to drain before choosing.
+    pending.drain()
+    active = new_store if use_new else old_store
+    return active

@@ -178,7 +178,7 @@ class TestRobustFixtures:
         "fixture",
         ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
          "rename_no_fsync_clean.py", "unbounded_retry_clean.py",
-         "unbounded_cache_clean.py"],
+         "unbounded_cache_clean.py", "cutover_no_watermark_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)
@@ -219,6 +219,38 @@ class TestRobustFixtures:
                 if "# BAD:" in line
             ]
         assert sorted(f.line for f in findings) == marked
+
+    def test_cutover_no_watermark_bad_fires_on_both_shapes(self):
+        """The bad twin carries TWO flip shapes (if/else branch pair,
+        bare conditional expression) inside cutover-named functions;
+        each fires exactly robust-cutover-no-watermark at its marked
+        flip line."""
+        path = os.path.join(FIXTURES, "cutover_no_watermark_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [
+            "robust-cutover-no-watermark", "robust-cutover-no-watermark"
+        ], [(f.rule_id, f.line) for f in findings]
+        with open(path) as fh:
+            marked = [
+                lineno for lineno, line in enumerate(fh, start=1)
+                if "# BAD:" in line
+            ]
+        assert sorted(f.line for f in findings) == marked
+
+    def test_migration_cutover_is_the_clean_exemplar(self, package_result):
+        """storage/migration.py's cutover() IS a layout flip (the name
+        gate engages, self._active is assigned one store per branch)
+        yet carries zero findings: the freeze, the final drain_queue
+        and the per-keyspace watermark loop ahead of the flip are the
+        barrier evidence the rule demands."""
+        findings = _package_findings(
+            package_result, "storage/migration.py",
+            "robust-cutover-no-watermark",
+        )
+        assert findings == [], (
+            f"storage/migration.py regressed its exemplar status: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
 
     def test_response_cache_is_the_clean_exemplar(self, package_result):
         """fleet/cache.py IS a cache (the name gate engages, it stores

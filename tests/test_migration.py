@@ -1,0 +1,590 @@
+"""Live partition migration + fleet autoscaling (ISSUE 17).
+
+Covers the elastic-fleet robustness contract end to end:
+
+1. **PartitionMigration** over in-process layouts: dual-write acking,
+   backfill to the per-keyspace watermark, the race-window write
+   between the watermark check and the flip (must land in BOTH
+   layouts), M < N merge direction, abort leaving the old layout
+   byte-identical, and coordinator kill/resume from durable cursors.
+2. **The chaos drill** (``loadgen --migrate-drill``) over real HTTP
+   fleets: new-layout primary killed mid-backfill, coordinator killed
+   mid-dual-write, zero lost acked writes, zero duplicated folds
+   through the cursor handoff (docs/storage.md#live-migration).
+3. **OpLog.adopt_slot** — the empty-log slot-adoption path the new
+   layout's logs use, and its history/conflict refusals.
+4. **FleetAutoscaler** — the synthetic-overload drill: exactly one
+   bounded action, hysteresis (no flapping on recovery), every
+   decision in the flight recorder, and the ``pio autoscale`` CLI
+   (docs/robustness.md#autoscaler).
+"""
+
+import datetime as dt
+import json
+import os
+
+import pytest
+
+from predictionio_tpu.continuous.watcher import LocalFeed, handoff_cursors
+from predictionio_tpu.fleet.autoscale import (
+    AutoscaleConfig,
+    AutoscaleSignals,
+    FleetAutoscaler,
+)
+from predictionio_tpu.storage.changefeed import Changefeed
+from predictionio_tpu.storage.event import Event
+from predictionio_tpu.storage.migration import (
+    MigrationError,
+    MigrationFrozen,
+    PartitionMigration,
+)
+from predictionio_tpu.storage.oplog import OpLog
+from predictionio_tpu.storage.partition import partition_for_event
+from predictionio_tpu.storage.sqlite_events import SqliteEventStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+APP = 1
+
+
+# ---------------------------------------------------------------------------
+# in-process layout harness: N sqlite partitions + oplogs, one client
+# ---------------------------------------------------------------------------
+class LocalLayout:
+    def __init__(self, root, count):
+        self.count = count
+        self.parts = []
+        for i in range(count):
+            events = SqliteEventStore(":memory:")
+            oplog = OpLog(os.path.join(root, f"p{i}"), partition=(i, count))
+            self.parts.append((events, Changefeed(oplog, events, None, None),
+                               oplog))
+
+    def feeds(self):
+        return [LocalFeed(p[2]) for p in self.parts]
+
+
+class LocalLayoutClient:
+    """The slice of the partitioned-store client surface the migration
+    coordinator drives (insert/write/delete/init/remove + count)."""
+
+    def __init__(self, layout):
+        self._l = layout
+        self.partition_count = layout.count
+
+    def _cf(self, app_id, entity_id):
+        return self._l.parts[
+            partition_for_event(self._l.count, app_id, entity_id)
+        ][1]
+
+    def insert(self, event, app_id):
+        eid, _seq = self._cf(app_id, event.entity_id).insert_event(
+            event, app_id
+        )
+        return eid
+
+    def write(self, events, app_id):
+        by = {}
+        for e in events:
+            by.setdefault(
+                partition_for_event(self._l.count, app_id, e.entity_id), []
+            ).append(e)
+        for idx, evs in by.items():
+            self._l.parts[idx][1].write_events(evs, app_id, fresh=False)
+
+    def delete(self, event_id, app_id):
+        for _, cf, _ in self._l.parts:
+            found, _ = cf.delete_event(event_id, app_id)
+            if found:
+                return True
+        return False
+
+    def init(self, app_id):
+        for _, cf, _ in self._l.parts:
+            cf.init_app(app_id)
+        return True
+
+    def remove(self, app_id):
+        for _, cf, _ in self._l.parts:
+            cf.remove_app(app_id)
+        return True
+
+    def find_ids(self, app_id):
+        ids = set()
+        for events, _, _ in self._l.parts:
+            for e in events.find(app_id):
+                ids.add(e.event_id)
+        return ids
+
+    def dump(self, app_id):
+        """Full-content snapshot, partition-attributed — the
+        byte-identical comparison the abort contract needs."""
+        rows = []
+        for idx, (events, _, _) in enumerate(self._l.parts):
+            for e in events.find(app_id):
+                rows.append((
+                    idx, e.event_id, e.event, e.entity_type, e.entity_id,
+                    e.target_entity_type, e.target_entity_id,
+                    json.dumps(dict(e.properties), sort_keys=True),
+                ))
+        return sorted(rows)
+
+
+def ev(i):
+    return Event(
+        event="rate", entity_type="user", entity_id=f"u{i}",
+        target_entity_type="item", target_entity_id=f"i{i % 7}",
+        properties={"rating": float(i % 5)},
+        event_time=dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc),
+    )
+
+
+def make_layouts(tmp_path, old_count=2, new_count=3):
+    old = LocalLayout(str(tmp_path / "old"), old_count)
+    new = LocalLayout(str(tmp_path / "new"), new_count)
+    oc, nc = LocalLayoutClient(old), LocalLayoutClient(new)
+    oc.init(APP)
+    nc.init(APP)
+    return old, new, oc, nc
+
+
+def pump_to_ready(mig, rounds=60, max_ops=100):
+    for _ in range(rounds):
+        if mig.pump(max_ops=max_ops)["phase"] == "ready":
+            return
+    raise AssertionError(f"never reached ready: {mig.status()}")
+
+
+# ---------------------------------------------------------------------------
+# 1. migration core: expand, merge, race window, abort, kill/resume
+# ---------------------------------------------------------------------------
+class TestPartitionMigration:
+    def test_expand_2_to_3_converges_exactly(self, tmp_path):
+        old, new, oc, nc = make_layouts(tmp_path, 2, 3)
+        pre = [oc.insert(ev(i), APP) for i in range(40)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        live = mig.write([ev(100 + i) for i in range(10)], APP)
+        pump_to_ready(mig)
+        assert mig.watermark()["ok"]
+        assert mig.cutover(timeout_s=10)["phase"] == "done"
+        new_ids = nc.find_ids(APP)
+        acked = set(pre) | set(live)
+        assert acked <= new_ids
+        assert new_ids == oc.find_ids(APP)  # converged exactly, no extras
+        # post-flip writes land in the new layout ONLY
+        post = set(mig.write([ev(2000)], APP))
+        assert post <= nc.find_ids(APP)
+        assert not post & oc.find_ids(APP)
+
+    def test_merge_3_to_2_converges_exactly(self, tmp_path):
+        """M < N: a merge is the same protocol run the other way — the
+        bucket space is fixed, only the bucket→partition map changes."""
+        old, new, oc, nc = make_layouts(tmp_path, 3, 2)
+        pre = [oc.insert(ev(i), APP) for i in range(30)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        live = mig.write([ev(200 + i) for i in range(8)], APP)
+        pump_to_ready(mig)
+        assert mig.cutover(timeout_s=10)["phase"] == "done"
+        assert set(pre) | set(live) <= nc.find_ids(APP)
+        assert nc.find_ids(APP) == oc.find_ids(APP)
+
+    def test_race_window_write_lands_in_both_layouts(self, tmp_path):
+        """A write acked between the operator's watermark check and the
+        cutover flip must exist in BOTH layouts: acked to old (it was
+        pre-flip), carried to new by the final in-freeze drain."""
+        old, new, oc, nc = make_layouts(tmp_path)
+        [oc.insert(ev(i), APP) for i in range(12)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        pump_to_ready(mig)
+        assert mig.watermark()["ok"]
+        race = set(mig.write([ev(999)], APP))  # after the check
+        assert mig.cutover(timeout_s=10)["phase"] == "done"
+        assert race <= oc.find_ids(APP)
+        assert race <= nc.find_ids(APP)
+
+    def test_abort_leaves_old_layout_byte_identical(self, tmp_path):
+        old, new, oc, nc = make_layouts(tmp_path)
+        [oc.insert(ev(i), APP) for i in range(20)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        mig.write([ev(300 + i) for i in range(5)], APP)
+        mig.begin_backfill()
+        mig.pump(max_ops=7)  # partial backfill, then the operator bails
+        before = oc.dump(APP)
+        out = mig.abort("operator says no")
+        assert out["phase"] == "aborted"
+        assert oc.dump(APP) == before  # abort touched nothing in old
+        # post-abort writes are plain old-layout writes: no mirroring
+        post = set(mig.write([ev(400)], APP))
+        assert post <= oc.find_ids(APP)
+        assert not post & nc.find_ids(APP)
+
+    def test_abort_after_flip_refuses(self, tmp_path):
+        old, new, oc, nc = make_layouts(tmp_path)
+        [oc.insert(ev(i), APP) for i in range(6)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        pump_to_ready(mig)
+        mig.cutover(timeout_s=10)
+        with pytest.raises(MigrationError):
+            mig.abort("too late")
+
+    def test_early_cutover_refused_before_watermark(self, tmp_path):
+        """With the new layout dead the backfill cannot reach the
+        head; cutover must refuse inside its deadline — and succeed
+        once the layout is back."""
+        old, new, oc, nc = make_layouts(tmp_path)
+        [oc.insert(ev(i), APP) for i in range(25)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        mig.begin_backfill()
+        healthy_insert, healthy_write = nc.insert, nc.write
+
+        def dead(*_a, **_k):
+            raise RuntimeError("new primary dead")
+
+        nc.insert = nc.write = dead
+        mig.pump(max_ops=3)  # stalls loudly, cursor holds
+        assert not mig.watermark()["ok"]
+        with pytest.raises(MigrationError):
+            mig.cutover(timeout_s=0.2)
+        assert mig.phase != "done"
+        assert not mig.writes_frozen  # the failed freeze thawed
+        nc.insert, nc.write = healthy_insert, healthy_write  # "promote"
+        pump_to_ready(mig)
+        assert mig.cutover(timeout_s=10)["phase"] == "done"
+        assert nc.find_ids(APP) == oc.find_ids(APP)
+
+    def test_pump_auto_advances_dual_write_to_backfill(self, tmp_path):
+        old, new, oc, nc = make_layouts(tmp_path)
+        [oc.insert(ev(i), APP) for i in range(20)]
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        assert mig.phase == "dual_write"
+        # max_ops=1 keeps the first tick short of the head: the phase
+        # must already have left dual_write for backfill
+        assert mig.pump(max_ops=1)["phase"] == "backfill"
+
+    def test_kill_then_resume_from_durable_cursors(self, tmp_path):
+        """The coordinator dies mid-backfill; its writer role (the
+        event-server side of the split) keeps acking; a fresh instance
+        over the same state dir resumes and converges."""
+        old, new, oc, nc = make_layouts(tmp_path)
+        pre = [oc.insert(ev(i), APP) for i in range(50)]
+        state = str(tmp_path / "mig")
+        mig = PartitionMigration(oc, nc, state, old_feeds=old.feeds())
+        mig.start()
+        mig.begin_backfill()
+        mig.pump(max_ops=10)  # partial
+        mig.kill()
+        with pytest.raises(MigrationError):
+            mig.pump()
+        survivors = mig.write([ev(500)], APP)  # writer role survives
+        mig2 = PartitionMigration(oc, nc, state, old_feeds=old.feeds())
+        assert mig2.phase == "backfill"
+        assert mig2.state.cursors  # resumed mid-stream, not from zero
+        pump_to_ready(mig2)
+        assert mig2.cutover(timeout_s=10)["phase"] == "done"
+        assert set(pre) | set(survivors) <= nc.find_ids(APP)
+        assert nc.find_ids(APP) == oc.find_ids(APP)
+
+    def test_cutover_freeze_sheds_writes_with_retry_after(self, tmp_path):
+        old, new, oc, nc = make_layouts(tmp_path)
+        mig = PartitionMigration(
+            oc, nc, str(tmp_path / "mig"), old_feeds=old.feeds()
+        )
+        mig.start()
+        mig.writes_frozen = True  # the in-cutover posture
+        with pytest.raises(MigrationFrozen) as exc:
+            mig.check_frozen()
+        assert exc.value.retry_after_s > 0
+        mig.writes_frozen = False
+
+
+# ---------------------------------------------------------------------------
+# 2. the chaos drill over real HTTP fleets (tier-1, per the ISSUE gate)
+# ---------------------------------------------------------------------------
+class TestMigrateDrill:
+    def test_drill_holds_every_invariant(self, tmp_path):
+        from predictionio_tpu.tools.loadgen import run_migrate_drill
+
+        report = run_migrate_drill(
+            old_partitions=2, new_partitions=3, ops_per_phase=12,
+            state_root=str(tmp_path),
+        )
+        assert report["ok"], report
+        assert report["deadCoordinatorRefusesPump"]
+        assert report["resumedPhase"] == "dual_write"
+        assert report["earlyCutoverRefused"]
+        assert report["lostAckedWrites"] == 0
+        assert report["layoutsIdenticalAtFlip"]
+        assert report["duplicateFolds"] == 0
+        assert report["postFlipInNewOnly"]
+        assert report["dualWriteOverhead"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 3. OpLog slot adoption (the new layout's empty logs joining it)
+# ---------------------------------------------------------------------------
+class TestAdoptSlot:
+    def test_empty_log_adopts_and_persists(self, tmp_path):
+        log = OpLog(str(tmp_path / "log"))
+        log.adopt_slot(1, 3)
+        assert log.partition == [1, 3]
+        assert log.checkpoint()["partition"] == [1, 3]
+        # durable: a reopen configured for the slot agrees
+        again = OpLog(str(tmp_path / "log"), partition=(1, 3))
+        assert again.checkpoint()["partition"] == [1, 3]
+
+    def test_matching_slot_is_a_noop(self, tmp_path):
+        log = OpLog(str(tmp_path / "log"), partition=(0, 2))
+        log.adopt_slot(0, 2)
+        assert log.partition == [0, 2]
+
+    def test_conflicting_slot_is_loud(self, tmp_path):
+        log = OpLog(str(tmp_path / "log"), partition=(0, 2))
+        with pytest.raises(ValueError):
+            log.adopt_slot(1, 2)
+
+    def test_log_with_history_refuses(self, tmp_path):
+        events = SqliteEventStore(":memory:")
+        log = OpLog(str(tmp_path / "log"))
+        cf = Changefeed(log, events, None, None)
+        cf.init_app(APP)
+        cf.insert_event(ev(1), APP)
+        with pytest.raises(ValueError, match="history"):
+            log.adopt_slot(0, 2)
+
+    def test_changefeed_adopt_updates_its_slot(self, tmp_path):
+        events = SqliteEventStore(":memory:")
+        log = OpLog(str(tmp_path / "log"))
+        cf = Changefeed(log, events, None, None)
+        cf.adopt_slot(2, 4)
+        assert cf.partition == (2, 4)
+        assert log.partition == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# 4. watcher cursor handoff across the flip
+# ---------------------------------------------------------------------------
+class TestHandoffCursors:
+    def _feed(self, tmp_path, name, n):
+        events = SqliteEventStore(":memory:")
+        log = OpLog(str(tmp_path / name))
+        cf = Changefeed(log, events, None, None)
+        cf.init_app(APP)
+        for i in range(n):
+            cf.insert_event(ev(i), APP)
+        return LocalFeed(log)
+
+    def test_partitioned_cursors_seed_at_feed_heads(self, tmp_path):
+        feeds = [
+            self._feed(tmp_path, "f0", 3), self._feed(tmp_path, "f1", 5)
+        ]
+        state = str(tmp_path / "watch")
+        written = handoff_cursors(feeds, state)
+        assert set(written) == {0, 1}
+        for i, feed in enumerate(feeds):
+            path = os.path.join(
+                state, f"partition-{i}", "continuous_cursor.json"
+            )
+            with open(path) as fh:
+                cur = json.load(fh)
+            assert cur["seq"] == feed.checkpoint()["seq"]
+            assert cur["seq"] > 0
+
+    def test_single_feed_writes_flat_cursor(self, tmp_path):
+        feed = self._feed(tmp_path, "f0", 2)
+        state = str(tmp_path / "watch")
+        handoff_cursors([feed], state)
+        assert os.path.exists(
+            os.path.join(state, "continuous_cursor.json")
+        )
+
+
+# ---------------------------------------------------------------------------
+# 5. the autoscaler drill: bounded, damped, ledgered
+# ---------------------------------------------------------------------------
+def _hot(replicas=1, **kw):
+    return AutoscaleSignals(
+        replicas_per_shard=replicas, shard_count=2, partition_count=2,
+        firing=("query-availability",), **kw
+    )
+
+
+def _calm(replicas=2):
+    return AutoscaleSignals(
+        replicas_per_shard=replicas, shard_count=2, partition_count=2
+    )
+
+
+class TestFleetAutoscaler:
+    def test_overload_drill_exactly_one_action_no_flapping(self):
+        """Synthetic overload: exactly ONE add-replica, then cooldown
+        holds through continued pain, then recovery does not flap a
+        remove until down_ticks calm ticks elapse."""
+        from predictionio_tpu.obs.flight import default_recorder
+
+        recorder = default_recorder()
+        mark = len(recorder)
+        scaler = FleetAutoscaler(AutoscaleConfig(
+            up_ticks=2, down_ticks=6, cooldown_ticks=5, dry_run=True,
+        ))
+        actions = []
+        for _ in range(4):  # hot: tick 2 acts, 3-4 are cooldown holds
+            actions += scaler.observe(_hot())
+        for _ in range(5):  # recovered: cooldown tail + calm build-up
+            actions += scaler.observe(_calm())
+        assert [a.kind for a in actions] == ["add_replica"]
+        assert actions[0].target == 2
+        assert actions[0].dry_run and not actions[0].executed
+        # every tick — the action AND the holds — hit the ledger
+        ledgered = [
+            e for e in recorder.dump()[max(0, mark - 2048):]
+            if e["site"] == "fleet.autoscale.decide"
+        ]
+        assert len(ledgered) >= scaler.tick_count
+        assert any(
+            e["details"]["action"] == "add_replica" for e in ledgered
+        )
+
+    def test_calm_scale_down_is_slow_and_floored(self):
+        scaler = FleetAutoscaler(AutoscaleConfig(
+            up_ticks=2, down_ticks=3, cooldown_ticks=0, dry_run=True,
+            min_replicas=1,
+        ))
+        acts = []
+        for _ in range(3):
+            acts += scaler.observe(_calm(replicas=2))
+        assert [a.kind for a in acts] == ["remove_replica"]
+        assert acts[0].target == 1
+        # at the floor, calm ticks hold forever
+        scaler2 = FleetAutoscaler(AutoscaleConfig(
+            down_ticks=2, cooldown_ticks=0, dry_run=True, min_replicas=1,
+        ))
+        for _ in range(6):
+            assert scaler2.observe(_calm(replicas=1)) == []
+
+    def test_ingest_pressure_recommends_n_plus_one_migration(self):
+        actuated = []
+        scaler = FleetAutoscaler(
+            AutoscaleConfig(
+                up_ticks=2, cooldown_ticks=5, dry_run=False,
+                max_partitions=8,
+            ),
+            actuator=actuated.append,
+        )
+        sig = AutoscaleSignals(
+            replicas_per_shard=1, shard_count=2, partition_count=2,
+            partition_shed={0: 3.0, 1: 1.0},
+        )
+        assert scaler.observe(sig) == []
+        (action,) = scaler.observe(sig)
+        assert action.kind == "migrate_partitions"
+        assert action.target == 3  # N+1, never a jump
+        assert action.executed and action.error is None
+        assert [a.kind for a in actuated] == ["migrate_partitions"]
+
+    def test_hot_at_max_replicas_holds_not_acts(self):
+        scaler = FleetAutoscaler(AutoscaleConfig(
+            up_ticks=1, cooldown_ticks=0, dry_run=True, max_replicas=2,
+        ))
+        assert scaler.observe(_hot(replicas=2)) == []
+        assert scaler.decisions()[-1]["action"]["kind"] == "hold"
+
+    def test_actuator_failure_marks_action_never_raises(self):
+        def boom(_action):
+            raise RuntimeError("provisioner down")
+
+        scaler = FleetAutoscaler(
+            AutoscaleConfig(up_ticks=1, cooldown_ticks=0, dry_run=False),
+            actuator=boom,
+        )
+        (action,) = scaler.observe(_hot())
+        assert not action.executed
+        assert "provisioner down" in action.error
+
+    def test_cli_dry_run_emits_decisions(self, tmp_path, capsys):
+        from predictionio_tpu.tools import console
+
+        signals = tmp_path / "signals.json"
+        signals.write_text(json.dumps({
+            "replicasPerShard": 1, "shardCount": 2, "partitionCount": 2,
+            "firing": ["query-availability"],
+        }))
+        rc = console.main(
+            ["autoscale", "--signals", str(signals), "--ticks", "3"]
+        )
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["dryRun"] is True
+        assert out["ticks"] == 3
+        assert [a["kind"] for a in out["actions"]] == ["add_replica"]
+        assert len(out["decisions"]) == 3  # holds ledgered too
+
+
+# ---------------------------------------------------------------------------
+# 6. perf-ledger records: trend-only, keyed by the layout move
+# ---------------------------------------------------------------------------
+class TestMigrationLedger:
+    def _bench(self, old=2, new=3, ok=True):
+        return {
+            "device": "cpu",
+            "migrationDrill": {
+                "ok": ok, "oldPartitions": old, "newPartitions": new,
+                "opsPerPhase": 12, "wallS": 0.8,
+                "dualWriteOverhead": 1.4, "lostAckedWrites": 0,
+                "duplicateFolds": 0,
+            },
+        }
+
+    def test_records_are_trend_only_and_keyed_by_layout_move(self):
+        from predictionio_tpu.obs import perfledger
+
+        records = perfledger.migration_records(self._bench())
+        assert [r["metric"] for r in records] == [
+            "migration_drill_wall_s", "migration_dualwrite_overhead"
+        ]
+        # neither unit is the gated "s": both are pure trend records
+        assert all(r["unit"] != "s" for r in records)
+        assert all(r["scale"] == "2->3" for r in records)
+        # a 2->3 expansion and a 3->2 merge never share a comparable
+        # group, so `pio perf diff` can never compare across moves
+        merge = perfledger.migration_records(self._bench(old=3, new=2))
+        keys = {
+            perfledger.comparable_key(r) for r in records + merge
+        }
+        assert len(keys) == 4
+        # a failed drill records nothing — it timed a broken run
+        assert perfledger.migration_records(self._bench(ok=False)) == []
+
+
+# ---------------------------------------------------------------------------
+# 7. metric catalog rows for the new planes (docs/observability.md)
+# ---------------------------------------------------------------------------
+class TestMigrationMetricCatalog:
+    def test_new_metrics_are_cataloged(self):
+        with open(os.path.join(REPO, "docs", "observability.md")) as fh:
+            doc = fh.read()
+        for name in (
+            "pio_migration_phase",
+            "pio_migration_backfill_lag_events",
+            "pio_autoscale_actions_total",
+        ):
+            assert name in doc, f"{name} missing from the metric catalog"
