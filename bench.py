@@ -245,6 +245,12 @@ def _append_ledger(record: dict) -> None:
         # and hit-rate as trend records (docs/fleet.md#cache)
         for cache_record in perfledger.cache_records(record):
             perfledger.append_record(path, cache_record)
+        # shared-tier numbers (loadgen --shared-cache-drill): the
+        # hedged healthy-phase p99 gated at its declared wide band, the
+        # fleet-wide hit rate as a trend record
+        # (docs/fleet.md#shared-cache-tier)
+        for shared_record in perfledger.shared_cache_records(record):
+            perfledger.append_record(path, shared_record)
         # model-quality trajectory (score PSI / feedback hit-rate from
         # the feedback-stream drill) rides as trend-only records so
         # `pio perf trend` shows quality next to latency
@@ -726,6 +732,32 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             }
         except Exception as exc:
             record["cachedFleet"] = {"error": str(exc)}
+    # Shared cache tier (docs/fleet.md#shared-cache-tier): the
+    # kill-the-tier drill gives every BENCH round the fleet-wide hit
+    # rate and the hedged healthy-phase p99 — with the zero-stale,
+    # byte-identity, recorded-degrade and recovery proofs hard-gating
+    # the block's ok. Opt out with BENCH_SHAREDCACHE=0; a failure here
+    # never fails the bench.
+    if os.environ.get("BENCH_SHAREDCACHE") != "0":
+        try:
+            from predictionio_tpu.tools.loadgen import run_shared_cache_drill
+
+            shared = run_shared_cache_drill(queries=96)
+            record["sharedCache"] = {
+                "healthyQPS": shared.get("healthyQPS"),
+                "hedgedP99Ms": shared.get("hedgedP99Ms"),
+                "sharedHitRate": shared.get("sharedHitRate"),
+                "degradesRecorded": shared.get("degradesRecorded"),
+                "byteIdenticalAfterKill": shared.get(
+                    "byteIdenticalAfterKill"
+                ),
+                "staleAfterRollout": shared.get("staleAfterRollout"),
+                "clientFailures": shared.get("clientFailures"),
+                "warmedEntries": shared.get("warmedEntries"),
+                "ok": shared.get("ok"),
+            }
+        except Exception as exc:
+            record["sharedCache"] = {"error": str(exc)}
     # Alert hygiene (docs/slo.md): the in-process brownout drill gives
     # every BENCH round a fired/cleared/false-positive count, so alert
     # noisiness is tracked across rounds like perf and quality already

@@ -44,6 +44,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "CURSOR_NAME",
+    "ChangefeedSubscriber",
     "DeltaBatch",
     "DeltaEvent",
     "FeedGap",
@@ -118,6 +119,208 @@ class RemoteFeed:
         url = f"{self._primary}/replicate/checkpoint"
         with _request(url, timeout=self._timeout) as resp:
             return _json(resp)
+
+
+class ChangefeedSubscriber:
+    """Pushed invalidation: a daemon thread tails a changefeed source
+    (:class:`LocalFeed` / :class:`RemoteFeed`) from the current head and
+    hands every new batch of ops to ``on_ops(ops, gap=...)`` — the
+    router's near-zero-staleness epoch flush
+    (docs/fleet.md#shared-cache-tier).
+
+    Robustness contract (the subscriber is a *signal*, never the source
+    of truth):
+
+    - a :class:`FeedGap` resyncs to the head and reports the hole as one
+      ``on_ops([], gap=True)`` wakeup — the owner must treat "I missed
+      an unknown window" as "assume the epoch moved";
+    - a fetch error never kills the thread: it is recorded
+      (``last_error``, a warning log) and retried after a backoff;
+    - :meth:`alive` answers False the moment the last *successful*
+      fetch is older than ``stale_after_s`` (or the thread died), so an
+      owner polling :meth:`alive` falls back to its own cadence instead
+      of trusting a wedged push plane — a dead subscriber can never
+      silently freeze the owner's view (the PR-14 headroom fix).
+
+    ``clock`` is injectable but the thread sleeps on a real
+    ``threading.Event`` — tests that need determinism drive
+    :meth:`poll_once` directly without :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        feed,
+        on_ops: Callable[[List[dict], bool], None],
+        poll_s: float = 0.05,
+        batch_limit: int = 500,
+        stale_after_s: Optional[float] = None,
+        clock: Callable[[], float] = None,
+        name: str = "changefeed-subscriber",
+    ):
+        import time as _time
+
+        self._feed = feed
+        self._on_ops = on_ops
+        self.poll_s = max(0.005, float(poll_s))
+        self.batch_limit = int(batch_limit)
+        self.stale_after_s = (
+            float(stale_after_s)
+            if stale_after_s is not None
+            else max(1.0, 20.0 * self.poll_s)
+        )
+        self._clock = clock or _time.monotonic
+        self._name = name
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._since: Optional[int] = None  # None = not yet at the head
+        self._generation: Optional[int] = None
+        self._last_ok: Optional[float] = None
+        self._last_error: Optional[str] = None
+        self.events_seen = 0
+        self.gaps = 0
+
+    # -- health (any thread) ----------------------------------------------
+    def alive(self) -> bool:
+        """True only while the push plane is *demonstrably* working: the
+        thread runs AND the last successful fetch is fresh. Everything
+        else — never started, crashed, wedged on an unreachable feed —
+        is False, and the owner's poll watchdog takes over."""
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            return False
+        with self._lock:
+            last_ok = self._last_ok
+        return (
+            last_ok is not None
+            and self._clock() - last_ok <= self.stale_after_s
+        )
+
+    def status(self) -> dict:
+        """The ``/router.json`` subscriber block."""
+        with self._lock:
+            last_ok = self._last_ok
+            out = {
+                "alive": False,  # filled below, outside the lock
+                "eventsSeen": self.events_seen,
+                "gaps": self.gaps,
+                "lastError": self._last_error,
+                "cursor": self._since,
+                "staleAfterS": self.stale_after_s,
+                "lastOkAgoS": (
+                    round(self._clock() - last_ok, 3)
+                    if last_ok is not None
+                    else None
+                ),
+            }
+        out["alive"] = self.alive()
+        return out
+
+    # -- tailing -----------------------------------------------------------
+    def _resync(self) -> None:
+        """Jump the cursor to the feed head (initial attach, or after a
+        gap): pushed invalidation only cares about *new* ops."""
+        cp = self._feed.checkpoint()
+        with self._lock:
+            self._since = int(cp.get("seq", cp.get("lastSeq", 0)))
+            self._generation = cp.get("generation")
+            self._last_ok = self._clock()
+
+    def poll_once(self) -> int:
+        """One fetch → callback round; returns how many ops were
+        delivered. Raises nothing: errors are recorded and swallowed
+        here (the loop must outlive any feed outage), gaps surface to
+        the owner as ``on_ops([], gap=True)``."""
+        gap = False
+        ops: List[dict] = []
+        try:
+            if self._since is None:
+                self._resync()
+                return 0
+            page = self._feed.fetch(self._since, self.batch_limit)
+            generation = page.get("generation")
+            with self._lock:
+                if (
+                    self._generation is not None
+                    and generation is not None
+                    and generation != self._generation
+                ):
+                    gap = True  # primary replaced: unknown history
+                self._generation = generation
+            if gap:
+                self._resync()
+            else:
+                ops = [c.get("op") for c in page.get("changes", ())]
+                with self._lock:
+                    self._since = int(page.get("lastSeq", self._since))
+                    self._last_ok = self._clock()
+                    self._last_error = None
+                    self.events_seen += len(ops)
+        except FeedGap as exc:
+            gap = True
+            with self._lock:
+                self._last_error = f"gap: {exc}"
+            try:
+                self._resync()
+            except Exception as resync_exc:
+                with self._lock:
+                    self._last_error = (
+                        f"resync failed: {resync_exc!r}"
+                    )
+                logger.warning(
+                    "%s: resync after gap failed: %s",
+                    self._name, resync_exc,
+                )
+                return 0
+        except Exception as exc:
+            # the push plane degraded — recorded here, surfaced via
+            # alive()/status(); the owner's poll watchdog covers the
+            # outage (docs/fleet.md#shared-cache-tier failure modes)
+            with self._lock:
+                self._last_error = f"{type(exc).__name__}: {exc}"
+            logger.warning(
+                "%s: fetch failed (owner falls back to polling): %s",
+                self._name, exc,
+            )
+            return 0
+        if gap:
+            with self._lock:
+                self.gaps += 1
+        if ops or gap:
+            try:
+                self._on_ops(ops, gap)
+            except Exception:
+                logger.exception(
+                    "%s: on_ops callback failed", self._name
+                )
+        return len(ops)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            delivered = self.poll_once()
+            if delivered == 0:
+                # idle or erroring: wait out the interval (errors wait a
+                # longer beat so a dead feed isn't hammered)
+                beat = self.poll_s
+                with self._lock:
+                    if self._last_error is not None:
+                        beat = min(
+                            self.stale_after_s, self.poll_s * 10.0
+                        )
+                self._stop.wait(beat)
+
+    def start(self) -> "ChangefeedSubscriber":
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
 
 
 @dataclasses.dataclass(frozen=True)

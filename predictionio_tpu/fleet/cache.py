@@ -72,17 +72,27 @@ def canonical_query(payload: Any) -> str:
 
 class CacheEntry:
     """One cached response: the parsed 200 body, the variant header it
-    was served under, and the epoch it was filled at."""
+    was served under, and the epoch it was filled at. ``ttl_s`` — when
+    set — overrides the cache-wide TTL (the negative-caching lever:
+    known-empty results live on a much shorter fuse, docs/fleet.md
+    #shared-cache-tier); ``negative`` marks such entries so owners can
+    label the hit. ``hits`` counts reads served from this entry — the
+    popularity signal behind the shared tier's top-keys export."""
 
-    __slots__ = ("body", "variant", "epoch", "stored_at")
+    __slots__ = ("body", "variant", "epoch", "stored_at", "ttl_s",
+                 "negative", "hits")
 
     def __init__(
-        self, body: Any, variant: Optional[str], epoch: str, stored_at: float
+        self, body: Any, variant: Optional[str], epoch: str, stored_at: float,
+        ttl_s: Optional[float] = None, negative: bool = False,
     ):
         self.body = body
         self.variant = variant
         self.epoch = epoch
         self.stored_at = stored_at
+        self.ttl_s = ttl_s
+        self.negative = negative
+        self.hits = 0
 
 
 class ResponseCache:
@@ -144,7 +154,8 @@ class ResponseCache:
             if entry is None:
                 self.misses += 1
                 return None
-            if self.clock() - entry.stored_at > self.ttl_s:
+            ttl = entry.ttl_s if entry.ttl_s is not None else self.ttl_s
+            if self.clock() - entry.stored_at > ttl:
                 del self._cache[key]
                 self._note_invalidation("ttl", 1)
                 self.misses += 1
@@ -157,6 +168,7 @@ class ResponseCache:
             else:
                 self._cache.move_to_end(key)
                 self.hits += 1
+                entry.hits += 1
         if dropped is not None:
             self._emit(dropped, 1)
             return None
@@ -168,15 +180,21 @@ class ResponseCache:
         body: Any,
         variant: Optional[str],
         epoch: str,
+        ttl_s: Optional[float] = None,
+        negative: bool = False,
     ) -> None:
         """Store one 200 response under the epoch it was computed at.
         Beyond ``max_entries`` the least-recently-used entry is evicted
-        (counted as a "capacity" invalidation)."""
+        (counted as a "capacity" invalidation). ``ttl_s`` overrides the
+        cache-wide TTL for this entry; ``negative`` marks a known-empty
+        result (callers pair it with a short TTL)."""
         evicted = 0
         with self._lock:
             self._cache[key] = CacheEntry(
                 body=body, variant=variant, epoch=epoch,
                 stored_at=self.clock(),
+                ttl_s=float(ttl_s) if ttl_s is not None else None,
+                negative=bool(negative),
             )
             self._cache.move_to_end(key)
             while len(self._cache) > self.max_entries:
@@ -207,6 +225,37 @@ class ResponseCache:
                 self._note_invalidation(reason, count)
         self._emit(reason, count)
         return count
+
+    def export_top(self, n: int = 50) -> list:
+        """The ``n`` most-hit live entries, hottest first — the warming
+        export (docs/fleet.md#shared-cache-tier): a restarting router
+        pre-fills its local LRU from this list so the backends never see
+        the full hot set again. Entries past their TTL are skipped (not
+        dropped — export is a read, never a mutation); negative entries
+        ride along with their flag so the importer keeps the short
+        fuse."""
+        now = self.clock()
+        with self._lock:
+            live = [
+                (key, entry)
+                for key, entry in self._cache.items()
+                if now - entry.stored_at <= (
+                    entry.ttl_s if entry.ttl_s is not None else self.ttl_s
+                )
+            ]
+        live.sort(key=lambda item: item[1].hits, reverse=True)
+        return [
+            {
+                "variant": key[0],
+                "query": key[1],
+                "body": entry.body,
+                "servedVariant": entry.variant,
+                "epoch": entry.epoch,
+                "hits": entry.hits,
+                "negative": entry.negative,
+            }
+            for key, entry in live[: max(0, int(n))]
+        ]
 
     # -- introspection -----------------------------------------------------
     def __len__(self) -> int:

@@ -198,6 +198,55 @@ class RouterConfig:
     #: None resolves from PIO_ROUTER_LEG_WORKERS (default 2);
     #: 0 = per-request threads only (the pre-cache behavior)
     leg_workers: Optional[int] = None
+    #: shared cache tier sidecar, ``host:port``
+    #: (docs/fleet.md#shared-cache-tier). None resolves from
+    #: PIO_ROUTER_SHARED_CACHE (default: no tier). Requires the local
+    #: cache to be enabled — the tier is the middle level of the same
+    #: hierarchy, not a replacement for it. Advisory by construction:
+    #: any sidecar doubt is a miss, never a stale serve.
+    shared_cache: Optional[str] = None
+    #: per-call sidecar timeout; None resolves from
+    #: PIO_ROUTER_SHARED_TIMEOUT_S (default 0.25 s — the tier must never
+    #: cost a meaningful share of a request budget)
+    shared_timeout_s: Optional[float] = None
+    #: pre-fill the local LRU from the sidecar's top-keys export at
+    #: startup (cache warming on deploy); None resolves from
+    #: PIO_ROUTER_SHARED_WARM (default ON when a tier is configured)
+    shared_warm: Optional[bool] = None
+    #: TTL for negative entries (known-empty 200 results); None resolves
+    #: from PIO_ROUTER_NEGATIVE_TTL_S (default 5 s; 0 disables negative
+    #: caching). Deliberately short: "nothing matched" goes stale the
+    #: moment new data lands, and no epoch sees data-only changes
+    negative_ttl_s: Optional[float] = None
+    #: request hedging (docs/fleet.md#hedging): after a p9x-derived
+    #: delay, issue ONE hedge leg to the next replica from the
+    #: *remaining* deadline budget; first response wins. None resolves
+    #: from PIO_ROUTER_HEDGE (default ON — it only ever fires on the
+    #: observed tail)
+    hedge_enabled: Optional[bool] = None
+    #: the "9x" in p9x: which latency percentile of recent successful
+    #: legs sets the hedge delay
+    hedge_percentile: float = 95.0
+    #: floor for the hedge delay — a sub-millisecond p95 must not turn
+    #: hedging into double-send-everything
+    hedge_min_delay_s: float = 0.005
+    #: minimum remaining deadline budget a hedge leg needs; below it the
+    #: hedge is denied (counted, never fired) — a doomed duplicate helps
+    #: nobody
+    hedge_leg_min_s: float = 0.05
+    #: metadata changefeed to subscribe to for PUSHED epoch invalidation
+    #: (a storage server base URL, e.g. ``http://host:port``). None
+    #: resolves from PIO_ROUTER_META_FEED (default: poll only). With a
+    #: live subscription the poll below stretches to ``push_watchdog_s``
+    #: — staleness drops to ~push latency and the per-request metadata
+    #: read disappears; a dead/wedged subscriber falls back to
+    #: ``plan_refresh_s`` polling automatically (never a frozen epoch)
+    meta_feed: Optional[str] = None
+    #: subscriber tail interval (near-zero staleness knob)
+    push_poll_s: float = 0.05
+    #: poll cadence while the push plane is healthy — a watchdog, not
+    #: the staleness bound
+    push_watchdog_s: float = 30.0
 
 
 class _RouterHandler(JsonHTTPHandler):
@@ -223,6 +272,12 @@ class _RouterHandler(JsonHTTPHandler):
             self.headers.get(DEADLINE_HEADER), clock=self.server.clock
         )
         started = self.server.clock()
+        # the routed work runs inside the quota slot; the response WRITE
+        # does not — the slot is released before the client can observe
+        # the answer, so "my request returned" implies "my slot is
+        # free" (a slow client draining a response must not hold fan-out
+        # concurrency hostage either)
+        out: Tuple[int, Any, Dict[str, Any]]
         try:
             if deadline is not None:
                 deadline.check("router-admission")
@@ -235,7 +290,7 @@ class _RouterHandler(JsonHTTPHandler):
                 status, body, variant = self.server.route_query(
                     raw, deadline, trace_id=span.trace_id, info=info
                 )
-            headers = {TRACE_HEADER: span.trace_id}
+            headers: Dict[str, Any] = {TRACE_HEADER: span.trace_id}
             if variant is not None:
                 headers[VARIANT_HEADER] = variant
             if info.get("cache"):
@@ -244,30 +299,31 @@ class _RouterHandler(JsonHTTPHandler):
                 # header differ (docs/fleet.md#cache)
                 headers[CACHE_HEADER] = info["cache"]
             self.server.count_request("ok" if status == 200 else "error")
-            self.respond(status, body, headers=headers)
+            out = (status, body, headers)
         except DeadlineExceeded as exc:
             self.server.count_request("deadline")
-            self.respond(504, {"message": str(exc), "stage": exc.stage})
+            out = (504, {"message": str(exc), "stage": exc.stage}, {})
         except RouterBadRequest as exc:
             self.server.count_request("bad_request")
-            self.respond(400, {"message": str(exc)})
+            out = (400, {"message": str(exc)}, {})
         except FleetOverloaded as exc:
             # fleet-wide backpressure relays as a shed, never a 502:
             # clients that honor Retry-After must keep backing off
             self.server.count_request("shed")
             self.server.count_shed(app)
-            self.respond(
+            out = (
                 503,
                 {"message": str(exc)},
-                headers={"Retry-After": exc.retry_after_s},
+                {"Retry-After": exc.retry_after_s},
             )
         except Exception as exc:
             logger.exception("router query failed")
             self.server.count_request("error")
-            self.respond(502, {"message": str(exc)})
+            out = (502, {"message": str(exc)}, {})
         finally:
             self.server.observe_latency(self.server.clock() - started)
             self.server.release(app)
+        self.respond(out[0], out[1], headers=out[2])
 
     def do_GET(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
@@ -385,6 +441,66 @@ class _ShardLegPool:
             self._q.put(self._STOP)
 
 
+class _HedgeTracker:
+    """The p9x estimator behind request hedging (docs/fleet.md#hedging,
+    the tail-at-scale discipline in PAPERS.md): a bounded ring of recent
+    *successful* leg latencies; :meth:`delay_s` answers the configured
+    percentile (floored at ``min_delay_s``) once the window is warm, or
+    None while it is not — a cold router never hedges, because it has
+    no tail to read."""
+
+    def __init__(
+        self,
+        percentile: float = 95.0,
+        window: int = 128,
+        min_samples: int = 16,
+        min_delay_s: float = 0.005,
+    ):
+        from collections import deque
+
+        self.percentile = min(99.9, max(50.0, float(percentile)))
+        self.min_samples = max(2, int(min_samples))
+        self.min_delay_s = float(min_delay_s)
+        self._lat: "deque" = deque(maxlen=max(self.min_samples, int(window)))
+        self._lock = threading.Lock()
+
+    def observe(self, elapsed_s: float) -> None:
+        with self._lock:
+            self._lat.append(max(0.0, float(elapsed_s)))
+
+    def delay_s(self) -> Optional[float]:
+        with self._lock:
+            if len(self._lat) < self.min_samples:
+                return None
+            lat = sorted(self._lat)
+        idx = min(len(lat) - 1, int(len(lat) * self.percentile / 100.0))
+        return max(self.min_delay_s, lat[idx])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            samples = len(self._lat)
+        delay = self.delay_s()
+        return {
+            "enabled": True,
+            "percentile": self.percentile,
+            "samples": samples,
+            "delayS": round(delay, 6) if delay is not None else None,
+        }
+
+
+def _is_empty_result(body: Any) -> bool:
+    """A *known-empty* 200: a dict with at least one list field, all of
+    them empty (``{"itemScores": []}`` — the engines' "nothing matched"
+    shape). These are negative-cached under a short TTL: misses for
+    unknown entities are the classic cache-punch-through, but "nothing"
+    goes stale the moment new data lands, and no epoch sees data-only
+    changes — hence the separate, short fuse."""
+    if not isinstance(body, dict) or not body:
+        return False
+    lists = [v for v in body.values() if isinstance(v, list)]
+    return bool(lists) and all(not v for v in lists)
+
+
 class RouterServer(BackgroundHTTPServer):
     """The router process: stateless but for quota counters, breaker
     state and the cached plan read — everything a replica needs to agree
@@ -395,7 +511,12 @@ class RouterServer(BackgroundHTTPServer):
         config: RouterConfig,
         registry=None,
         clock: Callable[[], float] = time.monotonic,
+        meta_feed=None,
     ):
+        """``meta_feed`` — an already-constructed changefeed source
+        (``LocalFeed``/``RemoteFeed`` protocol) for pushed invalidation;
+        overrides ``config.meta_feed`` (which names a storage server by
+        URL). In-process fleets and drills inject their oplog here."""
         if not config.backends:
             raise ValueError("router needs at least one backend (host:port)")
         if config.replicas_per_shard < 1:
@@ -496,6 +617,50 @@ class RouterServer(BackgroundHTTPServer):
             if config.sharded and leg_workers > 0
             else {}
         )
+        # shared cache tier levers (docs/fleet.md#shared-cache-tier);
+        # the client itself is built after the metrics block so its
+        # outcome callback lands on a live counter
+        shared_addr = config.shared_cache
+        if shared_addr is None:
+            shared_addr = (
+                os.environ.get("PIO_ROUTER_SHARED_CACHE", "").strip() or None
+            )
+        shared_timeout = config.shared_timeout_s
+        if shared_timeout is None:
+            shared_timeout = float(
+                os.environ.get("PIO_ROUTER_SHARED_TIMEOUT_S", "0.25")
+            )
+        shared_warm = config.shared_warm
+        if shared_warm is None:
+            shared_warm = os.environ.get("PIO_ROUTER_SHARED_WARM", "1") != "0"
+        self._shared_warm = bool(shared_warm)
+        negative_ttl = config.negative_ttl_s
+        if negative_ttl is None:
+            negative_ttl = float(
+                os.environ.get("PIO_ROUTER_NEGATIVE_TTL_S", "5")
+            )
+        self._negative_ttl_s = max(0.0, negative_ttl)
+        hedge_on = config.hedge_enabled
+        if hedge_on is None:
+            hedge_on = os.environ.get("PIO_ROUTER_HEDGE", "1") != "0"
+        self._hedge: Optional[_HedgeTracker] = (
+            _HedgeTracker(
+                percentile=config.hedge_percentile,
+                min_delay_s=config.hedge_min_delay_s,
+            )
+            if hedge_on
+            else None
+        )
+        self._hedge_leg_min_s = float(config.hedge_leg_min_s)
+        meta_feed_url = config.meta_feed
+        if meta_feed_url is None:
+            meta_feed_url = (
+                os.environ.get("PIO_ROUTER_META_FEED", "").strip() or None
+            )
+        self._warmed_entries = 0
+        self._refresh_forced = False
+        self._shared = None
+        self._subscriber = None
 
         metrics_clock = clock
         from ..obs.metrics import MetricsRegistry
@@ -549,6 +714,35 @@ class RouterServer(BackgroundHTTPServer):
             "Sharded fan-outs answered by joining another request's "
             "in-flight scatter/gather (single-flight)",
         )
+        self._shared_counter = metrics.counter(
+            "pio_router_shared_cache_total",
+            "Shared cache tier client outcomes (hit/negative_hit/miss/"
+            "epoch_skew/open/error/put/put_error — degrades are "
+            "recorded, never silent)",
+            labelnames=("outcome",),
+        )
+        self._hedges = metrics.counter(
+            "pio_router_hedges_total",
+            "Request hedging outcomes (fired/primary_won/hedge_won/"
+            "loser_cancelled/budget_denied/breaker_denied)",
+            labelnames=("outcome",),
+        )
+        self._epoch_events = metrics.counter(
+            "pio_router_epoch_events_total",
+            "Epoch-moving cache flushes by how the move was observed "
+            "(push = changefeed subscription, poll = refresh cadence)",
+            labelnames=("source",),
+        )
+        metrics.gauge_callback(
+            "pio_router_push_alive",
+            lambda: (
+                1.0
+                if self._subscriber is not None and self._subscriber.alive()
+                else 0.0
+            ),
+            "1 while the pushed-invalidation subscriber is demonstrably "
+            "live (0 = poll fallback)",
+        )
         metrics.gauge_callback(
             "pio_router_cache_entries",
             lambda: len(self._cache) if self._cache is not None else 0,
@@ -569,6 +763,36 @@ class RouterServer(BackgroundHTTPServer):
             tracer=Tracer("router", clock=clock),
             health_kind="router",
         )
+        # -- shared tier + pushed invalidation (after the bind: a failed
+        # construction must not leave client threads behind) -------------
+        if shared_addr is not None and self._cache is not None:
+            from .sharedcache import SharedCacheClient
+
+            self._shared = SharedCacheClient(
+                shared_addr,
+                timeout_s=shared_timeout,
+                on_outcome=self._count_shared,
+                clock=clock,
+            )
+        if meta_feed is None and meta_feed_url is not None:
+            from ..continuous.watcher import RemoteFeed
+
+            meta_feed = RemoteFeed(meta_feed_url, timeout=5.0)
+        if meta_feed is not None:
+            from ..continuous.watcher import ChangefeedSubscriber
+
+            self._subscriber = ChangefeedSubscriber(
+                meta_feed,
+                self._on_meta_ops,
+                poll_s=config.push_poll_s,
+                clock=clock,
+                name=f"router-{self.bound_port}-subscriber",
+            ).start()
+        if self._shared is not None and self._shared_warm:
+            threading.Thread(
+                target=self._warm_safely, daemon=True,
+                name=f"router-{self.bound_port}-warm",
+            ).start()
 
     # -- live ring update (fleet/autoscale.py) ----------------------------
     def resize_replicas(
@@ -651,6 +875,76 @@ class RouterServer(BackgroundHTTPServer):
     def _count_invalidation(self, reason: str, count: int) -> None:
         self._cache_invalidations.inc(count, reason=reason)
 
+    def _count_shared(self, outcome: str) -> None:
+        self._shared_counter.inc(1, outcome=outcome)
+
+    # -- shared tier: warming (docs/fleet.md#shared-cache-tier) -----------
+    def warm_from_shared(self, n: int = 256) -> int:
+        """Pre-fill the local LRU from the sidecar's top-keys export —
+        cache warming on deploy: a restarting router re-learns the hot
+        set from the tier instead of exposing the backends to it. Only
+        entries under the CURRENT epoch are imported (a stale export
+        must not seed a stale cache); negative entries keep their short
+        fuse. Returns how many entries landed."""
+        shared, cache = self._shared, self._cache
+        if shared is None or cache is None:
+            return 0
+        epoch = self.current_epoch()
+        warmed = 0
+        for item in shared.top(n):
+            if not isinstance(item, dict):
+                continue
+            if str(item.get("epoch")) != epoch:
+                continue
+            key = (
+                str(item.get("variant", "-")),
+                str(item.get("query", "")),
+            )
+            negative = bool(item.get("negative", False))
+            if negative and self._negative_ttl_s <= 0:
+                continue
+            cache.put(
+                key,
+                item.get("body"),
+                item.get("servedVariant"),
+                epoch,
+                ttl_s=self._negative_ttl_s if negative else None,
+                negative=negative,
+            )
+            warmed += 1
+        with self._lock:
+            self._warmed_entries += warmed
+        return warmed
+
+    def _warm_safely(self) -> None:
+        try:
+            warmed = self.warm_from_shared()
+            if warmed:
+                logger.info(
+                    "warmed %d cache entries from the shared tier", warmed
+                )
+        except Exception:
+            # warming is opportunistic: a cold start is the status quo
+            # ante, never a boot failure (the client records transport
+            # degrades itself)
+            logger.debug("cache warming failed", exc_info=True)
+
+    # -- pushed invalidation (docs/fleet.md#shared-cache-tier) ------------
+    def _on_meta_ops(self, ops: List[dict], gap: bool) -> None:
+        """Changefeed subscriber callback: an epoch-relevant op — or a
+        feed gap, an unknown window that MAY have held one — forces the
+        next plan read instead of waiting out the refresh cadence."""
+        from ..storage.changefeed import op_moves_epoch
+
+        if gap or any(op_moves_epoch(op) for op in ops):
+            self._force_epoch_refresh()
+
+    def _force_epoch_refresh(self) -> None:
+        with self._lock:
+            self._plan_read_at = None
+            self._refresh_forced = True
+        self.active_plan()
+
     def observe_latency(self, elapsed_s: float) -> None:
         self._hist.observe(max(0.0, elapsed_s))
 
@@ -679,17 +973,29 @@ class RouterServer(BackgroundHTTPServer):
         plane and replicated metadata) invalidates within one
         ``plan_refresh_s`` of the durable write. Reads that cannot
         complete keep the PRIOR epoch: "metadata unreachable" must not
-        flap the epoch and stampede the backends with a cold cache."""
+        flap the epoch and stampede the backends with a cold cache.
+
+        With a LIVE changefeed subscriber the poll stretches to
+        ``push_watchdog_s`` — epoch moves arrive pushed, and the poll
+        is only the watchdog behind the push plane. The stretch is
+        re-decided on :meth:`ChangefeedSubscriber.alive` at *every*
+        read: a dead or wedged subscriber silently restores the old
+        cadence, so the epoch can never freeze behind a stuck push
+        plane (docs/fleet.md#shared-cache-tier)."""
         if self.registry is None:
             return None
         with self._lock:
+            interval = self.config.plan_refresh_s
+            if self._subscriber is not None and self._subscriber.alive():
+                interval = max(interval, self.config.push_watchdog_s)
             fresh = (
                 self._plan_read_at is not None
-                and self.clock() - self._plan_read_at
-                < self.config.plan_refresh_s
+                and self.clock() - self._plan_read_at < interval
             )
             if fresh:
                 return self._plan
+            forced = self._refresh_forced
+            self._refresh_forced = False
             engine_key = self._engine_key
         plan = None
         epoch: Optional[str] = None
@@ -719,10 +1025,24 @@ class RouterServer(BackgroundHTTPServer):
                 self._epoch = epoch
         if flush_from is not None and self._cache is not None:
             dropped = self._cache.flush(reason="epoch")
+            self._epoch_events.inc(1, source="push" if forced else "poll")
             logger.info(
-                "rollout/model epoch moved; flushed %d cached responses",
+                "rollout/model epoch moved (%s); flushed %d cached "
+                "responses",
+                "pushed invalidation" if forced else "poll",
                 dropped,
             )
+            if self._shared is not None:
+                # the sidecar flush rides a fire-and-forget thread: the
+                # LOCAL flush is the correctness event (and every shared
+                # read is epoch-checked anyway) — a slow sidecar must
+                # not stall whoever observed the epoch move
+                threading.Thread(
+                    target=self._shared.flush,
+                    kwargs={"reason": "epoch"},
+                    daemon=True,
+                    name="router-shared-flush",
+                ).start()
         return plan
 
     def current_epoch(self) -> str:
@@ -805,6 +1125,45 @@ class RouterServer(BackgroundHTTPServer):
             self._cache_misses.inc(1)
             if info is not None:
                 info["cache"] = "miss"
+            if self._shared is not None:
+                # the shared tier sits BETWEEN the local LRU and the
+                # fan-out (docs/fleet.md#shared-cache-tier). The lookup
+                # spends at most half the remaining budget — the
+                # sidecar may make this request faster, never later —
+                # and any doubt (timeout, open breaker, epoch skew)
+                # comes back as None: an advisory miss, handled by the
+                # fan-out below exactly as if the tier did not exist.
+                shared_entry = self._shared.lookup(
+                    qkey,
+                    epoch,
+                    budget_s=(
+                        deadline.remaining_s() / 2.0
+                        if deadline is not None
+                        else None
+                    ),
+                )
+                if shared_entry is not None:
+                    if info is not None:
+                        info["cache"] = "hit-shared"
+                    self._check_variant(
+                        payload, shared_entry.variant, expected
+                    )
+                    # promote into the local LRU so the NEXT identical
+                    # read is a local hit (negative entries keep their
+                    # short fuse)
+                    self._cache.put(
+                        qkey,
+                        shared_entry.body,
+                        shared_entry.variant,
+                        epoch,
+                        ttl_s=(
+                            self._negative_ttl_s
+                            if shared_entry.negative
+                            else None
+                        ),
+                        negative=shared_entry.negative,
+                    )
+                    return 200, shared_entry.body, shared_entry.variant
         # stall watchdog (docs/slo.md): a routed request that outlives a
         # multiple of its budget — every failover leg wedged — is a
         # fleet-level stall worth a flight dump
@@ -834,10 +1193,28 @@ class RouterServer(BackgroundHTTPServer):
         if status == 200:
             self._check_variant(payload, variant, expected)
             if self._cache is not None and qkey is not None:
+                # negative caching: a known-empty answer is still an
+                # answer — cache it on a short fuse so a hammered
+                # missing key stops reaching the backends, without a
+                # late-arriving model having to wait out the full TTL
+                negative = (
+                    self._negative_ttl_s > 0 and _is_empty_result(body)
+                )
+                ttl = self._negative_ttl_s if negative else None
                 # filled under the epoch observed BEFORE the backend
                 # call: if the plan moved mid-request, the very next
                 # refresh observes the new epoch and drops this entry
-                self._cache.put(qkey, body, variant, epoch)
+                self._cache.put(
+                    qkey, body, variant, epoch, ttl_s=ttl, negative=negative
+                )
+                if self._shared is not None:
+                    # share the fill synchronously: the client's answer
+                    # is already paid for, and a dead sidecar costs at
+                    # most one fast-failing put before its breaker opens
+                    self._shared.put(
+                        qkey, body, variant, epoch,
+                        ttl_s=ttl, negative=negative,
+                    )
         return status, body, variant
 
     def _sharded_singleflight(
@@ -917,6 +1294,222 @@ class RouterServer(BackgroundHTTPServer):
         # before_call below re-checks each breaker's cooldown properly)
         return admitting or list(ring)
 
+    def _attempt_leg(
+        self,
+        backend: str,
+        raw: bytes,
+        deadline: Optional[Deadline],
+        attempts_left: int,
+        trace_id: Optional[str],
+        has_next: bool,
+    ) -> Tuple[str, Any]:
+        """One ring position with ALL its bookkeeping: breaker
+        admission, the HTTP leg, the breaker verdict, per-backend event
+        counts, the retry count (only when a next position exists to
+        retry onto), and the hedge tracker's latency sample on success.
+        Returns ``("ok", (status, body, headers))`` — which includes
+        non-retryable answers (4xx, 504) that pass through to the
+        client; ``("failed", (message, shed))`` where ``shed`` is True
+        iff the backend answered 503; or ``("skip", message)`` for an
+        open breaker (the replica was never tried).
+
+        504 is never a failure here: an expired deadline is the
+        CLIENT's budget, not backend sickness — it must neither trip
+        the breaker nor burn a failover leg it cannot afford."""
+        breaker = self.breakers[backend]
+        try:
+            breaker.before_call()
+        except CircuitOpen as exc:
+            self._backend_events.inc(1, backend=backend, kind="open_skip")
+            return "skip", f"{backend}: {exc}"
+        started = self.clock()
+        try:
+            status, body, headers = self._leg(
+                backend, raw, deadline, attempts_left, trace_id
+            )
+        except Exception as exc:
+            breaker.record_failure()
+            self._backend_events.inc(1, backend=backend, kind="error")
+            if has_next:
+                self._retries.inc(1, backend=backend)
+            return "failed", (f"{backend}: {exc}", False)
+        if status == 503 or (status >= 500 and status != 504):
+            # a shedding or erroring backend: the read belongs on
+            # another replica (bounded-admission discipline says the
+            # *fleet* answers even when one member cannot)
+            breaker.record_failure()
+            self._backend_events.inc(1, backend=backend, kind="error")
+            if has_next:
+                self._retries.inc(1, backend=backend)
+            return "failed", (f"{backend}: HTTP {status}", status == 503)
+        breaker.record_success()
+        self._backend_events.inc(1, backend=backend, kind="ok")
+        if self._hedge is not None:
+            self._hedge.observe(self.clock() - started)
+        return "ok", (status, body, headers)
+
+    def _q_wait(
+        self,
+        q: "queue.SimpleQueue",
+        deadline: Optional[Deadline],
+    ) -> Tuple[str, Tuple[str, Any]]:
+        """Block for the next hedge-race verdict within the remaining
+        deadline budget (forever without a deadline — the legs
+        themselves are timeout-bounded, so 'forever' is bounded too)."""
+        timeout = (
+            max(0.0, deadline.remaining_s()) if deadline is not None else None
+        )
+        try:
+            return q.get(timeout=timeout)
+        except queue.Empty:
+            raise DeadlineExceeded(
+                "deadline exceeded waiting for the hedged leg",
+                stage="router-hedge",
+            ) from None
+
+    def _hedged_first(
+        self,
+        replicas: Sequence[str],
+        raw: bytes,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+    ) -> Tuple[int, List[Tuple[str, Any]]]:
+        """The ring's FIRST position, hedged when the tail tracker says
+        so (docs/fleet.md#hedging; the tail-at-scale discipline in
+        PAPERS.md): the primary leg launches immediately; if no answer
+        lands within the p9x delay, ONE hedge leg fires at the next
+        replica and the first response wins — the loser is abandoned
+        and counted, its keep-alive connection dying with its thread.
+
+        The hedge leg is funded from the budget REMAINING at fire time
+        (its ``attempts_left`` split is computed then, against what the
+        primary already spent), and never fires at all when that
+        remainder is under ``hedge_leg_min_s`` or the next replica's
+        breaker is open. Ineligible calls (tracker cold, hedging off, a
+        lone replica) degrade to the plain sequential attempt.
+
+        Returns ``(consumed, verdicts)``: how many ring positions were
+        used (1 or 2) and the verdicts to fold into the walk."""
+        delay = self._hedge.delay_s() if self._hedge is not None else None
+        if delay is None or len(replicas) < 2:
+            verdict = self._attempt_leg(
+                replicas[0], raw, deadline, len(replicas), trace_id,
+                len(replicas) > 1,
+            )
+            return 1, [verdict]
+        q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+        def run(
+            tag: str, backend: str, attempts_left: int, has_next: bool
+        ) -> None:
+            try:
+                verdict = self._attempt_leg(
+                    backend, raw, deadline, attempts_left, trace_id,
+                    has_next,
+                )
+            except BaseException as exc:  # belt: a leg never goes silent
+                verdict = ("failed", (f"{backend}: {exc}", False))
+            finally:
+                self._close_thread_conns()
+            q.put((tag, verdict))
+
+        threading.Thread(
+            target=run, args=("primary", replicas[0], len(replicas), True),
+            daemon=True, name="router-hedge-primary",
+        ).start()
+        try:
+            first = q.get(timeout=delay)
+        except queue.Empty:
+            first = None
+        if first is not None:
+            # answered inside the p9x window: no hedge, no extra cost —
+            # the common case by construction
+            return 1, [first[1]]
+        remaining = deadline.remaining_s() if deadline is not None else None
+        if remaining is not None and remaining < self._hedge_leg_min_s:
+            # too little budget left to fund a second leg: a hedge now
+            # would only split starvation two ways
+            self._hedges.inc(1, outcome="budget_denied")
+            return 1, [self._q_wait(q, deadline)[1]]
+        if self.breakers[replicas[1]].state == CircuitBreaker.OPEN:
+            self._hedges.inc(1, outcome="breaker_denied")
+            return 1, [self._q_wait(q, deadline)[1]]
+        self._hedges.inc(1, outcome="fired")
+        threading.Thread(
+            target=run,
+            args=(
+                "hedge", replicas[1], max(1, len(replicas) - 1),
+                len(replicas) > 2,
+            ),
+            daemon=True, name="router-hedge-leg",
+        ).start()
+        tag, verdict = self._q_wait(q, deadline)
+        if verdict[0] == "ok":
+            self._hedges.inc(
+                1, outcome="hedge_won" if tag == "hedge" else "primary_won"
+            )
+            self._hedges.inc(1, outcome="loser_cancelled")
+            return 2, [verdict]
+        tag2, verdict2 = self._q_wait(q, deadline)
+        if verdict2[0] == "ok":
+            self._hedges.inc(
+                1, outcome="hedge_won" if tag2 == "hedge" else "primary_won"
+            )
+            return 2, [verdict2]
+        return 2, [verdict, verdict2]
+
+    def _walk_ring(
+        self,
+        replicas: Sequence[str],
+        raw: bytes,
+        deadline: Optional[Deadline],
+        trace_id: Optional[str],
+        stage: str,
+    ) -> Tuple[str, Any]:
+        """Walk one failover ring in order — the ONE status discipline
+        both routing modes share (503/5xx fail over and trip the
+        breaker; 504 and 4xx pass through; open breakers skip). The
+        first position runs through :meth:`_hedged_first` and may
+        consume two ring positions when the hedge fires. Returns
+        ``("ok", (status, body, variant))`` or ``("failed", (details,
+        all_shed))`` where ``details`` is the ordered ``(kind,
+        message)`` trail and ``all_shed`` is True iff every tried
+        replica answered 503."""
+        details: List[Tuple[str, str]] = []
+        all_shed = bool(replicas)
+        i = 0
+        while i < len(replicas):
+            if deadline is not None:
+                deadline.check(stage)
+            if i == 0:
+                consumed, verdicts = self._hedged_first(
+                    replicas, raw, deadline, trace_id
+                )
+                i += consumed
+            else:
+                verdicts = [
+                    self._attempt_leg(
+                        replicas[i], raw, deadline, len(replicas) - i,
+                        trace_id, i + 1 < len(replicas),
+                    )
+                ]
+                i += 1
+            for kind, value in verdicts:
+                if kind == "ok":
+                    status, body, headers = value
+                    return "ok", (
+                        status, body, headers.get(VARIANT_HEADER.lower())
+                    )
+                if kind == "skip":
+                    details.append(("skip", value))
+                    all_shed = False
+                else:
+                    msg, shed = value
+                    details.append(("failed", msg))
+                    if not shed:
+                        all_shed = False
+        return "failed", (details, all_shed)
+
     def _route_replicated(
         self,
         raw: bytes,
@@ -927,55 +1520,20 @@ class RouterServer(BackgroundHTTPServer):
         replicas = self._ordered_replicas(payload)
         if self.config.max_attempts > 0:
             replicas = replicas[: self.config.max_attempts]
-        last_error: Optional[str] = None
-        all_shed = bool(replicas)
-        for i, backend in enumerate(replicas):
-            if deadline is not None:
-                deadline.check("router-retry")
-            attempts_left = len(replicas) - i
-            breaker = self.breakers[backend]
-            try:
-                breaker.before_call()
-            except CircuitOpen:
-                self._backend_events.inc(1, backend=backend, kind="open_skip")
-                all_shed = False
-                continue
-            try:
-                status, body, headers = self._leg(
-                    backend, raw, deadline, attempts_left, trace_id
-                )
-            except Exception as exc:
-                breaker.record_failure()
-                self._backend_events.inc(1, backend=backend, kind="error")
-                if i + 1 < len(replicas):
-                    self._retries.inc(1, backend=backend)
-                last_error = f"{backend}: {exc}"
-                all_shed = False
-                continue
-            if status == 503 or (status >= 500 and status != 504):
-                # a shedding or erroring backend: the read belongs on
-                # another replica (bounded-admission discipline says the
-                # *fleet* answers even when one member cannot). 504 is
-                # excluded: an expired deadline is the CLIENT's budget,
-                # not backend sickness — it must neither trip the
-                # breaker nor burn a failover leg it cannot afford.
-                breaker.record_failure()
-                self._backend_events.inc(1, backend=backend, kind="error")
-                if i + 1 < len(replicas):
-                    self._retries.inc(1, backend=backend)
-                last_error = f"{backend}: HTTP {status}"
-                if status != 503:
-                    all_shed = False
-                continue
-            breaker.record_success()
-            self._backend_events.inc(1, backend=backend, kind="ok")
-            return status, body, headers.get(VARIANT_HEADER.lower())
+        kind, value = self._walk_ring(
+            replicas, raw, deadline, trace_id, "router-retry"
+        )
+        if kind == "ok":
+            return value
+        details, all_shed = value
         if all_shed:
             # every replica answered 503: fleet-wide backpressure, not a
             # routing failure — relay the shed so clients back off
             raise FleetOverloaded(
                 f"all {len(replicas)} replicas are shedding load"
             )
+        failed = [msg for k, msg in details if k == "failed"]
+        last_error = failed[-1] if failed else None
         raise RuntimeError(
             f"no backend could serve the read (tried {len(replicas)}): "
             f"{last_error or 'all breakers open'}"
@@ -1124,47 +1682,14 @@ class RouterServer(BackgroundHTTPServer):
         ``("ok", (status, body, variant))`` or
         ``("dead", (error detail, all_replicas_shed))``."""
         replicas = self._ordered_shard_replicas(shard, key)
-        errors: List[str] = []
-        all_shed = bool(replicas)
-        for i, backend in enumerate(replicas):
-            if deadline is not None:
-                deadline.check("shard-retry")
-            attempts_left = len(replicas) - i
-            breaker = self.breakers[backend]
-            try:
-                breaker.before_call()
-            except CircuitOpen as exc:
-                self._backend_events.inc(
-                    1, backend=backend, kind="open_skip"
-                )
-                errors.append(f"{backend}: {exc}")
-                all_shed = False
-                continue
-            try:
-                status, body, headers = self._leg(
-                    backend, raw, deadline, attempts_left, trace_id
-                )
-            except Exception as exc:
-                breaker.record_failure()
-                self._backend_events.inc(1, backend=backend, kind="error")
-                if i + 1 < len(replicas):
-                    self._retries.inc(1, backend=backend)
-                errors.append(f"{backend}: {exc}")
-                all_shed = False
-                continue
-            if status == 503 or (status >= 500 and status != 504):
-                breaker.record_failure()
-                self._backend_events.inc(1, backend=backend, kind="error")
-                if i + 1 < len(replicas):
-                    self._retries.inc(1, backend=backend)
-                errors.append(f"{backend}: HTTP {status}")
-                if status != 503:
-                    all_shed = False
-                continue
-            breaker.record_success()
-            self._backend_events.inc(1, backend=backend, kind="ok")
-            return "ok", (status, body, headers.get(VARIANT_HEADER.lower()))
-        return "dead", ("; ".join(errors) or "no replica configured", all_shed)
+        kind, value = self._walk_ring(
+            replicas, raw, deadline, trace_id, "shard-retry"
+        )
+        if kind == "ok":
+            return "ok", value
+        details, all_shed = value
+        joined = "; ".join(msg for _, msg in details)
+        return "dead", (joined or "no replica configured", all_shed)
 
     # -- one backend leg --------------------------------------------------
     def _leg_timeout(
@@ -1259,6 +1784,8 @@ class RouterServer(BackgroundHTTPServer):
         pool.clear()
 
     def server_close(self) -> None:
+        if self._subscriber is not None:
+            self._subscriber.stop()
         for pool in self._leg_pools.values():
             pool.stop()
         super().server_close()
@@ -1302,6 +1829,28 @@ class RouterServer(BackgroundHTTPServer):
         }
         if self._cache is not None:
             out["cache"]["enabled"] = True
+        if self._shared is not None:
+            with self._lock:
+                warmed = self._warmed_entries
+            shared = self._shared.status()
+            shared["enabled"] = True
+            shared["warmedEntries"] = warmed
+            shared["negativeTtlS"] = self._negative_ttl_s
+            out["sharedCache"] = shared
+        else:
+            out["sharedCache"] = {"enabled": False}
+        if self._subscriber is not None:
+            out["subscriber"] = self._subscriber.status()
+            out["epochSource"] = (
+                "push" if self._subscriber.alive() else "poll"
+            )
+        else:
+            out["epochSource"] = "poll"
+        out["hedging"] = (
+            self._hedge.snapshot()
+            if self._hedge is not None
+            else {"enabled": False}
+        )
         if plan is not None:
             out["rolloutPlan"] = {
                 "id": plan.id,

@@ -1,0 +1,450 @@
+"""Shared cache tier: one sidecar cache, many routers — advisory by
+construction (docs/fleet.md#shared-cache-tier).
+
+The PR-14 response cache is per-router-process: at fleet scale every
+router replica pays its own miss storm for the same Zipfian head. This
+module adds the middle level of the fleet's memory hierarchy — a
+stdlib sidecar cache server that router replicas consult between their
+local LRU and the backend fan-out, so a hot key is computed once per
+*fleet* instead of once per router (the shared, staleness-bounded
+serving cache the ads-serving infrastructure in PAPERS.md treats as
+table stakes).
+
+The robustness contract, in one sentence: **the sidecar can make the
+fleet faster, it can never make it wrong.**
+
+- Every entry carries the PR-14 **epoch** (rollout plan + serving
+  instance). A lookup under a different epoch is a miss and drops the
+  entry — server-side in :class:`~predictionio_tpu.fleet.cache.
+  ResponseCache` and re-checked client-side (a skewed sidecar answer is
+  dropped locally, never served).
+- The client is **advisory**: any doubt — timeout, protocol error,
+  open breaker, epoch skew — degrades to a miss, never a stale serve,
+  and every degrade is *recorded* (an outcome counter + ``lastError``
+  on the status surface; the ``robust-fallback-swallows`` lint rule
+  pins this path as its clean exemplar). Killing the tier therefore
+  degrades the fleet to exactly the per-router PR-14 behavior.
+- A :class:`~predictionio_tpu.utils.resilience.CircuitBreaker` guards
+  the sidecar socket: a dead sidecar costs a handful of timeouts, then
+  every lookup is an instant local miss until the cooldown probe.
+
+The sidecar also answers ``GET /cache/top`` — the hottest entries by
+hit count — which restarting routers use to pre-fill their local LRU
+(**cache warming**: a deploy never exposes the backends to the full
+hot set again).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ..api.http import BackgroundHTTPServer, JsonHTTPHandler
+from ..utils.resilience import CircuitBreaker, CircuitOpen
+from .cache import CacheEntry, ResponseCache
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SHARED_OUTCOMES",
+    "SharedCacheClient",
+    "SharedCacheServer",
+]
+
+#: client outcome vocabulary — closed, safe as a metric label
+#: (``pio_router_shared_cache_total{outcome}``): "hit"/"negative_hit"/
+#: "miss" are the sidecar's answers; "epoch_skew" is an answer the
+#: client dropped locally (entry filled under another epoch);
+#: "open"/"error" are degrades (breaker short-circuit / any transport
+#: or protocol failure); "put"/"put_error" account the fill path.
+SHARED_OUTCOMES = (
+    "hit",
+    "negative_hit",
+    "miss",
+    "epoch_skew",
+    "open",
+    "error",
+    "put",
+    "put_error",
+)
+
+
+class SharedCacheHandler(JsonHTTPHandler):
+    """The sidecar's wire surface — same HTTP discipline as the storage
+    nodes (JSON bodies, keep-alive, obs routes)."""
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        parts = urlsplit(self.path)
+        if self.serve_obs(parts.path):
+            return
+        if parts.path == "/status.json":
+            self.respond(200, self.server.status_json())
+        elif parts.path == "/cache/top":
+            query = parse_qs(parts.query)
+            try:
+                n = int(query.get("n", ["50"])[0])
+            except ValueError:
+                self.respond(400, {"error": "n must be an integer"})
+                return
+            self.respond(200, {"entries": self.server.cache.export_top(n)})
+        else:
+            self.respond(404, {"error": f"no route {parts.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        raw = self.read_body()
+        try:
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+        except (ValueError, UnicodeDecodeError):
+            self.respond(400, {"error": "invalid JSON body"})
+            return
+        if not isinstance(body, dict):
+            self.respond(400, {"error": "body must be a JSON object"})
+            return
+        if self.path == "/cache/lookup":
+            self.respond(200, self.server.lookup(body))
+        elif self.path == "/cache/put":
+            self.respond(200, self.server.put(body))
+        elif self.path == "/cache/flush":
+            self.respond(
+                200,
+                {
+                    "flushed": self.server.cache.flush(
+                        variant=body.get("variant"),
+                        reason=str(body.get("reason", "explicit")),
+                    )
+                },
+            )
+        else:
+            self.respond(404, {"error": f"no route {self.path}"})
+
+
+class SharedCacheServer(BackgroundHTTPServer):
+    """The sidecar: a :class:`ResponseCache` behind HTTP.
+
+    Deliberately dumb — it stores what routers hand it and answers
+    epoch-checked reads; *all* policy (what to cache, negative TTLs,
+    when to flush) lives in the routers. A dumb tier has nothing to
+    disagree with the routers about."""
+
+    def __init__(
+        self,
+        ip: str = "127.0.0.1",
+        port: int = 0,
+        max_entries: int = 8192,
+        ttl_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__((ip, port), SharedCacheHandler)
+        self.cache = ResponseCache(
+            max_entries=max_entries,
+            ttl_s=ttl_s,
+            clock=clock,
+            on_invalidate=self._on_invalidate,
+        )
+        self._lookups = self.metrics.counter(
+            "pio_sharedcache_lookups_total",
+            "Sidecar lookups by outcome",
+            labelnames=("outcome",),
+        )
+        self._invalidations = self.metrics.counter(
+            "pio_sharedcache_invalidations_total",
+            "Sidecar entries dropped, by reason",
+            labelnames=("reason",),
+        )
+        self.metrics.gauge_callback(
+            "pio_sharedcache_entries",
+            lambda: float(len(self.cache)),
+            help="Live sidecar cache entries",
+        )
+
+    def _on_invalidate(self, reason: str, count: int) -> None:
+        self._invalidations.inc(count, reason=reason)
+
+    # -- ops (handler thread) ---------------------------------------------
+    def lookup(self, body: dict) -> dict:
+        key = (str(body.get("variant", "-")), str(body.get("query", "")))
+        epoch = str(body.get("epoch", ""))
+        entry = self.cache.get(key, epoch)
+        if entry is None:
+            self._lookups.inc(1, outcome="miss")
+            return {"found": False}
+        self._lookups.inc(
+            1, outcome="negative_hit" if entry.negative else "hit"
+        )
+        return {
+            "found": True,
+            "body": entry.body,
+            "servedVariant": entry.variant,
+            "epoch": entry.epoch,
+            "negative": entry.negative,
+        }
+
+    def put(self, body: dict) -> dict:
+        key = (str(body.get("variant", "-")), str(body.get("query", "")))
+        ttl_s = body.get("ttlS")
+        self.cache.put(
+            key,
+            body.get("body"),
+            body.get("servedVariant"),
+            str(body.get("epoch", "")),
+            ttl_s=float(ttl_s) if ttl_s is not None else None,
+            negative=bool(body.get("negative", False)),
+        )
+        return {"stored": True}
+
+    def status_json(self) -> dict:
+        return {"server": "sharedcache", "cache": self.cache.snapshot()}
+
+
+class SharedCacheClient:
+    """The router-side advisory client.
+
+    Degrade contract (the ``robust-fallback-swallows`` clean exemplar):
+    every path that turns a sidecar problem into a miss goes through
+    :meth:`_record_degrade`, which counts the outcome, keeps the last
+    error on the status surface and logs at debug — a degraded tier is
+    *visible*, never silent. The return value of a degrade is always
+    ``None`` (= miss): the one thing this client never does is guess.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        timeout_s: float = 0.25,
+        breaker: Optional[CircuitBreaker] = None,
+        on_outcome: Optional[Callable[[str], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.addr = addr
+        host, _, port = addr.partition(":")
+        self._host = host
+        self._port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.breaker = (
+            breaker
+            if breaker is not None
+            else CircuitBreaker.from_env(f"sharedcache-{addr}", clock=clock)
+        )
+        self._on_outcome = on_outcome
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self.outcomes: Dict[str, int] = {}
+        self.last_error: Optional[str] = None
+
+    # -- accounting --------------------------------------------------------
+    def _count(self, outcome: str) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if self._on_outcome is not None:
+            try:
+                self._on_outcome(outcome)
+            except Exception:
+                pass  # observability must never fail a lookup
+
+    def _record_degrade(self, outcome: str, exc: BaseException) -> None:
+        """Advisory degrade: record the failure (counter + status
+        surface + debug log) and answer a miss. Never raises."""
+        self._count(outcome)
+        with self._lock:
+            self.last_error = f"{type(exc).__name__}: {exc}"
+        logger.debug(
+            "shared cache %s degraded to miss (%s): %s",
+            self.addr, outcome, exc,
+        )
+        return None
+
+    # -- transport ---------------------------------------------------------
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self.timeout_s
+            )
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """One keep-alive request → parsed JSON; raises on ANY problem
+        (non-200, bad JSON, socket error) — callers translate into a
+        recorded degrade. A failed connection is dropped so the next
+        call starts clean."""
+        conn = self._conn()
+        conn.timeout = (
+            self.timeout_s if timeout_s is None else float(timeout_s)
+        )
+        if conn.sock is not None:
+            conn.sock.settimeout(conn.timeout)
+        try:
+            body = (
+                json.dumps(payload).encode("utf-8")
+                if payload is not None
+                else None
+            )
+            conn.request(
+                method, path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"sidecar answered {resp.status} on {path}"
+                )
+            return json.loads(raw.decode("utf-8"))
+        except Exception:
+            self._drop_conn()
+            raise
+
+    # -- the tier ----------------------------------------------------------
+    def lookup(
+        self,
+        key: Tuple[str, str],
+        epoch: str,
+        budget_s: Optional[float] = None,
+    ) -> Optional[CacheEntry]:
+        """The shared tier's answer for ``key`` under ``epoch`` — a
+        :class:`CacheEntry` on a hit, ``None`` on a miss *or any doubt*.
+        ``budget_s`` caps the lookup below the request's remaining
+        deadline so the tier can never blow the caller's budget."""
+        try:
+            self.breaker.before_call()
+        except CircuitOpen as exc:
+            return self._record_degrade("open", exc)
+        timeout = self.timeout_s
+        if budget_s is not None:
+            timeout = max(0.001, min(timeout, float(budget_s)))
+        try:
+            out = self._request(
+                "POST",
+                "/cache/lookup",
+                {"variant": key[0], "query": key[1], "epoch": epoch},
+                timeout_s=timeout,
+            )
+        except Exception as exc:
+            self.breaker.record_failure()
+            return self._record_degrade("error", exc)
+        self.breaker.record_success()
+        if not out.get("found"):
+            self._count("miss")
+            return None
+        if str(out.get("epoch")) != epoch:
+            # skewed sidecar (should not happen: the server checks too)
+            # — drop locally, never serve across epochs
+            self._count("epoch_skew")
+            return None
+        negative = bool(out.get("negative", False))
+        self._count("negative_hit" if negative else "hit")
+        return CacheEntry(
+            body=out.get("body"),
+            variant=out.get("servedVariant"),
+            epoch=epoch,
+            stored_at=0.0,  # freshness is the sidecar's concern
+            negative=negative,
+        )
+
+    def put(
+        self,
+        key: Tuple[str, str],
+        body: Any,
+        variant: Optional[str],
+        epoch: str,
+        ttl_s: Optional[float] = None,
+        negative: bool = False,
+    ) -> bool:
+        """Offer one filled response to the tier; best-effort (False =
+        not stored, recorded)."""
+        try:
+            self.breaker.before_call()
+        except CircuitOpen as exc:
+            self._record_degrade("open", exc)
+            return False
+        try:
+            self._request(
+                "POST",
+                "/cache/put",
+                {
+                    "variant": key[0],
+                    "query": key[1],
+                    "body": body,
+                    "servedVariant": variant,
+                    "epoch": epoch,
+                    "ttlS": ttl_s,
+                    "negative": negative,
+                },
+            )
+        except Exception as exc:
+            self.breaker.record_failure()
+            self._record_degrade("put_error", exc)
+            return False
+        self.breaker.record_success()
+        self._count("put")
+        return True
+
+    def flush(self, reason: str = "epoch") -> Optional[int]:
+        """Ask the sidecar to drop everything (routers push this on an
+        epoch move so the tier converges without waiting out reads).
+        Best-effort: ``None`` = the ask didn't land (recorded)."""
+        try:
+            self.breaker.before_call()
+        except CircuitOpen as exc:
+            self._record_degrade("open", exc)
+            return None
+        try:
+            out = self._request(
+                "POST", "/cache/flush", {"reason": reason}
+            )
+        except Exception as exc:
+            self.breaker.record_failure()
+            self._record_degrade("error", exc)
+            return None
+        self.breaker.record_success()
+        return int(out.get("flushed", 0))
+
+    def top(self, n: int = 50) -> list:
+        """The sidecar's hottest entries (the warming export); an empty
+        list on any doubt (recorded) — warming is opportunistic."""
+        try:
+            self.breaker.before_call()
+        except CircuitOpen as exc:
+            self._record_degrade("open", exc)
+            return []
+        try:
+            out = self._request("GET", f"/cache/top?n={int(n)}")
+        except Exception as exc:
+            self.breaker.record_failure()
+            self._record_degrade("error", exc)
+            return []
+        self.breaker.record_success()
+        entries = out.get("entries")
+        return entries if isinstance(entries, list) else []
+
+    def status(self) -> dict:
+        """The ``/router.json`` sharedCache block."""
+        with self._lock:
+            return {
+                "addr": self.addr,
+                "timeoutS": self.timeout_s,
+                "breaker": self.breaker.snapshot(),
+                "outcomes": dict(self.outcomes),
+                "lastError": self.last_error,
+            }

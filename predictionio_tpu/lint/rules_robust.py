@@ -35,6 +35,13 @@ first incident:
   lagging side strands every write still in flight on a path nothing
   reads anymore; ``storage/migration.py``'s ``cutover`` (freeze →
   final drain → per-keyspace watermark → flip) is the packaged shape.
+- ``robust-fallback-swallows`` (ISSUE 18): a fallback/degrade-marked
+  except handler that discards the primary's failure without recording
+  it anywhere (no log/counter call, the bound exception never read) —
+  the degrade path works, so nothing pages, and the primary stays
+  silently dead until the fallback ALSO fails;
+  ``fleet/sharedcache.py``'s ``_record_degrade`` (count + last_error +
+  debug log, THEN return the advisory miss) is the packaged shape.
 """
 
 from __future__ import annotations
@@ -705,7 +712,145 @@ class CutoverNoWatermark(Rule):
         return False
 
 
+#: identifiers that mark an except handler as a *deliberate* degrade
+#: path — the rule's gate: only code that advertises "I fall back" is
+#: held to the recording contract (an ordinary except is rules_obs's
+#: business, not this rule's)
+_FALLBACK_MARKERS = ("fallback", "fall_back", "degrade", "advisory")
+
+#: dotted-name components that count as recording the failure —
+#: loggers, metric counters, flight recorders; substring match per
+#: component, benefit of the doubt on purpose (a false "recorded" is
+#: cheaper than training people to ignore the rule)
+_RECORD_MARKERS = (
+    "log", "warn", "error", "exception", "debug", "info", "inc",
+    "observe", "record", "count", "note", "emit", "flight", "metric",
+)
+
+
+class FallbackSwallows(Rule):
+    """A fallback/degrade-marked except handler that discards the
+    primary failure without recording it. The degrade path *working* is
+    exactly what makes the swallow dangerous: clients see answers, no
+    error rate moves, and the primary stays dead until the day the
+    fallback also fails — at which point the incident starts with zero
+    history. A degrade is only safe when every occurrence leaves a
+    trace (``fleet/sharedcache.py``'s ``_record_degrade``: count the
+    outcome, keep ``last_error``, debug-log, THEN return the miss)."""
+
+    id = "robust-fallback-swallows"
+    severity = "error"
+    short = (
+        "fallback/degrade except handler discards the primary "
+        "failure without recording it"
+    )
+    motivation = (
+        "a silent degrade path turns a dead primary into a latent "
+        "incident with no history; record every occurrence (counter, "
+        "log, last_error) before returning the fallback answer — "
+        "fleet/sharedcache.py's _record_degrade is the packaged shape"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        lowered = ctx.source.lower()
+        if not any(m in lowered for m in _FALLBACK_MARKERS):
+            return
+        for handler, fn_name in self._handlers(ctx.tree):
+            if not self._gated(handler, fn_name):
+                continue
+            if self._records(handler):
+                continue
+            yield self.finding(
+                ctx,
+                handler,
+                (
+                    f"{fn_name}(): " if fn_name else ""
+                )
+                + "this fallback/degrade handler swallows the primary "
+                "failure — nothing logs, counts, or keeps the "
+                "exception, so the degrade is invisible until the "
+                "fallback ALSO fails. Record the failure (counter + "
+                "last_error + log, fleet/sharedcache.py's "
+                "_record_degrade shape) before returning the "
+                "fallback answer.",
+            )
+
+    @staticmethod
+    def _handlers(tree: ast.AST):
+        """Every except handler, paired with its enclosing function's
+        name ("" at module level) — the gate looks at both."""
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_name = node.name
+                for sub in _walk_in_scope(node):
+                    if isinstance(sub, ast.Try):
+                        for handler in sub.handlers:
+                            yield handler, fn_name
+        # module-level try blocks (import fallbacks and the like)
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    yield handler, ""
+
+    @classmethod
+    def _gated(cls, handler: ast.ExceptHandler, fn_name: str) -> bool:
+        """In scope iff the code ADVERTISES a degrade: the enclosing
+        function's name or any identifier inside the handler carries a
+        fallback marker."""
+        lname = fn_name.lower()
+        if any(m in lname for m in _FALLBACK_MARKERS):
+            return True
+        for ident in cls._handler_idents(handler):
+            if any(m in ident for m in _FALLBACK_MARKERS):
+                return True
+        return False
+
+    @staticmethod
+    def _handler_idents(handler: ast.ExceptHandler):
+        for node in _walk_in_scope(handler):
+            if isinstance(node, ast.Name):
+                yield node.id.lower()
+            elif isinstance(node, ast.Attribute):
+                yield node.attr.lower()
+
+    @staticmethod
+    def _records(handler: ast.ExceptHandler) -> bool:
+        """Recording evidence inside the handler: a re-raise, a call
+        whose dotted name carries a logger/counter component, an
+        assignment to an error-named slot, or ANY read of the bound
+        exception (an exception that flows somewhere was not
+        discarded)."""
+        bound = handler.name
+        for node in _walk_in_scope(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or call_name(node)
+                for part in name.lower().split("."):
+                    if any(m in part for m in _RECORD_MARKERS):
+                        return True
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    tname = (
+                        target.attr
+                        if isinstance(target, ast.Attribute)
+                        else target.id
+                        if isinstance(target, ast.Name)
+                        else ""
+                    ).lower()
+                    if "error" in tname or "fail" in tname:
+                        return True
+            if (
+                bound
+                and isinstance(node, ast.Name)
+                and node.id == bound
+                and isinstance(node.ctx, ast.Load)
+            ):
+                return True
+        return False
+
+
 RULES: List[Rule] = [
     NoTimeout(), BareSleepRetry(), RenameNoFsync(), UnboundedRetry(),
-    UnboundedCache(), CutoverNoWatermark(),
+    UnboundedCache(), CutoverNoWatermark(), FallbackSwallows(),
 ]

@@ -49,6 +49,7 @@ __all__ = [
     "migration_records",
     "quality_records",
     "render_trend",
+    "shared_cache_records",
     "sharded_records",
 ]
 
@@ -141,7 +142,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
             for key in (
                 "iterations", "nnz", "error", "jit", "servingFleet",
                 "quality", "bf16_gate", "ingestScaling", "cachedFleet",
-                "shardedTrain", "migrationDrill",
+                "shardedTrain", "migrationDrill", "sharedCache",
             )
             if key in bench
         },
@@ -278,6 +279,57 @@ def cache_records(bench: dict, source: str = "bench") -> List[dict]:
                 unit="ratio",
                 device=bench.get("device"),
                 scale=cached.get("replicas"),
+            )
+        )
+    return out
+
+
+def shared_cache_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The shared-tier numbers a bench run attached
+    (``bench["sharedCache"]``, from ``loadgen --shared-cache-drill`` —
+    docs/fleet.md#shared-cache-tier) as their own ledger records:
+
+    - ``fleet_hedged_p99_s`` — seconds through the hedged router on the
+      healthy (tier-up) phase of the drill, lower-better → GATED at the
+      same wide record-declared band (0.5) as the other in-process
+      serving tails: one scheduler hiccup doubles a small drive's p99,
+      so only a real collapse (hedging gone wrong, a tier that blocks
+      the request path) should fire;
+    - ``fleet_shared_hit_rate`` — trend-only ``ratio`` (the drill
+      itself hard-gates correctness: zero stale responses, byte
+      identity across the kill, every degrade recorded).
+
+    A failed drill (``ok`` false) records nothing — its numbers
+    measured a broken tier, not the code."""
+    shared = bench.get("sharedCache")
+    if not isinstance(shared, dict) or not shared.get("ok"):
+        return []
+    out: List[dict] = []
+    p99_ms = shared.get("hedgedP99Ms")
+    if isinstance(p99_ms, (int, float)) and p99_ms > 0:
+        record = make_record(
+            source=source,
+            metric="fleet_hedged_p99_s",
+            value=float(p99_ms) / 1000.0,
+            unit="s",
+            device=bench.get("device"),
+            extra={
+                "sharedHitRate": shared.get("sharedHitRate"),
+                "healthyQPS": shared.get("healthyQPS"),
+            },
+        )
+        record["noise_band"] = 0.5
+        out.append(record)
+    hit_rate = shared.get("sharedHitRate")
+    if isinstance(hit_rate, (int, float)):
+        out.append(
+            make_record(
+                source=source,
+                metric="fleet_shared_hit_rate",
+                value=float(hit_rate),
+                unit="ratio",
+                device=bench.get("device"),
+                extra={"degradesRecorded": shared.get("degradesRecorded")},
             )
         )
     return out

@@ -81,6 +81,37 @@ METADATA_MUTATING_METHODS = frozenset(
     }
 )
 
+#: the subset of metadata mutations that can move a router's cache
+#: epoch (``plan_epoch + latest completed instance`` — see
+#: ``fleet/router.py``): rollout plan writes change the plan half,
+#: engine-instance writes can change which instance is "latest
+#: completed". The pushed-invalidation subscribers
+#: (docs/fleet.md#shared-cache-tier) flush on exactly these; like
+#: METADATA_MUTATING_METHODS above, membership of a future method is a
+#: decision, never an accident.
+EPOCH_MUTATING_METHODS = frozenset(
+    {
+        "rollout_plan_upsert",
+        "engine_instance_insert",
+        "engine_instance_update",
+        "engine_instance_delete",
+    }
+)
+
+
+def op_moves_epoch(op: dict) -> bool:
+    """True when a changefeed op may move the serving epoch — the
+    pushed-invalidation filter. Anything unrecognized answers True for
+    ``kind == "meta"`` (a NEW metadata mutation defaults to "flush", the
+    fail-soft direction: a spurious flush costs a re-read, a missed one
+    costs staleness)."""
+    if not isinstance(op, dict) or op.get("kind") != "meta":
+        return False
+    method = op.get("method")
+    if method in METADATA_MUTATING_METHODS:
+        return method in EPOCH_MUTATING_METHODS
+    return True
+
 
 def _resolve_events(events: Sequence[Event]) -> List[Event]:
     """Mint ids for events that lack one (same mint the stores use), so
@@ -311,3 +342,46 @@ def apply_op(op: dict, events, metadata, models) -> None:
         models.delete(op["id"])
     else:
         raise ValueError(f"unknown changefeed op kind {kind!r}")
+
+
+class RecordingMetadata:
+    """A MetadataStore proxy that routes every mutating RPC through a
+    :class:`Changefeed`, so in-process fleets (drills, tests) get a real
+    oplog under their metadata writes without running a storage server.
+    Reads pass straight through. This is exactly the storage server's
+    routing, packaged for embedding — the pushed-invalidation
+    subscribers (docs/fleet.md#shared-cache-tier) tail the resulting
+    feed."""
+
+    def __init__(self, changefeed: Changefeed, metadata):
+        self._changefeed = changefeed
+        self._metadata = metadata
+
+    def __getattr__(self, name: str):
+        if name in METADATA_MUTATING_METHODS:
+            def call(*args):
+                result, _seq = self._changefeed.metadata_rpc(
+                    name, list(args)
+                )
+                return result
+            return call
+        return getattr(self._metadata, name)
+
+
+class RecordingRegistry:
+    """A StorageRegistry facade whose metadata surface is a
+    :class:`RecordingMetadata` — drop-in for servers that take a
+    registry, used by the shared-cache drill to give routers a live
+    metadata changefeed to subscribe to."""
+
+    def __init__(self, registry, changefeed: Changefeed):
+        self._registry = registry
+        self._metadata = RecordingMetadata(
+            changefeed, registry.get_metadata()
+        )
+
+    def get_metadata(self):
+        return self._metadata
+
+    def __getattr__(self, name: str):
+        return getattr(self._registry, name)

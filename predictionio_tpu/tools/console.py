@@ -538,6 +538,44 @@ def build_parser() -> argparse.ArgumentParser:
     )
     rt.add_argument("--engine-version", default=None)
     rt.add_argument("--engine-variant", default="engine.json")
+    rt.add_argument(
+        "--shared-cache", default=None, metavar="HOST:PORT",
+        help="consult a `pio sharedcache` sidecar between the local LRU "
+        "and the backend fan-out (docs/fleet.md#shared-cache-tier; "
+        "advisory by construction — any doubt is a miss, killing the "
+        "sidecar degrades to per-router caching; also "
+        "PIO_ROUTER_SHARED_CACHE)",
+    )
+    rt.add_argument(
+        "--meta-feed", default=None, metavar="URL",
+        help="storage-server base URL whose metadata changefeed pushes "
+        "epoch invalidations (docs/fleet.md#shared-cache-tier; the "
+        "plan poll stretches to a watchdog while the subscription is "
+        "live; also PIO_ROUTER_META_FEED)",
+    )
+    rt.add_argument(
+        "--no-hedge", action="store_true",
+        help="disable tail-latency request hedging (docs/fleet.md"
+        "#hedging; default on, PIO_ROUTER_HEDGE=0 also disables)",
+    )
+
+    sc = sub.add_parser(
+        "sharedcache",
+        help="shared response-cache sidecar for a router fleet: one "
+        "epoch-checked LRU every `pio router --shared-cache` replica "
+        "consults before fanning out (docs/fleet.md#shared-cache-tier)",
+    )
+    sc.add_argument("--ip", default="localhost")
+    sc.add_argument("--port", type=int, default=8800)
+    sc.add_argument(
+        "--max-entries", type=int, default=8192, metavar="N",
+        help="LRU bound (default 8192)",
+    )
+    sc.add_argument(
+        "--ttl", type=float, default=30.0, metavar="S",
+        help="entry TTL backstop in seconds (default 30; correctness "
+        "comes from epoch checks, not the TTL)",
+    )
 
     es = sub.add_parser("eventserver", help="run the event REST server")
     es.add_argument("--ip", default="localhost")
@@ -941,6 +979,7 @@ def main(
     prev = None
     if args.command in (
         "eventserver", "dashboard", "storageserver", "deploy", "router",
+        "sharedcache",
     ):
         # long-running server commands arm the crash path (docs/slo.md):
         # with PIO_FLIGHT_DIR set, SIGTERM/exit leaves the flight-
@@ -1155,8 +1194,30 @@ def _dispatch(args: argparse.Namespace, registry: StorageRegistry) -> int:
             cache_enabled=False if args.no_cache else None,
             cache_ttl_s=args.cache_ttl,
             cache_max_entries=args.cache_max_entries,
+            shared_cache=args.shared_cache,
+            meta_feed=args.meta_feed,
+            hedge_enabled=False if args.no_hedge else None,
         )
         create_router(config, registry=registry, block=True)
+        return EXIT_OK
+
+    if cmd == "sharedcache":
+        from ..fleet.sharedcache import SharedCacheServer
+
+        server = SharedCacheServer(
+            ip=args.ip,
+            port=args.port,
+            max_entries=args.max_entries,
+            ttl_s=args.ttl,
+        )
+        _emit(
+            f"shared cache sidecar on {args.ip}:{server.bound_port} "
+            f"({args.max_entries} entries, {args.ttl}s TTL)"
+        )
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
         return EXIT_OK
 
     if cmd == "eventserver":

@@ -48,7 +48,7 @@ import os
 import sys
 import threading
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 from urllib.parse import urlparse
 
 import numpy as np
@@ -2906,6 +2906,397 @@ def run_cached_hot_set(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_shared_cache_drill(
+    queries: int = 240,
+    concurrency: int = 4,
+    n_users: int = 24,
+    n_items: int = 16,
+    zipf_s: float = 1.2,
+    percent: float = 50.0,
+    base_dir: Optional[str] = None,
+) -> dict:
+    """The kill-the-tier acceptance drive (``--shared-cache-drill``,
+    docs/fleet.md#shared-cache-tier): two routers over the same two
+    backends share one ``pio sharedcache`` sidecar, with pushed
+    invalidation subscribed to the metadata changefeed and request
+    hedging armed. The drill proves the tier's one-line contract —
+    *the sidecar can make the fleet faster, it can never make it
+    wrong* — by killing it and watching nothing break:
+
+    - **cross-router reuse**: a key filled through router A answers
+      router B's first lookup from the shared tier (``hit-shared``),
+      byte-identical to A's body;
+    - **fail-soft**: the sidecar is HARD-KILLED mid-Zipfian-drive —
+      zero client failures, byte-identical answers, and every degrade
+      recorded (breaker open / transport error outcomes), i.e. exactly
+      the per-router cache behavior with the tier subtracted;
+    - **recovery + warming**: a restarted sidecar (same port) refills
+      and serves shared hits again once the client breaker re-probes,
+      and a router booted AFTER the restart pre-fills its local LRU
+      from the sidecar's top-keys export (``warmedEntries > 0``);
+    - **pushed invalidation**: a rollout flip lands with the plan poll
+      stretched to minutes (``plan_refresh_s=300``) — the changefeed
+      subscription must flush both routers within the push latency,
+      zero stale variant assignments, no poll to wait for.
+
+    Reports ``sharedHitRate`` (trend) and ``hedgedP99Ms`` (gated) —
+    the numbers ``bench.py`` attaches (``sharedCache``, opt out
+    ``BENCH_SHAREDCACHE=0``) and the perf ledger records as
+    ``fleet_shared_hit_rate`` / ``fleet_hedged_p99_s``."""
+    import os as _os
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.storage.registry as regmod
+    from ..continuous.watcher import LocalFeed
+    from ..fleet.cache import CACHE_HEADER
+    from ..fleet.router import RouterConfig, RouterServer, VARIANT_HEADER
+    from ..fleet.sharedcache import SharedCacheServer
+    from ..models.recommendation import engine_factory
+    from ..obs.expo import parse_text, render
+    from ..rollout.plan import sticky_key, variant_for_key
+    from ..storage import StorageRegistry
+    from ..storage.changefeed import Changefeed, RecordingRegistry
+    from ..storage.oplog import OpLog
+    from ..utils.resilience import CircuitBreaker
+    from ..workflow.serving import QueryServer, ServerConfig
+
+    tmp = base_dir or tempfile.mkdtemp(prefix="pio-shared-cache-")
+    owns_tmp = base_dir is None
+    registry = StorageRegistry(env={"PIO_FS_BASEDIR": tmp})
+    prev_registry = regmod._default_registry
+    regmod._default_registry = registry
+    report: dict = {
+        "mode": "shared-cache-drill",
+        "clientFailures": 0,
+        "staleAfterRollout": 0,
+    }
+    backends: List[QueryServer] = []
+    routers: List[RouterServer] = []
+    sidecars: List[SharedCacheServer] = []
+    try:
+        engine = engine_factory()
+        # the fleet drills' shared train-once workspace: zero extra
+        # training cost in a process that already ran a fleet drill
+        info = _prepared_workspace(
+            f"fleet-{n_users}x{n_items}",
+            lambda reg: _build_fleet_workspace(
+                reg, n_users=n_users, n_items=n_items
+            ),
+            tmp,
+        )
+        baseline_id = info["baselineInstanceId"]
+        candidate_id = info["candidateInstanceId"]
+        # every metadata mutation flows through the changefeed, so the
+        # routers have a live feed to subscribe to — the same recording
+        # discipline a storage server applies (storage/changefeed.py)
+        oplog = OpLog(_os.path.join(tmp, "oplog"))
+        changefeed = Changefeed(
+            oplog,
+            registry.get_events(),
+            registry.get_metadata(),
+            registry.get_models(),
+        )
+        recording = RecordingRegistry(registry, changefeed)
+        for _ in range(2):  # two replicas: the hedge needs a second leg
+            backends.append(
+                QueryServer(
+                    ServerConfig(
+                        ip="127.0.0.1", port=0, batching=False,
+                        engine_instance_id=baseline_id,
+                    ),
+                    engine, recording,
+                )
+            )
+        for server in backends:
+            server.start_background()
+        sidecar = SharedCacheServer(ip="127.0.0.1", port=0)
+        sidecar.start_background()
+        sidecars.append(sidecar)
+        shared_addr = f"127.0.0.1:{sidecar.bound_port}"
+
+        def make_router() -> RouterServer:
+            router = RouterServer(
+                RouterConfig(
+                    ip="127.0.0.1", port=0,
+                    backends=tuple(
+                        f"127.0.0.1:{s.bound_port}" for s in backends
+                    ),
+                    timeout_s=10.0,
+                    # minutes of poll staleness ON PURPOSE: only the
+                    # pushed invalidation can make the flip proof pass
+                    plan_refresh_s=300.0,
+                    cache_enabled=True,
+                    shared_cache=shared_addr,
+                    shared_warm=False,  # warming proven on router C
+                ),
+                registry=recording,
+                meta_feed=LocalFeed(oplog),
+            )
+            # drill-speed breaker: open after 2 failures, re-probe
+            # after 0.3s — the drill proves reopen/recovery without
+            # waiting out the production cooldown
+            router._shared.breaker = CircuitBreaker.from_env(
+                "sharedcache-drill",
+                env={
+                    "PIO_BREAKER_FAILURES": "2",
+                    "PIO_BREAKER_RESET_S": "0.3",
+                },
+            )
+            router.start_background()
+            routers.append(router)
+            return router
+
+        router_a = make_router()
+        router_b = make_router()
+
+        rng = np.random.default_rng(7)
+        keys = [f"u{u}" for u in range(n_users)]
+        weights = np.array(
+            [1.0 / (r + 1) ** zipf_s for r in range(len(keys))]
+        )
+        weights /= weights.sum()
+        mix = [
+            keys[i]
+            for i in rng.choice(len(keys), size=queries, p=weights)
+        ]
+        payloads = {
+            k: json.dumps({"user": k, "num": 5}).encode() for k in keys
+        }
+        lock = threading.Lock()
+
+        def drive(
+            router: RouterServer, kill_at: Optional[int] = None
+        ) -> dict:
+            """Concurrent Zipfian drive; with ``kill_at``, hard-kill
+            the live sidecar once that many queries have completed —
+            the drive itself must not notice."""
+            latencies: List[float] = []
+            cursor = {"next": 0, "done": 0, "killed": False}
+
+            def worker() -> None:
+                while True:
+                    with lock:
+                        pos = cursor["next"]
+                        if pos >= len(mix):
+                            return
+                        cursor["next"] = pos + 1
+                    t0 = time.monotonic()
+                    try:
+                        status, _headers, _body = _post_raw(
+                            f"127.0.0.1:{router.bound_port}",
+                            payloads[mix[pos]],
+                        )
+                    except Exception:
+                        status = -1
+                    elapsed = time.monotonic() - t0
+                    with lock:
+                        cursor["done"] += 1
+                        if status == 200:
+                            latencies.append(elapsed)
+                        else:
+                            report["clientFailures"] += 1
+                        do_kill = (
+                            kill_at is not None
+                            and cursor["done"] >= kill_at
+                            and not cursor["killed"]
+                        )
+                        if do_kill:
+                            cursor["killed"] = True
+                    if do_kill:
+                        sidecars[-1].kill()
+
+            t_start = time.monotonic()
+            threads = [
+                threading.Thread(target=worker, daemon=True)
+                for _ in range(concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t_start
+            out = {
+                "qps": round(len(latencies) / wall, 1) if wall > 0 else 0.0,
+            }
+            if latencies:
+                lat = np.asarray(latencies)
+                out["p50Ms"] = round(float(np.percentile(lat, 50)) * 1e3, 3)
+                out["p99Ms"] = round(float(np.percentile(lat, 99)) * 1e3, 3)
+            return out
+
+        def shared_outcomes(router: RouterServer) -> Dict[str, int]:
+            return dict(router._shared.outcomes)
+
+        # -- phase A: healthy tier — cross-router reuse, byte identity
+        reference: Dict[str, bytes] = {}
+        cross_router = True
+        for key in keys[:6]:
+            s1, h1, b1 = _post_raw(
+                f"127.0.0.1:{router_a.bound_port}", payloads[key]
+            )
+            s2, h2, b2 = _post_raw(
+                f"127.0.0.1:{router_b.bound_port}", payloads[key]
+            )
+            reference[key] = b1
+            if not (
+                s1 == s2 == 200
+                and h1.get(CACHE_HEADER.lower()) == "miss"
+                and h2.get(CACHE_HEADER.lower()) == "hit-shared"
+                and b1 == b2
+            ):
+                cross_router = False
+        report["crossRouterReuse"] = cross_router
+        healthy = drive(router_a)
+        report["healthyQPS"] = healthy["qps"]
+        report["hedgedP99Ms"] = healthy.get("p99Ms")
+        # router B rides A's fills: flush its local LRU so every lookup
+        # exercises the shared tier, then measure the tier's hit rate
+        router_b._cache.flush(reason="explicit")
+        before_b = shared_outcomes(router_b)
+        drive(router_b)
+        after_b = shared_outcomes(router_b)
+        shared_hits = after_b.get("hit", 0) - before_b.get("hit", 0)
+        shared_lookups = shared_hits + (
+            after_b.get("miss", 0) - before_b.get("miss", 0)
+        )
+        report["sharedHitRate"] = (
+            round(shared_hits / shared_lookups, 3) if shared_lookups else 0.0
+        )
+
+        # -- phase B: hard-kill the sidecar mid-drive. The flushed local
+        # LRU forces every miss through the (dying) shared tier; the
+        # contract is zero client failures and recorded degrades.
+        router_a._cache.flush(reason="explicit")
+        before_a = shared_outcomes(router_a)
+        drive(router_a, kill_at=max(1, queries // 3))
+        after_a = shared_outcomes(router_a)
+        degrades = sum(
+            after_a.get(k, 0) - before_a.get(k, 0)
+            for k in ("error", "open", "put_error")
+        )
+        report["degradesRecorded"] = degrades
+        byte_identical = True
+        for key in keys[:6]:
+            status, _h, body = _post_raw(
+                f"127.0.0.1:{router_a.bound_port}", payloads[key]
+            )
+            if status != 200 or body != reference[key]:
+                byte_identical = False
+        report["byteIdenticalAfterKill"] = byte_identical
+
+        # -- phase C: restart the sidecar on the SAME port; the breaker
+        # re-probes after its cooldown and shared hits resume
+        sidecar = SharedCacheServer(
+            ip="127.0.0.1", port=sidecars[-1].bound_port
+        )
+        sidecar.start_background()
+        sidecars.append(sidecar)
+        time.sleep(0.4)  # past the drill breaker's reset window
+        router_a._cache.flush(reason="explicit")
+        drive(router_a)  # refills sidecar through the put path
+        router_a._cache.flush(reason="explicit")
+        before_a = shared_outcomes(router_a)
+        drive(router_a)
+        after_a = shared_outcomes(router_a)
+        report["recoveredSharedHits"] = (
+            after_a.get("hit", 0) - before_a.get("hit", 0)
+        )
+        # a router booted NOW pre-fills from the sidecar's top keys
+        router_c = make_router()
+        warmed = router_c.warm_from_shared()
+        report["warmedEntries"] = warmed
+        warm_key = keys[0]
+        status, h, body = _post_raw(
+            f"127.0.0.1:{router_c.bound_port}", payloads[warm_key]
+        )
+        report["warmServesLocalHit"] = bool(
+            status == 200
+            and h.get(CACHE_HEADER.lower()) == "hit"
+            and body == reference[warm_key]
+        )
+
+        # -- phase D: pushed invalidation — a rollout flip must land on
+        # every router within push latency, with the poll 300s away
+        backends[0].rollout.start(
+            candidate_instance_id=candidate_id,
+            percent=percent,
+            gates={
+                "min_samples": 1_000_000, "window_s": 1e9,
+                "shadow_hold_s": 1e9, "canary_hold_s": 1e9,
+                "max_divergence": 1.0, "max_p99_latency_ratio": 1e9,
+            },
+        )
+        backends[0].rollout.promote("shared-cache drill: -> canary")
+        backends[1].rollout.resume()  # second replica re-reads the plan
+        plan = backends[0].rollout.plan
+        deadline = time.monotonic() + 2.0
+        flushed = False
+        while time.monotonic() < deadline and not flushed:
+            flushed = all(
+                any(
+                    labels.get("source") == "push" and value > 0
+                    for labels, value in parse_text(
+                        render(r.metrics)
+                    ).get("pio_router_epoch_events_total", [])
+                )
+                for r in (router_a, router_b, router_c)
+            )
+            if not flushed:
+                time.sleep(0.05)
+        report["pushFlushObserved"] = flushed
+        stale = 0
+        for router in (router_a, router_b, router_c):
+            for key in keys:
+                status, headers, _body = _post_raw(
+                    f"127.0.0.1:{router.bound_port}", payloads[key]
+                )
+                if status != 200:
+                    report["clientFailures"] += 1
+                    continue
+                expected = variant_for_key(
+                    plan.salt,
+                    sticky_key({"user": key, "num": 5}),
+                    plan.percent,
+                )
+                if headers.get(VARIANT_HEADER.lower()) != expected:
+                    stale += 1
+        report["staleAfterRollout"] = stale
+        snap = router_a._cache.snapshot()
+        report["epochInvalidations"] = snap.get("invalidations", {}).get(
+            "epoch", 0
+        )
+        hedges: Dict[str, float] = {}
+        for labels, value in parse_text(render(router_a.metrics)).get(
+            "pio_router_hedges_total", []
+        ):
+            hedges[labels.get("outcome", "-")] = value
+        report["hedges"] = hedges
+        report["ok"] = bool(
+            report["clientFailures"] == 0
+            and cross_router
+            and byte_identical
+            and degrades > 0
+            and report["recoveredSharedHits"] > 0
+            and warmed > 0
+            and report["warmServesLocalHit"]
+            and flushed
+            and stale == 0
+            and report["epochInvalidations"] > 0
+            and report["sharedHitRate"] > 0.3
+        )
+        return report
+    finally:
+        regmod._default_registry = prev_registry
+        for srv in [*routers, *backends, *sidecars]:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _post_raw(node: str, payload: bytes):
     """One POST /queries.json against ``host:port`` → (status, headers
     dict lowercase, raw body BYTES). The cached-hot-set drive compares
@@ -3050,6 +3441,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "byte-identical hit bodies, and zero stale "
                         "responses across a mid-drive rollout stage "
                         "transition (the BENCH cachedFleet block)")
+    p.add_argument("--shared-cache-drill", action="store_true",
+                   help="kill-the-tier acceptance drive (docs/fleet.md"
+                        "#shared-cache-tier): two routers share one "
+                        "sharedcache sidecar with pushed invalidation "
+                        "and hedging armed; the sidecar is hard-killed "
+                        "mid-Zipfian-drive — acceptance is zero client "
+                        "failures, zero stale responses, recorded "
+                        "degrades, recovery + warming after restart, "
+                        "and a rollout flip landing by push with the "
+                        "plan poll minutes away (the BENCH sharedCache "
+                        "block)")
     p.add_argument("--partitions", type=int, default=None, metavar="N",
                    help="partitioned write-path chaos scenario "
                         "(docs/storage.md#partitioning): N in-process "
@@ -3134,6 +3536,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         enable_compilation_cache()
         result = run_cached_hot_set(queries=args.queries)
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+
+    if args.shared_cache_drill:
+        from ..utils.jax_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        result = run_shared_cache_drill(queries=args.queries)
         print(json.dumps(result))
         return 0 if result["ok"] else 1
 

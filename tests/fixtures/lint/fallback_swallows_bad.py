@@ -1,0 +1,26 @@
+"""Fixture: robust-fallback-swallows MUST fire on both swallow shapes."""
+
+
+class TieredReader:
+    def __init__(self, primary, cache):
+        self.primary = primary
+        self.cache = cache
+        self.degraded = False
+
+    def read_with_fallback(self, key):
+        # shape 1: the function NAME advertises the degrade path, yet
+        # the handler drops the primary's exception on the floor — the
+        # fallback works, nothing pages, the primary is silently dead
+        try:
+            return self.primary.read(key)
+        except Exception:  # BAD: fallback handler swallows the failure
+            return self.cache.read(key)
+
+    def read(self, key):
+        # shape 2: the handler body itself advertises the degrade (the
+        # `degraded` flag) but still records nothing about WHY
+        try:
+            return self.primary.read(key)
+        except Exception:  # BAD: degrade flagged, failure unrecorded
+            self.degraded = True
+            return self.cache.read(key)

@@ -1,0 +1,37 @@
+"""Clean twin: degrade paths that RECORD the primary failure do not fire."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class TieredReader:
+    def __init__(self, primary, cache):
+        self.primary = primary
+        self.cache = cache
+        self.degraded = False
+        self.last_error = None
+
+    def read_with_fallback(self, key):
+        # the degrade leaves a trace: the exception is kept and logged
+        # before the fallback answers (fleet/sharedcache.py's
+        # _record_degrade shape)
+        try:
+            return self.primary.read(key)
+        except Exception as exc:
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            logger.warning("primary read failed; serving from cache: %s", exc)
+            return self.cache.read(key)
+
+    def read(self, key):
+        # counting the degrade is recording too — the counter IS the
+        # page-able signal
+        try:
+            return self.primary.read(key)
+        except Exception:
+            self.degraded = True
+            self.count_degrade("primary_error")
+            return self.cache.read(key)
+
+    def count_degrade(self, outcome):
+        logger.debug("degrade outcome: %s", outcome)

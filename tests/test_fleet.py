@@ -24,7 +24,7 @@ from predictionio_tpu.fleet.router import (
 )
 from predictionio_tpu.rollout.plan import bucket_for_key
 from predictionio_tpu.testing.clock import FakeClock
-from predictionio_tpu.utils.resilience import Deadline
+from predictionio_tpu.utils.resilience import CircuitBreaker, Deadline
 
 
 # ---------------------------------------------------------------------------
@@ -766,6 +766,305 @@ class TestRouterCacheUnits:
             assert off.status_json()["cache"] == {"enabled": False}
         finally:
             off.server_close()
+
+
+class TestRouterHedging:
+    """The hedging budget math (docs/fleet.md#hedging): the hedge leg
+    is funded from the deadline budget REMAINING at fire time, never
+    fires below the leg minimum or into an open breaker, and the
+    abandoned loser is counted on ``pio_router_hedges_total``."""
+
+    def _hedges(self, router):
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        return {
+            labels["outcome"]: v
+            for labels, v in parse_text(render(router.metrics)).get(
+                "pio_router_hedges_total", []
+            )
+        }
+
+    def _warm(self, router, delay_s=0.02):
+        for _ in range(router._hedge.min_samples):
+            router._hedge.observe(delay_s)
+
+    def test_cold_tracker_never_hedges(self):
+        """Hedging is ON by default but a cold router has no tail to
+        read: the first position degrades to the plain sequential
+        attempt, one leg, no hedge bookkeeping."""
+        router, _clock = _cached_router(backends=("h1:1", "h2:2"))
+        seen = []
+
+        def leg(backend, *_a, **_k):
+            seen.append(backend)
+            return 200, {"n": 1}, {}
+
+        router._leg = leg
+        try:
+            assert router._hedge is not None
+            assert router._hedge.delay_s() is None
+            consumed, verdicts = router._hedged_first(
+                ("h1:1", "h2:2"), b"{}", None, None
+            )
+            assert (consumed, verdicts[0][0]) == (1, "ok")
+            assert seen == ["h1:1"]
+            assert self._hedges(router) == {}
+        finally:
+            router.server_close()
+
+    def test_hedge_fires_on_the_remaining_split_and_counts_the_loser(self):
+        """A primary past the p9x delay fires ONE hedge leg; the hedge
+        is funded with the ring positions remaining at fire time (the
+        primary keeps the full split it was launched with), the first
+        answer wins, and the abandoned loser is counted."""
+        import threading
+
+        router, _clock = _cached_router(backends=("h1:1", "h2:2"))
+        self._warm(router, 0.02)
+        block = threading.Event()
+        calls = []
+
+        def leg(backend, raw, deadline, attempts_left, trace_id):
+            calls.append((backend, attempts_left))
+            if backend == "h1:1":
+                block.wait(5.0)
+                return 200, {"from": "primary"}, {}
+            return 200, {"from": "hedge"}, {}
+
+        router._leg = leg
+        try:
+            consumed, verdicts = router._hedged_first(
+                ("h1:1", "h2:2"), b"{}", None, None
+            )
+            assert (consumed, verdicts[0][0]) == (2, "ok")
+            assert verdicts[0][1][1] == {"from": "hedge"}
+            # launch split: primary got both positions' budget share,
+            # the hedge leg only what REMAINED at fire time
+            assert ("h1:1", 2) in calls and ("h2:2", 1) in calls
+            hedges = self._hedges(router)
+            assert hedges.get("fired") == 1.0
+            assert hedges.get("hedge_won") == 1.0
+            assert hedges.get("loser_cancelled") == 1.0
+        finally:
+            block.set()
+            time.sleep(0.05)
+            router.server_close()
+
+    def test_primary_win_still_counts_the_hedged_loser(self):
+        import threading
+
+        router, _clock = _cached_router(backends=("h1:1", "h2:2"))
+        self._warm(router, 0.02)
+        block = threading.Event()
+
+        def leg(backend, raw, deadline, attempts_left, trace_id):
+            if backend == "h1:1":
+                time.sleep(0.08)
+                return 200, {"from": "primary"}, {}
+            block.wait(5.0)
+            return 200, {"from": "hedge"}, {}
+
+        router._leg = leg
+        try:
+            consumed, verdicts = router._hedged_first(
+                ("h1:1", "h2:2"), b"{}", None, None
+            )
+            assert (consumed, verdicts[0][0]) == (2, "ok")
+            assert verdicts[0][1][1] == {"from": "primary"}
+            hedges = self._hedges(router)
+            assert hedges.get("fired") == 1.0
+            assert hedges.get("primary_won") == 1.0
+            assert hedges.get("loser_cancelled") == 1.0
+        finally:
+            block.set()
+            time.sleep(0.05)
+            router.server_close()
+
+    def test_hedge_never_fires_below_the_leg_minimum(self):
+        """Below ``hedge_leg_min_s`` of remaining deadline the hedge is
+        denied and counted — a doomed duplicate would only split
+        starvation two ways. The primary still answers."""
+        router, _clock = _cached_router(
+            backends=("h1:1", "h2:2"), hedge_leg_min_s=10.0
+        )
+        self._warm(router, 0.02)
+        calls = []
+
+        def leg(backend, raw, deadline, attempts_left, trace_id):
+            calls.append(backend)
+            if backend == "h1:1":
+                time.sleep(0.06)
+            return 200, {"n": 1}, {}
+
+        router._leg = leg
+        try:
+            consumed, verdicts = router._hedged_first(
+                ("h1:1", "h2:2"), b"{}", Deadline.after_ms(5000.0), None
+            )
+            assert (consumed, verdicts[0][0]) == (1, "ok")
+            assert calls == ["h1:1"]  # the second leg never launched
+            hedges = self._hedges(router)
+            assert hedges.get("budget_denied") == 1.0
+            assert "fired" not in hedges
+        finally:
+            router.server_close()
+
+    def test_open_breaker_denies_the_hedge(self):
+        """A hedge into an open breaker is a guaranteed-loser duplicate:
+        denied, counted, and the primary is simply awaited."""
+        router, _clock = _cached_router(backends=("h1:1", "h2:2"))
+        self._warm(router, 0.02)
+        breaker = router.breakers["h2:2"]
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        calls = []
+
+        def leg(backend, raw, deadline, attempts_left, trace_id):
+            calls.append(backend)
+            if backend == "h1:1":
+                time.sleep(0.06)
+            return 200, {"n": 1}, {}
+
+        router._leg = leg
+        try:
+            consumed, verdicts = router._hedged_first(
+                ("h1:1", "h2:2"), b"{}", None, None
+            )
+            assert (consumed, verdicts[0][0]) == (1, "ok")
+            assert calls == ["h1:1"]
+            hedges = self._hedges(router)
+            assert hedges.get("breaker_denied") == 1.0
+            assert "fired" not in hedges
+        finally:
+            router.server_close()
+
+
+class _FakeSubscriber:
+    """Just enough ChangefeedSubscriber surface for the watchdog pin."""
+
+    def __init__(self, alive=True):
+        self.live = alive
+        self.stopped = False
+
+    def alive(self):
+        return self.live
+
+    def status(self):
+        return {"alive": self.live, "fetches": 1, "lastError": None}
+
+    def stop(self):
+        self.stopped = True
+
+
+class TestPushPlaneFallback:
+    """The push-plane headroom fix: a LIVE subscriber stretches the
+    poll to the watchdog cadence, a dead or wedged one silently
+    restores ``plan_refresh_s`` — the epoch can never freeze behind a
+    stuck push plane — and the state is visible on /router.json."""
+
+    def _events(self, router):
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        return {
+            labels["source"]: v
+            for labels, v in parse_text(render(router.metrics)).get(
+                "pio_router_epoch_events_total", []
+            )
+        }
+
+    def test_wedged_subscriber_never_freezes_the_epoch(self):
+        registry = _FakeRegistry()
+        router, _clock = _cached_router(
+            registry=registry, push_watchdog_s=30.0
+        )
+        router._subscriber = _FakeSubscriber(alive=True)
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            # the epoch moves but no push event arrives: a subscriber
+            # that CLAIMS to be healthy holds the poll to the watchdog
+            # cadence, so the stale hit survives (push owns freshness)
+            registry.latest = _FakeInstance("EI-2")
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            assert router.status_json()["epochSource"] == "push"
+            # the subscriber wedges: the VERY NEXT read re-decides the
+            # cadence, polls, and sees the move — no push event, no
+            # watchdog wait, no frozen epoch
+            router._subscriber.live = False
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+            out = router.status_json()
+            assert out["epochSource"] == "poll"
+            assert out["subscriber"]["alive"] is False
+            assert self._events(router).get("poll") == 1.0
+        finally:
+            router.server_close()
+
+    def test_watchdog_poll_still_runs_behind_a_live_push_plane(self):
+        registry = _FakeRegistry()
+        router, clock = _cached_router(
+            registry=registry, push_watchdog_s=30.0
+        )
+        router._subscriber = _FakeSubscriber(alive=True)
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            registry.latest = _FakeInstance("EI-2")
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"  # inside the watchdog window
+            clock.advance(30.5)
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"  # the watchdog poll caught it
+            assert self._events(router).get("poll") == 1.0
+        finally:
+            router.server_close()
+
+    def test_pushed_op_flushes_without_waiting_for_any_poll(self):
+        registry = _FakeRegistry()
+        router, _clock = _cached_router(
+            registry=registry, push_watchdog_s=30.0
+        )
+        router._subscriber = _FakeSubscriber(alive=True)
+        router._leg = lambda *a, **k: (200, {"n": 1}, {"x-pio-variant": "-"})
+        try:
+            router.route_query(b'{"user": "u1"}', None)
+            registry.latest = _FakeInstance("EI-2")
+            info: dict = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+            # the changefeed delivers the instance insert: the flush is
+            # immediate and counted against the push source
+            router._on_meta_ops(
+                [{"kind": "meta", "method": "engine_instance_insert"}],
+                gap=False,
+            )
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "miss"
+            assert self._events(router) == {"push": 1.0}
+            # a non-epoch op (an event append) flushes nothing
+            router._on_meta_ops([{"kind": "event", "id": "x"}], gap=False)
+            info = {}
+            router.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+        finally:
+            router.server_close()
+
+    def test_subscriber_stops_with_the_server(self):
+        router, _clock = _cached_router()
+        sub = _FakeSubscriber(alive=True)
+        router._subscriber = sub
+        router.server_close()
+        assert sub.stopped is True
 
 
 class TestShardReplicaUnits:
@@ -1651,3 +1950,101 @@ class TestCacheLedger:
 
         record = bench_to_record(self.BENCH)
         assert record["extra"]["cachedFleet"]["hitRate"] == 0.85
+
+
+class TestSharedCacheLedger:
+    BENCH = {
+        "metric": "ml20m_als_rank50_train_s",
+        "value": 12.0,
+        "unit": "s",
+        "device": "TFRT_CPU_0",
+        "scale": 0.01,
+        "sharedCache": {
+            "healthyQPS": 900.0,
+            "hedgedP99Ms": 18.0,
+            "sharedHitRate": 0.8,
+            "degradesRecorded": 12,
+            "byteIdenticalAfterKill": True,
+            "staleAfterRollout": 0,
+            "clientFailures": 0,
+            "warmedEntries": 20,
+            "ok": True,
+        },
+    }
+
+    def test_shared_cache_records_shape(self):
+        from predictionio_tpu.obs.perfledger import shared_cache_records
+
+        by_metric = {
+            r["metric"]: r for r in shared_cache_records(self.BENCH)
+        }
+        p99 = by_metric["fleet_hedged_p99_s"]
+        assert p99["unit"] == "s" and p99["value"] == pytest.approx(0.018)
+        assert p99["noise_band"] == pytest.approx(0.5)
+        assert p99["extra"]["sharedHitRate"] == pytest.approx(0.8)
+        hit = by_metric["fleet_shared_hit_rate"]
+        assert hit["unit"] == "ratio"  # trend-only: the gate compares "s"
+        assert hit["value"] == pytest.approx(0.8)
+
+    def test_failed_drill_records_nothing(self):
+        from predictionio_tpu.obs.perfledger import shared_cache_records
+
+        bad = dict(self.BENCH, sharedCache={"ok": False, "hedgedP99Ms": 1.0})
+        assert shared_cache_records(bad) == []
+        assert shared_cache_records({"metric": "x", "value": 1.0}) == []
+
+    def test_shared_records_never_gate_the_other_fleet_records(self):
+        """Comparable-key separation: the hedged p99 gates only against
+        its own history, never the cached or uncached serving tails."""
+        from predictionio_tpu.obs.perfledger import (
+            cache_records,
+            comparable_key,
+            fleet_records,
+            shared_cache_records,
+        )
+
+        shared_keys = {
+            comparable_key(r) for r in shared_cache_records(self.BENCH)
+        }
+        other_keys = {
+            comparable_key(r)
+            for r in cache_records(TestCacheLedger.BENCH)
+        } | {
+            comparable_key(r)
+            for r in fleet_records(TestFleetLedger.BENCH)
+        }
+        assert shared_keys and shared_keys.isdisjoint(other_keys)
+
+    def test_gate_fires_on_hedged_p99_collapse_only(self):
+        from predictionio_tpu.obs.perfledger import (
+            detect_regressions,
+            shared_cache_records,
+        )
+
+        def history(p99s):
+            out = []
+            for p99 in p99s:
+                bench = dict(
+                    self.BENCH,
+                    sharedCache=dict(
+                        self.BENCH["sharedCache"], hedgedP99Ms=p99
+                    ),
+                )
+                out.extend(shared_cache_records(bench))
+            return out
+
+        flat = [18.0, 20.0, 19.0]
+        assert detect_regressions(history(flat)) == []
+        # +40% is inside the declared 0.5 band (CI weather)...
+        assert detect_regressions(history(flat + [26.0])) == []
+        # ...a 2.2x collapse fires
+        flagged = detect_regressions(history(flat + [42.0]))
+        assert [f["key"]["metric"] for f in flagged] == [
+            "fleet_hedged_p99_s"
+        ]
+
+    def test_bench_record_carries_shared_block(self):
+        from predictionio_tpu.obs.perfledger import bench_to_record
+
+        record = bench_to_record(self.BENCH)
+        assert record["extra"]["sharedCache"]["sharedHitRate"] == 0.8

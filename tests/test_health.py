@@ -843,6 +843,9 @@ def boot_metrics(tmp_path_factory):
                 DashboardConfig(ip="127.0.0.1", port=0), registry
             )
         )
+        from predictionio_tpu.fleet.sharedcache import SharedCacheServer
+
+        servers.append(SharedCacheServer(ip="127.0.0.1", port=0))
 
         class TypedAlgo(Algo0):
             def query_class(self):

@@ -178,7 +178,8 @@ class TestRobustFixtures:
         "fixture",
         ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
          "rename_no_fsync_clean.py", "unbounded_retry_clean.py",
-         "unbounded_cache_clean.py", "cutover_no_watermark_clean.py"],
+         "unbounded_cache_clean.py", "cutover_no_watermark_clean.py",
+         "fallback_swallows_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)
@@ -236,6 +237,65 @@ class TestRobustFixtures:
                 if "# BAD:" in line
             ]
         assert sorted(f.line for f in findings) == marked
+
+    def test_fallback_swallows_bad_fires_on_both_shapes(self):
+        """The bad twin carries TWO swallow shapes (function named for
+        the fallback, handler that flips a ``degraded`` flag); each
+        fires exactly robust-fallback-swallows at its marked except
+        line."""
+        path = os.path.join(FIXTURES, "fallback_swallows_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [
+            "robust-fallback-swallows", "robust-fallback-swallows"
+        ], [(f.rule_id, f.line) for f in findings]
+        with open(path) as fh:
+            marked = [
+                lineno for lineno, line in enumerate(fh, start=1)
+                if "# BAD:" in line
+            ]
+        assert sorted(f.line for f in findings) == marked
+
+    def test_sharedcache_degrade_is_the_clean_exemplar(self, package_result):
+        """fleet/sharedcache.py's client IS wall-to-wall degrade paths
+        (every handler calls _record_degrade, so the name gate engages
+        on each one) yet carries zero findings: the outcome counter,
+        the lastError capture and the debug log are exactly the
+        recording evidence the rule demands."""
+        findings = _package_findings(
+            package_result, "fleet/sharedcache.py",
+            "robust-fallback-swallows",
+        )
+        assert findings == [], (
+            f"fleet/sharedcache.py regressed its exemplar status: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_sharedcache_mutated_swallow_is_caught(self):
+        """Strip ONE degrade site of its recording (swap the
+        _record_degrade call for a bare advisory-named helper, drop the
+        bound exception) and the rule bites — proof the exemplar above
+        is load-bearing, not accidentally exempt."""
+        path = os.path.join(
+            PACKAGE, "fleet", "sharedcache.py"
+        )
+        with open(path) as fh:
+            source = fh.read()
+        anchor = (
+            "except CircuitOpen as exc:\n"
+            '            return self._record_degrade("open", exc)'
+        )
+        mutated = source.replace(
+            anchor,
+            "except CircuitOpen:\n"
+            "            return self._advisory_miss()",
+            1,
+        )
+        assert mutated != source, "mutation anchor drifted out of source"
+        findings = [
+            f for f in lint_file(path, source=mutated)
+            if f.rule_id == "robust-fallback-swallows" and not f.suppressed
+        ]
+        assert len(findings) == 1, [(f.rule_id, f.line) for f in findings]
 
     def test_migration_cutover_is_the_clean_exemplar(self, package_result):
         """storage/migration.py's cutover() IS a layout flip (the name

@@ -1,0 +1,363 @@
+"""The fleet's shared cache tier (``fleet/sharedcache``,
+docs/fleet.md#shared-cache-tier).
+
+Three layers:
+
+1. **Sidecar server**: the HTTP surface (lookup/put/flush/top/status)
+   and its epoch-checked reads.
+2. **Advisory client**: the degrade contract — any doubt (dead sidecar,
+   open breaker, epoch skew) is a RECORDED miss, never a stale serve
+   and never a client-visible failure.
+3. **Router integration**: cross-router reuse with local promotion,
+   negative caching, cache warming on deploy, and the kill-the-tier
+   acceptance drill (``loadgen --shared-cache-drill``).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import pytest
+
+from predictionio_tpu.fleet.router import RouterConfig, RouterServer
+from predictionio_tpu.fleet.sharedcache import (
+    SHARED_OUTCOMES,
+    SharedCacheClient,
+    SharedCacheServer,
+)
+from predictionio_tpu.testing.clock import FakeClock
+from predictionio_tpu.utils.resilience import CircuitBreaker
+
+
+@pytest.fixture()
+def sidecar():
+    server = SharedCacheServer(ip="127.0.0.1", port=0)
+    server.start_background()
+    yield server
+    server.kill()
+
+
+def _client(server, **kw):
+    return SharedCacheClient(f"127.0.0.1:{server.bound_port}", **kw)
+
+
+def _raw(port, method, path, payload=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2.0)
+    try:
+        body = payload if isinstance(payload, bytes) else (
+            json.dumps(payload).encode() if payload is not None else None
+        )
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def _mini_router(shared=None, clock=None, **kw):
+    kw.setdefault("cache_enabled", True)
+    kw.setdefault("cache_ttl_s", 30.0)
+    kw.setdefault("plan_refresh_s", 0.0)
+    kw.setdefault("engine_id", "eng")
+    if shared is not None:
+        kw.setdefault("shared_cache", f"127.0.0.1:{shared.bound_port}")
+        kw.setdefault("shared_warm", False)
+    cfg = RouterConfig(
+        ip="127.0.0.1", port=0, backends=kw.pop("backends", ("h1:1",)), **kw
+    )
+    return RouterServer(cfg, clock=clock or FakeClock())
+
+
+class TestSidecarServer:
+    def test_put_lookup_roundtrip_is_epoch_checked(self, sidecar):
+        client = _client(sidecar)
+        key = ("-", '{"user":"u1"}')
+        assert client.put(key, {"n": 1}, "baseline", "E1") is True
+        entry = client.lookup(key, "E1")
+        assert entry is not None
+        assert entry.body == {"n": 1} and entry.variant == "baseline"
+        # a lookup under another epoch is a miss AND drops the entry
+        # server-side — the tier never carries answers across epochs
+        assert client.lookup(key, "E2") is None
+        assert len(sidecar.cache) == 0
+        assert client.outcomes == {"put": 1, "hit": 1, "miss": 1}
+
+    def test_flush_and_top_routes(self, sidecar):
+        client = _client(sidecar)
+        client.put(("-", "q1"), {"n": 1}, None, "E1")
+        client.put(("-", "q2"), {"n": 2}, None, "E1")
+        client.lookup(("-", "q1"), "E1")  # q1 now the hotter entry
+        top = client.top(10)
+        assert [item["query"] for item in top] == ["q1", "q2"]
+        assert top[0]["hits"] == 1 and top[0]["epoch"] == "E1"
+        assert client.flush(reason="test") == 2
+        assert client.top(10) == []
+
+    def test_status_and_error_routes(self, sidecar):
+        port = sidecar.bound_port
+        status, body = _raw(port, "GET", "/status.json")
+        assert status == 200 and body["server"] == "sharedcache"
+        assert body["cache"]["entries"] == 0
+        status, body = _raw(port, "GET", "/cache/top?n=junk")
+        assert status == 400
+        status, body = _raw(port, "GET", "/nope")
+        assert status == 404
+        status, body = _raw(port, "POST", "/cache/lookup", b"not json")
+        assert status == 400
+        status, body = _raw(port, "POST", "/cache/lookup", payload=[1, 2])
+        assert status == 400
+
+    def test_sidecar_metrics_move(self, sidecar):
+        from predictionio_tpu.obs.expo import parse_text, render
+
+        client = _client(sidecar)
+        client.put(("-", "q1"), {"n": 1}, None, "E1")
+        client.lookup(("-", "q1"), "E1")
+        client.lookup(("-", "q9"), "E1")
+        client.lookup(("-", "q1"), "E2")  # epoch drop
+        scraped = parse_text(render(sidecar.metrics))
+        lookups = {
+            labels["outcome"]: v
+            for labels, v in scraped["pio_sharedcache_lookups_total"]
+        }
+        assert lookups == {"hit": 1.0, "miss": 2.0}
+        reasons = {
+            labels["reason"]: v
+            for labels, v in scraped["pio_sharedcache_invalidations_total"]
+        }
+        assert reasons.get("epoch") == 1.0
+        assert scraped["pio_sharedcache_entries"] == [({}, 0.0)]
+
+
+class TestAdvisoryClient:
+    def test_dead_sidecar_degrades_to_recorded_miss(self):
+        server = SharedCacheServer(ip="127.0.0.1", port=0)
+        server.start_background()
+        client = _client(server)
+        server.kill()
+        assert client.lookup(("-", "q"), "E1") is None
+        assert client.put(("-", "q"), {"n": 1}, None, "E1") is False
+        assert client.flush() is None
+        assert client.top() == []
+        out = client.status()
+        assert out["outcomes"]["error"] >= 2
+        assert out["outcomes"]["put_error"] == 1
+        assert out["lastError"]  # the degrade is visible, never silent
+
+    def test_open_breaker_short_circuits_to_recorded_miss(self):
+        server = SharedCacheServer(ip="127.0.0.1", port=0)
+        server.start_background()
+        breaker = CircuitBreaker.from_env(
+            "sharedcache-test",
+            env={"PIO_BREAKER_FAILURES": "1", "PIO_BREAKER_RESET_S": "60"},
+        )
+        client = _client(server, breaker=breaker)
+        server.kill()
+        assert client.lookup(("-", "q"), "E1") is None  # trips the breaker
+        assert client.lookup(("-", "q"), "E1") is None  # short-circuited
+        assert client.outcomes.get("error") == 1
+        assert client.outcomes.get("open") == 1
+        assert client.status()["breaker"]["state"] == CircuitBreaker.OPEN
+
+    def test_skewed_sidecar_answer_is_dropped_locally(self, sidecar):
+        """Belt and braces: even if a (buggy) sidecar answered across
+        epochs, the client drops the entry locally and counts the
+        skew — a stale serve needs BOTH sides wrong at once."""
+        client = _client(sidecar)
+        client._request = lambda *a, **k: {
+            "found": True, "body": {"n": 1}, "servedVariant": "-",
+            "epoch": "OTHER", "negative": False,
+        }
+        assert client.lookup(("-", "q"), "E1") is None
+        assert client.outcomes == {"epoch_skew": 1}
+
+    def test_outcome_vocabulary_stays_closed(self, sidecar):
+        """Every counted outcome is in SHARED_OUTCOMES — the vocabulary
+        is a metric label (bounded cardinality, docs/observability.md)."""
+        client = _client(sidecar)
+        client.put(("-", "q"), {"n": 1}, None, "E1")
+        client.lookup(("-", "q"), "E1")
+        client.lookup(("-", "other"), "E1")
+        sidecar.kill()
+        client.lookup(("-", "q"), "E1")
+        assert set(client.outcomes) <= set(SHARED_OUTCOMES)
+
+    def test_lookup_budget_caps_the_socket_timeout(self, sidecar):
+        client = _client(sidecar, timeout_s=0.25)
+        client.put(("-", "q"), {"n": 1}, None, "E1")
+        seen = {}
+        original = client._request
+
+        def spy(method, path, payload=None, timeout_s=None):
+            seen["timeout"] = timeout_s
+            return original(method, path, payload, timeout_s=timeout_s)
+
+        client._request = spy
+        assert client.lookup(("-", "q"), "E1", budget_s=0.05) is not None
+        # the per-call budget undercuts the configured client timeout:
+        # the tier can never blow the caller's remaining deadline
+        assert seen["timeout"] == pytest.approx(0.05)
+
+
+class TestRouterSharedTier:
+    def _leg(self, counter, body=None):
+        def leg(*_a, **_k):
+            counter["n"] += 1
+            return 200, body or {"items": ["a"]}, {"x-pio-variant": "-"}
+
+        return leg
+
+    def test_cross_router_reuse_promotes_to_local(self, sidecar):
+        router_a = _mini_router(shared=sidecar)
+        router_b = _mini_router(shared=sidecar)
+        calls_a, calls_b = {"n": 0}, {"n": 0}
+        router_a._leg = self._leg(calls_a)
+        router_b._leg = self._leg(calls_b)
+        try:
+            info: dict = {}
+            _s, body_a, _v = router_a.route_query(
+                b'{"user": "u1"}', None, info=info
+            )
+            assert info["cache"] == "miss" and calls_a["n"] == 1
+            assert router_a._shared.outcomes.get("put") == 1
+            # a DIFFERENT router answers from the tier without touching
+            # its backend, byte-identical to the filling router
+            info = {}
+            _s, body_b, _v = router_b.route_query(
+                b'{"user": "u1"}', None, info=info
+            )
+            assert info["cache"] == "hit-shared"
+            assert calls_b["n"] == 0
+            assert body_b == body_a
+            # ...and the hit was PROMOTED into b's local LRU
+            info = {}
+            router_b.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit"
+        finally:
+            router_a.server_close()
+            router_b.server_close()
+
+    def test_killed_tier_is_invisible_to_clients(self, sidecar):
+        router = _mini_router(shared=sidecar)
+        calls = {"n": 0}
+        router._leg = self._leg(calls)
+        try:
+            sidecar.kill()
+            info: dict = {}
+            status, body, _v = router.route_query(
+                b'{"user": "u1"}', None, info=info
+            )
+            assert status == 200 and body == {"items": ["a"]}
+            assert info["cache"] == "miss" and calls["n"] == 1
+            out = router.status_json()["sharedCache"]
+            assert out["enabled"] is True
+            assert out["outcomes"].get("error", 0) >= 1
+            assert out["lastError"]
+        finally:
+            router.server_close()
+
+    def test_negative_caching_rides_a_short_fuse(self):
+        clock = FakeClock()
+        router = _mini_router(clock=clock, negative_ttl_s=2.0)
+        calls = {"n": 0}
+
+        def leg(*_a, **_k):
+            calls["n"] += 1
+            return 200, {"itemScores": []}, {"x-pio-variant": "-"}
+
+        router._leg = leg
+        try:
+            info: dict = {}
+            router.route_query(b'{"user": "ghost"}', None, info=info)
+            assert info["cache"] == "miss" and calls["n"] == 1
+            # the known-empty answer IS cached (no punch-through)...
+            info = {}
+            router.route_query(b'{"user": "ghost"}', None, info=info)
+            assert info["cache"] == "hit" and calls["n"] == 1
+            # ...but on the negative fuse, not the cache-wide TTL
+            clock.advance(2.5)
+            info = {}
+            router.route_query(b'{"user": "ghost"}', None, info=info)
+            assert info["cache"] == "miss" and calls["n"] == 2
+        finally:
+            router.server_close()
+
+    def test_negative_flag_travels_through_the_tier(self, sidecar):
+        router_a = _mini_router(shared=sidecar, negative_ttl_s=5.0)
+        router_b = _mini_router(shared=sidecar, negative_ttl_s=5.0)
+        empty = {"itemScores": []}
+        router_a._leg = self._leg({"n": 0}, body=empty)
+        router_b._leg = self._leg({"n": 0}, body=empty)
+        try:
+            router_a.route_query(b'{"user": "ghost"}', None)
+            entry = next(iter(sidecar.cache._cache.values()))
+            assert entry.negative is True and entry.ttl_s == 5.0
+            info: dict = {}
+            _s, body, _v = router_b.route_query(
+                b'{"user": "ghost"}', None, info=info
+            )
+            assert info["cache"] == "hit-shared" and body == empty
+            assert router_b._shared.outcomes.get("negative_hit") == 1
+        finally:
+            router_a.server_close()
+            router_b.server_close()
+
+    def test_warm_from_shared_imports_only_current_epoch(self, sidecar):
+        filler = _mini_router(shared=sidecar)
+        filler._leg = self._leg({"n": 0})
+        try:
+            filler.route_query(b'{"user": "u1"}', None)
+            filler.route_query(b'{"user": "u2"}', None)
+        finally:
+            filler.server_close()
+        # a leftover entry from another epoch must not seed the cache
+        _client(sidecar).put(("-", '{"user":"u3"}'), {"n": 3}, None, "OLD")
+        fresh = _mini_router(shared=sidecar)
+        calls = {"n": 0}
+        fresh._leg = self._leg(calls)
+        try:
+            assert fresh.warm_from_shared() == 2
+            assert fresh.status_json()["sharedCache"]["warmedEntries"] == 2
+            info: dict = {}
+            fresh.route_query(b'{"user": "u1"}', None, info=info)
+            assert info["cache"] == "hit" and calls["n"] == 0
+        finally:
+            fresh.server_close()
+
+    def test_status_json_disabled_block(self):
+        router = _mini_router()
+        try:
+            assert router.status_json()["sharedCache"] == {"enabled": False}
+        finally:
+            router.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the kill-the-tier acceptance drill (loadgen --shared-cache-drill)
+# ---------------------------------------------------------------------------
+
+
+class TestSharedCacheDrill:
+    def test_kill_the_tier_zero_stale_zero_failures(self):
+        from predictionio_tpu.tools.loadgen import run_shared_cache_drill
+
+        report = run_shared_cache_drill(queries=96)
+        assert report["clientFailures"] == 0
+        assert report["crossRouterReuse"] is True
+        assert report["sharedHitRate"] > 0.3
+        # the kill: recorded degrades, byte-identical re-computed
+        # answers, zero client-visible failures
+        assert report["degradesRecorded"] > 0
+        assert report["byteIdenticalAfterKill"] is True
+        # recovery: the restarted tier fills back up and warms a
+        # restarting router into local hits
+        assert report["recoveredSharedHits"] > 0
+        assert report["warmedEntries"] > 0
+        assert report["warmServesLocalHit"] is True
+        # the push plane: the rollout's epoch move arrives pushed and
+        # no router serves a pre-rollout answer
+        assert report["pushFlushObserved"] is True
+        assert report["epochInvalidations"] > 0
+        assert report["staleAfterRollout"] == 0
+        assert report["ok"] is True
