@@ -251,6 +251,12 @@ def _append_ledger(record: dict) -> None:
         # (docs/fleet.md#shared-cache-tier)
         for shared_record in perfledger.shared_cache_records(record):
             perfledger.append_record(path, shared_record)
+        # quantized-serving numbers (BENCH_QUANT block): the int8 table
+        # byte count gated as a deterministic lower-is-better "bytes"
+        # record, the top-k match rate as a trend record
+        # (docs/quantization.md)
+        for quant_record in perfledger.quant_records(record):
+            perfledger.append_record(path, quant_record)
         # model-quality trajectory (score PSI / feedback hit-rate from
         # the feedback-stream drill) rides as trend-only records so
         # `pio perf trend` shows quality next to latency
@@ -363,6 +369,58 @@ def run_lint_sweep() -> dict:
         "ok": bool(
             not cold.errors and not warm.errors and identical
         ),
+    }
+
+
+def run_quant_serve(user_factors, item_factors, k: int = 10) -> dict:
+    """Quantize THIS round's trained item table and measure what the
+    ledger wants to trend: the int8 serving footprint vs its f32 twin
+    (serve_table_bytes, GATED — bytes are deterministic, so any
+    compression regression trips the band) and the exactness-gate
+    match rate (quant_topk_match_rate, trend-only — the id-identity
+    margin the serve lever needs before it can turn on for this
+    recipe). Uses the ungated constructor + gate probe directly: the
+    bench MEASURES the gate margin, it does not refuse on it."""
+    import jax
+
+    from predictionio_tpu.quant import (
+        default_probe_idx,
+        estimate_table_bytes,
+        quantize_table,
+        top_k_quantized,
+        topk_match_gate,
+    )
+
+    user_factors = np.asarray(user_factors, dtype=np.float32)
+    item_factors = np.asarray(item_factors, dtype=np.float32)
+    qtable = quantize_table(item_factors)
+    probe = default_probe_idx(user_factors.shape[0])
+    match_rate = topk_match_gate(
+        user_factors, item_factors, qtable, probe, k
+    )
+    # quantized top-k wall over the probe batch (steady state: second
+    # call, first one pays the jit)
+    top_k_quantized(user_factors, qtable, probe, k)
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        top_k_quantized(user_factors, qtable, probe, k)
+    )
+    topk_s = time.perf_counter() - t0
+    return {
+        "ok": True,
+        "tableDtype": qtable.dtype,
+        "tableBytes": qtable.table_bytes,
+        "f32Bytes": qtable.f32_bytes,
+        "ratio": round(qtable.compression_ratio, 3),
+        "estTableBytes": estimate_table_bytes(
+            qtable.n_rows, qtable.rank, qtable.dtype
+        ),
+        "matchRate": round(match_rate, 4),
+        "probes": int(probe.size),
+        "k": int(min(k, item_factors.shape[0])),
+        "topkS": round(topk_s, 4),
+        "rank": qtable.rank,
+        "nItems": qtable.n_rows,
     }
 
 
@@ -846,6 +904,19 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             record["lintSweep"] = run_lint_sweep()
         except Exception as exc:
             record["lintSweep"] = {"error": str(exc)}
+    # Quantized serving tables (docs/quantization.md): quantize this
+    # round's trained item table, measure the int8 footprint vs the f32
+    # twin (serve_table_bytes, GATED) and the exactness-gate top-k
+    # match rate (trend-only). Opt out with BENCH_QUANT=0; a failure
+    # never fails the bench.
+    if os.environ.get("BENCH_QUANT") != "0":
+        try:
+            record["quantServe"] = run_quant_serve(
+                np.asarray(factors.user_factors),
+                np.asarray(factors.item_factors),
+            )
+        except Exception as exc:
+            record["quantServe"] = {"error": str(exc)}
     _append_ledger(record)
     print(json.dumps(record))
     return 0

@@ -226,14 +226,23 @@ class ResponseCache:
         self._emit(reason, count)
         return count
 
-    def export_top(self, n: int = 50) -> list:
+    def export_top(
+        self, n: int = 50, max_bytes: Optional[int] = None
+    ) -> list:
         """The ``n`` most-hit live entries, hottest first — the warming
         export (docs/fleet.md#shared-cache-tier): a restarting router
         pre-fills its local LRU from this list so the backends never see
         the full hot set again. Entries past their TTL are skipped (not
         dropped — export is a read, never a mutation); negative entries
         ride along with their flag so the importer keeps the short
-        fuse."""
+        fuse.
+
+        ``max_bytes`` caps the export by payload size (body + query
+        bytes): one giant blob with many hits no longer crowds the whole
+        warming budget out — an entry that would overflow the remaining
+        budget is skipped and the scan continues, so smaller but still
+        hot entries behind it make the cut (``PIO_SHAREDCACHE_WARM_BYTES``
+        sets the fleet default — docs/cli.md)."""
         now = self.clock()
         with self._lock:
             live = [
@@ -244,18 +253,37 @@ class ResponseCache:
                 )
             ]
         live.sort(key=lambda item: item[1].hits, reverse=True)
-        return [
-            {
-                "variant": key[0],
-                "query": key[1],
-                "body": entry.body,
-                "servedVariant": entry.variant,
-                "epoch": entry.epoch,
-                "hits": entry.hits,
-                "negative": entry.negative,
-            }
-            for key, entry in live[: max(0, int(n))]
-        ]
+        out: list = []
+        remaining = None if max_bytes is None else max(0, int(max_bytes))
+        for key, entry in live:
+            if len(out) >= max(0, int(n)):
+                break
+            if remaining is not None:
+                # cost = what the wire carries: serialized body + query
+                try:
+                    body_len = len(
+                        json.dumps(
+                            entry.body, separators=(",", ":"), default=str
+                        )
+                    )
+                except (TypeError, ValueError):
+                    body_len = len(repr(entry.body))
+                cost = body_len + len(key[1])
+                if cost > remaining:
+                    continue  # too big for what's left; keep scanning
+                remaining -= cost
+            out.append(
+                {
+                    "variant": key[0],
+                    "query": key[1],
+                    "body": entry.body,
+                    "servedVariant": entry.variant,
+                    "epoch": entry.epoch,
+                    "hits": entry.hits,
+                    "negative": entry.negative,
+                }
+            )
+        return out
 
     # -- introspection -----------------------------------------------------
     def __len__(self) -> int:

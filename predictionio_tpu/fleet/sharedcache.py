@@ -39,6 +39,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -91,7 +92,29 @@ class SharedCacheHandler(JsonHTTPHandler):
             except ValueError:
                 self.respond(400, {"error": "n must be an integer"})
                 return
-            self.respond(200, {"entries": self.server.cache.export_top(n)})
+            # byte budget for the warming export: explicit maxBytes
+            # query param wins, PIO_SHAREDCACHE_WARM_BYTES is the fleet
+            # default, unset = unbounded (docs/cli.md)
+            raw_budget = query.get("maxBytes", [None])[0]
+            if raw_budget is None:
+                raw_budget = os.environ.get("PIO_SHAREDCACHE_WARM_BYTES")
+            max_bytes: Optional[int] = None
+            if raw_budget not in (None, ""):
+                try:
+                    max_bytes = int(raw_budget)
+                except ValueError:
+                    self.respond(
+                        400, {"error": "maxBytes must be an integer"}
+                    )
+                    return
+            self.respond(
+                200,
+                {
+                    "entries": self.server.cache.export_top(
+                        n, max_bytes=max_bytes
+                    )
+                },
+            )
         else:
             self.respond(404, {"error": f"no route {parts.path}"})
 
@@ -420,16 +443,22 @@ class SharedCacheClient:
         self.breaker.record_success()
         return int(out.get("flushed", 0))
 
-    def top(self, n: int = 50) -> list:
+    def top(self, n: int = 50, max_bytes: Optional[int] = None) -> list:
         """The sidecar's hottest entries (the warming export); an empty
-        list on any doubt (recorded) — warming is opportunistic."""
+        list on any doubt (recorded) — warming is opportunistic.
+        ``max_bytes`` forwards a byte budget for the export (the sidecar
+        applies its own ``PIO_SHAREDCACHE_WARM_BYTES`` default when this
+        is None)."""
         try:
             self.breaker.before_call()
         except CircuitOpen as exc:
             self._record_degrade("open", exc)
             return []
+        path = f"/cache/top?n={int(n)}"
+        if max_bytes is not None:
+            path += f"&maxBytes={int(max_bytes)}"
         try:
-            out = self._request("GET", f"/cache/top?n={int(n)}")
+            out = self._request("GET", path)
         except Exception as exc:
             self.breaker.record_failure()
             self._record_degrade("error", exc)

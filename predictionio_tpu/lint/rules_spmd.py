@@ -36,6 +36,11 @@ Mosaic rules, before a pod ever runs:
   trace-time ``TypeError`` — but ONLY when the sharded path actually
   traces, which for mesh-gated trainers is on the hardware day, not at
   your desk.
+- ``spmd-unguarded-downcast``: a cast below f32 (int8/bf16/fp8/...)
+  inside a serve/train/predict-marked function with no gate-shaped
+  check (``*_gate``, ``rmse``, ``topk_match*``, allclose) in the same
+  scope — precision leaves the data path with nothing measuring the
+  cost (docs/quantization.md#gate).
 """
 
 from __future__ import annotations
@@ -719,6 +724,127 @@ class CollectiveMissingAxis(Rule):
                     )
 
 
+#: dtypes narrower than f32 — writing one of these into serve/train
+#: state without a numeric gate is silent precision loss. Index dtypes
+#: (uint16/int32/int64) are deliberately absent: narrowing an *id* is
+#: lossless below the table size, and the gather paths pack ids that
+#: way on purpose.
+_SUB_F32_DTYPES = frozenset(
+    {
+        "int8", "uint8", "int4", "uint4",
+        "bfloat16", "float16", "half",
+        "float8_e4m3fn", "float8_e5m2", "float8_e4m3",
+        "float8_e4m3fnuz", "float8_e5m2fnuz",
+    }
+)
+
+#: substrings that put a function on the serve/train data path — the
+#: scopes where a narrowed value reaches a user or a model ("serv"
+#: catches serve/serving/server)
+_PATH_MARKERS = ("serv", "train", "predict")
+
+
+def _is_gate_call(name: str) -> bool:
+    """Does this call name look like a numeric gate — an exactness or
+    tolerance check that licenses a precision cut in its scope?"""
+    return (
+        name.endswith("_gate")
+        or name == "rmse"
+        or "topk_match" in name
+        or name in ("allclose", "isclose", "assert_allclose")
+    )
+
+
+def _dtype_tail(node: ast.AST) -> str:
+    """The dtype a cast targets, as a bare name: ``jnp.int8`` → "int8",
+    ``"bfloat16"`` → "bfloat16"; "" when not statically resolvable (a
+    variable like ``gdt`` stays silent rather than guessed)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    dn = dotted_name(node)
+    if dn and "." in dn:
+        return dn.rsplit(".", 1)[-1]
+    return ""
+
+
+def _downcast_dtype(node: ast.Call) -> str:
+    """The sub-f32 dtype this call casts to, or "" if it is not a
+    statically-resolvable downcast (``x.astype(jnp.int8)``,
+    ``lax.convert_element_type(x, jnp.bfloat16)``, string forms)."""
+    name = call_name(node)
+    target: Optional[ast.AST] = None
+    if name == "astype":
+        target = node.args[0] if node.args else _kw(node, "dtype")
+    elif name == "convert_element_type":
+        if len(node.args) > 1:
+            target = node.args[1]
+        else:
+            target = _kw(node, "new_dtype")
+    if target is None:
+        return ""
+    tail = _dtype_tail(target)
+    return tail if tail in _SUB_F32_DTYPES else ""
+
+
+class UnguardedDowncast(Rule):
+    """A cast below f32 inside a serve/train/predict-marked function
+    with no gate-shaped call in the same scope: precision left the data
+    path and nothing measured what it cost. The quantization contract
+    (docs/quantization.md) is cut-precision-AND-measure in one scope —
+    ``quant/table.py``'s ``quantize_serving_table`` inlines its int8
+    encode next to ``topk_match_gate`` for exactly this adjacency, and
+    the tests mutation-pin it as the clean exemplar."""
+
+    id = "spmd-unguarded-downcast"
+    severity = "error"
+    short = (
+        "sub-f32 cast (int8/bf16/fp8/...) in a serve/train-marked "
+        "function with no gate-shaped check in scope"
+    )
+    motivation = (
+        "the bf16 bench gate and the int8 serving gate both exist "
+        "because an unmeasured narrowing ships silent accuracy loss; "
+        "a downcast that dodges both is the regression they guard "
+        "against, written fresh"
+    )
+
+    _MARKERS = ("astype", "convert_element_type")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(m in ctx.source for m in self._MARKERS):
+            return
+        for scope in _scopes(ctx.tree):
+            if not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            lowered = scope.name.lower()
+            if not any(m in lowered for m in _PATH_MARKERS):
+                continue
+            if any(
+                isinstance(node, ast.Call)
+                and _is_gate_call(call_name(node))
+                for node in walk_in_scope(scope)
+            ):
+                continue  # a gate in scope licenses the cut
+            for node in walk_in_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                dtype = _downcast_dtype(node)
+                if dtype:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"cast to {dtype} inside "
+                        f"{scope.name!r} with no gate-shaped check "
+                        "(*_gate / rmse / topk_match / allclose) in "
+                        "scope: precision leaves the serve/train path "
+                        "unmeasured — gate the narrowed value against "
+                        "its f32 twin in the same scope "
+                        "(docs/quantization.md#gate).",
+                    )
+
+
 RULES: List[Rule] = [
     CollectiveHostBranch(),
     AxisNameMismatch(),
@@ -727,4 +853,5 @@ RULES: List[Rule] = [
     UnorderedCollectiveOperand(),
     HostDependentRng(),
     CollectiveMissingAxis(),
+    UnguardedDowncast(),
 ]

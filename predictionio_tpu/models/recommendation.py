@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import weakref
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -258,6 +259,23 @@ class ALSAlgorithmParams(Params):
     #: ops.scoring.top_k_for_users_fused (XLA lax.top_k fallback
     #: off-TPU) and /status.json reports the resolved path (topkPath).
     streaming_top_k: str = "auto"
+    #: Serve top-k from an int8-quantized item table (per-row scales,
+    #: docs/quantization.md) — ~4x less serving memory and item-table
+    #: read traffic. Tri-state per the PR-12 lever discipline: explicit
+    #: True/False wins, None resolves from ``PIO_SERVE_QUANT``
+    #: ("1"/"0"), else OFF. Enabling runs the exactness gate at model
+    #: attach (train / fold-in / first serve of a loaded model): the
+    #: quantized top-k ids must match the f32 top-k on a probe set or
+    #: the attach REFUSES loudly (quant.QuantGateError + counted
+    #: metric) — never a silent quality slide. /status.json reports
+    #: dtype, bytes, compression and the gate verdict (quantServing).
+    quantized_serving: Optional[bool] = None
+    #: Exactness-gate bound: minimum fraction of probe users whose
+    #: quantized top-k id set must equal the f32 set. The default (1.0)
+    #: demands identity; lowering it is an explicit operator decision
+    #: (recorded in the gate status), the analogue of the bench's
+    #: BENCH_BF16_RMSE_GATE override.
+    quant_gate_min_match: float = 1.0
 
 
 @dataclasses.dataclass
@@ -287,13 +305,59 @@ class ALSAlgorithm(Algorithm):
     def __init__(self, params: ALSAlgorithmParams = ALSAlgorithmParams()):
         self.params = params
         #: the top-k path the LAST batch actually took ("streaming" |
-        #: "dense"; None before the first query) — the resolved serving
-        #: lever, read by the query server's /status.json
+        #: "dense" | "quant"; None before the first query) — the
+        #: resolved serving lever, read by the query server's
+        #: /status.json
         self._topk_path: Optional[str] = None
+        # quantized-serving state: the gated table for the attached
+        # model (weakref identity — a fold-in's new model re-gates) and
+        # the gate status /status.json surfaces
+        self._quant = None
+        self._quant_model_ref = None
+        self._quant_status: Optional[dict] = None
 
     @property
     def topk_path(self) -> Optional[str]:
         return self._topk_path
+
+    @property
+    def quant_status(self) -> Optional[dict]:
+        """The quantized-serving gate status for the attached model
+        (dtype, bytes, compression, matchRate) — None while the lever
+        is off. Read by /status.json (quantServing)."""
+        return self._quant_status
+
+    def _attach_quant(self, model: ALSModel) -> None:
+        """Resolve the quantized_serving lever against THIS model.
+
+        Runs the exactness gate once per attached model — at train and
+        fold-in return, and on the first serve of a model loaded from
+        the blob store — always BEFORE any quantized answer is
+        produced. A gate refusal propagates (loud + counted, the
+        docs/quantization.md#gate contract); it never falls back to
+        f32 silently."""
+        from ..quant import quantize_serving_table, resolve_quantized_serving
+
+        if not resolve_quantized_serving(self.params.quantized_serving):
+            self._quant = None
+            self._quant_model_ref = None
+            self._quant_status = None
+            return
+        if (
+            self._quant is not None
+            and self._quant_model_ref is not None
+            and self._quant_model_ref() is model
+        ):
+            return
+        qtable, status = quantize_serving_table(
+            model.item_factors,
+            model.user_factors,
+            min_match=self.params.quant_gate_min_match,
+        )
+        status["minMatch"] = self.params.quant_gate_min_match
+        self._quant = qtable
+        self._quant_model_ref = weakref.ref(model)
+        self._quant_status = status
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         p = self.params
@@ -341,13 +405,15 @@ class ALSAlgorithm(Algorithm):
                 cfg=cfg,
                 shards=shards,
             )
-            return ALSModel(
+            model = ALSModel(
                 rank=p.rank,
                 user_factors=np.asarray(factors.user_factors),
                 item_factors=np.asarray(factors.item_factors),
                 user_map=pd.user_map,
                 item_map=pd.item_map,
             )
+            self._attach_quant(model)
+            return model
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None
         if p.checkpoint_every > 0 and ctx is not None:
@@ -370,13 +436,18 @@ class ALSAlgorithm(Algorithm):
             checkpoint=checkpoint,
             checkpoint_every=p.checkpoint_every,
         )
-        return ALSModel(
+        model = ALSModel(
             rank=p.rank,
             user_factors=np.asarray(factors.user_factors),
             item_factors=np.asarray(factors.item_factors),
             user_map=pd.user_map,
             item_map=pd.item_map,
         )
+        # quantized-serving gate at train time (a refusal must surface
+        # here, not on the first query after deploy — the same reasoning
+        # as the use_streaming_topk validation above)
+        self._attach_quant(model)
+        return model
 
     @property
     def fold_in_supported(self) -> bool:
@@ -496,6 +567,10 @@ class ALSAlgorithm(Algorithm):
             rmse_before=before,
             rmse_after=after,
         )
+        # re-gate the folded table: fold-in moved item rows, so the old
+        # quantized table (if any) is stale and the new one must prove
+        # exactness again before it serves
+        self._attach_quant(folded)
         return folded, stats
 
     def fold_in_partitioned(
@@ -660,6 +735,7 @@ class ALSAlgorithm(Algorithm):
             rmse_before=before,
             rmse_after=after,
         )
+        self._attach_quant(folded)  # merged table re-gates (see fold_in)
         return folded, stats, completed
 
     def shard_model(
@@ -718,19 +794,36 @@ class ALSAlgorithm(Algorithm):
             b_pad = pad_pow2(b)
             k_pad = min(pad_pow2(max_k, lo=8), n_items)
             padded_idx = np.pad(user_idx, (0, b_pad - b))
-            # the fused score+select entry dispatches: Pallas streaming
-            # on TPU past the use_streaming_topk bar (the [B, I] score
-            # matrix never exists), XLA score + lax.top_k below it —
-            # record which path serves (resolve_topk_path is the ONE
-            # decision home the entry itself dispatches on, same
-            # (mode, b, n) inputs), surfaced at /status.json
-            self._topk_path = resolve_topk_path(
-                self.params.streaming_top_k, b_pad, n_items
-            )
-            scores, items = top_k_for_users_fused(
-                model.user_factors, model.item_factors, padded_idx,
-                k=k_pad, mode=self.params.streaming_top_k,
-            )
+            # gate-or-refuse BEFORE any answer when the quantized lever
+            # is on and this model (e.g. loaded from the blob store)
+            # has not been gated yet — a query must never be served
+            # from ungated codes
+            self._attach_quant(model)
+            if self._quant is not None:
+                # quantized serving: scores from int8 codes + per-row
+                # scales (quant.top_k_quantized) — licensed by the
+                # exactness gate _attach_quant just ran/cached
+                from ..quant import top_k_quantized
+
+                self._topk_path = "quant"
+                scores, items = top_k_quantized(
+                    model.user_factors, self._quant, padded_idx, k=k_pad
+                )
+            else:
+                # the fused score+select entry dispatches: Pallas
+                # streaming on TPU past the use_streaming_topk bar (the
+                # [B, I] score matrix never exists), XLA score +
+                # lax.top_k below it — record which path serves
+                # (resolve_topk_path is the ONE decision home the entry
+                # itself dispatches on, same (mode, b, n) inputs),
+                # surfaced at /status.json
+                self._topk_path = resolve_topk_path(
+                    self.params.streaming_top_k, b_pad, n_items
+                )
+                scores, items = top_k_for_users_fused(
+                    model.user_factors, model.item_factors, padded_idx,
+                    k=k_pad, mode=self.params.streaming_top_k,
+                )
             # one fetch for both arrays: each device_get is a full host↔
             # device round trip, which dominates per-batch latency on
             # high-latency links (tunneled/remote devices)

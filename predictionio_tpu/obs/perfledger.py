@@ -48,6 +48,7 @@ __all__ = [
     "make_record",
     "migration_records",
     "quality_records",
+    "quant_records",
     "render_trend",
     "shared_cache_records",
     "sharded_records",
@@ -88,8 +89,9 @@ def make_record(
     extra: Optional[dict] = None,
     recorded_at: Optional[float] = None,
 ) -> dict:
-    """One schema-versioned ledger record. ``unit == "s"`` means lower
-    is better (the only unit the regression gate compares)."""
+    """One schema-versioned ledger record. ``unit == "s"`` and
+    ``unit == "bytes"`` mean lower is better (the only units the
+    regression gate compares); everything else is trend-only."""
     record: dict = {
         "schema": SCHEMA_VERSION,
         "source": source,
@@ -143,6 +145,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
                 "iterations", "nnz", "error", "jit", "servingFleet",
                 "quality", "bf16_gate", "ingestScaling", "cachedFleet",
                 "shardedTrain", "migrationDrill", "sharedCache",
+                "quantServe",
             )
             if key in bench
         },
@@ -330,6 +333,61 @@ def shared_cache_records(bench: dict, source: str = "bench") -> List[dict]:
                 unit="ratio",
                 device=bench.get("device"),
                 extra={"degradesRecorded": shared.get("degradesRecorded")},
+            )
+        )
+    return out
+
+
+def quant_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The quantized-serving numbers a bench run attached
+    (``bench["quantServe"]``, from the ``BENCH_QUANT`` block —
+    docs/quantization.md) as their own ledger records:
+
+    - ``serve_table_bytes`` — resident bytes of the int8 serving table
+      (codes + per-row scales), lower-better → GATED: the count is
+      deterministic for a given recipe, so any growth is a real layout
+      regression, not noise. The f32 twin and the compression ratio
+      travel in ``extra`` so ``pio perf trend`` can show the reduction
+      without a second comparable group;
+    - ``quant_topk_match_rate`` — trend-only ``ratio``: the fraction of
+      probe users whose int8 top-k id SET matches f32 exactly. Serving
+      hard-gates this at model load (:class:`~..quant.QuantGateError`);
+      the bench just measures the margin.
+
+    A failed block (``ok`` false or an ``error`` entry) records nothing
+    — its numbers measured a broken table, not the code."""
+    quant = bench.get("quantServe")
+    if not isinstance(quant, dict) or not quant.get("ok"):
+        return []
+    out: List[dict] = []
+    table_bytes = quant.get("tableBytes")
+    if isinstance(table_bytes, (int, float)) and table_bytes > 0:
+        out.append(
+            make_record(
+                source=source,
+                metric="serve_table_bytes",
+                value=float(table_bytes),
+                unit="bytes",
+                device=bench.get("device"),
+                extra={
+                    "ratio": quant.get("ratio"),
+                    "f32Bytes": quant.get("f32Bytes"),
+                    "tableDtype": quant.get("tableDtype"),
+                    "rank": quant.get("rank"),
+                    "nItems": quant.get("nItems"),
+                },
+            )
+        )
+    match_rate = quant.get("matchRate")
+    if isinstance(match_rate, (int, float)):
+        out.append(
+            make_record(
+                source=source,
+                metric="quant_topk_match_rate",
+                value=float(match_rate),
+                unit="ratio",
+                device=bench.get("device"),
+                extra={"probes": quant.get("probes"), "k": quant.get("k")},
             )
         )
     return out
@@ -708,14 +766,15 @@ def _key_dict(key: Tuple) -> dict:
 
 def _gateable_groups(records: List[dict]) -> Dict[Tuple, List[dict]]:
     """Records eligible for the regression gate, grouped by comparable
-    key in given (= chronological) order: lower-is-better seconds only,
+    key in given (= chronological) order: lower-is-better units only
+    (seconds, plus deterministic byte counts like ``serve_table_bytes``),
     failed runs (value -1) and error-carrying runs excluded — a
     quality-gate failure carries a real (positive) wall time but
     measured an invalid run, so it must neither be gated nor pollute a
     baseline median."""
     groups: Dict[Tuple, List[dict]] = {}
     for record in records:
-        if record.get("unit", "s") != "s":
+        if record.get("unit", "s") not in ("s", "bytes"):
             continue
         value = record.get("value")
         if not isinstance(value, (int, float)) or value <= 0:
@@ -786,7 +845,8 @@ def detect_regressions(
 ) -> List[dict]:
     """Per comparable group (records in given = chronological order):
     compare the latest value against the median of its predecessors.
-    Lower-is-better (``unit == "s"`` only; other units are trend-only).
+    Lower-is-better (``unit in ("s", "bytes")``; other units are
+    trend-only).
     A record may carry its own ``noise_band`` (a noisier measurement —
     the fleet drive's small-sample p99); the group's effective band is
     the WIDER of it and the caller's, so a noisy metric can never be

@@ -25,12 +25,16 @@ on ``shard_map`` so the collective schedule is explicit:
    needs the global permutation.
 4. **Off-shard gathers overlapped with solves**: inside the mapped body,
    one tiled ``all_gather`` fetches the opposite table's row shards; each
-   bucket's first gathered slab (``y_full[idx]``) is then issued — in
-   program order, dataflow-independent — BEFORE the previous bucket's
-   solves, a software pipeline XLA's latency-hiding scheduler can overlap
-   on TPU. (At higher shard counts a ragged per-bucket gather of only the
-   referenced rows replaces the dense all-gather — documented as
-   hardware-day headroom in docs/distributed_training.md.)
+   bucket's slab then reads its referenced rows through the shared
+   ragged/deduplicated gather (``quant.ragged_gather`` — each unique row
+   touched once, duplicates replayed via the inverse map; bit-identical
+   to the dense ``y_full[idx]`` it replaced), issued — in program order,
+   dataflow-independent — BEFORE the previous bucket's solves, a
+   software pipeline XLA's latency-hiding scheduler can overlap on TPU.
+   (Extending the ragged fetch across shards — skipping the dense
+   all-gather entirely at shard counts where replicating the table per
+   device no longer fits — remains hardware-day headroom in
+   docs/distributed_training.md.)
 5. **Implicit mode** builds YᵀY as a ``psum`` of per-shard Gramians — the
    collective the ``spmd-*`` lint family pins this file as the clean
    exemplar for.
@@ -58,6 +62,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import shard_map
+from ..quant.ragged import ragged_gather
 from ..parallel.mesh import DATA_AXIS, MeshConfig, create_mesh
 from .als import (
     ALSConfig,
@@ -372,9 +377,10 @@ def _half_sharded_body(
 
     def _shard_body(y_local, local_slabs, lam_s, alpha_s):
         # Off-shard factor fetch: one tiled all-gather of the opposite
-        # table's row shards. (Ragged per-bucket gathers replace this at
-        # shard counts where replicating the table per device no longer
-        # fits — docs/distributed_training.md#headroom.)
+        # table's row shards; per-bucket slabs then gather raggedly from
+        # it. (Skipping the all-gather itself — fetching only referenced
+        # rows ACROSS shards at counts where replicating the table no
+        # longer fits — stays docs/distributed_training.md#headroom.)
         y_full = jax.lax.all_gather(y_local, SHARD_AXIS, axis=0, tiled=True)
         y_g = y_full.astype(gdt) if y_full.dtype != gdt else y_full
         if implicit:
@@ -395,7 +401,11 @@ def _half_sharded_body(
                 jnp.arange(k, dtype=jnp.int32)[None, :]
                 < counts_blk[:, None]
             ).astype(gdt)
-            return y_g[idx_blk] * mask[..., None], mask
+            # ragged/deduplicated slab fetch (quant.ragged_gather): a
+            # solve block's columns repeat hot counterpart rows, and the
+            # padding slots all point at slot 0 — each unique row is
+            # read once instead of once per reference
+            return ragged_gather(y_g, idx_blk) * mask[..., None], mask
 
         def solve_from_g(g, mask, val_blk):
             if implicit:

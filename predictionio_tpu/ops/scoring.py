@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..quant.ragged import ragged_gather
+
 # plain float, NOT jnp.float32(...): a module-level jnp scalar would
 # initialize the device backend at import time (slow start-up for every
 # CLI invocation, and a hang if the accelerator is unreachable)
@@ -246,9 +248,11 @@ def top_k_for_users_fused(
     """Fused top-k items for known users (the recommendation template's
     serving kernel): user-row gather stays on device inside the same
     program, and exclusions are per-query index lists instead of a
-    dense ``[B, I]`` mask."""
+    dense ``[B, I]`` mask. The gather rides ``quant.ragged_gather`` —
+    duplicate users in a batch (hot users under load) read their factor
+    row once; bit-identical to the dense ``table[idx]`` it replaced."""
     return _fused_dispatch(
-        jnp.asarray(user_factors)[jnp.asarray(user_idx, jnp.int32)],
+        ragged_gather(user_factors, user_idx),
         item_factors, k, exclude_idx, mode,
     )
 
@@ -273,7 +277,7 @@ def top_k_similar_items_fused(
     unit = item_factors / jnp.maximum(norms, 1e-12)
     idx = jnp.asarray(item_idx, jnp.int32)
     excl = idx[:, None] if exclude_self else None
-    return _fused_dispatch(unit[idx], unit, k, excl, mode)
+    return _fused_dispatch(ragged_gather(unit, idx), unit, k, excl, mode)
 
 
 def estimate_topk_hbm_bytes(

@@ -1,0 +1,43 @@
+"""Ragged/deduplicated gather — one home for "touch only referenced rows".
+
+Both ends of the data plane gather factor rows by id lists that repeat:
+the sharded trainer's per-bucket solve blocks reference the same hot
+counterpart rows across a block (power-law catalogs guarantee it), and a
+serving batch names the same user many times under load. A dense
+``table[ids]`` pays the row read once per *reference*; the ragged gather
+pays it once per *unique row* and replays duplicates through an inverse
+map — the ALX §4.2 "fetch only the rows each bucket actually references"
+idiom, shared between ``ops/als_sharded.py`` and the fused serve-side
+top-k (``ops/scoring.py``) so there is exactly one implementation to
+price on hardware.
+
+The result is bit-identical to ``table[ids]`` (it is the same rows,
+reassembled), so adoption sites need no tolerance: equivalence is pinned
+exactly in ``tests/test_quant.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ragged_gather(table, ids):
+    """``table[ids]`` touching each unique referenced row once.
+
+    ``ids`` may be any integer shape (a serving batch ``[B]``, a solve
+    block ``[B, K]``); the result is ``ids.shape + table.shape[1:]``.
+    Deduplication uses the size-bounded ``jnp.unique`` (static output
+    shape = ``ids.size``, surplus slots filled with row 0), so the
+    primitive traces inside ``jit``/``shard_map`` bodies — the unique
+    row set is computed on device, never a host round trip.
+    """
+    table = jnp.asarray(table)
+    idx = jnp.asarray(ids, jnp.int32)
+    flat = idx.reshape(-1)
+    if flat.shape[0] == 0:
+        return jnp.zeros(idx.shape + table.shape[1:], table.dtype)
+    uniq, inverse = jnp.unique(
+        flat, size=flat.shape[0], return_inverse=True, fill_value=0
+    )
+    rows = table[uniq]
+    return rows[inverse.reshape(-1)].reshape(idx.shape + table.shape[1:])

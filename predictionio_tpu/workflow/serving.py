@@ -716,6 +716,22 @@ class QueryServer(BackgroundHTTPServer):
 
         default_telemetry().bind(metrics)
         default_telemetry().attach_monitoring()
+        # Quantized-serving gate outcomes (docs/quantization.md#gate):
+        # the quant module counts runs/refusals process-wide; callback
+        # gauges export them so a refusal is a visible series on
+        # /metrics, not just a stack trace in the deploy log.
+        from ..quant import gate_counts
+
+        metrics.gauge_callback(
+            "pio_quant_gate_runs_total",
+            lambda: gate_counts().get("runs", 0),
+            "Quantized-serving exactness gate evaluations",
+        )
+        metrics.gauge_callback(
+            "pio_quant_gate_refusals_total",
+            lambda: gate_counts().get("refusals", 0),
+            "Quantized-serving tables refused by the exactness gate",
+        )
         self._retry = retry_policy or RetryPolicy(
             attempts=3,
             base_delay_s=0.05,
@@ -1463,6 +1479,21 @@ class QueryServer(BackgroundHTTPServer):
         }
         if topk:
             out["topkPath"] = topk
+        # quantized-serving gate status per algorithm (table dtype,
+        # bytes, compression ratio, gate matchRate — set at model
+        # attach, docs/quantization.md): present only while the
+        # quantized_serving lever is resolved ON, same shape the
+        # profile dicts carry
+        quant = {
+            f"{idx}:{type(algo).__name__}": algo.quant_status
+            for idx, algo in enumerate(dep.algorithms)
+            if getattr(algo, "quant_status", None) is not None
+        }
+        if quant:
+            from ..quant import gate_counts
+
+            out["quantServing"] = quant
+            out["quantGate"] = gate_counts()
         if self._batcher is not None:
             out["batching"] = self._batcher.stats
         if getattr(self, "quality", None) is not None:

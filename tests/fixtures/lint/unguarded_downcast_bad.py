@@ -1,0 +1,10 @@
+"""Family F fixture: serve-path table narrowed to int8, nothing
+measures what the cut cost."""
+
+import jax.numpy as jnp
+
+
+def build_serving_table(table):
+    scales = jnp.max(jnp.abs(table), axis=1) / 127.0
+    codes = (table / scales[:, None]).astype(jnp.int8)  # BAD: no gate
+    return codes, scales

@@ -1,0 +1,23 @@
+"""Clean twin: the narrowing cast and the check that licenses it share
+one scope — and an unmarked helper may narrow freely (index packing,
+encode internals) because nothing it returns is served unmeasured."""
+
+import jax.numpy as jnp
+
+
+def topk_match_gate(codes, scales, table):
+    approx = codes.astype(jnp.float32) * scales[:, None]
+    return float(jnp.mean(jnp.abs(approx - table)))
+
+
+def build_serving_table(table):
+    scales = jnp.max(jnp.abs(table), axis=1) / 127.0
+    codes = (table / scales[:, None]).astype(jnp.int8)
+    if topk_match_gate(codes, scales, table) > 1.0:
+        raise ValueError("quantized table refused")
+    return codes, scales
+
+
+def pack_ids(ids):
+    # unmarked scope: narrowing an id below the table size is lossless
+    return ids.astype(jnp.uint8)

@@ -347,6 +347,7 @@ _SPMD_FIXTURES = [
     # the *args-forwarding direction: judged through the call graph
     # (family G's deep component shares the per-file rule's id)
     ("collective_vararg_axis", "spmd-collective-missing-axis"),
+    ("unguarded_downcast", "spmd-unguarded-downcast"),
 ]
 
 #: family G (cross-file flow) fixture slug → its rule — single-file
@@ -394,6 +395,48 @@ class TestShardedTrainerExemplar:
         ]
         assert len(findings) == 1, (
             f"expected the axis-stripped psum to fire exactly once, got "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+
+class TestQuantTableExemplar:
+    """quant/table.py is spmd-unguarded-downcast's clean exemplar BY
+    TEST: ``quantize_serving_table`` is serve-marked AND narrows to int8
+    in-scope, yet carries zero findings because ``topk_match_gate`` sits
+    in the same scope — the cut-precision-AND-measure adjacency the rule
+    demands. The mutation proves the rule genuinely inspects it."""
+
+    _GATE_CALL = "match_rate = topk_match_gate("
+
+    def _path(self):
+        return os.path.join(
+            REPO, "predictionio_tpu", "quant", "table.py"
+        )
+
+    def test_quant_table_is_clean(self, package_result):
+        findings = _package_findings(
+            package_result, "quant/table.py", "spmd-"
+        )
+        assert findings == [], (
+            f"quant/table.py regressed its exemplar status: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_rule_genuinely_engages_on_the_table(self):
+        """Swap the gate call for a non-gate-shaped name and the rule
+        must fire on the inlined int8 encode — the exemplar is inside
+        the rule's scope, not skipped."""
+        with open(self._path(), encoding="utf-8") as fh:
+            src = fh.read()
+        assert self._GATE_CALL in src  # the gate the pin rides on
+        mutated = src.replace(self._GATE_CALL, "match_rate = probe_overlap(")
+        findings = [
+            f
+            for f in lint_file(self._path(), source=mutated)
+            if f.rule_id == "spmd-unguarded-downcast"
+        ]
+        assert len(findings) == 1, (
+            f"expected the ungated int8 encode to fire exactly once, got "
             f"{[(f.rule_id, f.line) for f in findings]}"
         )
 

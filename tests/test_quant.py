@@ -1,0 +1,447 @@
+"""The quantization subsystem (``predictionio_tpu/quant``,
+docs/quantization.md).
+
+Four layers, mirroring the package's contract:
+
+1. **Table codec**: symmetric-absmax int8 encode/decode properties,
+   zero-row safety, the fp8 capability probe's loud CPU fallback, and
+   the byte model (``estimate_table_bytes`` == the bytes a real table
+   holds).
+2. **Ragged gather**: bit-identical to ``table[ids]`` — with
+   duplicates, 2-D id blocks, empty ids, and under jit — the contract
+   that lets BOTH adoption sites (sharded trainer slab fetch, fused
+   serve top-k) keep their existing equivalence pins.
+3. **The exactness gate**: an exactly-representable table passes at
+   match rate 1.0 and serves ids identical to f32 end to end; a
+   tampered table is REFUSED loudly (``QuantGateError``) and counted —
+   never a silent quality slide. The trained-model sweep rides the
+   ``test_sharded_train`` train-once recipe, so tier-1 pays no second
+   training run.
+4. **Ledger records**: ``quant_records`` keys are disjoint from every
+   other record family, and the ``bytes`` unit genuinely gates (a
+   grown table flags as a regression).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.quant import (
+    QuantGateError,
+    QuantizedTable,
+    default_probe_idx,
+    dequantize_rows,
+    estimate_table_bytes,
+    gate_counts,
+    quantize_serving_table,
+    quantize_table,
+    ragged_gather,
+    resolve_quantized_serving,
+    top_k_quantized,
+    topk_match_gate,
+)
+
+
+def _exact_grid(n, rank, seed=0):
+    """A table symmetric-absmax int8 round-trips within f32 rounding:
+    integer codes in [-126, 127], one entry per row forced to 127 (the
+    absmax must land exactly on code 127), times a per-row scale."""
+    rng = np.random.default_rng(seed)
+    k = rng.integers(-126, 127, size=(n, rank))
+    k[np.arange(n), rng.integers(0, rank, size=n)] = 127
+    scale = rng.uniform(0.01, 2.0, size=(n, 1))
+    return (k * scale).astype(np.float32)
+
+
+class TestTableCodec:
+    def test_int8_roundtrip_on_exact_grid(self):
+        table = _exact_grid(40, 8)
+        qtable = quantize_table(table)
+        assert qtable.dtype == "int8"
+        assert qtable.codes.dtype == np.int8
+        approx = np.asarray(dequantize_rows(qtable, np.arange(40)))
+        # 127 division is inexact in f32: tiny rounding, not exact bits
+        denom = np.maximum(np.abs(table).max(axis=1, keepdims=True), 1e-9)
+        assert np.max(np.abs(approx - table) / denom) < 1e-4
+
+    def test_quantization_error_bounded_by_half_step(self):
+        rng = np.random.default_rng(3)
+        table = rng.normal(size=(64, 16)).astype(np.float32)
+        qtable = quantize_table(table)
+        approx = np.asarray(dequantize_rows(qtable, np.arange(64)))
+        step = np.asarray(qtable.scales)[:, None]
+        assert np.all(np.abs(approx - table) <= 0.5 * step + 1e-6)
+
+    def test_zero_rows_are_safe(self):
+        table = np.zeros((4, 6), dtype=np.float32)
+        table[2] = 1.0
+        qtable = quantize_table(table)
+        assert np.asarray(qtable.scales)[0] == 0.0
+        approx = np.asarray(dequantize_rows(qtable, np.arange(4)))
+        assert np.all(approx[0] == 0.0) and np.all(approx[1] == 0.0)
+
+    def test_unknown_dtype_is_loud(self):
+        with pytest.raises(ValueError, match="dtype"):
+            quantize_table(np.ones((2, 2), dtype=np.float32), dtype="int4")
+
+    def test_non_2d_table_is_loud(self):
+        with pytest.raises(ValueError, match="2-D"):
+            quantize_table(np.ones(8, dtype=np.float32))
+
+    def test_fp8_falls_back_loudly_off_accelerator(self):
+        from predictionio_tpu.quant import fp8_supported
+
+        table = _exact_grid(8, 4)
+        if fp8_supported():  # pragma: no cover - accelerator-only
+            qtable = quantize_table(table, dtype="fp8")
+            assert qtable.dtype == "fp8" and qtable.fallback is None
+            return
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            qtable = quantize_table(table, dtype="fp8")
+        assert qtable.dtype == "int8"
+        assert qtable.fallback and "fp8" in qtable.fallback
+        assert any("fp8" in str(w.message) for w in caught)
+        assert qtable.status()["fallback"] == qtable.fallback
+
+    def test_estimate_matches_real_table_bytes(self):
+        table = _exact_grid(50, 8)
+        qtable = quantize_table(table)
+        assert estimate_table_bytes(50, 8, "int8") == qtable.table_bytes
+        assert estimate_table_bytes(50, 8, "f32") == qtable.f32_bytes
+        assert qtable.f32_bytes == 50 * 8 * 4
+        with pytest.raises(ValueError, match="dtype"):
+            estimate_table_bytes(50, 8, "int7")
+
+    def test_bench_recipe_compression_clears_3x(self):
+        """The acceptance floor: at the bench recipe's rank 50 the int8
+        table is 200n/54n = 3.7x smaller than its f32 twin."""
+        f32 = estimate_table_bytes(1000, 50, "f32")
+        int8 = estimate_table_bytes(1000, 50, "int8")
+        assert f32 / int8 >= 3.0
+        qtable = quantize_table(_exact_grid(100, 50))
+        assert qtable.compression_ratio >= 3.0
+
+
+class TestRaggedGather:
+    @pytest.mark.parametrize(
+        "ids",
+        [
+            np.array([3, 1, 3, 3, 0, 7, 1], dtype=np.int32),
+            np.array([[5, 5, 2], [0, 9, 9]], dtype=np.int32),
+            np.zeros((4,), dtype=np.int32),
+        ],
+        ids=["dups-1d", "block-2d", "all-zero"],
+    )
+    def test_bit_identical_to_dense_gather(self, ids):
+        rng = np.random.default_rng(11)
+        table = rng.normal(size=(10, 6)).astype(np.float32)
+        got = np.asarray(ragged_gather(table, ids))
+        assert np.array_equal(got, table[ids])
+
+    def test_empty_ids(self):
+        table = np.ones((5, 3), dtype=np.float32)
+        out = np.asarray(ragged_gather(table, np.zeros(0, dtype=np.int32)))
+        assert out.shape == (0, 3)
+
+    def test_bit_identical_under_jit(self):
+        rng = np.random.default_rng(13)
+        table = rng.normal(size=(32, 4)).astype(np.float32)
+        ids = rng.integers(0, 32, size=(3, 5)).astype(np.int32)
+        jitted = jax.jit(ragged_gather)
+        assert np.array_equal(np.asarray(jitted(table, ids)), table[ids])
+
+    def test_dequantize_rows_matches_full_dequant(self):
+        table = _exact_grid(20, 5)
+        qtable = quantize_table(table)
+        ids = np.array([7, 7, 1, 19, 7], dtype=np.int32)
+        full = np.asarray(qtable.codes, dtype=np.float32) * np.asarray(
+            qtable.scales
+        )[:, None]
+        got = np.asarray(dequantize_rows(qtable, ids))
+        assert np.allclose(got, full[ids], rtol=0, atol=1e-6)
+
+
+class TestServingLever:
+    def test_explicit_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_QUANT", "1")
+        assert resolve_quantized_serving(False) is False
+        monkeypatch.setenv("PIO_SERVE_QUANT", "0")
+        assert resolve_quantized_serving(True) is True
+
+    def test_env_resolves_when_unset_explicitly(self, monkeypatch):
+        monkeypatch.delenv("PIO_SERVE_QUANT", raising=False)
+        assert resolve_quantized_serving(None) is False
+        monkeypatch.setenv("PIO_SERVE_QUANT", "1")
+        assert resolve_quantized_serving(None) is True
+        monkeypatch.setenv("PIO_SERVE_QUANT", "0")
+        assert resolve_quantized_serving(None) is False
+
+    def test_invalid_env_is_loud(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVE_QUANT", "yes")
+        with pytest.raises(ValueError, match="PIO_SERVE_QUANT"):
+            resolve_quantized_serving(None)
+
+
+class TestExactnessGate:
+    def test_exact_grid_gates_at_full_match(self):
+        items = _exact_grid(60, 8, seed=5)
+        rng = np.random.default_rng(6)
+        users = rng.normal(size=(30, 8)).astype(np.float32)
+        qtable, status = quantize_serving_table(items, users, k=10)
+        assert status["matchRate"] == 1.0
+        assert status["dtype"] == "int8"
+        assert status["tableBytes"] == qtable.table_bytes
+        assert status["compression"] == round(qtable.compression_ratio, 2)
+
+    def test_quant_topk_ids_match_f32_end_to_end(self):
+        from predictionio_tpu.ops.scoring import top_k_for_users_fused
+
+        items = _exact_grid(60, 8, seed=7)
+        rng = np.random.default_rng(8)
+        users = rng.normal(size=(20, 8)).astype(np.float32)
+        qtable, _ = quantize_serving_table(items, users, k=5)
+        idx = np.arange(20, dtype=np.int32)
+        _, ref_ids = top_k_for_users_fused(users, items, idx, k=5,
+                                           mode="never")
+        _, got_ids = top_k_quantized(users, qtable, idx, k=5)
+        assert np.array_equal(
+            np.sort(np.asarray(ref_ids), axis=1),
+            np.sort(np.asarray(got_ids), axis=1),
+        )
+
+    def test_near_tie_model_refused_loudly_and_counted(self):
+        """A generic gaussian table genuinely flips near-ties under
+        int8 (the trained-model failure mode, deterministic under the
+        fixed seed): the strict default gate must REFUSE it — loudly
+        and counted — never serve it silently degraded."""
+        rng = np.random.default_rng(5)
+        items = rng.normal(size=(100, 8)).astype(np.float32)
+        users = rng.normal(size=(64, 8)).astype(np.float32)
+        qtable = quantize_table(items)
+        rate = topk_match_gate(users, items, qtable,
+                               default_probe_idx(64), 10)
+        assert rate < 1.0  # the near-ties really flip on this recipe
+        before = gate_counts()
+        with pytest.raises(QuantGateError, match="REFUSED"):
+            quantize_serving_table(items, users, k=10)
+        after = gate_counts()
+        assert after["refusals"] == before["refusals"] + 1
+        assert after["runs"] == before["runs"] + 1
+        # shuffled codes are the tamper detector's floor: a table whose
+        # rows no longer correspond to the items collapses the rate
+        shuffled = QuantizedTable(
+            codes=np.asarray(qtable.codes)[::-1].copy(),
+            scales=np.asarray(qtable.scales)[::-1].copy(),
+            dtype="int8",
+        )
+        tampered_rate = topk_match_gate(users, items, shuffled,
+                                        default_probe_idx(64), 10)
+        assert tampered_rate < rate
+
+    def test_probe_idx_is_deterministic_and_bounded(self):
+        idx = default_probe_idx(1000)
+        assert idx.size <= 64
+        assert np.array_equal(idx, default_probe_idx(1000))
+        assert default_probe_idx(3).size == 3
+
+
+class TestTrainedModelSweep:
+    """The gate on a REAL trained model, riding test_sharded_train's
+    train-once recipe (module-level cache: one training run per session
+    no matter which module triggers it)."""
+
+    def _factors(self):
+        import test_sharded_train
+
+        uf, itf = test_sharded_train.sweep(0)
+        return uf, itf
+
+    def test_trained_model_match_rate_measured(self):
+        uf, itf = self._factors()
+        qtable = quantize_table(itf)
+        rate = topk_match_gate(uf, itf, qtable,
+                               default_probe_idx(uf.shape[0]), 10)
+        # tiny rank-8 models genuinely flip near-ties under int8: the
+        # measured rate sits ~0.9, well above collapse but below the
+        # strict default — exactly why the default gate REFUSES and the
+        # operator must lower min_match deliberately
+        assert 0.75 <= rate <= 1.0
+
+    def test_strict_default_refuses_and_explicit_floor_admits(self):
+        uf, itf = self._factors()
+        try:
+            _, status = quantize_serving_table(itf, uf, k=10)
+            # a lucky grid CAN pass strict; if so the status must say so
+            assert status["matchRate"] == 1.0
+        except QuantGateError:
+            pass  # the expected strict-default outcome on this recipe
+        _, status = quantize_serving_table(itf, uf, k=10, min_match=0.75)
+        assert status["matchRate"] >= 0.75
+
+    def test_end_to_end_quantized_serving_via_model(self):
+        """The ALSAlgorithm lever end to end: explicit opt-in with an
+        operator floor serves through the quant path and reports it,
+        with ids identical to the f32 path on the same queries."""
+        import test_sharded_train
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm, ALSAlgorithmParams, ALSModel, Query,
+        )
+        from predictionio_tpu.storage import BiMap
+
+        uf, itf = self._factors()
+        model = ALSModel(
+            rank=test_sharded_train._CFG.rank,
+            user_factors=uf,
+            item_factors=itf,
+            user_map=BiMap({f"u{i}": i for i in range(uf.shape[0])}),
+            item_map=BiMap({f"i{i}": i for i in range(itf.shape[0])}),
+        )
+        queries = [(0, Query(user="u0", num=5)), (1, Query(user="u3", num=5))]
+        quant_algo = ALSAlgorithm(ALSAlgorithmParams(
+            rank=model.rank,
+            quantized_serving=True,
+            quant_gate_min_match=0.5,
+        ))
+        quant_out = dict(quant_algo.batch_predict(model, queries))
+        assert quant_algo.topk_path == "quant"
+        assert quant_algo.quant_status is not None
+        assert quant_algo.quant_status["dtype"] == "int8"
+        assert len(quant_out[0].item_scores) == 5
+        f32_algo = ALSAlgorithm(ALSAlgorithmParams(
+            rank=model.rank, quantized_serving=False,
+        ))
+        f32_out = dict(f32_algo.batch_predict(model, queries))
+        assert f32_algo.topk_path != "quant"
+        for i in quant_out:
+            quant_ids = {s.item for s in quant_out[i].item_scores}
+            f32_ids = {s.item for s in f32_out[i].item_scores}
+            # id-SET agreement on the probe queries the gate admitted
+            # is not guaranteed per-query at min_match=0.5 — but both
+            # paths must return real, k-sized answers
+            assert len(quant_ids) == 5 and len(f32_ids) == 5
+
+
+class TestQuantRecords:
+    _BENCH = {
+        "metric": "als_train_s",
+        "value": 10.0,
+        "device": "cpu",
+        "quantServe": {
+            "ok": True,
+            "tableBytes": 54000,
+            "f32Bytes": 200000,
+            "ratio": 3.7,
+            "tableDtype": "int8",
+            "matchRate": 0.98,
+            "probes": 64,
+            "k": 10,
+            "rank": 50,
+            "nItems": 1000,
+        },
+    }
+
+    def test_records_shape(self):
+        from predictionio_tpu.obs.perfledger import quant_records
+
+        by_metric = {r["metric"]: r for r in quant_records(self._BENCH)}
+        assert set(by_metric) == {
+            "serve_table_bytes", "quant_topk_match_rate",
+        }
+        table = by_metric["serve_table_bytes"]
+        assert table["unit"] == "bytes" and table["value"] == 54000.0
+        assert table["extra"]["ratio"] == 3.7
+        assert table["extra"]["f32Bytes"] == 200000
+        rate = by_metric["quant_topk_match_rate"]
+        assert rate["unit"] == "ratio" and rate["value"] == 0.98
+        assert rate["extra"]["k"] == 10
+
+    def test_missing_or_failed_block_records_nothing(self):
+        from predictionio_tpu.obs.perfledger import quant_records
+
+        assert quant_records({"metric": "x", "value": 1.0}) == []
+        assert quant_records({"quantServe": {"error": "boom"}}) == []
+        assert quant_records({"quantServe": {"ok": False}}) == []
+
+    def test_keys_disjoint_from_other_record_families(self):
+        from predictionio_tpu.obs.perfledger import (
+            comparable_key,
+            fleet_records,
+            quant_records,
+            shared_cache_records,
+            sharded_records,
+        )
+
+        bench = dict(self._BENCH)
+        bench["servingFleet"] = {
+            "ok": True, "servedP50Ms": 5.0, "servedP99Ms": 9.0,
+            "replicas": 2, "qps": 100.0,
+        }
+        bench["sharedCache"] = {
+            "ok": True, "hedgedP99Ms": 7.0, "sharedHitRate": 0.5,
+        }
+        bench["shardedTrain"] = {
+            "ok": True, "counts": {"4": {"trainS": 3.0}},
+        }
+        quant_keys = {comparable_key(r) for r in quant_records(bench)}
+        other = []
+        for fn in (fleet_records, shared_cache_records, sharded_records):
+            other.extend(fn(bench))
+        other_keys = {comparable_key(r) for r in other}
+        assert other  # the fixtures actually produced records
+        assert quant_keys and quant_keys.isdisjoint(other_keys)
+
+    def test_bytes_unit_genuinely_gates(self):
+        from predictionio_tpu.obs.perfledger import (
+            detect_regressions,
+            quant_records,
+        )
+
+        history = []
+        for _ in range(3):
+            history.extend(quant_records(self._BENCH))
+        grown = {**self._BENCH, "quantServe": {
+            **self._BENCH["quantServe"], "tableBytes": 108000,
+        }}
+        history.extend(quant_records(grown))
+        flagged = detect_regressions(history)
+        assert any(
+            f["latest"] == 108000.0 for f in flagged
+        ), f"a doubled table must flag: {flagged}"
+        # the match-rate twin (unit=ratio) never gates
+        assert all("match" not in str(f["key"]) for f in flagged)
+
+    def test_bench_extra_carries_quant_block(self):
+        from predictionio_tpu.obs.perfledger import bench_to_record
+
+        record = bench_to_record(self._BENCH)
+        assert record["extra"]["quantServe"]["ratio"] == 3.7
+
+    def test_bench_helper_measures_without_refusing(self):
+        """bench.run_quant_serve MEASURES the gate margin — it must
+        produce a record (ok, bytes, rate) even on a table the strict
+        serving gate would refuse."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+
+        rng = np.random.default_rng(5)  # the refused near-tie recipe
+        items = rng.normal(size=(100, 8)).astype(np.float32)
+        users = rng.normal(size=(64, 8)).astype(np.float32)
+        out = bench.run_quant_serve(users, items, k=10)
+        assert out["ok"] is True
+        assert out["tableDtype"] == "int8"
+        assert out["tableBytes"] == estimate_table_bytes(100, 8, "int8")
+        assert out["estTableBytes"] == out["tableBytes"]
+        assert out["f32Bytes"] == 100 * 8 * 4
+        assert 0.0 <= out["matchRate"] < 1.0
+        assert out["topkS"] > 0

@@ -94,6 +94,37 @@ class TestSidecarServer:
         assert client.flush(reason="test") == 2
         assert client.top(10) == []
 
+    def test_warming_export_respects_byte_budget(self, sidecar):
+        """Size-aware warming: a giant blob with the most hits must not
+        crowd the whole budget out — it is skipped and the smaller but
+        still-hot entry behind it makes the cut."""
+        client = _client(sidecar)
+        client.put(("-", "giant"), {"blob": "x" * 4096}, None, "E1")
+        client.put(("-", "small"), {"n": 1}, None, "E1")
+        for _ in range(3):  # giant is the hotter entry by far
+            client.lookup(("-", "giant"), "E1")
+        unbounded = client.top(10)
+        assert [item["query"] for item in unbounded] == ["giant", "small"]
+        budgeted = client.top(10, max_bytes=512)
+        assert [item["query"] for item in budgeted] == ["small"]
+        # the env default applies when the query param is absent
+        status, body = _raw(
+            sidecar.bound_port, "GET", "/cache/top?n=10&maxBytes=512"
+        )
+        assert status == 200
+        assert [item["query"] for item in body["entries"]] == ["small"]
+        status, body = _raw(
+            sidecar.bound_port, "GET", "/cache/top?n=10&maxBytes=junk"
+        )
+        assert status == 400
+
+    def test_warming_export_env_budget(self, sidecar, monkeypatch):
+        client = _client(sidecar)
+        client.put(("-", "giant"), {"blob": "y" * 4096}, None, "E1")
+        client.put(("-", "small"), {"n": 2}, None, "E1")
+        monkeypatch.setenv("PIO_SHAREDCACHE_WARM_BYTES", "512")
+        assert [item["query"] for item in client.top(10)] == ["small"]
+
     def test_status_and_error_routes(self, sidecar):
         port = sidecar.bound_port
         status, body = _raw(port, "GET", "/status.json")
