@@ -286,6 +286,11 @@ def _append_ledger(record: dict) -> None:
         # an expansion and a merge never share a trajectory
         for migration_record in perfledger.migration_records(record):
             perfledger.append_record(path, migration_record)
+        # checkpointing overhead ratio from the preemption drill,
+        # trend-only (docs/checkpoint.md): the cost of never losing a
+        # run gets a trajectory, never a gate
+        for ckpt_record in perfledger.ckpt_records(record):
+            perfledger.append_record(path, ckpt_record)
     except Exception as exc:
         print(f"bench: ledger append failed (ignored): {exc}",
               file=sys.stderr)
@@ -338,6 +343,211 @@ out = {{
 }}
 print("SHARDED_JSON " + json.dumps(out))
 """
+
+
+#: Child program for the preemption drill (docs/checkpoint.md). Two
+#: modes in a SUBPROCESS each (virtual device count must be pinned
+#: before the first `import jax`): "kill" trains with checkpointing and
+#: SIGKILLs itself the instant the chosen step commits — a reclaimed VM,
+#: not a clean shutdown — and "resume" picks the run back up at a
+#: DIFFERENT shard count, compares against an uninterrupted in-process
+#: twin within the PR-12 reassociation tolerances, and measures the
+#: checkpointing overhead ratio on an untouched third run.
+_CKPT_SNIPPET = r"""
+import json, os, shutil, signal, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from predictionio_tpu.ckpt import CheckpointStore
+from predictionio_tpu.ops.als import ALSConfig, rmse
+from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+mode = {mode!r}
+ckpt_dir = {ckpt_dir!r}
+shards = {shards}
+kill_step = {kill_step}
+
+rng = np.random.default_rng(11)
+nnz, n_u, n_i = 30_000, 1_000, 400
+w = 1.0 / np.arange(1, n_u + 1) ** 0.8
+u = rng.choice(n_u, size=nnz, p=w / w.sum()).astype(np.int32)
+i = rng.integers(0, n_i, nnz).astype(np.int32)
+v = rng.integers(1, 6, nnz).astype(np.float32)
+cfg = ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=3)
+
+if mode == "kill":
+    class KillingStore(CheckpointStore):
+        def save(self, step, arrays, meta):
+            out = super().save(step, arrays, meta)
+            if step == kill_step:
+                os.kill(os.getpid(), signal.SIGKILL)
+            return out
+
+    als_train_sharded(
+        u, i, v, n_users=n_u, n_items=n_i, cfg=cfg, shards=shards,
+        checkpoint=KillingStore(ckpt_dir), checkpoint_every=1,
+    )
+    print("CKPT_JSON " + json.dumps({{"error": "kill never fired"}}))
+    sys.exit(3)
+
+profile = {{}}
+t0 = time.monotonic()
+resumed = als_train_sharded(
+    u, i, v, n_users=n_u, n_items=n_i, cfg=cfg, shards=shards,
+    checkpoint=CheckpointStore(ckpt_dir), checkpoint_every=1,
+    profile=profile,
+)
+ru = np.asarray(resumed.user_factors)
+ri = np.asarray(resumed.item_factors)
+resume_s = time.monotonic() - t0
+
+t0 = time.monotonic()
+plain = als_train_sharded(
+    u, i, v, n_users=n_u, n_items=n_i, cfg=cfg, shards=shards,
+)
+plain_s = time.monotonic() - t0
+pu = np.asarray(plain.user_factors)
+pi = np.asarray(plain.item_factors)
+
+fresh = ckpt_dir + ".overhead"
+shutil.rmtree(fresh, ignore_errors=True)
+t0 = time.monotonic()
+als_train_sharded(
+    u, i, v, n_users=n_u, n_items=n_i, cfg=cfg, shards=shards,
+    checkpoint=CheckpointStore(fresh), checkpoint_every=1,
+)
+ckpt_s = time.monotonic() - t0
+shutil.rmtree(fresh, ignore_errors=True)
+
+import jax
+ck = profile.get("ckpt") or {{}}
+rmse_resumed = rmse(resumed, u, i, v)
+rmse_plain = rmse(plain, u, i, v)
+out = {{
+    "resumedFrom": ck.get("resumedFrom"),
+    "equivalent": bool(
+        np.allclose(ru, pu, rtol=1e-3, atol=1e-4)
+        and np.allclose(ri, pi, rtol=1e-3, atol=1e-4)
+        and abs(rmse_resumed - rmse_plain) <= 1e-3
+    ),
+    "maxAbsDiff": round(float(max(
+        np.max(np.abs(ru - pu)), np.max(np.abs(ri - pi))
+    )), 6),
+    "rmseResumed": round(float(rmse_resumed), 4),
+    "rmsePlain": round(float(rmse_plain), 4),
+    "resumeS": round(resume_s, 3),
+    "plainS": round(plain_s, 3),
+    "ckptS": round(ckpt_s, 3),
+    "overheadRatio": (
+        round(ckpt_s / plain_s, 4) if plain_s > 0 else None
+    ),
+    "snapshotS": ck.get("snapshotS"),
+    "written": ck.get("written"),
+    "dropped": ck.get("dropped"),
+    "errors": ck.get("errors"),
+    "device": str(jax.devices()[0]),
+    "nnz": nnz,
+    "iterations": cfg.iterations,
+}}
+print("CKPT_JSON " + json.dumps(out))
+"""
+
+
+def run_ckpt_resume(
+    train_shards: int = 2, resume_shards: int = 4, timeout_s: float = 600.0
+) -> dict:
+    """The preemption drill (docs/checkpoint.md#preemption-drill):
+    checkpointed training at N shards SIGKILLed the instant a chosen
+    step commits, resumed at M shards, compared against an uninterrupted
+    twin within the PR-12 tolerances. The overhead ratio (ckpt-on wall /
+    plain wall) rides the ledger trend-only as
+    ``train_ckpt_overhead_ratio``. Returns the ``ckptResume`` bench
+    block (``ok`` only when the kill fired, the resume picked up the
+    killed run's last committed step, and the factors match)."""
+    import random
+    import shutil
+    import signal
+    import tempfile
+
+    from predictionio_tpu.utils.platform import force_cpu_env
+
+    # a random kill point keeps the drill honest over bench history —
+    # resume must work from ANY committed step, not a lucky one
+    kill_step = random.choice((1, 2))
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    block: dict = {
+        "trainShards": train_shards,
+        "resumeShards": resume_shards,
+        "killStep": kill_step,
+        "ok": False,
+    }
+
+    def _child(mode: str, shards: int) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                _CKPT_SNIPPET.format(
+                    repo=_REPO_ROOT, mode=mode, ckpt_dir=ckpt_dir,
+                    shards=shards, kill_step=kill_step,
+                ),
+            ],
+            env=force_cpu_env(n_devices=shards),
+            cwd=_REPO_ROOT,
+            timeout=timeout_s,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+
+    try:
+        kill = _child("kill", train_shards)
+        if kill.returncode != -signal.SIGKILL:
+            tail = kill.stderr.decode("utf-8", "replace").strip().splitlines()
+            block["error"] = (
+                f"kill child rc={kill.returncode}, expected SIGKILL: "
+                f"{tail[-1] if tail else '(no stderr)'}"
+            )
+            return block
+        proc = _child("resume", resume_shards)
+        line = next(
+            (
+                ln[len("CKPT_JSON "):]
+                for ln in proc.stdout.decode("utf-8", "replace").splitlines()
+                if ln.startswith("CKPT_JSON ")
+            ),
+            None,
+        )
+        if proc.returncode != 0 or line is None:
+            tail = proc.stderr.decode("utf-8", "replace").strip().splitlines()
+            block["error"] = (
+                f"resume child rc={proc.returncode}: "
+                f"{tail[-1] if tail else '(no stderr)'}"
+            )
+            return block
+        block.update(json.loads(line))
+        if block.get("resumedFrom") != kill_step:
+            block["error"] = (
+                f"resumed from step {block.get('resumedFrom')}, "
+                f"expected the killed run's last commit {kill_step}"
+            )
+        elif not block.get("equivalent"):
+            block["error"] = (
+                f"resumed factors drifted beyond tolerance "
+                f"(maxAbsDiff {block.get('maxAbsDiff')})"
+            )
+        else:
+            block["ok"] = True
+        print(
+            f"bench ckptResume: killed@{kill_step} "
+            f"{train_shards}->{resume_shards} shards "
+            f"ok={block['ok']} overhead {block.get('overheadRatio')}",
+            file=sys.stderr,
+        )
+        return block
+    except subprocess.TimeoutExpired:
+        block["error"] = f"timed out after {timeout_s:.0f}s"
+        return block
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 def run_lint_sweep() -> dict:
@@ -893,6 +1103,17 @@ def run_bench(scale: float, iterations: int, fallback: str) -> int:
             record["shardedTrain"] = run_sharded_train()
         except Exception as exc:
             record["shardedTrain"] = {"error": str(exc)}
+    # Preemption drill (docs/checkpoint.md#preemption-drill): a
+    # checkpointed sharded run SIGKILLed mid-train resumes at a
+    # DIFFERENT shard count and lands within tolerance of the
+    # uninterrupted twin; the checkpointing overhead ratio rides the
+    # ledger trend-only (train_ckpt_overhead_ratio). Opt out with
+    # BENCH_CKPT=0; a failure never fails the bench.
+    if os.environ.get("BENCH_CKPT") != "0":
+        try:
+            record["ckptResume"] = run_ckpt_resume()
+        except Exception as exc:
+            record["ckptResume"] = {"error": str(exc)}
     # Lint-sweep wall clock (docs/lint.md#cache): cold vs warm over the
     # package with a throwaway cache, in-process (the linter is stdlib-
     # only — no device, no subprocess needed). Rides the ledger trend-

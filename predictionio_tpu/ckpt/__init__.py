@@ -1,0 +1,45 @@
+"""Checkpoint subsystem: async sharded step-resume (docs/checkpoint.md).
+
+A preemption costs minutes, not the run: the sharded ALS trainer
+(``ops/als_sharded.py``) snapshots both factor tables in CANONICAL
+(global, unpermuted) row order every ``checkpoint_every`` iterations, a
+background :class:`CheckpointWriter` commits each snapshot atomically
+(per-file tmp + fsync + rename with SHA-256, ``manifest.json`` LAST),
+and resume re-deals the canonical rows through the balancer at ANY
+shard count — N→M lands within the PR-12 reassociation tolerances of
+the uninterrupted run.
+
+Failure discipline, in one line each:
+
+- crash mid-write       → no manifest → the step never existed
+- corrupt file on load  → loud skip to the previous valid step, counted
+- mismatched recipe     → loud :class:`CheckpointMismatch` refusal
+- disk can't keep up    → snapshot dropped + counted, loop never stalls
+
+Operator surface: ``pio ckpt ls|verify|gc`` (:mod:`.cli`), the
+``PIO_CKPT_*`` envs (:mod:`.settings`), and the ``ckptResume`` bench
+block with the ``train_ckpt_overhead_ratio`` ledger metric.
+"""
+
+from .settings import (  # noqa: F401
+    DIR_ENV,
+    EVERY_ENV,
+    KEEP_EVERY_ENV,
+    KEEP_LAST_ENV,
+    QUEUE_ENV,
+    RESUME_ENV,
+    resolve_every,
+    resolve_queue_depth,
+    resolve_resume,
+    resolve_retention,
+)
+from .store import (  # noqa: F401
+    MANIFEST,
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointMismatch,
+    CheckpointStore,
+    LoadedCheckpoint,
+    sha256_bytes,
+)
+from .writer import CheckpointWriter  # noqa: F401

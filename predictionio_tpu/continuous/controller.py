@@ -128,6 +128,14 @@ class ContinuousConfig:
     #: partition never blocks another's commit. 0 = wait for every
     #: partition.
     fold_partition_timeout_s: float = 0.0
+    #: checkpoint cadence for FULL retrains (docs/checkpoint.md): the
+    #: retrain workflow's ``--checkpoint-every`` equivalent. The batch
+    #: slug is stable ("continuous-retrain"), so a retrain killed
+    #: mid-run — node preemption, controller restart — leaves committed
+    #: checkpoints behind and the NEXT full retrain resumes from the
+    #: latest valid one instead of starting over. None defers to the
+    #: engine params / ``PIO_CKPT_EVERY`` tri-state; 0 forces off.
+    retrain_checkpoint_every: Optional[int] = None
     #: start the background tick thread with the server
     autostart: bool = True
 
@@ -895,7 +903,14 @@ class ContinuousController:
                 engine_version=inst.engine_version,
                 engine_variant=inst.engine_variant,
                 engine_factory=inst.engine_factory,
-                workflow_params=WorkflowParams(batch="continuous-retrain"),
+                # the stable batch slug makes the derived checkpoint dir
+                # stable across retrains: a killed retrain's committed
+                # checkpoints are found by the next one, which resumes
+                # from the latest valid step (docs/checkpoint.md)
+                workflow_params=WorkflowParams(
+                    batch="continuous-retrain",
+                    checkpoint_every=self.config.retrain_checkpoint_every,
+                ),
                 # run_train stops its ctx when done — give it its own
                 # instead of the server's serving context
             )

@@ -184,6 +184,7 @@ class Algorithm(Controller, Generic[PD, M, Q, P]):
         - anything else → pickled into the model blob store by the workflow.
         """
         if isinstance(model, PersistentModel):
+            # pio: lint-ok[robust-nonatomic-checkpoint] delegation, not a write: the PersistentModel subclass owns the file I/O and is linted where it is defined
             if model.save(instance_id, self.params, ctx):
                 return PersistentModelManifest.of(model)
             return RETRAIN

@@ -69,6 +69,10 @@ class WorkflowParams:
     #: hyperparameter-sweep parallelism: 0 = auto (one slice per candidate
     #: up to the mesh data-axis size), 1 = serial, N = N mesh slices
     eval_parallelism: int = 0
+    #: per-run checkpoint cadence override (``pio train
+    #: --checkpoint-every``; docs/checkpoint.md): None defers to the
+    #: engine params / ``PIO_CKPT_EVERY`` tri-state
+    checkpoint_every: Optional[int] = None
 
 
 class StopAfterReadInterruption(Exception):

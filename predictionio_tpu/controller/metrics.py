@@ -18,6 +18,7 @@ import json
 import logging
 from typing import Any, Generic, List, Optional, Sequence, Tuple, TypeVar
 
+from ..utils.durability import atomic_write_bytes
 from .engine import EngineParams, params_to_json
 
 logger = logging.getLogger(__name__)
@@ -277,6 +278,5 @@ class MetricEvaluator(Generic[EI, Q, P, A, R]):
             "engineFactory": factory,
             **_engine_params_json(engine_params),
         }
-        with open(path, "w") as fh:
-            json.dump(variant, fh, indent=2)
+        atomic_write_bytes(path, json.dumps(variant, indent=2).encode("utf-8"))
         logger.info("Best variant params written to %s", path)

@@ -24,6 +24,8 @@ import re
 import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
+from ..utils.durability import atomic_write_bytes
+
 #: bumped whenever any rule's judgment OR the fact-extraction schema
 #: changes: the incremental cache keys every stored result (findings
 #: AND the per-file fact tables flow rules judge) on (this, the
@@ -31,7 +33,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 #: cache instead of serving verdicts a previous version produced. A
 #: stale cache can therefore never suppress a finding the current
 #: rules would raise.
-RULES_VERSION = "2"
+RULES_VERSION = "3"
 
 #: ``# pio: lint-ok[rule-a, rule-b] free-text reason``
 _SUPPRESS_RE = re.compile(
@@ -964,18 +966,17 @@ def _load_cache(path: str) -> Optional[dict]:
 
 
 def _save_cache(path: str, doc: dict) -> None:
-    """Atomic best-effort write (tmp + rename): a half-written cache
-    must never exist for the next run to trust, and a read-only target
-    dir must not fail the lint run that earned its verdict."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    """Atomic best-effort write: a half-written cache must never exist
+    for the next run to trust, and a read-only target dir must not fail
+    the lint run that earned its verdict. Uses the packaged durable
+    sequence — the fsync costs microseconds per run and retires the
+    hand-rolled tmp+rename this function used to carry a lint
+    suppression for."""
     try:
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh)
-        # pio: lint-ok[robust-rename-no-fsync] the cache is best-effort by contract: a torn or missing file just reads as a cold sweep, so durability buys nothing here
-        os.replace(tmp, path)
+        atomic_write_bytes(path, json.dumps(doc).encode("utf-8"))
     except OSError:
         try:
-            os.unlink(tmp)
+            os.unlink(f"{path}.tmp")
         except OSError:
             pass
 

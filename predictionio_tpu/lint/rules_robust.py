@@ -35,6 +35,13 @@ first incident:
   lagging side strands every write still in flight on a path nothing
   reads anymore; ``storage/migration.py``'s ``cutover`` (freeze →
   final drain → per-keyspace watermark → flip) is the packaged shape.
+- ``robust-nonatomic-checkpoint`` (ISSUE 20): a checkpoint/save/
+  persist-marked function that writes files with no atomicity evidence
+  in scope (no ``atomic_*`` helper, no rename+fsync sequence) — a crash
+  mid-write leaves a half-written file under the real name, which the
+  next run loads as a valid checkpoint; ``ckpt/store.py``'s
+  per-file ``atomic_write_bytes`` + manifest-last commit is the
+  packaged shape.
 - ``robust-fallback-swallows`` (ISSUE 18): a fallback/degrade-marked
   except handler that discards the primary's failure without recording
   it anywhere (no log/counter call, the bound exception never read) —
@@ -276,6 +283,99 @@ class RenameNoFsync(Rule):
                     "file's data may not be durable when the rename is — "
                     "fsync the temp file (and the directory) first, or "
                     "use utils/durability.atomic_write_bytes.",
+                )
+
+
+#: a function whose name carries one of these is a persistence point:
+#: its writes are state some later process will trust after a crash
+_CKPT_SCOPE_MARKERS = ("checkpoint", "ckpt", "snapshot", "save", "persist")
+
+#: bare/dotted call names that write a file straight to its final path
+#: (numpy's save/savez take the destination directly; json/pickle dump
+#: write through a handle the same scope's open() produced)
+_DIRECT_WRITE_NAMES = frozenset({"save", "savez", "savez_compressed", "dump"})
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """open(...) whose mode argument creates/truncates (w/a/x). Default
+    mode is read, so an open without a mode is not write evidence."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and any(ch in mode.value for ch in "wax")
+    )
+
+
+class NonatomicCheckpoint(Rule):
+    """A checkpoint/save/persist-marked function writing files with no
+    atomicity evidence in scope: a crash mid-write leaves a torn file
+    under the final name, and the next run — whose whole reason for the
+    checkpoint is surviving exactly that crash — loads it as valid
+    state. Clean shapes: any ``atomic_*`` durability helper, or the
+    manual tmp-write → fsync → rename sequence in the same scope."""
+
+    id = "robust-nonatomic-checkpoint"
+    severity = "error"
+    short = (
+        "checkpoint/save-marked scope writes files without atomic "
+        "commit evidence (torn state under the real name after a crash)"
+    )
+    motivation = (
+        "the checkpoint subsystem (ISSUE 20) exists so a preemption "
+        "costs minutes, not the run — but only if a kill mid-save can "
+        "never produce a loadable half-checkpoint; ckpt/store.py's "
+        "atomic_write_bytes per file + manifest-written-last is the "
+        "packaged shape, and the preemption drill in bench.py proves it"
+    )
+
+    _RENAMES = ("os.replace", "os.rename")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for scope in _scopes(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            lowered = scope.name.lower()
+            if not any(m in lowered for m in _CKPT_SCOPE_MARKERS):
+                continue
+            writes = []
+            has_atomic = False
+            has_rename = False
+            has_fsync = False
+            for node in _walk_in_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                name = call_name(node) or ""
+                if "atomic" in name or "atomic" in dn:
+                    has_atomic = True
+                if dn in self._RENAMES or (
+                    name in ("replace", "rename") and dn == name
+                ):
+                    has_rename = True
+                if "fsync" in name or "fsync" in dn:
+                    has_fsync = True
+                if name == "open" and dn == "open" and _open_write_mode(node):
+                    writes.append((node, "open(..., 'w')"))
+                elif name in _DIRECT_WRITE_NAMES:
+                    writes.append((node, f"{dn or name}(...)"))
+            if not writes or has_atomic or (has_rename and has_fsync):
+                continue
+            for node, shown in writes:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{shown} in checkpoint-marked scope "
+                    f"'{scope.name}' with no atomic-commit evidence: a "
+                    "crash mid-write leaves a torn file the next run "
+                    "loads as valid state — use "
+                    "utils/durability.atomic_write_bytes (or tmp + "
+                    "fsync + rename in this scope).",
                 )
 
 
@@ -851,6 +951,7 @@ class FallbackSwallows(Rule):
 
 
 RULES: List[Rule] = [
-    NoTimeout(), BareSleepRetry(), RenameNoFsync(), UnboundedRetry(),
-    UnboundedCache(), CutoverNoWatermark(), FallbackSwallows(),
+    NoTimeout(), BareSleepRetry(), RenameNoFsync(), NonatomicCheckpoint(),
+    UnboundedRetry(), UnboundedCache(), CutoverNoWatermark(),
+    FallbackSwallows(),
 ]

@@ -52,10 +52,18 @@ class PredictedResult:
 
 @dataclasses.dataclass
 class TrainingData:
-    """Labeled points (``DataSource.scala:59-61``)."""
+    """Labeled points (``DataSource.scala:59-61``).
+
+    ``entity_ids`` is row-aligned provenance: which entity each labeled
+    point was aggregated from. The continuous controller's fold path
+    needs it to translate a delta batch's entity ids into rows; eval
+    folds and hand-built fixtures may leave it ``None`` (fold-in then
+    refuses and the controller escalates to a full retrain).
+    """
 
     features: np.ndarray  # [N, D]
     labels: np.ndarray  # [N]
+    entity_ids: Tuple[str, ...] = None  # [N] source entity per row
 
     def sanity_check(self) -> None:
         if self.features.shape[0] == 0:
@@ -95,7 +103,9 @@ class ClassificationDataSource(DataSource):
         )
         feats: List[List[float]] = []
         labels: List[float] = []
+        entity_ids: List[str] = []
         for entity_id, props in sorted(props_by_entity.items()):
+            entity_ids.append(entity_id)
             labels.append(float(props.get(p.label_property)))
             feats.append([float(props.get(f)) for f in p.feature_properties])
         return TrainingData(
@@ -103,6 +113,7 @@ class ClassificationDataSource(DataSource):
                 len(labels), len(p.feature_properties)
             ),
             labels=np.asarray(labels),
+            entity_ids=tuple(entity_ids),
         )
 
     def read_eval(self, ctx):
@@ -113,7 +124,13 @@ class ClassificationDataSource(DataSource):
         for f in range(k):
             test = idx % k == f
             train_td = TrainingData(
-                features=td.features[~test], labels=td.labels[~test]
+                features=td.features[~test],
+                labels=td.labels[~test],
+                entity_ids=(
+                    tuple(np.asarray(td.entity_ids, object)[~test])
+                    if td.entity_ids is not None
+                    else None
+                ),
             )
             qa = [
                 (
@@ -133,6 +150,34 @@ class NaiveBayesParams(Params):
     lam: float = 1.0
 
 
+@dataclasses.dataclass
+class NaiveBayesModel:
+    """The ops-layer NB model plus the engine-generic fold surface.
+
+    The continuous controller's fold protocol is duck-typed: any model
+    exposing ``user_map``/``item_map`` (entity id → row) paired with an
+    algorithm exposing ``fold_in``/``fold_in_supported`` rides the same
+    decide → fold → persist loop ALS does — the controller itself has no
+    per-template code. Classification has one entity axis, so
+    ``item_map`` is always empty; ``user_map`` values are the training
+    rows the entities came from (membership is the contract the
+    controller reads, the indices are this model's provenance only).
+    """
+
+    nb: classifier.MultinomialNBModel
+    user_map: dict  # entity id -> training row
+    item_map: dict  # no second entity axis: always {}
+
+    def predict(self, features) -> float:
+        return self.nb.predict(features)
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        return self.nb.predict_batch(features)
+
+    def sanity_check(self) -> None:
+        self.nb.sanity_check()
+
+
 class NaiveBayesAlgorithm(Algorithm):
     """Multinomial NB on device (``NaiveBayesAlgorithm.scala:19-27``)."""
 
@@ -141,8 +186,89 @@ class NaiveBayesAlgorithm(Algorithm):
     def __init__(self, params: NaiveBayesParams = NaiveBayesParams()):
         self.params = params
 
-    def train(self, ctx, pd: TrainingData) -> classifier.MultinomialNBModel:
-        return classifier.train(pd.features, pd.labels, lam=self.params.lam)
+    def train(self, ctx, pd: TrainingData) -> NaiveBayesModel:
+        nb = classifier.train(pd.features, pd.labels, lam=self.params.lam)
+        ents = pd.entity_ids if getattr(pd, "entity_ids", None) else ()
+        return NaiveBayesModel(
+            nb=nb,
+            user_map={e: i for i, e in enumerate(ents)},
+            item_map={},
+        )
+
+    @property
+    def fold_in_supported(self) -> bool:
+        """Multinomial NB's sufficient statistics are additive, so
+        folding new labeled entities is EXACT (identical to a retrain on
+        the union) — the cheapest possible fold path."""
+        return True
+
+    def fold_in(
+        self,
+        ctx,
+        model: NaiveBayesModel,
+        pd: TrainingData,
+        changed_user_ids,
+        changed_item_ids,
+        policy=None,
+    ):
+        """Fold changed/new entities' labeled points into the model by
+        adding their scatter-add statistics (:func:`classifier.fold_in`).
+        New entities are exact; a re-``$set`` entity is approximate (its
+        old row still contributes) — the controller's RMSE-drift gate
+        judges that. Returns ``(NaiveBayesModel, FoldInStats)`` where the
+        "rmse" fields carry the classification analogue: full-data error
+        rate before/after the fold.
+        """
+        from ..continuous.foldin import FoldInStats
+
+        if getattr(pd, "entity_ids", None) is None:
+            raise ValueError(
+                "prepared data has no entity_ids; cannot map the delta "
+                "batch to labeled rows — full retrain instead"
+            )
+        row_of = {e: i for i, e in enumerate(pd.entity_ids)}
+        # classification has one entity axis: fold whatever axis the
+        # delta names (the controller passes both verbatim)
+        changed = [
+            e
+            for e in dict.fromkeys(
+                tuple(changed_user_ids) + tuple(changed_item_ids)
+            )
+            if e in row_of
+        ]
+        new = [e for e in changed if e not in model.user_map]
+        rows = np.asarray([row_of[e] for e in changed], dtype=np.int64)
+        before = self._error_rate(model.nb, pd)
+        nb = (
+            classifier.fold_in(model.nb, pd.features[rows], pd.labels[rows])
+            if len(rows)
+            else model.nb
+        )
+        after = self._error_rate(nb, pd)
+        user_map = dict(model.user_map)
+        for e in new:
+            user_map[e] = row_of[e]
+        folded = NaiveBayesModel(
+            nb=nb, user_map=user_map, item_map=dict(model.item_map)
+        )
+        stats = FoldInStats(
+            folded_users=len(rows),
+            folded_items=0,
+            new_users=len(new),
+            new_items=0,
+            rmse_before=before,
+            rmse_after=after,
+        )
+        return folded, stats
+
+    @staticmethod
+    def _error_rate(nb: classifier.MultinomialNBModel, pd: TrainingData) -> float:
+        """Full-data misclassification rate — the drift measure the fold
+        policy's ``max_rmse_drift`` gates on for this template."""
+        if pd.labels.shape[0] == 0:
+            return 0.0
+        pred = nb.predict_batch(np.asarray(pd.features, np.float32))
+        return float(np.mean(pred != np.asarray(pd.labels)))
 
     def predict(self, model, query: Query) -> PredictedResult:
         return PredictedResult(label=model.predict(query.features))

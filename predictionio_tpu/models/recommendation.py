@@ -226,12 +226,17 @@ class ALSAlgorithmParams(Params):
     #: ``PIO_TRAIN_SHARDS`` (what ``pio train --shards N`` sets), else 1 —
     #: the single-device trainer, byte-identical config resolution to
     #: today's path. Mutually exclusive with ``distributed`` (the
-    #: pjit-annotation path) and ``checkpoint_every`` — conflicts fail
-    #: loudly at train time, never silently pick one.
+    #: pjit-annotation path) — conflicts fail loudly at train time,
+    #: never silently pick one.
     shards: Optional[int] = None
-    #: checkpoint factor tables every N iterations (0 = off); a rerun of the
-    #: same workflow resumes from the newest step
-    checkpoint_every: int = 0
+    #: checkpoint factor tables every N iterations; a rerun of the same
+    #: workflow resumes from the newest valid step. Tri-state
+    #: (ckpt.resolve_every): explicit N (0 = explicitly off) wins, None
+    #: resolves from the workflow run (``pio train --checkpoint-every``),
+    #: else ``PIO_CKPT_EVERY``, else off. With ``shards > 1`` the
+    #: sharded trainer snapshots canonical row order, so the resume
+    #: shard count is free to differ (docs/checkpoint.md).
+    checkpoint_every: Optional[int] = None
     #: "auto" | "chunked" | "two_phase" | "pallas" — see
     #: ops.als.ALSConfig.solve_mode ("auto" picks the fused pallas
     #: Cholesky kernel on a single-chip TPU run, "chunked" elsewhere)
@@ -376,9 +381,17 @@ class ALSAlgorithm(Algorithm):
             sort_gather_indices=p.sort_gather_indices,
             fused_gather=p.fused_gather,
         )
+        from ..ckpt import resolve_every, resolve_resume
         from ..ops.als_sharded import als_train_sharded, resolve_shards
 
         shards = resolve_shards(p.shards)
+        # checkpoint cadence: params > workflow run (--checkpoint-every /
+        # the continuous retrain config) > PIO_CKPT_EVERY > off; an
+        # invalid value refuses here, at train time
+        every = resolve_every(
+            p.checkpoint_every,
+            workflow=getattr(ctx, "checkpoint_every", None),
+        )
         if shards > 1:
             # the ALX-style sharded data plane (docs/distributed_training
             # .md): both factor tables sharded over the mesh data axis.
@@ -390,12 +403,19 @@ class ALSAlgorithm(Algorithm):
                     "exclusive: the sharded trainer builds its own mesh "
                     "(pass one or the other)"
                 )
-            if p.checkpoint_every > 0:
-                raise ValueError(
-                    "checkpoint_every is not supported with shards > 1 "
-                    "yet (sharded step-resume is hardware-day headroom, "
-                    "docs/distributed_training.md#headroom)"
-                )
+            store = None
+            if every > 0 and ctx is not None:
+                store_factory = getattr(ctx, "checkpoint_store", None)
+                if store_factory:
+                    # one namespace per algorithm slot, disjoint from the
+                    # single-device manager's: the canonical-row store
+                    # and the pytree manager must never read each other
+                    store = store_factory(
+                        subdir="algo_"
+                        f"{getattr(ctx, 'algorithm_index', 0)}_sharded"
+                    )
+                if store is not None and not resolve_resume():
+                    store.clear()  # --no-resume: train fresh
             factors = als_train_sharded(
                 pd.users,
                 pd.items,
@@ -404,6 +424,8 @@ class ALSAlgorithm(Algorithm):
                 n_items=len(pd.item_map),
                 cfg=cfg,
                 shards=shards,
+                checkpoint=store,
+                checkpoint_every=every if store is not None else 0,
             )
             model = ALSModel(
                 rank=p.rank,
@@ -416,7 +438,7 @@ class ALSAlgorithm(Algorithm):
             return model
         mesh = ctx.mesh if (p.distributed and ctx is not None) else None
         checkpoint = None
-        if p.checkpoint_every > 0 and ctx is not None:
+        if every > 0 and ctx is not None:
             manager_factory = getattr(ctx, "checkpoint_manager", None)
             if manager_factory:
                 # one namespace per algorithm slot: a second ALS block in the
@@ -424,6 +446,14 @@ class ALSAlgorithm(Algorithm):
                 checkpoint = manager_factory(
                     subdir=f"algo_{getattr(ctx, 'algorithm_index', 0)}"
                 )
+                if checkpoint is not None and not resolve_resume():
+                    import os
+                    import shutil
+
+                    # --no-resume: train fresh (the manager recreates
+                    # the empty dir it expects to list)
+                    shutil.rmtree(checkpoint.directory, ignore_errors=True)
+                    os.makedirs(checkpoint.directory, exist_ok=True)
         factors = als_train_coo(
             pd.users,
             pd.items,
@@ -434,7 +464,7 @@ class ALSAlgorithm(Algorithm):
             mesh=mesh,
             factor_sharding=p.factor_sharding,
             checkpoint=checkpoint,
-            checkpoint_every=p.checkpoint_every,
+            checkpoint_every=every,
         )
         model = ALSModel(
             rank=p.rank,

@@ -38,6 +38,7 @@ __all__ = [
     "append_record",
     "bench_to_record",
     "cache_records",
+    "ckpt_records",
     "comparable_key",
     "detect_regressions",
     "find_no_prior",
@@ -145,7 +146,7 @@ def bench_to_record(bench: dict, source: str = "bench") -> dict:
                 "iterations", "nnz", "error", "jit", "servingFleet",
                 "quality", "bf16_gate", "ingestScaling", "cachedFleet",
                 "shardedTrain", "migrationDrill", "sharedCache",
-                "quantServe",
+                "quantServe", "ckptResume",
             )
             if key in bench
         },
@@ -618,6 +619,53 @@ def sharded_records(bench: dict, source: str = "bench") -> List[dict]:
             record["noise_band"] = 0.5
             out.append(record)
     return out
+
+
+def ckpt_records(bench: dict, source: str = "bench") -> List[dict]:
+    """The preemption-drill numbers a bench run attached
+    (``bench["ckptResume"]``, from the SIGKILL + cross-shard-resume
+    subprocess drive — docs/checkpoint.md#preemption-drill) as
+    trend-only ledger records:
+
+    - ``train_ckpt_overhead_ratio`` — checkpointed wall / plain wall of
+      the same recipe at the same shard count (unit ``ratio``,
+      deliberately NOT ``s``: the gate only compares lower-is-better
+      ``s``/``bytes`` units, and the cost of never losing a run must
+      never fail a perf gate on a contended CI box — the trajectory is
+      the product). The resume wall, snapshot seconds, writer counters
+      and the factor-equivalence evidence ride in ``extra`` so a
+      creeping overhead or a tolerance near-miss is visible in history.
+
+    The metric name is this family's namespace: ``comparable_key``
+    groups by metric first, so these records can never gate — or be
+    gated by — the ``train_sharded_s``/``quant``/``fleet`` families.
+    A failed drill (``ok`` false) records nothing — its ratio measured
+    a broken resume, not the writer."""
+    block = bench.get("ckptResume")
+    if not isinstance(block, dict) or not block.get("ok"):
+        return []
+    ratio = block.get("overheadRatio")
+    if not isinstance(ratio, (int, float)) or ratio <= 0:
+        return []
+    return [
+        make_record(
+            source=source,
+            metric="train_ckpt_overhead_ratio",
+            value=float(ratio),
+            unit="ratio",
+            device=block.get("device"),
+            scale=block.get("resumeShards"),
+            extra={
+                k: block[k]
+                for k in (
+                    "trainShards", "killStep", "resumedFrom", "resumeS",
+                    "plainS", "ckptS", "snapshotS", "written", "dropped",
+                    "errors", "maxAbsDiff",
+                )
+                if k in block
+            },
+        )
+    ]
 
 
 def lint_records(bench: dict, source: str = "bench") -> List[dict]:

@@ -526,6 +526,8 @@ def als_train_sharded(
     shards: Optional[int] = None,
     mesh=None,
     devices=None,
+    checkpoint=None,
+    checkpoint_every: int = 0,
     profile: Optional[dict] = None,
 ) -> ALSFactors:
     """Train ALS with both factor tables sharded over ``shards`` devices.
@@ -540,19 +542,45 @@ def als_train_sharded(
     ``initialize_from_env()`` (docs/hardware_day.md#multi-host-train);
     single-host runs build a mesh over the first ``shards`` devices.
 
+    ``checkpoint`` (a :class:`~predictionio_tpu.ckpt.CheckpointStore`)
+    enables sharded step-resume (docs/checkpoint.md): every
+    ``checkpoint_every`` iterations both factor tables are snapshotted to
+    host in CANONICAL (global, unpermuted) row order and committed by a
+    background writer thread — the loop never stalls on disk. Because
+    the snapshot is canonical, resume re-deals rows through the balancer
+    at ANY shard count: a run checkpointed at N shards resumes at M and
+    lands within the PR-12 reassociation tolerances of the uninterrupted
+    run. Resuming against a mismatched recipe raises
+    :class:`~predictionio_tpu.ckpt.CheckpointMismatch` (loud refusal); a
+    corrupt step is skipped loudly to the previous valid one. When a
+    store is passed, ``shards=1`` runs the sharded loop on a one-device
+    mesh instead of delegating (the ckpt contract is tolerance-bounded,
+    not byte-identical, and owns every shard count uniformly).
+
     ``profile`` receives the resolved levers (+ ``shards``), per-iteration
-    wall clock, and the ``shard_plan`` balance evidence (per-shard FLOPs,
+    wall clock, the ``shard_plan`` balance evidence (per-shard FLOPs,
     imbalance ratio, rows per shard) — the per-host bucket stats the
-    hardware-day drive prints to confirm balance on real silicon.
+    hardware-day drive prints to confirm balance on real silicon — and,
+    when checkpointing, a ``ckpt`` block (written/dropped/errors counts,
+    snapshot seconds, the step resumed from).
     """
     import time as _time
 
     if cfg.iterations < 1:
         raise ValueError(f"ALS iterations must be >= 1, got {cfg.iterations}")
+    if checkpoint_every < 0:
+        raise ValueError(
+            f"checkpoint_every must be >= 0, got {checkpoint_every}"
+        )
+    if checkpoint_every > 0 and checkpoint is None:
+        raise ValueError(
+            "checkpoint_every > 0 needs a checkpoint store — pass "
+            "checkpoint=CheckpointStore(dir) (docs/checkpoint.md)"
+        )
     n = resolve_shards(shards)
     if mesh is not None:
         n = int(mesh.shape[SHARD_AXIS])
-    if n == 1:
+    if n == 1 and checkpoint is None:
         # Degenerate path: byte-identical config resolution to today's
         # trainer — same bucketize call, same als_train, same profile
         # fields (plus the resolved shard count).
@@ -604,12 +632,70 @@ def als_train_sharded(
     user_slabs = tuple(tuple(put(a) for a in slab) for slab in user_slabs_np)
     item_slabs = tuple(tuple(put(a) for a in slab) for slab in item_slabs_np)
 
+    # Sharded step-resume (docs/checkpoint.md#resume-contract): the
+    # config identity a checkpoint must match to be resumable. The shard
+    # count is deliberately ABSENT — snapshots are canonical row order,
+    # so any N resumes at any M; the balancer re-deals above.
+    ck_meta = {
+        "rank": cfg.rank,
+        "lambda": cfg.lambda_,
+        "alpha": cfg.alpha,
+        "implicit": cfg.implicit_prefs,
+        "seed": cfg.seed,
+        "nnz": int(len(ratings)),
+        "n_users": int(n_users),
+        "n_items": int(n_items),
+    }
+    start_iter = 0
+    y_canonical = None
+    if checkpoint is not None:
+        # mismatched recipe → CheckpointMismatch propagates (loud
+        # refusal); corrupt steps are skipped + counted inside load()
+        loaded = checkpoint.load(
+            expect_meta=ck_meta, max_step=cfg.iterations
+        )
+        if loaded is not None:
+            x_canonical = np.asarray(loaded.arrays["x"], np.float32)
+            y_canonical = np.asarray(loaded.arrays["y"], np.float32)
+            if x_canonical.shape != (n_users, rank) or (
+                y_canonical.shape != (n_items, rank)
+            ):
+                from ..ckpt import CheckpointMismatch
+
+                raise CheckpointMismatch(
+                    f"step {loaded.step}: factor shapes "
+                    f"{x_canonical.shape}/{y_canonical.shape} do not "
+                    f"match this run's ({n_users}, {rank})/"
+                    f"({n_items}, {rank})"
+                )
+            start_iter = int(loaded.meta.get("iteration", loaded.step))
+            if profile is not None:
+                profile["ckpt"] = {"resumedFrom": start_iter}
+            if start_iter >= cfg.iterations:
+                # the interrupted run had already finished its sweeps —
+                # nothing to train, return the checkpointed factors
+                if profile is not None:
+                    profile["stage_s"] = _time.monotonic() - t_stage
+                    profile["shards"] = n
+                    profile["iteration_s"] = []  # zero sweeps re-run
+                    profile.update(levers)
+                return ALSFactors(
+                    user_factors=jnp.asarray(x_canonical),
+                    item_factors=jnp.asarray(y_canonical),
+                    rank=rank,
+                )
+
     # MLlib iteration order: item factors initialize, users solve first.
     # The SAME global init the single-device trainer mints, permuted —
     # every global row starts from the identical value at any shard count.
+    # On resume the checkpointed canonical table replaces the init: the
+    # loop consumes only y at an iteration boundary, so restoring y is
+    # the complete sweep state (x is re-solved from it immediately).
     y = jax.device_put(
         _permuted_table(
-            np.asarray(init_factors(n_items, rank, cfg.seed)), item_plan
+            np.asarray(init_factors(n_items, rank, cfg.seed))
+            if y_canonical is None else y_canonical,
+            item_plan,
         ),
         table_sharding,
     )
@@ -653,20 +739,61 @@ def als_train_sharded(
     from ..obs.profile import default_telemetry
 
     _telemetry = default_telemetry()
+    writer = None
+    if checkpoint is not None and checkpoint_every > 0:
+        from ..ckpt import CheckpointWriter, resolve_queue_depth
+
+        writer = CheckpointWriter(
+            checkpoint, queue_depth=resolve_queue_depth()
+        )
+    snapshot_s = 0.0
     x = None
-    for _ in range(cfg.iterations):
-        t_iter = _time.monotonic()
-        x = _telemetry.call(
-            "als_sharded_half", _half_sharded, y, user_slabs, lam, alpha,
-            cap_x=user_plan.cap, **common,
-        )
-        y = _telemetry.call(
-            "als_sharded_half", _half_sharded, x, item_slabs, lam, alpha,
-            cap_x=item_plan.cap, **common,
-        )
-        if profile is not None:
-            jax.block_until_ready((x, y))
-            profile["iteration_s"].append(_time.monotonic() - t_iter)
+    try:
+        _ix_user = np.arange(n_users)
+        _ix_item = np.arange(n_items)
+        for it in range(start_iter, cfg.iterations):
+            t_iter = _time.monotonic()
+            x = _telemetry.call(
+                "als_sharded_half", _half_sharded, y, user_slabs, lam,
+                alpha, cap_x=user_plan.cap, **common,
+            )
+            y = _telemetry.call(
+                "als_sharded_half", _half_sharded, x, item_slabs, lam,
+                alpha, cap_x=item_plan.cap, **common,
+            )
+            if profile is not None:
+                jax.block_until_ready((x, y))
+                profile["iteration_s"].append(_time.monotonic() - t_iter)
+            done = it + 1
+            if writer is not None and (
+                done % checkpoint_every == 0 or done == cfg.iterations
+            ):
+                # snapshot in CANONICAL row order — the layout any shard
+                # count can re-permute — on the train thread (one host
+                # gather per table); the disk write happens on the
+                # writer thread behind the bounded queue
+                t_snap = _time.monotonic()
+                snap = {
+                    "x": np.asarray(x)[user_plan.flat_index(_ix_user)],
+                    "y": np.asarray(y)[item_plan.flat_index(_ix_item)],
+                }
+                meta = {**ck_meta, "iteration": done}
+                if done == cfg.iterations:
+                    # the final checkpoint is the run's durable result —
+                    # it waits for a queue slot instead of dropping
+                    writer.flush_submit(done, snap, meta)
+                else:
+                    writer.submit(done, snap, meta)
+                snapshot_s += _time.monotonic() - t_snap
+    finally:
+        if writer is not None:
+            stats = writer.close()
+            if profile is not None:
+                ck_prof = profile.setdefault("ckpt", {})
+                ck_prof.update(stats)
+                ck_prof["snapshotS"] = round(snapshot_s, 4)
+                ck_prof["corruptSkipped"] = checkpoint.corrupt_skipped
+                ck_prof.setdefault("resumedFrom", None)
 
     # permuted sharded layout → global row order (host-side unpermute)
     uf = np.asarray(x)[user_plan.flat_index(np.arange(n_users))]

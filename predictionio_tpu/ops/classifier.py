@@ -31,11 +31,22 @@ class MultinomialNBModel:
     ``class_values`` holds the original label values (MLlib labels are
     doubles, e.g. the "plan" property); row ``c`` of ``pi``/``theta``
     corresponds to ``class_values[c]``.
+
+    ``counts``/``sums`` are the per-class sufficient statistics the
+    parameters were derived from. Because multinomial NB's statistics
+    are ADDITIVE over examples, keeping them makes :func:`fold_in`
+    exact: folding new examples produces bit-for-bit the model a full
+    retrain on the union would — the property the continuous
+    controller's fold path leans on (docs/continuous.md). ``None`` on a
+    model deserialized from before they existed; fold_in refuses those.
     """
 
     class_values: np.ndarray  # [C] original label values
     pi: np.ndarray  # [C] log priors
     theta: np.ndarray  # [C, D] log feature likelihoods
+    counts: np.ndarray = None  # [C] per-class example counts
+    sums: np.ndarray = None  # [C, D] per-class feature sums
+    lam: float = 1.0  # the smoothing the parameters were built with
 
     def predict(self, features: Sequence[float]) -> float:
         return float(self.predict_batch(np.asarray(features)[None])[0])
@@ -95,10 +106,85 @@ def train(
     counts = np.asarray(counts, np.float64)
     sums = np.asarray(sums, np.float64)
 
+    return _from_stats(class_values, counts, sums, lam)
+
+
+def _from_stats(
+    class_values: np.ndarray,
+    counts: np.ndarray,  # [C] float64
+    sums: np.ndarray,  # [C, D] float64
+    lam: float,
+) -> MultinomialNBModel:
+    """Derive (pi, theta) from sufficient statistics — the single place
+    the smoothing formulas live, so train and fold can't drift apart."""
+    n = counts.sum()
+    n_classes, d = sums.shape
     pi = np.log(counts + lam) - np.log(n + lam * n_classes)
     theta = np.log(sums + lam) - np.log(
         sums.sum(axis=1, keepdims=True) + lam * d
     )
     return MultinomialNBModel(
-        class_values=class_values, pi=pi, theta=theta
+        class_values=class_values,
+        pi=pi,
+        theta=theta,
+        counts=counts,
+        sums=sums,
+        lam=lam,
     )
+
+
+def fold_in(
+    model: MultinomialNBModel,
+    features: np.ndarray,  # [M, D] new examples' feature values
+    labels: np.ndarray,  # [M] new examples' label values
+) -> MultinomialNBModel:
+    """Fold new labelled examples into a trained model without a retrain.
+
+    Adds the examples' scatter-add statistics to the model's retained
+    ``counts``/``sums`` and re-derives (pi, theta) with the same
+    smoothing — for examples not in the original training set this is
+    EXACT: identical to retraining on the union. Unseen label values
+    extend the class axis (a zero-stat row plus the new examples).
+
+    Re-folding an entity whose properties changed is approximate (its
+    old contribution is still in the statistics); the caller measures
+    that drift against its fold policy.
+    """
+    if model.counts is None or model.sums is None:
+        raise ValueError(
+            "model has no sufficient statistics (trained before they were "
+            "retained?) — fold_in needs counts/sums; retrain instead"
+        )
+    features = np.asarray(features, np.float64)
+    labels = np.asarray(labels)
+    if features.ndim != 2 or features.shape[0] != labels.shape[0]:
+        raise ValueError(
+            f"features {features.shape} and labels {labels.shape} mismatch"
+        )
+    if features.shape[1] != model.sums.shape[1]:
+        raise ValueError(
+            f"feature dimension {features.shape[1]} != model's "
+            f"{model.sums.shape[1]}"
+        )
+    if (features < 0).any():
+        raise ValueError(
+            "Multinomial NaiveBayes requires non-negative feature values"
+        )
+    class_values = model.class_values
+    counts = np.array(model.counts, np.float64)
+    sums = np.array(model.sums, np.float64)
+    fresh = np.setdiff1d(np.unique(labels), class_values)
+    if fresh.size:
+        class_values = np.concatenate([class_values, fresh])
+        order = np.argsort(class_values, kind="stable")
+        class_values = class_values[order]
+        grown_counts = np.concatenate([counts, np.zeros(fresh.size)])
+        grown_sums = np.concatenate(
+            [sums, np.zeros((fresh.size, sums.shape[1]))]
+        )
+        counts, sums = grown_counts[order], grown_sums[order]
+    # M is a delta batch (small); plain numpy scatter-add beats a jit
+    row = np.searchsorted(class_values, labels)
+    np.add.at(counts, row, 1.0)
+    np.add.at(sums, row, features)
+    return _from_stats(class_values, counts, sums, model.lam)

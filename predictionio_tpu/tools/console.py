@@ -666,6 +666,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ln.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    ck = sub.add_parser(
+        "ckpt",
+        help="checkpoint store: ls | verify | gc "
+        "(docs/checkpoint.md#operator-surface)",
+        # the ckpt CLI owns its option surface (ckpt/cli.py) — forwarded
+        # verbatim like lint/perf
+        add_help=False,
+    )
+    ck.add_argument("ckpt_args", nargs=argparse.REMAINDER)
+
     top = sub.add_parser(
         "top",
         help="fleet table: scrape GET /metrics from a node list "
@@ -823,6 +833,14 @@ _WORKFLOW_FLAGS = [
     ("--shards", {"type": int, "default": None, "metavar": "N",
                   "help": "train with both factor tables sharded over N "
                           "devices (docs/distributed_training.md)"}),
+    ("--checkpoint-every", {"type": int, "default": None, "metavar": "N",
+                            "help": "checkpoint factor tables every N "
+                                    "iterations (docs/checkpoint.md)"}),
+    ("--resume", {"default": None,
+                  "action": argparse.BooleanOptionalAction,
+                  "help": "resume from the newest valid checkpoint "
+                          "(default); --no-resume trains fresh "
+                          "(docs/checkpoint.md)"}),
 ]
 
 
@@ -909,6 +927,10 @@ def _workflow_argv(args: argparse.Namespace, extra: Sequence[str] = ()) -> List[
         # forward an explicit 0 too: it must fail loudly in
         # resolve_shards, never silently train single-device
         argv += ["--shards", str(args.shards)]
+    if getattr(args, "checkpoint_every", None) is not None:
+        argv += ["--checkpoint-every", str(args.checkpoint_every)]
+    if getattr(args, "resume", None) is not None:
+        argv.append("--resume" if args.resume else "--no-resume")
     return argv + list(extra)
 
 
@@ -931,6 +953,14 @@ def main(
 
         tail = list(sys.argv[2:] if argv is None else argv[1:])
         return lint_mod.main(tail)
+    if head == ["ckpt"]:
+        # forwarded verbatim like lint: the ckpt CLI owns its option
+        # surface (ckpt/cli.py) and is pure filesystem — it must work on
+        # an unconfigured host, the box you ssh into after a preemption.
+        from ..ckpt import cli as ckpt_cli
+
+        tail = list(sys.argv[2:] if argv is None else argv[1:])
+        return ckpt_cli.main(tail)
     if head == ["quality"]:
         # forwarded verbatim like lint/perf: the quality CLI owns its
         # whole option surface (tools/quality.py) and needs neither the

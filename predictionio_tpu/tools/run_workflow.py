@@ -56,6 +56,20 @@ def build_parser() -> argparse.ArgumentParser:
              "tri-state resolves from — an explicit engine.json value "
              "still wins",
     )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=None, metavar="N",
+        help="checkpoint factor tables every N iterations "
+             "(docs/checkpoint.md); the run's override in the "
+             "checkpoint_every tri-state — an explicit engine.json "
+             "value still wins, PIO_CKPT_EVERY is the fleet default",
+    )
+    p.add_argument(
+        "--resume", default=None, action=argparse.BooleanOptionalAction,
+        help="resume from the newest valid checkpoint (default; a "
+             "mismatched recipe refuses loudly). --no-resume clears "
+             "existing checkpoints and trains fresh. Env default: "
+             "PIO_CKPT_RESUME",
+    )
     return p
 
 
@@ -65,6 +79,15 @@ def run(
     """Execute one train or eval run; returns the instance id
     (``CreateWorkflow.main``, ``CreateWorkflow.scala:142-279``)."""
     loader.modify_logging(args.verbose)
+    fn = lambda: _run_inner(args, registry)  # noqa: E731
+    if getattr(args, "resume", None) is not None:
+        # env-driven like --shards below, so --spawn and in-process runs
+        # behave identically; scoped to this run
+        from ..ckpt import RESUME_ENV
+
+        fn = (lambda inner: lambda: _with_env(
+            RESUME_ENV, "1" if args.resume else "0", inner
+        ))(fn)
     if getattr(args, "shards", None) is not None:
         # an explicit 0 must reach resolve_shards and fail loudly there
         # — a falsy check would silently train single-device
@@ -75,10 +98,10 @@ def run(
         # flag into a later train in the same process.
         from ..ops.als_sharded import SHARDS_ENV
 
-        return _with_env(
-            SHARDS_ENV, str(args.shards), lambda: _run_inner(args, registry)
-        )
-    return _run_inner(args, registry)
+        fn = (lambda inner: lambda: _with_env(
+            SHARDS_ENV, str(args.shards), inner
+        ))(fn)
+    return fn()
 
 
 def _with_env(key: str, value: str, fn):
@@ -104,6 +127,7 @@ def _run_inner(
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
         eval_parallelism=args.eval_parallelism,
+        checkpoint_every=getattr(args, "checkpoint_every", None),
     )
 
     # runtimeConf binds to every workflow run, train AND eval — the

@@ -15,6 +15,7 @@ on checkpoint-library APIs; any pytree of numpy/jax arrays round-trips.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
@@ -23,7 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.durability import fsync_dir as _fsync_dir
+from ..utils.durability import atomic_write_bytes, fsync_dir as _fsync_dir
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _SEP = "/"
@@ -112,19 +113,17 @@ class CheckpointManager:
             shutil.rmtree(d)  # replace an incomplete/old attempt
         os.makedirs(d)
         flat = _flatten(tree)
-        np.savez(os.path.join(d, "arrays.npz"), **flat)
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump(metadata or {}, f)
-        # Durability ordering: data files (and the directory entry) must hit
-        # disk before the _COMPLETE marker, or a power loss can leave a
+        # Durability ordering: every data file commits atomically
+        # (tmp + fsync + rename, utils/durability.atomic_write_bytes)
+        # BEFORE the _COMPLETE marker, or a power loss can leave a
         # durable marker pointing at garbage.
-        for name in ("arrays.npz", "meta.json"):
-            fd = os.open(os.path.join(d, name), os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-        _fsync_dir(d)
+        buf = io.BytesIO()
+        np.savez(buf, **flat)
+        atomic_write_bytes(os.path.join(d, "arrays.npz"), buf.getvalue())
+        atomic_write_bytes(
+            os.path.join(d, "meta.json"),
+            json.dumps(metadata or {}).encode("utf-8"),
+        )
         with open(os.path.join(d, "_COMPLETE"), "w") as f:
             f.write("ok")
             f.flush()

@@ -54,7 +54,34 @@ class WorkflowContext:
         self.timer = StepTimer()
         #: set by the training workflow to the run's checkpoint directory;
         #: algorithms with step checkpointing call ``checkpoint_manager()``
+        #: (single-device pytree checkpoints) or ``checkpoint_store()``
+        #: (the sharded canonical-row store, docs/checkpoint.md)
         self.checkpoint_dir: Optional[str] = None
+        #: the workflow run's checkpoint-cadence override (``pio train
+        #: --checkpoint-every`` / the continuous controller's retrain
+        #: config); sits between the engine params' explicit value and
+        #: the ``PIO_CKPT_EVERY`` env in ``ckpt.resolve_every``
+        self.checkpoint_every: Optional[int] = None
+
+    def checkpoint_store(
+        self,
+        subdir: Optional[str] = None,
+        keep_last: Optional[int] = None,
+        keep_every: Optional[int] = None,
+    ):
+        """``ckpt.CheckpointStore`` for this run, or None when the
+        workflow did not assign a checkpoint directory. Same ``subdir``
+        namespacing contract as :meth:`checkpoint_manager`; retention
+        defaults resolve from ``PIO_CKPT_KEEP_LAST``/``_KEEP_EVERY``."""
+        if not self.checkpoint_dir:
+            return None
+        from ..ckpt import CheckpointStore, resolve_retention
+
+        kl, ke = resolve_retention(keep_last, keep_every)
+        d = self.checkpoint_dir
+        if subdir:
+            d = os.path.join(d, subdir)
+        return CheckpointStore(d, keep_last=kl, keep_every=ke)
 
     def checkpoint_manager(self, subdir: Optional[str] = None, keep: int = 3):
         """CheckpointManager for this run, or None when the workflow did not

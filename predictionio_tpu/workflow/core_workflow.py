@@ -69,18 +69,34 @@ def run_train(
     instance_id = md.engine_instance_insert(instance)
 
     ctx = ctx or WorkflowContext(mode="Training", batch=workflow_params.batch)
+    if ctx.checkpoint_every is None:
+        # per-run cadence override (`pio train --checkpoint-every`, the
+        # continuous controller's retrain config) — sits between the
+        # engine params and PIO_CKPT_EVERY in ckpt.resolve_every
+        ctx.checkpoint_every = getattr(
+            workflow_params, "checkpoint_every", None
+        )
     derived_checkpoint_dir = False
     if ctx.checkpoint_dir is None:
-        from ..storage.registry import base_dir
+        explicit_dir = os.environ.get("PIO_CKPT_DIR")
+        if explicit_dir:
+            # an operator-pinned checkpoint root (docs/checkpoint.md):
+            # NOT deleted on success — its retention is the store's GC
+            ctx.checkpoint_dir = explicit_dir
+        else:
+            from ..storage.registry import base_dir
 
-        # Stable across reruns of the same workflow (NOT the per-run
-        # instance id): a crashed run's rerun finds and resumes these
-        # checkpoints; a successful run deletes them below.
-        slug = re.sub(r"[^A-Za-z0-9_.-]", "_", workflow_params.batch) or "default"
-        ctx.checkpoint_dir = os.path.join(
-            base_dir(), "checkpoints", engine_id, engine_version, slug
-        )
-        derived_checkpoint_dir = True
+            # Stable across reruns of the same workflow (NOT the per-run
+            # instance id): a crashed run's rerun finds and resumes these
+            # checkpoints; a successful run deletes them below.
+            slug = (
+                re.sub(r"[^A-Za-z0-9_.-]", "_", workflow_params.batch)
+                or "default"
+            )
+            ctx.checkpoint_dir = os.path.join(
+                base_dir(), "checkpoints", engine_id, engine_version, slug
+            )
+            derived_checkpoint_dir = True
     try:
         from ..obs.profile import default_telemetry
         from ..utils.profiling import device_trace

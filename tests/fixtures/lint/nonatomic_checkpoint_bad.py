@@ -1,0 +1,22 @@
+"""Fixture: robust-nonatomic-checkpoint MUST fire on raw writes in
+checkpoint-marked scopes."""
+
+import json
+import os
+
+import numpy as np
+
+
+def save_checkpoint(path, arrays, meta):
+    # writes land on the final names directly: a crash mid-loop leaves
+    # torn .npy bytes the next run trusts as a valid checkpoint
+    for name, arr in arrays.items():
+        np.save(os.path.join(path, name + ".npy"), arr)  # BAD: direct save
+    with open(os.path.join(path, "meta.json"), "w") as fh:  # BAD: open w
+        json.dump(meta, fh)  # BAD: dump through the raw handle
+
+
+class Trainer:
+    def persist_state(self, path, state):
+        with open(path, "wb") as fh:  # BAD: open wb, no atomic evidence
+            fh.write(state)

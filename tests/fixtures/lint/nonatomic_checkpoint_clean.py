@@ -1,0 +1,42 @@
+"""Fixture twin: the same persistence shapes with atomic evidence stay
+clean — the packaged helper, or the manual tmp+fsync+rename sequence."""
+
+import json
+import os
+
+import numpy as np
+
+
+def atomic_write_bytes(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def save_checkpoint(path, arrays, meta):
+    # CLEAN: every write goes through the atomic helper
+    for name, arr in arrays.items():
+        atomic_write_bytes(os.path.join(path, name + ".npy"), arr.tobytes())
+    atomic_write_bytes(
+        os.path.join(path, "meta.json"), json.dumps(meta).encode()
+    )
+
+
+class Trainer:
+    def persist_state(self, path, state):
+        # CLEAN: the manual sequence — tmp write, fsync, rename — in scope
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(state)
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+
+def save_report(path, rows):
+    # CLEAN: a read in a save-marked scope is not write evidence
+    with open(path) as fh:
+        prior = json.load(fh)
+    return prior + rows

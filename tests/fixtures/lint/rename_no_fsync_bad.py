@@ -13,7 +13,7 @@ Fixture only: parsed by the linter, never imported or executed.
 import os
 
 
-def save_blob(path, data):
+def put_blob(path, data):
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         fh.write(data)

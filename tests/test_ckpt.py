@@ -1,0 +1,497 @@
+"""Checkpoint subsystem (predictionio_tpu/ckpt): the preemption
+contract, CI-sized.
+
+Four layers:
+
+1. **Store commit protocol**: manifest-last atomicity (a crash inside
+   the array-write window leaves NOTHING loadable), checksum verify on
+   load (corrupt = loud skip + counter, never a silent load), loud
+   config-mismatch refusal, GC retention math.
+2. **Background writer**: bounded queue that drops (and counts) under
+   backpressure rather than stalling an iteration, error containment.
+3. **Step-resume equivalence**: a run checkpointed at iteration 1 and
+   resumed to iteration 3 — at the SAME or a DIFFERENT shard count —
+   matches the uninterrupted twin within the PR-12 sharding tolerances
+   (canonical row order makes the shard count a free variable;
+   docs/checkpoint.md#resume-contract).
+4. **Operator surface**: ``pio ckpt ls|verify|gc`` exit codes and the
+   cadence/resume tri-state resolution.
+
+CI budget: every resume case reads one module-level cache over the
+test_sharded_train recipe (iterations=1 base + one resumed and one
+uninterrupted training per shard count), all on the conftest 8-device
+virtual CPU mesh — no subprocesses (the kill-mid-run drill lives in
+bench.py where wall-clock is budgeted).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ckpt import (
+    EVERY_ENV,
+    RESUME_ENV,
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    CheckpointStore,
+    CheckpointWriter,
+    resolve_every,
+    resolve_resume,
+)
+from predictionio_tpu.ckpt.cli import main as ckpt_main
+from predictionio_tpu.ops.als import ALSConfig
+from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+#: the PR-12 equivalence tolerances — resume re-deals canonical rows
+#: through the balancer, so the only drift is float reassociation
+RTOL, ATOL = 1e-3, 1e-4
+
+
+def _arrays(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(6, 4)).astype(np.float32),
+        "y": rng.normal(size=(5, 4)).astype(np.float32),
+    }
+
+
+META = {"rank": 4, "lambda": 0.1, "seed": 2}
+
+
+class TestCommitProtocol:
+    def test_save_load_roundtrip(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        arrays = _arrays()
+        store.save(3, arrays, {**META, "iteration": 3})
+        assert store.steps() == [3]
+        loaded = store.load(expect_meta=META)
+        assert loaded.step == 3
+        np.testing.assert_array_equal(loaded.arrays["x"], arrays["x"])
+        np.testing.assert_array_equal(loaded.arrays["y"], arrays["y"])
+        assert loaded.meta["iteration"] == 3
+
+    def test_crash_before_manifest_leaves_nothing_loadable(
+        self, tmp_path, monkeypatch
+    ):
+        """Kill the writer anywhere inside the array-write window: the
+        step dir exists but carries no manifest, so it is crash garbage
+        — invisible to steps()/load(), listed by uncommitted()."""
+        store = CheckpointStore(str(tmp_path))
+
+        def boom(d, step, files, meta):
+            raise KeyboardInterrupt("preempted mid-commit")
+
+        monkeypatch.setattr(store, "_commit_manifest", boom)
+        with pytest.raises(KeyboardInterrupt):
+            store.save(1, _arrays(), META)
+        assert store.steps() == []
+        assert store.load(expect_meta=META) is None
+        assert store.uncommitted() == ["step_00000001"]
+        monkeypatch.undo()
+        # the recovering run re-saves the same step over the garbage
+        store.save(1, _arrays(), {**META, "iteration": 1})
+        assert store.steps() == [1]
+        assert store.uncommitted() == []
+
+    def test_corrupt_checksum_is_skipped_loudly(self, tmp_path, caplog):
+        """A flipped bit in the newest step: load skips it (counted,
+        ERROR-logged), falls back to the older committed step, and
+        verify_step raises — a corrupt checkpoint is NEVER loaded."""
+        store = CheckpointStore(str(tmp_path))
+        store.save(1, _arrays(1), {**META, "iteration": 1})
+        store.save(2, _arrays(2), {**META, "iteration": 2})
+        target = os.path.join(store.step_dir(2), "x.npy")
+        blob = bytearray(open(target, "rb").read())
+        blob[-1] ^= 0xFF
+        with open(target, "wb") as fh:
+            fh.write(blob)
+        with pytest.raises(CheckpointCorrupt):
+            store.verify_step(2)
+        with caplog.at_level("ERROR"):
+            loaded = store.load(expect_meta=META)
+        assert loaded.step == 1
+        assert store.corrupt_skipped == 1
+        assert any("corrupt" in r.message.lower() for r in caplog.records)
+
+    def test_missing_file_is_corrupt(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.save(1, _arrays(), META)
+        os.unlink(os.path.join(store.step_dir(1), "y.npy"))
+        with pytest.raises(CheckpointCorrupt):
+            store.verify_step(1)
+        assert store.load(expect_meta=META) is None
+        assert store.corrupt_skipped == 1
+
+    def test_config_mismatch_refuses_loudly(self, tmp_path):
+        """A checkpoint from a different recipe must never silently
+        seed this run: the refusal names every differing key."""
+        store = CheckpointStore(str(tmp_path))
+        store.save(1, _arrays(), {**META, "iteration": 1})
+        with pytest.raises(CheckpointMismatch, match="lambda"):
+            store.load_step(1, expect_meta={**META, "lambda": 0.05})
+        # load() propagates the refusal rather than skipping: mismatch
+        # is an operator error, not corruption
+        with pytest.raises(CheckpointMismatch):
+            store.load(expect_meta={**META, "lambda": 0.05})
+
+    def test_verify_report(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.save(1, _arrays(1), META)
+        store.save(2, _arrays(2), META)
+        report = store.verify()
+        assert [r["step"] for r in report] == [1, 2]
+        assert all(r["ok"] for r in report)
+        assert all(r["files"] == 2 for r in report)
+
+
+class TestRetention:
+    def test_keep_last_k(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=3)
+        for s in range(1, 8):
+            store.save(s, _arrays(s), META)
+        assert store.steps() == [5, 6, 7]
+
+    def test_keep_every_j_survives_gc(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=2, keep_every=4)
+        for s in range(1, 11):
+            store.save(s, _arrays(s), META)
+        # newest 2 plus every 4th: 4 and 8 pinned for archaeology
+        assert store.steps() == [4, 8, 9, 10]
+
+    def test_gc_prunes_uncommitted_only_when_asked(
+        self, tmp_path, monkeypatch
+    ):
+        store = CheckpointStore(str(tmp_path), keep_last=2)
+        monkeypatch.setattr(
+            store, "_commit_manifest",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("crash")),
+        )
+        with pytest.raises(OSError):
+            store.save(9, _arrays(), META)
+        monkeypatch.undo()
+        store.save(10, _arrays(), META)
+        assert store.uncommitted() == ["step_00000009"]
+        store.gc()  # routine GC leaves crash evidence for inspection
+        assert store.uncommitted() == ["step_00000009"]
+        store.gc(prune_uncommitted=True)  # the explicit `pio ckpt gc`
+        assert store.uncommitted() == []
+        assert store.steps() == [10]
+
+
+class TestWriter:
+    def test_backpressure_drops_and_counts(self, tmp_path):
+        """A full queue must cost a DROPPED snapshot, never a stalled
+        iteration: gate the store's save, flood the queue, count."""
+        gate = threading.Event()
+
+        class SlowStore(CheckpointStore):
+            def save(self, step, arrays, meta):
+                gate.wait(timeout=30)
+                return super().save(step, arrays, meta)
+
+        store = SlowStore(str(tmp_path), keep_last=10)
+        w = CheckpointWriter(store, queue_depth=1)
+        assert w.submit(1, _arrays(1), META)  # dequeued, blocked in save
+        # poll until the worker holds step 1 (queue drained) so the
+        # depth-1 queue state is deterministic
+        for _ in range(1000):
+            if w._queue.empty():
+                break
+            threading.Event().wait(0.005)
+        assert w.submit(2, _arrays(2), META)  # fills the queue
+        assert not w.submit(3, _arrays(3), META)  # Full -> dropped
+        gate.set()
+        stats = w.close()
+        assert stats["written"] == 2
+        assert stats["dropped"] == 1
+        assert stats["errors"] == 0
+        assert store.steps() == [1, 2]
+
+    def test_save_error_is_contained(self, tmp_path):
+        class BrokenStore(CheckpointStore):
+            def save(self, step, arrays, meta):
+                raise OSError("disk gone")
+
+        w = CheckpointWriter(BrokenStore(str(tmp_path)), queue_depth=2)
+        w.flush_submit(1, _arrays(), META)
+        stats = w.close()
+        assert stats["errors"] == 1
+        assert "disk gone" in stats["lastError"]
+
+    def test_submit_after_close_is_refused(self, tmp_path):
+        w = CheckpointWriter(CheckpointStore(str(tmp_path)))
+        w.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            w.submit(1, _arrays(), META)
+
+
+# ---------------------------------------------------------------------------
+# step-resume equivalence (the tentpole's contract)
+# ---------------------------------------------------------------------------
+
+
+def _recipe():
+    rng = np.random.default_rng(7)
+    nnz, n_u, n_i = 6_000, 240, 100
+    w = 1.0 / np.arange(1, n_u + 1) ** 0.8
+    u = rng.choice(n_u, size=nnz, p=w / w.sum()).astype(np.int32)
+    i = rng.integers(0, n_i, nnz).astype(np.int32)
+    v = rng.integers(1, 6, nnz).astype(np.float32)
+    return u, i, v, n_u, n_i
+
+
+_CFG1 = ALSConfig(rank=8, iterations=1, lambda_=0.05, seed=2)
+_CFG3 = ALSConfig(rank=8, iterations=3, lambda_=0.05, seed=2)
+_CACHE: dict = {}
+
+
+@pytest.fixture(scope="module")
+def base_store(tmp_path_factory):
+    """One interrupted run: 4 shards, stopped after iteration 1 with a
+    committed checkpoint — the recipe's canonical factors. ``iterations``
+    is deliberately absent from the config identity, so resuming it to 3
+    iterations at ANY shard count is the legal continuation."""
+    root = str(tmp_path_factory.mktemp("ckpt") / "als")
+    store = CheckpointStore(root)
+    u, i, v, n_u, n_i = _recipe()
+    als_train_sharded(
+        u, i, v, n_u, n_i, _CFG1, shards=4,
+        checkpoint=store, checkpoint_every=1,
+    )
+    assert store.steps() == [1]
+    return store
+
+
+def _uninterrupted(shards):
+    key = ("full", shards)
+    if key not in _CACHE:
+        u, i, v, n_u, n_i = _recipe()
+        f = als_train_sharded(u, i, v, n_u, n_i, _CFG3, shards=shards)
+        _CACHE[key] = (
+            np.asarray(f.user_factors), np.asarray(f.item_factors)
+        )
+    return _CACHE[key]
+
+
+def _fork(base_store, tmp_path):
+    """A private copy of the interrupted run's store: the resumed run
+    commits steps 2/3 into its own fork, keeping the module-cached base
+    pristine for the other parametrizations."""
+    import shutil
+
+    dst = str(tmp_path / "fork")
+    shutil.copytree(base_store.root, dst)
+    return CheckpointStore(dst)
+
+
+class TestStepResume:
+    @pytest.mark.parametrize("resume_shards", [1, 2, 4])
+    def test_resume_matches_uninterrupted_twin(
+        self, base_store, tmp_path, resume_shards
+    ):
+        """Interrupted at 4 shards after iteration 1, resumed at
+        ``resume_shards`` to iteration 3: factors match the twin that
+        never died — N→M included, because the checkpoint stores
+        canonical (global-order) rows that the balancer re-deals."""
+        u, i, v, n_u, n_i = _recipe()
+        store = _fork(base_store, tmp_path)
+        profile: dict = {}
+        f = als_train_sharded(
+            u, i, v, n_u, n_i, _CFG3, shards=resume_shards,
+            checkpoint=store, checkpoint_every=1, profile=profile,
+        )
+        assert store.steps()[-1] == 3  # the fork carries the new steps
+        assert profile["ckpt"]["resumedFrom"] == 1
+        ref_u, ref_i = _uninterrupted(resume_shards)
+        np.testing.assert_allclose(
+            np.asarray(f.user_factors), ref_u, rtol=RTOL, atol=ATOL
+        )
+        np.testing.assert_allclose(
+            np.asarray(f.item_factors), ref_i, rtol=RTOL, atol=ATOL
+        )
+
+    def test_already_complete_returns_without_training(self, base_store):
+        """Resuming a run whose checkpoint already covers cfg.iterations
+        returns the checkpointed factors — zero iterations re-run."""
+        u, i, v, n_u, n_i = _recipe()
+        profile: dict = {}
+        f = als_train_sharded(
+            u, i, v, n_u, n_i, _CFG1, shards=2,
+            checkpoint=base_store, checkpoint_every=1, profile=profile,
+        )
+        assert profile["ckpt"]["resumedFrom"] == 1
+        assert profile["iteration_s"] == []
+        loaded = base_store.load_step(1, expect_meta=None)
+        np.testing.assert_array_equal(
+            np.asarray(f.user_factors), loaded.arrays["x"]
+        )
+
+    def test_mismatched_recipe_refuses(self, base_store):
+        """The same store fed to a different lambda: loud refusal, not a
+        silent warm start from the wrong model."""
+        u, i, v, n_u, n_i = _recipe()
+        with pytest.raises(CheckpointMismatch, match="lambda"):
+            als_train_sharded(
+                u, i, v, n_u, n_i,
+                ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=2),
+                shards=2, checkpoint=base_store, checkpoint_every=1,
+            )
+
+    def test_profile_ledgers_writer_stats(self, tmp_path):
+        u, i, v, n_u, n_i = _recipe()
+        store = CheckpointStore(str(tmp_path / "p"))
+        profile: dict = {}
+        als_train_sharded(
+            u, i, v, n_u, n_i, _CFG1, shards=2,
+            checkpoint=store, checkpoint_every=1, profile=profile,
+        )
+        ck = profile["ckpt"]
+        assert ck["written"] == 1
+        assert ck["dropped"] == 0
+        assert ck["errors"] == 0
+        assert ck["resumedFrom"] is None
+        assert ck["snapshotS"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# operator surface
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_cadence_precedence(self, monkeypatch):
+        monkeypatch.setenv(EVERY_ENV, "7")
+        assert resolve_every(None, workflow=None) == 7
+        assert resolve_every(None, workflow=5) == 5
+        assert resolve_every(2, workflow=5) == 2
+        assert resolve_every(0, workflow=5) == 0  # explicit off wins
+        monkeypatch.delenv(EVERY_ENV)
+        assert resolve_every(None, workflow=None) == 0
+
+    def test_invalid_cadence_fails_loudly(self, monkeypatch):
+        with pytest.raises(ValueError):
+            resolve_every(-1)
+        monkeypatch.setenv(EVERY_ENV, "three")
+        with pytest.raises(ValueError):
+            resolve_every(None)
+
+    def test_resume_default_on(self, monkeypatch):
+        monkeypatch.delenv(RESUME_ENV, raising=False)
+        assert resolve_resume() is True
+        monkeypatch.setenv(RESUME_ENV, "0")
+        assert resolve_resume() is False
+        assert resolve_resume(True) is True  # explicit beats env
+
+
+class TestCkptCLI:
+    def _seeded(self, tmp_path):
+        store = CheckpointStore(str(tmp_path / "s"), keep_last=10)
+        for s in (1, 2, 3):
+            store.save(s, _arrays(s), {**META, "iteration": s})
+        return store
+
+    def test_ls_json(self, tmp_path, capsys):
+        store = self._seeded(tmp_path)
+        assert ckpt_main(["ls", "--dir", store.root, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert [s["step"] for s in doc["steps"]] == [1, 2, 3]
+
+    def test_verify_exit_codes(self, tmp_path, capsys):
+        store = self._seeded(tmp_path)
+        assert ckpt_main(["verify", "--dir", store.root]) == 0
+        target = os.path.join(store.step_dir(2), "x.npy")
+        with open(target, "ab") as fh:
+            fh.write(b"junk")
+        assert ckpt_main(["verify", "--dir", store.root]) == 1
+        out = capsys.readouterr().out
+        assert "corrupt" in out.lower()
+
+    def test_gc_applies_retention(self, tmp_path, capsys):
+        store = self._seeded(tmp_path)
+        assert ckpt_main(
+            ["gc", "--dir", store.root, "--keep-last", "1"]
+        ) == 0
+        assert CheckpointStore(store.root).steps() == [3]
+
+    def test_missing_dir_is_an_error(self, tmp_path, capsys):
+        assert ckpt_main(
+            ["ls", "--dir", str(tmp_path / "nope")]
+        ) != 0
+
+    def test_console_forwards_ckpt(self, tmp_path, capsys):
+        """``pio ckpt`` head-forwards before argparse/platform setup —
+        the same jax-free dispatch lint and perf use."""
+        from predictionio_tpu.tools.console import main as pio_main
+
+        store = self._seeded(tmp_path)
+        assert pio_main(["ckpt", "ls", "--dir", store.root]) == 0
+        assert "files" in capsys.readouterr().out
+
+
+class TestCkptLedger:
+    def test_overhead_ratio_is_trend_only_and_family_disjoint(self):
+        from predictionio_tpu.obs import perfledger
+
+        bench = {
+            "ckptResume": {
+                "ok": True,
+                "overheadRatio": 1.07,
+                "trainShards": 2,
+                "resumeShards": 4,
+                "killStep": 1,
+                "resumedFrom": 1,
+                "resumeS": 2.5,
+                "plainS": 3.0,
+                "ckptS": 3.2,
+                "snapshotS": 0.04,
+                "written": 3,
+                "dropped": 0,
+                "errors": 0,
+                "maxAbsDiff": 1e-5,
+                "device": "cpu",
+            },
+            "shardedTrain": {
+                "ok": True,
+                "counts": {"4": {"trainS": 4.0, "rmse": 0.9,
+                                 "device": "cpu"}},
+            },
+        }
+        records = perfledger.ckpt_records(bench)
+        assert [r["metric"] for r in records] == [
+            "train_ckpt_overhead_ratio"
+        ]
+        rec = records[0]
+        # NOT "s": the gate only compares lower-is-better "s"/"bytes",
+        # so checkpointing cost can trend but never fail a perf gate
+        assert rec["unit"] == "ratio"
+        assert rec["value"] == pytest.approx(1.07)
+        assert rec["extra"]["resumedFrom"] == 1
+        assert rec["extra"]["written"] == 3
+        # disjoint from the sharded-train family even at the same scale
+        sharded = perfledger.sharded_records(bench)[0]
+        assert perfledger.comparable_key(rec) != (
+            perfledger.comparable_key(sharded)
+        )
+
+    def test_failed_or_missing_drill_records_nothing(self):
+        from predictionio_tpu.obs import perfledger
+
+        assert perfledger.ckpt_records({}) == []
+        assert perfledger.ckpt_records(
+            {"ckptResume": {"ok": False, "overheadRatio": 1.1}}
+        ) == []
+        assert perfledger.ckpt_records(
+            {"ckptResume": {"ok": True, "overheadRatio": None}}
+        ) == []
+
+    def test_block_rides_bench_record_extras(self):
+        from predictionio_tpu.obs import perfledger
+
+        record = perfledger.bench_to_record(
+            {"metric": "als_train_s", "value": 9.0,
+             "ckptResume": {"ok": True, "overheadRatio": 1.05}}
+        )
+        assert record["extra"]["ckptResume"]["overheadRatio"] == 1.05

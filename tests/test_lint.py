@@ -179,7 +179,7 @@ class TestRobustFixtures:
         ["no_timeout_clean.py", "bare_sleep_retry_clean.py",
          "rename_no_fsync_clean.py", "unbounded_retry_clean.py",
          "unbounded_cache_clean.py", "cutover_no_watermark_clean.py",
-         "fallback_swallows_clean.py"],
+         "fallback_swallows_clean.py", "nonatomic_checkpoint_clean.py"],
     )
     def test_clean_twin_has_no_findings(self, fixture):
         path = os.path.join(FIXTURES, fixture)
@@ -294,6 +294,59 @@ class TestRobustFixtures:
         findings = [
             f for f in lint_file(path, source=mutated)
             if f.rule_id == "robust-fallback-swallows" and not f.suppressed
+        ]
+        assert len(findings) == 1, [(f.rule_id, f.line) for f in findings]
+
+    def test_nonatomic_checkpoint_bad_fires_on_all_marked_writes(self):
+        """The bad twin carries FOUR raw-write shapes across two
+        checkpoint-marked scopes (np.save to the final path, open-w +
+        json.dump, open-wb in a persist method); each fires exactly
+        robust-nonatomic-checkpoint at its marked line."""
+        path = os.path.join(FIXTURES, "nonatomic_checkpoint_bad.py")
+        findings = _unsuppressed(path)
+        assert [f.rule_id for f in findings] == [
+            "robust-nonatomic-checkpoint"
+        ] * 4, [(f.rule_id, f.line) for f in findings]
+        with open(path) as fh:
+            marked = [
+                lineno for lineno, line in enumerate(fh, start=1)
+                if "# BAD:" in line
+            ]
+        assert sorted(f.line for f in findings) == marked
+
+    def test_ckpt_store_is_the_clean_exemplar(self, package_result):
+        """ckpt/store.py's save path IS the rule's target shape (the
+        name gate engages on save/_save_files, both write checkpoint
+        files) yet carries zero findings: every byte goes through
+        atomic_write_bytes, which is exactly the commit evidence the
+        rule demands."""
+        findings = _package_findings(
+            package_result, "ckpt/store.py",
+            "robust-nonatomic-checkpoint",
+        )
+        assert findings == [], (
+            f"ckpt/store.py regressed its exemplar status: "
+            f"{[(f.rule_id, f.line) for f in findings]}"
+        )
+
+    def test_ckpt_store_mutated_raw_write_is_caught(self):
+        """Swap the store's one atomic per-file write for a raw
+        open().write() and the rule bites — proof the exemplar above is
+        load-bearing, not accidentally exempt."""
+        path = os.path.join(PACKAGE, "ckpt", "store.py")
+        with open(path) as fh:
+            source = fh.read()
+        anchor = "atomic_write_bytes(os.path.join(d, fname), data)"
+        mutated = source.replace(
+            anchor,
+            'open(os.path.join(d, fname), "wb").write(data)',
+            1,
+        )
+        assert mutated != source, "mutation anchor drifted out of source"
+        findings = [
+            f for f in lint_file(path, source=mutated)
+            if f.rule_id == "robust-nonatomic-checkpoint"
+            and not f.suppressed
         ]
         assert len(findings) == 1, [(f.rule_id, f.line) for f in findings]
 

@@ -276,12 +276,33 @@ class TestLoudConflicts:
                     rank=2, num_iterations=1, shards=2, distributed=True
                 )
             ).train(None, pd)
+        # checkpoint_every + shards is SUPPORTED since ISSUE 20 (the
+        # sharded trainer snapshots canonical factors); without a
+        # workflow checkpoint store it simply trains uncheckpointed
+        model = ALSAlgorithm(
+            ALSAlgorithmParams(
+                rank=2, num_iterations=1, shards=2, checkpoint_every=1
+            )
+        ).train(None, pd)
+        assert model.user_factors.shape[0] == 3
+
+    def test_negative_checkpoint_every(self):
+        u, i, v = self._tiny()
         with pytest.raises(ValueError, match="checkpoint_every"):
-            ALSAlgorithm(
-                ALSAlgorithmParams(
-                    rank=2, num_iterations=1, shards=2, checkpoint_every=1
-                )
-            ).train(None, pd)
+            als_train_sharded(
+                u, i, v, 3, 2,
+                ALSConfig(rank=4, iterations=1),
+                shards=2, checkpoint_every=-1,
+            )
+
+    def test_checkpoint_cadence_without_store(self):
+        u, i, v = self._tiny()
+        with pytest.raises(ValueError, match="checkpoint"):
+            als_train_sharded(
+                u, i, v, 3, 2,
+                ALSConfig(rank=4, iterations=1),
+                shards=2, checkpoint=None, checkpoint_every=1,
+            )
 
 
 class TestProfileEvidence:
